@@ -1,0 +1,117 @@
+"""paddlepaddle_tpu — a TPU-native deep-learning framework with PaddlePaddle's
+capabilities, built from scratch on JAX/XLA/Pallas/pjit.
+
+The public namespace mirrors ``paddle.*`` (reference: python/paddle/__init__.py)
+so reference users can switch with ``import paddlepaddle_tpu as paddle``.
+Compute lowers to XLA HLO (MXU matmuls, fused elementwise) with Pallas kernels
+for the fused hot ops; distribution is GSPMD mesh sharding over ICI/DCN.
+"""
+
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+import jax as _jax
+
+# paddle semantics: int64 indices / float64 on request. Floats still default
+# to float32 (bfloat16 in AMP) — creation paths coerce explicitly, so enabling
+# x64 does not leak f64 into compute.
+_jax.config.update("jax_enable_x64", True)
+
+from .core import (  # noqa: F401
+    Parameter,
+    Tensor,
+    enable_grad,
+    get_default_dtype,
+    grad,
+    no_grad,
+    set_default_dtype,
+    set_grad_enabled,
+)
+from .core.device import (  # noqa: F401
+    CPUPlace,
+    CUDAPlace,
+    TPUPlace,
+    get_device,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+    is_compiled_with_xpu,
+    set_device,
+)
+from .core.dtype import (  # noqa: F401
+    bfloat16,
+    bool_ as bool8,
+    complex64,
+    complex128,
+    float8_e4m3fn,
+    float8_e5m2,
+    float16,
+    float32,
+    float64,
+    int8,
+    int16,
+    int32,
+    int64,
+    uint8,
+)
+from .core.flags import get_flags, set_flags  # noqa: F401
+from .core.random import get_rng_state, seed, set_rng_state  # noqa: F401
+
+# ops namespace (also patches Tensor methods)
+from .ops import comparison as _cmp  # noqa: F401
+from .ops import creation as _creation
+from .ops import linalg as _linalg
+from .ops import manipulation as _manip
+from .ops import math as _math
+from .ops import reduction as _reduction
+from .ops import search as _search
+
+_OP_MODULES = (_creation, _math, _reduction, _manip, _cmp, _linalg, _search)
+_globals = globals()
+for _mod in _OP_MODULES:
+    for _name in dir(_mod):
+        if _name.startswith("_"):
+            continue
+        _obj = getattr(_mod, _name)
+        if callable(_obj) and getattr(_obj, "__module__", "").startswith("paddlepaddle_tpu"):
+            _globals.setdefault(_name, _obj)
+
+# submodules (populated as the build progresses)
+from . import amp  # noqa: E402,F401
+from . import autograd  # noqa: E402,F401
+from . import distributed  # noqa: E402,F401
+from . import framework  # noqa: E402,F401
+from . import io  # noqa: E402,F401
+from . import jit  # noqa: E402,F401
+from . import linalg  # noqa: E402,F401
+from . import metric  # noqa: E402,F401
+from . import nn  # noqa: E402,F401
+from . import optimizer  # noqa: E402,F401
+from .framework.io_api import load, save  # noqa: E402,F401
+from .jit.api import to_static  # noqa: E402,F401
+
+# paddle.device module alias
+from .core import device  # noqa: E402,F401
+
+DataParallel = distributed.DataParallel
+
+
+def disable_static(place=None):
+    """Dygraph is the only eager mode; kept for API compatibility."""
+
+
+def enable_static():
+    raise NotImplementedError(
+        "The legacy static-graph mode is not provided; use "
+        "paddlepaddle_tpu.jit.to_static (XLA compilation) instead."
+    )
+
+
+def in_dynamic_mode():
+    return True
+
+
+def is_grad_enabled():
+    from .core.autograd import is_grad_enabled as _ige
+
+    return _ige()

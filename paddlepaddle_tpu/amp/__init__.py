@@ -1,0 +1,117 @@
+"""AMP — mixed precision (reference: python/paddle/amp/).
+
+On TPU the default low-precision dtype is bfloat16 (no loss scaling needed);
+fp16 is supported with GradScaler dynamic loss scaling for parity with the
+reference (python/paddle/amp/grad_scaler.py:657).
+
+O1: only white-list ops (matmul/conv/…) run in low precision — implemented as
+a cast hook on the eager dispatcher (the analogue of AmpAutoCast inserted in
+every generated ad_func, eager_gen.py:642).
+O2: whole-network low precision with fp32 master weights in the optimizer
+(multi_precision).
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.dispatch import set_amp_cast_hook
+from .amp_lists import BLACK_LIST, WHITE_LIST
+from .grad_scaler import GradScaler  # noqa: F401
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.level = "O1"
+        self.dtype = jnp.bfloat16
+
+
+_state = _AmpState()
+
+
+def _hook(op_name, datas, tensor_pos):
+    if not _state.enabled:
+        return datas
+    low = _state.dtype
+    if _state.level == "O1":
+        if op_name not in WHITE_LIST:
+            # black list ops run in fp32: promote low-precision float inputs
+            if op_name in BLACK_LIST:
+                return [
+                    d.astype(jnp.float32)
+                    if i in tensor_pos and hasattr(d, "dtype") and d.dtype in (jnp.bfloat16, jnp.float16)
+                    else d
+                    for i, d in enumerate(datas)
+                ]
+            return datas
+        cast_to = low
+    else:  # O2
+        if op_name in BLACK_LIST:
+            cast_to = jnp.float32
+        else:
+            cast_to = low
+    out = []
+    for i, d in enumerate(datas):
+        if i in tensor_pos and hasattr(d, "dtype") and dtypes.is_floating_point(d.dtype) and d.dtype != jnp.float64:
+            out.append(d.astype(cast_to) if d.dtype != cast_to else d)
+        else:
+            out.append(d)
+    return out
+
+
+set_amp_cast_hook(_hook)
+
+
+@contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    """paddle.amp.auto_cast equivalent (python/paddle/amp/auto_cast.py:462)."""
+    prev = (_state.enabled, _state.level, _state.dtype)
+    added_white = set(custom_white_list or ())
+    added_black = set(custom_black_list or ())
+    WHITE_LIST.update(added_white)
+    BLACK_LIST.update(added_black)
+    _state.enabled = enable
+    _state.level = level
+    _state.dtype = dtypes.convert_dtype(dtype)
+    try:
+        yield
+    finally:
+        _state.enabled, _state.level, _state.dtype = prev
+        WHITE_LIST.difference_update(added_white)
+        BLACK_LIST.difference_update(added_black)
+
+
+amp_guard = auto_cast
+
+
+def is_bfloat16_supported(device=None):
+    return True
+
+
+def is_float16_supported(device=None):
+    return True
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """paddle.amp.decorate: O2 casts model params to low precision and enables
+    optimizer master weights."""
+    low = dtypes.convert_dtype(dtype)
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=low)
+        if optimizers is not None:
+            opt_list = [optimizers] if not isinstance(optimizers, (list, tuple)) else optimizers
+            for o in opt_list:
+                o._multi_precision = True if master_weight is None else master_weight
+    if optimizers is None:
+        return models
+    return models, optimizers

@@ -1,0 +1,54 @@
+"""AMP op lists (reference: python/paddle/amp/amp_lists.py:33-109).
+
+White list: compute-bound ops that are numerically safe and fast in bf16/fp16
+(MXU ops). Black list: reductions/exponentials that need fp32. Names match the
+op_name passed by the dispatcher (the pure-fn __name__)."""
+
+WHITE_LIST = {
+    "matmul",
+    "bmm",
+    "mm",
+    "mv",
+    "linear",
+    "conv1d",
+    "conv2d",
+    "conv3d",
+    "conv2d_transpose",
+    "einsum",
+    "flash_attention",
+    "addmm",
+}
+
+BLACK_LIST = {
+    "exp",
+    "square",
+    "log",
+    "log2",
+    "log10",
+    "log1p",
+    "mean",
+    "sum",
+    "cos_sim",
+    "softmax",
+    "log_softmax",
+    "softmax_with_cross_entropy",
+    "cross_entropy",
+    "nll_loss",
+    "layer_norm",
+    "rms_norm",
+    "batch_norm",
+    "group_norm",
+    "cumsum",
+    "logsumexp",
+    "erf",
+    "erfinv",
+    "pow",
+    "norm",
+    "var",
+    "std",
+    "renorm",
+    "mse_loss",
+    "kl_div",
+    "binary_cross_entropy",
+    "binary_cross_entropy_with_logits",
+}

@@ -1,0 +1,108 @@
+"""``paddle.autograd`` equivalent: backward, PyLayer, functional jacobian/hessian."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd as _ag
+from ..core.autograd import backward, grad  # noqa: F401
+from ..core.autograd import no_grad, set_grad_enabled  # noqa: F401
+from ..core.dispatch import unwrap, wrap
+from ..core.tensor import Tensor
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+    def mark_not_inplace(self, *tensors):
+        self.not_inplace_tensors = tensors
+
+
+class PyLayer:
+    """Custom autograd op (reference: python/paddle/autograd/py_layer.py:282).
+
+    Subclass with static ``forward(ctx, *args)`` and ``backward(ctx, *grads)``.
+    The backward feeds the eager tape as a GradNode — the analogue of the
+    reference's PyLayer GradNode (paddle/fluid/eager/pylayer/)."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with _ag.no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outputs, (list, tuple))
+        out_list = [outputs] if single else list(outputs)
+
+        diff_inputs = [a for a in args if isinstance(a, Tensor)
+                       and not a.stop_gradient and _ag.is_grad_enabled()]
+        if diff_inputs:
+            tensor_args = [a for a in args if isinstance(a, Tensor)]
+
+            def vjp_fn(cotangents):
+                cts = [wrap(c) for c in cotangents]
+                grads = cls.backward(ctx, *cts)
+                if not isinstance(grads, (list, tuple)):
+                    grads = (grads,)
+                # backward returns one grad per tensor input, in order
+                gmap = {id(t): g for t, g in zip(tensor_args, grads)}
+                return tuple(
+                    unwrap(gmap[id(d)]) if gmap.get(id(d)) is not None else None
+                    for d in diff_inputs
+                )
+
+            node = _ag.GradNode(
+                cls.__name__,
+                vjp_fn,
+                tuple(diff_inputs),
+                [(tuple(o._data.shape), o._data.dtype) for o in out_list],
+            )
+            for i, o in enumerate(out_list):
+                o.stop_gradient = False
+                o._grad_node = node
+                o._out_index = i
+        return outputs
+
+
+class LegacyPyLayer(PyLayer):
+    pass
+
+
+def jacobian(ys, xs, create_graph=False, batch_axis=None):
+    """Functional jacobian via jax.jacrev (reference: python/paddle/autograd/autograd.py:461)."""
+    raise NotImplementedError(
+        "Use paddlepaddle_tpu.incubate.autograd.jacobian(func, xs) — the "
+        "functional form; tape-based jacobian is not provided."
+    )
+
+
+def functional_jacobian(func, *xs):
+    f = lambda *a: unwrap(func(*[wrap(x) for x in a]))
+    jac = jax.jacrev(f, argnums=tuple(range(len(xs))))(*[unwrap(x) for x in xs])
+    return jax.tree_util.tree_map(wrap, jac)
+
+
+def functional_hessian(func, *xs):
+    f = lambda *a: unwrap(func(*[wrap(x) for x in a]))
+    h = jax.hessian(f, argnums=tuple(range(len(xs))))(*[unwrap(x) for x in xs])
+    return jax.tree_util.tree_map(wrap, h)

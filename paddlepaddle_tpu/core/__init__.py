@@ -1,0 +1,7 @@
+"""Core runtime: dtype system, Tensor, autograd tape, dispatch, device, flags, RNG."""
+
+from . import autograd, device, dispatch, dtype, flags, random  # noqa: F401
+from .autograd import enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
+from .dispatch import apply_op, defop, unwrap, wrap  # noqa: F401
+from .dtype import convert_dtype, get_default_dtype, set_default_dtype  # noqa: F401
+from .tensor import Parameter, Tensor  # noqa: F401

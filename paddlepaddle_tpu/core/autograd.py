@@ -1,0 +1,297 @@
+"""Eager define-by-run autograd engine.
+
+Reference behavior being matched (see SURVEY.md §2.4): per-tensor autograd meta +
+reverse graph of grad nodes (paddle/fluid/eager/grad_node_info.h:197), topological
+backward execution (paddle/fluid/eager/backward.cc:105,439), leaf accumulation
+(paddle/fluid/eager/accumulation/accumulation_node.h), tensor hooks.
+
+TPU-native design: instead of hand-written per-op GradNode classes generated from
+backward.yaml, every eager op call captures its cotangent function from
+``jax.vjp`` of the op's pure-jnp implementation. The "tape" is therefore exact
+(same VJPs jax uses under jit) and requires zero per-op backward code. Under
+``jit`` capture the tape is bypassed entirely — differentiation of compiled
+train steps uses ``jax.grad`` on the functional form, which is the idiomatic
+XLA path (whole-graph AD, fusable by the compiler).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_state = _GradState()
+
+
+def is_grad_enabled() -> bool:
+    return _state.enabled
+
+
+def set_grad_enabled(mode: bool):
+    _state.enabled = bool(mode)
+
+
+class no_grad:
+    """Context manager / decorator disabling gradient recording.
+
+    Mirrors ``paddle.no_grad`` (python/paddle/base/dygraph/base.py).
+    """
+
+    def __enter__(self):
+        self._prev = _state.enabled
+        _state.enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _state.enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+
+        wrapper.__name__ = getattr(fn, "__name__", "wrapped")
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = _state.enabled
+        _state.enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _state.enabled = self._prev
+        return False
+
+
+class GradNode:
+    """One recorded op: holds the vjp closure and edges to producer tensors.
+
+    ``inputs`` are exactly the differentiable input tensors the vjp closes
+    over (the analogue of the reference's TensorWrapper-saved forward inputs).
+    """
+
+    __slots__ = (
+        "name",
+        "vjp_fn",
+        "inputs",
+        "out_avals",
+        "out_grads",
+        "released",
+    )
+
+    def __init__(self, name, vjp_fn, inputs, out_avals):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.inputs: Tuple[Any, ...] = inputs
+        self.out_avals = out_avals  # list of (shape, dtype) per output
+        self.out_grads: List[Optional[jnp.ndarray]] = [None] * len(out_avals)
+        self.released = False
+
+    def accumulate(self, index: int, grad):
+        cur = self.out_grads[index]
+        self.out_grads[index] = grad if cur is None else cur + grad
+
+    def materialized_out_grads(self):
+        outs = []
+        for (shape, dtype), g in zip(self.out_avals, self.out_grads):
+            if g is None:
+                g = jnp.zeros(shape, dtype)
+            outs.append(g)
+        return tuple(outs)
+
+    def release(self):
+        self.vjp_fn = None
+        self.out_grads = [None] * len(self.out_avals)
+        self.released = True
+
+
+def _topo_collect(root_nodes, stop_nodes=None):
+    """Collect the reachable reverse subgraph and per-node consumer counts.
+
+    ``deps[node]`` = number of in-subgraph edges that feed gradient INTO node
+    (i.e. consumers of node's outputs). A node is ready once all those have run.
+    """
+    stop_nodes = stop_nodes or frozenset()
+    deps = {}
+    visited = set()
+    stack = list(root_nodes)
+    for n in root_nodes:
+        deps.setdefault(n, 0)
+    while stack:
+        node = stack.pop()
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        if node in stop_nodes:
+            continue
+        for t in node.inputs:
+            prod = t._grad_node
+            if prod is not None:
+                deps[prod] = deps.get(prod, 0) + 1
+                stack.append(prod)
+    return deps
+
+
+def run_backward(
+    tensors: Sequence,
+    grad_tensors: Sequence,
+    retain_graph: bool = False,
+    accumulate_into_leaves: bool = True,
+    target_tensors: Optional[Sequence] = None,
+):
+    """Execute reverse accumulation from ``tensors`` seeded with ``grad_tensors``.
+
+    If ``target_tensors`` is given, additionally capture the cotangents arriving
+    at those tensors (used by :func:`grad`); returns that list (None where
+    unreached). Mirrors RunBackward/GeneralGrad in the reference
+    (paddle/fluid/eager/backward.cc:105, general_grad.h).
+    """
+    target_ids = {}
+    captured = None
+    if target_tensors is not None:
+        captured = [None] * len(target_tensors)
+        for i, t in enumerate(target_tensors):
+            target_ids.setdefault(id(t), []).append(i)
+
+    def capture(tensor, g):
+        if captured is not None and id(tensor) in target_ids:
+            for i in target_ids[id(tensor)]:
+                captured[i] = g if captured[i] is None else captured[i] + g
+
+    # Seed
+    roots = []
+    for t, g in zip(tensors, grad_tensors):
+        capture(t, g)
+        node = t._grad_node
+        if node is None:
+            if accumulate_into_leaves and not t.stop_gradient:
+                t._accumulate_grad(g)
+            continue
+        if node.released:
+            raise RuntimeError(
+                "Trying to backward through the graph a second time after it "
+                "was freed. Specify retain_graph=True on the first backward."
+            )
+        node.accumulate(t._out_index, g)
+        roots.append(node)
+
+    deps = _topo_collect(roots)
+    ready = [n for n in dict.fromkeys(roots) if deps.get(n, 0) == 0]
+    seen_ready = set(id(n) for n in ready)
+    while ready:
+        node = ready.pop()
+        in_grads = node.vjp_fn(node.materialized_out_grads())
+        for t, g in zip(node.inputs, in_grads):
+            if g is None:
+                continue
+            capture(t, g)
+            prod = t._grad_node
+            if prod is None:
+                if accumulate_into_leaves and not t.stop_gradient:
+                    t._accumulate_grad(g)
+            else:
+                prod.accumulate(t._out_index, g)
+                deps[prod] -= 1
+                if deps[prod] == 0 and id(prod) not in seen_ready:
+                    seen_ready.add(id(prod))
+                    ready.append(prod)
+        if not retain_graph:
+            node.release()
+    return captured
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """``paddle.autograd.backward`` equivalent."""
+    from .tensor import Tensor  # local import to avoid cycle
+
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+    seeds = []
+    for t, g in zip(tensors, grad_tensors):
+        if g is None:
+            if t._data.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    "pass grad_tensors for non-scalar tensors"
+                )
+            g = jnp.ones_like(t._data)
+        else:
+            g = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+        seeds.append(g)
+    run_backward(tensors, seeds, retain_graph=retain_graph)
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=None,
+    create_graph=False,
+    only_inputs=True,
+    allow_unused=False,
+    no_grad_vars=None,
+):
+    """``paddle.grad`` equivalent (python/paddle/base/dygraph/base.py:656).
+
+    ``create_graph=True`` (higher-order grad) is supported through the
+    functional path: recompute via jax.grad is recommended for higher-order;
+    the tape path raises for now.
+    """
+    from .tensor import Tensor
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True on the eager tape is not supported yet; use "
+            "paddlepaddle_tpu.incubate.autograd (functional jax.grad/jacobian/"
+            "hessian) for higher-order derivatives."
+        )
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+    seeds = []
+    for t, g in zip(outputs, grad_outputs):
+        if g is None:
+            g = jnp.ones_like(t._data)
+        else:
+            g = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+        seeds.append(g)
+    if retain_graph is None:
+        retain_graph = False
+    captured = run_backward(
+        outputs,
+        seeds,
+        retain_graph=retain_graph,
+        accumulate_into_leaves=False,
+        target_tensors=inputs,
+    )
+    results = []
+    for t, g in zip(inputs, captured):
+        if g is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "One of the differentiated tensors appears to not have "
+                    "been used in the graph. Set allow_unused=True if this "
+                    "is intended."
+                )
+            results.append(None)
+        else:
+            results.append(Tensor._from_data(g, stop_gradient=True))
+    return results

@@ -1,0 +1,120 @@
+"""Single eager-op dispatcher — the whole framework's "kernel launch" path.
+
+Reference analogue: the generated ``xxx_ad_func`` chain (SURVEY.md §3.1:
+python_c wrapper → AMP autocast → GradNode capture → PHI kernel). Here one
+generic function does all of it:
+
+  1. unwrap Tensors to jax.Arrays,
+  2. apply the active AMP cast policy (see paddlepaddle_tpu.amp),
+  3. run the pure-jnp op — XLA is the kernel library, dispatch/fusion is its job,
+  4. if any differentiable input is being traced for grad, capture the op's
+     ``jax.vjp`` closure into a GradNode (TensorWrapper equivalent),
+  5. wrap outputs back into Tensors.
+
+No per-op codegen is needed: shape/dtype inference (InferMeta) comes for free
+from jnp, VJPs from jax, SPMD rules from GSPMD sharding propagation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from . import autograd as ag
+from .dtype import is_differentiable
+from .tensor import Tensor
+
+# AMP hook: paddlepaddle_tpu.amp installs a callable (op_name, datas) -> datas.
+_amp_cast_hook = None
+
+
+def set_amp_cast_hook(hook):
+    global _amp_cast_hook
+    _amp_cast_hook = hook
+
+
+def _requires_grad(t: Tensor) -> bool:
+    return (not t.stop_gradient) and is_differentiable(t._data.dtype)
+
+
+def apply_op(fn: Callable, *args, op_name: str = None, **kwargs) -> Any:
+    """Run ``fn`` (a pure function of jax arrays) on Tensor/array arguments.
+
+    Tensors may appear anywhere in args/kwargs (including in lists/tuples).
+    Returns Tensors mirroring fn's output structure.
+    """
+    name = op_name or getattr(fn, "__name__", "op")
+    leaves, treedef = jax.tree_util.tree_flatten(
+        (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor)
+    )
+    tensor_pos = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
+    datas = [l._data if isinstance(l, Tensor) else l for l in leaves]
+
+    if _amp_cast_hook is not None and tensor_pos:
+        datas = _amp_cast_hook(name, datas, tensor_pos)
+
+    grad_on = ag.is_grad_enabled()
+    diff_pos = [i for i in tensor_pos if grad_on and _requires_grad(leaves[i])]
+
+    def run(vals):
+        a, k = jax.tree_util.tree_unflatten(treedef, vals)
+        return fn(*a, **k)
+
+    if not diff_pos:
+        out = run(datas)
+        return jax.tree_util.tree_map(
+            lambda x: Tensor._from_data(x, stop_gradient=True), out
+        )
+
+    def pure(*diff_vals):
+        vals = list(datas)
+        for p, v in zip(diff_pos, diff_vals):
+            vals[p] = v
+        return run(vals)
+
+    primal_out, vjp_fn = jax.vjp(pure, *[datas[p] for p in diff_pos])
+
+    out_leaves, out_treedef = jax.tree_util.tree_flatten(primal_out)
+    node = ag.GradNode(
+        name,
+        lambda cts: vjp_fn(jax.tree_util.tree_unflatten(out_treedef, list(cts))),
+        tuple(leaves[p] for p in diff_pos),
+        [(tuple(o.shape), o.dtype) for o in out_leaves],
+    )
+    wrapped = []
+    for i, o in enumerate(out_leaves):
+        t = Tensor._from_data(o, stop_gradient=False)
+        t._grad_node = node
+        t._out_index = i
+        wrapped.append(t)
+    return jax.tree_util.tree_unflatten(out_treedef, wrapped)
+
+
+def defop(fn: Callable = None, *, name: str = None):
+    """Decorator turning a pure-jnp function into an eager Tensor op."""
+
+    def deco(f):
+        op_name = name or f.__name__
+
+        def wrapper(*args, **kwargs):
+            return apply_op(f, *args, op_name=op_name, **kwargs)
+
+        wrapper.__name__ = op_name
+        wrapper.__doc__ = f.__doc__
+        wrapper.__wrapped__ = f
+        return wrapper
+
+    if fn is not None:
+        return deco(fn)
+    return deco
+
+
+def unwrap(x):
+    """Tensor → jax.Array (identity on anything else)."""
+    return x._data if isinstance(x, Tensor) else x
+
+
+def wrap(x, stop_gradient=True):
+    return Tensor._from_data(x, stop_gradient=stop_gradient)

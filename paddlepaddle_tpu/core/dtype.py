@@ -1,0 +1,124 @@
+"""Dtype system.
+
+Mirrors the reference's dtype surface (paddle/phi/common/data_type.h and the
+python-visible names like ``paddle.float32``) but is natively a thin mapping
+onto :mod:`jax.numpy` dtypes — there is no custom dtype object because XLA is
+the only backend and jnp dtypes are canonical on TPU.
+
+bfloat16 is a first-class citizen (the TPU-native 16-bit float); float16 is
+supported but bf16 is the default half precision everywhere (AMP, bench
+configs).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects (numpy dtype instances, which is what jax uses).
+bfloat16 = jnp.bfloat16
+float16 = jnp.float16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+uint16 = jnp.uint16
+uint32 = jnp.uint32
+uint64 = jnp.uint64
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+float8_e4m3fn = jnp.float8_e4m3fn
+float8_e5m2 = jnp.float8_e5m2
+
+_NAME_TO_DTYPE = {
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float16": float16,
+    "fp16": float16,
+    "half": float16,
+    "float32": float32,
+    "fp32": float32,
+    "float": float32,
+    "float64": float64,
+    "fp64": float64,
+    "double": float64,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "uint8": uint8,
+    "uint16": uint16,
+    "uint32": uint32,
+    "uint64": uint64,
+    "bool": bool_,
+    "complex64": complex64,
+    "complex128": complex128,
+    "float8_e4m3fn": float8_e4m3fn,
+    "float8_e5m2": float8_e5m2,
+}
+
+_FLOATING = {bfloat16, float16, float32, float64, float8_e4m3fn, float8_e5m2}
+_COMPLEX = {complex64, complex128}
+_INTEGER = {int8, int16, int32, int64, uint8, uint16, uint32, uint64}
+
+
+def convert_dtype(dtype) -> np.dtype:
+    """Normalize any dtype spec (str, np/jnp dtype, python type) to a numpy dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _NAME_TO_DTYPE:
+            raise ValueError(f"Unknown dtype name: {dtype!r}")
+        return np.dtype(_NAME_TO_DTYPE[dtype])
+    if dtype is float:
+        return np.dtype(float32)
+    if dtype is int:
+        return np.dtype(int64)
+    if dtype is bool:
+        return np.dtype(bool_)
+    return np.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    d = np.dtype(dtype)
+    return d.name
+
+
+def is_floating_point(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return any(d == np.dtype(f) for f in _FLOATING)
+
+
+def is_integer(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return any(d == np.dtype(f) for f in _INTEGER)
+
+
+def is_complex(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return any(d == np.dtype(f) for f in _COMPLEX)
+
+
+def is_differentiable(dtype) -> bool:
+    return is_floating_point(dtype) or is_complex(dtype)
+
+
+# Default dtype management (reference: paddle.set_default_dtype,
+# python/paddle/base/framework.py).
+_default_dtype = np.dtype(float32)
+
+
+def set_default_dtype(dtype):
+    global _default_dtype
+    d = convert_dtype(dtype)
+    if not is_floating_point(d) and not is_complex(d):
+        raise TypeError("default dtype must be floating point or complex")
+    _default_dtype = d
+
+
+def get_default_dtype() -> np.dtype:
+    return _default_dtype

@@ -1,0 +1,85 @@
+"""Global runtime flags registry.
+
+Reference: paddle/common/flags.h:38 (PHI_DEFINE_EXPORTED_* macros; 185 flags in
+paddle/common/flags.cc) + python paddle.set_flags/get_flags
+(python/paddle/base/framework.py:132). Same semantics: typed flags, env-var
+override at first read (FLAGS_xxx), settable at runtime from python.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+
+class _Flag:
+    __slots__ = ("name", "default", "type", "help", "value", "env_read")
+
+    def __init__(self, name, default, help_):
+        self.name = name
+        self.default = default
+        self.type = type(default)
+        self.help = help_
+        self.value = default
+        self.env_read = False
+
+
+_registry: Dict[str, _Flag] = {}
+
+
+def define_flag(name: str, default: Any, help_: str = ""):
+    if not name.startswith("FLAGS_"):
+        name = "FLAGS_" + name
+    if name in _registry:
+        return _registry[name]
+    f = _Flag(name, default, help_)
+    env = os.environ.get(name)
+    if env is not None:
+        f.value = _parse(env, f.type)
+        f.env_read = True
+    _registry[name] = f
+    return f
+
+
+def _parse(s: str, t: type):
+    if t is bool:
+        return s.lower() in ("1", "true", "yes", "on")
+    return t(s)
+
+
+def set_flags(flags: Dict[str, Any]):
+    for k, v in flags.items():
+        if not k.startswith("FLAGS_"):
+            k = "FLAGS_" + k
+        if k not in _registry:
+            define_flag(k, v)
+        else:
+            f = _registry[k]
+            f.value = _parse(v, f.type) if isinstance(v, str) and f.type is not str else f.type(v)
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for k in flags:
+        key = k if k.startswith("FLAGS_") else "FLAGS_" + k
+        if key not in _registry:
+            raise ValueError(f"Unknown flag {k}")
+        out[k] = _registry[key].value
+    return out
+
+
+def flag_value(name: str):
+    key = name if name.startswith("FLAGS_") else "FLAGS_" + name
+    return _registry[key].value
+
+
+# Core flags (subset of paddle/common/flags.cc relevant to this runtime).
+define_flag("check_nan_inf", False, "scan op outputs for NaN/Inf (debugging)")
+define_flag("benchmark", False, "synchronize after each op for timing")
+define_flag("use_pallas_kernels", True, "use Pallas TPU kernels for fused ops")
+define_flag("flash_attn_block_q", 512, "pallas flash-attn q block")
+define_flag("flash_attn_block_kv", 512, "pallas flash-attn kv block")
+define_flag("eager_delete_tensor_gb", 0.0, "compat no-op (XLA owns memory)")
+define_flag("allocator_strategy", "xla", "compat: allocation handled by XLA runtime")

@@ -1,0 +1,358 @@
+"""The eager Tensor: a paddle-semantics handle over a ``jax.Array``.
+
+Reference surface being matched: the eager Tensor bound in
+paddle/fluid/pybind/eager.cc + method patches in
+python/paddle/base/dygraph/tensor_patch_methods.py (``.numpy()``, ``.item()``,
+``.backward()``, ``.grad``, ``stop_gradient``, in-place ``set_value`` …).
+
+TPU-native design: the payload is always a ``jax.Array`` (device-resident,
+possibly sharded over a mesh) or a jax tracer (inside ``jit`` capture — the
+same Tensor code traces to XLA). Mutation (in-place ops, optimizer updates)
+rebinds ``_data``; under XLA there is no aliasing cost because donation handles
+buffer reuse at jit boundaries. Most methods are monkey-patched from
+``paddlepaddle_tpu.ops`` (the analogue of paddle's math_op_patch).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtypes
+from .autograd import backward as _ag_backward
+
+
+class Tensor:
+    __slots__ = (
+        "_data",
+        "stop_gradient",
+        "_grad",
+        "_grad_node",
+        "_out_index",
+        "_retain_grads",
+        "name",
+        "persistable",
+        "_version",
+        "_hooks",
+        "__weakref__",
+    )
+
+    _counter = 0
+
+    def __init__(self, data=None, dtype=None, place=None, stop_gradient=True):
+        if data is None:
+            data = jnp.zeros([], dtypes.get_default_dtype())
+        self._data = _coerce(data, dtype)
+        self.stop_gradient = stop_gradient
+        self._grad = None
+        self._grad_node = None
+        self._out_index = 0
+        self._retain_grads = False
+        Tensor._counter += 1
+        self.name = f"generated_tensor_{Tensor._counter}"
+        self.persistable = False
+        self._version = 0
+        self._hooks = None
+
+    # -- construction -----------------------------------------------------
+    @classmethod
+    def _from_data(cls, data, stop_gradient=True, name=None):
+        t = cls.__new__(cls)
+        t._data = data
+        t.stop_gradient = stop_gradient
+        t._grad = None
+        t._grad_node = None
+        t._out_index = 0
+        t._retain_grads = False
+        cls._counter += 1
+        t.name = name or f"generated_tensor_{cls._counter}"
+        t.persistable = False
+        t._version = 0
+        t._hooks = None
+        return t
+
+    # -- meta -------------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    ndimension = ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def place(self):
+        from .device import _place_of
+
+        return _place_of(self._data)
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    def dim(self):
+        return self._data.ndim
+
+    def rank(self):
+        return self._data.ndim
+
+    def numel(self):
+        return self.size
+
+    def element_size(self):
+        return np.dtype(self._data.dtype).itemsize
+
+    # -- host interop ------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __jax_array__(self):
+        return self._data
+
+    def __len__(self):
+        if self._data.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __bool__(self):
+        return bool(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __index__(self):
+        return int(self.numpy())
+
+    def __hash__(self):
+        return id(self)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # -- autograd ----------------------------------------------------------
+    @property
+    def grad(self) -> Optional["Tensor"]:
+        if self._grad is None:
+            return None
+        return Tensor._from_data(self._grad, stop_gradient=True)
+
+    @grad.setter
+    def grad(self, value):
+        if value is None:
+            self._grad = None
+        else:
+            self._grad = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+
+    def _accumulate_grad(self, g):
+        if g.dtype != self._data.dtype:
+            g = g.astype(self._data.dtype)
+        if self._hooks:
+            from .tensor import Tensor as T
+
+            for hook in self._hooks.values():
+                out = hook(T._from_data(g, stop_gradient=True))
+                if out is not None:
+                    g = out._data if isinstance(out, T) else jnp.asarray(out)
+        self._grad = g if self._grad is None else self._grad + g
+
+    def backward(self, grad_tensor=None, retain_graph=False):
+        _ag_backward(self, grad_tensor, retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self._grad = None
+
+    clear_gradient = clear_grad
+    zero_grad = clear_grad
+
+    def retain_grads(self):
+        self._retain_grads = True
+
+    def register_hook(self, hook):
+        """Gradient hook on this (leaf) tensor; returns a removable handle."""
+        if self._hooks is None:
+            self._hooks = {}
+        key = len(self._hooks)
+        self._hooks[key] = hook
+
+        class _Handle:
+            def remove(_self):
+                self._hooks.pop(key, None)
+
+        return _Handle()
+
+    def detach(self) -> "Tensor":
+        return Tensor._from_data(self._data, stop_gradient=True, name=self.name)
+
+    def detach_(self):
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    # -- mutation ----------------------------------------------------------
+    def _replace_data(self, data):
+        self._data = data
+        self._version += 1
+
+    def set_value(self, value):
+        data = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+        if tuple(data.shape) != tuple(self._data.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {data.shape} vs {self._data.shape}"
+            )
+        self._replace_data(data.astype(self._data.dtype))
+        return self
+
+    def copy_(self, other, blocking=True):
+        return self.set_value(other)
+
+    # -- misc --------------------------------------------------------------
+    def pin_memory(self):
+        return self
+
+    def cuda(self, *a, **k):  # reference API compat; everything is device-resident
+        return self
+
+    def cpu(self):
+        from .device import to_device
+
+        return Tensor._from_data(to_device(self._data, "cpu"), self.stop_gradient)
+
+    def to(self, *args, **kwargs):
+        """`.to(dtype)`, `.to(device)`, `.to(device, dtype)` like the reference Layer.to."""
+        from .device import to_device
+
+        data = self._data
+        for a in list(args) + list(kwargs.values()):
+            if a is None:
+                continue
+            if isinstance(a, str) and not _is_dtype_str(a):
+                data = to_device(data, a)
+            else:
+                data = data.astype(dtypes.convert_dtype(a))
+        return Tensor._from_data(data, self.stop_gradient)
+
+    def value(self):
+        return self
+
+    def get_tensor(self):
+        return self
+
+    def _md5sum(self):
+        import hashlib
+
+        return hashlib.md5(self.numpy().tobytes()).hexdigest()
+
+    def __repr__(self):
+        grad_info = f", stop_gradient={self.stop_gradient}"
+        try:
+            data_str = np.array2string(
+                np.asarray(self._data), precision=8, separator=", "
+            )
+        except Exception:
+            data_str = f"<traced {self._data}>"
+        return (
+            f"Tensor(shape={self.shape}, dtype={dtypes.dtype_name(self.dtype)}"
+            f"{grad_info},\n       {data_str})"
+        )
+
+    __str__ = __repr__
+
+    # NOTE: arithmetic/relational/indexing methods are attached by
+    # paddlepaddle_tpu.ops._patch_tensor_methods() — keep this class minimal.
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference: python/paddle/base/framework.py EagerParamBase)."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip", "is_distributed")
+
+    def __init__(self, data, trainable=True, name=None):
+        data = data._data if isinstance(data, Tensor) else jnp.asarray(data)
+        super().__init__(data)
+        self.stop_gradient = not trainable
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+        self.is_distributed = False
+        self.persistable = True
+        if name:
+            self.name = name
+
+    @property
+    def requires_grad(self):
+        return self.trainable
+
+
+def _is_dtype_str(s: str) -> bool:
+    try:
+        dtypes.convert_dtype(s)
+        return True
+    except (ValueError, TypeError):
+        return False
+
+
+def _coerce(data, dtype=None):
+    if isinstance(data, Tensor):
+        data = data._data
+    if isinstance(data, (jax.Array,)) or hasattr(data, "aval"):
+        arr = data
+        if dtype is not None:
+            arr = arr.astype(dtypes.convert_dtype(dtype))
+        return arr
+    np_dtype = dtypes.convert_dtype(dtype) if dtype is not None else None
+    arr = np.asarray(data)
+    if np_dtype is None:
+        if arr.dtype == np.float64:
+            np_dtype = dtypes.get_default_dtype()
+        elif arr.dtype == np.int32:
+            np_dtype = np.dtype(np.int32)
+    return jnp.asarray(arr, dtype=np_dtype)
+
+
+# Register Tensor as a jax pytree so user functions over Tensors can be jitted
+# directly; only the payload is traced, autograd meta stays python-side.
+def _tensor_flatten(t: Tensor):
+    return (t._data,), (t.stop_gradient, t.name)
+
+
+def _tensor_unflatten(aux, children):
+    t = Tensor._from_data(children[0], stop_gradient=aux[0], name=aux[1])
+    return t
+
+
+jax.tree_util.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)
+jax.tree_util.register_pytree_node(
+    Parameter,
+    _tensor_flatten,
+    lambda aux, ch: Tensor._from_data(ch[0], stop_gradient=aux[0], name=aux[1]),
+)

@@ -1,0 +1,33 @@
+"""``distributed`` — GSPMD mesh parallelism (reference: python/paddle/distributed/).
+
+TPU-native design (SURVEY.md §2.6-2.7 mapping): one device mesh with named
+axes replaces NCCL process groups; placements (Shard/Replicate/Partial)
+become jax NamedShardings; collectives are emitted by XLA from shardings, and
+the explicit-collective python API maps to shard_map + psum/all_gather/
+ppermute over mesh axes."""
+
+from .collective import (  # noqa: F401
+    all_gather,
+    all_reduce,
+    all_to_all,
+    barrier,
+    broadcast,
+    get_group,
+    new_group,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+)
+from .env import (  # noqa: F401
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+    is_initialized,
+    parallel_device_count,
+)
+from .mesh import ProcessMesh, auto_mesh, get_mesh, set_mesh  # noqa: F401
+from .parallel import DataParallel  # noqa: F401
+from .placement import Partial, Placement, Replicate, Shard  # noqa: F401
+from .sharding_api import reshard, shard_layer, shard_optimizer, shard_tensor  # noqa: F401

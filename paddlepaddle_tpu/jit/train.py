@@ -1,0 +1,93 @@
+"""Compiled training step builder — the perf-critical path.
+
+This is the TPU-idiomatic training loop the reference reaches via
+dy2static + PirInterpreter: ONE jitted function of
+(params, opt_state, batch, key) doing forward + whole-graph AD + optimizer
+update, with parameter buffers donated so XLA updates weights in place.
+
+Used by the flagship models and bench.py; the eager .backward()/opt.step()
+path coexists for API parity but this is the fast one.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as prandom
+from ..core.dispatch import unwrap, wrap
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from ..optimizer.optimizer import Optimizer
+
+
+class TrainStep:
+    """Compiles loss_fn(model_outputs...) into a fused train step.
+
+    loss_fn signature: loss_fn(model, *batch) -> scalar loss Tensor, called
+    under bind_state so the same define-by-run code traces functionally.
+    """
+
+    def __init__(self, model: Layer, optimizer: Optimizer, loss_fn: Callable,
+                 grad_accum_steps: int = 1, donate: bool = True):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.grad_accum = grad_accum_steps
+        self.params = model.functional_state(trainable_only=True)
+        self.buffers = {k: v for k, v in model.functional_state().items()
+                        if k not in self.params}
+        self.opt_state = optimizer.init_state(self.params)
+        donate_argnums = (0, 1) if donate else ()
+        self._step = jax.jit(self._step_impl, donate_argnums=donate_argnums)
+        self._step_count = 0
+
+    def _step_impl(self, params, opt_state, batch, key, lr):
+        def loss_of(p):
+            with prandom.key_scope(key):
+                state = dict(p)
+                state.update(self.buffers)
+                with self.model.bind_state(state):
+                    loss = self.loss_fn(self.model, *batch)
+            return unwrap(loss)
+
+        loss, grads = jax.value_and_grad(loss_of)(params)
+        new_params, new_opt = self.optimizer.apply(grads, opt_state, params, lr=lr)
+        return new_params, new_opt, loss
+
+    def __call__(self, *batch):
+        batch_arrays = tuple(
+            jax.tree_util.tree_map(
+                lambda x: x._data if isinstance(x, Tensor) else jnp.asarray(x), b,
+                is_leaf=lambda x: isinstance(x, Tensor))
+            for b in batch
+        )
+        key = prandom.next_key()
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        self.params, self.opt_state, loss = self._step(
+            self.params, self.opt_state, batch_arrays, key, lr)
+        self._step_count += 1
+        return wrap(loss)
+
+    def sync_to_model(self):
+        """Write the functional params back into the eager model handles."""
+        handles = self.model.raw_state()
+        for name, val in self.params.items():
+            if name in handles:
+                handles[name]._replace_data(val)
+
+    def state_dict(self):
+        import numpy as np
+
+        return {
+            "params": jax.tree_util.tree_map(lambda x: np.asarray(x), self.params),
+            "opt_state": jax.tree_util.tree_map(lambda x: np.asarray(x), self.opt_state),
+        }
+
+    def set_state_dict(self, sd):
+        self.params = jax.tree_util.tree_map(jnp.asarray, sd["params"])
+        self.opt_state = jax.tree_util.tree_map(jnp.asarray, sd["opt_state"])
+        self.sync_to_model()

@@ -1,0 +1,155 @@
+"""Metrics (reference: python/paddle/metric/metrics.py:44)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dispatch import unwrap, wrap
+
+
+class Metric:
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        return self.__class__.__name__.lower()
+
+    def compute(self, *args):
+        return args
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name=None):
+        self.topk = topk if isinstance(topk, (list, tuple)) else (topk,)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def reset(self):
+        self.total = np.zeros(len(self.topk))
+        self.count = np.zeros(len(self.topk))
+
+    def compute(self, pred, label, *args):
+        p = np.asarray(unwrap(pred))
+        l = np.asarray(unwrap(label)).reshape(-1)
+        topk_idx = np.argsort(-p, axis=-1)[..., : self.maxk]
+        correct = topk_idx == l[:, None]
+        return correct
+
+    def update(self, correct):
+        correct = np.asarray(unwrap(correct))
+        for i, k in enumerate(self.topk):
+            num = correct[..., :k].any(axis=-1).sum()
+            self.total[i] += num
+            self.count[i] += correct.shape[0]
+        accs = self.total / np.maximum(self.count, 1)
+        return accs[0] if len(self.topk) == 1 else accs
+
+    def accumulate(self):
+        accs = self.total / np.maximum(self.count, 1)
+        return float(accs[0]) if len(self.topk) == 1 else accs.tolist()
+
+    def name(self):
+        return self._name
+
+
+class Precision(Metric):
+    def __init__(self, name=None):
+        self._name = name or "precision"
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        p = (np.asarray(unwrap(preds)).reshape(-1) > 0.5).astype(np.int64)
+        l = np.asarray(unwrap(labels)).reshape(-1)
+        self.tp += int(((p == 1) & (l == 1)).sum())
+        self.fp += int(((p == 1) & (l == 0)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return float(self.tp) / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    def __init__(self, name=None):
+        self._name = name or "recall"
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        p = (np.asarray(unwrap(preds)).reshape(-1) > 0.5).astype(np.int64)
+        l = np.asarray(unwrap(labels)).reshape(-1)
+        self.tp += int(((p == 1) & (l == 1)).sum())
+        self.fn += int(((p == 0) & (l == 1)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return float(self.tp) / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    def __init__(self, curve="ROC", num_thresholds=4095, name=None):
+        self._name = name or "auc"
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1)
+        self._stat_neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, preds, labels):
+        p = np.asarray(unwrap(preds))
+        if p.ndim == 2:
+            p = p[:, 1]
+        l = np.asarray(unwrap(labels)).reshape(-1)
+        bins = np.round(p * self.num_thresholds).astype(np.int64)
+        for b, y in zip(bins, l):
+            if y:
+                self._stat_pos[b] += 1
+            else:
+                self._stat_neg[b] += 1
+
+    def accumulate(self):
+        tot_pos = self._stat_pos.sum()
+        tot_neg = self._stat_neg.sum()
+        if not tot_pos or not tot_neg:
+            return 0.0
+        area = 0.0
+        pos = neg = 0.0
+        for i in range(self.num_thresholds, -1, -1):
+            new_pos = pos + self._stat_pos[i]
+            new_neg = neg + self._stat_neg[i]
+            area += (new_neg - neg) * (pos + new_pos) / 2
+            pos, neg = new_pos, new_neg
+        return float(area / (tot_pos * tot_neg))
+
+    def name(self):
+        return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    import jax.numpy as jnp
+
+    p = unwrap(input)
+    l = unwrap(label).reshape(-1)
+    topk = jnp.argsort(-p, axis=-1)[..., :k]
+    hit = jnp.any(topk == l[:, None], axis=-1)
+    return wrap(jnp.mean(hit.astype(jnp.float32)))

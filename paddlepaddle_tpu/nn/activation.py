@@ -1,0 +1,79 @@
+"""Activation layers (reference: python/paddle/nn/layer/activation.py)."""
+
+from __future__ import annotations
+
+from . import functional as F
+from .layer import Layer
+
+
+def _layer(fn_name, **fixed):
+    class _Act(Layer):
+        def __init__(self, *args, name=None, **kwargs):
+            super().__init__()
+            self._args = args
+            self._kwargs = kwargs
+
+        def forward(self, x):
+            return getattr(F, fn_name)(x, *self._args, **self._kwargs)
+
+    _Act.__name__ = "".join(p.capitalize() for p in fn_name.split("_"))
+    return _Act
+
+
+ReLU = _layer("relu")
+ReLU6 = _layer("relu6")
+Sigmoid = _layer("sigmoid")
+Tanh = _layer("tanh")
+Silu = _layer("silu")
+Swish = _layer("swish")
+Mish = _layer("mish")
+GELU = _layer("gelu")
+LeakyReLU = _layer("leaky_relu")
+ELU = _layer("elu")
+CELU = _layer("celu")
+SELU = _layer("selu")
+Hardtanh = _layer("hardtanh")
+Hardsigmoid = _layer("hardsigmoid")
+Hardswish = _layer("hardswish")
+Hardshrink = _layer("hardshrink")
+Softshrink = _layer("softshrink")
+Softplus = _layer("softplus")
+Softsign = _layer("softsign")
+Tanhshrink = _layer("tanhshrink")
+ThresholdedReLU = _layer("thresholded_relu")
+LogSigmoid = _layer("log_sigmoid")
+Maxout = _layer("maxout")
+GLU = _layer("glu")
+RReLU = _layer("rrelu")
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, axis=self.axis)
+
+
+class LogSoftmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.log_softmax(x, axis=self.axis)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        from .initializer import Constant
+
+        self.data_format = data_format
+        self.weight = self.create_parameter(
+            [num_parameters], attr=weight_attr, default_initializer=Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self.data_format)

@@ -1,0 +1,104 @@
+"""Conv layers (reference: python/paddle/nn/layer/conv.py). Weight layout
+[out_channels, in_channels/groups, *kernel] (paddle OIHW convention); lowering
+is one XLA conv_general_dilated which the TPU compiler maps to the MXU."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import functional as F
+from .initializer import KaimingUniform, Uniform
+from .layer import Layer
+
+
+def _ntuple(v, n):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v,) * n
+
+
+class _ConvNd(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, nd, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
+                 bias_attr=None, data_format="NCHW"):
+        super().__init__()
+        self._in_channels = in_channels
+        self._out_channels = out_channels
+        self._kernel_size = _ntuple(kernel_size, nd)
+        self._stride = _ntuple(stride, nd)
+        self._padding = padding
+        self._dilation = _ntuple(dilation, nd)
+        self._groups = groups
+        self._data_format = data_format
+        self._nd = nd
+        filter_shape = [out_channels, in_channels // groups] + list(self._kernel_size)
+        fan_in = in_channels * int(np.prod(self._kernel_size)) // groups
+        self.weight = self.create_parameter(
+            filter_shape, attr=weight_attr, default_initializer=KaimingUniform(fan_in=fan_in))
+        bound = 1.0 / np.sqrt(fan_in)
+        self.bias = (
+            self.create_parameter([out_channels], attr=bias_attr, is_bias=True,
+                                  default_initializer=Uniform(-bound, bound))
+            if bias_attr is not False else None
+        )
+
+    def extra_repr(self):
+        return (f"{self._in_channels}, {self._out_channels}, kernel_size={self._kernel_size}, "
+                f"stride={self._stride}, padding={self._padding}")
+
+
+class Conv1D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
+                 bias_attr=None, data_format="NCL"):
+        super().__init__(in_channels, out_channels, kernel_size, 1, stride, padding,
+                         dilation, groups, padding_mode, weight_attr, bias_attr, data_format)
+
+    def forward(self, x):
+        return F.conv1d(x, self.weight, self.bias, self._stride, self._padding,
+                        self._dilation, self._groups, self._data_format)
+
+
+class Conv2D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
+                 bias_attr=None, data_format="NCHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 2, stride, padding,
+                         dilation, groups, padding_mode, weight_attr, bias_attr, data_format)
+
+    def forward(self, x):
+        return F.conv2d(x, self.weight, self.bias, self._stride, self._padding,
+                        self._dilation, self._groups, self._data_format)
+
+
+class Conv3D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
+                 bias_attr=None, data_format="NCDHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 3, stride, padding,
+                         dilation, groups, padding_mode, weight_attr, bias_attr, data_format)
+
+    def forward(self, x):
+        return F.conv3d(x, self.weight, self.bias, self._stride, self._padding,
+                        self._dilation, self._groups, self._data_format)
+
+
+class Conv2DTranspose(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, weight_attr=None,
+                 bias_attr=None, data_format="NCHW"):
+        super().__init__()
+        self._stride = _ntuple(stride, 2)
+        self._padding = padding
+        self._output_padding = output_padding
+        self._dilation = _ntuple(dilation, 2)
+        self._groups = groups
+        kernel = _ntuple(kernel_size, 2)
+        # paddle layout for transpose conv: [in, out/groups, kh, kw]
+        self.weight = self.create_parameter(
+            [in_channels, out_channels // groups] + list(kernel), attr=weight_attr)
+        self.bias = (self.create_parameter([out_channels], attr=bias_attr, is_bias=True)
+                     if bias_attr is not False else None)
+
+    def forward(self, x, output_size=None):
+        return F.conv2d_transpose(x, self.weight, self.bias, self._stride, self._padding,
+                                  self._output_padding, self._groups, self._dilation,
+                                  output_size=output_size)

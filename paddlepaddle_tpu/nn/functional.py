@@ -1,0 +1,960 @@
+"""``nn.functional`` — stateless NN ops (reference: python/paddle/nn/functional/).
+
+All ops are pure jnp routed through the eager dispatcher; XLA fuses the
+elementwise chains into surrounding matmuls/convs (the role of the reference's
+fused_bias_act / fused_dropout_add CUDA kernels)."""
+
+from __future__ import annotations
+
+import math as _math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core import random as prandom
+from ..core.dispatch import apply_op, unwrap, wrap
+from ..core.tensor import Tensor
+
+# ---------------------------------------------------------------------------
+# activations (reference: python/paddle/nn/functional/activation.py)
+# ---------------------------------------------------------------------------
+
+
+def _act(jfn, name):
+    def op(x, name=None):
+        return apply_op(jfn, x, op_name=name)
+
+    op.__name__ = name
+    return op
+
+
+relu = _act(jax.nn.relu, "relu")
+relu6 = _act(jax.nn.relu6, "relu6")
+sigmoid = _act(jax.nn.sigmoid, "sigmoid")
+tanh = _act(jnp.tanh, "tanh")
+silu = _act(jax.nn.silu, "silu")
+swish = silu
+mish = _act(lambda x: x * jnp.tanh(jax.nn.softplus(x)), "mish")
+softsign = _act(jax.nn.soft_sign, "softsign")
+tanhshrink = _act(lambda x: x - jnp.tanh(x), "tanhshrink")
+log_sigmoid = _act(jax.nn.log_sigmoid, "log_sigmoid")
+
+
+def gelu(x, approximate=False, name=None):
+    return apply_op(lambda a: jax.nn.gelu(a, approximate=approximate), x, op_name="gelu")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply_op(lambda a: jax.nn.leaky_relu(a, negative_slope), x)
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply_op(lambda a: jax.nn.elu(a, alpha), x)
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply_op(lambda a: jax.nn.celu(a, alpha), x)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply_op(lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), x)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply_op(lambda a: jnp.clip(a, min, max), x)
+
+
+def hardsigmoid(x, slope=1 / 6, offset=0.5, name=None):
+    return apply_op(lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), x)
+
+
+def hardswish(x, name=None):
+    return apply_op(lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, x)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply_op(lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), x)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply_op(
+        lambda a: jnp.where(a > threshold, a - threshold, jnp.where(a < -threshold, a + threshold, 0.0)),
+        x,
+    )
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply_op(
+        lambda a: jnp.where(beta * a > threshold, a, jax.nn.softplus(beta * a) / beta), x
+    )
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply_op(lambda a: jnp.where(a > threshold, a, value), x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(a, w):
+        if w.size == 1:
+            ww = w.reshape(())
+        else:
+            ch_axis = 1 if data_format[1] == "C" else a.ndim - 1
+            sh = [1] * a.ndim
+            sh[ch_axis] = w.size
+            ww = w.reshape(sh)
+        return jnp.where(a > 0, a, ww * a)
+
+    return apply_op(f, x, weight)
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
+    if training:
+        k = prandom.next_key()
+        a = unwrap(x)
+        slope = jax.random.uniform(k, a.shape, jnp.float32, lower, upper).astype(a.dtype)
+        return apply_op(lambda v: jnp.where(v >= 0, v, slope * v), x)
+    mid = (lower + upper) / 2.0
+    return leaky_relu(x, mid)
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = a.shape[:ax] + (groups, c // groups) + a.shape[ax + 1 :]
+        return jnp.max(a.reshape(new_shape), axis=ax)
+
+    return apply_op(f, x)
+
+
+def glu(x, axis=-1, name=None):
+    return apply_op(lambda a: jax.nn.glu(a, axis=axis), x)
+
+
+def swiglu(x, y=None, name=None):
+    """Fused swiglu (reference: python/paddle/incubate/nn/functional/swiglu)."""
+    if y is None:
+        return apply_op(lambda a: jax.nn.silu(a[..., : a.shape[-1] // 2]) * a[..., a.shape[-1] // 2 :], x)
+    return apply_op(lambda a, b: jax.nn.silu(a) * b, x, y)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def f(a):
+        if dtype is not None:
+            a = a.astype(dtypes.convert_dtype(dtype))
+        return jax.nn.softmax(a, axis=axis)
+
+    return apply_op(f, x, op_name="softmax")
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def f(a):
+        if dtype is not None:
+            a = a.astype(dtypes.convert_dtype(dtype))
+        return jax.nn.log_softmax(a, axis=axis)
+
+    return apply_op(f, x, op_name="log_softmax")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    k = prandom.next_key()
+
+    def f(a):
+        g = -jnp.log(-jnp.log(jax.random.uniform(k, a.shape, jnp.float32) + 1e-20) + 1e-20)
+        y = jax.nn.softmax((a + g.astype(a.dtype)) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+            y = y_hard - jax.lax.stop_gradient(y) + y
+        return y
+
+    return apply_op(f, x)
+
+
+# ---------------------------------------------------------------------------
+# linear / embedding
+# ---------------------------------------------------------------------------
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W (+ b); W layout [in, out] (paddle convention)."""
+
+    def f(a, w, b):
+        out = jnp.matmul(a, w)
+        if b is not None:
+            out = out + b
+        return out
+
+    return apply_op(f, x, weight, bias, op_name="linear")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def f(idx, w):
+        out = jnp.take(w, idx, axis=0)
+        if padding_idx is not None:
+            pad = padding_idx if padding_idx >= 0 else w.shape[0] + padding_idx
+            mask = (idx == pad)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+
+    return apply_op(f, x, weight, op_name="embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    return apply_op(lambda a: jax.nn.one_hot(a, num_classes, dtype=dtypes.get_default_dtype()), x)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def f(a, b, w, bb):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bb is not None:
+            out = out + bb
+        return out
+
+    return apply_op(f, x1, x2, weight, bias)
+
+
+# ---------------------------------------------------------------------------
+# dropout
+# ---------------------------------------------------------------------------
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return apply_op(lambda a: a * (1.0 - p), x)
+        return x
+    key = prandom.next_key()
+
+    def f(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in [ax % a.ndim for ax in axes] else 1 for i, s in enumerate(a.shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+
+    return apply_op(f, x, op_name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ch_axis = 1 if data_format == "NCHW" else 3
+    return dropout(x, p, axis=[0, ch_axis], training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ch_axis = 1 if data_format == "NCDHW" else 4
+    return dropout(x, p, axis=[0, ch_axis], training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    key = prandom.next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def f(a):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        q = 1.0 - p
+        coef_a = (q + alpha_p**2 * q * p) ** -0.5
+        coef_b = -coef_a * alpha_p * p
+        return (coef_a * jnp.where(keep, a, alpha_p) + coef_b).astype(a.dtype)
+
+    return apply_op(f, x)
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(normalized_shape)
+
+    def f(a, w, b):
+        axes = tuple(range(a.ndim - n_axes, a.ndim))
+        mean = jnp.mean(a.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(a.astype(jnp.float32), axis=axes, keepdims=True)
+        out = (a.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + epsilon)
+        out = out.astype(a.dtype)
+        if w is not None:
+            out = out * w
+        if b is not None:
+            out = out + b
+        return out
+
+    return apply_op(f, x, weight, bias, op_name="layer_norm")
+
+
+def rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1, name=None):
+    """Fused rms_norm equivalent (reference: incubate fused_rms_norm)."""
+
+    def f(a, w, b):
+        var = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=-1, keepdims=True)
+        out = (a.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon)).astype(a.dtype)
+        if w is not None:
+            out = out * w
+        if b is not None:
+            out = out + b
+        return out
+
+    return apply_op(f, x, weight, bias, op_name="rms_norm")
+
+
+def batch_norm(
+    x,
+    running_mean,
+    running_var,
+    weight=None,
+    bias=None,
+    training=False,
+    momentum=0.9,
+    epsilon=1e-5,
+    data_format="NCHW",
+    use_global_stats=None,
+    name=None,
+):
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    use_batch_stats = training and not use_global_stats
+
+    def f(a, w, b, rm, rv):
+        sh = [1] * a.ndim
+        sh[ch_axis] = a.shape[ch_axis]
+        axes = tuple(i for i in range(a.ndim) if i != ch_axis)
+        if use_batch_stats:
+            mean = jnp.mean(a.astype(jnp.float32), axis=axes)
+            var = jnp.var(a.astype(jnp.float32), axis=axes)
+        else:
+            mean, var = rm, rv
+        out = (a.astype(jnp.float32) - mean.reshape(sh)) * jax.lax.rsqrt(var.reshape(sh) + epsilon)
+        out = out.astype(a.dtype)
+        if w is not None:
+            out = out * w.reshape(sh)
+        if b is not None:
+            out = out + b.reshape(sh)
+        return out
+
+    out = apply_op(f, x, weight, bias, running_mean, running_var, op_name="batch_norm")
+
+    if use_batch_stats and isinstance(running_mean, Tensor):
+        # update running stats in place (reference batch_norm_kernel semantics)
+        a = unwrap(x).astype(jnp.float32)
+        axes = tuple(i for i in range(a.ndim) if i != ch_axis)
+        mean = jnp.mean(a, axis=axes)
+        n = np.prod([a.shape[i] for i in axes])
+        var_unbiased = jnp.var(a, axis=axes) * (n / max(n - 1, 1))
+        running_mean._replace_data(
+            (momentum * running_mean._data + (1 - momentum) * mean).astype(running_mean.dtype)
+        )
+        running_var._replace_data(
+            (momentum * running_var._data + (1 - momentum) * var_unbiased).astype(running_var.dtype)
+        )
+    return out
+
+
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5, data_format="NCHW", name=None):
+    def f(a, w, b):
+        if data_format != "NCHW" and not data_format.startswith("NC"):
+            a_t = jnp.moveaxis(a, -1, 1)
+        else:
+            a_t = a
+        n, c = a_t.shape[0], a_t.shape[1]
+        spatial = a_t.shape[2:]
+        g = a_t.reshape((n, num_groups, c // num_groups) + spatial).astype(jnp.float32)
+        axes = tuple(range(2, g.ndim))
+        mean = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - mean) * jax.lax.rsqrt(var + epsilon)).reshape(a_t.shape).astype(a.dtype)
+        sh = [1] * a_t.ndim
+        sh[1] = c
+        if w is not None:
+            out = out * w.reshape(sh)
+        if b is not None:
+            out = out + b.reshape(sh)
+        if data_format != "NCHW" and not data_format.startswith("NC"):
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return apply_op(f, x, weight, bias)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW", name=None):
+    def f(a, w, b):
+        axes = tuple(range(2, a.ndim))
+        mean = jnp.mean(a.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(a.astype(jnp.float32), axis=axes, keepdims=True)
+        out = ((a.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + eps)).astype(a.dtype)
+        sh = [1, a.shape[1]] + [1] * (a.ndim - 2)
+        if w is not None:
+            out = out * w.reshape(sh)
+        if b is not None:
+            out = out + b.reshape(sh)
+        return out
+
+    return apply_op(f, x, weight, bias)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(a):
+        nrm = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(nrm, epsilon)
+
+    return apply_op(f, x)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    def f(a):
+        ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        sq = jnp.square(a)
+        half = size // 2
+        pads = [(0, 0)] * a.ndim
+        pads[ch_axis] = (half, size - half - 1)
+        padded = jnp.pad(sq, pads)
+        acc = jnp.zeros_like(a)
+        for i in range(size):
+            sl = [slice(None)] * a.ndim
+            sl[ch_axis] = slice(i, i + a.shape[ch_axis])
+            acc = acc + padded[tuple(sl)]
+        return a / (k + alpha * acc) ** beta
+
+    return apply_op(f, x)
+
+
+# ---------------------------------------------------------------------------
+# conv / pooling
+# ---------------------------------------------------------------------------
+
+
+def _tup(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(i) for i in v)
+    return (int(v),) * n
+
+
+def _conv_nd(a, w, b, stride, padding, dilation, groups, nd, data_format):
+    chan_last = not data_format.startswith("NC")
+    if isinstance(padding, str):
+        pad = padding.upper()
+        if pad == "SAME":
+            pad = "SAME"
+        elif pad == "VALID":
+            pad = "VALID"
+    else:
+        p = _tup(padding, nd)
+        if len(p) == nd:
+            pad = [(pi, pi) for pi in p]
+        else:
+            pad = [(p[2 * i], p[2 * i + 1]) for i in range(nd)]
+    spatial = "DHW"[-nd:] if nd <= 3 else None
+    lhs_spec = ("N" + "C" + spatial) if not chan_last else ("N" + spatial + "C")
+    out_spec = lhs_spec
+    dn = jax.lax.conv_dimension_numbers(
+        a.shape, w.shape, (lhs_spec, "OI" + spatial, out_spec)
+    )
+    out = jax.lax.conv_general_dilated(
+        a,
+        w,
+        window_strides=_tup(stride, nd),
+        padding=pad,
+        rhs_dilation=_tup(dilation, nd),
+        dimension_numbers=dn,
+        feature_group_count=groups,
+    )
+    if b is not None:
+        sh = [1] * out.ndim
+        sh[1 if not chan_last else out.ndim - 1] = b.shape[0]
+        out = out + b.reshape(sh)
+    return out
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCL", name=None):
+    return apply_op(
+        lambda a, w, b: _conv_nd(a, w, b, stride, padding, dilation, groups, 1, data_format),
+        x, weight, bias, op_name="conv1d",
+    )
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCHW", name=None):
+    return apply_op(
+        lambda a, w, b: _conv_nd(a, w, b, stride, padding, dilation, groups, 2, data_format),
+        x, weight, bias, op_name="conv2d",
+    )
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCDHW", name=None):
+    return apply_op(
+        lambda a, w, b: _conv_nd(a, w, b, stride, padding, dilation, groups, 3, data_format),
+        x, weight, bias, op_name="conv3d",
+    )
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, data_format="NCHW", output_size=None, name=None):
+    def f(a, w, b):
+        nd = 2
+        p = _tup(padding, nd)
+        s = _tup(stride, nd)
+        d = _tup(dilation, nd)
+        # weight layout [in, out/groups, kh, kw] in paddle
+        dn = jax.lax.conv_dimension_numbers(a.shape, w.shape, ("NCHW", "IOHW", "NCHW"))
+        k = [(w.shape[2 + i] - 1) * d[i] + 1 for i in range(nd)]
+        pads = [(k[i] - 1 - p[i], k[i] - 1 - p[i] + _tup(output_padding, nd)[i]) for i in range(nd)]
+        out = jax.lax.conv_general_dilated(
+            a, jnp.flip(w, axis=(2, 3)).swapaxes(0, 1) if False else w,
+            window_strides=(1, 1),
+            padding=pads,
+            lhs_dilation=s,
+            rhs_dilation=d,
+            dimension_numbers=jax.lax.conv_dimension_numbers(
+                a.shape, (w.shape[1] * groups, w.shape[0] // groups,) + w.shape[2:],
+                ("NCHW", "OIHW", "NCHW")),
+            feature_group_count=groups,
+            rhs=None,
+        ) if False else jax.lax.conv_transpose(
+            a, w, strides=s,
+            padding=[(p[i], p[i]) for i in range(nd)],
+            rhs_dilation=d,
+            dimension_numbers=dn,
+            transpose_kernel=True,
+        )
+        if b is not None:
+            out = out + b.reshape(1, -1, 1, 1)
+        return out
+
+    return apply_op(f, x, weight, bias, op_name="conv2d_transpose")
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW", name=None):
+    ks = _tup(kernel_size, 2)
+    st = _tup(stride if stride is not None else kernel_size, 2)
+    p = _tup(padding, 2)
+
+    def f(a):
+        window = (1, 1) + ks if data_format == "NCHW" else (1,) + ks + (1,)
+        strides = (1, 1) + st if data_format == "NCHW" else (1,) + st + (1,)
+        pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])) if data_format == "NCHW" else (
+            (0, 0), (p[0], p[0]), (p[1], p[1]), (0, 0))
+        return jax.lax.reduce_window(a, -jnp.inf if dtypes.is_floating_point(a.dtype) else jnp.iinfo(a.dtype).min,
+                                     jax.lax.max, window, strides, pads)
+
+    return apply_op(f, x, op_name="max_pool2d")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    ks = _tup(kernel_size, 2)
+    st = _tup(stride if stride is not None else kernel_size, 2)
+    p = _tup(padding, 2)
+
+    def f(a):
+        window = (1, 1) + ks if data_format == "NCHW" else (1,) + ks + (1,)
+        strides = (1, 1) + st if data_format == "NCHW" else (1,) + st + (1,)
+        pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])) if data_format == "NCHW" else (
+            (0, 0), (p[0], p[0]), (p[1], p[1]), (0, 0))
+        summed = jax.lax.reduce_window(a.astype(jnp.float32), 0.0, jax.lax.add, window, strides, pads)
+        if divisor_override:
+            return (summed / divisor_override).astype(a.dtype)
+        if exclusive and (p[0] or p[1]):
+            ones = jnp.ones_like(a, jnp.float32)
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
+            return (summed / counts).astype(a.dtype)
+        return (summed / (ks[0] * ks[1])).astype(a.dtype)
+
+    return apply_op(f, x, op_name="avg_pool2d")
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, name=None):
+    x4 = x.unsqueeze(2)
+    out = max_pool2d(x4, (1, _tup(kernel_size, 1)[0]), (1, _tup(stride if stride is not None else kernel_size, 1)[0]),
+                     (0, _tup(padding, 1)[0]))
+    return out.squeeze(2)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, name=None):
+    x4 = x.unsqueeze(2)
+    out = avg_pool2d(x4, (1, _tup(kernel_size, 1)[0]), (1, _tup(stride if stride is not None else kernel_size, 1)[0]),
+                     (0, _tup(padding, 1)[0]), exclusive=exclusive)
+    return out.squeeze(2)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    os = _tup(output_size, 2)
+
+    def f(a):
+        h, w = (a.shape[2], a.shape[3]) if data_format == "NCHW" else (a.shape[1], a.shape[2])
+        if h % os[0] == 0 and w % os[1] == 0:
+            kh, kw = h // os[0], w // os[1]
+            if data_format == "NCHW":
+                r = a.reshape(a.shape[0], a.shape[1], os[0], kh, os[1], kw)
+                return jnp.mean(r, axis=(3, 5))
+            r = a.reshape(a.shape[0], os[0], kh, os[1], kw, a.shape[-1])
+            return jnp.mean(r, axis=(2, 4))
+        # general: mean over variable windows via cumulative sums
+        idx_h = [(int(np.floor(i * h / os[0])), int(np.ceil((i + 1) * h / os[0]))) for i in range(os[0])]
+        idx_w = [(int(np.floor(j * w / os[1])), int(np.ceil((j + 1) * w / os[1]))) for j in range(os[1])]
+        rows = []
+        for (hs, he) in idx_h:
+            cols = []
+            for (ws, we) in idx_w:
+                sl = a[:, :, hs:he, ws:we] if data_format == "NCHW" else a[:, hs:he, ws:we, :]
+                cols.append(jnp.mean(sl, axis=(2, 3) if data_format == "NCHW" else (1, 2)))
+            rows.append(jnp.stack(cols, axis=-1))
+        out = jnp.stack(rows, axis=-2)
+        return out
+
+    return apply_op(f, x)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    out = adaptive_avg_pool2d(x.unsqueeze(2), (1, output_size))
+    return out.squeeze(2)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    os = _tup(output_size, 2)
+
+    def f(a):
+        h, w = a.shape[2], a.shape[3]
+        kh, kw = h // os[0], w // os[1]
+        r = a.reshape(a.shape[0], a.shape[1], os[0], kh, os[1], kw)
+        return jnp.max(r, axis=(3, 5))
+
+    return apply_op(f, x)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    ks = _tup(kernel_sizes, 2)
+    st = _tup(strides, 2)
+    p = _tup(paddings, 2)
+    d = _tup(dilations, 2)
+
+    def f(a):
+        n, c, h, w = a.shape
+        a_p = jnp.pad(a, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])))
+        oh = (h + 2 * p[0] - d[0] * (ks[0] - 1) - 1) // st[0] + 1
+        ow = (w + 2 * p[1] - d[1] * (ks[1] - 1) - 1) // st[1] + 1
+        patches = []
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                sl = a_p[:, :, i * d[0] : i * d[0] + oh * st[0] : st[0],
+                         j * d[1] : j * d[1] + ow * st[1] : st[1]]
+                patches.append(sl)
+        out = jnp.stack(patches, axis=2)  # n, c, k*k, oh, ow
+        return out.reshape(n, c * ks[0] * ks[1], oh * ow)
+
+    return apply_op(f, x)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+                align_mode=0, data_format="NCHW", name=None):
+    def f(a):
+        chan_last = not data_format.startswith("NC")
+        spatial_dims = list(range(1, a.ndim - 1)) if chan_last else list(range(2, a.ndim))
+        in_sizes = [a.shape[i] for i in spatial_dims]
+        if size is not None:
+            out_sizes = [int(unwrap(s)) for s in (size if isinstance(size, (list, tuple)) else [size])]
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * len(in_sizes)
+            out_sizes = [int(s * f_) for s, f_ in zip(in_sizes, sf)]
+        new_shape = list(a.shape)
+        for dim, s in zip(spatial_dims, out_sizes):
+            new_shape[dim] = s
+        method = {"nearest": "nearest", "bilinear": "bilinear", "trilinear": "trilinear",
+                  "bicubic": "bicubic", "linear": "linear", "area": "linear"}[mode]
+        return jax.image.resize(a, tuple(new_shape), method=method).astype(a.dtype)
+
+    return apply_op(f, x)
+
+
+upsample = interpolate
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def f(a):
+        n, c, h, w = a.shape
+        out = a.reshape(n, c // (r * r), r, r, h, w)
+        out = out.transpose(0, 1, 4, 2, 5, 3)
+        return out.reshape(n, c // (r * r), h * r, w * r)
+
+    return apply_op(f, x)
+
+
+# ---------------------------------------------------------------------------
+# losses (reference: python/paddle/nn/functional/loss.py)
+# ---------------------------------------------------------------------------
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0, name=None):
+    def f(logits, lab, w):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
+        else:
+            logp = jnp.log(jnp.maximum(logits.astype(jnp.float32), 1e-30))
+        n_classes = logits.shape[axis]
+        if soft_label or (lab.ndim == logits.ndim and lab.shape == logits.shape):
+            tgt = lab.astype(jnp.float32)
+            if label_smoothing > 0:
+                tgt = tgt * (1 - label_smoothing) + label_smoothing / n_classes
+            loss = -jnp.sum(tgt * logp, axis=axis)
+            valid = jnp.ones_like(loss)
+        else:
+            lab_i = lab.astype(jnp.int32)
+            if lab_i.ndim == logits.ndim:
+                lab_i = jnp.squeeze(lab_i, axis)
+            valid = (lab_i != ignore_index).astype(jnp.float32)
+            safe = jnp.where(lab_i == ignore_index, 0, lab_i)
+            picked = jnp.take_along_axis(logp, safe[..., None], axis=axis)[..., 0]
+            if label_smoothing > 0:
+                smooth = jnp.mean(logp, axis=axis)
+                picked = (1 - label_smoothing) * picked + label_smoothing * smooth
+            loss = -picked * valid
+            if w is not None:
+                wv = jnp.take(w, safe, axis=0) * valid
+                loss = loss * jnp.take(w, safe, axis=0)
+                if reduction == "mean":
+                    return jnp.sum(loss) / jnp.maximum(jnp.sum(wv), 1e-12)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(valid), 1e-12)
+        return _reduce(loss, reduction)
+
+    return apply_op(f, input, label, weight, op_name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label, ignore_index=ignore_index,
+                         reduction="none", axis=axis)
+    loss = loss.unsqueeze(axis)
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply_op(lambda a, b: _reduce(jnp.square(a - b), reduction), input, label)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply_op(lambda a, b: _reduce(jnp.abs(a - b), reduction), input, label)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(loss, reduction)
+
+    return apply_op(f, input, label)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    def f(logp, lab, w):
+        valid = (lab != ignore_index)
+        safe = jnp.where(valid, lab, 0)
+        picked = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        wv = jnp.where(valid, 1.0, 0.0)
+        if w is not None:
+            wv = wv * jnp.take(w, safe, axis=0)
+        picked = picked * wv
+        if reduction == "mean":
+            return jnp.sum(picked) / jnp.maximum(jnp.sum(wv), 1e-12)
+        return _reduce(picked, reduction)
+
+    return apply_op(f, input, label, weight)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def f(p, y, w):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    return apply_op(f, input, label, weight)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def f(z, y, w, pw):
+        neg_abs = -jnp.abs(z)
+        base = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(neg_abs))
+        if pw is not None:
+            log_weight = 1 + (pw - 1) * y
+            base = jnp.maximum(z, 0) - z * y + log_weight * jnp.log1p(jnp.exp(neg_abs))
+        if w is not None:
+            base = base * w
+        return _reduce(base, reduction)
+
+    return apply_op(f, logit, label, weight, pos_weight)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def f(lp, t):
+        tt = jnp.exp(t) if log_target else t
+        pointwise = tt * ((t if log_target else jnp.log(jnp.maximum(t, 1e-12))) - lp)
+        if reduction == "batchmean":
+            return jnp.sum(pointwise) / lp.shape[0]
+        return _reduce(pointwise, reduction)
+
+    return apply_op(f, input, label)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def f(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+
+    return apply_op(f, x1, x2)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean", name=None):
+    sim = cosine_similarity(input1, input2, axis=-1)
+
+    def f(s, y):
+        loss = jnp.where(y == 1, 1 - s, jnp.maximum(0.0, s - margin))
+        return _reduce(loss, reduction)
+
+    return apply_op(f, sim, label)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    return apply_op(
+        lambda a, b, y: _reduce(jnp.maximum(0.0, -y * (a - b) + margin), reduction),
+        input, other, label,
+    )
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    return apply_op(
+        lambda a, y: _reduce(jnp.where(y == 1, a, jnp.maximum(0.0, margin - a)), reduction),
+        input, label,
+    )
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2, epsilon=1e-6,
+                        swap=False, reduction="mean", name=None):
+    def f(a, pos, neg):
+        dp = jnp.sum(jnp.abs(a - pos) ** p, axis=-1) ** (1 / p)
+        dn = jnp.sum(jnp.abs(a - neg) ** p, axis=-1) ** (1 / p)
+        if swap:
+            dn2 = jnp.sum(jnp.abs(pos - neg) ** p, axis=-1) ** (1 / p)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+    return apply_op(f, input, positive, negative)
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    def f(a):
+        s = a.shape[-1]
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        masked = jnp.where(mask, a, -1e9)
+        return jax.nn.softmax(masked, axis=-1)
+
+    return apply_op(f, x)
+
+
+def square_error_cost(input, label):
+    return apply_op(lambda a, b: jnp.square(a - b), input, label)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return apply_op(
+        lambda p, y: -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon),
+        input, label,
+    )
+
+
+def ctc_loss(*a, **k):
+    raise NotImplementedError("ctc_loss lands with the audio kit")
+
+
+# ---------------------------------------------------------------------------
+# attention (reference: python/paddle/nn/functional/flash_attention.py:364,1145)
+# ---------------------------------------------------------------------------
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, name=None):
+    """BSHD layout [batch, seq, heads, head_dim] like the reference flash API.
+
+    Routes to the Pallas flash-attention kernel on TPU; XLA fallback elsewhere
+    (see paddlepaddle_tpu/ops/kernels/flash_attention.py)."""
+    from ..ops.kernels.flash_attention import flash_attention_bshd
+
+    out = flash_attention_bshd(query, key, value, causal=is_causal, mask=attn_mask,
+                               dropout=dropout_p if training else 0.0)
+    return out
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False,
+                    fixed_seed_offset=None, rng_name="", training=True, name=None):
+    out = scaled_dot_product_attention(query, key, value, dropout_p=dropout,
+                                       is_causal=causal, training=training)
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    def f(lens):
+        m = maxlen or int(jnp.max(lens))
+        ar = jnp.arange(m)
+        return (ar[None, :] < lens[..., None]).astype(dtypes.convert_dtype(dtype))
+
+    return apply_op(f, x)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(lab, pd):
+        k = lab.shape[-1]
+        if pd is not None:
+            return (1 - epsilon) * lab + epsilon * pd
+        return (1 - epsilon) * lab + epsilon / k
+
+    return apply_op(f, label, prior_dist)
+
+
+def pad(x, pad_, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from ..ops.manipulation import pad as _pad
+
+    return _pad(x, pad_, mode=mode, value=value, data_format=data_format)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    def f(a):
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        v = a.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        left = jnp.concatenate([v[:, 1:, :fold], jnp.zeros_like(v[:, :1, :fold])], axis=1)
+        right = jnp.concatenate([jnp.zeros_like(v[:, :1, fold:2*fold]), v[:, :-1, fold:2*fold]], axis=1)
+        rest = v[:, :, 2*fold:]
+        return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
+
+    return apply_op(f, x)

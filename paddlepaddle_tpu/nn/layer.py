@@ -1,0 +1,359 @@
+"""``Layer`` — the module base class.
+
+Reference surface: python/paddle/nn/layer/layers.py:354 (parameters/buffers/
+sublayers registries, hooks, state_dict, ``to()``, ``apply``, train/eval).
+
+TPU-native addition: a functional bridge (``functional_state`` /
+``bind_state``) that temporarily rebinds every parameter/buffer payload to a
+provided pytree. This is what lets the same define-by-run ``forward`` be
+traced by ``jax.jit``/``jax.grad`` into one XLA program with parameters as
+real inputs (donatable, shardable) instead of baked constants — the analogue
+of the reference's dy2static ProgramTranslator, with XLA in place of PIR.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from contextlib import contextmanager
+from typing import Iterator, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.tensor import Parameter, Tensor
+from .initializer import Constant, XavierNormal, _resolve_initializer
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self.training = True
+        self._dtype = dtypes.convert_dtype(dtype)
+        self._parameters = OrderedDict()
+        self._buffers = OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._sub_layers = OrderedDict()
+        self._forward_pre_hooks = OrderedDict()
+        self._forward_post_hooks = OrderedDict()
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+
+    # -- registration ------------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call super().__init__() before assigning parameters")
+            params[name] = value
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call super().__init__() before assigning sublayers")
+            layers[name] = value
+            self.__dict__.pop(name, None)
+        else:
+            if params is not None and name in params:
+                if value is None:
+                    del params[name]
+                else:
+                    raise TypeError(f"cannot assign non-Parameter to parameter {name}")
+            if layers is not None and name in layers and value is None:
+                del layers[name]
+                return
+            if buffers is not None and name in buffers:
+                if value is None or isinstance(value, Tensor):
+                    buffers[name] = value
+                    return
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for registry in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(registry)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'"
+        )
+
+    def __delattr__(self, name):
+        for registry in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(registry)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def add_parameter(self, name, parameter):
+        if parameter is not None and not isinstance(parameter, Parameter):
+            raise TypeError("add_parameter expects a Parameter")
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    def create_parameter(
+        self,
+        shape,
+        attr=None,
+        dtype=None,
+        is_bias=False,
+        default_initializer=None,
+    ) -> Parameter:
+        from .initializer import ParamAttr
+
+        dtype = dtypes.convert_dtype(dtype) if dtype is not None else self._dtype
+        init = None
+        name = None
+        trainable = True
+        if isinstance(attr, ParamAttr):
+            init = attr.initializer
+            name = attr.name
+            trainable = attr.trainable
+        elif attr is not None and attr is not True:
+            init = _resolve_initializer(attr)
+        if init is None:
+            init = default_initializer or (Constant(0.0) if is_bias else XavierNormal())
+        data = init(tuple(shape), dtype)
+        p = Parameter(data, trainable=trainable, name=name)
+        return p
+
+    # -- traversal ---------------------------------------------------------
+    def children(self) -> Iterator["Layer"]:
+        for l in self._sub_layers.values():
+            if l is not None:
+                yield l
+
+    def named_children(self):
+        for n, l in self._sub_layers.items():
+            if l is not None:
+                yield n, l
+
+    def sublayers(self, include_self=False):
+        out = []
+        if include_self:
+            out.append(self)
+        for l in self.children():
+            out.extend(l.sublayers(include_self=True))
+        return out
+
+    def named_sublayers(self, prefix="", include_self=False, layers_set=None):
+        if include_self:
+            yield prefix, self
+        for n, l in self.named_children():
+            p = f"{prefix}.{n}" if prefix else n
+            yield from l.named_sublayers(prefix=p, include_self=True)
+
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, p in self._parameters.items():
+            if p is not None and id(p) not in seen:
+                seen.add(id(p))
+                yield (f"{prefix}.{name}" if prefix else name), p
+        if include_sublayers:
+            for lname, l in self.named_children():
+                sub_prefix = f"{prefix}.{lname}" if prefix else lname
+                for n, p in l.named_parameters(prefix=sub_prefix):
+                    if id(p) not in seen:
+                        seen.add(id(p))
+                        yield n, p
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        for name, b in self._buffers.items():
+            if b is not None:
+                yield (f"{prefix}.{name}" if prefix else name), b
+        if include_sublayers:
+            for lname, l in self.named_children():
+                sub_prefix = f"{prefix}.{lname}" if prefix else lname
+                yield from l.named_buffers(prefix=sub_prefix)
+
+    def apply(self, fn):
+        for l in self.children():
+            l.apply(fn)
+        fn(self)
+        return self
+
+    # -- modes -------------------------------------------------------------
+    def train(self):
+        self.training = True
+        for l in self.children():
+            l.train()
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.children():
+            l.eval()
+        return self
+
+    # -- state dict ---------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True, structured_name_prefix="", use_hook=True):
+        dest = destination if destination is not None else OrderedDict()
+        for name, p in self._parameters.items():
+            if p is not None:
+                dest[structured_name_prefix + name] = p
+        for name, b in self._buffers.items():
+            if b is not None and name not in self._non_persistable_buffer_names:
+                dest[structured_name_prefix + name] = b
+        if include_sublayers:
+            for lname, l in self.named_children():
+                l.state_dict(dest, True, structured_name_prefix + lname + ".")
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for k, v in state_dict.items():
+            if k not in own:
+                unexpected.append(k)
+                continue
+            tgt = own[k]
+            data = v._data if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+            if tuple(data.shape) != tuple(tgt._data.shape):
+                raise ValueError(
+                    f"shape mismatch for {k}: {data.shape} vs {tgt._data.shape}"
+                )
+            tgt._replace_data(data.astype(tgt._data.dtype))
+        for k in own:
+            if k not in state_dict:
+                missing.append(k)
+        return missing, unexpected
+
+    set_dict = set_state_dict
+    load_dict = set_state_dict
+
+    def to(self, device=None, dtype=None, blocking=None):
+        from ..core.device import to_device
+
+        def convert(t):
+            data = t._data
+            if dtype is not None and dtypes.is_floating_point(data.dtype):
+                data = data.astype(dtypes.convert_dtype(dtype))
+            if device is not None:
+                data = to_device(data, device if isinstance(device, str) else "cpu")
+            t._replace_data(data)
+
+        for _, p in self.named_parameters():
+            convert(p)
+        for _, b in self.named_buffers():
+            convert(b)
+        if dtype is not None:
+            self._dtype = dtypes.convert_dtype(dtype)
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    # -- hooks ---------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        key = len(self._forward_pre_hooks)
+        self._forward_pre_hooks[key] = hook
+        return _HookHandle(self._forward_pre_hooks, key)
+
+    def register_forward_post_hook(self, hook):
+        key = len(self._forward_post_hooks)
+        self._forward_post_hooks[key] = hook
+        return _HookHandle(self._forward_post_hooks, key)
+
+    # -- call ----------------------------------------------------------------
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            res = hook(self, inputs, outputs)
+            if res is not None:
+                outputs = res
+        return outputs
+
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, child in self.named_children():
+            child_repr = repr(child).split("\n")
+            child_repr = [child_repr[0]] + ["  " + l for l in child_repr[1:]]
+            lines.append(f"  ({name}): " + "\n".join(child_repr))
+        main = f"{type(self).__name__}({extra}"
+        if lines:
+            return main + "\n" + "\n".join(lines) + "\n)"
+        return main + ")"
+
+    def full_name(self):
+        return self._name_scope
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    # -- functional bridge (jit/grad/pjit path) ------------------------------
+    def functional_state(self, trainable_only=False):
+        """Pytree {name: jax.Array} of parameters (+buffers unless trainable_only)."""
+        tree = {n: p._data for n, p in self.named_parameters()
+                if (not trainable_only) or p.trainable}
+        if not trainable_only:
+            tree.update({n: b._data for n, b in self.named_buffers()})
+        return tree
+
+    def raw_state(self):
+        """{name: Tensor} over params+buffers (handles, not copies)."""
+        d = dict(self.named_parameters())
+        d.update(dict(self.named_buffers()))
+        return d
+
+    @contextmanager
+    def bind_state(self, tree):
+        """Temporarily rebind parameter/buffer payloads to ``tree`` values.
+
+        Values may be jax.Arrays or tracers; forward run inside this context
+        traces against them, enabling jax.jit/grad/vmap over the layer.
+        """
+        handles = self.raw_state()
+        saved = {}
+        try:
+            for name, val in tree.items():
+                t = handles.get(name)
+                if t is None:
+                    continue
+                saved[name] = t._data
+                t._data = val._data if isinstance(val, Tensor) else val
+            yield self
+        finally:
+            for name, val in saved.items():
+                handles[name]._data = val
+
+
+class _HookHandle:
+    def __init__(self, registry, key):
+        self._registry = registry
+        self._key = key
+
+    def remove(self):
+        self._registry.pop(self._key, None)

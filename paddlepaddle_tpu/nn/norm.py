@@ -1,0 +1,195 @@
+"""Normalization layers (reference: python/paddle/nn/layer/norm.py)."""
+
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+from . import functional as F
+from .initializer import Constant
+from .layer import Layer
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self.normalized_shape = list(normalized_shape)
+        self.epsilon = epsilon
+        self.weight = (
+            self.create_parameter(self.normalized_shape, attr=weight_attr,
+                                  default_initializer=Constant(1.0))
+            if weight_attr is not False else None
+        )
+        self.bias = (
+            self.create_parameter(self.normalized_shape, attr=bias_attr, is_bias=True)
+            if bias_attr is not False else None
+        )
+
+    def forward(self, x):
+        return F.layer_norm(x, self.normalized_shape, self.weight, self.bias, self.epsilon)
+
+    def extra_repr(self):
+        return f"normalized_shape={self.normalized_shape}, epsilon={self.epsilon}"
+
+
+class RMSNorm(Layer):
+    """TPU-native fused rms_norm (reference: incubate fused_rms_norm + PaddleNLP RMSNorm)."""
+
+    def __init__(self, normalized_shape, epsilon=1e-6, weight_attr=None, bias_attr=False, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self.normalized_shape = list(normalized_shape)
+        self.epsilon = epsilon
+        self.weight = self.create_parameter(
+            self.normalized_shape, attr=weight_attr, default_initializer=Constant(1.0))
+        self.bias = (
+            self.create_parameter(self.normalized_shape, attr=bias_attr, is_bias=True)
+            if bias_attr not in (False, None) else None
+        )
+
+    def forward(self, x):
+        return F.rms_norm(x, self.weight, self.bias, self.epsilon)
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", use_global_stats=None, name=None):
+        super().__init__()
+        self._num_features = num_features
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self._use_global_stats = use_global_stats
+        self.weight = (
+            self.create_parameter([num_features], attr=weight_attr,
+                                  default_initializer=Constant(1.0))
+            if weight_attr is not False else None
+        )
+        self.bias = (
+            self.create_parameter([num_features], attr=bias_attr, is_bias=True)
+            if bias_attr is not False else None
+        )
+        from ..ops.creation import ones, zeros
+
+        self.register_buffer("_mean", zeros([num_features], "float32"))
+        self.register_buffer("_variance", ones([num_features], "float32"))
+
+    def forward(self, x):
+        return F.batch_norm(
+            x, self._mean, self._variance, self.weight, self.bias,
+            training=self.training, momentum=self._momentum, epsilon=self._epsilon,
+            data_format=self._data_format, use_global_stats=self._use_global_stats,
+        )
+
+    def extra_repr(self):
+        return f"num_features={self._num_features}, momentum={self._momentum}, epsilon={self._epsilon}"
+
+
+class BatchNorm(_BatchNormBase):
+    pass
+
+
+class BatchNorm1D(_BatchNormBase):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, data_format="NCL", use_global_stats=None, name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr, bias_attr,
+                         "NCHW" if data_format == "NCL" else data_format, use_global_stats)
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, data_format="NCDHW", use_global_stats=None, name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr, bias_attr,
+                         data_format, use_global_stats)
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """On TPU, batch statistics are computed over the global (sharded) batch by
+    XLA when the input is sharded over the data axis — sync is free under
+    GSPMD; this class exists for API parity (reference:
+    python/paddle/nn/layer/norm.py SyncBatchNorm)."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        if isinstance(layer, _BatchNormBase) and not isinstance(layer, SyncBatchNorm):
+            new = SyncBatchNorm(layer._num_features, layer._momentum, layer._epsilon,
+                                data_format=layer._data_format)
+            new.weight, new.bias = layer.weight, layer.bias
+            new._buffers = layer._buffers
+            return new
+        for name, sub in list(layer.named_children()):
+            layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        return layer
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self.weight = (
+            self.create_parameter([num_channels], attr=weight_attr,
+                                  default_initializer=Constant(1.0))
+            if weight_attr is not False else None
+        )
+        self.bias = (
+            self.create_parameter([num_channels], attr=bias_attr, is_bias=True)
+            if bias_attr is not False else None
+        )
+
+    def forward(self, x):
+        return F.group_norm(x, self._num_groups, self.weight, self.bias,
+                            self._epsilon, self._data_format)
+
+
+class InstanceNorm1D(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9, weight_attr=None,
+                 bias_attr=None, data_format="NCL", name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        self.weight = (
+            self.create_parameter([num_features], attr=weight_attr,
+                                  default_initializer=Constant(1.0))
+            if weight_attr is not False else None
+        )
+        self.bias = (
+            self.create_parameter([num_features], attr=bias_attr, is_bias=True)
+            if bias_attr is not False else None
+        )
+
+    def forward(self, x):
+        return F.instance_norm(x, weight=self.weight, bias=self.bias, eps=self._epsilon)
+
+
+class InstanceNorm2D(InstanceNorm1D):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", name=None):
+        super().__init__(num_features, epsilon, momentum, weight_attr, bias_attr)
+
+
+class InstanceNorm3D(InstanceNorm1D):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9, weight_attr=None,
+                 bias_attr=None, data_format="NCDHW", name=None):
+        super().__init__(num_features, epsilon, momentum, weight_attr, bias_attr)
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+        super().__init__()
+        self.args = (size, alpha, beta, k, data_format)
+
+    def forward(self, x):
+        return F.local_response_norm(x, *self.args)
+
+
+class SpectralNorm(Layer):
+    def __init__(self, *a, **k):
+        super().__init__()
+        raise NotImplementedError("SpectralNorm lands with the GAN kit")

@@ -1,0 +1,111 @@
+"""Op namespace + Tensor method patching.
+
+The analogue of paddle's monkey_patch_math_tensor / tensor_patch_methods
+(python/paddle/base/dygraph/math_op_patch.py): every functional op is also a
+Tensor method, and python operators dispatch to them."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+from . import comparison, creation, indexing, linalg, manipulation, math, reduction, search
+
+_MODULES = [math, reduction, manipulation, comparison, linalg, search]
+
+_NOT_METHODS = {
+    "broadcast_shape",
+    "builtins_sum",
+    "builtins_slice",
+    "is_tensor",
+    "scatter_nd",
+    "einsum",
+    "multi_dot",
+    "broadcast_tensors",
+}
+
+
+def _make_method(fn):
+    def method(self, *args, **kwargs):
+        return fn(self, *args, **kwargs)
+
+    method.__name__ = fn.__name__
+    method.__doc__ = fn.__doc__
+    return method
+
+
+def _patch_tensor_methods():
+    for mod in _MODULES:
+        for name in dir(mod):
+            if name.startswith("_") or name in _NOT_METHODS:
+                continue
+            fn = getattr(mod, name)
+            if not callable(fn) or isinstance(fn, type):
+                continue
+            if getattr(fn, "__module__", "").startswith("jax") or getattr(
+                fn, "__module__", ""
+            ).startswith("numpy"):
+                continue
+            if not hasattr(Tensor, name):
+                setattr(Tensor, name, _make_method(fn))
+
+    # creation-adjacent methods
+    for name in ("zeros_like", "ones_like", "full_like", "clone"):
+        setattr(Tensor, name, _make_method(getattr(creation, name)))
+
+    Tensor.astype = _make_method(manipulation.cast)
+    Tensor.cast = _make_method(manipulation.cast)
+    Tensor.item_ = Tensor.item
+
+    # ---- operators -----------------------------------------------------
+    Tensor.__add__ = lambda s, o: math.add(s, _c(o))
+    Tensor.__radd__ = lambda s, o: math.add(_c(o), s)
+    Tensor.__sub__ = lambda s, o: math.subtract(s, _c(o))
+    Tensor.__rsub__ = lambda s, o: math.subtract(_c(o), s)
+    Tensor.__mul__ = lambda s, o: math.multiply(s, _c(o))
+    Tensor.__rmul__ = lambda s, o: math.multiply(_c(o), s)
+    Tensor.__truediv__ = lambda s, o: math.divide(s, _c(o))
+    Tensor.__rtruediv__ = lambda s, o: math.divide(_c(o), s)
+    Tensor.__floordiv__ = lambda s, o: math.floor_divide(s, _c(o))
+    Tensor.__rfloordiv__ = lambda s, o: math.floor_divide(_c(o), s)
+    Tensor.__mod__ = lambda s, o: math.remainder(s, _c(o))
+    Tensor.__rmod__ = lambda s, o: math.remainder(_c(o), s)
+    Tensor.__pow__ = lambda s, o: math.pow(s, _c(o))
+    Tensor.__rpow__ = lambda s, o: math.pow(_c(o), s)
+    Tensor.__matmul__ = lambda s, o: linalg.matmul(s, _c(o))
+    Tensor.__rmatmul__ = lambda s, o: linalg.matmul(_c(o), s)
+    Tensor.__neg__ = lambda s: math.neg(s)
+    Tensor.__abs__ = lambda s: math.abs(s)
+    Tensor.__invert__ = lambda s: comparison.bitwise_not(s) if not _isbool(s) else comparison.logical_not(s)
+    Tensor.__and__ = lambda s, o: (comparison.logical_and if _isbool(s) else comparison.bitwise_and)(s, _c(o))
+    Tensor.__or__ = lambda s, o: (comparison.logical_or if _isbool(s) else comparison.bitwise_or)(s, _c(o))
+    Tensor.__xor__ = lambda s, o: (comparison.logical_xor if _isbool(s) else comparison.bitwise_xor)(s, _c(o))
+    Tensor.__lshift__ = lambda s, o: comparison.bitwise_left_shift(s, _c(o))
+    Tensor.__rshift__ = lambda s, o: comparison.bitwise_right_shift(s, _c(o))
+    Tensor.__eq__ = lambda s, o: comparison.equal(s, _c(o))
+    Tensor.__ne__ = lambda s, o: comparison.not_equal(s, _c(o))
+    Tensor.__lt__ = lambda s, o: comparison.less_than(s, _c(o))
+    Tensor.__le__ = lambda s, o: comparison.less_equal(s, _c(o))
+    Tensor.__gt__ = lambda s, o: comparison.greater_than(s, _c(o))
+    Tensor.__ge__ = lambda s, o: comparison.greater_equal(s, _c(o))
+    Tensor.__getitem__ = lambda s, item: indexing.getitem(s, item)
+    Tensor.__setitem__ = lambda s, item, v: indexing.setitem(s, item, _c(v) if not _isscalarlike(v) else v)
+
+    Tensor.T = property(lambda s: manipulation.transpose(s, list(range(s.ndim))[::-1]))
+    Tensor.mT = property(lambda s: manipulation.matrix_transpose(s))
+
+
+def _c(o):
+    return o
+
+
+def _isbool(t):
+    return t._data.dtype == jnp.bool_
+
+
+def _isscalarlike(v):
+    return isinstance(v, (int, float, bool, complex))
+
+
+_patch_tensor_methods()

@@ -1,0 +1,55 @@
+"""Comparison & logic ops (reference: python/paddle/tensor/logic.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op, unwrap, wrap
+
+
+def _cmp(jfn, name):
+    def op(x, y, name=None):
+        return apply_op(jfn, x, y, op_name=name)
+
+    op.__name__ = name
+    return op
+
+
+equal = _cmp(lambda a, b: jnp.equal(a, b), "equal")
+not_equal = _cmp(jnp.not_equal, "not_equal")
+greater_than = _cmp(jnp.greater, "greater_than")
+greater_equal = _cmp(jnp.greater_equal, "greater_equal")
+less_than = _cmp(jnp.less, "less_than")
+less_equal = _cmp(jnp.less_equal, "less_equal")
+logical_and = _cmp(jnp.logical_and, "logical_and")
+logical_or = _cmp(jnp.logical_or, "logical_or")
+logical_xor = _cmp(jnp.logical_xor, "logical_xor")
+bitwise_and = _cmp(jnp.bitwise_and, "bitwise_and")
+bitwise_or = _cmp(jnp.bitwise_or, "bitwise_or")
+bitwise_xor = _cmp(jnp.bitwise_xor, "bitwise_xor")
+bitwise_left_shift = _cmp(jnp.left_shift, "bitwise_left_shift")
+bitwise_right_shift = _cmp(jnp.right_shift, "bitwise_right_shift")
+
+
+def logical_not(x, name=None):
+    return apply_op(jnp.logical_not, x)
+
+
+def bitwise_not(x, name=None):
+    return apply_op(jnp.bitwise_not, x)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op(
+        lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), x, y
+    )
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op(
+        lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), x, y
+    )
+
+
+def equal_all(x, y, name=None):
+    return wrap(jnp.array_equal(unwrap(x), unwrap(y)))

@@ -1,0 +1,242 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py, random.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core import random as prandom
+from ..core.dispatch import apply_op, unwrap, wrap
+from ..core.tensor import Tensor
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    t = Tensor(data, dtype=dtype)
+    t.stop_gradient = stop_gradient
+    if place is not None:
+        from ..core.device import to_device
+
+        t._data = to_device(t._data, place if isinstance(place, str) else "cpu")
+    return t
+
+
+def _dt(dtype, like=None):
+    if dtype is not None:
+        return dtypes.convert_dtype(dtype)
+    if like is not None:
+        return like.dtype
+    return dtypes.get_default_dtype()
+
+
+def zeros(shape, dtype=None, name=None):
+    return wrap(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return wrap(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    fill_value = unwrap(fill_value)
+    if dtype is None and hasattr(fill_value, "dtype"):
+        dtype = fill_value.dtype
+    return wrap(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return apply_op(lambda a: jnp.zeros_like(a, dtype=_dt(dtype, x) if dtype else None), x)
+
+
+def ones_like(x, dtype=None, name=None):
+    return apply_op(lambda a: jnp.ones_like(a, dtype=_dt(dtype, x) if dtype else None), x)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return wrap(jnp.full_like(unwrap(x), unwrap(fill_value), dtype=_dt(dtype, x)))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    if end is None:
+        start, end = 0, start
+    start, end, step = unwrap(start), unwrap(end), unwrap(step)
+    if dtype is None:
+        py = all(isinstance(v, (int, np.integer)) for v in (start, end, step))
+        dtype = jnp.int64 if py else dtypes.get_default_dtype()
+    return wrap(jnp.arange(start, end, step, dtype=dtypes.convert_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return wrap(jnp.linspace(unwrap(start), unwrap(stop), int(unwrap(num)), dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return wrap(jnp.logspace(unwrap(start), unwrap(stop), int(num), base=base, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return wrap(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def f(a):
+        if a.ndim == 1 and padding_value != 0:
+            d = jnp.diag(a, k=offset)
+            mask = jnp.eye(*d.shape, k=offset, dtype=bool)
+            return jnp.where(mask, d, jnp.asarray(padding_value, d.dtype))
+        return jnp.diag(a, k=offset)
+
+    return apply_op(f, x)
+
+
+def diagflat(x, offset=0, name=None):
+    return apply_op(lambda a: jnp.diagflat(a, k=offset), x)
+
+
+def tril(x, diagonal=0, name=None):
+    return apply_op(lambda a: jnp.tril(a, k=diagonal), x)
+
+
+def triu(x, diagonal=0, name=None):
+    return apply_op(lambda a: jnp.triu(a, k=diagonal), x)
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return wrap(jnp.asarray(np.stack([r, c]), dtype=dtypes.convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return wrap(jnp.asarray(np.stack([r, c]), dtype=dtypes.convert_dtype(dtype)))
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    outs = jnp.meshgrid(*[unwrap(a) for a in args], indexing="ij")
+    return [wrap(o) for o in outs]
+
+
+def assign(x, output=None):
+    data = unwrap(x)
+    if not hasattr(data, "dtype"):
+        data = jnp.asarray(np.asarray(data))
+    if output is not None:
+        output._replace_data(jnp.asarray(data, output.dtype))
+        return output
+    return apply_op(lambda a: a + 0, x) if isinstance(x, Tensor) else wrap(data)
+
+
+def clone(x, name=None):
+    return apply_op(lambda a: a + 0, x, op_name="clone")
+
+
+def numel(x, name=None):
+    return wrap(jnp.asarray(int(np.prod(unwrap(x).shape)), jnp.int64))
+
+
+def complex(real, imag, name=None):
+    return apply_op(jax.lax.complex, real, imag)
+
+
+def polar(abs, angle, name=None):
+    return apply_op(lambda r, t: r * jnp.exp(1j * t.astype(jnp.complex64)), abs, angle)
+
+
+# ---- random creation (reference: python/paddle/tensor/random.py) ----------
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, min=0.0, max=1.0)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.PRNGKey(seed) if seed else prandom.next_key()
+    return wrap(jax.random.uniform(key, _shape(shape), _dt(dtype), minval=min, maxval=max))
+
+
+def randn(shape, dtype=None, name=None):
+    key = prandom.next_key()
+    return wrap(jax.random.normal(key, _shape(shape), _dt(dtype)))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m, s = unwrap(mean), unwrap(std)
+        sh = jnp.broadcast_shapes(
+            getattr(m, "shape", ()), getattr(s, "shape", ())
+        )
+        key = prandom.next_key()
+        return wrap(jax.random.normal(key, sh, dtypes.get_default_dtype()) * s + m)
+    key = prandom.next_key()
+    sh = _shape(shape) if shape is not None else ()
+    return wrap(jax.random.normal(key, sh, dtypes.get_default_dtype()) * std + mean)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    key = prandom.next_key()
+    return wrap(
+        jax.random.randint(key, _shape(shape), low, high).astype(dtypes.convert_dtype(dtype))
+    )
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    return randint(low, high, unwrap(x).shape, dtype or x.dtype)
+
+
+def randperm(n, dtype="int64", name=None):
+    key = prandom.next_key()
+    return wrap(jax.random.permutation(key, n).astype(dtypes.convert_dtype(dtype)))
+
+
+def bernoulli(x, name=None):
+    key = prandom.next_key()
+    p = unwrap(x)
+    return wrap(jax.random.bernoulli(key, p).astype(p.dtype))
+
+
+def poisson(x, name=None):
+    key = prandom.next_key()
+    p = unwrap(x)
+    return wrap(jax.random.poisson(key, p).astype(p.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    key = prandom.next_key()
+    p = unwrap(x)
+
+    def draw(key, logits_1d):
+        if replacement:
+            return jax.random.categorical(key, jnp.log(logits_1d), shape=(num_samples,))
+        return jax.random.choice(
+            key, logits_1d.shape[0], shape=(num_samples,), replace=False, p=logits_1d / logits_1d.sum()
+        )
+
+    if p.ndim == 1:
+        return wrap(draw(key, p).astype(jnp.int64))
+    keys = jax.random.split(key, p.shape[0])
+    return wrap(jax.vmap(draw)(keys, p).astype(jnp.int64))
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(unwrap(s)) if not isinstance(s, int) else s for s in shape)

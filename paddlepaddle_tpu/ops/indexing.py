@@ -1,0 +1,55 @@
+"""__getitem__/__setitem__ with paddle/numpy semantics.
+
+Reference: the C++ getitem/setitem paths (paddle/fluid/pybind/eager_method.cc
+``__getitem__``/``__setitem__``, slice/strided_slice/set_value kernels). Under
+XLA these are gather/scatter/dynamic-slice HLOs; advanced indexing maps to
+jnp's numpy-compatible indexing directly. ``__setitem__`` is functional
+underneath: ``x.at[idx].set(v)`` then rebind — the tape stays correct because
+the rebind carries the new grad node."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+
+
+def _norm_index(item):
+    """Convert Tensors inside an index expression to jnp arrays."""
+    if isinstance(item, Tensor):
+        d = item._data
+        return d
+    if isinstance(item, tuple):
+        return tuple(_norm_index(i) for i in item)
+    if isinstance(item, list):
+        # python list of ints/bools/tensors → array index
+        if any(isinstance(i, (Tensor,)) for i in item):
+            return jnp.stack([_norm_index(i) for i in item])
+        return jnp.asarray(item) if item and not isinstance(item[0], (slice, type(None))) else tuple(item)
+    return item
+
+
+def getitem(x, item):
+    idx = _norm_index(item)
+
+    def f(a):
+        return a[idx]
+
+    return apply_op(f, x, op_name="getitem")
+
+
+def setitem(x, item, value):
+    idx = _norm_index(item)
+
+    def f(a, v):
+        if not hasattr(v, "dtype"):
+            v = jnp.asarray(v, a.dtype)
+        return a.at[idx].set(v.astype(a.dtype))
+
+    out = apply_op(f, x, value, op_name="setitem")
+    x._data = out._data
+    x._grad_node = out._grad_node
+    x._out_index = out._out_index
+    x._version += 1
+    return x

@@ -1,0 +1,7 @@
+"""Pallas TPU kernels for the fused hot ops.
+
+These are the TPU-native equivalents of the reference's fused CUDA kernels
+(paddle/phi/kernels/fusion/gpu/: flash-attn via dynload, fused_rope,
+fused_rms_norm, fused_bias_act …). Each kernel has an XLA fallback used on
+CPU (tests run on a virtual CPU mesh) and when FLAGS_use_pallas_kernels=0.
+"""

@@ -1,0 +1,231 @@
+"""Flash attention — Pallas TPU kernel + XLA fallback.
+
+Reference surface: python/paddle/nn/functional/flash_attention.py:364 (BSHD
+[batch, seq, heads, head_dim], fp16/bf16, causal) backed by dynload flashattn
+CUDA kernels (paddle/phi/backends/dynload/flashattn.cc). Here the TPU-native
+implementation is an online-softmax Pallas kernel tiled for the MXU: grid over
+(batch*heads, q-blocks), inner fori_loop over kv-blocks held in VMEM, f32
+accumulators, causal masking by block skip.
+
+Backward currently recomputes attention via the XLA path (flash-style
+recompute — O(N) memory, matching jax.checkpoint semantics); a dedicated
+Pallas backward kernel is a planned optimization.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op
+from ...core.flags import flag_value
+
+try:  # pallas import is cheap; kernels only compile when called on TPU
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+NEG_INF = -1e30
+
+
+def _use_pallas(q) -> bool:
+    if not _HAS_PALLAS or not flag_value("use_pallas_kernels"):
+        return False
+    if jax.default_backend() not in ("tpu", "axon"):
+        return False
+    # kernel wants seq divisible by block and head_dim aligned to 128 lanes
+    return q.shape[-1] % 128 == 0 or q.shape[-1] in (64, 128, 256)
+
+
+# ---------------------------------------------------------------------------
+# XLA reference path (also the recompute backward)
+# ---------------------------------------------------------------------------
+
+
+def _xla_attention(q, k, v, causal, mask, scale):
+    # [b, s, h, d] -> [b, h, s, d]
+    qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
+    kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+    vt = jnp.swapaxes(v, 1, 2)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+    sq, sk = logits.shape[-2], logits.shape[-1]
+    if causal:
+        causal_mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(causal_mask, logits, NEG_INF)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, NEG_INF)
+        else:
+            logits = logits + mask.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(vt.dtype), vt)
+    return jnp.swapaxes(out, 1, 2)
+
+
+# ---------------------------------------------------------------------------
+# Pallas forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q, block_kv, seq_k):
+    # q_ref: [block_q, d]; k_ref/v_ref: [seq_k, d]; o_ref: [block_q, d]
+    qi = pl.program_id(1)
+    q = q_ref[:].astype(jnp.float32) * scale
+    d = q.shape[-1]
+
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    num_kv = seq_k // block_kv
+    if causal:
+        # only visit kv blocks that intersect the causal triangle
+        num_visit = qi * block_q // block_kv + pl.cdiv(block_q, block_kv)
+    else:
+        num_visit = num_kv
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
+        v = v_ref[pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [bq, bkv]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
+            k_pos = j * block_kv + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.dot(p, v, preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, num_visit, body, (m0, l0, acc0))
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _pallas_forward(q, k, v, causal, scale):
+    """q,k,v: [bh, s, d] (already flattened batch*heads)."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    block_q = min(int(flag_value("flash_attn_block_q")), sq)
+    block_kv = min(int(flag_value("flash_attn_block_kv")), sk)
+    # shrink blocks until they divide the sequence
+    while sq % block_q:
+        block_q //= 2
+    while sk % block_kv:
+        block_kv //= 2
+    block_q = max(block_q, 8)
+    block_kv = max(block_kv, 8)
+    if sq % block_q or sk % block_kv:
+        return None  # fallback
+
+    kernel = functools.partial(
+        _fwd_kernel_wrapped, scale=scale, causal=causal, block_q=block_q,
+        block_kv=block_kv, seq_k=sk,
+    )
+    grid = (bh, sq // block_q)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+    )(q, k, v)
+
+
+# Blocks arrive with a leading singleton dim; reshape inside the kernel refs is
+# awkward, so wrap the kernel to squeeze/unsqueeze.
+def _fwd_kernel_wrapped(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q, block_kv, seq_k):
+    class _Squeezed:
+        def __init__(self, ref):
+            self._ref = ref
+
+        def __getitem__(self, idx):
+            if isinstance(idx, tuple):
+                return self._ref[(0,) + idx]
+            return self._ref[(0, idx)]
+
+        def __setitem__(self, idx, val):
+            if isinstance(idx, tuple):
+                self._ref[(0,) + idx] = val
+            else:
+                self._ref[(0, idx)] = val
+
+        @property
+        def shape(self):
+            return self._ref.shape[1:]
+
+        @property
+        def dtype(self):
+            return self._ref.dtype
+
+    _fwd_kernel(
+        _Squeezed(q_ref), _Squeezed(k_ref), _Squeezed(v_ref), _Squeezed(o_ref),
+        scale=scale, causal=causal, block_q=block_q, block_kv=block_kv, seq_k=seq_k,
+    )
+
+
+# ---------------------------------------------------------------------------
+# public op
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_core(q, k, v, causal, scale, use_pallas):
+    return _flash_fwd_impl(q, k, v, causal, scale, use_pallas)
+
+
+def _flash_fwd_impl(q, k, v, causal, scale, use_pallas):
+    if use_pallas:
+        b, s, h, d = q.shape
+        qf = jnp.swapaxes(q, 1, 2).reshape(b * h, s, d)
+        kf = jnp.swapaxes(k, 1, 2).reshape(b * h, k.shape[1], d)
+        vf = jnp.swapaxes(v, 1, 2).reshape(b * h, v.shape[1], d)
+        out = _pallas_forward(qf, kf, vf, causal, scale)
+        if out is not None:
+            return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2)
+    return _xla_attention(q, k, v, causal, None, scale)
+
+
+def _flash_fwd(q, k, v, causal, scale, use_pallas):
+    out = _flash_core(q, k, v, causal, scale, use_pallas)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, scale, use_pallas, res, g):
+    q, k, v = res
+    # flash-style recompute: re-run attention under VJP (O(N) memory)
+    _, vjp = jax.vjp(lambda q_, k_, v_: _xla_attention(q_, k_, v_, causal, None, scale), q, k, v)
+    return vjp(g)
+
+
+_flash_core.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention_bshd(query, key, value, causal=False, mask=None, dropout=0.0):
+    """Public entry — Tensor in/out, BSHD layout like the reference API."""
+
+    def f(q, k, v, m):
+        scale = 1.0 / math.sqrt(q.shape[-1])
+        if m is None and (dropout == 0.0):
+            return _flash_core(q, k, v, causal, scale, _use_pallas(q))
+        out = _xla_attention(q, k, v, causal, m, scale)
+        if dropout > 0.0:
+            from ...core import random as prandom
+
+            keep = jax.random.bernoulli(prandom.next_key(), 1.0 - dropout, out.shape)
+            out = jnp.where(keep, out / (1.0 - dropout), 0.0).astype(out.dtype)
+        return out
+
+    return apply_op(f, query, key, value, mask, op_name="flash_attention")

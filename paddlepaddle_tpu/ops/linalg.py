@@ -1,0 +1,267 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py, paddle.linalg).
+
+matmul lowers to a single XLA dot_general, which XLA tiles onto the MXU —
+this is the perf-critical op (reference call stack SURVEY.md §3.1)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply_op, unwrap
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return apply_op(f, x, y, op_name="matmul")
+
+
+mm = matmul
+
+
+def dot(x, y, name=None):
+    def f(a, b):
+        if a.ndim == 2:
+            return jnp.sum(a * b, axis=-1)
+        return jnp.dot(a, b)
+
+    return apply_op(f, x, y, op_name="dot")
+
+
+def bmm(x, y, name=None):
+    return apply_op(jnp.matmul, x, y, op_name="bmm")
+
+
+def mv(x, vec, name=None):
+    return apply_op(jnp.matmul, x, vec, op_name="mv")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply_op(
+        lambda i, a, b: beta * i + alpha * jnp.matmul(a, b), input, x, y, op_name="addmm"
+    )
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def f(a):
+        if p is None or p == "fro":
+            if axis is None:
+                return jnp.sqrt(jnp.sum(jnp.square(a)))
+            return jnp.linalg.norm(a, ord=None, axis=_ax(axis), keepdims=keepdim)
+        if p == "nuc":
+            return jnp.linalg.norm(a, ord="nuc", axis=_ax(axis), keepdims=keepdim)
+        if p == float("inf") or p == "inf":
+            base = jnp.abs(a)
+            return jnp.max(base, axis=_ax(axis), keepdims=keepdim) if axis is not None or True else base
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=_ax(axis), keepdims=keepdim)
+        if axis is None:
+            a = a.reshape(-1)
+            return jnp.sum(jnp.abs(a) ** p) ** (1.0 / p)
+        return jnp.sum(jnp.abs(a) ** p, axis=_ax(axis), keepdims=keepdim) ** (1.0 / p)
+
+    return apply_op(f, x, op_name="norm")
+
+
+def _ax(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(axis)
+    return axis
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return apply_op(lambda a: jnp.linalg.norm(a, ord=p, axis=tuple(axis), keepdims=keepdim), x)
+
+
+def dist(x, y, p=2, name=None):
+    return apply_op(lambda a, b: jnp.linalg.norm((a - b).reshape(-1), ord=p), x, y)
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=None):
+    def f(a, b):
+        diff = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 0.0)
+        return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+
+    return apply_op(f, x, y)
+
+
+def cross(x, y, axis=9, name=None):
+    def f(a, b):
+        ax = axis
+        if ax == 9:
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+
+    return apply_op(f, x, y)
+
+
+def cholesky(x, upper=False, name=None):
+    def f(a):
+        l = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(l, -1, -2) if upper else l
+
+    return apply_op(f, x)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    return apply_op(lambda b, l: jax.scipy.linalg.cho_solve((l, not upper), b), x, y)
+
+
+def inv(x, name=None):
+    return apply_op(jnp.linalg.inv, x)
+
+
+inverse = inv
+
+
+def det(x, name=None):
+    return apply_op(jnp.linalg.det, x)
+
+
+def slogdet(x, name=None):
+    def f(a):
+        sign, logabs = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logabs])
+
+    return apply_op(f, x)
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply_op(lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)), x)
+
+
+def svdvals(x, name=None):
+    return apply_op(lambda a: jnp.linalg.svd(a, compute_uv=False), x)
+
+
+def qr(x, mode="reduced", name=None):
+    return apply_op(lambda a: tuple(jnp.linalg.qr(a, mode=mode)), x)
+
+
+def eig(x, name=None):
+    import numpy.linalg as npl
+
+    w, v = npl.eig(np.asarray(unwrap(x)))
+    from ..core.dispatch import wrap
+
+    return wrap(jnp.asarray(w)), wrap(jnp.asarray(v))
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply_op(lambda a: tuple(jnp.linalg.eigh(a, symmetrize_input=True)), x)
+
+
+def eigvals(x, name=None):
+    import numpy.linalg as npl
+
+    from ..core.dispatch import wrap
+
+    return wrap(jnp.asarray(npl.eigvals(np.asarray(unwrap(x)))))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply_op(jnp.linalg.eigvalsh, x)
+
+
+def solve(x, y, name=None):
+    return apply_op(jnp.linalg.solve, x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    return apply_op(
+        lambda a, b: jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular
+        ),
+        x,
+        y,
+    )
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    return apply_op(lambda a, b: tuple(jnp.linalg.lstsq(a, b, rcond=rcond)), x, y)
+
+
+def matrix_power(x, n, name=None):
+    return apply_op(lambda a: jnp.linalg.matrix_power(a, n), x)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply_op(lambda a: jnp.linalg.matrix_rank(a, rtol=tol), x)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply_op(lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), x)
+
+
+def multi_dot(x, name=None):
+    return apply_op(lambda *xs: jnp.linalg.multi_dot(xs), *x)
+
+
+def householder_product(x, tau, name=None):
+    def f(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+
+        def apply_one(carry, i):
+            q = carry
+            v = jnp.where(jnp.arange(m) > i, a[:, i], jnp.where(jnp.arange(m) == i, 1.0, 0.0))
+            h = eye - t[i] * jnp.outer(v, v)
+            return q @ h, None
+
+        q, _ = jax.lax.scan(apply_one, eye, jnp.arange(t.shape[-1]))
+        return q[:, :n]
+
+    return apply_op(f, x, tau)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply_op(lambda a: jnp.corrcoef(a, rowvar=rowvar), x)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply_op(
+        lambda a: jnp.cov(
+            a,
+            rowvar=rowvar,
+            ddof=1 if ddof else 0,
+            fweights=unwrap(fweights) if fweights is not None else None,
+            aweights=unwrap(aweights) if aweights is not None else None,
+        ),
+        x,
+    )
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def f(a):
+        lu_, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_, (piv + 1).astype(jnp.int32)
+
+    out = apply_op(f, x)
+    if get_infos:
+        from .creation import zeros
+
+        return out[0], out[1], zeros([1], dtype="int32")
+    return out
+
+
+def einsum(equation, *operands, name=None):
+    if len(operands) == 1 and isinstance(operands[0], (list, tuple)):
+        operands = tuple(operands[0])
+    return apply_op(
+        lambda *ops: jnp.einsum(equation, *ops), *operands, op_name="einsum"
+    )

@@ -1,0 +1,574 @@
+"""Shape/layout manipulation ops (reference: python/paddle/tensor/manipulation.py).
+
+The reference implements views via stride kernels (paddle/phi/kernels/stride/);
+under XLA these are free reshapes/slices fused by the compiler, so every op
+here is a pure functional jnp transform."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.dispatch import apply_op, unwrap, wrap
+from ..core.tensor import Tensor
+
+
+def _ishape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in shape.numpy())
+    out = []
+    for s in shape:
+        out.append(int(unwrap(s)) if not isinstance(s, int) else s)
+    return tuple(out)
+
+
+def cast(x, dtype):
+    dt = dtypes.convert_dtype(dtype)
+    return apply_op(lambda a: a.astype(dt), x, op_name="cast")
+
+
+astype = cast
+
+
+def reshape(x, shape, name=None):
+    sh = _ishape(shape)
+    return apply_op(lambda a: jnp.reshape(a, sh), x, op_name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._data, x._grad_node, x._out_index = out._data, out._grad_node, out._out_index
+    return x
+
+
+def transpose(x, perm, name=None):
+    return apply_op(lambda a: jnp.transpose(a, tuple(perm)), x, op_name="transpose")
+
+
+def t(x, name=None):
+    def f(a):
+        if a.ndim < 2:
+            return a
+        return a.T
+
+    return apply_op(f, x, op_name="t")
+
+
+def matrix_transpose(x, name=None):
+    return apply_op(lambda a: jnp.swapaxes(a, -1, -2), x)
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply_op(lambda a: jnp.moveaxis(a, source, destination), x)
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply_op(lambda a: jnp.swapaxes(a, axis0, axis1), x)
+
+
+swapdims = swapaxes
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def f(a):
+        nd = a.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = a.shape[:s] + (-1,) + a.shape[e + 1 :]
+        return jnp.reshape(a, new_shape)
+
+    return apply_op(f, x, op_name="flatten")
+
+
+def squeeze(x, axis=None, name=None):
+    def f(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(ax % a.ndim for ax in axes if a.shape[ax % a.ndim] == 1)
+        return jnp.squeeze(a, axis=axes) if axes else a
+
+    return apply_op(f, x, op_name="squeeze")
+
+
+def unsqueeze(x, axis, name=None):
+    def f(a):
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        out = a
+        for ax in sorted(int(unwrap(v)) for v in axes):
+            out = jnp.expand_dims(out, ax)
+        return out
+
+    return apply_op(f, x, op_name="unsqueeze")
+
+
+def concat(x, axis=0, name=None):
+    axis = int(unwrap(axis))
+    return apply_op(lambda *xs: jnp.concatenate(xs, axis=axis), *x, op_name="concat")
+
+
+def stack(x, axis=0, name=None):
+    return apply_op(lambda *xs: jnp.stack(xs, axis=axis), *x, op_name="stack")
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(unwrap(axis))
+
+    def f(a):
+        n = a.shape[axis]
+        if isinstance(num_or_sections, int):
+            return tuple(jnp.split(a, num_or_sections, axis=axis))
+        secs = [s if s != -1 else n - builtins_sum(s2 for s2 in num_or_sections if s2 != -1)
+                for s in num_or_sections]
+        idx = np.cumsum(secs[:-1]).tolist()
+        return tuple(jnp.split(a, idx, axis=axis))
+
+    out = apply_op(f, x, op_name="split")
+    return list(out)
+
+
+def builtins_sum(it):
+    import builtins
+
+    return builtins.sum(it)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    n = unwrap(x).shape[axis]
+    out = apply_op(
+        lambda a: tuple(jnp.squeeze(s, axis) for s in jnp.split(a, n, axis=axis)),
+        x,
+        op_name="unbind",
+    )
+    return list(out)
+
+
+def tile(x, repeat_times, name=None):
+    reps = _ishape(repeat_times)
+    return apply_op(lambda a: jnp.tile(a, reps), x, op_name="tile")
+
+
+def expand(x, shape, name=None):
+    sh = _ishape(shape)
+
+    def f(a):
+        tgt = list(sh)
+        # paddle: -1 keeps the original dim
+        off = len(tgt) - a.ndim
+        for i in range(len(tgt)):
+            if tgt[i] == -1:
+                tgt[i] = a.shape[i - off]
+        return jnp.broadcast_to(a, tuple(tgt))
+
+    return apply_op(f, x, op_name="expand")
+
+
+def expand_as(x, y, name=None):
+    return apply_op(lambda a, b: jnp.broadcast_to(a, b.shape), x, y)
+
+
+def broadcast_to(x, shape, name=None):
+    return apply_op(lambda a: jnp.broadcast_to(a, _ishape(shape)), x)
+
+
+def broadcast_tensors(inputs, name=None):
+    out = apply_op(lambda *xs: tuple(jnp.broadcast_arrays(*xs)), *inputs)
+    return list(out)
+
+
+def flip(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return apply_op(lambda a: jnp.flip(a, axis=tuple(axes)), x)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply_op(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x)
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply_op(lambda a: jnp.roll(a, shifts, axis=axis), x)
+
+
+def gather(x, index, axis=0, name=None):
+    axis_v = int(unwrap(axis))
+    return apply_op(
+        lambda a, i: jnp.take(a, i.reshape(-1) if i.ndim > 1 else i, axis=axis_v),
+        x,
+        index,
+        op_name="gather",
+    )
+
+
+def gather_nd(x, index, name=None):
+    def f(a, idx):
+        ix = tuple(jnp.moveaxis(idx, -1, 0))
+        return a[ix]
+
+    return apply_op(f, x, index, op_name="gather_nd")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def f(a, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            # paddle semantics: later rows win; zero-then-add of last occurrence
+            return a.at[i].set(u)
+        base = a.at[i].set(jnp.zeros_like(u))
+        return base.at[i].add(u)
+
+    return apply_op(f, x, index, updates, op_name="scatter")
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    out = scatter(x, index, updates, overwrite)
+    x._data, x._grad_node, x._out_index = out._data, out._grad_node, out._out_index
+    return x
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def f(a, i, u):
+        ix = tuple(jnp.moveaxis(i, -1, 0))
+        return a.at[ix].add(u)
+
+    return apply_op(f, x, index, updates, op_name="scatter_nd_add")
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+
+    return scatter_nd_add(zeros(shape, dtype=updates.dtype), index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply_op(lambda a, i: jnp.take(a, i, axis=axis), x, index)
+
+
+def index_sample(x, index):
+    def f(a, i):
+        rows = jnp.arange(a.shape[0])[:, None]
+        return a[rows, i]
+
+    return apply_op(f, x, index)
+
+
+def index_add(x, index, axis, value, name=None):
+    def f(a, i, v):
+        a_m = jnp.moveaxis(a, axis, 0)
+        v_m = jnp.moveaxis(v, axis, 0)
+        out = a_m.at[i].add(v_m)
+        return jnp.moveaxis(out, 0, axis)
+
+    return apply_op(f, x, index, value)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    def f(a, v, *idx):
+        ix = tuple(idx)
+        return a.at[ix].add(v) if accumulate else a.at[ix].set(v)
+
+    return apply_op(f, x, value, *indices)
+
+
+def masked_select(x, mask, name=None):
+    data = unwrap(x)
+    m = np.asarray(unwrap(mask))
+    return wrap(data[jnp.asarray(m)])
+
+
+def masked_fill(x, mask, value, name=None):
+    return apply_op(
+        lambda a, m, v: jnp.where(m, jnp.asarray(v, a.dtype), a), x, mask, unwrap(value)
+    )
+
+
+def masked_scatter(x, mask, value, name=None):
+    def f(a, m, v):
+        flat_m = m.reshape(-1)
+        idx = jnp.cumsum(flat_m) - 1
+        picked = v.reshape(-1)[jnp.clip(idx, 0, v.size - 1)]
+        return jnp.where(flat_m, picked, a.reshape(-1)).reshape(a.shape)
+
+    return apply_op(f, x, mask, value)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        from .search import nonzero
+
+        return nonzero(condition, as_tuple=True)
+    return apply_op(lambda c, a, b: jnp.where(c, a, b), condition, x, y, op_name="where")
+
+
+def where_(condition, x, y, name=None):
+    out = where(condition, x, y)
+    x._data, x._grad_node, x._out_index = out._data, out._grad_node, out._out_index
+    return x
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return apply_op(lambda a, i: jnp.take_along_axis(a, i, axis=axis), arr, indices)
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True, broadcast=True, name=None):
+    def f(a, i, v):
+        v = jnp.broadcast_to(jnp.asarray(v, a.dtype), i.shape) if not hasattr(v, "ndim") or v.ndim == 0 else v
+        if reduce == "assign":
+            return jnp.put_along_axis(a, i, v, axis=axis, inplace=False)
+        ones_like_idx = jnp.ones(i.shape, a.dtype)
+        if reduce == "add":
+            base = a if include_self else jnp.put_along_axis(a, i, jnp.zeros_like(v), axis=axis, inplace=False)
+            # scatter-add via at[]
+            a_m = jnp.moveaxis(base, axis, -1)
+            i_m = jnp.moveaxis(i, axis, -1)
+            v_m = jnp.moveaxis(jnp.broadcast_to(v, i.shape), axis, -1)
+            lead = a_m.shape[:-1]
+            grid = jnp.indices(lead + (i_m.shape[-1],))
+            out = a_m.at[tuple(grid[:-1]) + (i_m,)].add(v_m)
+            return jnp.moveaxis(out, -1, axis)
+        if reduce in ("mul", "multiply"):
+            a_m = jnp.moveaxis(a, axis, -1)
+            i_m = jnp.moveaxis(i, axis, -1)
+            v_m = jnp.moveaxis(jnp.broadcast_to(v, i.shape), axis, -1)
+            lead = a_m.shape[:-1]
+            grid = jnp.indices(lead + (i_m.shape[-1],))
+            out = a_m.at[tuple(grid[:-1]) + (i_m,)].multiply(v_m)
+            return jnp.moveaxis(out, -1, axis)
+        raise ValueError(f"unknown reduce {reduce}")
+
+    return apply_op(f, arr, indices, unwrap(values))
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    return apply_op(
+        lambda a, r: jnp.repeat(a, r, axis=axis),
+        x,
+        unwrap(repeats),
+    )
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", pad_from_left_axis=True, name=None):
+    def f(a):
+        p = list(pad)
+        if len(p) == a.ndim * 2:
+            width = [(p[2 * i], p[2 * i + 1]) for i in range(a.ndim)]
+        else:
+            # paddle NCHW/NCL conventions: pad applies to spatial dims, given
+            # as [left, right, top, bottom, ...] over the LAST dims reversed.
+            n_spatial = len(p) // 2
+            width = [(0, 0)] * a.ndim
+            if data_format.endswith("HWC") or data_format.endswith("LC") or data_format.endswith("DHWC"):
+                spatial = list(range(1, 1 + n_spatial))
+            else:
+                spatial = list(range(a.ndim - n_spatial, a.ndim))
+            for k, dim in enumerate(spatial):
+                width[dim] = (p[2 * k], p[2 * k + 1])
+        if mode == "constant":
+            return jnp.pad(a, width, constant_values=value)
+        jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+        return jnp.pad(a, width, mode=jmode)
+
+    return apply_op(f, x, op_name="pad")
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    a = np.asarray(unwrap(x))
+    res = np.unique(
+        a, return_index=return_index, return_inverse=return_inverse,
+        return_counts=return_counts, axis=axis,
+    )
+    if not isinstance(res, tuple):
+        return wrap(jnp.asarray(res))
+    return tuple(wrap(jnp.asarray(r)) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    a = np.asarray(unwrap(x))
+    if axis is None:
+        a = a.reshape(-1)
+        axis = 0
+    keep = np.ones(a.shape[axis], bool)
+    sl = [slice(None)] * a.ndim
+    prev = None
+    vals = np.moveaxis(a, axis, 0)
+    keep[1:] = np.any(vals[1:] != vals[:-1], axis=tuple(range(1, a.ndim)))
+    out = np.compress(keep, a, axis=axis)
+    rets = [wrap(jnp.asarray(out))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        rets.append(wrap(jnp.asarray(inv.astype(np.int64))))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, a.shape[axis]))
+        rets.append(wrap(jnp.asarray(counts.astype(np.int64))))
+    return rets[0] if len(rets) == 1 else tuple(rets)
+
+
+def as_real(x, name=None):
+    return apply_op(lambda a: jnp.stack([a.real, a.imag], axis=-1), x)
+
+
+def as_complex(x, name=None):
+    return apply_op(lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x)
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return apply_op(lambda a: a.view(dtypes.convert_dtype(shape_or_dtype)), x)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    def f(a):
+        flat = a.reshape(-1)
+        idx = np.full(tuple(shape), offset, dtype=np.int64)
+        for d, (s, st) in enumerate(zip(shape, stride)):
+            ar = np.arange(s) * st
+            idx = idx + ar.reshape([-1 if i == d else 1 for i in range(len(shape))])
+        return flat[jnp.asarray(idx)]
+
+    return apply_op(f, x)
+
+
+def slice(input, axes, starts, ends, name=None):
+    def f(a):
+        sl = [builtins_slice(None)] * a.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            sl[ax] = builtins_slice(int(unwrap(s)), int(unwrap(e)))
+        return a[tuple(sl)]
+
+    return apply_op(f, input, op_name="slice")
+
+
+def builtins_slice(*a):
+    return __builtins__["slice"](*a) if isinstance(__builtins__, dict) else slice_builtin(*a)
+
+
+import builtins as _builtins  # noqa: E402
+
+builtins_slice = _builtins.slice  # type: ignore
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def f(a):
+        sl = [_builtins.slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            sl[ax] = _builtins.slice(int(unwrap(s)), int(unwrap(e)), int(unwrap(st)))
+        return a[tuple(sl)]
+
+    return apply_op(f, x)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    def f(a):
+        offs = [int(unwrap(o)) for o in (offsets or [0] * a.ndim)]
+        sh = [int(unwrap(s)) for s in (shape or a.shape)]
+        sh = [a.shape[i] - offs[i] if sh[i] == -1 else sh[i] for i in range(a.ndim)]
+        sl = tuple(_builtins.slice(o, o + s) for o, s in zip(offs, sh))
+        return a[sl]
+
+    return apply_op(f, x)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def f(a):
+        size = index_num // nshards
+        lo = shard_id * size
+        in_shard = (a >= lo) & (a < lo + size)
+        return jnp.where(in_shard, a - lo, ignore_value)
+
+    return apply_op(f, input)
+
+
+def tensordot(x, y, axes=2, name=None):
+    ax = axes
+    if isinstance(ax, (list, tuple)):
+        ax = tuple(tuple(int(v) for v in a) if isinstance(a, (list, tuple)) else a for a in ax)
+    return apply_op(lambda a, b: jnp.tensordot(a, b, axes=ax), x, y)
+
+
+def one_hot(x, num_classes, name=None):
+    return apply_op(
+        lambda a: jax.nn.one_hot(a, num_classes, dtype=dtypes.get_default_dtype()), x
+    )
+
+
+def numel(x, name=None):
+    return wrap(jnp.asarray(int(np.prod(unwrap(x).shape)), jnp.int64))
+
+
+def rank(x):
+    return wrap(jnp.asarray(unwrap(x).ndim, jnp.int32))
+
+
+def shape(x):
+    return wrap(jnp.asarray(unwrap(x).shape, jnp.int32))
+
+
+def is_empty(x):
+    return wrap(jnp.asarray(unwrap(x).size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op(lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    def f(a):
+        n = a.shape[-1] + _builtins.abs(offset)
+        out_shape = a.shape[:-1] + (n, n)
+        out = jnp.zeros(out_shape, a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        r = idx + _builtins.max(-offset, 0)
+        c = idx + _builtins.max(offset, 0)
+        out = out.at[..., r, c].set(a)
+        perm_src = list(range(out.ndim))
+        d1 = dim1 % out.ndim
+        d2 = dim2 % out.ndim
+        if (d1, d2) != (out.ndim - 2, out.ndim - 1):
+            rest = [i for i in range(out.ndim) if i not in (d1, d2)]
+            inv = [0] * out.ndim
+            for pos, srcdim in enumerate(rest):
+                inv[srcdim] = pos
+            inv[d1] = out.ndim - 2
+            inv[d2] = out.ndim - 1
+            out = jnp.transpose(out, tuple(np.argsort(inv)))
+        return out
+
+    return apply_op(f, x)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    a = np.asarray(unwrap(x))
+    w = np.asarray(unwrap(weights)) if weights is not None else None
+    return wrap(jnp.asarray(np.bincount(a, w, minlength)))
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
+    a = np.asarray(unwrap(input))
+    rng = (min, max) if (min != 0 or max != 0) else (a.min(), a.max())
+    hist, _ = np.histogram(a, bins=bins, range=rng,
+                           weights=np.asarray(unwrap(weight)) if weight is not None else None,
+                           density=density)
+    return wrap(jnp.asarray(hist if density else hist.astype(np.int64)))
+
+
+def chunk_eval(*a, **k):
+    raise NotImplementedError
+
+
+def tolist(x):
+    return x.tolist()

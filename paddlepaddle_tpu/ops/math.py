@@ -1,0 +1,285 @@
+"""Elementwise & pointwise math ops (reference: python/paddle/tensor/math.py, ops.py).
+
+Every op is a pure jnp function routed through the eager dispatcher; under
+``jit`` they trace to single HLO ops and XLA fuses them into surrounding
+matmuls (the role of paddle's fused elementwise kernels / CINN)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op, unwrap, wrap
+from ..core.tensor import Tensor
+
+
+def _binop(jfn, name):
+    def op(x, y, name=None):
+        return apply_op(jfn, x, y, op_name=name)
+
+    op.__name__ = name
+    return op
+
+
+def _unop(jfn, name):
+    def op(x, name=None):
+        return apply_op(jfn, x, op_name=name)
+
+    op.__name__ = name
+    return op
+
+
+add = _binop(jnp.add, "add")
+subtract = _binop(jnp.subtract, "subtract")
+multiply = _binop(jnp.multiply, "multiply")
+divide = _binop(jnp.divide, "divide")
+floor_divide = _binop(jnp.floor_divide, "floor_divide")
+remainder = _binop(jnp.remainder, "remainder")
+mod = remainder
+floor_mod = remainder
+fmod = _binop(jnp.fmod, "fmod")
+pow = _binop(lambda x, y: jnp.power(x, y), "pow")
+maximum = _binop(jnp.maximum, "maximum")
+minimum = _binop(jnp.minimum, "minimum")
+fmax = _binop(jnp.fmax, "fmax")
+fmin = _binop(jnp.fmin, "fmin")
+atan2 = _binop(jnp.arctan2, "atan2")
+hypot = _binop(jnp.hypot, "hypot")
+logaddexp = _binop(jnp.logaddexp, "logaddexp")
+heaviside = _binop(jnp.heaviside, "heaviside")
+copysign = _binop(jnp.copysign, "copysign")
+nextafter = _binop(jnp.nextafter, "nextafter")
+ldexp = _binop(lambda x, y: jnp.ldexp(x, y.astype(jnp.int32)), "ldexp")
+gcd = _binop(jnp.gcd, "gcd")
+lcm = _binop(jnp.lcm, "lcm")
+
+exp = _unop(jnp.exp, "exp")
+expm1 = _unop(jnp.expm1, "expm1")
+log = _unop(jnp.log, "log")
+log2 = _unop(jnp.log2, "log2")
+log10 = _unop(jnp.log10, "log10")
+log1p = _unop(jnp.log1p, "log1p")
+sqrt = _unop(jnp.sqrt, "sqrt")
+rsqrt = _unop(jax.lax.rsqrt, "rsqrt")
+abs = _unop(jnp.abs, "abs")
+neg = _unop(jnp.negative, "neg")
+sign = _unop(jnp.sign, "sign")
+sin = _unop(jnp.sin, "sin")
+cos = _unop(jnp.cos, "cos")
+tan = _unop(jnp.tan, "tan")
+asin = _unop(jnp.arcsin, "asin")
+acos = _unop(jnp.arccos, "acos")
+atan = _unop(jnp.arctan, "atan")
+sinh = _unop(jnp.sinh, "sinh")
+cosh = _unop(jnp.cosh, "cosh")
+tanh = _unop(jnp.tanh, "tanh")
+asinh = _unop(jnp.arcsinh, "asinh")
+acosh = _unop(jnp.arccosh, "acosh")
+atanh = _unop(jnp.arctanh, "atanh")
+floor = _unop(jnp.floor, "floor")
+ceil = _unop(jnp.ceil, "ceil")
+round = _unop(jnp.round, "round")
+trunc = _unop(jnp.trunc, "trunc")
+frac = _unop(lambda x: x - jnp.trunc(x), "frac")
+reciprocal = _unop(lambda x: 1.0 / x, "reciprocal")
+square = _unop(jnp.square, "square")
+erf = _unop(jax.lax.erf, "erf")
+erfinv = _unop(jax.lax.erf_inv, "erfinv")
+sigmoid = _unop(jax.nn.sigmoid, "sigmoid")
+logsigmoid = _unop(jax.nn.log_sigmoid, "logsigmoid")
+digamma = _unop(jax.scipy.special.digamma, "digamma")
+lgamma = _unop(jax.scipy.special.gammaln, "lgamma")
+gammaln = lgamma
+i0 = _unop(jax.scipy.special.i0, "i0")
+i0e = _unop(jax.scipy.special.i0e, "i0e")
+i1 = _unop(jax.scipy.special.i1, "i1")
+i1e = _unop(jax.scipy.special.i1e, "i1e")
+angle = _unop(jnp.angle, "angle")
+conj = _unop(jnp.conj, "conj")
+real = _unop(jnp.real, "real")
+imag = _unop(jnp.imag, "imag")
+deg2rad = _unop(jnp.deg2rad, "deg2rad")
+rad2deg = _unop(jnp.rad2deg, "rad2deg")
+exponent = _unop(lambda x: jnp.frexp(x)[1].astype(x.dtype), "exponent")
+
+isnan = _unop(jnp.isnan, "isnan")
+isinf = _unop(jnp.isinf, "isinf")
+isfinite = _unop(jnp.isfinite, "isfinite")
+isneginf = _unop(jnp.isneginf, "isneginf")
+isposinf = _unop(jnp.isposinf, "isposinf")
+isreal = _unop(jnp.isreal, "isreal")
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    def f(a, s, b):
+        out = a * s + b if bias_after_scale else (a + b) * s
+        return out
+
+    out = apply_op(f, x, unwrap(scale), unwrap(bias), op_name="scale")
+    if act is not None:
+        from ..nn import functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+def clip(x, min=None, max=None, name=None):
+    def f(a, lo, hi):
+        return jnp.clip(a, lo, hi)
+
+    return apply_op(f, x, unwrap(min) if min is not None else None,
+                    unwrap(max) if max is not None else None, op_name="clip")
+
+
+def lerp(x, y, weight, name=None):
+    return apply_op(lambda a, b, w: a + w * (b - a), x, y, weight, op_name="lerp")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply_op(lambda a: scale_b * jnp.tanh(scale_a * a), x, op_name="stanh")
+
+
+def logit(x, eps=None, name=None):
+    def f(a):
+        p = jnp.clip(a, eps, 1 - eps) if eps else a
+        return jnp.log(p / (1 - p))
+
+    return apply_op(f, x, op_name="logit")
+
+
+def multiplex(inputs, index, name=None):
+    def f(idx, *ins):
+        stacked = jnp.stack(ins, axis=0)
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[idx.reshape(-1), rows]
+
+    return apply_op(f, index, *inputs, op_name="multiplex")
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply_op(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf), x)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    def f(a):
+        if axis is None:
+            a = a.reshape(-1)
+            return jnp.cumsum(a, dtype=dtype)
+        return jnp.cumsum(a, axis=axis, dtype=dtype)
+
+    return apply_op(f, x, op_name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    return apply_op(lambda a: jnp.cumprod(a, axis=dim, dtype=dtype), x, op_name="cumprod")
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def f(a):
+        ax = axis if axis is not None else 0
+        if axis is None:
+            a = a.reshape(-1)
+            ax = 0
+        vals = jax.lax.cummax(a, axis=ax)
+        eq = a == vals
+        idx = jnp.arange(a.shape[ax]).reshape([-1 if i == ax else 1 for i in range(a.ndim)])
+        inds = jax.lax.cummax(jnp.where(eq, idx, 0), axis=ax)
+        return vals, inds.astype(jnp.int64)
+
+    return apply_op(f, x, op_name="cummax")
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    def f(a):
+        ax = axis if axis is not None else 0
+        if axis is None:
+            a = a.reshape(-1)
+            ax = 0
+        vals = jax.lax.cummin(a, axis=ax)
+        eq = a == vals
+        idx = jnp.arange(a.shape[ax]).reshape([-1 if i == ax else 1 for i in range(a.ndim)])
+        inds = jax.lax.cummax(jnp.where(eq, idx, 0), axis=ax)
+        return vals, inds.astype(jnp.int64)
+
+    return apply_op(f, x, op_name="cummin")
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    def f(a):
+        if axis is None:
+            return jax.lax.cumlogsumexp(a.reshape(-1), axis=0)
+        return jax.lax.cumlogsumexp(a, axis=axis)
+
+    return apply_op(f, x, op_name="logcumsumexp")
+
+
+def increment(x, value=1.0, name=None):
+    x._replace_data(unwrap(x) + value)
+    return x
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    return apply_op(
+        lambda a, p, ap: jnp.diff(a, n=n, axis=axis, prepend=p, append=ap),
+        x,
+        unwrap(prepend) if prepend is not None else None,
+        unwrap(append) if append is not None else None,
+    )
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op(lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+def kron(x, y, name=None):
+    return apply_op(jnp.kron, x, y)
+
+
+def inner(x, y, name=None):
+    return apply_op(jnp.inner, x, y)
+
+
+def outer(x, y, name=None):
+    return apply_op(lambda a, b: jnp.outer(a, b), x, y)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+# ---- in-place variants (mutate by rebinding; tape picks up the new node) ---
+
+
+def _inplace(fn):
+    def op(x, *args, **kwargs):
+        out = fn(x, *args, **kwargs)
+        x._data = out._data
+        x._grad_node = out._grad_node
+        x._out_index = out._out_index
+        x._version += 1
+        return x
+
+    return op
+
+
+add_ = _inplace(add)
+subtract_ = _inplace(subtract)
+multiply_ = _inplace(multiply)
+divide_ = _inplace(divide)
+scale_ = _inplace(scale)
+clip_ = _inplace(clip)
+exp_ = _inplace(exp)
+sqrt_ = _inplace(sqrt)
+rsqrt_ = _inplace(rsqrt)
+floor_ = _inplace(floor)
+ceil_ = _inplace(ceil)
+round_ = _inplace(round)
+reciprocal_ = _inplace(reciprocal)
+tanh_ = _inplace(tanh)
+abs_ = _inplace(abs)
+sin_ = _inplace(sin)
+cos_ = _inplace(cos)
+neg_ = _inplace(neg)
+lerp_ = _inplace(lerp)
+remainder_ = _inplace(remainder)
+pow_ = _inplace(pow)

@@ -1,0 +1,139 @@
+"""Reduction ops (reference: python/paddle/tensor/math.py sum/mean/…, stat.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.dispatch import apply_op, unwrap
+
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(unwrap(a)) for a in axis)
+    return int(unwrap(axis))
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    dt = dtypes.convert_dtype(dtype) if dtype is not None else None
+
+    def f(a):
+        out = jnp.sum(a, axis=ax, keepdims=keepdim, dtype=dt)
+        return out
+
+    return apply_op(f, x, op_name="sum")
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply_op(lambda a: jnp.mean(a, axis=ax, keepdims=keepdim), x, op_name="mean")
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply_op(lambda a: jnp.max(a, axis=ax, keepdims=keepdim), x, op_name="max")
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply_op(lambda a: jnp.min(a, axis=ax, keepdims=keepdim), x, op_name="min")
+
+
+amax = max
+amin = min
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    ax = _norm_axis(axis)
+    dt = dtypes.convert_dtype(dtype) if dtype is not None else None
+    return apply_op(lambda a: jnp.prod(a, axis=ax, keepdims=keepdim, dtype=dt), x)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply_op(
+        lambda a: jnp.std(a, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim), x
+    )
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply_op(
+        lambda a: jnp.var(a, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim), x
+    )
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply_op(lambda a: jnp.all(a, axis=ax, keepdims=keepdim), x)
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply_op(lambda a: jnp.any(a, axis=ax, keepdims=keepdim), x)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply_op(
+        lambda a: jax.scipy.special.logsumexp(a, axis=ax, keepdims=keepdim), x
+    )
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply_op(lambda a: jnp.nansum(a, axis=ax, keepdims=keepdim), x)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply_op(lambda a: jnp.nanmean(a, axis=ax, keepdims=keepdim), x)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply_op(
+        lambda a: jnp.count_nonzero(a, axis=ax, keepdims=keepdim).astype(jnp.int64), x
+    )
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    ax = _norm_axis(axis)
+
+    def f(a):
+        if mode == "min":
+            n = a.shape[ax] if ax is not None else a.size
+            k = (n - 1) // 2
+            s = jnp.sort(a, axis=ax) if ax is not None else jnp.sort(a.reshape(-1))
+            out = jnp.take(s, k, axis=ax if ax is not None else 0)
+            if keepdim and ax is not None:
+                out = jnp.expand_dims(out, ax)
+            return out
+        return jnp.median(a, axis=ax, keepdims=keepdim)
+
+    return apply_op(f, x)
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply_op(lambda a: jnp.nanmedian(a, axis=ax, keepdims=keepdim), x)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    ax = _norm_axis(axis)
+    return apply_op(
+        lambda a: jnp.quantile(a, jnp.asarray(unwrap(q)), axis=ax, keepdims=keepdim,
+                               method=interpolation),
+        x,
+    )
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply_op(
+        lambda a: jnp.nanquantile(a, jnp.asarray(unwrap(q)), axis=ax, keepdims=keepdim), x
+    )

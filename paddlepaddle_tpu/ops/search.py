@@ -1,0 +1,128 @@
+"""Search/sort ops (reference: python/paddle/tensor/search.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.dispatch import apply_op, unwrap, wrap
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    dt = dtypes.convert_dtype(dtype)
+    return apply_op(
+        lambda a: jnp.argmax(a.reshape(-1) if axis is None else a,
+                             axis=None if axis is None else axis,
+                             keepdims=keepdim if axis is not None else False).astype(dt),
+        x,
+    )
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    dt = dtypes.convert_dtype(dtype)
+    return apply_op(
+        lambda a: jnp.argmin(a.reshape(-1) if axis is None else a,
+                             axis=None if axis is None else axis,
+                             keepdims=keepdim if axis is not None else False).astype(dt),
+        x,
+    )
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(a):
+        idx = jnp.argsort(a, axis=axis, stable=stable, descending=descending)
+        return idx.astype(jnp.int64)
+
+    return apply_op(f, x)
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    return apply_op(
+        lambda a: jnp.sort(a, axis=axis, stable=stable, descending=descending), x
+    )
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    k = int(unwrap(k))
+
+    def f(a):
+        ax = axis % a.ndim
+        if ax != a.ndim - 1:
+            a_m = jnp.moveaxis(a, ax, -1)
+        else:
+            a_m = a
+        if largest:
+            vals, idx = jax.lax.top_k(a_m, k)
+        else:
+            vals, idx = jax.lax.top_k(-a_m, k)
+            vals = -vals
+        if ax != a.ndim - 1:
+            vals = jnp.moveaxis(vals, -1, ax)
+            idx = jnp.moveaxis(idx, -1, ax)
+        return vals, idx.astype(jnp.int64)
+
+    return apply_op(f, x, op_name="topk")
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        s = jnp.sort(a, axis=ax)
+        si = jnp.argsort(a, axis=ax)
+        vals = jnp.take(s, k - 1, axis=ax)
+        idx = jnp.take(si, k - 1, axis=ax)
+        if keepdim:
+            vals = jnp.expand_dims(vals, ax)
+            idx = jnp.expand_dims(idx, ax)
+        return vals, idx.astype(jnp.int64)
+
+    return apply_op(f, x)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    a = np.asarray(unwrap(x))
+    ax = axis % a.ndim
+    moved = np.moveaxis(a, ax, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    vals = np.empty(flat.shape[0], a.dtype)
+    idxs = np.empty(flat.shape[0], np.int64)
+    for i, row in enumerate(flat):
+        uv, counts = np.unique(row, return_counts=True)
+        v = uv[np.argmax(counts)]
+        vals[i] = v
+        idxs[i] = np.where(row == v)[0][-1]
+    out_shape = moved.shape[:-1]
+    vals = vals.reshape(out_shape)
+    idxs = idxs.reshape(out_shape)
+    if keepdim:
+        vals = np.expand_dims(vals, ax)
+        idxs = np.expand_dims(idxs, ax)
+    return wrap(jnp.asarray(vals)), wrap(jnp.asarray(idxs))
+
+
+def nonzero(x, as_tuple=False, name=None):
+    a = np.asarray(unwrap(x))
+    nz = np.nonzero(a)
+    if as_tuple:
+        return tuple(wrap(jnp.asarray(n.astype(np.int64)).reshape(-1)) for n in nz)
+    return wrap(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    def f(seq, v):
+        side = "right" if right else "left"
+        if seq.ndim == 1:
+            out = jnp.searchsorted(seq, v, side=side)
+        else:
+            out = jax.vmap(lambda s, vv: jnp.searchsorted(s, vv, side=side))(
+                seq.reshape(-1, seq.shape[-1]), v.reshape(-1, v.shape[-1])
+            ).reshape(v.shape)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+    return apply_op(f, sorted_sequence, values)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)

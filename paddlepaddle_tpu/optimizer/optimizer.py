@@ -1,0 +1,200 @@
+"""Optimizer base (reference: python/paddle/optimizer/optimizer.py:127).
+
+Two execution forms share one update rule per subclass:
+  * eager: ``opt.step()`` reads ``p.grad`` tapes and rebinds parameter payloads
+    (reference dygraph path);
+  * functional: ``init_state(params)`` / ``apply(grads, state, params)`` are
+    pure pytree functions for jitted/pjit train steps — the idiomatic XLA path
+    (whole-update fused, state shardable over the mesh for sharding stage 1-3).
+
+``multi_precision`` master-weight semantics follow the reference
+(python/paddle/optimizer/adamw.py:289-447): bf16/fp16 params keep an fp32
+master copy updated in fp32 and cast back each step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.dispatch import unwrap
+from ..core.tensor import Tensor
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        self._lr = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        self._accumulators: Dict[int, Dict[str, Any]] = {}
+        self._masters: Dict[int, Any] = {}
+        self._step_count = 0
+
+    # -- lr ------------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._lr, LRScheduler):
+            return float(self._lr())
+        return float(self._lr)
+
+    def set_lr(self, value):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler; call scheduler.step()")
+        self._lr = value
+
+    def set_lr_scheduler(self, scheduler):
+        self._lr = scheduler
+
+    # -- update rule (override) ---------------------------------------------
+    def _init_slots(self, p_data) -> Dict[str, Any]:
+        """Create per-parameter accumulator arrays."""
+        return {}
+
+    def _rule(self, p, g, slots, lr, wd_scale=1.0):
+        """Pure update: (param_f32, grad_f32, slots, lr) -> (new_param_f32, new_slots)."""
+        raise NotImplementedError
+
+    def _decoupled_weight_decay(self) -> bool:
+        return False
+
+    # -- eager step ----------------------------------------------------------
+    @property
+    def _params(self) -> List[Tensor]:
+        if self._parameter_list is None:
+            raise ValueError("optimizer created without parameters")
+        return self._parameter_list
+
+    def step(self):
+        params_grads = [(p, p.grad) for p in self._params
+                        if (not p.stop_gradient) and p._grad is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr = self.get_lr()
+        for p, g in params_grads:
+            if g is None:
+                continue
+            self._apply_one(p, unwrap(g), lr)
+        self._step_count += 1
+
+    def _apply_one(self, p: Tensor, g, lr):
+        pid = id(p)
+        use_master = self._multi_precision and p._data.dtype in (jnp.bfloat16, jnp.float16)
+        if pid not in self._accumulators:
+            self._accumulators[pid] = self._init_slots(p._data)
+            if use_master:
+                self._masters[pid] = p._data.astype(jnp.float32)
+        master = self._masters.get(pid, None)
+        pf = master if master is not None else p._data.astype(jnp.float32)
+        gf = g.astype(jnp.float32)
+        # coupled L2 weight decay (non-decoupled optimizers fold into grad)
+        if self._weight_decay and not self._decoupled_weight_decay():
+            gf = gf + float(self._weight_decay) * pf
+        param_lr = p.optimize_attr.get("learning_rate", 1.0) if hasattr(p, "optimize_attr") else 1.0
+        new_pf, new_slots = self._rule(pf, gf, self._accumulators[pid], lr * param_lr)
+        self._accumulators[pid] = new_slots
+        if use_master:
+            self._masters[pid] = new_pf
+        p._replace_data(new_pf.astype(p._data.dtype))
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._params:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    # -- functional form for jit/pjit ----------------------------------------
+    def init_state(self, params_tree):
+        """Pytree-of-arrays optimizer state mirroring params structure."""
+        leaves, treedef = jax.tree_util.tree_flatten(params_tree)
+        slots = [self._init_slots(p) for p in leaves]
+        masters = [
+            p.astype(jnp.float32) if (self._multi_precision and p.dtype in (jnp.bfloat16, jnp.float16)) else None
+            for p in leaves
+        ]
+        return {
+            "slots": jax.tree_util.tree_unflatten(treedef, slots),
+            "master": jax.tree_util.tree_unflatten(treedef, masters),
+            "step": jnp.zeros([], jnp.int32),
+        }
+
+    def apply(self, grads_tree, state, params_tree, lr=None, skip_update=None):
+        """Pure functional update; jit/pjit-safe. Returns (new_params, new_state).
+
+        ``skip_update``: optional scalar bool (AMP found_inf) — when True the
+        update is a no-op (matches GradScaler semantics)."""
+        lr_val = jnp.asarray(lr if lr is not None else self.get_lr(), jnp.float32)
+        if self._grad_clip is not None and hasattr(self._grad_clip, "clip_tree"):
+            grads_tree = self._grad_clip.clip_tree(grads_tree)
+        g_leaves, treedef = jax.tree_util.tree_flatten(grads_tree)
+        p_leaves = jax.tree_util.tree_leaves(params_tree)
+        s_leaves = treedef.flatten_up_to(state["slots"])
+        m_leaves = treedef.flatten_up_to(state["master"])
+        new_p, new_s, new_m = [], [], []
+        for p, g, s, m in zip(p_leaves, g_leaves, s_leaves, m_leaves):
+            pf = m if m is not None else p.astype(jnp.float32)
+            gf = g.astype(jnp.float32)
+            if self._weight_decay and not self._decoupled_weight_decay():
+                gf = gf + float(self._weight_decay) * pf
+            npf, ns = self._rule(pf, gf, s, lr_val)
+            if skip_update is not None:
+                npf = jnp.where(skip_update, pf, npf)
+                ns = jax.tree_util.tree_map(
+                    lambda new, old: jnp.where(skip_update, old, new), ns, s)
+            new_p.append(npf.astype(p.dtype))
+            new_m.append(npf if m is not None else None)
+            new_s.append(ns)
+        return (
+            jax.tree_util.tree_unflatten(treedef, new_p),
+            {
+                "slots": jax.tree_util.tree_unflatten(treedef, new_s),
+                "master": jax.tree_util.tree_unflatten(treedef, new_m),
+                "step": state["step"] + 1,
+            },
+        )
+
+    # -- state dict ----------------------------------------------------------
+    def state_dict(self):
+        import numpy as np
+
+        sd = {}
+        for i, p in enumerate(self._params):
+            pid = id(p)
+            if pid in self._accumulators:
+                for k, v in self._accumulators[pid].items():
+                    sd[f"{p.name}_{k}"] = np.asarray(v)
+            if pid in self._masters:
+                sd[f"{p.name}_master"] = np.asarray(self._masters[pid])
+        if isinstance(self._lr, LRScheduler):
+            sd["LR_Scheduler"] = self._lr.state_dict()
+        sd["@step"] = self._step_count
+        return sd
+
+    def set_state_dict(self, state_dict):
+        for p in self._params:
+            pid = id(p)
+            slots = self._init_slots(p._data)
+            loaded = {}
+            for k in slots:
+                key = f"{p.name}_{k}"
+                if key in state_dict:
+                    loaded[k] = jnp.asarray(state_dict[key])
+                else:
+                    loaded[k] = slots[k]
+            self._accumulators[pid] = loaded
+            mkey = f"{p.name}_master"
+            if mkey in state_dict:
+                self._masters[pid] = jnp.asarray(state_dict[mkey])
+        if isinstance(self._lr, LRScheduler) and "LR_Scheduler" in state_dict:
+            self._lr.set_state_dict(state_dict["LR_Scheduler"])
+        self._step_count = int(state_dict.get("@step", 0))

@@ -1,0 +1,223 @@
+"""Concrete optimizers (reference: python/paddle/optimizer/{sgd,momentum,adam,
+adamw,adagrad,rmsprop,adadelta,adamax,lamb}.py). Update rules are pure
+functions of fp32 params/grads/slots so they jit and shard cleanly."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision)
+
+    def _rule(self, p, g, slots, lr, wd_scale=1.0):
+        return p - lr * g, slots
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_slots(self, p):
+        return {"velocity": jnp.zeros_like(p, jnp.float32)}
+
+    def _rule(self, p, g, slots, lr, wd_scale=1.0):
+        v = self._momentum * slots["velocity"] + g
+        if self._nesterov:
+            new_p = p - lr * (g + self._momentum * v)
+        else:
+            new_p = p - lr * v
+        return new_p, {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, use_multi_tensor=False, amsgrad=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._amsgrad = amsgrad
+
+    def _init_slots(self, p):
+        s = {
+            "moment1": jnp.zeros_like(p, jnp.float32),
+            "moment2": jnp.zeros_like(p, jnp.float32),
+            "beta1_pow": jnp.ones([], jnp.float32),
+            "beta2_pow": jnp.ones([], jnp.float32),
+        }
+        if self._amsgrad:
+            s["moment2_max"] = jnp.zeros_like(p, jnp.float32)
+        return s
+
+    def _rule(self, p, g, slots, lr, wd_scale=1.0):
+        b1, b2 = self._beta1, self._beta2
+        b1p = slots["beta1_pow"] * b1
+        b2p = slots["beta2_pow"] * b2
+        m1 = b1 * slots["moment1"] + (1 - b1) * g
+        m2 = b2 * slots["moment2"] + (1 - b2) * g * g
+        new = {"moment1": m1, "moment2": m2, "beta1_pow": b1p, "beta2_pow": b2p}
+        if self._amsgrad:
+            m2h = jnp.maximum(slots["moment2_max"], m2)
+            new["moment2_max"] = m2h
+        else:
+            m2h = m2
+        m1_hat = m1 / (1 - b1p)
+        m2_hat = m2h / (1 - b2p)
+        new_p = p - lr * m1_hat / (jnp.sqrt(m2_hat) + self._eps)
+        return new_p, new
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False, amsgrad=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision, amsgrad=amsgrad)
+        self._wd = weight_decay
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _decoupled_weight_decay(self):
+        return True
+
+    def _rule(self, p, g, slots, lr, wd_scale=1.0):
+        p = p * (1.0 - lr * self._wd * wd_scale)
+        return super()._rule(p, g, slots, lr)
+
+    def _apply_one(self, p, g, lr):
+        if self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(p.name):
+            wd, self._wd = self._wd, 0.0
+            try:
+                super()._apply_one(p, g, lr)
+            finally:
+                self._wd = wd
+        else:
+            super()._apply_one(p, g, lr)
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None, weight_decay=None,
+                 grad_clip=None, initial_accumulator_value=0.0, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision)
+        self._eps = epsilon
+        self._init_val = initial_accumulator_value
+
+    def _init_slots(self, p):
+        return {"moment": jnp.full(p.shape, self._init_val, jnp.float32)}
+
+    def _rule(self, p, g, slots, lr, wd_scale=1.0):
+        mom = slots["moment"] + g * g
+        return p - lr * g / (jnp.sqrt(mom) + self._eps), {"moment": mom}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision)
+        self._rho, self._eps, self._momentum, self._centered = rho, epsilon, momentum, centered
+
+    def _init_slots(self, p):
+        s = {
+            "mean_square": jnp.zeros_like(p, jnp.float32),
+            "momentum_acc": jnp.zeros_like(p, jnp.float32),
+        }
+        if self._centered:
+            s["mean_grad"] = jnp.zeros_like(p, jnp.float32)
+        return s
+
+    def _rule(self, p, g, slots, lr, wd_scale=1.0):
+        ms = self._rho * slots["mean_square"] + (1 - self._rho) * g * g
+        new = {"mean_square": ms}
+        if self._centered:
+            mg = self._rho * slots["mean_grad"] + (1 - self._rho) * g
+            new["mean_grad"] = mg
+            denom = jnp.sqrt(ms - mg * mg + self._eps)
+        else:
+            denom = jnp.sqrt(ms + self._eps)
+        mom = self._momentum * slots["momentum_acc"] + lr * g / denom
+        new["momentum_acc"] = mom
+        return p - mom, new
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision)
+        self._eps, self._rho = epsilon, rho
+
+    def _init_slots(self, p):
+        return {
+            "avg_squared_grad": jnp.zeros_like(p, jnp.float32),
+            "avg_squared_update": jnp.zeros_like(p, jnp.float32),
+        }
+
+    def _rule(self, p, g, slots, lr, wd_scale=1.0):
+        asg = self._rho * slots["avg_squared_grad"] + (1 - self._rho) * g * g
+        update = -jnp.sqrt(slots["avg_squared_update"] + self._eps) / jnp.sqrt(asg + self._eps) * g
+        asu = self._rho * slots["avg_squared_update"] + (1 - self._rho) * update * update
+        return p + lr * update, {"avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _init_slots(self, p):
+        return {
+            "moment": jnp.zeros_like(p, jnp.float32),
+            "inf_norm": jnp.zeros_like(p, jnp.float32),
+            "beta1_pow": jnp.ones([], jnp.float32),
+        }
+
+    def _rule(self, p, g, slots, lr, wd_scale=1.0):
+        b1p = slots["beta1_pow"] * self._beta1
+        m = self._beta1 * slots["moment"] + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * slots["inf_norm"], jnp.abs(g))
+        new_p = p - (lr / (1 - b1p)) * m / (u + self._eps)
+        return new_p, {"moment": m, "inf_norm": u, "beta1_pow": b1p}
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, multi_precision)
+        self._wd = lamb_weight_decay
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_slots(self, p):
+        return {
+            "moment1": jnp.zeros_like(p, jnp.float32),
+            "moment2": jnp.zeros_like(p, jnp.float32),
+            "beta1_pow": jnp.ones([], jnp.float32),
+            "beta2_pow": jnp.ones([], jnp.float32),
+        }
+
+    def _rule(self, p, g, slots, lr, wd_scale=1.0):
+        b1, b2 = self._beta1, self._beta2
+        b1p = slots["beta1_pow"] * b1
+        b2p = slots["beta2_pow"] * b2
+        m1 = b1 * slots["moment1"] + (1 - b1) * g
+        m2 = b2 * slots["moment2"] + (1 - b2) * g * g
+        m1_hat = m1 / (1 - b1p)
+        m2_hat = m2 / (1 - b2p)
+        r = m1_hat / (jnp.sqrt(m2_hat) + self._eps) + self._wd * p
+        w_norm = jnp.sqrt(jnp.sum(p * p))
+        r_norm = jnp.sqrt(jnp.sum(r * r))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        new_p = p - lr * trust * r
+        return new_p, {"moment1": m1, "moment2": m2, "beta1_pow": b1p, "beta2_pow": b2p}

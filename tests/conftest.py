@@ -1,0 +1,25 @@
+"""Test configuration: force an 8-device virtual CPU platform BEFORE jax
+import so sharding/mesh tests run without TPU hardware (the analogue of the
+reference's fake_cpu_device plugin used in test/custom_runtime/)."""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_all():
+    import paddlepaddle_tpu as paddle
+
+    paddle.seed(2024)
+    np.random.seed(2024)
+    yield

@@ -1,0 +1,74 @@
+"""OpTest-style harness (reference: test/legacy_test/op_test.py:418):
+check forward against a numpy reference and gradients against numeric
+finite differences, across dtypes and eager/jit modes."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import paddlepaddle_tpu as paddle
+
+
+def check_forward(fn, np_fn, arrays, rtol=1e-5, atol=1e-6, kwargs=None):
+    kwargs = kwargs or {}
+    tensors = [paddle.to_tensor(a) for a in arrays]
+    out = fn(*tensors, **kwargs)
+    ref = np_fn(*arrays, **kwargs)
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    refs = ref if isinstance(ref, (list, tuple)) else [ref]
+    for o, r in zip(outs, refs):
+        np.testing.assert_allclose(o.numpy().astype(np.float64),
+                                   np.asarray(r, np.float64), rtol=rtol, atol=atol)
+    return out
+
+
+def numeric_grad(fn, arrays, idx, out_grad=None, eps=1e-3, kwargs=None):
+    """Central finite differences of sum(fn * out_grad) wrt arrays[idx]."""
+    kwargs = kwargs or {}
+
+    def scalar_out(*arrs):
+        tensors = [paddle.to_tensor(a) for a in arrs]
+        out = fn(*tensors, **kwargs)
+        out_np = out.numpy().astype(np.float64)
+        if out_grad is None:
+            return out_np.sum()
+        return (out_np * out_grad).sum()
+
+    x = arrays[idx].astype(np.float64)
+    grad = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        i = it.multi_index
+        orig = x[i]
+        pert = list(arrays)
+        xp = x.copy()
+        xp[i] = orig + eps
+        pert[idx] = xp.astype(arrays[idx].dtype)
+        f1 = scalar_out(*pert)
+        xm = x.copy()
+        xm[i] = orig - eps
+        pert[idx] = xm.astype(arrays[idx].dtype)
+        f2 = scalar_out(*pert)
+        grad[i] = (f1 - f2) / (2 * eps)
+        it.iternext()
+    return grad
+
+
+def check_grad(fn, arrays, grad_idx=None, rtol=1e-2, atol=1e-3, eps=1e-3, kwargs=None):
+    """Compare tape backward() grads against numeric finite differences."""
+    kwargs = kwargs or {}
+    grad_idx = grad_idx if grad_idx is not None else list(range(len(arrays)))
+    tensors = []
+    for i, a in enumerate(arrays):
+        t = paddle.to_tensor(a)
+        if i in grad_idx:
+            t.stop_gradient = False
+        tensors.append(t)
+    out = fn(*tensors, **kwargs)
+    loss = out.sum() if out.size > 1 else out
+    loss.backward()
+    for i in grad_idx:
+        analytic = tensors[i].grad.numpy().astype(np.float64)
+        numeric = numeric_grad(fn, arrays, i, eps=eps, kwargs=kwargs)
+        np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol,
+                                   err_msg=f"grad mismatch for input {i}")

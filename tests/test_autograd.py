@@ -1,0 +1,175 @@
+"""Eager autograd tape tests (reference model: eager backward tests +
+numeric grad checks from OpTest)."""
+
+import numpy as np
+import pytest
+
+import paddlepaddle_tpu as paddle
+from op_test import check_grad
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 4, 6])
+
+
+def test_chain_backward():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * x * x  # x^3 -> 3x^2 = 12
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 12.0, rtol=1e-6)
+
+
+def test_grad_accumulation():
+    x = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5, 5])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_broadcast_grad():
+    x = paddle.to_tensor(np.ones((3, 4), np.float32), stop_gradient=False)
+    b = paddle.to_tensor(np.ones((4,), np.float32), stop_gradient=False)
+    ((x + b) * 2).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.full((3, 4), 2.0))
+    np.testing.assert_allclose(b.grad.numpy(), np.full((4,), 6.0))
+
+
+def test_matmul_grad_numeric():
+    a = np.random.randn(3, 4).astype(np.float32)
+    b = np.random.randn(4, 2).astype(np.float32)
+    check_grad(paddle.matmul, [a, b])
+
+
+def test_mixed_ops_grad_numeric():
+    x = np.random.uniform(0.5, 1.5, (3, 3)).astype(np.float32)
+
+    def fn(t):
+        return (paddle.exp(t) * paddle.sqrt(t) + paddle.sin(t)).sum()
+
+    check_grad(fn, [x])
+
+
+def test_stop_gradient():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0], stop_gradient=True)
+    (x * y).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_detach():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    z = y.detach()
+    assert z.stop_gradient
+    w = z * 3
+    with pytest.raises(RuntimeError):
+        w.backward()  # no grad path
+
+
+def test_no_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y._grad_node is None
+
+
+def test_non_scalar_backward_requires_grad_tensor():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError):
+        y.backward()
+    y = x * 2
+    y.backward(paddle.to_tensor([1.0, 0.5]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 1.0])
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+
+def test_double_backward_without_retain_raises():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_paddle_grad():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = (x * x).sum()
+    (gx,) = paddle.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), [2, 4])
+    assert x.grad is None  # grad() does not accumulate into leaves
+
+
+def test_grad_allow_unused():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    z = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).sum()
+    with pytest.raises(RuntimeError):
+        paddle.grad(y, [x, z])
+    gx, gz = paddle.grad(y, [x, z], allow_unused=True)
+    assert gz is None
+    np.testing.assert_allclose(gx.numpy(), [2.0])
+
+
+def test_multi_output_op_grad():
+    x = np.random.randn(4, 6).astype(np.float32)
+    t = paddle.to_tensor(x, stop_gradient=False)
+    parts = paddle.split(t, 2, axis=1)
+    loss = (parts[0] * 2).sum() + (parts[1] * 3).sum()
+    loss.backward()
+    ref = np.concatenate([np.full((4, 3), 2.0), np.full((4, 3), 3.0)], axis=1)
+    np.testing.assert_allclose(t.grad.numpy(), ref)
+
+
+def test_getitem_grad():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3), stop_gradient=False)
+    y = x[0].sum() * 2
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[2, 2, 2], [0, 0, 0]])
+
+
+def test_register_hook():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    seen = []
+    h = x.register_hook(lambda g: seen.append(g.numpy()) or (g * 2))
+    (x * 3).sum().backward()
+    assert seen and seen[0][0] == pytest.approx(3.0)
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+    h.remove()
+
+
+def test_pylayer():
+    class Double(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, grad):
+            return grad * 2
+
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = Double.apply(x)
+    np.testing.assert_allclose(y.numpy(), [2, 4])
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 2])
+
+
+def test_functional_jacobian():
+    x = np.array([1.0, 2.0], np.float32)
+    jac = paddle.autograd.functional_jacobian(lambda t: (t * t).sum(), x)
+    np.testing.assert_allclose(np.asarray(jac.numpy() if hasattr(jac, 'numpy') else jac), [2, 4], rtol=1e-5)

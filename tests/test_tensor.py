@@ -1,0 +1,229 @@
+"""Core Tensor + creation + math op tests (reference model:
+test/legacy_test/test_* API tests comparing against numpy)."""
+
+import numpy as np
+import pytest
+
+import paddlepaddle_tpu as paddle
+from op_test import check_forward, check_grad
+
+
+def test_to_tensor_basics():
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == [2, 2]
+    assert t.dtype == np.float32
+    assert t.ndim == 2
+    assert t.size == 4
+    np.testing.assert_array_equal(t.numpy(), [[1, 2], [3, 4]])
+    assert t.stop_gradient
+
+
+def test_dtype_conversion():
+    t = paddle.to_tensor([1, 2, 3], dtype="int64")
+    assert t.dtype == np.int64
+    f = t.astype("float32")
+    assert f.dtype == np.float32
+    b = paddle.to_tensor([1.0], dtype="bfloat16")
+    assert str(b.dtype) == "bfloat16"
+
+
+def test_default_dtype():
+    paddle.set_default_dtype("float32")
+    assert paddle.get_default_dtype() == np.float32
+    t = paddle.to_tensor([1.5])
+    assert t.dtype == np.float32
+
+
+def test_creation_ops():
+    assert paddle.zeros([2, 3]).numpy().sum() == 0
+    assert paddle.ones([2, 3]).numpy().sum() == 6
+    np.testing.assert_array_equal(paddle.full([2], 7).numpy(), [7, 7])
+    np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+    np.testing.assert_array_equal(paddle.eye(3).numpy(), np.eye(3, dtype=np.float32))
+    np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5), rtol=1e-6)
+    x = paddle.to_tensor([[1.0, 2], [3, 4]])
+    np.testing.assert_array_equal(paddle.zeros_like(x).numpy(), np.zeros((2, 2)))
+    np.testing.assert_array_equal(paddle.tril(x).numpy(), np.tril(x.numpy()))
+    np.testing.assert_array_equal(paddle.triu(x).numpy(), np.triu(x.numpy()))
+
+
+def test_random_creation():
+    paddle.seed(42)
+    a = paddle.rand([100])
+    assert 0 <= a.numpy().min() and a.numpy().max() < 1
+    b = paddle.randn([1000])
+    assert abs(b.numpy().mean()) < 0.2
+    c = paddle.randint(0, 10, [100])
+    assert c.numpy().min() >= 0 and c.numpy().max() < 10
+    p = paddle.randperm(10)
+    assert sorted(p.numpy().tolist()) == list(range(10))
+    # determinism
+    paddle.seed(7)
+    x1 = paddle.rand([4]).numpy()
+    paddle.seed(7)
+    x2 = paddle.rand([4]).numpy()
+    np.testing.assert_array_equal(x1, x2)
+
+
+def test_operators():
+    x = paddle.to_tensor([1.0, 2.0, 3.0])
+    y = paddle.to_tensor([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((x + y).numpy(), [5, 7, 9])
+    np.testing.assert_allclose((x - y).numpy(), [-3, -3, -3])
+    np.testing.assert_allclose((x * y).numpy(), [4, 10, 18])
+    np.testing.assert_allclose((y / x).numpy(), [4, 2.5, 2])
+    np.testing.assert_allclose((x**2).numpy(), [1, 4, 9])
+    np.testing.assert_allclose((2 + x).numpy(), [3, 4, 5])
+    np.testing.assert_allclose((-x).numpy(), [-1, -2, -3])
+    assert (x < y).numpy().all()
+    assert (x == x).numpy().all()
+    m = paddle.to_tensor([[1.0, 2], [3, 4]])
+    np.testing.assert_allclose((m @ m).numpy(), m.numpy() @ m.numpy())
+
+
+def test_math_unary_forward():
+    x = np.random.uniform(0.1, 2.0, (3, 4)).astype(np.float32)
+    for name in ["exp", "log", "sqrt", "sin", "cos", "tanh", "abs", "floor",
+                 "ceil", "square", "rsqrt", "sigmoid", "erf", "log1p"]:
+        np_ref = {
+            "rsqrt": lambda a: 1 / np.sqrt(a),
+            "sigmoid": lambda a: 1 / (1 + np.exp(-a)),
+            "square": lambda a: a * a,
+            "erf": lambda a: np.vectorize(__import__("math").erf)(a).astype(np.float64),
+        }.get(name, getattr(np, name, None))
+        check_forward(getattr(paddle, name), np_ref, [x], rtol=1e-3, atol=1e-5)
+
+
+def test_reductions():
+    x = np.random.randn(3, 4, 5).astype(np.float32)
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(t.sum().numpy(), x.sum(), rtol=1e-5)
+    np.testing.assert_allclose(t.mean(axis=1).numpy(), x.mean(1), rtol=1e-5)
+    np.testing.assert_allclose(t.max(axis=-1).numpy(), x.max(-1), rtol=1e-6)
+    np.testing.assert_allclose(t.min().numpy(), x.min(), rtol=1e-6)
+    np.testing.assert_allclose(t.prod(axis=0).numpy(), x.prod(0), rtol=1e-4)
+    np.testing.assert_allclose(t.std(axis=1).numpy(), x.std(1, ddof=1), rtol=1e-4)
+    np.testing.assert_allclose(t.var().numpy(), x.var(ddof=1), rtol=1e-4)
+    np.testing.assert_allclose(
+        paddle.logsumexp(t, axis=2).numpy(),
+        np.log(np.exp(x).sum(2)), rtol=1e-4)
+    assert paddle.all(paddle.to_tensor([True, True])).numpy()
+    assert paddle.any(paddle.to_tensor([False, True])).numpy()
+
+
+def test_manipulation():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    t = paddle.to_tensor(x)
+    assert t.reshape([6, 4]).shape == [6, 4]
+    assert t.reshape([-1]).shape == [24]
+    assert t.transpose([2, 0, 1]).shape == [4, 2, 3]
+    assert t.flatten().shape == [24]
+    assert t.flatten(1, 2).shape == [2, 12]
+    assert t.unsqueeze(0).shape == [1, 2, 3, 4]
+    assert t.unsqueeze(0).squeeze(0).shape == [2, 3, 4]
+    c = paddle.concat([t, t], axis=1)
+    assert c.shape == [2, 6, 4]
+    s = paddle.stack([t, t], axis=0)
+    assert s.shape == [2, 2, 3, 4]
+    parts = paddle.split(t, 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == [2, 1, 4]
+    np.testing.assert_array_equal(t.tile([2, 1, 1]).numpy(), np.tile(x, (2, 1, 1)))
+    np.testing.assert_array_equal(
+        paddle.expand(paddle.to_tensor([[1.0], [2.0]]), [2, 3]).numpy(),
+        np.broadcast_to([[1.0], [2.0]], (2, 3)))
+    np.testing.assert_array_equal(t.flip([0]).numpy(), x[::-1])
+    np.testing.assert_array_equal(t.roll(1, axis=0).numpy(), np.roll(x, 1, 0))
+
+
+def test_indexing():
+    x = np.arange(24, dtype=np.float32).reshape(4, 6)
+    t = paddle.to_tensor(x)
+    np.testing.assert_array_equal(t[0].numpy(), x[0])
+    np.testing.assert_array_equal(t[1:3].numpy(), x[1:3])
+    np.testing.assert_array_equal(t[:, 2].numpy(), x[:, 2])
+    np.testing.assert_array_equal(t[..., -1].numpy(), x[..., -1])
+    np.testing.assert_array_equal(t[t > 10].numpy(), x[x > 10])
+    idx = paddle.to_tensor([0, 2], dtype="int32")
+    np.testing.assert_array_equal(t[idx].numpy(), x[[0, 2]])
+    # setitem
+    t2 = paddle.to_tensor(x.copy())
+    t2[0] = 0.0
+    assert t2.numpy()[0].sum() == 0
+    t2[1:3, 2] = 9.0
+    assert (t2.numpy()[1:3, 2] == 9).all()
+
+
+def test_gather_scatter():
+    x = np.random.randn(5, 3).astype(np.float32)
+    t = paddle.to_tensor(x)
+    idx = paddle.to_tensor([0, 3], dtype="int64")
+    np.testing.assert_array_equal(paddle.gather(t, idx).numpy(), x[[0, 3]])
+    u = np.random.randn(2, 3).astype(np.float32)
+    out = paddle.scatter(t, idx, paddle.to_tensor(u))
+    ref = x.copy()
+    ref[[0, 3]] = u
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+    np.testing.assert_array_equal(
+        paddle.index_select(t, idx, axis=0).numpy(), x[[0, 3]])
+    nd_idx = paddle.to_tensor([[0, 1], [2, 2]], dtype="int64")
+    np.testing.assert_array_equal(paddle.gather_nd(t, nd_idx).numpy(), x[[0, 2], [1, 2]])
+
+
+def test_search_sort():
+    x = np.random.randn(4, 6).astype(np.float32)
+    t = paddle.to_tensor(x)
+    np.testing.assert_array_equal(paddle.argmax(t, axis=1).numpy(), x.argmax(1))
+    np.testing.assert_array_equal(paddle.argsort(t, axis=-1).numpy(), x.argsort(-1))
+    np.testing.assert_allclose(paddle.sort(t, axis=0).numpy(), np.sort(x, 0), rtol=1e-6)
+    vals, idx = paddle.topk(t, 3, axis=1)
+    ref = -np.sort(-x, axis=1)[:, :3]
+    np.testing.assert_allclose(vals.numpy(), ref, rtol=1e-6)
+    nz = paddle.nonzero(paddle.to_tensor([0, 1, 0, 2]))
+    np.testing.assert_array_equal(nz.numpy().reshape(-1), [1, 3])
+
+
+def test_linalg():
+    a = np.random.randn(3, 4).astype(np.float32)
+    b = np.random.randn(4, 5).astype(np.float32)
+    np.testing.assert_allclose(paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+                               a @ b, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b.T), transpose_y=True).numpy(),
+        a @ b, rtol=1e-4, atol=1e-5)
+    sq = np.random.randn(3, 3).astype(np.float32) + 3 * np.eye(3, dtype=np.float32)
+    np.testing.assert_allclose(paddle.linalg.inv(paddle.to_tensor(sq)).numpy(),
+                               np.linalg.inv(sq), rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(paddle.linalg.det(paddle.to_tensor(sq)).numpy(),
+                               np.linalg.det(sq), rtol=1e-4)
+    np.testing.assert_allclose(
+        paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+        np.einsum("ij,jk->ik", a, b), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(paddle.norm(paddle.to_tensor(a)).numpy(),
+                               np.linalg.norm(a), rtol=1e-5)
+
+
+def test_inplace_ops():
+    t = paddle.to_tensor([1.0, 2.0])
+    t.add_(paddle.to_tensor([1.0, 1.0]))
+    np.testing.assert_allclose(t.numpy(), [2, 3])
+    t.scale_(2.0)
+    np.testing.assert_allclose(t.numpy(), [4, 6])
+    t.set_value(np.array([7.0, 8.0], np.float32))
+    np.testing.assert_allclose(t.numpy(), [7, 8])
+
+
+def test_cast_where_clip():
+    x = np.random.randn(3, 4).astype(np.float32)
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(paddle.clip(t, -0.5, 0.5).numpy(), np.clip(x, -0.5, 0.5))
+    w = paddle.where(t > 0, t, paddle.zeros_like(t))
+    np.testing.assert_allclose(w.numpy(), np.where(x > 0, x, 0))
+    np.testing.assert_array_equal(paddle.cast(t, "int32").numpy(), x.astype(np.int32))
+
+
+def test_item_and_interop():
+    t = paddle.to_tensor([3.5])
+    assert t.item() == pytest.approx(3.5)
+    assert float(paddle.to_tensor(2.0)) == 2.0
+    assert len(paddle.zeros([5, 2])) == 5
+    assert np.asarray(paddle.ones([2])).sum() == 2
