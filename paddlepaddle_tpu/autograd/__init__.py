@@ -98,7 +98,8 @@ def jacobian(ys, xs, create_graph=False, batch_axis=None):
 
 def functional_jacobian(func, *xs):
     f = lambda *a: unwrap(func(*[wrap(x) for x in a]))
-    jac = jax.jacrev(f, argnums=tuple(range(len(xs))))(*[unwrap(x) for x in xs])
+    argnums = 0 if len(xs) == 1 else tuple(range(len(xs)))
+    jac = jax.jacrev(f, argnums=argnums)(*[unwrap(x) for x in xs])
     return jax.tree_util.tree_map(wrap, jac)
 
 

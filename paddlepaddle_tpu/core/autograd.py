@@ -116,31 +116,73 @@ class GradNode:
         self.released = True
 
 
-def _topo_collect(root_nodes, stop_nodes=None):
+def _topo_collect(root_nodes, allowed=None, no_grad_ids=frozenset()):
     """Collect the reachable reverse subgraph and per-node consumer counts.
 
     ``deps[node]`` = number of in-subgraph edges that feed gradient INTO node
     (i.e. consumers of node's outputs). A node is ready once all those have run.
+    ``allowed`` (node-id set) restricts the graph to nodes on a path to some
+    target (GeneralGrad-style pruning); edges through ``no_grad_ids`` tensors
+    are severed entirely.
     """
-    stop_nodes = stop_nodes or frozenset()
     deps = {}
     visited = set()
-    stack = list(root_nodes)
-    for n in root_nodes:
+    stack = [n for n in root_nodes if allowed is None or id(n) in allowed]
+    for n in stack:
         deps.setdefault(n, 0)
     while stack:
         node = stack.pop()
         if id(node) in visited:
             continue
         visited.add(id(node))
-        if node in stop_nodes:
-            continue
         for t in node.inputs:
+            if id(t) in no_grad_ids:
+                continue
             prod = t._grad_node
-            if prod is not None:
-                deps[prod] = deps.get(prod, 0) + 1
-                stack.append(prod)
+            if prod is None:
+                continue
+            if allowed is not None and id(prod) not in allowed:
+                continue
+            deps[prod] = deps.get(prod, 0) + 1
+            stack.append(prod)
     return deps
+
+
+def _useful_nodes(roots, target_ids, no_grad_ids):
+    """Node-ids from which some target tensor is reachable (depth-first,
+    post-order over the DAG). Used to prune grad() to only_inputs work —
+    the reference's GeneralGrad does the same subgraph selection
+    (paddle/fluid/eager/general_grad.h)."""
+    memo = {}
+    visited = set()
+    stack = [(r, False) for r in roots]
+    while stack:
+        node, post = stack.pop()
+        if post:
+            useful = False
+            for t in node.inputs:
+                if id(t) in no_grad_ids:
+                    continue
+                if id(t) in target_ids:
+                    useful = True
+                    break
+                p = t._grad_node
+                if p is not None and memo.get(id(p)):
+                    useful = True
+                    break
+            memo[id(node)] = useful
+        else:
+            if id(node) in visited:
+                continue
+            visited.add(id(node))
+            stack.append((node, True))
+            for t in node.inputs:
+                if id(t) in no_grad_ids:
+                    continue
+                p = t._grad_node
+                if p is not None and id(p) not in visited:
+                    stack.append((p, False))
+    return {nid for nid, u in memo.items() if u}
 
 
 def run_backward(
@@ -149,12 +191,16 @@ def run_backward(
     retain_graph: bool = False,
     accumulate_into_leaves: bool = True,
     target_tensors: Optional[Sequence] = None,
+    only_inputs: bool = True,
+    no_grad_tensors: Optional[Sequence] = None,
 ):
     """Execute reverse accumulation from ``tensors`` seeded with ``grad_tensors``.
 
     If ``target_tensors`` is given, additionally capture the cotangents arriving
     at those tensors (used by :func:`grad`); returns that list (None where
-    unreached). Mirrors RunBackward/GeneralGrad in the reference
+    unreached). With ``only_inputs`` the graph is pruned to nodes on a path to
+    a target; ``no_grad_tensors`` sever gradient flow entirely. Mirrors
+    RunBackward/GeneralGrad in the reference
     (paddle/fluid/eager/backward.cc:105, general_grad.h).
     """
     target_ids = {}
@@ -163,52 +209,98 @@ def run_backward(
         captured = [None] * len(target_tensors)
         for i, t in enumerate(target_tensors):
             target_ids.setdefault(id(t), []).append(i)
+    no_grad_ids = frozenset(id(t) for t in (no_grad_tensors or ()))
 
     def capture(tensor, g):
         if captured is not None and id(tensor) in target_ids:
             for i in target_ids[id(tensor)]:
                 captured[i] = g if captured[i] is None else captured[i] + g
 
+    def check_released(node):
+        if node.released:
+            raise RuntimeError(
+                "Trying to backward through the graph a second time after it "
+                "was freed. Specify retain_graph=True on the first backward."
+            )
+
     # Seed
     roots = []
     for t, g in zip(tensors, grad_tensors):
+        g = t._apply_grad_hooks(g)
         capture(t, g)
         node = t._grad_node
         if node is None:
             if accumulate_into_leaves and not t.stop_gradient:
                 t._accumulate_grad(g)
             continue
-        if node.released:
-            raise RuntimeError(
-                "Trying to backward through the graph a second time after it "
-                "was freed. Specify retain_graph=True on the first backward."
-            )
+        check_released(node)
         node.accumulate(t._out_index, g)
         roots.append(node)
 
-    deps = _topo_collect(roots)
-    ready = [n for n in dict.fromkeys(roots) if deps.get(n, 0) == 0]
+    # GeneralGrad-style pruning: when capturing targets, only execute nodes
+    # from which a target is reachable.
+    allowed = None
+    if target_tensors is not None and only_inputs:
+        allowed = _useful_nodes(roots, target_ids, no_grad_ids)
+
+    deps = _topo_collect(roots, allowed=allowed, no_grad_ids=no_grad_ids)
+    ready = [n for n in dict.fromkeys(roots)
+             if deps.get(n, 0) == 0 and (allowed is None or id(n) in allowed)]
     seen_ready = set(id(n) for n in ready)
     while ready:
         node = ready.pop()
         in_grads = node.vjp_fn(node.materialized_out_grads())
         for t, g in zip(node.inputs, in_grads):
-            if g is None:
+            if id(t) in no_grad_ids:
                 continue
-            capture(t, g)
+            if g is not None:
+                g = t._apply_grad_hooks(g)
+                capture(t, g)
             prod = t._grad_node
             if prod is None:
-                if accumulate_into_leaves and not t.stop_gradient:
+                if g is not None and accumulate_into_leaves and not t.stop_gradient:
                     t._accumulate_grad(g)
-            else:
+                continue
+            if allowed is not None and id(prod) not in allowed:
+                continue
+            check_released(prod)
+            if g is not None:
                 prod.accumulate(t._out_index, g)
-                deps[prod] -= 1
-                if deps[prod] == 0 and id(prod) not in seen_ready:
-                    seen_ready.add(id(prod))
-                    ready.append(prod)
-        if not retain_graph:
+            # A None cotangent (e.g. a PyLayer backward returning None) still
+            # consumes this edge — the producer must not stay blocked.
+            deps[prod] -= 1
+            if deps[prod] == 0 and id(prod) not in seen_ready:
+                seen_ready.add(id(prod))
+                ready.append(prod)
+        if retain_graph:
+            # Keep the vjp closure but drop accumulated cotangents so a
+            # subsequent backward over the same graph starts from zero
+            # (matches the reference: grads live on leaves, not nodes).
+            node.out_grads = [None] * len(node.out_avals)
+        else:
             node.release()
+    if retain_graph:
+        # Seeded-but-pruned nodes (only_inputs pruning) never executed; drop
+        # their cotangents too so they can't leak into a later backward.
+        for n in roots:
+            if not n.released:
+                n.out_grads = [None] * len(n.out_avals)
     return captured
+
+
+def _release_graph(tensors):
+    """Release every grad node reachable from ``tensors`` (post-hoc free)."""
+    stack = [t._grad_node for t in tensors if t._grad_node is not None]
+    seen = set()
+    while stack:
+        node = stack.pop()
+        if id(node) in seen or node.released:
+            continue
+        seen.add(id(node))
+        for t in node.inputs:
+            if t._grad_node is not None:
+                stack.append(t._grad_node)
+        node.release()
 
 
 def backward(tensors, grad_tensors=None, retain_graph=False):
@@ -224,11 +316,9 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
     seeds = []
     for t, g in zip(tensors, grad_tensors):
         if g is None:
-            if t._data.size != 1:
-                raise RuntimeError(
-                    "grad can be implicitly created only for scalar outputs; "
-                    "pass grad_tensors for non-scalar tensors"
-                )
+            # Paddle fills an implicit all-ones cotangent for ANY shape
+            # (python/paddle/base/dygraph/tensor_patch_methods.py:270) —
+            # no torch-style scalar-only restriction.
             g = jnp.ones_like(t._data)
         else:
             g = g._data if isinstance(g, Tensor) else jnp.asarray(g)
@@ -275,12 +365,18 @@ def grad(
         seeds.append(g)
     if retain_graph is None:
         retain_graph = False
+    # Run with the graph retained so an allow_unused error leaves it intact
+    # (the caller may retry); release afterwards if not requested to keep it.
+    if no_grad_vars is not None and not isinstance(no_grad_vars, (list, tuple)):
+        no_grad_vars = [no_grad_vars]
     captured = run_backward(
         outputs,
         seeds,
-        retain_graph=retain_graph,
+        retain_graph=True,
         accumulate_into_leaves=False,
         target_tensors=inputs,
+        only_inputs=only_inputs,
+        no_grad_tensors=no_grad_vars,
     )
     results = []
     for t, g in zip(inputs, captured):
@@ -294,4 +390,6 @@ def grad(
             results.append(None)
         else:
             results.append(Tensor._from_data(g, stop_gradient=True))
+    if not retain_graph:
+        _release_graph(outputs)
     return results

@@ -37,6 +37,7 @@ class Tensor:
         "persistable",
         "_version",
         "_hooks",
+        "_next_hook_id",
         "__weakref__",
     )
 
@@ -56,6 +57,7 @@ class Tensor:
         self.persistable = False
         self._version = 0
         self._hooks = None
+        self._next_hook_id = 0
 
     # -- construction -----------------------------------------------------
     @classmethod
@@ -72,6 +74,7 @@ class Tensor:
         t.persistable = False
         t._version = 0
         t._hooks = None
+        t._next_hook_id = 0
         return t
 
     # -- meta -------------------------------------------------------------
@@ -172,19 +175,30 @@ class Tensor:
         else:
             self._grad = value._data if isinstance(value, Tensor) else jnp.asarray(value)
 
-    def _accumulate_grad(self, g):
-        if g.dtype != self._data.dtype:
-            g = g.astype(self._data.dtype)
+    def _apply_grad_hooks(self, g):
+        """Run registered gradient hooks on an arriving cotangent. Called by
+        the engine for EVERY tensor a gradient reaches (leaf or not), matching
+        the reference's per-tensor grad hooks (paddle/fluid/eager/hooks.h)."""
         if self._hooks:
             from .tensor import Tensor as T
 
-            for hook in self._hooks.values():
+            for hook in list(self._hooks.values()):
                 out = hook(T._from_data(g, stop_gradient=True))
                 if out is not None:
                     g = out._data if isinstance(out, T) else jnp.asarray(out)
+        return g
+
+    def _accumulate_grad(self, g):
+        if g.dtype != self._data.dtype:
+            g = g.astype(self._data.dtype)
         self._grad = g if self._grad is None else self._grad + g
 
     def backward(self, grad_tensor=None, retain_graph=False):
+        if self.stop_gradient and self._grad_node is None:
+            raise RuntimeError(
+                "backward() called on a tensor that does not require grad "
+                "(stop_gradient=True and no grad path)"
+            )
         _ag_backward(self, grad_tensor, retain_graph=retain_graph)
 
     def clear_grad(self):
@@ -197,10 +211,11 @@ class Tensor:
         self._retain_grads = True
 
     def register_hook(self, hook):
-        """Gradient hook on this (leaf) tensor; returns a removable handle."""
+        """Gradient hook on this tensor; returns a removable handle."""
         if self._hooks is None:
             self._hooks = {}
-        key = len(self._hooks)
+        key = self._next_hook_id
+        self._next_hook_id = key + 1
         self._hooks[key] = hook
 
         class _Handle:

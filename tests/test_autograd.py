@@ -79,11 +79,14 @@ def test_no_grad():
     assert y._grad_node is None
 
 
-def test_non_scalar_backward_requires_grad_tensor():
+def test_non_scalar_backward_implicit_ones():
+    # Paddle fills an implicit all-ones grad for any shape
+    # (tensor_patch_methods.py:270) — no scalar-only restriction.
     x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
     y = x * 2
-    with pytest.raises(RuntimeError):
-        y.backward()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+    x.clear_grad()
     y = x * 2
     y.backward(paddle.to_tensor([1.0, 0.5]))
     np.testing.assert_allclose(x.grad.numpy(), [2.0, 1.0])
@@ -167,6 +170,55 @@ def test_pylayer():
     np.testing.assert_allclose(y.numpy(), [2, 4])
     y.sum().backward()
     np.testing.assert_allclose(x.grad.numpy(), [2, 2])
+
+
+def test_pylayer_none_grad_does_not_block():
+    # A PyLayer backward returning None must still unblock the producer so
+    # gradient arriving via other consumers is not dropped.
+    class NoGrad(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            return x * 5
+
+        @staticmethod
+        def backward(ctx, grad):
+            return None
+
+    a = paddle.to_tensor([1.0], stop_gradient=False)
+    z = a * 2
+    w = NoGrad.apply(z) + z
+    w.sum().backward()
+    np.testing.assert_allclose(a.grad.numpy(), [2.0])
+
+
+def test_nonleaf_hook_fires():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    h = x * 3  # non-leaf
+    seen = []
+    h.register_hook(lambda g: seen.append(g.numpy()) or (g * 10))
+    (h * 2).sum().backward()
+    assert seen and seen[0][0] == pytest.approx(2.0)
+    np.testing.assert_allclose(x.grad.numpy(), [60.0])  # 2 * 10 * 3
+
+
+def test_hook_remove_then_add():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    calls = []
+    h1 = x.register_hook(lambda g: calls.append("a"))
+    x.register_hook(lambda g: calls.append("b"))
+    h1.remove()
+    x.register_hook(lambda g: calls.append("c"))
+    (x * 2).sum().backward()
+    assert calls == ["b", "c"]
+
+
+def test_no_grad_vars():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    w = x * 3
+    y = (w * x).sum()  # y = 3x^2; through-w path contributes 3x, direct path 3x
+    (gx,) = paddle.grad(y, [x], no_grad_vars=[w])
+    # gradient through w severed: only the direct x edge remains -> w = 6
+    np.testing.assert_allclose(gx.numpy(), [6.0])
 
 
 def test_functional_jacobian():
