@@ -1,0 +1,82 @@
+"""Flagship benchmark: Llama pretrain train-step throughput on one chip.
+
+Prints ONE JSON line: tokens/sec/chip + MFU-derived vs_baseline, where
+baseline = the BASELINE.json north star (Llama pretrain at 40% MFU).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import numpy as np
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    table = [
+        ("v6", 918e12), ("v5p", 459e12), ("v5", 197e12),  # v5 lite
+        ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
+    ]
+    for key, val in table:
+        if key in kind:
+            return val
+    return 275e12 if device.platform in ("tpu", "axon") else 1e12
+
+
+def main():
+    dev = jax.devices()[0]
+    on_accel = dev.platform not in ("cpu",)
+
+    from paddlepaddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddlepaddle_tpu.optimizer import AdamW
+    from paddlepaddle_tpu.jit.train import TrainStep
+
+    if on_accel:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=4096,
+            num_hidden_layers=12, num_attention_heads=16, num_key_value_heads=8,
+            max_position_embeddings=2048, dtype="bfloat16")
+        batch, seq, iters = 8, 1024, 10
+    else:
+        cfg = LlamaConfig.tiny(vocab_size=512, hidden_size=128, layers=2,
+                               heads=4, kv_heads=2, max_len=256)
+        batch, seq, iters = 2, 128, 3
+
+    model = LlamaForCausalLM(cfg)
+    opt = AdamW(learning_rate=1e-4, parameters=model.parameters(), multi_precision=True)
+    step = TrainStep(model, opt, lambda m, ids, labels: m(ids, labels=labels))
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+
+    for _ in range(2):  # compile + warm
+        loss = step(ids, ids)
+    jax.block_until_ready(step.params)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(ids, ids)
+    jax.block_until_ready(step.params)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * iters / dt
+    n_params = cfg.num_params()
+    # 6N per token (fwd+bwd) + attention flops 12*L*h*s per token
+    flops_per_token = 6 * n_params + 12 * cfg.num_hidden_layers * cfg.hidden_size * seq
+    mfu = tokens_per_sec * flops_per_token / _peak_flops(dev)
+    print(json.dumps({
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "detail": {
+            "mfu": round(mfu, 4), "params": n_params, "device": str(dev.device_kind),
+            "batch": batch, "seq": seq, "final_loss": round(float(loss.numpy()), 4),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

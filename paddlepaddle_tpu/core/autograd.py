@@ -117,15 +117,20 @@ class GradNode:
 
 
 def _topo_collect(root_nodes, allowed=None, no_grad_ids=frozenset()):
-    """Collect the reachable reverse subgraph and per-node consumer counts.
+    """Collect the reachable reverse subgraph with per-node and per-tensor
+    consumer counts.
 
     ``deps[node]`` = number of in-subgraph edges that feed gradient INTO node
     (i.e. consumers of node's outputs). A node is ready once all those have run.
+    ``t_deps[id(t)]`` = number of in-subgraph consumer EDGES referencing tensor
+    ``t`` — a tensor's gradient is final (hooks may fire, reference per-tensor
+    hook semantics paddle/fluid/eager/hooks.h) once all of them have drained.
     ``allowed`` (node-id set) restricts the graph to nodes on a path to some
     target (GeneralGrad-style pruning); edges through ``no_grad_ids`` tensors
     are severed entirely.
     """
     deps = {}
+    t_deps = {}
     visited = set()
     stack = [n for n in root_nodes if allowed is None or id(n) in allowed]
     for n in stack:
@@ -138,6 +143,7 @@ def _topo_collect(root_nodes, allowed=None, no_grad_ids=frozenset()):
         for t in node.inputs:
             if id(t) in no_grad_ids:
                 continue
+            t_deps[id(t)] = t_deps.get(id(t), 0) + 1
             prod = t._grad_node
             if prod is None:
                 continue
@@ -145,7 +151,7 @@ def _topo_collect(root_nodes, allowed=None, no_grad_ids=frozenset()):
                 continue
             deps[prod] = deps.get(prod, 0) + 1
             stack.append(prod)
-    return deps
+    return deps, t_deps
 
 
 def _useful_nodes(roots, target_ids, no_grad_ids):
@@ -223,19 +229,17 @@ def run_backward(
                 "was freed. Specify retain_graph=True on the first backward."
             )
 
-    # Seed
+    # Seed-phase bookkeeping: roots + seed-edge counts per tensor. A seed is
+    # one extra inbound edge on its tensor; actual consumption happens after
+    # t_deps are known so hooks fire exactly once with the FULL gradient.
     roots = []
+    seed_edges = []
     for t, g in zip(tensors, grad_tensors):
-        g = t._apply_grad_hooks(g)
-        capture(t, g)
         node = t._grad_node
-        if node is None:
-            if accumulate_into_leaves and not t.stop_gradient:
-                t._accumulate_grad(g)
-            continue
-        check_released(node)
-        node.accumulate(t._out_index, g)
-        roots.append(node)
+        if node is not None:
+            check_released(node)
+            roots.append(node)
+        seed_edges.append((t, g))
 
     # GeneralGrad-style pruning: when capturing targets, only execute nodes
     # from which a target is reachable.
@@ -243,7 +247,47 @@ def run_backward(
     if target_tensors is not None and only_inputs:
         allowed = _useful_nodes(roots, target_ids, no_grad_ids)
 
-    deps = _topo_collect(roots, allowed=allowed, no_grad_ids=no_grad_ids)
+    deps, t_deps = _topo_collect(roots, allowed=allowed, no_grad_ids=no_grad_ids)
+    for t, _ in seed_edges:
+        t_deps[id(t)] = t_deps.get(id(t), 0) + 1
+
+    # Per-tensor raw accumulation; finalize (hooks → capture → leaf/.grad or
+    # producer slot) fires once, when the tensor's last inbound edge drains —
+    # matching the reference's per-tensor hook semantics (hooks see the
+    # accumulated gradient, not per-edge partials).
+    t_acc = {}  # id(t) -> (t, accumulated-raw-grad)
+
+    def finalize(t):
+        g = t_acc.pop(id(t), (t, None))[1]
+        if g is None:
+            return
+        g = t._apply_grad_hooks(g)
+        capture(t, g)
+        prod = t._grad_node
+        if prod is None:
+            if accumulate_into_leaves and not t.stop_gradient:
+                t._accumulate_grad(g)
+            return
+        if allowed is not None and id(prod) not in allowed:
+            return
+        check_released(prod)
+        prod.accumulate(t._out_index, g)
+
+    def add_edge_grad(t, g):
+        tid = id(t)
+        if g is not None:
+            cur = t_acc.get(tid)
+            t_acc[tid] = (t, g if cur is None or cur[1] is None else cur[1] + g)
+        elif tid not in t_acc:
+            t_acc[tid] = (t, None)
+        t_deps[tid] -= 1
+        if t_deps[tid] == 0:
+            finalize(t)
+
+    # Consume the seed edges.
+    for t, g in seed_edges:
+        add_edge_grad(t, g)
+
     ready = [n for n in dict.fromkeys(roots)
              if deps.get(n, 0) == 0 and (allowed is None or id(n) in allowed)]
     seen_ready = set(id(n) for n in ready)
@@ -253,19 +297,12 @@ def run_backward(
         for t, g in zip(node.inputs, in_grads):
             if id(t) in no_grad_ids:
                 continue
-            if g is not None:
-                g = t._apply_grad_hooks(g)
-                capture(t, g)
+            add_edge_grad(t, g)
             prod = t._grad_node
             if prod is None:
-                if g is not None and accumulate_into_leaves and not t.stop_gradient:
-                    t._accumulate_grad(g)
                 continue
             if allowed is not None and id(prod) not in allowed:
                 continue
-            check_released(prod)
-            if g is not None:
-                prod.accumulate(t._out_index, g)
             # A None cotangent (e.g. a PyLayer backward returning None) still
             # consumes this edge — the producer must not stay blocked.
             deps[prod] -= 1
@@ -279,12 +316,6 @@ def run_backward(
             node.out_grads = [None] * len(node.out_avals)
         else:
             node.release()
-    if retain_graph:
-        # Seeded-but-pruned nodes (only_inputs pruning) never executed; drop
-        # their cotangents too so they can't leak into a later backward.
-        for n in roots:
-            if not n.released:
-                n.out_grads = [None] * len(n.out_avals)
     return captured
 
 
@@ -350,6 +381,9 @@ def grad(
             "paddlepaddle_tpu.incubate.autograd (functional jax.grad/jacobian/"
             "hessian) for higher-order derivatives."
         )
+    # Matches the reference: python/paddle/base/dygraph/base.py asserts
+    # only_inputs=True ("only_inputs=False is not supported yet").
+    assert only_inputs, "only_inputs=False is not supported yet"
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     if grad_outputs is None:
@@ -367,8 +401,10 @@ def grad(
         retain_graph = False
     # Run with the graph retained so an allow_unused error leaves it intact
     # (the caller may retry); release afterwards if not requested to keep it.
-    if no_grad_vars is not None and not isinstance(no_grad_vars, (list, tuple)):
+    if no_grad_vars is not None and not isinstance(no_grad_vars, (list, tuple, set)):
         no_grad_vars = [no_grad_vars]
+    if isinstance(no_grad_vars, set):
+        no_grad_vars = list(no_grad_vars)
     captured = run_backward(
         outputs,
         seeds,

@@ -176,16 +176,15 @@ class Tensor:
             self._grad = value._data if isinstance(value, Tensor) else jnp.asarray(value)
 
     def _apply_grad_hooks(self, g):
-        """Run registered gradient hooks on an arriving cotangent. Called by
-        the engine for EVERY tensor a gradient reaches (leaf or not), matching
-        the reference's per-tensor grad hooks (paddle/fluid/eager/hooks.h)."""
+        """Run registered gradient hooks on this tensor's fully-accumulated
+        cotangent — the engine calls this once per tensor per backward,
+        matching the reference's per-tensor grad hooks
+        (paddle/fluid/eager/hooks.h)."""
         if self._hooks:
-            from .tensor import Tensor as T
-
             for hook in list(self._hooks.values()):
-                out = hook(T._from_data(g, stop_gradient=True))
+                out = hook(Tensor._from_data(g, stop_gradient=True))
                 if out is not None:
-                    g = out._data if isinstance(out, T) else jnp.asarray(out)
+                    g = out._data if isinstance(out, Tensor) else jnp.asarray(out)
         return g
 
     def _accumulate_grad(self, g):
@@ -195,10 +194,9 @@ class Tensor:
 
     def backward(self, grad_tensor=None, retain_graph=False):
         if self.stop_gradient and self._grad_node is None:
-            raise RuntimeError(
-                "backward() called on a tensor that does not require grad "
-                "(stop_gradient=True and no grad path)"
-            )
+            # Reference skips silently (backward.cc: "Skip auto grad since
+            # there is no grad op for var or loss is stop_gradient=True").
+            return
         _ag_backward(self, grad_tensor, retain_graph=retain_graph)
 
     def clear_grad(self):

@@ -46,8 +46,13 @@ class TrainStep:
         self._step_count = 0
 
     def _step_impl(self, params, opt_state, batch, key, lr):
+        from ..core import autograd as _ag
+
         def loss_of(p):
-            with prandom.key_scope(key):
+            # jax.value_and_grad differentiates via tracer provenance; the
+            # eager GradNode tape is dead weight here (per-op jax.vjp nesting
+            # overflows the Python stack on deep models), so switch it off.
+            with _ag.no_grad(), prandom.key_scope(key):
                 state = dict(p)
                 state.update(self.buffers)
                 with self.model.bind_state(state):

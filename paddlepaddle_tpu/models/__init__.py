@@ -1,0 +1,18 @@
+"""Model zoo (reference: PaddleNLP llm/ recipes + python/paddle/vision/models).
+
+Flagship families, all built on paddlepaddle_tpu.nn Layers so the same
+define-by-run code runs eagerly and traces to one XLA program via
+``Layer.bind_state`` (see jit/train.py / parallel/):
+
+* llama  — Llama-3-style decoder LM (BASELINE config 3 flagship)
+* bert   — BERT-base encoder for sequence classification (config 1)
+* resnet — ResNet family (config 2; also in vision.models)
+* moe    — Mixtral/DeepSeekMoE-style expert-parallel LM (config 5)
+"""
+
+from .llama import (  # noqa: F401
+    LlamaConfig,
+    LlamaForCausalLM,
+    LlamaModel,
+    llama_sharding_rules,
+)

@@ -1,0 +1,237 @@
+"""Llama-3-style decoder-only LM — the flagship pretrain model.
+
+Capability parity target: PaddleNLP's LlamaForCausalLM recipe semantics
+(reference framework surface: python/paddle/nn/layer/transformer.py,
+python/paddle/incubate/nn/functional/ fused_rms_norm / fused_rotary_position_
+embedding / swiglu, python/paddle/nn/functional/flash_attention.py:364).
+
+TPU-native design notes:
+* all compute is bf16-friendly and static-shape; attention goes through the
+  Pallas flash-attention kernel (ops/kernels/flash_attention.py) on TPU,
+  XLA fallback elsewhere;
+* GQA repeats kv heads at trace time — XLA fuses the broadcast into the
+  attention einsum, no materialized copy on TPU;
+* ``llama_sharding_rules`` carries the GSPMD placement table (the analogue of
+  the reference's per-layer ColumnParallel/RowParallel markup in
+  fleet/layers/mpu/mp_layers.py): 2D (tp × fsdp) sharding of every matmul
+  weight, so pjit emits all-gather/reduce-scatter over ICI.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn.common import Embedding, Linear
+from ..nn.container import LayerList
+from ..nn.layer import Layer
+from ..nn.norm import RMSNorm
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    dtype: str = "float32"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    # ready-made sizes -----------------------------------------------------
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig(
+            vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+            num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=8,
+            max_position_embeddings=8192, rope_theta=500000.0, dtype="bfloat16")
+
+    @staticmethod
+    def tiny(vocab_size=256, hidden_size=64, layers=2, heads=4, kv_heads=2,
+             max_len=128) -> "LlamaConfig":
+        return LlamaConfig(
+            vocab_size=vocab_size, hidden_size=hidden_size,
+            intermediate_size=hidden_size * 3, num_hidden_layers=layers,
+            num_attention_heads=heads, num_key_value_heads=kv_heads,
+            max_position_embeddings=max_len)
+
+    def num_params(self) -> int:
+        h, i, v = self.hidden_size, self.intermediate_size, self.vocab_size
+        kv = self.num_key_value_heads * self.head_dim
+        per_layer = h * h + 2 * h * kv + h * h + 3 * h * i + 2 * h
+        embed = v * h * (1 if self.tie_word_embeddings else 2)
+        return self.num_hidden_layers * per_layer + embed + h
+
+
+def _rope_cos_sin(config: LlamaConfig):
+    dim = config.head_dim
+    inv_freq = 1.0 / (config.rope_theta ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    t = jnp.arange(config.max_position_embeddings, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)                       # [T, dim/2]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)       # [T, dim]
+    return jnp.cos(emb), jnp.sin(emb)
+
+
+def _apply_rope(q, k, cos, sin, offset=0):
+    """NeoX-style rotate-half rope on BSHD tensors; cos/sin precomputed fp32."""
+
+    def rot(x):
+        half = x.shape[-1] // 2
+        return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+
+    def f(qa, ka, c, s):
+        seq = qa.shape[1]
+        c = jax.lax.dynamic_slice_in_dim(c, offset, seq, axis=0)[None, :, None, :]
+        s = jax.lax.dynamic_slice_in_dim(s, offset, seq, axis=0)[None, :, None, :]
+        c, s = c.astype(qa.dtype), s.astype(qa.dtype)
+        return (qa * c + rot(qa) * s, ka * c + rot(ka) * s)
+
+    return apply_op(f, q, k, cos, sin, op_name="fused_rope")
+
+
+class LlamaAttention(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        self.config = config
+        h = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.num_kv_heads = config.num_key_value_heads
+        self.head_dim = config.head_dim
+        kv = self.num_kv_heads * self.head_dim
+        self.q_proj = Linear(h, h, bias_attr=False)
+        self.k_proj = Linear(h, kv, bias_attr=False)
+        self.v_proj = Linear(h, kv, bias_attr=False)
+        self.o_proj = Linear(h, h, bias_attr=False)
+
+    def forward(self, x, cos, sin, attn_mask=None):
+        b, s = x.shape[0], x.shape[1]
+        q = self.q_proj(x).reshape([b, s, self.num_heads, self.head_dim])
+        k = self.k_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
+        v = self.v_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
+        q, k = _apply_rope(q, k, cos, sin)
+        if self.num_kv_heads != self.num_heads:
+            rep = self.num_heads // self.num_kv_heads
+            k = apply_op(lambda a: jnp.repeat(a, rep, axis=2), k)
+            v = apply_op(lambda a: jnp.repeat(a, rep, axis=2), v)
+        out, _ = F.flash_attention(q, k, v, causal=True)
+        return self.o_proj(out.reshape([b, s, -1]))
+
+
+class LlamaMLP(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        h, i = config.hidden_size, config.intermediate_size
+        self.gate_proj = Linear(h, i, bias_attr=False)
+        self.up_proj = Linear(h, i, bias_attr=False)
+        self.down_proj = Linear(i, h, bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(F.swiglu(self.gate_proj(x), self.up_proj(x)))
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        self.input_layernorm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        self.mlp = LlamaMLP(config)
+
+    def forward(self, x, cos, sin, attn_mask=None):
+        x = x + self.self_attn(self.input_layernorm(x), cos, sin, attn_mask)
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+
+class LlamaModel(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        self.config = config
+        self.embed_tokens = Embedding(config.vocab_size, config.hidden_size)
+        self.layers = LayerList([LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)])
+        self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        cos, sin = _rope_cos_sin(config)
+        self.register_buffer("rope_cos", Tensor(cos), persistable=False)
+        self.register_buffer("rope_sin", Tensor(sin), persistable=False)
+        if config.dtype != "float32":
+            self.to(dtype=config.dtype)
+
+    def forward(self, input_ids, attn_mask=None):
+        x = self.embed_tokens(input_ids)
+        cos, sin = self.rope_cos, self.rope_sin
+        for layer in self.layers:
+            x = layer(x, cos, sin, attn_mask)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        self.config = config
+        self.model = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = Linear(config.hidden_size, config.vocab_size, bias_attr=False)
+        if config.dtype != "float32":
+            self.to(dtype=config.dtype)
+
+    def forward(self, input_ids, labels=None, attn_mask=None):
+        hidden = self.model(input_ids, attn_mask)
+        if self.lm_head is None:
+            logits = apply_op(lambda h, w: h @ w.T, hidden, self.model.embed_tokens.weight)
+        else:
+            logits = self.lm_head(hidden)
+        if labels is None:
+            return logits
+        return self.loss_from_logits(logits, labels)
+
+    @staticmethod
+    def loss_from_logits(logits, labels):
+        """Next-token CE in fp32 over bf16 logits; labels == -100 ignored."""
+
+        def f(lg, lb):
+            lg = lg[:, :-1, :].astype(jnp.float32)
+            lb = lb[:, 1:]
+            logp = jax.nn.log_softmax(lg, axis=-1)
+            nll = -jnp.take_along_axis(logp, jnp.maximum(lb, 0)[..., None], axis=-1)[..., 0]
+            valid = (lb >= 0).astype(jnp.float32)
+            return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+
+        return apply_op(f, logits, labels, op_name="cross_entropy")
+
+
+def llama_sharding_rules(tp_axis="tp", fsdp_axis="fsdp"):
+    """GSPMD placement table: param-name regex → PartitionSpec axes.
+
+    The 2D-sharding recipe from the scaling playbook: every matmul weight is
+    sharded on both tp (the contracted-or-output hidden dim that TP splits)
+    and fsdp (the other dim, ZeRO-3 style), norms replicated. With this table
+    alone pjit reproduces the reference's ColumnParallel/RowParallel +
+    sharding-stage-3 composition (fleet/layers/mpu/mp_layers.py:336,543 +
+    group_sharded_stage3.py) as compiler-inserted ICI collectives.
+    """
+    return [
+        (r".*embed_tokens\.weight$", (tp_axis, fsdp_axis)),
+        (r".*(q|k|v)_proj\.weight$", (fsdp_axis, tp_axis)),   # column-parallel
+        (r".*o_proj\.weight$", (tp_axis, fsdp_axis)),          # row-parallel
+        (r".*(gate|up)_proj\.weight$", (fsdp_axis, tp_axis)),  # column-parallel
+        (r".*down_proj\.weight$", (tp_axis, fsdp_axis)),       # row-parallel
+        (r".*lm_head\.weight$", (fsdp_axis, tp_axis)),
+        (r".*", ()),                                           # norms etc. replicated
+    ]

@@ -131,17 +131,21 @@ def _pallas_forward(q, k, v, causal, scale):
         block_kv=block_kv, seq_k=sk,
     )
     grid = (bh, sq // block_q)
-    return pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-    )(q, k, v)
+    # Mosaic lowering has no int64/float64 path (jax 0.9 _convert_helper
+    # recurses forever on unsupported casts); the package enables x64 globally
+    # for paddle dtype parity, so trace the kernel with x64 off.
+    with jax.enable_x64(False):
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        )(q, k, v)
 
 
 # Blocks arrive with a leading singleton dim; reshape inside the kernel refs is

@@ -1,0 +1,14 @@
+"""Parallelism package — GSPMD mesh sharding in place of process groups.
+
+Reference surface being replaced: python/paddle/distributed/fleet (manual
+hybrid DP/TP/PP/sharding) and python/paddle/distributed/auto_parallel
+(DistTensor + SPMD rules + partitioner/reshard). The TPU-native design is one
+device mesh with named axes; placements are ``jax.sharding.PartitionSpec``s
+and every collective is emitted by XLA from shardings (SURVEY.md §7).
+"""
+
+from .sharded import (  # noqa: F401
+    ShardedTrainStep,
+    match_sharding_rules,
+    param_shardings,
+)

@@ -68,8 +68,9 @@ def test_detach():
     z = y.detach()
     assert z.stop_gradient
     w = z * 3
-    with pytest.raises(RuntimeError):
-        w.backward()  # no grad path
+    # no grad path: reference silently skips (backward.cc "Skip auto grad...")
+    w.backward()
+    assert w.grad is None and x.grad is None
 
 
 def test_no_grad():
@@ -225,3 +226,33 @@ def test_functional_jacobian():
     x = np.array([1.0, 2.0], np.float32)
     jac = paddle.autograd.functional_jacobian(lambda t: (t * t).sum(), x)
     np.testing.assert_allclose(np.asarray(jac.numpy() if hasattr(jac, 'numpy') else jac), [2, 4], rtol=1e-5)
+
+
+def test_grad_hook_fires_once_with_accumulated_grad():
+    """Hooks see the FULL accumulated gradient, once per tensor per backward
+    (reference per-tensor hook semantics, paddle/fluid/eager/hooks.h)."""
+    import paddlepaddle_tpu as paddle
+
+    x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    calls = []
+
+    def hook(g):
+        calls.append(float(g.numpy()[0]))
+        return g.clip(-1, 1)
+
+    x.register_hook(hook)
+    y = x * 2 + x * 3
+    y.backward()
+    assert calls == [5.0]
+    assert float(x.grad.numpy()[0]) == 1.0
+
+
+def test_interior_hook_affects_upstream():
+    import paddlepaddle_tpu as paddle
+
+    x = paddle.to_tensor(np.array([3.0], np.float32), stop_gradient=False)
+    h = x * 2
+    h.register_hook(lambda g: g * 10)
+    z = h * 4 + h
+    z.backward()
+    assert float(x.grad.numpy()[0]) == 100.0
