@@ -50,7 +50,7 @@ class Generator:
 
 _default_generator = Generator(0)
 _named_generators: Dict[str, Generator] = {}
-_scoped_keys = []  # traced-key stack used inside jitted train steps
+_scope_stack = []  # innermost-wins stack of ["key", key] / ("gen", Generator)
 
 
 from contextlib import contextmanager
@@ -63,11 +63,25 @@ def key_scope(key):
     The functional path's answer to stateful RNG under tracing: a jitted train
     step takes an explicit key argument and wraps its forward in key_scope so
     dropout masks differ per step while staying compile-safe."""
-    _scoped_keys.append(key)
+    _scope_stack.append(["key", key])
     try:
         yield
     finally:
-        _scoped_keys.pop()
+        _scope_stack.pop()
+
+
+@contextmanager
+def generator_scope(gen: Generator):
+    """Route next_key() to ``gen`` (the mpu RNGStatesTracker mechanism: a
+    named generator temporarily replaces the default stream). Innermost scope
+    wins, so an rng_state() region inside a traced train step (key_scope)
+    draws from the tracker as the fleet API documents — note the tracker key
+    is a compile-time constant under jit."""
+    _scope_stack.append(("gen", gen))
+    try:
+        yield
+    finally:
+        _scope_stack.pop()
 
 
 def default_generator() -> Generator:
@@ -91,10 +105,13 @@ def get_generator(name: str = None) -> Generator:
 
 
 def next_key(name: str = None):
-    if _scoped_keys:
-        k, sub = jax.random.split(_scoped_keys[-1])
-        _scoped_keys[-1] = k
-        return sub
+    if _scope_stack and name is None:
+        top = _scope_stack[-1]
+        if top[0] == "key":
+            k, sub = jax.random.split(top[1])
+            top[1] = k
+            return sub
+        return top[1].split()
     return get_generator(name).split()
 
 

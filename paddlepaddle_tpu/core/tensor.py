@@ -306,7 +306,8 @@ class Tensor:
 class Parameter(Tensor):
     """Trainable tensor (reference: python/paddle/base/framework.py EagerParamBase)."""
 
-    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip", "is_distributed")
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip",
+                 "is_distributed", "dist_spec")
 
     def __init__(self, data, trainable=True, name=None):
         data = data._data if isinstance(data, Tensor) else jnp.asarray(data)
@@ -317,6 +318,7 @@ class Parameter(Tensor):
         self.regularizer = None
         self.need_clip = True
         self.is_distributed = False
+        self.dist_spec = None  # GSPMD placement set by mpu/TP layers
         self.persistable = True
         if name:
             self.name = name

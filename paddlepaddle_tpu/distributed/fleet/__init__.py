@@ -1,0 +1,124 @@
+"""fleet — manual hybrid-parallel API (reference: python/paddle/distributed/
+fleet/fleet.py:151,218 init / distributed_model / distributed_optimizer).
+
+TPU-native: ``fleet.init`` builds ONE jax mesh over the hybrid axes
+(dp × pp × sharding × sep × mp) and registers it globally; distributed_model /
+distributed_optimizer are pass-throughs with placement bookkeeping because
+GSPMD replaces the reference's wrapper machinery (EagerReducer allreduce,
+HybridParallelOptimizer cross-group clip, sharding hooks) with compiler-
+inserted collectives. Real placement happens in parallel.ShardedTrainStep.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..mesh import ProcessMesh, set_mesh
+from .random import get_rng_state_tracker, model_parallel_random_seed  # noqa: F401
+from .topology import (  # noqa: F401
+    CommunicateTopology,
+    HybridCommunicateGroup,
+    ParallelMode,
+    _set_hcg,
+    get_hybrid_communicate_group,
+)
+
+
+class DistributedStrategy:
+    """Reference: fleet/base/distributed_strategy.py:284 (protobuf-backed
+    there; a plain attribute bag here — same knob names)."""
+
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1,
+            "mp_degree": 1,
+            "pp_degree": 1,
+            "sharding_degree": 1,
+            "sep_degree": 1,
+        }
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {}
+        self.find_unused_parameters = False
+
+    def __repr__(self):
+        return f"DistributedStrategy(hybrid_configs={self.hybrid_configs})"
+
+
+class _Fleet:
+    def __init__(self):
+        self._strategy: Optional[DistributedStrategy] = None
+        self._hcg: Optional[HybridCommunicateGroup] = None
+        self._initialized = False
+
+    def init(self, role_maker=None, is_collective=False, strategy=None, log_level="INFO"):
+        strategy = strategy or DistributedStrategy()
+        self._strategy = strategy
+        hc = strategy.hybrid_configs
+        topo = CommunicateTopology(
+            hybrid_group_names=("data", "pipe", "sharding", "sep", "model"),
+            dims=(
+                max(1, hc.get("dp_degree", 1)),
+                max(1, hc.get("pp_degree", 1)),
+                max(1, hc.get("sharding_degree", 1)),
+                max(1, hc.get("sep_degree", 1)),
+                max(1, hc.get("mp_degree", 1)),
+            ),
+        )
+        self._hcg = HybridCommunicateGroup(topo)
+        _set_hcg(self._hcg)
+        set_mesh(self._hcg.mesh)
+        self._initialized = True
+        return self
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    @property
+    def worker_num(self):
+        return self._hcg.topology().world_size() if self._hcg else 1
+
+    def worker_index(self):
+        return 0
+
+    def is_first_worker(self):
+        return True
+
+    def barrier_worker(self):
+        pass
+
+    def distributed_model(self, model):
+        """GSPMD needs no wrapper: TP layers carry placements, DP/sharding are
+        batch+param shardings in the train step. Returned as-is (reference
+        wraps by mode, fleet/model.py:32)."""
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        """The reference's HybridParallelOptimizer rewrites grad-clip to
+        aggregate the global norm across mp/pp/sharding groups; the functional
+        optimizer already computes the clip norm over ALL params of the one
+        process (= the global model under GSPMD), so semantics match."""
+        return optimizer
+
+    init_server = None
+    run_server = None
+
+
+fleet = _Fleet()
+
+# module-level API mirroring `from paddle.distributed import fleet`
+init = fleet.init
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer
+worker_index = fleet.worker_index
+is_first_worker = fleet.is_first_worker
+barrier_worker = fleet.barrier_worker

@@ -1,0 +1,184 @@
+"""Hybrid-parallel topology (reference: python/paddle/distributed/fleet/base/
+topology.py — CommunicateTopology:70-81, HybridCommunicateGroup:189).
+
+The five canonical axes ["data", "pipe", "sharding", "sep", "model"] map onto
+one jax Mesh with axes ("dp", "pp", "sharding", "sep", "mp"); per-axis
+"communication groups" are just axis metadata — XLA emits the collectives —
+so group objects here carry (axis name, size, rank) for API parity.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..collective import Group
+from ..mesh import ProcessMesh
+
+
+class ParallelMode:
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+_AXIS_TO_MESH_NAME = {
+    "data": "dp", "pipe": "pp", "sharding": "sharding", "sep": "sep", "model": "mp",
+}
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "sep", "model"),
+                 dims=(1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = None
+        self._world = np.arange(int(np.prod(self._dims))).reshape(self._dims)
+
+    def get_hybrid_group_names(self):
+        return list(self._parallel_names)
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return int(self._world.size)
+
+    def get_rank(self, **kwargs):
+        coords = [kwargs[n] for n in self._parallel_names]
+        return int(self._world[tuple(coords)])
+
+    def get_coord(self, rank):
+        coords = np.argwhere(self._world == rank)[0]
+        return dict(zip(self._parallel_names, (int(c) for c in coords)))
+
+    def get_axis_list(self, axis_name, index):
+        axis = self._parallel_names.index(axis_name)
+        taken = np.take(self._world, index, axis=axis)
+        return [int(r) for r in taken.reshape(-1)]
+
+    def get_comm_list(self, axis_name):
+        axis = self._parallel_names.index(axis_name)
+        moved = np.moveaxis(self._world, axis, -1).reshape(-1, self._dims[axis])
+        return [[int(r) for r in row] for row in moved]
+
+
+class HybridCommunicateGroup:
+    """Per-axis rank/size/group accessors (reference topology.py:189). In the
+    single-controller GSPMD model this process sees the whole mesh, so the
+    'rank' accessors report rank 0 of each axis; the mesh itself drives real
+    placement."""
+
+    def __init__(self, topology: CommunicateTopology):
+        self._topo = topology
+        self.global_rank = 0
+        self._dp_degree = topology.get_dim("data")
+        self._pp_degree = topology.get_dim("pipe")
+        self._sharding_degree = topology.get_dim("sharding")
+        self._sep_degree = topology.get_dim("sep")
+        self._mp_degree = topology.get_dim("model")
+        mesh_axes = []
+        mesh_dims = []
+        for name in topology.get_hybrid_group_names():
+            size = topology.get_dim(name)
+            mesh_axes.append(_AXIS_TO_MESH_NAME[name])
+            mesh_dims.append(size)
+        self._mesh = ProcessMesh(shape=mesh_dims, dim_names=mesh_axes)
+        self._groups: Dict[str, Group] = {
+            ax: Group(ranks=list(range(topology.get_dim(name))), axis_name=ax)
+            for name, ax in _AXIS_TO_MESH_NAME.items()
+        }
+
+    # -- mesh bridge --------------------------------------------------------
+    @property
+    def mesh(self) -> ProcessMesh:
+        return self._mesh
+
+    def topology(self):
+        return self._topo
+
+    def get_parallel_mode(self):
+        if self._pp_degree > 1:
+            return ParallelMode.PIPELINE_PARALLEL
+        if self._sharding_degree > 1:
+            return ParallelMode.SHARDING_PARALLEL
+        if self._mp_degree > 1:
+            return ParallelMode.TENSOR_PARALLEL
+        if self._sep_degree > 1:
+            return ParallelMode.SEGMENT_PARALLEL
+        return ParallelMode.DATA_PARALLEL
+
+    # -- per-axis accessors (reference API names) ---------------------------
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_data_parallel_group(self):
+        return self._groups["dp"]
+
+    def get_data_parallel_group_src_rank(self):
+        return 0
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_group(self):
+        return self._groups["mp"]
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_stage_id(self):
+        return 0
+
+    def get_pipe_parallel_group(self):
+        return self._groups["pp"]
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    def get_sharding_parallel_group(self):
+        return self._groups["sharding"]
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    def get_sep_parallel_rank(self):
+        return 0
+
+    def get_sep_parallel_group(self):
+        return self._groups["sep"]
+
+    def get_check_parallel_group(self, sharding=False):
+        return self._groups["mp"]
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        return self._topo.get_rank(data=0, pipe=stage_id, sharding=0, sep=0, model=0)
+
+
+_hcg: Optional[HybridCommunicateGroup] = None
+
+
+def _set_hcg(hcg: HybridCommunicateGroup):
+    global _hcg
+    _hcg = hcg
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _hcg

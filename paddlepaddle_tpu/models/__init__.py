@@ -10,9 +10,22 @@ define-by-run code runs eagerly and traces to one XLA program via
 * moe    — Mixtral/DeepSeekMoE-style expert-parallel LM (config 5)
 """
 
+from .bert import (  # noqa: F401
+    BertConfig,
+    BertForSequenceClassification,
+    BertModel,
+)
 from .llama import (  # noqa: F401
     LlamaConfig,
     LlamaForCausalLM,
     LlamaModel,
     llama_sharding_rules,
+)
+from .resnet import (  # noqa: F401
+    ResNet,
+    resnet18,
+    resnet34,
+    resnet50,
+    resnet101,
+    resnet152,
 )

@@ -1,0 +1,123 @@
+"""BERT encoder for sequence classification — BASELINE config 1.
+
+Reference surface: python/paddle/nn/layer/transformer.py (TransformerEncoder)
+as used by PaddleNLP's BertModel/BertForSequenceClassification recipe.
+TPU-native: same Layer code traces to one XLA program; attention uses the
+shared flash-attention path; everything static-shape, bf16-capable.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn.common import Dropout, Embedding, Linear
+from ..nn.container import LayerList
+from ..nn.layer import Layer
+from ..nn.norm import LayerNorm
+from ..nn.transformer import TransformerEncoder, TransformerEncoderLayer
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    layer_norm_eps: float = 1e-12
+    num_labels: int = 2
+
+    @staticmethod
+    def base() -> "BertConfig":
+        return BertConfig()
+
+    @staticmethod
+    def tiny(vocab_size=128, hidden_size=32, layers=2, heads=2) -> "BertConfig":
+        return BertConfig(vocab_size=vocab_size, hidden_size=hidden_size,
+                          num_hidden_layers=layers, num_attention_heads=heads,
+                          intermediate_size=hidden_size * 4,
+                          max_position_embeddings=64)
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.word_embeddings = Embedding(config.vocab_size, config.hidden_size)
+        self.position_embeddings = Embedding(config.max_position_embeddings, config.hidden_size)
+        self.token_type_embeddings = Embedding(config.type_vocab_size, config.hidden_size)
+        self.layer_norm = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None):
+        seq = input_ids.shape[1]
+        pos = apply_op(lambda: jnp.arange(seq, dtype=jnp.int64)[None, :])
+        x = self.word_embeddings(input_ids) + self.position_embeddings(pos)
+        if token_type_ids is not None:
+            x = x + self.token_type_embeddings(token_type_ids)
+        else:
+            x = x + self.token_type_embeddings.weight[0]
+        return self.dropout(self.layer_norm(x))
+
+
+class BertPooler(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.dense = Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, hidden):
+        return F.tanh(self.dense(hidden[:, 0]))
+
+
+class BertModel(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        self.encoder = TransformerEncoder(
+            TransformerEncoderLayer(
+                d_model=config.hidden_size,
+                nhead=config.num_attention_heads,
+                dim_feedforward=config.intermediate_size,
+                dropout=config.hidden_dropout_prob,
+                activation="gelu",
+                attn_dropout=config.attention_probs_dropout_prob,
+                normalize_before=False,
+            ),
+            config.num_hidden_layers,
+        )
+        self.pooler = BertPooler(config)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids)
+        if attention_mask is not None:
+            # [b, s] 1/0 mask -> additive [b, 1, 1, s]
+            attention_mask = apply_op(
+                lambda m: (1.0 - m[:, None, None, :].astype(jnp.float32)) * -1e9,
+                attention_mask)
+        x = self.encoder(x, src_mask=attention_mask)
+        return x, self.pooler(x)
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.bert = BertModel(config)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+        self.classifier = Linear(config.hidden_size, config.num_labels)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None, labels=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is None:
+            return logits
+        return F.cross_entropy(logits, labels)

@@ -165,11 +165,14 @@ class LlamaModel(Layer):
         self.embed_tokens = Embedding(config.vocab_size, config.hidden_size)
         self.layers = LayerList([LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)])
         self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        if config.dtype != "float32":
+            self.to(dtype=config.dtype)
+        # rope tables registered AFTER the dtype cast: they must stay fp32
+        # (the reference keeps rotary tables fp32; casting to the activation
+        # dtype happens per-use inside _apply_rope)
         cos, sin = _rope_cos_sin(config)
         self.register_buffer("rope_cos", Tensor(cos), persistable=False)
         self.register_buffer("rope_sin", Tensor(sin), persistable=False)
-        if config.dtype != "float32":
-            self.to(dtype=config.dtype)
 
     def forward(self, input_ids, attn_mask=None):
         x = self.embed_tokens(input_ids)
@@ -188,8 +191,8 @@ class LlamaForCausalLM(Layer):
             self.lm_head = None
         else:
             self.lm_head = Linear(config.hidden_size, config.vocab_size, bias_attr=False)
-        if config.dtype != "float32":
-            self.to(dtype=config.dtype)
+            if config.dtype != "float32":
+                self.lm_head.to(dtype=config.dtype)
 
     def forward(self, input_ids, labels=None, attn_mask=None):
         hidden = self.model(input_ids, attn_mask)

@@ -7,6 +7,15 @@ device mesh with named axes; placements are ``jax.sharding.PartitionSpec``s
 and every collective is emitted by XLA from shardings (SURVEY.md §7).
 """
 
+from .mpu import (  # noqa: F401
+    ColumnParallelLinear,
+    ColumnSequenceParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    RowSequenceParallelLinear,
+    VocabParallelEmbedding,
+    mark_placement,
+)
 from .sharded import (  # noqa: F401
     ShardedTrainStep,
     match_sharding_rules,

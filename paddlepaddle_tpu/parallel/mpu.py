@@ -1,0 +1,198 @@
+"""Tensor-parallel (mpu) layers — fleet.layers.mpu parity, GSPMD mechanics.
+
+Reference surface: python/paddle/distributed/fleet/layers/mpu/mp_layers.py
+(VocabParallelEmbedding:49, ColumnParallelLinear:336, RowParallelLinear:543,
+ParallelCrossEntropy:744) + mp_ops.py (_c_identity/_c_split/_mp_allreduce)
+and sequence_parallel_utils.py:85-157.
+
+TPU-native design: instead of manually slicing weights per rank and issuing
+NCCL collectives, each layer attaches a GSPMD placement to its parameters
+(``Parameter.dist_spec``, consumed by parallel.ShardedTrainStep /
+shard_tensor) and constrains its activations; the XLA partitioner inserts the
+identity/allreduce/allgather that mp_ops.py implements by hand. The layer
+code is therefore mesh-size-agnostic — the same program runs on 1 chip or a
+pod slice.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.dispatch import apply_op
+from ..nn import functional as F
+from ..nn.common import Linear
+from ..nn.initializer import Normal, XavierNormal
+from ..nn.layer import Layer
+
+
+def mark_placement(param, spec):
+    """Attach a GSPMD placement (tuple of mesh-axis names / None per dim) to a
+    parameter; picked up by ShardedTrainStep ahead of its regex rule table."""
+    param.dist_spec = tuple(spec)
+    return param
+
+
+def _constraint(x, spec_entries):
+    """with_sharding_constraint under an active mesh; no-op otherwise."""
+
+    def f(a):
+        mesh = _current_mesh()
+        if mesh is None:
+            return a
+        entries = [e if (e is None or (isinstance(e, str) and e in mesh.shape)) else None
+                   for e in spec_entries[: a.ndim]]
+        return jax.lax.with_sharding_constraint(
+            a, NamedSharding(mesh, P(*entries)))
+
+    return apply_op(f, x, op_name="sharding_constraint")
+
+
+def _current_mesh():
+    """Active jax mesh: the ``with mesh:`` context if entered, else the
+    process-global ProcessMesh set via distributed.set_mesh / fleet.init."""
+    from jax._src import mesh as mesh_lib
+
+    concrete = mesh_lib.thread_resources.env.physical_mesh
+    if concrete is not None and concrete.size > 0:
+        return concrete
+    from ..distributed.mesh import get_mesh
+
+    pm = get_mesh()
+    return pm.to_jax() if pm is not None else None
+
+
+class ColumnParallelLinear(Layer):
+    """y = xW, W:[in, out] sharded on the OUT dim over the mp axis.
+
+    gather_output=True replicates y (the reference's allgather); otherwise y
+    stays sharded on its last dim for a following RowParallelLinear.
+    Reference: mp_layers.py:336."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 gather_output=True, fuse_matmul_bias=False, mp_group=None,
+                 name=None, mp_axis="mp"):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.mp_axis = mp_axis
+        self.weight = mark_placement(
+            self.create_parameter([in_features, out_features], attr=weight_attr,
+                                  default_initializer=XavierNormal()),
+            (None, mp_axis))
+        self.bias = (
+            mark_placement(self.create_parameter([out_features], is_bias=True), (mp_axis,))
+            if has_bias else None
+        )
+
+    def forward(self, x):
+        y = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            return _constraint(y, [None] * 8)
+        return _constraint(y, [None] * (y.ndim - 1) + [self.mp_axis])
+
+
+class RowParallelLinear(Layer):
+    """y = xW, W:[in, out] sharded on the IN dim over the mp axis; the
+    contraction over the sharded dim makes XLA emit the mp allreduce the
+    reference issues manually. Reference: mp_layers.py:543."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 input_is_parallel=False, fuse_matmul_bias=False, mp_group=None,
+                 name=None, mp_axis="mp"):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.mp_axis = mp_axis
+        self.weight = mark_placement(
+            self.create_parameter([in_features, out_features], attr=weight_attr,
+                                  default_initializer=XavierNormal()),
+            (mp_axis, None))
+        self.bias = (
+            self.create_parameter([out_features], is_bias=True) if has_bias else None
+        )
+
+    def forward(self, x):
+        if not self.input_is_parallel:
+            x = _constraint(x, [None] * (x.ndim - 1) + [self.mp_axis])
+        y = F.linear(x, self.weight, self.bias)
+        return _constraint(y, [None] * 8)
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim sharded over mp (reference: mp_layers.py:49)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None, mp_axis="mp"):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.weight = mark_placement(
+            self.create_parameter([num_embeddings, embedding_dim], attr=weight_attr,
+                                  default_initializer=Normal(0.0, 1.0)),
+            (mp_axis, None))
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+class ParallelCrossEntropy(Layer):
+    """Softmax CE over class-dim-sharded logits (reference: mp_layers.py:744).
+
+    GSPMD computes the log-sum-exp reduction over the sharded class dim with
+    an ICI allreduce automatically — no custom c_softmax_with_cross_entropy
+    kernel needed."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return F.softmax_with_cross_entropy(
+            input, label, ignore_index=self.ignore_index, return_softmax=False)
+
+
+# ---------------------------------------------------------------------------
+# Sequence parallel (Megatron SP over activations)
+# Reference: python/paddle/distributed/fleet/utils/sequence_parallel_utils.py
+# ---------------------------------------------------------------------------
+
+
+def scatter_to_sequence_parallel(x, mp_axis="mp"):
+    """[b, s, h] -> sequence dim sharded over mp (reference ScatterOp:85)."""
+    return _constraint(x, [None, mp_axis, None])
+
+
+def gather_from_sequence_parallel(x, mp_axis="mp"):
+    """Undo SP sharding (reference GatherOp / AllGatherOp:113)."""
+    return _constraint(x, [None] * 8)
+
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    """Column-parallel linear whose input arrives sequence-sharded; XLA fuses
+    the allgather(seq)+matmul (reference: sequence_parallel_utils.py:257)."""
+
+    def forward(self, x):
+        x = gather_from_sequence_parallel(x, self.mp_axis)
+        return super().forward(x)
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    """Row-parallel linear producing a sequence-sharded output — XLA emits
+    reduce_scatter instead of allreduce (reference: sequence_parallel_utils.py:429)."""
+
+    def forward(self, x):
+        y = F.linear(x, self.weight, self.bias)
+        return scatter_to_sequence_parallel(y, self.mp_axis)
+
+
+def mark_as_sequence_parallel_parameter(param):
+    """SP params (norms) get allreduced grads across mp in the reference
+    (register_sequence_parallel_allreduce_hooks); under GSPMD replicated
+    params already produce summed grads — keep for API parity."""
+    return param

@@ -1,0 +1,95 @@
+"""SPMD pipeline — the multi-chip execution path for pipeline parallelism.
+
+This is the TPU-native replacement for the reference's NCCL p2p pipeline
+runtime (pipeline_parallel.py send/recv_forward + 1F1B scheduling): a
+``shard_map`` over the 'pp' mesh axis where every stage runs the SAME block
+program with ITS slice of stage-stacked weights, microbatch activations
+stream between neighbor stages via ``lax.ppermute`` over ICI, and the whole
+GPipe loop is one differentiable ``lax.scan`` — ``jax.grad`` of it IS the
+backward pipeline (reverse scan + reverse permutes), scheduled by XLA.
+
+Requires homogeneous middle stages (identical block structure), which is how
+transformer LMs are pipelined in practice; embed/head run outside the loop.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map as _shard_map  # jax >= 0.7 name
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def stack_stage_params(per_stage_params: Sequence[dict]) -> dict:
+    """[S trees with same structure] -> one tree with leading stage dim
+    (shard it on the 'pp' axis)."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, axis=0), *per_stage_params)
+
+
+def spmd_pipeline(stacked_params, acts, block_fn: Callable, mesh: Mesh,
+                  n_microbatches: int, pp_axis: str = "pp",
+                  data_axis=None):
+    """Run ``block_fn(stage_params, activations)`` through S pipeline stages.
+
+    Args:
+        stacked_params: pytree, each leaf [S, ...] (stage-major; shard dim 0
+            over ``pp_axis``). Inside the loop each stage sees its own slice.
+        acts: [B, ...] activations entering stage 0 (post-embedding).
+        block_fn: (params_one_stage, acts_mb) -> acts_mb; the per-stage program.
+        n_microbatches: M; B must divide by M.
+        data_axis: optional mesh axis name the batch dim is sharded over (DP
+            composed with PP).
+    Returns [B, ...] activations leaving the last stage (replicated over pp).
+    """
+    S = mesh.shape[pp_axis]
+    M = int(n_microbatches)
+    B = acts.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    mb = B // M
+    x_mb = acts.reshape(M, mb, *acts.shape[1:])
+    pad = jnp.zeros((S - 1, mb) + tuple(acts.shape[1:]), acts.dtype)
+    xs = jnp.concatenate([x_mb, pad], axis=0)  # [M+S-1, mb, ...]
+
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def per_stage(params, xs_local):
+        stage = jax.lax.axis_index(pp_axis)
+        p_local = jax.tree_util.tree_map(lambda a: a[0], params)
+
+        out_aval = jax.eval_shape(block_fn, p_local, xs_local[0])
+        if out_aval.shape != xs_local[0].shape:
+            raise ValueError(
+                f"pipeline block must preserve activation shape, got "
+                f"{xs_local[0].shape} -> {out_aval.shape}")
+
+        def step(state, xt):
+            inj = jnp.where(stage == 0, xt.astype(out_aval.dtype), state)
+            out = block_fn(p_local, inj).astype(out_aval.dtype)
+            nxt = jax.lax.ppermute(out, pp_axis, perm)
+            return nxt, out
+
+        state0 = jnp.zeros(out_aval.shape, out_aval.dtype)
+        _, ys = jax.lax.scan(step, state0, xs_local)
+        # stage S-1 finishes microbatch m at loop step m+S-1
+        outs = ys[S - 1:]
+        outs = jnp.where(stage == S - 1, outs, jnp.zeros_like(outs))
+        return jax.lax.psum(outs, pp_axis)  # replicate result over pp
+
+    ndim_rest = acts.ndim - 1
+    p_specs = jax.tree_util.tree_map(lambda _: P(pp_axis), stacked_params)
+    x_spec = P(None, data_axis, *([None] * (ndim_rest - 1)))
+
+    out = _shard_map(
+        per_stage, mesh=mesh,
+        in_specs=(p_specs, x_spec),
+        out_specs=x_spec,
+        check_vma=False,
+    )(stacked_params, xs)
+    return out.reshape(B, *acts.shape[1:])

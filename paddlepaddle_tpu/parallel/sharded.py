@@ -67,12 +67,21 @@ def match_sharding_rules(name: str, shape, rules, mesh: Mesh) -> P:
     return P()
 
 
-def param_shardings(params: Dict[str, jax.Array], rules, mesh) -> Dict[str, NamedSharding]:
+def param_shardings(params: Dict[str, jax.Array], rules, mesh,
+                    handles: Optional[dict] = None) -> Dict[str, NamedSharding]:
+    """Per-param NamedSharding: an explicit ``Parameter.dist_spec`` (set by
+    mpu/TP layers) wins over the regex rule table."""
     mesh = _as_jax_mesh(mesh)
-    return {
-        n: NamedSharding(mesh, match_sharding_rules(n, p.shape, rules, mesh))
-        for n, p in params.items()
-    }
+    out = {}
+    for n, p in params.items():
+        spec = None
+        h = handles.get(n) if handles else None
+        if h is not None and getattr(h, "dist_spec", None) is not None:
+            spec = _fit_spec(h.dist_spec, p.shape, mesh)
+        if spec is None:
+            spec = match_sharding_rules(n, p.shape, rules, mesh)
+        out[n] = NamedSharding(mesh, spec)
+    return out
 
 
 class ShardedTrainStep:
@@ -101,7 +110,8 @@ class ShardedTrainStep:
         params = model.functional_state(trainable_only=True)
         self.buffers = {k: v for k, v in model.functional_state().items()
                         if k not in params}
-        self._param_sh = param_shardings(params, self.rules, self.mesh)
+        self._param_sh = param_shardings(params, self.rules, self.mesh,
+                                         handles=model.raw_state())
         repl = NamedSharding(self.mesh, P())
 
         # place params / buffers / optimizer state on the mesh
@@ -177,8 +187,11 @@ class ShardedTrainStep:
         )
         key = prandom.next_key()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        self.params, self.opt_state, loss = self._step(
-            self.params, self.buffers, self.opt_state, batch_arrays, key, lr)
+        # enter the mesh context so activation sharding constraints inside
+        # layer code (parallel.mpu._constraint) resolve axis names at trace
+        with self.mesh:
+            self.params, self.opt_state, loss = self._step(
+                self.params, self.buffers, self.opt_state, batch_arrays, key, lr)
         self._step_count += 1
         return Tensor._from_data(loss)
 

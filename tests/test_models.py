@@ -1,0 +1,63 @@
+"""BERT (BASELINE config 1) and ResNet (config 2) smoke + training tests."""
+
+import numpy as np
+
+import paddlepaddle_tpu as paddle
+from paddlepaddle_tpu.models import (
+    BertConfig,
+    BertForSequenceClassification,
+    resnet18,
+)
+
+
+def test_bert_forward_and_loss():
+    m = BertForSequenceClassification(BertConfig.tiny())
+    ids = np.random.default_rng(0).integers(0, 128, (2, 16)).astype(np.int32)
+    logits = m(ids)
+    assert logits.shape == [2, 2]
+    labels = np.array([0, 1], np.int64)
+    loss = m(ids, labels=labels)
+    assert np.isfinite(float(loss.numpy()))
+
+
+def test_bert_train_step_decreases():
+    from paddlepaddle_tpu.jit.train import TrainStep
+    from paddlepaddle_tpu.optimizer import AdamW
+
+    m = BertForSequenceClassification(BertConfig.tiny())
+    opt = AdamW(learning_rate=1e-3, parameters=m.parameters())
+    step = TrainStep(m, opt, lambda mm, ids, labels: mm(ids, labels=labels))
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 128, (8, 16)).astype(np.int32)
+    labels = rng.integers(0, 2, (8,)).astype(np.int64)
+    losses = [float(step(ids, labels).numpy()) for _ in range(8)]
+    assert losses[-1] < losses[0]
+
+
+def test_bert_attention_mask():
+    m = BertForSequenceClassification(BertConfig.tiny())
+    m.eval()
+    ids = np.random.default_rng(0).integers(0, 128, (1, 8)).astype(np.int32)
+    mask = np.ones((1, 8), np.float32)
+    out = m(ids, attention_mask=mask)
+    assert np.isfinite(out.numpy()).all()
+
+
+def test_resnet18_forward_train_eval():
+    m = resnet18(num_classes=10)
+    x = np.random.default_rng(0).standard_normal((2, 3, 32, 32)).astype(np.float32)
+    out = m(x)
+    assert out.shape == [2, 10]
+    m.eval()
+    out_eval = m(x)
+    assert np.isfinite(out_eval.numpy()).all()
+
+
+def test_resnet_backward():
+    m = resnet18(num_classes=4)
+    x = np.random.default_rng(0).standard_normal((2, 3, 32, 32)).astype(np.float32)
+    labels = np.array([0, 1], np.int64)
+    loss = paddle.nn.functional.cross_entropy(m(x), labels)
+    loss.backward()
+    g = m.conv1.weight.grad
+    assert g is not None and float(np.abs(g.numpy()).sum()) > 0
