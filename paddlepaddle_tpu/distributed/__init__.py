@@ -27,6 +27,7 @@ from .env import (  # noqa: F401
     is_initialized,
     parallel_device_count,
 )
+from . import checkpoint  # noqa: F401
 from . import fleet  # noqa: F401
 from .mesh import ProcessMesh, auto_mesh, get_mesh, set_mesh  # noqa: F401
 from .parallel import DataParallel  # noqa: F401

@@ -21,6 +21,10 @@ from .llama import (  # noqa: F401
     LlamaModel,
     llama_sharding_rules,
 )
+from .moe import (  # noqa: F401
+    MoEConfig,
+    MoEForCausalLM,
+)
 from .resnet import (  # noqa: F401
     ResNet,
     resnet18,

@@ -1,0 +1,115 @@
+"""MoE decoder LM — DeepSeekMoE / Qwen2-MoE style (BASELINE config 5).
+
+Reference recipe semantics: PaddleNLP MoE llm configs over the incubate MoE
+layer (python/paddle/incubate/distributed/models/moe/). Reuses the Llama
+attention stack; the dense MLP is replaced by parallel.moe.MoELayer with an
+optional shared expert (DeepSeekMoE's always-on expert).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..core.tensor import Tensor
+from ..nn.common import Embedding, Linear
+from ..nn.container import LayerList
+from ..nn.layer import Layer
+from ..nn.norm import RMSNorm
+from ..parallel.moe import GShardGate, MoELayer, SwitchGate
+from .llama import LlamaAttention, LlamaConfig, LlamaForCausalLM, LlamaMLP, _rope_cos_sin
+
+
+@dataclass
+class MoEConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 2048
+    intermediate_size: int = 1408      # per-expert FFN width
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
+    num_key_value_heads: int = 16
+    num_experts: int = 64
+    num_experts_per_tok: int = 2
+    num_shared_experts: int = 0        # DeepSeekMoE shared expert width multiplier
+    capacity_factor: float = 1.25
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    aux_loss_weight: float = 0.01
+    dtype: str = "float32"
+
+    def as_llama(self) -> LlamaConfig:
+        return LlamaConfig(
+            vocab_size=self.vocab_size, hidden_size=self.hidden_size,
+            intermediate_size=self.intermediate_size,
+            num_hidden_layers=self.num_hidden_layers,
+            num_attention_heads=self.num_attention_heads,
+            num_key_value_heads=self.num_key_value_heads,
+            max_position_embeddings=self.max_position_embeddings,
+            rms_norm_eps=self.rms_norm_eps, rope_theta=self.rope_theta,
+            dtype=self.dtype)
+
+    @staticmethod
+    def tiny(vocab_size=128, hidden_size=32, layers=2, heads=4, experts=4,
+             topk=2, max_len=64) -> "MoEConfig":
+        return MoEConfig(vocab_size=vocab_size, hidden_size=hidden_size,
+                         intermediate_size=hidden_size * 2,
+                         num_hidden_layers=layers, num_attention_heads=heads,
+                         num_key_value_heads=heads, num_experts=experts,
+                         num_experts_per_tok=topk,
+                         max_position_embeddings=max_len)
+
+
+class MoEDecoderLayer(Layer):
+    def __init__(self, config: MoEConfig):
+        super().__init__()
+        lcfg = config.as_llama()
+        self.input_layernorm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        self.self_attn = LlamaAttention(lcfg)
+        self.post_attention_layernorm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        gate_cls = SwitchGate if config.num_experts_per_tok == 1 else GShardGate
+        self.mlp = MoELayer(
+            config.hidden_size, config.intermediate_size, config.num_experts,
+            gate=gate_cls(config.hidden_size, config.num_experts),
+            capacity_factor=config.capacity_factor)
+        self.shared_mlp = None
+        if config.num_shared_experts > 0:
+            import dataclasses
+
+            shared_cfg = dataclasses.replace(
+                lcfg, intermediate_size=config.intermediate_size * config.num_shared_experts)
+            self.shared_mlp = LlamaMLP(shared_cfg)
+
+    def forward(self, x, cos, sin, attn_mask=None):
+        x = x + self.self_attn(self.input_layernorm(x), cos, sin, attn_mask)
+        h = self.post_attention_layernorm(x)
+        y = self.mlp(h)
+        if self.shared_mlp is not None:
+            y = y + self.shared_mlp(h)
+        return x + y
+
+
+class MoEForCausalLM(Layer):
+    def __init__(self, config: MoEConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = Embedding(config.vocab_size, config.hidden_size)
+        self.layers = LayerList([MoEDecoderLayer(config) for _ in range(config.num_hidden_layers)])
+        self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        self.lm_head = Linear(config.hidden_size, config.vocab_size, bias_attr=False)
+        cos, sin = _rope_cos_sin(config.as_llama())
+        self.register_buffer("rope_cos", Tensor(cos), persistable=False)
+        self.register_buffer("rope_sin", Tensor(sin), persistable=False)
+
+    def forward(self, input_ids, labels=None, attn_mask=None):
+        x = self.embed_tokens(input_ids)
+        for layer in self.layers:
+            x = layer(x, self.rope_cos, self.rope_sin, attn_mask)
+        logits = self.lm_head(self.norm(x))
+        if labels is None:
+            return logits
+        loss = LlamaForCausalLM.loss_from_logits(logits, labels)
+        if self.config.aux_loss_weight:
+            for layer in self.layers:
+                if layer.mlp.l_aux is not None:
+                    loss = loss + self.config.aux_loss_weight * layer.mlp.l_aux
+        return loss
