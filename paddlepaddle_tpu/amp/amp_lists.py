@@ -16,6 +16,7 @@ WHITE_LIST = {
     "conv2d_transpose",
     "einsum",
     "flash_attention",
+    "ring_flash_attention",
     "addmm",
 }
 

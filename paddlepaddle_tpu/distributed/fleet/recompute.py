@@ -1,0 +1,102 @@
+"""Recompute (activation checkpointing) — reference:
+python/paddle/distributed/fleet/recompute/recompute.py:459 (recompute) and
+:626 (recompute_sequential), plus paddle.distributed.recompute alias.
+
+TPU-native mechanics: ``jax.checkpoint`` (remat). The recomputed region
+becomes ONE tape op whose vjp re-runs the forward — exactly the reference's
+RecomputeFunction PyLayer, with XLA scheduling the recomputation instead of a
+Python autograd hook. RNG inside the region replays automatically because the
+region draws keys from the same scoped stream on both passes (the analogue of
+the reference's preserve_rng_state=True state stashing).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from ...core import autograd as ag
+from ...core.dispatch import apply_op
+from ...nn.layer import Layer
+
+
+def recompute(function, *args, **kwargs) -> Any:
+    """Run ``function(*args)`` without saving interior activations; they are
+    rematerialized during backward.
+
+    * ``function`` is a Layer (the common case — a transformer block): its
+      parameters join the remat region as explicit differentiable inputs, so
+      eager-tape grads flow to them and under jit the region is a
+      jax.checkpoint whose residuals are just (params, inputs).
+    * For a plain callable under the eager tape, the call runs un-rematted
+      (the tape would not see parameters hidden in the closure); under a
+      traced train step it still remats via jax.checkpoint.
+    """
+    use_reentrant = kwargs.pop("use_reentrant", True)  # API parity; one path
+    preserve_rng_state = kwargs.pop("preserve_rng_state", True)  # noqa: F841
+
+    if isinstance(function, Layer):
+        params = dict(function.raw_state())
+
+        def pure(p, *arrs):
+            with ag.no_grad(), function.bind_state(p):
+                out = function(*arrs, **kwargs)
+            return jax.tree_util.tree_map(
+                lambda t: t._data if hasattr(t, "_data") else t, out,
+                is_leaf=lambda t: hasattr(t, "_data"))
+
+        return apply_op(jax.checkpoint(pure), params, *args, op_name="recompute")
+
+    if ag.is_grad_enabled():
+        # plain callable on the eager tape: run as-is (correct grads, no
+        # memory saving — eager memory is host-managed anyway)
+        return function(*args, **kwargs)
+
+    def pure_fn(*arrs):
+        with ag.no_grad():
+            out = function(*arrs, **kwargs)
+        return jax.tree_util.tree_map(
+            lambda t: t._data if hasattr(t, "_data") else t, out,
+            is_leaf=lambda t: hasattr(t, "_data"))
+
+    return apply_op(jax.checkpoint(pure_fn), *args, op_name="recompute")
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Recompute a Sequential in ``segments`` chunks (reference :626).
+
+    ``functions`` may be a Layer (its children are chained) or a list mixing
+    Layers and plain callables; extra positional args feed the FIRST chunk,
+    later chunks are single-input chains (reference semantics)."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else int(ctx or 1)
+    sublayers = list(functions) if not isinstance(functions, Layer) else list(functions.children())
+    if not sublayers:
+        return functions(*args, **kwargs)
+    per = max(1, len(sublayers) // max(1, segments))
+    out = args
+    i = 0
+    while i < len(sublayers):
+        chunk = sublayers[i:i + per]
+        seq = _Chain(chunk)
+        out = (recompute(seq, *out, **kwargs),)
+        i += per
+    return out[0]
+
+
+class _Chain(Layer):
+    """Chain of Layers and/or plain callables; first link gets all inputs."""
+
+    def __init__(self, links):
+        super().__init__()
+        for j, l in enumerate(links):
+            if isinstance(l, Layer):
+                self.add_sublayer(str(j), l)
+        self._chain = links
+
+    def forward(self, *args, **kwargs):
+        first, rest = self._chain[0], self._chain[1:]
+        x = first(*args, **kwargs)
+        for l in rest:
+            x = l(x)
+        return x

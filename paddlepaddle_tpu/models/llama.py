@@ -48,6 +48,10 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = False
     dtype: str = "float32"
+    # context parallelism: shard the sequence dim over this mesh axis and run
+    # ring attention over ICI (exceeds the reference, which has no ring attn)
+    context_parallel_axis: Optional[str] = None
+    data_parallel_axis: str = "dp"  # batch-dim axis inside the ring shard_map
 
     @property
     def head_dim(self) -> int:
@@ -128,7 +132,22 @@ class LlamaAttention(Layer):
             rep = self.num_heads // self.num_kv_heads
             k = apply_op(lambda a: jnp.repeat(a, rep, axis=2), k)
             v = apply_op(lambda a: jnp.repeat(a, rep, axis=2), v)
-        out, _ = F.flash_attention(q, k, v, causal=True)
+        if self.config.context_parallel_axis is not None:
+            from ..ops.kernels.ring_attention import ring_flash_attention
+
+            if attn_mask is not None:
+                raise NotImplementedError(
+                    "ring attention supports causal masking only; pad-free "
+                    "batches (or dense attention) are required under context "
+                    "parallelism")
+            out = ring_flash_attention(q, k, v, causal=True,
+                                       sp_axis=self.config.context_parallel_axis,
+                                       data_axis=self.config.data_parallel_axis)
+        elif attn_mask is not None:
+            out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                                 is_causal=True)
+        else:
+            out, _ = F.flash_attention(q, k, v, causal=True)
         return self.o_proj(out.reshape([b, s, -1]))
 
 
@@ -206,14 +225,22 @@ class LlamaForCausalLM(Layer):
 
     @staticmethod
     def loss_from_logits(logits, labels):
-        """Next-token CE in fp32 over bf16 logits; labels == -100 ignored."""
+        """Next-token CE in fp32 over bf16 logits; labels == -100 ignored.
+
+        Shape-preserving formulation (roll + position mask instead of the
+        usual [:-1]/[1:] slices): slicing one element off a sharded sequence
+        dim makes it unevenly sharded, which both costs a reshard and crashes
+        XLA's SPMD partitioner under context parallelism; roll lowers to a
+        collective-permute and keeps every tensor evenly sharded."""
 
         def f(lg, lb):
-            lg = lg[:, :-1, :].astype(jnp.float32)
-            lb = lb[:, 1:]
+            seq = lg.shape[1]
+            lg = lg.astype(jnp.float32)
+            lb_next = jnp.roll(lb, -1, axis=1)           # label for pos t is token t+1
             logp = jax.nn.log_softmax(lg, axis=-1)
-            nll = -jnp.take_along_axis(logp, jnp.maximum(lb, 0)[..., None], axis=-1)[..., 0]
-            valid = (lb >= 0).astype(jnp.float32)
+            nll = -jnp.take_along_axis(logp, jnp.maximum(lb_next, 0)[..., None], axis=-1)[..., 0]
+            pos = jax.lax.broadcasted_iota(jnp.int32, nll.shape, 1)
+            valid = ((lb_next >= 0) & (pos < seq - 1)).astype(jnp.float32)
             return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1.0)
 
         return apply_op(f, logits, labels, op_name="cross_entropy")
