@@ -80,14 +80,19 @@ for _mod in _OP_MODULES:
 from . import amp  # noqa: E402,F401
 from . import autograd  # noqa: E402,F401
 from . import distributed  # noqa: E402,F401
+from . import distribution  # noqa: E402,F401
 from . import framework  # noqa: E402,F401
+from . import hapi  # noqa: E402,F401
 from . import io  # noqa: E402,F401
 from . import jit  # noqa: E402,F401
 from . import linalg  # noqa: E402,F401
 from . import metric  # noqa: E402,F401
+from . import models  # noqa: E402,F401
 from . import nn  # noqa: E402,F401
 from . import optimizer  # noqa: E402,F401
+from . import vision  # noqa: E402,F401
 from .framework.io_api import load, save  # noqa: E402,F401
+from .hapi import Model, summary  # noqa: E402,F401
 from .jit.api import to_static  # noqa: E402,F401
 
 # paddle.device module alias

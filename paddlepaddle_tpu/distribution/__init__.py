@@ -1,0 +1,464 @@
+"""Probability distributions (reference: python/paddle/distribution/ — ~25
+classes with sample/rsample/log_prob/entropy/kl_divergence).
+
+TPU-native: densities/samplers are jnp + jax.random; every method routes
+through the dispatcher so log_prob is differentiable on the eager tape and
+traceable under jit.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as prandom
+from ..core.dispatch import apply_op, unwrap
+from ..core.tensor import Tensor
+
+
+def _as_array(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x, jnp.float32) if not isinstance(x, jax.Array) else x
+
+
+def _shape(sample_shape, *params):
+    base = jnp.broadcast_shapes(*[jnp.shape(p) for p in params]) if params else ()
+    return tuple(sample_shape) + base
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return apply_op(jnp.exp, self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        # keep original Tensor handles so log_prob stays differentiable
+        # w.r.t. distribution parameters on the eager tape
+        self._loc_t = loc if isinstance(loc, Tensor) else None
+        self._scale_t = scale if isinstance(scale, Tensor) else None
+        self.loc = _as_array(loc)
+        self.scale = _as_array(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor._from_data(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor._from_data(jnp.broadcast_to(self.scale ** 2, self.batch_shape))
+
+    def sample(self, shape=()):
+        key = prandom.next_key()
+        out = self.loc + self.scale * jax.random.normal(
+            key, _shape(shape, self.loc, self.scale), jnp.float32)
+        return Tensor._from_data(out)
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        def f(v, loc, scale):
+            var = scale ** 2
+            return -((v - loc) ** 2) / (2 * var) - jnp.log(scale) - 0.5 * math.log(2 * math.pi)
+
+        loc = self._loc_t if self._loc_t is not None else self.loc
+        scale = self._scale_t if self._scale_t is not None else self.scale
+        return apply_op(f, value, loc, scale)
+
+    def entropy(self):
+        def f(scale):
+            return 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(scale)
+
+        return apply_op(f, Tensor._from_data(jnp.broadcast_to(self.scale, self.batch_shape)))
+
+    def cdf(self, value):
+        return apply_op(lambda v: 0.5 * (1 + jax.scipy.special.erf((unwrap(v) - self.loc) / (self.scale * np.sqrt(2)))), value)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _as_array(low)
+        self.high = _as_array(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape, self.high.shape))
+
+    def sample(self, shape=()):
+        key = prandom.next_key()
+        u = jax.random.uniform(key, _shape(shape, self.low, self.high), jnp.float32)
+        return Tensor._from_data(self.low + (self.high - self.low) * u)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def f(v):
+            inside = (v >= self.low) & (v < self.high)
+            return jnp.where(inside, -jnp.log(self.high - self.low), -jnp.inf)
+
+        return apply_op(f, value)
+
+    def entropy(self):
+        return Tensor._from_data(jnp.log(self.high - self.low))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = _as_array(probs)
+        super().__init__(jnp.shape(self.probs))
+
+    def sample(self, shape=()):
+        key = prandom.next_key()
+        return Tensor._from_data(
+            jax.random.bernoulli(key, self.probs, _shape(shape, self.probs)).astype(jnp.float32))
+
+    def log_prob(self, value):
+        def f(v):
+            p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+
+        return apply_op(f, value)
+
+    def entropy(self):
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return Tensor._from_data(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+    @property
+    def mean(self):
+        return Tensor._from_data(self.probs)
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is None and probs is None:
+            raise ValueError("need logits or probs")
+        if logits is not None:
+            self.logits = _as_array(logits)
+        else:
+            self.logits = jnp.log(jnp.clip(_as_array(probs), 1e-9, None))
+        super().__init__(jnp.shape(self.logits)[:-1])
+
+    @property
+    def probs(self):
+        return Tensor._from_data(jax.nn.softmax(self.logits, axis=-1))
+
+    def sample(self, shape=()):
+        key = prandom.next_key()
+        return Tensor._from_data(
+            jax.random.categorical(key, self.logits, shape=tuple(shape) + jnp.shape(self.logits)[:-1]))
+
+    def log_prob(self, value):
+        def f(v):
+            logp = jax.nn.log_softmax(self.logits, axis=-1)
+            v = v.astype(jnp.int32)
+            logp = jnp.broadcast_to(logp, v.shape + logp.shape[-1:])
+            return jnp.take_along_axis(logp, v[..., None], axis=-1)[..., 0]
+
+        return apply_op(f, value)
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return Tensor._from_data(-jnp.sum(jnp.exp(logp) * logp, axis=-1))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _as_array(rate)
+        super().__init__(jnp.shape(self.rate))
+
+    def sample(self, shape=()):
+        key = prandom.next_key()
+        return Tensor._from_data(
+            jax.random.exponential(key, _shape(shape, self.rate)) / self.rate)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        return apply_op(lambda v: jnp.log(self.rate) - self.rate * v, value)
+
+    def entropy(self):
+        return Tensor._from_data(1.0 - jnp.log(self.rate))
+
+    @property
+    def mean(self):
+        return Tensor._from_data(1.0 / self.rate)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_array(loc)
+        self.scale = _as_array(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        key = prandom.next_key()
+        return Tensor._from_data(
+            self.loc + self.scale * jax.random.laplace(key, _shape(shape, self.loc, self.scale)))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        return apply_op(
+            lambda v: -jnp.abs(v - self.loc) / self.scale - jnp.log(2 * self.scale), value)
+
+    def entropy(self):
+        return Tensor._from_data(1.0 + jnp.log(2 * self.scale))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_array(loc)
+        self.scale = _as_array(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        key = prandom.next_key()
+        return Tensor._from_data(
+            self.loc + self.scale * jax.random.gumbel(key, _shape(shape, self.loc, self.scale)))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def f(v):
+            z = (v - self.loc) / self.scale
+            return -(z + jnp.exp(-z)) - jnp.log(self.scale)
+
+        return apply_op(f, value)
+
+    def entropy(self):
+        return Tensor._from_data(jnp.log(self.scale) + 1.0 + np.euler_gamma + jnp.zeros_like(self.scale))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _as_array(concentration)
+        self.rate = _as_array(rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape, self.rate.shape))
+
+    def sample(self, shape=()):
+        key = prandom.next_key()
+        return Tensor._from_data(
+            jax.random.gamma(key, self.concentration,
+                             _shape(shape, self.concentration, self.rate)) / self.rate)
+
+    def log_prob(self, value):
+        def f(v):
+            a, b = self.concentration, self.rate
+            return a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v - jax.scipy.special.gammaln(a)
+
+        return apply_op(f, value)
+
+    @property
+    def mean(self):
+        return Tensor._from_data(self.concentration / self.rate)
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _as_array(alpha)
+        self.beta = _as_array(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape, self.beta.shape))
+
+    def sample(self, shape=()):
+        key = prandom.next_key()
+        return Tensor._from_data(
+            jax.random.beta(key, self.alpha, self.beta, _shape(shape, self.alpha, self.beta)))
+
+    def log_prob(self, value):
+        def f(v):
+            a, b = self.alpha, self.beta
+            lbeta = (jax.scipy.special.gammaln(a) + jax.scipy.special.gammaln(b)
+                     - jax.scipy.special.gammaln(a + b))
+            return (a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) - lbeta
+
+        return apply_op(f, value)
+
+    @property
+    def mean(self):
+        return Tensor._from_data(self.alpha / (self.alpha + self.beta))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _as_array(concentration)
+        super().__init__(jnp.shape(self.concentration)[:-1],
+                         jnp.shape(self.concentration)[-1:])
+
+    def sample(self, shape=()):
+        key = prandom.next_key()
+        return Tensor._from_data(
+            jax.random.dirichlet(key, self.concentration, tuple(shape) + self.batch_shape))
+
+    def log_prob(self, value):
+        def f(v):
+            a = self.concentration
+            return (jnp.sum((a - 1) * jnp.log(v), axis=-1)
+                    + jax.scipy.special.gammaln(jnp.sum(a, -1))
+                    - jnp.sum(jax.scipy.special.gammaln(a), -1))
+
+        return apply_op(f, value)
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_array(loc)
+        self.scale = _as_array(scale)
+        self._normal = Normal(loc, scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        return apply_op(jnp.exp, self._normal.sample(shape))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def f(v):
+            logv = jnp.log(v)
+            var = self.scale ** 2
+            return (-((logv - self.loc) ** 2) / (2 * var)
+                    - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi) - logv)
+
+        return apply_op(f, value)
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _as_array(rate)
+        super().__init__(jnp.shape(self.rate))
+
+    def sample(self, shape=()):
+        key = prandom.next_key()
+        return Tensor._from_data(
+            jax.random.poisson(key, self.rate, _shape(shape, self.rate)).astype(jnp.float32))
+
+    def log_prob(self, value):
+        return apply_op(
+            lambda v: v * jnp.log(self.rate) - self.rate - jax.scipy.special.gammaln(v + 1), value)
+
+    @property
+    def mean(self):
+        return Tensor._from_data(self.rate)
+
+
+class Geometric(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = _as_array(probs)
+        super().__init__(jnp.shape(self.probs))
+
+    def sample(self, shape=()):
+        key = prandom.next_key()
+        u = jax.random.uniform(key, _shape(shape, self.probs))
+        return Tensor._from_data(jnp.floor(jnp.log1p(-u) / jnp.log1p(-self.probs)))
+
+    def log_prob(self, value):
+        return apply_op(lambda v: v * jnp.log1p(-self.probs) + jnp.log(self.probs), value)
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _as_array(probs)
+        super().__init__(jnp.shape(self.probs)[:-1], jnp.shape(self.probs)[-1:])
+
+    def sample(self, shape=()):
+        key = prandom.next_key()
+        n = jnp.shape(self.probs)[-1]
+        draws = jax.random.categorical(
+            key, jnp.log(jnp.clip(self.probs, 1e-9, None)),
+            shape=tuple(shape) + self.batch_shape + (self.total_count,))
+        counts = jax.nn.one_hot(draws, n).sum(axis=-2)
+        return Tensor._from_data(counts)
+
+    def log_prob(self, value):
+        def f(v):
+            logp = jnp.log(jnp.clip(self.probs, 1e-9, None))
+            coeff = (jax.scipy.special.gammaln(jnp.asarray(self.total_count + 1.0))
+                     - jnp.sum(jax.scipy.special.gammaln(v + 1.0), -1))
+            return coeff + jnp.sum(v * logp, -1)
+
+        return apply_op(f, value)
+
+
+# ---------------------------------------------------------------------------
+# KL divergence registry (reference: python/paddle/distribution/kl.py)
+# ---------------------------------------------------------------------------
+
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is None:
+        raise NotImplementedError(f"no KL({type(p).__name__} || {type(q).__name__})")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_p, var_q = p.scale ** 2, q.scale ** 2
+    out = 0.5 * (var_p / var_q + (q.loc - p.loc) ** 2 / var_q - 1.0) + jnp.log(q.scale / p.scale)
+    return Tensor._from_data(out)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    logp = jax.nn.log_softmax(p.logits, -1)
+    logq = jax.nn.log_softmax(q.logits, -1)
+    return Tensor._from_data(jnp.sum(jnp.exp(logp) * (logp - logq), -1))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    pp = jnp.clip(p.probs, 1e-7, 1 - 1e-7)
+    qq = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
+    return Tensor._from_data(pp * (jnp.log(pp) - jnp.log(qq))
+                             + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qq)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return Tensor._from_data(jnp.log((q.high - q.low) / (p.high - p.low)))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    return Tensor._from_data(jnp.log(p.rate / q.rate) + q.rate / p.rate - 1.0)

@@ -1,0 +1,10 @@
+"""hapi — the Keras-like high-level API (reference: python/paddle/hapi/).
+
+``paddle.Model`` wraps a Layer with prepare/fit/evaluate/predict/save/load
+plus callbacks and summary (reference model.py). Training steps run through
+jit.train.TrainStep, so fit() is the compiled XLA path, not op-by-op eager.
+"""
+
+from .callbacks import Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger  # noqa: F401
+from .model import Model  # noqa: F401
+from .summary import summary  # noqa: F401

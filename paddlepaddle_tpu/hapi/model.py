@@ -1,0 +1,210 @@
+"""paddle.Model — fit/evaluate/predict (reference: python/paddle/hapi/model.py).
+
+The train loop compiles ONE train step via jit.train.TrainStep (XLA path)
+instead of the reference's per-op dygraph loop; metrics update on host.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..jit.train import TrainStep
+from ..metric import Metric
+from ..nn.layer import Layer
+from .callbacks import Callback, ProgBarLogger
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _numpy(t):
+    return t.numpy() if isinstance(t, Tensor) else np.asarray(t)
+
+
+class Model:
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._train_step: Optional[TrainStep] = None
+        self.stop_training = False
+
+    # -- setup ---------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        self._train_step = None
+        return self
+
+    def _ensure_step(self):
+        if self._train_step is None:
+            def loss_fn(net, *batch):
+                *inputs, label = batch
+                out = net(*inputs)
+                return self._loss(out, label)
+
+            self._train_step = TrainStep(self.network, self._optimizer, loss_fn)
+        return self._train_step
+
+    # -- one-batch APIs (reference Model.train_batch/eval_batch/predict_batch)
+    def train_batch(self, inputs, labels=None, update=True):
+        step = self._ensure_step()
+        batch = _to_list(inputs) + _to_list(labels)
+        loss = step(*batch)
+        return [float(loss.numpy())]
+
+    def _sync_weights(self):
+        if self._train_step is not None:
+            self._train_step.sync_to_model()
+
+    def eval_batch(self, inputs, labels=None):
+        self._sync_weights()
+        self.network.eval()
+        out = self.network(*_to_list(inputs))
+        loss = self._loss(out, _to_list(labels)[0]) if self._loss else None
+        for m in self._metrics:
+            m.update(*[_numpy(x) for x in _to_list(m.compute(out, *_to_list(labels)))])
+        self.network.train()
+        return [float(loss.numpy())] if loss is not None else []
+
+    def predict_batch(self, inputs):
+        self._sync_weights()
+        self.network.eval()
+        out = self.network(*_to_list(inputs))
+        self.network.train()
+        return [_numpy(o) for o in _to_list(out)]
+
+    # -- loops ----------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        cbks = _to_list(callbacks) or [ProgBarLogger(log_freq, verbose=verbose)]
+        for c in cbks:
+            c.set_model(self)
+        loader = self._as_loader(train_data, batch_size, shuffle, drop_last)
+        self.stop_training = False
+        for c in cbks:
+            c.on_train_begin()
+        history = []
+        for epoch in range(epochs):
+            for c in cbks:
+                c.on_epoch_begin(epoch)
+            logs = {}
+            for step, batch in enumerate(loader):
+                inputs, labels = self._split_batch(batch)
+                for c in cbks:
+                    c.on_train_batch_begin(step)
+                losses = self.train_batch(inputs, labels)
+                logs = {"loss": losses[0], "step": step}
+                for c in cbks:
+                    c.on_train_batch_end(step, logs)
+                if num_iters is not None and step + 1 >= num_iters:
+                    break
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_data, batch_size=batch_size,
+                                          verbose=0, callbacks=cbks)
+                logs.update(eval_logs)
+            for c in cbks:
+                c.on_epoch_end(epoch, logs)
+            history.append(logs)
+            if self.stop_training:
+                break
+        for c in cbks:
+            c.on_train_end()
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        cbks = _to_list(callbacks)
+        for c in cbks:
+            c.set_model(self)
+            c.on_eval_begin()
+        for m in self._metrics:
+            m.reset()
+        loader = self._as_loader(eval_data, batch_size, False, False)
+        losses = []
+        for step, batch in enumerate(loader):
+            inputs, labels = self._split_batch(batch)
+            out = self.eval_batch(inputs, labels)
+            if out:
+                losses.append(out[0])
+            if num_iters is not None and step + 1 >= num_iters:
+                break
+        logs = {}
+        if losses:
+            logs["eval_loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            res = m.accumulate()
+            names = m.name() if isinstance(m.name(), (list, tuple)) else [m.name()]
+            vals = res if isinstance(res, (list, tuple)) else [res]
+            for n, v in zip(names, vals):
+                logs[f"eval_{n}"] = float(v)
+        for c in cbks:
+            c.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = self._as_loader(test_data, batch_size, False, False)
+        outputs = []
+        for batch in loader:
+            # labeled datasets (img, label) are common in predict too; drop
+            # the trailing label like the reference's input-spec split does
+            inputs, _ = self._split_batch(batch)
+            outputs.append(self.predict_batch(inputs))
+        if stack_outputs and outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs]) for i in range(n_out)]
+        return outputs
+
+    # -- persistence ----------------------------------------------------------
+    def save(self, path, training=True):
+        from ..framework.io_api import save
+
+        self._sync_weights()
+        save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None and hasattr(self._optimizer, "state_dict"):
+            save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io_api import load
+
+        self.network.set_state_dict(load(path + ".pdparams"))
+        return self
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary
+
+        return summary(self.network, input_size, dtypes=dtype)
+
+    # -- helpers ---------------------------------------------------------------
+    @staticmethod
+    def _as_loader(data, batch_size, shuffle, drop_last):
+        from ..io.dataloader import DataLoader
+        from ..io.dataset import Dataset
+
+        if data is None:
+            return []
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              drop_last=drop_last)
+        return data  # already an iterable of batches
+
+    @staticmethod
+    def _split_batch(batch, has_label=True):
+        batch = list(batch) if isinstance(batch, (list, tuple)) else [batch]
+        if not has_label or len(batch) == 1:
+            return batch, None
+        return batch[:-1], batch[-1:]

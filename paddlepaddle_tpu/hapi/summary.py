@@ -1,0 +1,63 @@
+"""paddle.summary (reference: python/paddle/hapi/model_summary.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None):
+    """Print a per-layer table; returns {'total_params': N, 'trainable_params': N}."""
+    rows = []
+    hooks = []
+    ids = set()
+
+    def register(layer, prefix):
+        def hook(l, inputs, outputs):
+            out = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
+            shape = list(out.shape) if isinstance(out, Tensor) else getattr(out, "shape", None)
+            n_params = sum(int(np.prod(p.shape)) for p in l._parameters.values() if p is not None)
+            rows.append((prefix or type(l).__name__, type(l).__name__, shape, n_params))
+
+        if id(layer) not in ids:
+            ids.add(id(layer))
+            hooks.append(layer.register_forward_post_hook(hook))
+
+    for name, sub in net.named_sublayers(include_self=False):
+        register(sub, name)
+
+    if input is not None:
+        x = input
+    elif input_size is not None:
+        shape = list(input_size if isinstance(input_size, (list, tuple)) else [input_size])
+        if isinstance(shape[0], (list, tuple)):
+            shape = list(shape[0])
+        dt = dtypes or "float32"
+        x = Tensor(np.zeros([abs(s) if s != -1 else 1 for s in shape], dtype=np.float32), dtype=dt)
+    else:
+        x = None
+
+    if x is not None:
+        was_training = net.training
+        net.eval()
+        try:
+            net(x)
+        finally:
+            if was_training:
+                net.train()
+    for h in hooks:
+        h.remove()
+
+    total = sum(int(np.prod(p.shape)) for _, p in net.named_parameters())
+    trainable = sum(int(np.prod(p.shape)) for _, p in net.named_parameters() if p.trainable)
+    header = f"{'Layer (type)':<40}{'Output Shape':<24}{'Param #':<12}"
+    print(header)
+    print("=" * len(header))
+    for name, cls, shape, n in rows:
+        print(f"{name + ' (' + cls + ')':<40}{str(shape):<24}{n:<12}")
+    print("=" * len(header))
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    return {"total_params": total, "trainable_params": trainable}

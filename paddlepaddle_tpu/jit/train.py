@@ -37,7 +37,10 @@ class TrainStep:
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self.grad_accum = grad_accum_steps
-        self.params = model.functional_state(trainable_only=True)
+        # copy: step params are DONATED to XLA each step; without the copy the
+        # eager model's handles would point at deleted buffers after step 1
+        self.params = {k: jnp.copy(v)
+                       for k, v in model.functional_state(trainable_only=True).items()}
         self.buffers = {k: v for k, v in model.functional_state().items()
                         if k not in self.params}
         self.opt_state = optimizer.init_state(self.params)
@@ -78,11 +81,14 @@ class TrainStep:
         return wrap(loss)
 
     def sync_to_model(self):
-        """Write the functional params back into the eager model handles."""
+        """Write the functional params back into the eager model handles.
+
+        Copies: self.params are donated to XLA on the next step, so the model
+        must own independent buffers."""
         handles = self.model.raw_state()
         for name, val in self.params.items():
             if name in handles:
-                handles[name]._replace_data(val)
+                handles[name]._replace_data(jnp.copy(val))
 
     def state_dict(self):
         import numpy as np

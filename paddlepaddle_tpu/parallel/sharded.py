@@ -114,8 +114,11 @@ class ShardedTrainStep:
                                          handles=model.raw_state())
         repl = NamedSharding(self.mesh, P())
 
-        # place params / buffers / optimizer state on the mesh
-        self.params = {n: jax.device_put(p, self._param_sh[n]) for n, p in params.items()}
+        # place params / buffers / optimizer state on the mesh (jnp.copy first:
+        # device_put to an identical sharding can alias, and step params are
+        # donated — the eager model's buffers must stay alive)
+        self.params = {n: jax.device_put(jnp.copy(p), self._param_sh[n])
+                       for n, p in params.items()}
         self.buffers = {n: jax.device_put(b, repl) for n, b in self.buffers.items()}
         opt_state = optimizer.init_state(self.params)
         self._opt_sh = self._opt_state_shardings(opt_state, repl)
@@ -196,7 +199,8 @@ class ShardedTrainStep:
         return Tensor._from_data(loss)
 
     def sync_to_model(self):
+        # copies: step params are donated on the next __call__ (see __init__)
         handles = self.model.raw_state()
         for name, val in self.params.items():
             if name in handles:
-                handles[name]._replace_data(val)
+                handles[name]._replace_data(jnp.copy(val))

@@ -1,0 +1,3 @@
+"""paddle.vision — models, transforms, datasets (reference: python/paddle/vision/)."""
+
+from . import datasets, models, transforms  # noqa: F401

@@ -1,0 +1,103 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/).
+
+Zero-egress environment: MNIST/Cifar accept a local ``data_file`` path
+instead of downloading; FakeData generates synthetic samples for smoke runs
+(the role of the reference's tests' fake inputs).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+
+import numpy as np
+
+from ...io.dataset import Dataset
+
+
+class FakeData(Dataset):
+    """Synthetic image classification dataset."""
+
+    def __init__(self, num_samples=100, image_shape=(3, 32, 32), num_classes=10,
+                 transform=None, seed=0):
+        self.num_samples = num_samples
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self._rng = np.random.default_rng(seed)
+        self._images = self._rng.standard_normal(
+            (num_samples,) + self.image_shape).astype(np.float32)
+        self._labels = self._rng.integers(0, num_classes, (num_samples,)).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self._images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self._labels[idx]
+
+    def __len__(self):
+        return self.num_samples
+
+
+class MNIST(Dataset):
+    """MNIST from local idx/gz files (image_path/label_path as the reference's
+    data_file args; no download in this environment)."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        if download and (image_path is None or label_path is None):
+            raise ValueError("downloads are disabled; pass image_path/label_path")
+        self.transform = transform
+        self.images, self.labels = self._load(image_path, label_path)
+
+    @staticmethod
+    def _load(image_path, label_path):
+        opener = gzip.open if str(image_path).endswith(".gz") else open
+        with opener(image_path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            images = np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols)
+        opener = gzip.open if str(label_path).endswith(".gz") else open
+        with opener(label_path, "rb") as f:
+            struct.unpack(">II", f.read(8))
+            labels = np.frombuffer(f.read(), np.uint8).astype(np.int64)
+        return images, labels
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 from a local python-pickle batch directory."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if download and data_file is None:
+            raise ValueError("downloads are disabled; pass data_file")
+        self.transform = transform
+        files = (["data_batch_%d" % i for i in range(1, 6)]
+                 if mode == "train" else ["test_batch"])
+        xs, ys = [], []
+        for fn in files:
+            with open(os.path.join(data_file, fn), "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            xs.append(np.asarray(d[b"data"]).reshape(-1, 3, 32, 32))
+            ys.extend(d[b"labels"])
+        self.images = np.concatenate(xs)
+        self.labels = np.asarray(ys, np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)

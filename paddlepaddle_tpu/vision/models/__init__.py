@@ -1,0 +1,18 @@
+"""Vision model zoo (reference: python/paddle/vision/models/__init__.py:64)."""
+
+from ...models.resnet import (  # noqa: F401
+    ResNet,
+    resnet18,
+    resnet34,
+    resnet50,
+    resnet101,
+    resnet152,
+    resnext50_32x4d,
+    resnext101_64x4d,
+    wide_resnet50_2,
+    wide_resnet101_2,
+)
+from .lenet import LeNet  # noqa: F401
+from .mobilenet import MobileNetV2, mobilenet_v2  # noqa: F401
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
+from .alexnet import AlexNet, alexnet  # noqa: F401

@@ -1,0 +1,85 @@
+"""MobileNetV2 (reference: python/paddle/vision/models/mobilenetv2.py)."""
+
+from ...nn.activation import ReLU6
+from ...nn.common import Dropout, Linear
+from ...nn.container import Sequential
+from ...nn.conv import Conv2D
+from ...nn.layer import Layer
+from ...nn.norm import BatchNorm2D
+from ...nn.pooling import AdaptiveAvgPool2D
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    min_value = min_value or divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+def _conv_bn(inp, oup, kernel, stride, groups=1):
+    pad = (kernel - 1) // 2
+    return Sequential(
+        Conv2D(inp, oup, kernel, stride=stride, padding=pad, groups=groups, bias_attr=False),
+        BatchNorm2D(oup),
+        ReLU6(),
+    )
+
+
+class InvertedResidual(Layer):
+    def __init__(self, inp, oup, stride, expand_ratio):
+        super().__init__()
+        self.stride = stride
+        hidden = int(round(inp * expand_ratio))
+        self.use_res = stride == 1 and inp == oup
+        layers = []
+        if expand_ratio != 1:
+            layers.append(_conv_bn(inp, hidden, 1, 1))
+        layers.extend([
+            _conv_bn(hidden, hidden, 3, stride, groups=hidden),
+            Conv2D(hidden, oup, 1, bias_attr=False),
+            BatchNorm2D(oup),
+        ])
+        self.conv = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        cfg = [
+            (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+            (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1),
+        ]
+        in_c = _make_divisible(32 * scale)
+        last_c = _make_divisible(1280 * max(1.0, scale))
+        features = [_conv_bn(3, in_c, 3, 2)]
+        for t, c, n, s in cfg:
+            out_c = _make_divisible(c * scale)
+            for i in range(n):
+                features.append(InvertedResidual(in_c, out_c, s if i == 0 else 1, t))
+                in_c = out_c
+        features.append(_conv_bn(in_c, last_c, 1, 1))
+        self.features = Sequential(*features)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.classifier = Sequential(Dropout(0.2), Linear(last_c, num_classes))
+        self._last_c = last_c
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+def mobilenet_v2(scale=1.0, **kwargs):
+    return MobileNetV2(scale=scale, **kwargs)

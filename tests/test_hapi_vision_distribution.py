@@ -1,0 +1,125 @@
+"""hapi Model.fit, vision zoo/transforms/datasets, distribution package."""
+
+import numpy as np
+import pytest
+from scipy import stats as sps
+
+import paddlepaddle_tpu as paddle
+
+
+def test_model_fit_evaluate_predict():
+    from paddlepaddle_tpu.vision.datasets import FakeData
+    from paddlepaddle_tpu.vision.models import LeNet
+
+    train = FakeData(num_samples=32, image_shape=(1, 28, 28), num_classes=10)
+    model = paddle.Model(LeNet())
+    opt = paddle.optimizer.Adam(learning_rate=1e-3, parameters=model.parameters())
+    model.prepare(opt, paddle.nn.CrossEntropyLoss(), paddle.metric.Accuracy())
+    hist = model.fit(train, epochs=1, batch_size=8, verbose=0)
+    assert len(hist) == 1 and "loss" in hist[0]
+    logs = model.evaluate(train, batch_size=8, verbose=0)
+    assert "eval_loss" in logs and "eval_acc" in logs
+    preds = model.predict(train, batch_size=8, stack_outputs=True)
+    assert preds[0].shape == (32, 10)
+
+
+def test_model_save_load(tmp_path):
+    from paddlepaddle_tpu.vision.models import LeNet
+
+    m = paddle.Model(LeNet())
+    opt = paddle.optimizer.Adam(learning_rate=1e-3, parameters=m.parameters())
+    m.prepare(opt, paddle.nn.CrossEntropyLoss())
+    p = str(tmp_path / "ckpt")
+    m.save(p)
+    m2 = paddle.Model(LeNet())
+    m2.prepare(paddle.optimizer.Adam(learning_rate=1e-3, parameters=m2.parameters()),
+               paddle.nn.CrossEntropyLoss())
+    m2.load(p)
+    w1 = m.network.features[0].weight.numpy()
+    w2 = m2.network.features[0].weight.numpy()
+    np.testing.assert_allclose(w1, w2)
+
+
+def test_summary():
+    from paddlepaddle_tpu.vision.models import LeNet
+
+    info = paddle.summary(LeNet(), (1, 1, 28, 28))
+    assert info["total_params"] > 0
+    assert info["trainable_params"] <= info["total_params"]
+
+
+def test_vision_models_forward():
+    from paddlepaddle_tpu.vision.models import mobilenet_v2, vgg11, alexnet
+
+    x = np.random.default_rng(0).standard_normal((1, 3, 64, 64)).astype(np.float32)
+    for net in (vgg11(num_classes=7), mobilenet_v2(num_classes=7)):
+        out = net(x)
+        assert out.shape == [1, 7]
+    xa = np.random.default_rng(0).standard_normal((1, 3, 224, 224)).astype(np.float32)
+    assert alexnet(num_classes=5)(xa).shape == [1, 5]
+
+
+def test_transforms():
+    from paddlepaddle_tpu.vision import transforms as T
+
+    img = (np.random.default_rng(0).random((32, 32, 3)) * 255).astype(np.uint8)
+    pipe = T.Compose([T.Resize(28), T.RandomHorizontalFlip(1.0), T.ToTensor(),
+                      T.Normalize(mean=[0.5] * 3, std=[0.5] * 3)])
+    out = pipe(img)
+    assert out.shape == (3, 28, 28)
+    assert out.dtype == np.float32
+
+
+def test_distribution_normal():
+    from paddlepaddle_tpu.distribution import Normal, kl_divergence
+
+    paddle.seed(0)
+    d = Normal(0.0, 1.0)
+    s = d.sample([2000])
+    assert abs(float(s.numpy().mean())) < 0.1
+    lp = d.log_prob(paddle.to_tensor(np.array([0.5], np.float32)))
+    np.testing.assert_allclose(lp.numpy(), sps.norm.logpdf(0.5), rtol=1e-5)
+    kl = kl_divergence(Normal(0.0, 1.0), Normal(1.0, 2.0))
+    ref = sps.norm.entropy(0, 1)  # sanity: kl positive and finite
+    assert float(np.asarray(kl.numpy())) > 0
+
+
+def test_distribution_log_probs_match_scipy():
+    from paddlepaddle_tpu import distribution as D
+
+    checks = [
+        (D.Exponential(2.0), sps.expon(scale=0.5), 0.7),
+        (D.Laplace(0.0, 2.0), sps.laplace(0, 2), 0.3),
+        (D.Gamma(2.0, 3.0), sps.gamma(2.0, scale=1 / 3.0), 0.9),
+        (D.Beta(2.0, 3.0), sps.beta(2, 3), 0.4),
+        (D.Poisson(3.0), sps.poisson(3.0), 2.0),
+        (D.Gumbel(0.0, 1.0), sps.gumbel_r(0, 1), 0.2),
+    ]
+    for dist, ref, x in checks:
+        lp = float(np.asarray(dist.log_prob(paddle.to_tensor(np.array(x, np.float32))).numpy()))
+        ref_lp = ref.logpmf(x) if hasattr(ref, "logpmf") else ref.logpdf(x)
+        np.testing.assert_allclose(lp, ref_lp, rtol=1e-4), type(dist)
+
+
+def test_distribution_categorical_and_bernoulli():
+    from paddlepaddle_tpu.distribution import Bernoulli, Categorical, kl_divergence
+
+    c = Categorical(logits=np.log(np.array([0.2, 0.3, 0.5], np.float32)))
+    lp = c.log_prob(paddle.to_tensor(np.array([2], np.int64)))
+    np.testing.assert_allclose(np.asarray(lp.numpy()), [np.log(0.5)], rtol=1e-5)
+    ent = float(np.asarray(c.entropy().numpy()))
+    np.testing.assert_allclose(ent, sps.entropy([0.2, 0.3, 0.5]), rtol=1e-5)
+    b = Bernoulli(0.3)
+    kl = kl_divergence(b, Bernoulli(0.5))
+    assert float(np.asarray(kl.numpy())) > 0
+
+
+def test_distribution_grad_through_log_prob():
+    from paddlepaddle_tpu.distribution import Normal
+
+    loc = paddle.to_tensor(np.array(0.5, np.float32), stop_gradient=False)
+    d = Normal(loc, 1.0)
+    lp = d.log_prob(paddle.to_tensor(np.array(1.0, np.float32)))
+    lp.backward()
+    # d/dloc logpdf = (x - loc) / var = 0.5
+    np.testing.assert_allclose(float(loc.grad.numpy()), 0.5, rtol=1e-5)
