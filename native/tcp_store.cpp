@@ -1,0 +1,375 @@
+// TCPStore — native key-value rendezvous store for multi-host (DCN) setup.
+//
+// Native-runtime equivalent of the reference's C++ TCPStore
+// (paddle/phi/core/distributed/store/tcp_store.h:121 + socket.cpp): rank 0
+// hosts a poll-loop server; every rank connects a client socket. Ops: SET,
+// GET (blocking until the key exists), ADD (atomic counter, used to hand out
+// ranks), CHECK, WAIT, DELETE. Wire format: 1-byte opcode, then
+// length-prefixed key/value blobs. Exposed through a C ABI consumed by
+// ctypes (paddlepaddle_tpu/distributed/store.py) — no pybind dependency.
+//
+// Build: g++ -std=c++17 -O2 -shared -fPIC tcp_store.cpp -o libtcpstore.so -lpthread
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+enum Op : uint8_t { SET = 1, GET = 2, ADD = 3, CHECK = 4, WAIT = 5, DEL = 6, GET_NOWAIT = 7 };
+
+bool send_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_blob(int fd, const std::string& s) {
+  uint32_t len = htonl(static_cast<uint32_t>(s.size()));
+  return send_all(fd, &len, 4) && (s.empty() || send_all(fd, s.data(), s.size()));
+}
+
+bool recv_blob(int fd, std::string* out) {
+  uint32_t len = 0;
+  if (!recv_all(fd, &len, 4)) return false;
+  len = ntohl(len);
+  out->resize(len);
+  return len == 0 || recv_all(fd, out->data(), len);
+}
+
+class Server {
+ public:
+  explicit Server(int port) : port_(port) {}
+
+  bool start() {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return false;
+    int one = 1;
+    setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port_));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0)
+      return false;
+    if (port_ == 0) {  // kernel-assigned port
+      socklen_t alen = sizeof(addr);
+      getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &alen);
+      port_ = ntohs(addr.sin_port);
+    }
+    if (::listen(listen_fd_, 128) != 0) return false;
+    running_ = true;
+    thread_ = std::thread([this] { loop(); });
+    return true;
+  }
+
+  void stop() {
+    running_ = false;
+    if (listen_fd_ >= 0) ::shutdown(listen_fd_, SHUT_RDWR), ::close(listen_fd_);
+    if (thread_.joinable()) thread_.join();
+    for (int fd : clients_) ::close(fd);
+  }
+
+  int port() const { return port_; }
+
+  ~Server() { stop(); }
+
+ private:
+  void loop() {
+    while (running_) {
+      std::vector<pollfd> fds;
+      fds.push_back({listen_fd_, POLLIN, 0});
+      {
+        std::lock_guard<std::mutex> g(cmu_);
+        for (int fd : clients_) fds.push_back({fd, POLLIN, 0});
+      }
+      int rc = ::poll(fds.data(), fds.size(), 200 /*ms*/);
+      if (rc <= 0) continue;
+      if (fds[0].revents & POLLIN) {
+        int cfd = ::accept(listen_fd_, nullptr, nullptr);
+        if (cfd >= 0) {
+          int one = 1;
+          setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          std::lock_guard<std::mutex> g(cmu_);
+          clients_.push_back(cfd);
+        }
+      }
+      for (size_t i = 1; i < fds.size(); ++i) {
+        if (fds[i].revents & (POLLIN | POLLHUP | POLLERR)) {
+          if (!handle(fds[i].fd)) {
+            ::close(fds[i].fd);
+            std::lock_guard<std::mutex> g(cmu_);
+            for (auto it = clients_.begin(); it != clients_.end(); ++it)
+              if (*it == fds[i].fd) { clients_.erase(it); break; }
+          }
+        }
+      }
+    }
+  }
+
+  bool handle(int fd) {
+    uint8_t op;
+    if (!recv_all(fd, &op, 1)) return false;
+    std::string key;
+    if (!recv_blob(fd, &key)) return false;
+    switch (op) {
+      case SET: {
+        std::string val;
+        if (!recv_blob(fd, &val)) return false;
+        {
+          std::lock_guard<std::mutex> g(mu_);
+          data_[key] = val;
+        }
+        cv_.notify_all();
+        uint8_t ok = 1;
+        return send_all(fd, &ok, 1);
+      }
+      case GET: {
+        // blocking get: server answers when the key exists (client applies
+        // its own timeout) — run the wait in a detached responder so other
+        // clients are not blocked.
+        std::unique_lock<std::mutex> lk(mu_);
+        if (data_.count(key)) {
+          std::string v = data_[key];
+          lk.unlock();
+          return send_blob(fd, v);
+        }
+        lk.unlock();
+        std::thread([this, fd, key] {
+          std::unique_lock<std::mutex> lk2(mu_);
+          cv_.wait_for(lk2, std::chrono::minutes(30),
+                       [&] { return data_.count(key) > 0 || !running_; });
+          if (!running_ || !data_.count(key)) return;
+          std::string v = data_[key];
+          lk2.unlock();
+          send_blob(fd, v);
+        }).detach();
+        return true;
+      }
+      case GET_NOWAIT: {
+        std::lock_guard<std::mutex> g(mu_);
+        auto it = data_.find(key);
+        uint8_t found = it != data_.end();
+        if (!send_all(fd, &found, 1)) return false;
+        return found ? send_blob(fd, it->second) : true;
+      }
+      case ADD: {
+        std::string amt_s;
+        if (!recv_blob(fd, &amt_s)) return false;
+        int64_t amount = 0;
+        std::memcpy(&amount, amt_s.data(), std::min<size_t>(8, amt_s.size()));
+        int64_t newval;
+        {
+          std::lock_guard<std::mutex> g(mu_);
+          int64_t cur = 0;
+          auto it = data_.find(key);
+          if (it != data_.end())
+            std::memcpy(&cur, it->second.data(), std::min<size_t>(8, it->second.size()));
+          newval = cur + amount;
+          std::string stored(8, '\0');
+          std::memcpy(stored.data(), &newval, 8);
+          data_[key] = stored;
+        }
+        cv_.notify_all();
+        std::string out(8, '\0');
+        std::memcpy(out.data(), &newval, 8);
+        return send_blob(fd, out);
+      }
+      case CHECK: {
+        std::lock_guard<std::mutex> g(mu_);
+        uint8_t found = data_.count(key) > 0;
+        return send_all(fd, &found, 1);
+      }
+      case DEL: {
+        std::lock_guard<std::mutex> g(mu_);
+        uint8_t erased = data_.erase(key) > 0;
+        return send_all(fd, &erased, 1);
+      }
+      default:
+        return false;
+    }
+  }
+
+  int port_;
+  int listen_fd_ = -1;
+  std::atomic<bool> running_{false};
+  std::thread thread_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<std::string, std::string> data_;
+  std::mutex cmu_;
+  std::vector<int> clients_;
+};
+
+class Client {
+ public:
+  bool connect_to(const char* host, int port, int timeout_ms) {
+    auto deadline = std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
+    while (std::chrono::steady_clock::now() < deadline) {
+      fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+      sockaddr_in addr{};
+      addr.sin_family = AF_INET;
+      addr.sin_port = htons(static_cast<uint16_t>(port));
+      if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+        ::close(fd_);
+        return false;
+      }
+      if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+        int one = 1;
+        setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        return true;
+      }
+      ::close(fd_);
+      std::this_thread::sleep_for(std::chrono::milliseconds(100));
+    }
+    return false;
+  }
+
+  bool set(const std::string& key, const std::string& val) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint8_t op = SET;
+    if (!send_all(fd_, &op, 1) || !send_blob(fd_, key) || !send_blob(fd_, val))
+      return false;
+    uint8_t ok;
+    return recv_all(fd_, &ok, 1) && ok == 1;
+  }
+
+  bool get(const std::string& key, std::string* out) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint8_t op = GET;
+    if (!send_all(fd_, &op, 1) || !send_blob(fd_, key)) return false;
+    return recv_blob(fd_, out);
+  }
+
+  int get_nowait(const std::string& key, std::string* out) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint8_t op = GET_NOWAIT;
+    if (!send_all(fd_, &op, 1) || !send_blob(fd_, key)) return -1;
+    uint8_t found;
+    if (!recv_all(fd_, &found, 1)) return -1;
+    if (!found) return 0;
+    return recv_blob(fd_, out) ? 1 : -1;
+  }
+
+  bool add(const std::string& key, int64_t amount, int64_t* out) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint8_t op = ADD;
+    std::string amt(8, '\0');
+    std::memcpy(amt.data(), &amount, 8);
+    if (!send_all(fd_, &op, 1) || !send_blob(fd_, key) || !send_blob(fd_, amt))
+      return false;
+    std::string res;
+    if (!recv_blob(fd_, &res) || res.size() < 8) return false;
+    std::memcpy(out, res.data(), 8);
+    return true;
+  }
+
+  int check(const std::string& key) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint8_t op = CHECK;
+    if (!send_all(fd_, &op, 1) || !send_blob(fd_, key)) return -1;
+    uint8_t found;
+    if (!recv_all(fd_, &found, 1)) return -1;
+    return found;
+  }
+
+  ~Client() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+ private:
+  int fd_ = -1;
+  std::mutex mu_;  // one request in flight per client
+};
+
+}  // namespace
+
+extern "C" {
+
+void* tcpstore_server_create(int port) {
+  auto* s = new Server(port);
+  if (!s->start()) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+int tcpstore_server_port(void* s) { return static_cast<Server*>(s)->port(); }
+
+void tcpstore_server_destroy(void* s) { delete static_cast<Server*>(s); }
+
+void* tcpstore_client_create(const char* host, int port, int timeout_ms) {
+  auto* c = new Client();
+  if (!c->connect_to(host, port, timeout_ms)) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+void tcpstore_client_destroy(void* c) { delete static_cast<Client*>(c); }
+
+int tcpstore_set(void* c, const char* key, const char* val, int len) {
+  return static_cast<Client*>(c)->set(key, std::string(val, len)) ? 0 : -1;
+}
+
+// caller passes a buffer; returns actual length or -1 (buffer too small -> -2)
+int tcpstore_get(void* c, const char* key, char* buf, int buflen) {
+  std::string out;
+  if (!static_cast<Client*>(c)->get(key, &out)) return -1;
+  if (static_cast<int>(out.size()) > buflen) return -2;
+  std::memcpy(buf, out.data(), out.size());
+  return static_cast<int>(out.size());
+}
+
+int tcpstore_get_nowait(void* c, const char* key, char* buf, int buflen) {
+  std::string out;
+  int rc = static_cast<Client*>(c)->get_nowait(key, &out);
+  if (rc <= 0) return rc == 0 ? -3 : -1;  // -3 = not found
+  if (static_cast<int>(out.size()) > buflen) return -2;
+  std::memcpy(buf, out.data(), out.size());
+  return static_cast<int>(out.size());
+}
+
+long long tcpstore_add(void* c, const char* key, long long amount) {
+  int64_t out = 0;
+  if (!static_cast<Client*>(c)->add(key, amount, &out)) return -1;
+  return out;
+}
+
+int tcpstore_check(void* c, const char* key) {
+  return static_cast<Client*>(c)->check(key);
+}
+
+}  // extern "C"

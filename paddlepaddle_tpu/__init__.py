@@ -81,6 +81,7 @@ from . import amp  # noqa: E402,F401
 from . import autograd  # noqa: E402,F401
 from . import distributed  # noqa: E402,F401
 from . import distribution  # noqa: E402,F401
+from . import fft  # noqa: E402,F401
 from . import framework  # noqa: E402,F401
 from . import hapi  # noqa: E402,F401
 from . import io  # noqa: E402,F401
@@ -90,6 +91,7 @@ from . import metric  # noqa: E402,F401
 from . import models  # noqa: E402,F401
 from . import nn  # noqa: E402,F401
 from . import optimizer  # noqa: E402,F401
+from . import profiler  # noqa: E402,F401
 from . import vision  # noqa: E402,F401
 from .framework.io_api import load, save  # noqa: E402,F401
 from .hapi import Model, summary  # noqa: E402,F401

@@ -1,0 +1,264 @@
+"""TCPStore — rendezvous key-value store for multi-host jobs.
+
+Reference surface: paddle/phi/core/distributed/store/tcp_store.h:121 (rank 0
+hosts the master socket, other ranks connect; set/get/add/wait used to
+exchange bootstrap info) surfaced as core.create_or_get_global_tcp_store
+(python/paddle/distributed/parallel.py:1134).
+
+The implementation is native C++ (native/tcp_store.cpp: poll-loop server,
+blocking GET, atomic ADD) compiled on demand with g++ and bound via ctypes —
+the runtime-outside-XLA piece of the DCN story. A pure-Python fallback keeps
+the API available when no compiler exists.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+import time
+from typing import Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "tcp_store.cpp")
+_LIB_PATH = os.path.join(_REPO_ROOT, "native", "libtcpstore.so")
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load_lib():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH) or (
+                os.path.exists(_SRC) and os.path.getmtime(_SRC) > os.path.getmtime(_LIB_PATH)):
+            if not os.path.exists(_SRC):
+                return None
+            try:
+                subprocess.run(
+                    ["g++", "-std=c++17", "-O2", "-shared", "-fPIC", _SRC,
+                     "-o", _LIB_PATH, "-lpthread"],
+                    check=True, capture_output=True, timeout=120)
+            except Exception:
+                return None
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.tcpstore_server_create.restype = ctypes.c_void_p
+        lib.tcpstore_server_create.argtypes = [ctypes.c_int]
+        lib.tcpstore_server_port.restype = ctypes.c_int
+        lib.tcpstore_server_port.argtypes = [ctypes.c_void_p]
+        lib.tcpstore_server_destroy.argtypes = [ctypes.c_void_p]
+        lib.tcpstore_client_create.restype = ctypes.c_void_p
+        lib.tcpstore_client_create.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+        lib.tcpstore_client_destroy.argtypes = [ctypes.c_void_p]
+        lib.tcpstore_set.restype = ctypes.c_int
+        lib.tcpstore_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+        lib.tcpstore_get.restype = ctypes.c_int
+        lib.tcpstore_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+        lib.tcpstore_get_nowait.restype = ctypes.c_int
+        lib.tcpstore_get_nowait.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+        lib.tcpstore_add.restype = ctypes.c_longlong
+        lib.tcpstore_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong]
+        lib.tcpstore_check.restype = ctypes.c_int
+        lib.tcpstore_check.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        _lib = lib
+        return _lib
+
+
+class TCPStore:
+    """is_master=True hosts the native server in-process AND connects a client
+    to it (rank 0 uses the store too, like the reference)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 is_master: bool = False, world_size: int = 1,
+                 timeout: float = 300.0):
+        self._lib = _load_lib()
+        self._timeout_ms = int(timeout * 1000)
+        self._server = None
+        if self._lib is None:
+            self._py = _PyStore(host, port, is_master, timeout)
+            self.port = self._py.port
+            return
+        self._py = None
+        if is_master:
+            self._server = self._lib.tcpstore_server_create(port)
+            if not self._server:
+                raise RuntimeError(f"TCPStore: cannot bind port {port}")
+            port = self._lib.tcpstore_server_port(self._server)
+        self.port = port
+        self._client = self._lib.tcpstore_client_create(
+            host.encode(), port, self._timeout_ms)
+        if not self._client:
+            if self._server:
+                self._lib.tcpstore_server_destroy(self._server)
+            raise RuntimeError(f"TCPStore: cannot connect to {host}:{port}")
+
+    # -- reference API -------------------------------------------------------
+    def set(self, key: str, value) -> None:
+        if self._py:
+            return self._py.set(key, value)
+        data = value if isinstance(value, bytes) else str(value).encode()
+        if self._lib.tcpstore_set(self._client, key.encode(), data, len(data)) != 0:
+            raise RuntimeError("TCPStore.set failed")
+
+    def get(self, key: str) -> bytes:
+        if self._py:
+            return self._py.get(key)
+        buf = ctypes.create_string_buffer(1 << 20)
+        n = self._lib.tcpstore_get(self._client, key.encode(), buf, len(buf))
+        if n < 0:
+            raise RuntimeError(f"TCPStore.get({key!r}) failed ({n})")
+        return buf.raw[:n]
+
+    def add(self, key: str, amount: int = 1) -> int:
+        if self._py:
+            return self._py.add(key, amount)
+        out = self._lib.tcpstore_add(self._client, key.encode(), amount)
+        if out < 0 and amount >= 0:
+            raise RuntimeError("TCPStore.add failed")
+        return int(out)
+
+    def check(self, keys) -> bool:
+        keys = [keys] if isinstance(keys, str) else list(keys)
+        if self._py:
+            return all(self._py.check(k) for k in keys)
+        return all(self._lib.tcpstore_check(self._client, k.encode()) == 1 for k in keys)
+
+    def wait(self, keys, timeout: Optional[float] = None) -> None:
+        deadline = time.time() + (timeout if timeout is not None else self._timeout_ms / 1000)
+        while time.time() < deadline:
+            if self.check(keys):
+                return
+            time.sleep(0.05)
+        raise TimeoutError(f"TCPStore.wait timed out on {keys}")
+
+    def __del__(self):
+        try:
+            if getattr(self, "_lib", None) and getattr(self, "_client", None):
+                self._lib.tcpstore_client_destroy(self._client)
+            if getattr(self, "_lib", None) and getattr(self, "_server", None):
+                self._lib.tcpstore_server_destroy(self._server)
+        except Exception:
+            pass
+
+
+class _PyStore:
+    """Pure-Python fallback (threaded socket server), same semantics."""
+
+    def __init__(self, host, port, is_master, timeout):
+        import socketserver
+
+        self._data = {}
+        self._cv = threading.Condition()
+        self.host = host
+        self.timeout = timeout
+        if is_master:
+            outer = self
+
+            class H(socketserver.BaseRequestHandler):
+                def handle(self):
+                    import struct
+
+                    f = self.request.makefile("rwb")
+                    while True:
+                        op = f.read(1)
+                        if not op:
+                            break
+                        (klen,) = struct.unpack(">I", f.read(4))
+                        key = f.read(klen).decode()
+                        if op[0] == 1:  # SET
+                            (vlen,) = struct.unpack(">I", f.read(4))
+                            val = f.read(vlen)
+                            with outer._cv:
+                                outer._data[key] = val
+                                outer._cv.notify_all()
+                            f.write(b"\x01")
+                        elif op[0] == 2:  # GET (blocking)
+                            with outer._cv:
+                                outer._cv.wait_for(lambda: key in outer._data,
+                                                   timeout=outer.timeout)
+                                val = outer._data.get(key, b"")
+                            f.write(struct.pack(">I", len(val)) + val)
+                        elif op[0] == 3:  # ADD
+                            (vlen,) = struct.unpack(">I", f.read(4))
+                            amt = int.from_bytes(f.read(vlen), "little", signed=True)
+                            with outer._cv:
+                                cur = int.from_bytes(outer._data.get(key, b"\0" * 8),
+                                                     "little", signed=True)
+                                new = cur + amt
+                                outer._data[key] = new.to_bytes(8, "little", signed=True)
+                                outer._cv.notify_all()
+                            out = new.to_bytes(8, "little", signed=True)
+                            f.write(struct.pack(">I", len(out)) + out)
+                        elif op[0] == 4:  # CHECK
+                            with outer._cv:
+                                f.write(b"\x01" if key in outer._data else b"\x00")
+                        f.flush()
+
+            self._srv = socketserver.ThreadingTCPServer((host, port), H)
+            self._srv.daemon_threads = True
+            self.port = self._srv.server_address[1]
+            threading.Thread(target=self._srv.serve_forever, daemon=True).start()
+        else:
+            self.port = port
+        import socket
+        import struct
+
+        self._struct = struct
+        deadline = time.time() + timeout
+        while True:
+            try:
+                self._sock = socket.create_connection((host, self.port), timeout=timeout)
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.1)
+        self._f = self._sock.makefile("rwb")
+        self._lock = threading.Lock()
+
+    def _req(self, op, key, payload=None):
+        s = self._struct
+        with self._lock:
+            msg = bytes([op]) + s.pack(">I", len(key)) + key.encode()
+            if payload is not None:
+                msg += s.pack(">I", len(payload)) + payload
+            self._f.write(msg)
+            self._f.flush()
+            if op == 1:
+                return self._f.read(1)
+            if op in (2, 3):
+                (n,) = s.unpack(">I", self._f.read(4))
+                return self._f.read(n)
+            if op == 4:
+                return self._f.read(1)
+
+    def set(self, key, value):
+        data = value if isinstance(value, bytes) else str(value).encode()
+        self._req(1, key, data)
+
+    def get(self, key):
+        return self._req(2, key)
+
+    def add(self, key, amount=1):
+        out = self._req(3, key, int(amount).to_bytes(8, "little", signed=True))
+        return int.from_bytes(out, "little", signed=True)
+
+    def check(self, key):
+        return self._req(4, key) == b"\x01"
+
+
+_global_store: Optional[TCPStore] = None
+
+
+def create_or_get_global_tcp_store() -> TCPStore:
+    """Reference: python/paddle/distributed/parallel.py:1134."""
+    global _global_store
+    if _global_store is None:
+        host = os.environ.get("MASTER_ADDR", "127.0.0.1")
+        port = int(os.environ.get("MASTER_PORT", "0") or 0)
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        _global_store = TCPStore(host, port, is_master=(rank == 0), world_size=world)
+    return _global_store

@@ -88,7 +88,13 @@ class Normal(Distribution):
         return Tensor._from_data(out)
 
     def rsample(self, shape=()):
-        return self.sample(shape)
+        """Reparameterized: pathwise gradients flow to loc/scale Tensors."""
+        noise = jax.random.normal(prandom.next_key(),
+                                  _shape(shape, self.loc, self.scale), jnp.float32)
+        return apply_op(lambda loc, scale: loc + scale * noise,
+                        self._loc_t if self._loc_t is not None else self.loc,
+                        self._scale_t if self._scale_t is not None else self.scale,
+                        op_name="normal_rsample")
 
     def log_prob(self, value):
         def f(v, loc, scale):
@@ -111,6 +117,8 @@ class Normal(Distribution):
 
 class Uniform(Distribution):
     def __init__(self, low, high, name=None):
+        self._low_t = low if isinstance(low, Tensor) else None
+        self._high_t = high if isinstance(high, Tensor) else None
         self.low = _as_array(low)
         self.high = _as_array(high)
         super().__init__(jnp.broadcast_shapes(self.low.shape, self.high.shape))
@@ -120,7 +128,13 @@ class Uniform(Distribution):
         u = jax.random.uniform(key, _shape(shape, self.low, self.high), jnp.float32)
         return Tensor._from_data(self.low + (self.high - self.low) * u)
 
-    rsample = sample
+    def rsample(self, shape=()):
+        u = jax.random.uniform(prandom.next_key(),
+                               _shape(shape, self.low, self.high), jnp.float32)
+        return apply_op(lambda lo, hi: lo + (hi - lo) * u,
+                        self._low_t if self._low_t is not None else self.low,
+                        self._high_t if self._high_t is not None else self.high,
+                        op_name="uniform_rsample")
 
     def log_prob(self, value):
         def f(v):
@@ -194,6 +208,7 @@ class Categorical(Distribution):
 
 class Exponential(Distribution):
     def __init__(self, rate, name=None):
+        self._rate_t = rate if isinstance(rate, Tensor) else None
         self.rate = _as_array(rate)
         super().__init__(jnp.shape(self.rate))
 
@@ -202,7 +217,11 @@ class Exponential(Distribution):
         return Tensor._from_data(
             jax.random.exponential(key, _shape(shape, self.rate)) / self.rate)
 
-    rsample = sample
+    def rsample(self, shape=()):
+        noise = jax.random.exponential(prandom.next_key(), _shape(shape, self.rate))
+        return apply_op(lambda r: noise / r,
+                        self._rate_t if self._rate_t is not None else self.rate,
+                        op_name="exponential_rsample")
 
     def log_prob(self, value):
         return apply_op(lambda v: jnp.log(self.rate) - self.rate * v, value)
@@ -217,6 +236,8 @@ class Exponential(Distribution):
 
 class Laplace(Distribution):
     def __init__(self, loc, scale, name=None):
+        self._loc_t = loc if isinstance(loc, Tensor) else None
+        self._scale_t = scale if isinstance(scale, Tensor) else None
         self.loc = _as_array(loc)
         self.scale = _as_array(scale)
         super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
@@ -226,7 +247,12 @@ class Laplace(Distribution):
         return Tensor._from_data(
             self.loc + self.scale * jax.random.laplace(key, _shape(shape, self.loc, self.scale)))
 
-    rsample = sample
+    def rsample(self, shape=()):
+        noise = jax.random.laplace(prandom.next_key(), _shape(shape, self.loc, self.scale))
+        return apply_op(lambda loc, scale: loc + scale * noise,
+                        self._loc_t if self._loc_t is not None else self.loc,
+                        self._scale_t if self._scale_t is not None else self.scale,
+                        op_name="laplace_rsample")
 
     def log_prob(self, value):
         return apply_op(
@@ -238,6 +264,8 @@ class Laplace(Distribution):
 
 class Gumbel(Distribution):
     def __init__(self, loc, scale, name=None):
+        self._loc_t = loc if isinstance(loc, Tensor) else None
+        self._scale_t = scale if isinstance(scale, Tensor) else None
         self.loc = _as_array(loc)
         self.scale = _as_array(scale)
         super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
@@ -247,7 +275,12 @@ class Gumbel(Distribution):
         return Tensor._from_data(
             self.loc + self.scale * jax.random.gumbel(key, _shape(shape, self.loc, self.scale)))
 
-    rsample = sample
+    def rsample(self, shape=()):
+        noise = jax.random.gumbel(prandom.next_key(), _shape(shape, self.loc, self.scale))
+        return apply_op(lambda loc, scale: loc + scale * noise,
+                        self._loc_t if self._loc_t is not None else self.loc,
+                        self._scale_t if self._scale_t is not None else self.scale,
+                        op_name="gumbel_rsample")
 
     def log_prob(self, value):
         def f(v):
@@ -340,7 +373,8 @@ class LogNormal(Distribution):
     def sample(self, shape=()):
         return apply_op(jnp.exp, self._normal.sample(shape))
 
-    rsample = sample
+    def rsample(self, shape=()):
+        return apply_op(jnp.exp, self._normal.rsample(shape))
 
     def log_prob(self, value):
         def f(v):
@@ -396,8 +430,8 @@ class Multinomial(Distribution):
         n = jnp.shape(self.probs)[-1]
         draws = jax.random.categorical(
             key, jnp.log(jnp.clip(self.probs, 1e-9, None)),
-            shape=tuple(shape) + self.batch_shape + (self.total_count,))
-        counts = jax.nn.one_hot(draws, n).sum(axis=-2)
+            shape=(self.total_count,) + tuple(shape) + self.batch_shape)
+        counts = jax.nn.one_hot(draws, n).sum(axis=0)
         return Tensor._from_data(counts)
 
     def log_prob(self, value):

@@ -124,7 +124,10 @@ class EarlyStopping(Callback):
         return cur > best + self.min_delta
 
     def on_eval_end(self, logs=None):
-        cur = (logs or {}).get(self.monitor)
+        logs = logs or {}
+        # evaluate() prefixes its keys with 'eval_'; accept both spellings so
+        # the reference's default monitor='loss' works
+        cur = logs.get(self.monitor, logs.get(f"eval_{self.monitor}"))
         if cur is None:
             return
         cur = float(cur[0] if isinstance(cur, (list, tuple)) else cur)

@@ -44,19 +44,20 @@ class Model:
         self._train_step = None
         return self
 
-    def _ensure_step(self):
+    def _ensure_step(self, grad_accum=1):
         if self._train_step is None:
             def loss_fn(net, *batch):
                 *inputs, label = batch
                 out = net(*inputs)
                 return self._loss(out, label)
 
-            self._train_step = TrainStep(self.network, self._optimizer, loss_fn)
+            self._train_step = TrainStep(self.network, self._optimizer, loss_fn,
+                                         grad_accum_steps=grad_accum)
         return self._train_step
 
     # -- one-batch APIs (reference Model.train_batch/eval_batch/predict_batch)
-    def train_batch(self, inputs, labels=None, update=True):
-        step = self._ensure_step()
+    def train_batch(self, inputs, labels=None, update=True, grad_accum=1):
+        step = self._ensure_step(grad_accum)
         batch = _to_list(inputs) + _to_list(labels)
         loss = step(*batch)
         return [float(loss.numpy())]
@@ -103,7 +104,8 @@ class Model:
                 inputs, labels = self._split_batch(batch)
                 for c in cbks:
                     c.on_train_batch_begin(step)
-                losses = self.train_batch(inputs, labels)
+                losses = self.train_batch(inputs, labels,
+                                          grad_accum=accumulate_grad_batches)
                 logs = {"loss": losses[0], "step": step}
                 for c in cbks:
                     c.on_train_batch_end(step, logs)
@@ -113,6 +115,11 @@ class Model:
                 eval_logs = self.evaluate(eval_data, batch_size=batch_size,
                                           verbose=0, callbacks=cbks)
                 logs.update(eval_logs)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                import os
+
+                os.makedirs(save_dir, exist_ok=True)
+                self.save(os.path.join(save_dir, str(epoch)))
             for c in cbks:
                 c.on_epoch_end(epoch, logs)
             history.append(logs)
@@ -179,6 +186,9 @@ class Model:
         from ..framework.io_api import load
 
         self.network.set_state_dict(load(path + ".pdparams"))
+        # the compiled step holds pre-load params; drop it so the next
+        # fit/eval rebuilds from (and never overwrites) the loaded weights
+        self._train_step = None
         return self
 
     def parameters(self, *args, **kwargs):

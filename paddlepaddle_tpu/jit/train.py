@@ -51,18 +51,39 @@ class TrainStep:
     def _step_impl(self, params, opt_state, batch, key, lr):
         from ..core import autograd as _ag
 
-        def loss_of(p):
+        def loss_of(p, batch_i, key_i):
             # jax.value_and_grad differentiates via tracer provenance; the
             # eager GradNode tape is dead weight here (per-op jax.vjp nesting
             # overflows the Python stack on deep models), so switch it off.
-            with _ag.no_grad(), prandom.key_scope(key):
+            with _ag.no_grad(), prandom.key_scope(key_i):
                 state = dict(p)
                 state.update(self.buffers)
                 with self.model.bind_state(state):
-                    loss = self.loss_fn(self.model, *batch)
+                    loss = self.loss_fn(self.model, *batch_i)
             return unwrap(loss)
 
-        loss, grads = jax.value_and_grad(loss_of)(params)
+        if self.grad_accum <= 1:
+            loss, grads = jax.value_and_grad(loss_of)(params, batch, key)
+        else:
+            # microbatch accumulation: split the leading batch dim into
+            # grad_accum chunks and scan — peak memory is one microbatch
+            a = self.grad_accum
+            batch_mb = jax.tree_util.tree_map(
+                lambda x: x.reshape((a, x.shape[0] // a) + x.shape[1:]), batch)
+            keys = jax.random.split(key, a)
+
+            def body(carry, xs):
+                g_acc, l_acc = carry
+                mb, k = xs
+                l, g = jax.value_and_grad(loss_of)(params, mb, k)
+                g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+                return (g_acc, l_acc + l.astype(jnp.float32)), None
+
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+            (g_sum, l_sum), _ = jax.lax.scan(
+                body, (zeros, jnp.zeros([], jnp.float32)), (batch_mb, keys))
+            grads = jax.tree_util.tree_map(lambda g: g / a, g_sum)
+            loss = l_sum / a
         new_params, new_opt = self.optimizer.apply(grads, opt_state, params, lr=lr)
         return new_params, new_opt, loss
 

@@ -1,0 +1,162 @@
+"""paddle.profiler (reference: python/paddle/profiler/profiler.py:358 —
+Profiler with scheduler/on_trace_ready, RecordEvent annotations,
+chrome-tracing export; C++ host tracer + CUPTI device tracer).
+
+TPU-native: jax.profiler is the device tracer (XPlane/TensorBoard +
+Perfetto); RecordEvent maps to jax.profiler.TraceAnnotation so host
+annotations land in the same timeline. Summary statistics are host-side
+wall-time aggregates per RecordEvent name.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import defaultdict
+from typing import Callable, Iterable, Optional
+
+import jax
+
+
+class ProfilerTarget:
+    CPU = 0
+    GPU = 1
+    CUSTOM_DEVICE = 3
+    TPU = 4
+
+
+class ProfilerState:
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], int]:
+    """Reference profiler.make_scheduler: step -> ProfilerState."""
+    cycle = closed + ready + record
+
+    def sched(step: int) -> int:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= cycle * repeat:
+            return ProfilerState.CLOSED
+        pos = s % cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return sched
+
+
+_event_stats = defaultdict(lambda: [0, 0.0])  # name -> [count, total_s]
+
+
+class RecordEvent:
+    """Host annotation (reference: paddle/phi/api/profiler/event_tracing.h:32);
+    shows up in the jax trace via TraceAnnotation and in summary()."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._ann = None
+        self._t0 = None
+
+    def begin(self):
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+        self._t0 = time.perf_counter()
+
+    def end(self):
+        if self._t0 is not None:
+            stats = _event_stats[self.name]
+            stats[0] += 1
+            stats[1] += time.perf_counter() - self._t0
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    def handler(prof):
+        prof.export(dir_name)
+
+    return handler
+
+
+class Profiler:
+    def __init__(self, *, targets: Optional[Iterable] = None, scheduler=None,
+                 on_trace_ready=None, timer_only: bool = False,
+                 record_shapes: bool = False, profile_memory: bool = False,
+                 with_flops: bool = False):
+        self._scheduler = (make_scheduler(closed=0, ready=0, record=scheduler[1] - scheduler[0],
+                                          skip_first=scheduler[0])
+                           if isinstance(scheduler, (tuple, list)) else scheduler)
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._step = 0
+        self._dir = None
+        self._active = False
+
+    def start(self):
+        self._dir = os.environ.get("PADDLE_PROFILER_LOGDIR", "/tmp/paddlepaddle_tpu_prof")
+        if not self._timer_only:
+            os.makedirs(self._dir, exist_ok=True)
+            jax.profiler.start_trace(self._dir)
+            self._active = True
+        self._t0 = time.perf_counter()
+        return self
+
+    def stop(self):
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+        if self._on_trace_ready is not None:
+            self._on_trace_ready(self)
+        return self
+
+    def step(self, num_samples: Optional[int] = None):
+        self._step += 1
+
+    def step_info(self, unit=None):
+        dt = time.perf_counter() - self._t0
+        self._t0 = time.perf_counter()
+        return f"step {self._step}: {dt * 1000:.2f} ms"
+
+    def export(self, path: str, format: str = "json"):
+        # device trace already written to self._dir by stop_trace
+        return self._dir
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        lines = [f"{'Event':<40}{'Calls':<8}{'Total(ms)':<12}{'Avg(ms)':<10}"]
+        for name, (cnt, total) in sorted(_event_stats.items(),
+                                         key=lambda kv: -kv[1][1]):
+            lines.append(f"{name:<40}{cnt:<8}{total * 1e3:<12.3f}{total / max(cnt, 1) * 1e3:<10.3f}")
+        out = "\n".join(lines)
+        print(out)
+        return out
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+def load_profiler_result(path):
+    raise NotImplementedError("load XPlane traces with TensorBoard")

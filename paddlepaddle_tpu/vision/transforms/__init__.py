@@ -68,8 +68,19 @@ class Normalize(BaseTransform):
 
 
 class Resize(BaseTransform):
+    """Reference semantics: an int size scales the SHORTER edge preserving
+    aspect ratio; a (h, w) pair is exact. Bilinear by default."""
+
     def __init__(self, size, interpolation="bilinear"):
-        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.size = size
+        self.interpolation = interpolation
+
+    def _target(self, h, w):
+        if isinstance(self.size, int):
+            if h <= w:
+                return self.size, max(1, int(round(w * self.size / h)))
+            return max(1, int(round(h * self.size / w))), self.size
+        return tuple(self.size)
 
     def _apply_image(self, img):
         img = np.asarray(img)
@@ -77,10 +88,31 @@ class Resize(BaseTransform):
         if chw:
             img = np.transpose(img, (1, 2, 0))
         h, w = img.shape[:2]
-        th, tw = self.size
-        ys = (np.arange(th) * (h / th)).astype(np.int64).clip(0, h - 1)
-        xs = (np.arange(tw) * (w / tw)).astype(np.int64).clip(0, w - 1)
-        out = img[ys][:, xs]
+        th, tw = self._target(h, w)
+        if self.interpolation == "nearest":
+            ys = (np.arange(th) * (h / th)).astype(np.int64).clip(0, h - 1)
+            xs = (np.arange(tw) * (w / tw)).astype(np.int64).clip(0, w - 1)
+            out = img[ys][:, xs]
+        else:  # bilinear (align_corners=False convention)
+            fy = (np.arange(th) + 0.5) * (h / th) - 0.5
+            fx = (np.arange(tw) + 0.5) * (w / tw) - 0.5
+            y0 = np.clip(np.floor(fy).astype(np.int64), 0, h - 1)
+            x0 = np.clip(np.floor(fx).astype(np.int64), 0, w - 1)
+            y1 = np.clip(y0 + 1, 0, h - 1)
+            x1 = np.clip(x0 + 1, 0, w - 1)
+            wy = np.clip(fy - y0, 0.0, 1.0)[:, None]
+            wx = np.clip(fx - x0, 0.0, 1.0)[None, :]
+            if img.ndim == 3:
+                wy = wy[..., None]
+                wx = wx[..., None]
+            f = img.astype(np.float32)
+            top = f[y0][:, x0] * (1 - wx) + f[y0][:, x1] * wx
+            bot = f[y1][:, x0] * (1 - wx) + f[y1][:, x1] * wx
+            out = top * (1 - wy) + bot * wy
+            if img.dtype == np.uint8:
+                out = np.clip(np.round(out), 0, 255).astype(np.uint8)
+            else:
+                out = out.astype(img.dtype)
         if chw:
             out = np.transpose(out, (2, 0, 1))
         return out
