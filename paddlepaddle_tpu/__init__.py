@@ -84,6 +84,8 @@ from . import distribution  # noqa: E402,F401
 from . import fft  # noqa: E402,F401
 from . import framework  # noqa: E402,F401
 from . import hapi  # noqa: E402,F401
+from . import incubate  # noqa: E402,F401
+from . import inference  # noqa: E402,F401
 from . import io  # noqa: E402,F401
 from . import jit  # noqa: E402,F401
 from . import linalg  # noqa: E402,F401
@@ -92,6 +94,8 @@ from . import models  # noqa: E402,F401
 from . import nn  # noqa: E402,F401
 from . import optimizer  # noqa: E402,F401
 from . import profiler  # noqa: E402,F401
+from . import sparse  # noqa: E402,F401
+from . import static  # noqa: E402,F401
 from . import vision  # noqa: E402,F401
 from .framework.io_api import load, save  # noqa: E402,F401
 from .hapi import Model, summary  # noqa: E402,F401

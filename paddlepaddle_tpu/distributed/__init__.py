@@ -29,6 +29,15 @@ from .env import (  # noqa: F401
 )
 from . import checkpoint  # noqa: F401
 from . import fleet  # noqa: F401
+from . import auto_parallel  # noqa: F401  (isort: after fleet to avoid cycle)
+from .auto_parallel import (  # noqa: F401
+    ColWiseParallel,
+    DistModel,
+    RowWiseParallel,
+    parallelize,
+    shard_dataloader,
+    to_static,
+)
 from .mesh import ProcessMesh, auto_mesh, get_mesh, set_mesh  # noqa: F401
 from .parallel import DataParallel  # noqa: F401
 from .placement import Partial, Placement, Replicate, Shard  # noqa: F401

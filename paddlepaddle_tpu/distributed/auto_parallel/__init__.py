@@ -1,0 +1,250 @@
+"""auto_parallel — DistTensor-style semi-automatic parallel API.
+
+Reference surface: python/paddle/distributed/auto_parallel/api.py
+(shard_tensor:220, shard_layer:733, to_static/DistModel:2776,2167) and the
+intermediate ``parallelize`` API
+(auto_parallel/intermediate/{parallelize.py:22,tensor_parallel.py:73-146}).
+
+TPU-native: placements are GSPMD PartitionSpecs; ``to_static`` compiles ONE
+pjit train step (parallel.ShardedTrainStep) — completion/partitioner/reshard
+passes are the XLA SPMD partitioner's job. ``parallelize`` applies per-layer
+plans (ColWiseParallel/RowWiseParallel/...) by attaching ``dist_spec`` to
+parameters, exactly what the mpu layers do internally.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Optional
+
+from ...core.tensor import Tensor
+from ...nn.layer import Layer
+from ..mesh import ProcessMesh, get_mesh
+from ..placement import Partial, Placement, Replicate, Shard
+from ..sharding_api import dist_attr, reshard, shard_layer, shard_optimizer, shard_tensor  # noqa: F401
+
+
+# ---------------------------------------------------------------------------
+# parallelize plans (reference: intermediate/tensor_parallel.py:73-146)
+# ---------------------------------------------------------------------------
+
+
+class _Plan:
+    def apply(self, layer: Layer, mp_axis: str) -> None:
+        raise NotImplementedError
+
+
+class ColWiseParallel(_Plan):
+    """Shard the layer weight's OUTPUT dim over mp (Linear [in, out] ->
+    (None, mp); Embedding [vocab, h] -> (None, mp))."""
+
+    def __init__(self, gather_output: bool = False):
+        self.gather_output = gather_output
+
+    def apply(self, layer, mp_axis):
+        w = getattr(layer, "weight", None)
+        if w is not None:
+            w.dist_spec = (None, mp_axis)
+        b = getattr(layer, "bias", None)
+        if b is not None:
+            b.dist_spec = (mp_axis,)
+
+
+class RowWiseParallel(_Plan):
+    """Shard the layer weight's INPUT dim over mp (Linear -> (mp, None);
+    Embedding [vocab, h] -> (mp, None))."""
+
+    def apply(self, layer, mp_axis):
+        w = getattr(layer, "weight", None)
+        if w is not None:
+            w.dist_spec = (mp_axis, None)
+
+
+class PrepareLayerInput(_Plan):
+    def __init__(self, fn=None):
+        self.fn = fn
+
+    def apply(self, layer, mp_axis):
+        if self.fn is not None:
+            layer.register_forward_pre_hook(lambda l, inp: self.fn(inp))
+
+
+class PrepareLayerOutput(_Plan):
+    def __init__(self, fn=None):
+        self.fn = fn
+
+    def apply(self, layer, mp_axis):
+        if self.fn is not None:
+            layer.register_forward_post_hook(lambda l, inp, out: self.fn(out))
+
+
+class SequenceParallelBegin(_Plan):
+    def apply(self, layer, mp_axis):
+        from ...parallel.mpu import scatter_to_sequence_parallel
+
+        layer.register_forward_post_hook(
+            lambda l, inp, out: scatter_to_sequence_parallel(out, mp_axis))
+
+
+class SequenceParallelEnd(_Plan):
+    def apply(self, layer, mp_axis):
+        from ...parallel.mpu import gather_from_sequence_parallel
+
+        layer.register_forward_pre_hook(
+            lambda l, inp: tuple(gather_from_sequence_parallel(x, mp_axis) for x in inp))
+
+
+def parallelize(model: Layer, optimizer=None, mesh: Optional[ProcessMesh] = None,
+                config: Optional[Dict] = None):
+    """Apply per-layer parallel plans to an undistributed model
+    (reference parallelize.py:22; torch parallelize_module-like).
+
+    config = {"mp_config": {"parallelize_plan": {"layer.name.regex": Plan}},
+              "dp_config": {...}, "pp_config": {...}}
+    """
+    config = config or {}
+    mp_cfg = config.get("mp_config") or {}
+    plan_table = mp_cfg.get("parallelize_plan", {})
+    mp_axis = mp_cfg.get("mp_axis", "mp")
+    named = dict(model.named_sublayers(include_self=True))
+    for pattern, plan in plan_table.items():
+        plans = plan if isinstance(plan, (list, tuple)) else [plan]
+        matched = False
+        for name, sub in named.items():
+            if re.fullmatch(pattern, name) or name == pattern or name.endswith("." + pattern):
+                for p in plans:
+                    p.apply(sub, mp_axis)
+                matched = True
+        if not matched:
+            raise ValueError(f"parallelize plan pattern {pattern!r} matched no sublayer")
+    if optimizer is not None:
+        return model, optimizer
+    return model
+
+
+# ---------------------------------------------------------------------------
+# to_static / DistModel (reference api.py:2776, 2167)
+# ---------------------------------------------------------------------------
+
+
+class DistModel:
+    """Compiled distributed model: __call__ runs one pjit step.
+
+    Modes follow the reference: with loss+optimizer -> train step (returns
+    loss); ``eval()`` -> forward+loss without update; ``predict()`` ->
+    forward only.
+    """
+
+    def __init__(self, layer: Layer, loader=None, loss=None, optimizer=None,
+                 strategy=None, metrics=None, mesh: Optional[ProcessMesh] = None,
+                 rules=None, data_axes=("dp", "fsdp")):
+        from ...parallel import ShardedTrainStep
+
+        self.network = layer
+        self._loss = loss
+        self._optimizer = optimizer
+        self._mode = "train" if (loss is not None and optimizer is not None) else "predict"
+        pm = mesh or get_mesh()
+        if pm is None:
+            raise ValueError("to_static needs a mesh: dist.set_mesh(...) or fleet.init first")
+        self._mesh = pm
+        if rules is None:
+            rules = [(r".*", ())]  # dist_spec placements still win
+        self._rules = rules
+        self._data_axes = data_axes
+        self._step = None
+        if self._mode == "train":
+            self._step = self._build_step()
+
+    def _build_step(self):
+        from ...parallel import ShardedTrainStep
+
+        loss = self._loss
+
+        def loss_fn(net, *batch):
+            *inputs, label = batch
+            return loss(net(*inputs), label)
+
+        return ShardedTrainStep(self.network, self._optimizer, loss_fn,
+                                mesh=self._mesh, rules=self._rules,
+                                data_axes=self._data_axes)
+
+    def train(self):
+        self._mode = "train"
+
+    def eval(self):
+        self._mode = "eval"
+
+    def predict(self):
+        self._mode = "predict"
+
+    def __call__(self, *batch):
+        if self._mode == "train":
+            return self._step(*batch)
+        if self._mode == "eval":
+            *inputs, label = batch
+            if self._step is not None:
+                self._step.sync_to_model()
+            out = self.network(*inputs)
+            return self._loss(out, label)
+        if self._step is not None:
+            self._step.sync_to_model()
+        return self.network(*batch)
+
+    def state_dict(self, mode="all"):
+        if self._step is not None:
+            self._step.sync_to_model()
+        return self.network.state_dict()
+
+    def set_state_dict(self, state_dict):
+        self.network.set_state_dict(state_dict)
+        if self._step is not None:
+            # rebuild placed params from the updated eager weights with the
+            # SAME rules/data_axes this model was constructed with
+            self._step = self._build_step()
+
+
+def to_static(layer: Layer, loader=None, loss=None, optimizer=None,
+              strategy=None, mesh=None, rules=None) -> DistModel:
+    return DistModel(layer, loader, loss, optimizer, strategy, mesh=mesh, rules=rules)
+
+
+# ---------------------------------------------------------------------------
+# shard_dataloader (reference api.py shard_dataloader)
+# ---------------------------------------------------------------------------
+
+
+class _ShardedLoader:
+    def __init__(self, loader, mesh: ProcessMesh, shard_dims="dp"):
+        self._loader = loader
+        self._mesh = mesh
+        self._dims = shard_dims
+
+    def __iter__(self):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        jm = self._mesh.to_jax()
+        axes = [a for a in ([self._dims] if isinstance(self._dims, str) else self._dims)
+                if a in jm.shape]
+        spec = P(tuple(axes) if len(axes) > 1 else (axes[0] if axes else None))
+
+        for batch in self._loader:
+            def place(x):
+                arr = x._data if isinstance(x, Tensor) else x
+                if getattr(arr, "ndim", 0) == 0:
+                    return x
+                try:
+                    return Tensor._from_data(jax.device_put(arr, NamedSharding(jm, spec)))
+                except Exception:
+                    return x
+
+            yield [place(b) for b in batch] if isinstance(batch, (list, tuple)) else place(batch)
+
+    def __len__(self):
+        return len(self._loader)
+
+
+def shard_dataloader(dataloader, meshes, shard_dims="dp", is_dataset_splitted=False):
+    mesh = meshes[0] if isinstance(meshes, (list, tuple)) else meshes
+    return _ShardedLoader(dataloader, mesh, shard_dims)

@@ -1,0 +1,3 @@
+"""paddle.incubate — experimental API surface (reference: python/paddle/incubate/)."""
+
+from . import autograd, nn  # noqa: F401

@@ -1,0 +1,3 @@
+"""paddle.incubate.nn (reference: python/paddle/incubate/nn/)."""
+
+from . import functional  # noqa: F401

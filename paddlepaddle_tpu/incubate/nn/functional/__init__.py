@@ -1,0 +1,184 @@
+"""Fused-op API surface (reference: python/paddle/incubate/nn/functional/ —
+fused_rms_norm, fused_rotary_position_embedding, swiglu, fused_moe,
+fused_multi_head_attention, variable_length_memory_efficient_attention...).
+
+On TPU "fused" means: one jnp expression XLA fuses, or a Pallas kernel for
+the attention path — the incubate names are thin aliases onto those."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ....core.dispatch import apply_op
+from ....nn import functional as F
+from ....nn.functional import swiglu  # noqa: F401  (already fused)
+
+
+def fused_rms_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, bias=None, residual=None,
+                   quant_scale=-1, quant_round_type=0, quant_max_bound=0,
+                   quant_min_bound=0):
+    """Reference: incubate fused_rms_norm(x, w, b, eps, begin_norm_axis).
+    Returns (out, residual_out) like the reference when residual given."""
+    if residual is not None:
+        x = x + residual
+    if bias is not None:
+        x = x + bias
+    out = F.rms_norm(x, norm_weight, norm_bias, epsilon, begin_norm_axis)
+    if residual is not None:
+        return out, x
+    return out
+
+
+def fused_layer_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-5,
+                     begin_norm_axis=-1, bias=None, residual=None, **kw):
+    if residual is not None:
+        x = x + residual
+    if bias is not None:
+        x = x + bias
+    out = F.layer_norm(x, list(x.shape[begin_norm_axis:]), norm_weight, norm_bias, epsilon)
+    if residual is not None:
+        return out, x
+    return out
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    time_major=False, rotary_emb_base=10000.0):
+    """Reference: fused_rope — BSHD q/k(/v passthrough), neox rotate-half."""
+
+    def rope(x, c, s):
+        def f(xa, ca, sa):
+            seq = xa.shape[1]
+            ca = ca.reshape(1, seq, 1, -1).astype(xa.dtype)
+            sa = sa.reshape(1, seq, 1, -1).astype(xa.dtype)
+            half = xa.shape[-1] // 2
+            rot = jnp.concatenate([-xa[..., half:], xa[..., :half]], axis=-1)
+            return xa * ca + rot * sa
+
+        return apply_op(f, x, c, s, op_name="fused_rope")
+
+    outs = [rope(q, cos, sin)]
+    outs.append(rope(k, cos, sin) if k is not None else None)
+    outs.append(v)
+    return tuple(outs)
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=False,
+                               pre_ln_scale=None, pre_ln_bias=None, ln_scale=None,
+                               ln_bias=None, pre_ln_epsilon=1e-5, qkv_bias=None,
+                               linear_bias=None, cache_kv=None, attn_mask=None,
+                               dropout_rate=0.0, attn_dropout_rate=0.0,
+                               ln_epsilon=1e-5, training=True, mode="upscale_in_train",
+                               ring_id=-1, add_residual=True, num_heads=None, name=None):
+    """Condensed reference fused_attention: (pre-)LN -> qkv -> sdpa -> proj ->
+    residual (+post-LN)."""
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, [x.shape[-1]], pre_ln_scale, pre_ln_bias, pre_ln_epsilon)
+    b, s, h = x.shape[0], x.shape[1], x.shape[-1]
+
+    def qkv_fn(xa, w, bias_arr):
+        # w: [3, n_heads, head_dim, h] (reference layout)
+        out = jnp.einsum("bsh,kndh->bsknd", xa, w)
+        if bias_arr is not None:
+            out = out + bias_arr[None, None]
+        return out
+
+    qkv = apply_op(qkv_fn, x, qkv_weight, qkv_bias, op_name="fused_qkv")
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    ctx = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                         dropout_p=attn_dropout_rate,
+                                         training=training)
+    ctx = ctx.reshape([b, s, -1])
+    out = F.linear(ctx, linear_weight, linear_bias)
+    if dropout_rate:
+        out = F.dropout(out, dropout_rate, training=training)
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, [out.shape[-1]], ln_scale, ln_bias, ln_epsilon)
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu", ln1_epsilon=1e-5,
+                      ln2_epsilon=1e-5, pre_layer_norm=False, training=True,
+                      mode="upscale_in_train", ring_id=-1, name=None):
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, [x.shape[-1]], ln1_scale, ln1_bias, ln1_epsilon)
+    h = F.linear(x, linear1_weight, linear1_bias)
+    h = getattr(F, activation)(h)
+    if dropout1_rate:
+        h = F.dropout(h, dropout1_rate, training=training)
+    out = F.linear(h, linear2_weight, linear2_bias)
+    if dropout2_rate:
+        out = F.dropout(out, dropout2_rate, training=training)
+    out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, [out.shape[-1]], ln2_scale, ln2_bias, ln2_epsilon)
+    return out
+
+
+def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
+              ffn2_bias=None, quant_method="None", moe_topk=2, norm_topk_prob=True):
+    """Reference: incubate fused_moe — top-k routed expert FFN bank."""
+    from ....parallel.moe import MoELayer, NaiveGate
+
+    b, s, d = x.shape[0], x.shape[1], x.shape[-1]
+    e, _, hidden = (ffn1_weight.shape if not hasattr(ffn1_weight, "_data")
+                    else tuple(ffn1_weight.shape))
+
+    def run(xa, gw, w1, w2, b1, b2):
+        logits = xa.reshape(-1, d).astype(jnp.float32) @ gw.astype(jnp.float32)
+        probs = jax.nn.softmax(logits, -1)
+        topv, topi = jax.lax.top_k(probs, moe_topk)
+        if norm_topk_prob:
+            topv = topv / (topv.sum(-1, keepdims=True) + 1e-9)
+        xf = xa.reshape(-1, d)
+        out = jnp.zeros_like(xf)
+        for j in range(moe_topk):
+            sel = topi[:, j]
+            w1_t = w1[sel]           # [T, d, hidden]
+            w2_t = w2[sel]
+            hmid = jnp.einsum("td,tdh->th", xf, w1_t)
+            if b1 is not None:
+                hmid = hmid + b1[sel]
+            act = jax.nn.silu(hmid[..., : hmid.shape[-1] // 2]) * hmid[..., hmid.shape[-1] // 2:] \
+                if hmid.shape[-1] % 2 == 0 else jax.nn.silu(hmid)
+            o = jnp.einsum("th,thd->td", act, w2_t)
+            if b2 is not None:
+                o = o + b2[sel]
+            out = out + o * topv[:, j:j + 1].astype(out.dtype)
+        return out.reshape(b, s, d)
+
+    return apply_op(run, x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias,
+                    ffn2_bias, op_name="fused_moe")
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    def f(xa, w, ba):
+        w = w.T if transpose_weight else w
+        out = xa @ w
+        return out + ba if ba is not None else out
+
+    return apply_op(f, x, weight, bias, op_name="fused_linear")
+
+
+def fused_bias_act(x, bias=None, act_method="gelu", **kw):
+    def f(xa, ba):
+        if ba is not None:
+            xa = xa + ba
+        return getattr(jax.nn, act_method if act_method != "geglu" else "gelu")(xa)
+
+    return apply_op(f, x, bias, op_name="fused_bias_act")
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train", name=None):
+    return F.dropout(x, p, training=training, mode=mode) + y

@@ -1,0 +1,112 @@
+"""paddle.inference — deployment Predictor API.
+
+Reference surface: paddle/fluid/inference/api/paddle_inference_api.h:52,229
+(Config, Predictor, create_predictor, zero-copy tensors). TPU-native: the
+222 IR fusion passes and the analysis pipeline are XLA's job; a predictor
+wraps a jit.load'ed StableHLO module (or a live Layer) with the
+name-indexed input/output handle API deployment code expects.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+class Config:
+    def __init__(self, prog_file: Optional[str] = None, params_file: Optional[str] = None):
+        # paddle convention: both files share a prefix; accept either style
+        if prog_file and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self.model_prefix = prog_file
+        self._device = "tpu"
+        self._memory_pool_mb = 0
+        self._ir_optim = True
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._device = "tpu"  # accelerator path
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+    def enable_memory_optim(self):
+        pass
+
+    def switch_ir_optim(self, flag=True):
+        self._ir_optim = flag
+
+    def enable_mkldnn(self):
+        pass
+
+
+class PredictorTensor:
+    """Zero-copy style handle (reference ZeroCopyTensor)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+
+    def reshape(self, shape):
+        pass  # shape comes from copy_from_cpu
+
+    def copy_from_cpu(self, arr):
+        self._value = np.ascontiguousarray(arr)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._value)
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        from ..jit.save_load import load
+
+        if config.model_prefix is None:
+            raise ValueError("Config needs a model path prefix")
+        self._layer = load(config.model_prefix)
+        self._inputs: Dict[str, PredictorTensor] = {}
+        self._outputs: List[PredictorTensor] = []
+        # exported avals are the flattened (params..., inputs...) — subtract
+        # the param count to recover the real input arity
+        n_total = len(self._layer._exported.in_avals)
+        n_params = len(self._layer._param_list)
+        n_in = max(1, n_total - n_params)
+        self._input_names = [f"input_{i}" for i in range(n_in)]
+
+    def get_input_names(self):
+        return list(self._input_names)
+
+    def get_input_handle(self, name):
+        return self._inputs.setdefault(name, PredictorTensor(name))
+
+    def get_output_names(self):
+        return [f"output_{i}" for i in range(len(self._outputs))] or ["output_0"]
+
+    def get_output_handle(self, name):
+        i = int(name.split("_")[-1])
+        return self._outputs[i]
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        if inputs is None:
+            inputs = [self._inputs[n]._value for n in self._input_names
+                      if n in self._inputs]
+        outs = self._layer(*inputs)
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        self._outputs = []
+        results = []
+        for i, o in enumerate(outs):
+            h = PredictorTensor(f"output_{i}")
+            val = o.numpy() if isinstance(o, Tensor) else np.asarray(o)
+            h.copy_from_cpu(val)
+            self._outputs.append(h)
+            results.append(val)
+        return results
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -1,19 +1,5 @@
 """``jit`` — XLA compilation of dygraph code (reference: python/paddle/jit/)."""
 
 from .api import StaticFunction, enable_to_static, ignore_module, not_to_static, to_static  # noqa: F401
+from .save_load import TranslatedLayer, load, save  # noqa: F401
 from .train import TrainStep  # noqa: F401
-
-
-def save(layer, path, input_spec=None, **configs):
-    """Minimal jit.save: persists the state_dict; StableHLO export lands with
-    the inference module (reference: paddle.jit.save serializes a Program)."""
-    from ..framework.io_api import save as _save
-
-    _save(layer.state_dict(), path + ".pdparams")
-
-
-def load(path, **configs):
-    raise NotImplementedError(
-        "jit.load requires the inference/export module (planned); "
-        "use paddlepaddle_tpu.load + Layer.set_state_dict."
-    )

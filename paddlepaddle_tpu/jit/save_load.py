@@ -1,0 +1,114 @@
+"""jit.save / jit.load — serialized compiled models (deployment path).
+
+Reference surface: python/paddle/jit/{api.py save, translated_layer.py
+TranslatedLayer} + paddle/fluid/jit/: a saved model is (program, params).
+TPU-native: the "program" is serialized StableHLO via jax.export (versioned,
+loadable without the python model class — the role of the reference's
+.pdmodel) and params are saved alongside (.pdparams via framework.io_api).
+``load`` returns a TranslatedLayer whose forward executes the deserialized
+StableHLO with the loaded params.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import export as jexport
+
+from ..core import autograd as ag
+from ..core.dispatch import unwrap, wrap
+from ..core.tensor import Tensor
+from ..framework.io_api import load as _load_params
+from ..framework.io_api import save as _save_params
+from ..nn.layer import Layer
+
+
+def _spec_to_sds(spec, sym_counter):
+    from ..static import InputSpec
+
+    if isinstance(spec, InputSpec):
+        from ..core.dtype import convert_dtype
+
+        dims = []
+        for s in spec.shape:
+            if s is None or (isinstance(s, int) and s < 0):
+                # dynamic dim -> jax.export symbolic dimension, so the loaded
+                # model accepts any size (the reference's None batch dim)
+                name = f"d{sym_counter[0]}"
+                sym_counter[0] += 1
+                dims.append(jexport.symbolic_shape(name)[0])
+            else:
+                dims.append(s)
+        return jax.ShapeDtypeStruct(tuple(dims), convert_dtype(spec.dtype))
+    if isinstance(spec, Tensor):
+        return jax.ShapeDtypeStruct(tuple(spec.shape), spec._data.dtype)
+    arr = jnp.asarray(spec)
+    return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+
+
+def save(layer, path: str, input_spec: Optional[Sequence] = None, **configs):
+    """Write <path>.pdmodel (serialized StableHLO) + <path>.pdparams."""
+    if not isinstance(layer, Layer):
+        # StaticFunction (jit.to_static product) keeps its layer in _layer
+        inner = getattr(layer, "_layer", None)
+        if isinstance(inner, Layer):
+            layer = inner
+        else:
+            raise TypeError(f"jit.save expects a Layer or to_static-wrapped "
+                            f"Layer method, got {type(layer).__name__}")
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec (shapes to trace with)")
+    params = layer.functional_state()
+    names = sorted(params.keys())
+
+    def fn(param_list, *inputs):
+        p = dict(zip(names, param_list))
+        with ag.no_grad(), layer.bind_state(p):
+            out = layer(*inputs)
+        return jax.tree_util.tree_map(
+            lambda t: t._data if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda t: isinstance(t, Tensor))
+
+    sds_params = [jax.ShapeDtypeStruct(params[n].shape, params[n].dtype) for n in names]
+    sym_counter = [0]
+    sds_inputs = [_spec_to_sds(s, sym_counter) for s in input_spec]
+    was_training = layer.training
+    layer.eval()
+    try:
+        exp = jexport.export(jax.jit(fn))(sds_params, *sds_inputs)
+    finally:
+        if was_training:
+            layer.train()
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(exp.serialize())
+    _save_params({n: np.asarray(params[n]) for n in names}, path + ".pdparams")
+
+
+class TranslatedLayer(Layer):
+    """Loaded compiled model (reference: translated_layer.py TranslatedLayer)."""
+
+    def __init__(self, exported, params_by_name):
+        super().__init__()
+        self._exported = exported
+        self._param_names = sorted(params_by_name.keys())
+        self._param_list = [jnp.asarray(params_by_name[n]) for n in self._param_names]
+
+    def forward(self, *inputs):
+        arrs = [unwrap(x) if isinstance(x, Tensor) else jnp.asarray(x) for x in inputs]
+        out = self._exported.call(self._param_list, *arrs)
+        return jax.tree_util.tree_map(wrap, out)
+
+    def state_dict(self, *a, **k):
+        return dict(zip(self._param_names, (Tensor._from_data(p) for p in self._param_list)))
+
+
+def load(path: str, **configs) -> TranslatedLayer:
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jexport.deserialize(f.read())
+    params = _load_params(path + ".pdparams", return_numpy=True)
+    return TranslatedLayer(exported, params)

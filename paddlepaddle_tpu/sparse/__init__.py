@@ -1,0 +1,152 @@
+"""paddle.sparse — COO/CSR sparse tensors and ops.
+
+Reference surface: python/paddle/sparse/ (sparse_coo_tensor,
+sparse_csr_tensor, to_dense/to_sparse_coo, add/matmul/masked_matmul, sparse
+nn). TPU-native: backed by jax.experimental.sparse.BCOO — XLA lowers sparse
+matmuls to gather/scatter programs; note TPUs favor dense MXU compute, so
+sparse here is a capability surface (the reference's SelectedRows/PS use
+cases), not the perf path.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core.dispatch import unwrap
+from ..core.tensor import Tensor
+
+
+class SparseCooTensor(Tensor):
+    """Tensor whose payload is a BCOO; dense ops densify on demand (the
+    ``_data`` property materializes ``bcoo.todense()`` lazily, so every
+    inherited Tensor op works on the densified value)."""
+
+    __slots__ = ("_bcoo", "_dense_cache")
+
+    # shadow the base-class slot with a lazy property
+    @property
+    def _data(self):
+        if self._dense_cache is None and self._bcoo is not None:
+            self._dense_cache = self._bcoo.todense()
+        return self._dense_cache
+
+    @_data.setter
+    def _data(self, value):
+        self._dense_cache = value
+
+    @classmethod
+    def _from_bcoo(cls, bcoo):
+        t = cls.__new__(cls)
+        t._bcoo = None
+        Tensor.__init__(t, jnp.zeros([], jnp.float32))
+        t._bcoo = bcoo
+        t._dense_cache = None  # densified lazily via the property
+        return t
+
+    # -- sparse API ---------------------------------------------------------
+    def indices(self):
+        return Tensor._from_data(self._bcoo.indices.T)
+
+    def values(self):
+        return Tensor._from_data(self._bcoo.data)
+
+    def to_dense(self):
+        return Tensor._from_data(self._bcoo.todense())
+
+    def is_sparse_coo(self):
+        return True
+
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    def numpy(self):
+        return np.asarray(self._bcoo.todense())
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self._bcoo.nse}, "
+                f"dtype={self.dtype})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    idx = np.asarray(indices._data if isinstance(indices, Tensor) else indices)
+    vals = jnp.asarray(unwrap(values))
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+
+        vals = vals.astype(convert_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(i.max()) + 1 for i in idx)
+    bcoo = jsparse.BCOO((vals, jnp.asarray(idx.T)), shape=tuple(shape))
+    return SparseCooTensor._from_bcoo(bcoo)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    """CSR accepted at the API, stored as BCOO (XLA-preferred layout)."""
+    crows = np.asarray(unwrap(crows)).astype(np.int64)
+    cols = np.asarray(unwrap(cols)).astype(np.int64)
+    vals = jnp.asarray(unwrap(values))
+    rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+    idx = np.stack([rows, cols], axis=1)
+    bcoo = jsparse.BCOO((vals, jnp.asarray(idx)), shape=tuple(shape))
+    return SparseCooTensor._from_bcoo(bcoo)
+
+
+def is_sparse(x):
+    return isinstance(x, SparseCooTensor)
+
+
+def to_dense(x):
+    return x.to_dense() if isinstance(x, SparseCooTensor) else x
+
+
+def add(x, y):
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        return SparseCooTensor._from_bcoo(x._bcoo + y._bcoo)
+    return Tensor._from_data(to_dense(x)._data + to_dense(y)._data)
+
+
+def matmul(x, y):
+    """sparse @ dense (the reference's spmm)."""
+    if isinstance(x, SparseCooTensor):
+        out = x._bcoo @ (y._data if isinstance(y, Tensor) else jnp.asarray(y))
+        return Tensor._from_data(out)
+    return Tensor._from_data(unwrap(x) @ unwrap(y))
+
+
+def masked_matmul(x, y, mask: SparseCooTensor):
+    """(x @ y) sampled at mask's sparsity (SDDMM)."""
+    dense = unwrap(x) @ unwrap(y)
+    idx = mask._bcoo.indices
+    vals = dense[idx[:, 0], idx[:, 1]]
+    return SparseCooTensor._from_bcoo(
+        jsparse.BCOO((vals, idx), shape=mask._bcoo.shape))
+
+
+def relu(x):
+    if isinstance(x, SparseCooTensor):
+        return SparseCooTensor._from_bcoo(
+            jsparse.BCOO((jax.nn.relu(x._bcoo.data), x._bcoo.indices),
+                         shape=x._bcoo.shape))
+    return Tensor._from_data(jax.nn.relu(unwrap(x)))
+
+
+class nn:  # namespace parity: paddle.sparse.nn
+    @staticmethod
+    def ReLU():
+        class _R:
+            def __call__(self, x):
+                return relu(x)
+
+        return _R()

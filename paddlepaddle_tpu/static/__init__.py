@@ -1,0 +1,122 @@
+"""paddle.static — compatibility shim over jit compilation.
+
+Reference surface: python/paddle/static/ (Program/program_guard, Executor,
+data, nn re-exports). The PIR program + PirInterpreter stack (SURVEY.md
+§2.5) is absorbed by jax tracing + XLA: a "Program" here records the traced
+callables registered under its guard, and ``Executor.run`` executes the
+compiled function. Kept so reference code paths importing paddle.static
+don't break; new code should use jit.to_static directly.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn import functional as F  # noqa: F401
+
+
+class InputSpec:
+    """Reference: python/paddle/static/input.py InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+class Program:
+    def __init__(self):
+        self._feed_targets: Dict[str, "Variable"] = {}
+        self._fetch_list: List = []
+        self._fn = None
+        self.random_seed = 0
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+
+class Variable(Tensor):
+    pass
+
+
+_default_main = Program()
+_default_startup = Program()
+_prog_stack: List[Program] = []
+
+
+def default_main_program() -> Program:
+    return _prog_stack[-1] if _prog_stack else _default_main
+
+
+def default_startup_program() -> Program:
+    return _default_startup
+
+
+@contextmanager
+def program_guard(main_program: Program, startup_program: Optional[Program] = None):
+    _prog_stack.append(main_program)
+    try:
+        yield
+    finally:
+        _prog_stack.pop()
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Declare a feed placeholder (eager: returns a zero tensor template)."""
+    shape = [1 if (s is None or s < 0) else s for s in shape]
+    t = Tensor(np.zeros(shape, dtype="float32" if dtype is None else dtype))
+    t.name = name
+    prog = default_main_program()
+    prog._feed_targets[name] = t
+    return t
+
+
+class Executor:
+    """Reference: python/paddle/base/executor.py:1247. In the shim, ``run``
+    invokes ``program._fn`` (a python callable traced by jit) with the feeds;
+    programs without a function echo the fetch_list (startup programs)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program: Optional[Program] = None, feed=None, fetch_list=None,
+            return_numpy=True, **kwargs):
+        program = program or default_main_program()
+        feed = feed or {}
+        if program._fn is None:
+            return [None for _ in (fetch_list or [])]
+        out = program._fn(**feed)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        if return_numpy:
+            return [o.numpy() if isinstance(o, Tensor) else np.asarray(o) for o in outs]
+        return list(outs)
+
+    def close(self):
+        pass
+
+
+# re-exported nn helpers the reference keeps under paddle.static.nn
+class nn:  # noqa: N801 — module-like namespace
+    @staticmethod
+    def fc(x, size, num_flatten_dims=1, activation=None, name=None):
+        from ..nn.common import Linear
+
+        in_features = int(np.prod(x.shape[num_flatten_dims:]))
+        layer = Linear(in_features, size)
+        out = layer(x.reshape(list(x.shape[:num_flatten_dims]) + [in_features]))
+        if activation == "relu":
+            out = F.relu(out)
+        elif activation == "softmax":
+            out = F.softmax(out)
+        return out

@@ -1,0 +1,144 @@
+"""jit.save/load (StableHLO), inference Predictor, sparse, static shim,
+incubate fused ops, auto_parallel parallelize/to_static."""
+
+import numpy as np
+import pytest
+
+import paddlepaddle_tpu as paddle
+
+
+def test_jit_save_load_roundtrip(tmp_path):
+    from paddlepaddle_tpu.static import InputSpec
+
+    m = paddle.nn.Linear(4, 3)
+    x = np.random.default_rng(0).standard_normal((2, 4)).astype(np.float32)
+    ref = m(x).numpy()
+    path = str(tmp_path / "model")
+    paddle.jit.save(m, path, input_spec=[InputSpec([2, 4], "float32")])
+    loaded = paddle.jit.load(path)
+    out = loaded(x)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+
+def test_inference_predictor(tmp_path):
+    from paddlepaddle_tpu.inference import Config, create_predictor
+    from paddlepaddle_tpu.static import InputSpec
+
+    m = paddle.nn.Linear(4, 2)
+    x = np.random.default_rng(1).standard_normal((3, 4)).astype(np.float32)
+    ref = m(x).numpy()
+    path = str(tmp_path / "deploy")
+    paddle.jit.save(m, path, input_spec=[InputSpec([3, 4], "float32")])
+    pred = create_predictor(Config(path))
+    (out,) = pred.run([x])
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_sparse_coo():
+    idx = np.array([[0, 1, 2], [1, 2, 0]])
+    vals = np.array([1.0, 2.0, 3.0], np.float32)
+    s = paddle.sparse.sparse_coo_tensor(idx, vals, shape=[3, 3])
+    dense = s.to_dense().numpy()
+    assert dense[0, 1] == 1.0 and dense[1, 2] == 2.0 and dense[2, 0] == 3.0
+    y = np.eye(3, dtype=np.float32)
+    out = paddle.sparse.matmul(s, y)
+    np.testing.assert_allclose(out.numpy(), dense @ y)
+    r = paddle.sparse.relu(paddle.sparse.sparse_coo_tensor(idx, -vals, shape=[3, 3]))
+    assert r.to_dense().numpy().sum() == 0.0
+
+
+def test_static_shim():
+    import paddlepaddle_tpu.static as static
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [None, 4])
+        assert x.shape[1] == 4
+    exe = static.Executor()
+    prog._fn = lambda x: paddle.to_tensor(np.asarray(x) * 2)
+    (out,) = exe.run(prog, feed={"x": np.ones((2, 4), np.float32)}, fetch_list=[x])
+    np.testing.assert_allclose(out, 2 * np.ones((2, 4)))
+
+
+def test_incubate_fused_ops():
+    from paddlepaddle_tpu.incubate.nn import functional as IF
+
+    x = np.random.default_rng(0).standard_normal((2, 4, 8)).astype(np.float32)
+    w = np.ones((8,), np.float32)
+    out = IF.fused_rms_norm(paddle.to_tensor(x), paddle.to_tensor(w))
+    ref = paddle.nn.functional.rms_norm(paddle.to_tensor(x), paddle.to_tensor(w))
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5)
+
+    q = np.random.default_rng(1).standard_normal((1, 4, 2, 8)).astype(np.float32)
+    cos = np.cos(np.outer(np.arange(4), np.ones(8))).astype(np.float32)
+    sin = np.sin(np.outer(np.arange(4), np.ones(8))).astype(np.float32)
+    qo, ko, vo = IF.fused_rotary_position_embedding(
+        paddle.to_tensor(q), sin=paddle.to_tensor(sin), cos=paddle.to_tensor(cos))
+    assert qo.shape == [1, 4, 2, 8] and ko is None
+
+
+def test_incubate_autograd():
+    from paddlepaddle_tpu.incubate.autograd import hessian, jacobian
+
+    x = np.array([1.0, 2.0], np.float32)
+    jac = jacobian(lambda t: (t * t).sum(), paddle.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(jac.numpy()), [2.0, 4.0], rtol=1e-5)
+    h = hessian(lambda t: (t ** 3).sum(), paddle.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(h.numpy()), np.diag([6.0, 12.0]), rtol=1e-5)
+
+
+def test_parallelize_plans():
+    from paddlepaddle_tpu.distributed import ColWiseParallel, RowWiseParallel, parallelize
+
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.up = paddle.nn.Linear(8, 16)
+            self.down = paddle.nn.Linear(16, 8)
+
+        def forward(self, x):
+            return self.down(self.up(x))
+
+    net = Net()
+    parallelize(net, config={"mp_config": {"parallelize_plan": {
+        "up": ColWiseParallel(), "down": RowWiseParallel()}}})
+    assert net.up.weight.dist_spec == (None, "mp")
+    assert net.up.bias.dist_spec == ("mp",)
+    assert net.down.weight.dist_spec == ("mp", None)
+    with pytest.raises(ValueError):
+        parallelize(net, config={"mp_config": {"parallelize_plan": {
+            "nonexistent_layer_xyz": ColWiseParallel()}}})
+
+
+def test_dist_to_static():
+    import jax
+
+    from paddlepaddle_tpu.distributed import to_static
+    from paddlepaddle_tpu.distributed.mesh import ProcessMesh, set_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    mesh = ProcessMesh(shape=[4, 2], dim_names=["dp", "mp"])
+    set_mesh(mesh)
+
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = paddle.nn.Linear(8, 8)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    net = Net()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2, parameters=net.parameters())
+    dist_model = to_static(net, loss=paddle.nn.functional.mse_loss, optimizer=opt,
+                           mesh=mesh, rules=[(r".*", ())])
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((8, 8)).astype(np.float32)
+    y = rng.standard_normal((8, 8)).astype(np.float32)
+    losses = [float(dist_model(x, y).numpy()) for _ in range(5)]
+    assert losses[-1] < losses[0]
+    dist_model.eval()
+    ev = float(dist_model(x, y).numpy())
+    assert np.isfinite(ev)
+    set_mesh(None)
