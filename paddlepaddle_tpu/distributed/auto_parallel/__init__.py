@@ -173,6 +173,9 @@ class DistModel:
         self._mode = "train"
 
     def eval(self):
+        if self._loss is None:
+            raise ValueError("DistModel.eval() requires a loss; this model was "
+                             "built for predict only (construct with loss=...)")
         self._mode = "eval"
 
     def predict(self):

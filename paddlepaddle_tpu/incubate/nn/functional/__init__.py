@@ -48,18 +48,26 @@ def fused_layer_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-5,
 def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
                                     position_ids=None, use_neox_rotary_style=True,
                                     time_major=False, rotary_emb_base=10000.0):
-    """Reference: fused_rope — BSHD q/k(/v passthrough), neox rotate-half."""
+    """Reference: fused_rope — BSHD q/k(/v passthrough), neox rotate-half.
+    ``position_ids`` [b, s] selects per-token table rows (KV-cache decode)."""
 
     def rope(x, c, s):
-        def f(xa, ca, sa):
+        def f(xa, ca, sa, pos):
             seq = xa.shape[1]
-            ca = ca.reshape(1, seq, 1, -1).astype(xa.dtype)
-            sa = sa.reshape(1, seq, 1, -1).astype(xa.dtype)
+            ca = ca.reshape(-1, ca.shape[-1])
+            sa = sa.reshape(-1, sa.shape[-1])
+            if pos is not None:
+                ca = ca[pos.astype(jnp.int32)][:, :, None, :]   # [b, s, 1, dim]
+                sa = sa[pos.astype(jnp.int32)][:, :, None, :]
+            else:
+                ca = ca[:seq].reshape(1, seq, 1, -1)
+                sa = sa[:seq].reshape(1, seq, 1, -1)
+            ca, sa = ca.astype(xa.dtype), sa.astype(xa.dtype)
             half = xa.shape[-1] // 2
             rot = jnp.concatenate([-xa[..., half:], xa[..., :half]], axis=-1)
             return xa * ca + rot * sa
 
-        return apply_op(f, x, c, s, op_name="fused_rope")
+        return apply_op(f, x, c, s, position_ids, op_name="fused_rope")
 
     outs = [rope(q, cos, sin)]
     outs.append(rope(k, cos, sin) if k is not None else None)
@@ -132,8 +140,6 @@ def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
     from ....parallel.moe import MoELayer, NaiveGate
 
     b, s, d = x.shape[0], x.shape[1], x.shape[-1]
-    e, _, hidden = (ffn1_weight.shape if not hasattr(ffn1_weight, "_data")
-                    else tuple(ffn1_weight.shape))
 
     def run(xa, gw, w1, w2, b1, b2):
         logits = xa.reshape(-1, d).astype(jnp.float32) @ gw.astype(jnp.float32)

@@ -82,6 +82,8 @@ class Predictor:
         return list(self._input_names)
 
     def get_input_handle(self, name):
+        if name not in self._input_names:
+            raise KeyError(f"unknown input {name!r}; inputs are {self._input_names}")
         return self._inputs.setdefault(name, PredictorTensor(name))
 
     def get_output_names(self):
@@ -93,8 +95,13 @@ class Predictor:
 
     def run(self, inputs: Optional[List[np.ndarray]] = None):
         if inputs is None:
-            inputs = [self._inputs[n]._value for n in self._input_names
-                      if n in self._inputs]
+            missing = [n for n in self._input_names
+                       if n not in self._inputs or self._inputs[n]._value is None]
+            if missing:
+                raise RuntimeError(
+                    f"inputs {missing} not set; call get_input_handle(name)."
+                    f"copy_from_cpu(arr) for every input first")
+            inputs = [self._inputs[n]._value for n in self._input_names]
         outs = self._layer(*inputs)
         outs = outs if isinstance(outs, (list, tuple)) else [outs]
         self._outputs = []

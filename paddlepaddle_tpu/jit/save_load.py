@@ -27,7 +27,7 @@ from ..framework.io_api import save as _save_params
 from ..nn.layer import Layer
 
 
-def _spec_to_sds(spec, sym_counter):
+def _spec_to_sds(spec, sym_state):
     from ..static import InputSpec
 
     if isinstance(spec, InputSpec):
@@ -37,10 +37,13 @@ def _spec_to_sds(spec, sym_counter):
         for s in spec.shape:
             if s is None or (isinstance(s, int) and s < 0):
                 # dynamic dim -> jax.export symbolic dimension, so the loaded
-                # model accepts any size (the reference's None batch dim)
-                name = f"d{sym_counter[0]}"
-                sym_counter[0] += 1
-                dims.append(jexport.symbolic_shape(name)[0])
+                # model accepts any size (the reference's None batch dim).
+                # All symbols must live in ONE SymbolicScope.
+                if sym_state.get("scope") is None:
+                    sym_state["scope"] = jexport.SymbolicScope()
+                name = f"d{sym_state['n']}"
+                sym_state["n"] += 1
+                dims.append(jexport.symbolic_shape(name, scope=sym_state["scope"])[0])
             else:
                 dims.append(s)
         return jax.ShapeDtypeStruct(tuple(dims), convert_dtype(spec.dtype))
@@ -61,7 +64,11 @@ def save(layer, path: str, input_spec: Optional[Sequence] = None, **configs):
             raise TypeError(f"jit.save expects a Layer or to_static-wrapped "
                             f"Layer method, got {type(layer).__name__}")
     if input_spec is None:
-        raise ValueError("jit.save requires input_spec (shapes to trace with)")
+        # params-only save (previous minimal behavior); load() will explain
+        # that a .pdmodel needs an input_spec'd save
+        _save_params({k: np.asarray(v) for k, v in layer.functional_state().items()},
+                     path + ".pdparams")
+        return
     params = layer.functional_state()
     names = sorted(params.keys())
 
@@ -74,8 +81,8 @@ def save(layer, path: str, input_spec: Optional[Sequence] = None, **configs):
             is_leaf=lambda t: isinstance(t, Tensor))
 
     sds_params = [jax.ShapeDtypeStruct(params[n].shape, params[n].dtype) for n in names]
-    sym_counter = [0]
-    sds_inputs = [_spec_to_sds(s, sym_counter) for s in input_spec]
+    sym_state = {"scope": None, "n": 0}
+    sds_inputs = [_spec_to_sds(s, sym_state) for s in input_spec]
     was_training = layer.training
     layer.eval()
     try:
@@ -108,6 +115,11 @@ class TranslatedLayer(Layer):
 
 
 def load(path: str, **configs) -> TranslatedLayer:
+    if not os.path.exists(path + ".pdmodel"):
+        raise FileNotFoundError(
+            f"{path}.pdmodel not found — this checkpoint was saved without "
+            f"input_spec (params only); re-save with jit.save(layer, path, "
+            f"input_spec=[...]) to export a loadable compiled program")
     with open(path + ".pdmodel", "rb") as f:
         exported = jexport.deserialize(f.read())
     params = _load_params(path + ".pdparams", return_numpy=True)

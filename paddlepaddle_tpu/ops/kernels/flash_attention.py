@@ -1,15 +1,20 @@
-"""Flash attention — Pallas TPU kernel + XLA fallback.
+"""Flash attention — Pallas TPU kernels (forward AND backward) + XLA fallback.
 
 Reference surface: python/paddle/nn/functional/flash_attention.py:364 (BSHD
 [batch, seq, heads, head_dim], fp16/bf16, causal) backed by dynload flashattn
-CUDA kernels (paddle/phi/backends/dynload/flashattn.cc). Here the TPU-native
-implementation is an online-softmax Pallas kernel tiled for the MXU: grid over
-(batch*heads, q-blocks), inner fori_loop over kv-blocks held in VMEM, f32
-accumulators, causal masking by block skip.
+CUDA kernels (paddle/phi/backends/dynload/flashattn.cc). TPU-native
+implementation: online-softmax kernels tiled for the MXU —
 
-Backward currently recomputes attention via the XLA path (flash-style
-recompute — O(N) memory, matching jax.checkpoint semantics); a dedicated
-Pallas backward kernel is a planned optimization.
+* forward: grid (batch*heads, q-blocks), inner fori_loop over kv blocks in
+  VMEM, f32 accumulators, causal block skip; also emits the log-sum-exp rows
+  used by backward.
+* backward: the standard flash bwd pair — a dQ kernel (grid over q-blocks,
+  loop kv) and a dK/dV kernel (grid over kv-blocks, loop q), both
+  recomputing p = exp(s - lse) blockwise so memory stays O(seq·d), never
+  O(seq²). delta = rowsum(dO∘O) is precomputed with one fused XLA op.
+
+When the Pallas path is unavailable (CPU tests, odd shapes) both directions
+fall back to one XLA einsum attention (recompute-style backward).
 """
 
 from __future__ import annotations
@@ -25,7 +30,7 @@ from ...core.flags import flag_value
 
 try:  # pallas import is cheap; kernels only compile when called on TPU
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
 
     _HAS_PALLAS = True
 except Exception:  # pragma: no cover
@@ -43,8 +48,22 @@ def _use_pallas(q) -> bool:
     return q.shape[-1] % 128 == 0 or q.shape[-1] in (64, 128, 256)
 
 
+def _blocks(sq, sk):
+    block_q = min(int(flag_value("flash_attn_block_q")), sq)
+    block_kv = min(int(flag_value("flash_attn_block_kv")), sk)
+    while sq % block_q:
+        block_q //= 2
+    while sk % block_kv:
+        block_kv //= 2
+    block_q = max(block_q, 8)
+    block_kv = max(block_kv, 8)
+    if sq % block_q or sk % block_kv:
+        return None
+    return block_q, block_kv
+
+
 # ---------------------------------------------------------------------------
-# XLA reference path (also the recompute backward)
+# XLA reference path (fallback fwd + recompute bwd)
 # ---------------------------------------------------------------------------
 
 
@@ -69,14 +88,14 @@ def _xla_attention(q, k, v, causal, mask, scale):
 
 
 # ---------------------------------------------------------------------------
-# Pallas forward kernel
+# Pallas kernels. Block refs carry a leading singleton grid dim; [0] strips it.
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q, block_kv, seq_k):
-    # q_ref: [block_q, d]; k_ref/v_ref: [seq_k, d]; o_ref: [block_q, d]
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                block_q, block_kv, seq_k):
     qi = pl.program_id(1)
-    q = q_ref[:].astype(jnp.float32) * scale
+    q = q_ref[0].astype(jnp.float32) * scale          # [bq, d]
     d = q.shape[-1]
 
     m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
@@ -85,16 +104,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q, block_kv,
 
     num_kv = seq_k // block_kv
     if causal:
-        # only visit kv blocks that intersect the causal triangle
         num_visit = qi * block_q // block_kv + pl.cdiv(block_q, block_kv)
     else:
         num_visit = num_kv
 
     def body(j, carry):
         m, l, acc = carry
-        k = k_ref[pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
-        v = v_ref[pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [bq, bkv]
+        k = k_ref[0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
             k_pos = j * block_kv + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
@@ -107,29 +125,91 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q, block_kv,
         return m_new, l_new, acc_new
 
     m, l, acc = jax.lax.fori_loop(0, num_visit, body, (m0, l0, acc0))
-    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l_safe)  # [bq, 1]
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               scale, causal, block_q, block_kv, seq_k):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)                  # [bq, d]
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]                                  # [bq, 1]
+    delta = delta_ref[0]
+    d = q.shape[-1]
+
+    if causal:
+        num_visit = qi * block_q // block_kv + pl.cdiv(block_q, block_kv)
+    else:
+        num_visit = seq_k // block_kv
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
+        s = jnp.dot(q * scale, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
+            k_pos = j * block_kv + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                          # [bq, bkv]
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, num_visit, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *,
+                scale, causal, block_q, block_kv, seq_q):
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)                  # [bkv, d]
+    v = v_ref[0].astype(jnp.float32)
+    d = k.shape[-1]
+    num_q = seq_q // block_q
+    if causal:
+        # q blocks at or after this kv block participate
+        start = (ki * block_kv) // block_q
+    else:
+        start = 0
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q), :]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q), :]
+        s = jnp.dot(q * scale, k.T, preferred_element_type=jnp.float32)  # [bq, bkv]
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
+            k_pos = ki * block_kv + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dv_new = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk_new = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    zeros = jnp.zeros((block_kv, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start, num_q, body, (zeros, zeros))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
 def _pallas_forward(q, k, v, causal, scale):
-    """q,k,v: [bh, s, d] (already flattened batch*heads)."""
+    """q,k,v: [bh, s, d]. Returns (out, lse) or None on unsupported shapes."""
     bh, sq, d = q.shape
     sk = k.shape[1]
-    block_q = min(int(flag_value("flash_attn_block_q")), sq)
-    block_kv = min(int(flag_value("flash_attn_block_kv")), sk)
-    # shrink blocks until they divide the sequence
-    while sq % block_q:
-        block_q //= 2
-    while sk % block_kv:
-        block_kv //= 2
-    block_q = max(block_q, 8)
-    block_kv = max(block_kv, 8)
-    if sq % block_q or sk % block_kv:
-        return None  # fallback
+    blocks = _blocks(sq, sk)
+    if blocks is None:
+        return None
+    block_q, block_kv = blocks
 
     kernel = functools.partial(
-        _fwd_kernel_wrapped, scale=scale, causal=causal, block_q=block_q,
-        block_kv=block_kv, seq_k=sk,
-    )
+        _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_kv=block_kv, seq_k=sk)
     grid = (bh, sq // block_q)
     # Mosaic lowering has no int64/float64 path (jax 0.9 _convert_helper
     # recurses forever on unsupported casts); the package enables x64 globally
@@ -143,41 +223,56 @@ def _pallas_forward(q, k, v, causal, scale):
                 pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
                 pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
             ],
-            out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            out_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+                jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+            ],
         )(q, k, v)
 
 
-# Blocks arrive with a leading singleton dim; reshape inside the kernel refs is
-# awkward, so wrap the kernel to squeeze/unsqueeze.
-def _fwd_kernel_wrapped(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q, block_kv, seq_k):
-    class _Squeezed:
-        def __init__(self, ref):
-            self._ref = ref
+def _pallas_backward(q, k, v, out, lse, do, causal, scale):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    blocks = _blocks(sq, sk)
+    if blocks is None:
+        return None
+    block_q, block_kv = blocks
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # [bh, sq, 1]
 
-        def __getitem__(self, idx):
-            if isinstance(idx, tuple):
-                return self._ref[(0,) + idx]
-            return self._ref[(0, idx)]
+    full_q = pl.BlockSpec((1, sq, d), lambda b, i: (b, 0, 0))
+    full_kv = pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0))
+    row_q = pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0))
+    row_kv = pl.BlockSpec((1, block_kv, d), lambda b, i: (b, i, 0))
+    vec_q_block = pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0))
+    vec_q_full = pl.BlockSpec((1, sq, 1), lambda b, i: (b, 0, 0))
 
-        def __setitem__(self, idx, val):
-            if isinstance(idx, tuple):
-                self._ref[(0,) + idx] = val
-            else:
-                self._ref[(0, idx)] = val
+    with jax.enable_x64(False):
+        dq = pl.pallas_call(
+            functools.partial(_dq_kernel, scale=scale, causal=causal,
+                              block_q=block_q, block_kv=block_kv, seq_k=sk),
+            grid=(bh, sq // block_q),
+            in_specs=[row_q, full_kv, full_kv, row_q, vec_q_block, vec_q_block],
+            out_specs=row_q,
+            out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        )(q, k, v, do, lse, delta)
 
-        @property
-        def shape(self):
-            return self._ref.shape[1:]
-
-        @property
-        def dtype(self):
-            return self._ref.dtype
-
-    _fwd_kernel(
-        _Squeezed(q_ref), _Squeezed(k_ref), _Squeezed(v_ref), _Squeezed(o_ref),
-        scale=scale, causal=causal, block_q=block_q, block_kv=block_kv, seq_k=seq_k,
-    )
+        dk, dv = pl.pallas_call(
+            functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                              block_q=block_q, block_kv=block_kv, seq_q=sq),
+            grid=(bh, sk // block_kv),
+            in_specs=[full_q, row_kv, row_kv, full_q, vec_q_full, vec_q_full],
+            out_specs=[row_kv, row_kv],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+                jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+            ],
+        )(q, k, v, do, lse, delta)
+    return dq, dk, dv
 
 
 # ---------------------------------------------------------------------------
@@ -185,31 +280,53 @@ def _fwd_kernel_wrapped(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q, b
 # ---------------------------------------------------------------------------
 
 
+def _bshd_to_flat(x):
+    b, s, h, d = x.shape
+    return jnp.swapaxes(x, 1, 2).reshape(b * h, s, d)
+
+
+def _flat_to_bshd(x, b, h):
+    bh, s, d = x.shape
+    return jnp.swapaxes(x.reshape(b, h, s, d), 1, 2)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _flash_core(q, k, v, causal, scale, use_pallas):
-    return _flash_fwd_impl(q, k, v, causal, scale, use_pallas)
+    out, _ = _flash_fwd_impl(q, k, v, causal, scale, use_pallas)
+    return out
 
 
 def _flash_fwd_impl(q, k, v, causal, scale, use_pallas):
     if use_pallas:
         b, s, h, d = q.shape
-        qf = jnp.swapaxes(q, 1, 2).reshape(b * h, s, d)
-        kf = jnp.swapaxes(k, 1, 2).reshape(b * h, k.shape[1], d)
-        vf = jnp.swapaxes(v, 1, 2).reshape(b * h, v.shape[1], d)
-        out = _pallas_forward(qf, kf, vf, causal, scale)
-        if out is not None:
-            return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2)
-    return _xla_attention(q, k, v, causal, None, scale)
+        res = _pallas_forward(_bshd_to_flat(q), _bshd_to_flat(k),
+                              _bshd_to_flat(v), causal, scale)
+        if res is not None:
+            out_flat, lse = res
+            return _flat_to_bshd(out_flat, b, h), lse
+    return _xla_attention(q, k, v, causal, None, scale), None
 
 
 def _flash_fwd(q, k, v, causal, scale, use_pallas):
-    out = _flash_core(q, k, v, causal, scale, use_pallas)
-    return out, (q, k, v)
+    out, lse = _flash_fwd_impl(q, k, v, causal, scale, use_pallas)
+    # out is a residual only for the Pallas backward (delta = rowsum(dO∘O));
+    # the XLA recompute fallback never reads it — don't keep it alive there
+    res_out = out if lse is not None else None
+    return out, (q, k, v, res_out, lse)
 
 
 def _flash_bwd(causal, scale, use_pallas, res, g):
-    q, k, v = res
-    # flash-style recompute: re-run attention under VJP (O(N) memory)
+    q, k, v, out, lse = res
+    if use_pallas and lse is not None:
+        b, s, h, d = q.shape
+        grads = _pallas_backward(
+            _bshd_to_flat(q), _bshd_to_flat(k), _bshd_to_flat(v),
+            _bshd_to_flat(out), lse, _bshd_to_flat(g), causal, scale)
+        if grads is not None:
+            dq, dk, dv = grads
+            return (_flat_to_bshd(dq, b, h), _flat_to_bshd(dk, b, h),
+                    _flat_to_bshd(dv, b, h))
+    # recompute fallback (O(N²) intermediate, XLA-fused)
     _, vjp = jax.vjp(lambda q_, k_, v_: _xla_attention(q_, k_, v_, causal, None, scale), q, k, v)
     return vjp(g)
 

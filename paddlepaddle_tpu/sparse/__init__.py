@@ -28,7 +28,8 @@ class SparseCooTensor(Tensor):
 
     __slots__ = ("_bcoo", "_dense_cache")
 
-    # shadow the base-class slot with a lazy property
+    # shadow the base-class slot with a lazy property; assigning a new dense
+    # payload invalidates the BCOO (re-sparsified on the next sparse accessor)
     @property
     def _data(self):
         if self._dense_cache is None and self._bcoo is not None:
@@ -38,6 +39,13 @@ class SparseCooTensor(Tensor):
     @_data.setter
     def _data(self, value):
         self._dense_cache = value
+        if getattr(self, "_bcoo", None) is not None and value is not None:
+            self._bcoo = None  # stale; _coo() rebuilds from the dense value
+
+    def _coo(self):
+        if self._bcoo is None:
+            self._bcoo = jsparse.BCOO.fromdense(self._dense_cache)
+        return self._bcoo
 
     @classmethod
     def _from_bcoo(cls, bcoo):
@@ -50,30 +58,32 @@ class SparseCooTensor(Tensor):
 
     # -- sparse API ---------------------------------------------------------
     def indices(self):
-        return Tensor._from_data(self._bcoo.indices.T)
+        return Tensor._from_data(self._coo().indices.T)
 
     def values(self):
-        return Tensor._from_data(self._bcoo.data)
+        return Tensor._from_data(self._coo().data)
 
     def to_dense(self):
-        return Tensor._from_data(self._bcoo.todense())
+        return Tensor._from_data(self._coo().todense())
 
     def is_sparse_coo(self):
         return True
 
     @property
     def shape(self):
-        return list(self._bcoo.shape)
+        if self._bcoo is not None:
+            return list(self._bcoo.shape)
+        return list(self._dense_cache.shape)
 
     @property
     def dtype(self):
-        return self._bcoo.dtype
+        return self._bcoo.dtype if self._bcoo is not None else self._dense_cache.dtype
 
     def numpy(self):
-        return np.asarray(self._bcoo.todense())
+        return np.asarray(self._data)
 
     def __repr__(self):
-        return (f"SparseCooTensor(shape={self.shape}, nnz={self._bcoo.nse}, "
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self._coo().nse}, "
                 f"dtype={self.dtype})")
 
 
@@ -113,14 +123,14 @@ def to_dense(x):
 
 def add(x, y):
     if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
-        return SparseCooTensor._from_bcoo(x._bcoo + y._bcoo)
+        return SparseCooTensor._from_bcoo(x._coo() + y._coo())
     return Tensor._from_data(to_dense(x)._data + to_dense(y)._data)
 
 
 def matmul(x, y):
     """sparse @ dense (the reference's spmm)."""
     if isinstance(x, SparseCooTensor):
-        out = x._bcoo @ (y._data if isinstance(y, Tensor) else jnp.asarray(y))
+        out = x._coo() @ (y._data if isinstance(y, Tensor) else jnp.asarray(y))
         return Tensor._from_data(out)
     return Tensor._from_data(unwrap(x) @ unwrap(y))
 
@@ -128,17 +138,18 @@ def matmul(x, y):
 def masked_matmul(x, y, mask: SparseCooTensor):
     """(x @ y) sampled at mask's sparsity (SDDMM)."""
     dense = unwrap(x) @ unwrap(y)
-    idx = mask._bcoo.indices
+    coo = mask._coo()
+    idx = coo.indices
     vals = dense[idx[:, 0], idx[:, 1]]
     return SparseCooTensor._from_bcoo(
-        jsparse.BCOO((vals, idx), shape=mask._bcoo.shape))
+        jsparse.BCOO((vals, idx), shape=coo.shape))
 
 
 def relu(x):
     if isinstance(x, SparseCooTensor):
+        coo = x._coo()
         return SparseCooTensor._from_bcoo(
-            jsparse.BCOO((jax.nn.relu(x._bcoo.data), x._bcoo.indices),
-                         shape=x._bcoo.shape))
+            jsparse.BCOO((jax.nn.relu(coo.data), coo.indices), shape=coo.shape))
     return Tensor._from_data(jax.nn.relu(unwrap(x)))
 
 
