@@ -94,6 +94,7 @@ from . import models  # noqa: E402,F401
 from . import nn  # noqa: E402,F401
 from . import optimizer  # noqa: E402,F401
 from . import profiler  # noqa: E402,F401
+from . import quantization  # noqa: E402,F401
 from . import sparse  # noqa: E402,F401
 from . import static  # noqa: E402,F401
 from . import vision  # noqa: E402,F401

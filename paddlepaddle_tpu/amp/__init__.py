@@ -115,3 +115,4 @@ def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
     if optimizers is None:
         return models
     return models, optimizers
+from . import debugging  # noqa: F401

@@ -1,0 +1,130 @@
+"""amp.debugging — numerical sanitizers (reference: python/paddle/amp/
+debugging.py:56,361,481,654 — check_numerics, TensorCheckerConfig,
+enable_operator_stats_collection; C++ side paddle/fluid/eager/nan_inf_utils).
+
+TPU-native: host-side scans over device arrays (jnp reductions — one fused
+kernel per check); the per-op autocheck installs a dispatcher hook, the
+analogue of FLAGS_check_nan_inf's per-kernel scan.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import set_op_observer, unwrap
+from ..core.tensor import Tensor
+
+
+class DebugMode:
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 4
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT):
+    """Count (num_nan, num_inf, num_zero); abort mode raises on nan/inf."""
+    a = unwrap(tensor)
+    num_nan = int(jnp.isnan(a).sum())
+    num_inf = int(jnp.isinf(a).sum())
+    num_zero = int((a == 0).sum())
+    if debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT and (num_nan or num_inf):
+        raise FloatingPointError(
+            f"[check_numerics] op={op_type} var={var_name}: "
+            f"{num_nan} NaN, {num_inf} Inf in tensor of shape {list(a.shape)}")
+    return (Tensor._from_data(jnp.asarray(num_nan)),
+            Tensor._from_data(jnp.asarray(num_inf)),
+            Tensor._from_data(jnp.asarray(num_zero)))
+
+
+class TensorCheckerConfig:
+    """Reference debugging.py:481."""
+
+    def __init__(self, enable=True, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir=None, checked_op_list=None, skipped_op_list=None,
+                 debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.checked_op_list = set(checked_op_list or [])
+        self.skipped_op_list = set(skipped_op_list or [])
+
+
+_checker: Optional[TensorCheckerConfig] = None
+_op_stats = defaultdict(lambda: defaultdict(int))
+_collecting = False
+
+
+def _observer(op_name, out_datas):
+    if _collecting:
+        for a in out_datas:
+            if hasattr(a, "dtype"):
+                _op_stats[op_name][str(a.dtype)] += 1
+    cfg = _checker
+    if cfg is None or not cfg.enable:
+        return
+    if cfg.checked_op_list and op_name not in cfg.checked_op_list:
+        return
+    if op_name in cfg.skipped_op_list:
+        return
+    import jax
+
+    for a in out_datas:
+        if not hasattr(a, "dtype") or not jnp.issubdtype(a.dtype, jnp.floating):
+            continue
+        if isinstance(a, jax.core.Tracer):
+            # under jit/export tracing there is no concrete value to test;
+            # the traced program itself is checked when executed eagerly
+            continue
+        bad = bool(jnp.any(jnp.isnan(a)) or jnp.any(jnp.isinf(a)))
+        if bad:
+            if cfg.debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+                raise FloatingPointError(f"NaN/Inf detected in output of op {op_name!r}")
+            print(f"[nan_inf] op {op_name!r} produced NaN/Inf")
+
+
+def enable_tensor_checker(checker_config: TensorCheckerConfig):
+    global _checker
+    _checker = checker_config
+    set_op_observer(_observer)
+
+
+def disable_tensor_checker():
+    global _checker
+    _checker = None
+    if not _collecting:
+        set_op_observer(None)
+
+
+def enable_operator_stats_collection():
+    global _collecting
+    _collecting = True
+    _op_stats.clear()
+    set_op_observer(_observer)
+
+
+def disable_operator_stats_collection():
+    global _collecting
+    _collecting = False
+    if _checker is None:
+        set_op_observer(None)
+    print("<------------------------------ op list ------------------------------->")
+    for op, dtypes in sorted(_op_stats.items()):
+        counts = ", ".join(f"{d}: {c}" for d, c in dtypes.items())
+        print(f"  {op:<30} {counts}")
+
+
+def collect_operator_stats():
+    from contextlib import contextmanager
+
+    @contextmanager
+    def ctx():
+        enable_operator_stats_collection()
+        try:
+            yield
+        finally:
+            disable_operator_stats_collection()
+
+    return ctx()

@@ -29,10 +29,19 @@ from .tensor import Tensor
 # AMP hook: paddlepaddle_tpu.amp installs a callable (op_name, datas) -> datas.
 _amp_cast_hook = None
 
+# post-op observer: amp.debugging installs (op_name, out_datas) -> None for
+# the per-op NaN/Inf scan (FLAGS_check_nan_inf analogue) and op-stats.
+_op_observer = None
+
 
 def set_amp_cast_hook(hook):
     global _amp_cast_hook
     _amp_cast_hook = hook
+
+
+def set_op_observer(observer):
+    global _op_observer
+    _op_observer = observer
 
 
 def _requires_grad(t: Tensor) -> bool:
@@ -64,6 +73,8 @@ def apply_op(fn: Callable, *args, op_name: str = None, **kwargs) -> Any:
 
     if not diff_pos:
         out = run(datas)
+        if _op_observer is not None:
+            _op_observer(name, jax.tree_util.tree_leaves(out))
         return jax.tree_util.tree_map(
             lambda x: Tensor._from_data(x, stop_gradient=True), out
         )
@@ -77,6 +88,8 @@ def apply_op(fn: Callable, *args, op_name: str = None, **kwargs) -> Any:
     primal_out, vjp_fn = jax.vjp(pure, *[datas[p] for p in diff_pos])
 
     out_leaves, out_treedef = jax.tree_util.tree_flatten(primal_out)
+    if _op_observer is not None:
+        _op_observer(name, out_leaves)
     node = ag.GradNode(
         name,
         lambda cts: vjp_fn(jax.tree_util.tree_unflatten(out_treedef, list(cts))),

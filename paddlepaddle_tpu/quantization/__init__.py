@@ -1,0 +1,201 @@
+"""paddle.quantization — QAT / PTQ (reference: python/paddle/quantization/
+with observer/quanter factories, QuantConfig, QAT/PTQ drivers + nn/quant
+fake-quant layers).
+
+TPU-native: fake-quant is simulated int8 in bf16/f32 compute (quantize →
+dequantize with a straight-through estimator), which is how the reference's
+QAT works too; XLA fuses the quant/dequant pairs into the surrounding ops.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+
+def _fake_quant(x, scale, bits=8):
+    """Symmetric per-tensor fake quantization with STE gradients."""
+    qmax = 2.0 ** (bits - 1) - 1
+
+    @jax.custom_vjp
+    def fq(a, s):
+        s = jnp.maximum(s, 1e-9)
+        return jnp.clip(jnp.round(a / s * qmax), -qmax, qmax) * s / qmax
+
+    def fwd(a, s):
+        return fq(a, s), (a, s)
+
+    def bwd(res, g):
+        a, s = res
+        s = jnp.maximum(s, 1e-9)
+        inside = (jnp.abs(a) <= s).astype(g.dtype)  # STE, clip outside range
+        return g * inside, jnp.zeros_like(s)
+
+    fq.defvjp(fwd, bwd)
+    return fq(x, scale)
+
+
+class BaseObserver:
+    """Collects statistics to derive a quant scale (reference observers)."""
+
+    def __init__(self, quant_bits=8):
+        self.quant_bits = quant_bits
+        self._scale = None
+
+    def observe(self, x: np.ndarray):
+        raise NotImplementedError
+
+    def scale(self) -> float:
+        return float(self._scale if self._scale is not None else 1.0)
+
+
+class AbsmaxObserver(BaseObserver):
+    def observe(self, x):
+        m = float(np.max(np.abs(x))) if x.size else 0.0
+        self._scale = m if self._scale is None else max(self._scale, m)
+
+
+class EMAObserver(BaseObserver):
+    def __init__(self, quant_bits=8, moving_rate=0.9):
+        super().__init__(quant_bits)
+        self.moving_rate = moving_rate
+
+    def observe(self, x):
+        m = float(np.max(np.abs(x))) if x.size else 0.0
+        self._scale = m if self._scale is None else (
+            self.moving_rate * self._scale + (1 - self.moving_rate) * m)
+
+
+class FakeQuanterWithAbsMax(Layer):
+    """QAT fake-quant layer (reference: nn/quant fake quanters)."""
+
+    def __init__(self, quant_bits=8, moving_rate=0.9, name=None):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.moving_rate = moving_rate
+        self.register_buffer("scale", Tensor(np.asarray(1.0, np.float32)))
+
+    def forward(self, x):
+        if self.training:
+            cur = apply_op(lambda a: jnp.max(jnp.abs(a)), x)
+            new_scale = apply_op(
+                lambda s, c: self.moving_rate * s + (1 - self.moving_rate) * c,
+                self.scale, cur.detach())
+            self.scale._replace_data(new_scale._data)
+        return apply_op(lambda a, s: _fake_quant(a, s, self.quant_bits), x, self.scale)
+
+
+class QuantConfig:
+    """Reference: quantization/config.py QuantConfig."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation or FakeQuanterWithAbsMax
+        self.weight = weight or FakeQuanterWithAbsMax
+        self._layer_types = []
+
+    def add_type_config(self, layer_types, activation=None, weight=None):
+        types = layer_types if isinstance(layer_types, (list, tuple)) else [layer_types]
+        self._layer_types.extend(types)
+        if activation is not None:
+            self.activation = activation
+        if weight is not None:
+            self.weight = weight
+
+    def matches(self, layer) -> bool:
+        from ..nn.common import Linear
+        from ..nn.conv import _ConvNd
+
+        types = tuple(self._layer_types) or (Linear, _ConvNd)
+        return isinstance(layer, types)
+
+
+class QuantedWrapper(Layer):
+    """Wraps a Linear/Conv with activation+weight fake quanters."""
+
+    def __init__(self, inner: Layer, config: QuantConfig):
+        super().__init__()
+        self.inner = inner
+        self.act_quanter = config.activation()
+        self.weight_quanter = config.weight()
+
+    def forward(self, *args, **kwargs):
+        x = self.act_quanter(args[0])
+        w = self.inner.weight
+        saved = w._data
+        try:
+            w._data = self.weight_quanter(Tensor._from_data(saved))._data
+            return self.inner(x, *args[1:], **kwargs)
+        finally:
+            w._data = saved
+
+
+class QAT:
+    """Quantization-aware training driver (reference: quantization/qat.py)."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: Layer, inplace=False) -> Layer:
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)  # reference keeps the FP model intact
+        for name, sub in list(model.named_children()):
+            if self.config.matches(sub):
+                model.add_sublayer(name, QuantedWrapper(sub, self.config))
+            else:
+                self.quantize(sub, inplace=True)
+        return model
+
+    def convert(self, model: Layer, inplace=False) -> Layer:
+        return model  # fake-quant stays; XLA folds constants at export
+
+
+class PTQ:
+    """Post-training quantization: run calibration batches through observers,
+    then bake per-tensor scales (reference: quantization/ptq.py)."""
+
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self.config = config or QuantConfig()
+        self._observers: Dict[int, AbsmaxObserver] = {}
+        self._hooks = []
+
+    def quantize(self, model: Layer, inplace=False) -> Layer:
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+        for _, sub in model.named_sublayers(include_self=True):
+            if self.config.matches(sub):
+                obs = AbsmaxObserver()
+                self._observers[id(sub)] = obs
+
+                def hook(l, inputs, _obs=obs):
+                    first = inputs[0]
+                    _obs.observe(np.asarray(
+                        first.numpy() if hasattr(first, "numpy") else first))
+
+                self._hooks.append(sub.register_forward_pre_hook(hook))
+        return model
+
+    def convert(self, model: Layer, inplace=False) -> Layer:
+        for h in self._hooks:
+            h.remove()
+        for _, sub in model.named_sublayers(include_self=True):
+            obs = self._observers.get(id(sub))
+            if obs is None:
+                continue
+            scale = obs.scale()
+            w = getattr(sub, "weight", None)
+            if w is not None:
+                w._replace_data(np.asarray(
+                    _fake_quant(w._data, jnp.asarray(float(np.max(np.abs(w.numpy())))))))
+            sub._ptq_input_scale = scale
+        return model

@@ -1,0 +1,114 @@
+"""DenseNet (reference: python/paddle/vision/models/densenet.py)."""
+
+from ...core.dispatch import apply_op
+from ...nn.activation import ReLU
+from ...nn.common import Dropout, Linear
+from ...nn.container import Sequential
+from ...nn.conv import Conv2D
+from ...nn.layer import Layer
+from ...nn.norm import BatchNorm2D
+from ...nn.pooling import AdaptiveAvgPool2D, AvgPool2D, MaxPool2D
+
+import jax.numpy as jnp
+
+
+def _concat(xs):
+    return apply_op(lambda *a: jnp.concatenate(a, axis=1), *xs)
+
+
+class _DenseLayer(Layer):
+    def __init__(self, num_input_features, growth_rate, bn_size, drop_rate):
+        super().__init__()
+        self.norm1 = BatchNorm2D(num_input_features)
+        self.relu = ReLU()
+        self.conv1 = Conv2D(num_input_features, bn_size * growth_rate, 1, bias_attr=False)
+        self.norm2 = BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = Conv2D(bn_size * growth_rate, growth_rate, 3, padding=1, bias_attr=False)
+        self.drop_rate = drop_rate
+        self.dropout = Dropout(drop_rate) if drop_rate > 0 else None
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.norm1(x)))
+        out = self.conv2(self.relu(self.norm2(out)))
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return _concat([x, out])
+
+
+class _DenseBlock(Layer):
+    def __init__(self, num_layers, num_input_features, bn_size, growth_rate, drop_rate):
+        super().__init__()
+        layers = []
+        for i in range(num_layers):
+            layers.append(_DenseLayer(num_input_features + i * growth_rate,
+                                      growth_rate, bn_size, drop_rate))
+        self.block = Sequential(*layers)
+
+    def forward(self, x):
+        return self.block(x)
+
+
+class _Transition(Layer):
+    def __init__(self, num_input_features, num_output_features):
+        super().__init__()
+        self.norm = BatchNorm2D(num_input_features)
+        self.relu = ReLU()
+        self.conv = Conv2D(num_input_features, num_output_features, 1, bias_attr=False)
+        self.pool = AvgPool2D(2, 2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.norm(x))))
+
+
+class DenseNet(Layer):
+    _cfgs = {121: (6, 12, 24, 16), 161: (6, 12, 36, 24),
+             169: (6, 12, 32, 32), 201: (6, 12, 48, 32), 264: (6, 12, 64, 48)}
+
+    def __init__(self, layers=121, growth_rate=32, num_init_features=64,
+                 bn_size=4, dropout=0.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        block_config = self._cfgs[layers]
+        self.features_head = Sequential(
+            Conv2D(3, num_init_features, 7, stride=2, padding=3, bias_attr=False),
+            BatchNorm2D(num_init_features), ReLU(), MaxPool2D(3, 2, padding=1))
+        num_features = num_init_features
+        blocks = []
+        for i, num_layers in enumerate(block_config):
+            blocks.append(_DenseBlock(num_layers, num_features, bn_size, growth_rate, dropout))
+            num_features += num_layers * growth_rate
+            if i != len(block_config) - 1:
+                blocks.append(_Transition(num_features, num_features // 2))
+                num_features //= 2
+        self.blocks = Sequential(*blocks)
+        self.norm5 = BatchNorm2D(num_features)
+        self.relu = ReLU()
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.classifier = Linear(num_features, num_classes)
+
+    def forward(self, x):
+        x = self.relu(self.norm5(self.blocks(self.features_head(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+def densenet121(**kwargs):
+    return DenseNet(121, **kwargs)
+
+
+def densenet161(**kwargs):
+    return DenseNet(161, **kwargs)
+
+
+def densenet169(**kwargs):
+    return DenseNet(169, **kwargs)
+
+
+def densenet201(**kwargs):
+    return DenseNet(201, **kwargs)

@@ -1,0 +1,127 @@
+"""ShuffleNetV2 (reference: python/paddle/vision/models/shufflenetv2.py)."""
+
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op
+from ...nn.activation import ReLU, Swish
+from ...nn.common import Linear
+from ...nn.container import Sequential
+from ...nn.conv import Conv2D
+from ...nn.layer import Layer
+from ...nn.norm import BatchNorm2D
+from ...nn.pooling import AdaptiveAvgPool2D, MaxPool2D
+
+
+def _channel_shuffle(x, groups):
+    def f(a):
+        b, c, h, w = a.shape
+        a = a.reshape(b, groups, c // groups, h, w)
+        a = jnp.swapaxes(a, 1, 2)
+        return a.reshape(b, c, h, w)
+
+    return apply_op(f, x, op_name="channel_shuffle")
+
+
+def _split2(x):
+    def f(a):
+        half = a.shape[1] // 2
+        return a[:, :half], a[:, half:]
+
+    return apply_op(f, x)
+
+
+def _cat(a, b):
+    return apply_op(lambda u, v: jnp.concatenate([u, v], axis=1), a, b)
+
+
+def _act(name):
+    return Swish() if name == "swish" else ReLU()
+
+
+class _InvertedResidual(Layer):
+    def __init__(self, inp, oup, stride, act="relu"):
+        super().__init__()
+        self.stride = stride
+        branch_c = oup // 2
+        if stride > 1:
+            self.branch1 = Sequential(
+                Conv2D(inp, inp, 3, stride=stride, padding=1, groups=inp, bias_attr=False),
+                BatchNorm2D(inp),
+                Conv2D(inp, branch_c, 1, bias_attr=False), BatchNorm2D(branch_c), _act(act))
+            b2_in = inp
+        else:
+            self.branch1 = None
+            b2_in = inp // 2
+        self.branch2 = Sequential(
+            Conv2D(b2_in, branch_c, 1, bias_attr=False), BatchNorm2D(branch_c), _act(act),
+            Conv2D(branch_c, branch_c, 3, stride=stride, padding=1, groups=branch_c, bias_attr=False),
+            BatchNorm2D(branch_c),
+            Conv2D(branch_c, branch_c, 1, bias_attr=False), BatchNorm2D(branch_c), _act(act))
+
+    def forward(self, x):
+        if self.stride == 1:
+            x1, x2 = _split2(x)
+            out = _cat(x1, self.branch2(x2))
+        else:
+            out = _cat(self.branch1(x), self.branch2(x))
+        return _channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(Layer):
+    _stage_repeats = [4, 8, 4]
+    _out_channels = {
+        0.5: [24, 48, 96, 192, 1024],
+        1.0: [24, 116, 232, 464, 1024],
+        1.5: [24, 176, 352, 704, 1024],
+        2.0: [24, 244, 488, 976, 2048],
+    }
+
+    def __init__(self, scale=1.0, act="relu", num_classes=1000, with_pool=True):
+        super().__init__()
+        chans = self._out_channels[scale]
+        self.conv1 = Sequential(
+            Conv2D(3, chans[0], 3, stride=2, padding=1, bias_attr=False),
+            BatchNorm2D(chans[0]), _act(act))
+        self.maxpool = MaxPool2D(3, 2, padding=1)
+        stages = []
+        inp = chans[0]
+        for i, reps in enumerate(self._stage_repeats):
+            oup = chans[i + 1]
+            blocks = [_InvertedResidual(inp, oup, 2, act)]
+            for _ in range(reps - 1):
+                blocks.append(_InvertedResidual(oup, oup, 1, act))
+            stages.append(Sequential(*blocks))
+            inp = oup
+        self.stages = Sequential(*stages)
+        self.conv5 = Sequential(
+            Conv2D(inp, chans[-1], 1, bias_attr=False), BatchNorm2D(chans[-1]), _act(act))
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = Linear(chans[-1], num_classes)
+
+    def forward(self, x):
+        x = self.conv5(self.stages(self.maxpool(self.conv1(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def shufflenet_v2_x0_5(**kwargs):
+    return ShuffleNetV2(scale=0.5, **kwargs)
+
+
+def shufflenet_v2_x1_0(**kwargs):
+    return ShuffleNetV2(scale=1.0, **kwargs)
+
+
+def shufflenet_v2_x1_5(**kwargs):
+    return ShuffleNetV2(scale=1.5, **kwargs)
+
+
+def shufflenet_v2_x2_0(**kwargs):
+    return ShuffleNetV2(scale=2.0, **kwargs)

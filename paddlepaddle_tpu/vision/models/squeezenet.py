@@ -1,0 +1,71 @@
+"""SqueezeNet (reference: python/paddle/vision/models/squeezenet.py)."""
+
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op
+from ...nn.activation import ReLU
+from ...nn.common import Dropout
+from ...nn.container import Sequential
+from ...nn.conv import Conv2D
+from ...nn.layer import Layer
+from ...nn.pooling import AdaptiveAvgPool2D, MaxPool2D
+
+
+class _Fire(Layer):
+    def __init__(self, inplanes, squeeze_planes, expand1x1_planes, expand3x3_planes):
+        super().__init__()
+        self.squeeze = Conv2D(inplanes, squeeze_planes, 1)
+        self.relu = ReLU()
+        self.expand1x1 = Conv2D(squeeze_planes, expand1x1_planes, 1)
+        self.expand3x3 = Conv2D(squeeze_planes, expand3x3_planes, 3, padding=1)
+
+    def forward(self, x):
+        x = self.relu(self.squeeze(x))
+        a = self.relu(self.expand1x1(x))
+        b = self.relu(self.expand3x3(x))
+        return apply_op(lambda u, v: jnp.concatenate([u, v], axis=1), a, b)
+
+
+class SqueezeNet(Layer):
+    def __init__(self, version="1.1", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if version == "1.0":
+            self.features = Sequential(
+                Conv2D(3, 96, 7, stride=2), ReLU(), MaxPool2D(3, 2),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64), _Fire(128, 32, 128, 128),
+                MaxPool2D(3, 2),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                MaxPool2D(3, 2), _Fire(512, 64, 256, 256))
+        else:
+            self.features = Sequential(
+                Conv2D(3, 64, 3, stride=2), ReLU(), MaxPool2D(3, 2),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                MaxPool2D(3, 2),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                MaxPool2D(3, 2),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256))
+        if num_classes > 0:
+            self.classifier = Sequential(
+                Dropout(0.5), Conv2D(512, num_classes, 1), ReLU())
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.num_classes > 0:
+            x = self.classifier(x)
+        if self.with_pool:
+            x = self.pool(x)
+        return x.flatten(1)
+
+
+def squeezenet1_0(**kwargs):
+    return SqueezeNet("1.0", **kwargs)
+
+
+def squeezenet1_1(**kwargs):
+    return SqueezeNet("1.1", **kwargs)

@@ -61,3 +61,17 @@ def test_resnet_backward():
     loss.backward()
     g = m.conv1.weight.grad
     assert g is not None and float(np.abs(g.numpy()).sum()) > 0
+
+
+def test_vision_zoo_extras_forward():
+    from paddlepaddle_tpu.vision.models import (
+        densenet121,
+        shufflenet_v2_x0_5,
+        squeezenet1_1,
+    )
+
+    x = np.random.default_rng(0).standard_normal((1, 3, 64, 64)).astype(np.float32)
+    for net in (densenet121(num_classes=6), squeezenet1_1(num_classes=6),
+                shufflenet_v2_x0_5(num_classes=6)):
+        out = net(x)
+        assert out.shape == [1, 6], type(net).__name__

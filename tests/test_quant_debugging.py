@@ -1,0 +1,103 @@
+"""Quantization (QAT/PTQ) and amp.debugging sanitizer tests."""
+
+import numpy as np
+import pytest
+
+import paddlepaddle_tpu as paddle
+
+
+def test_fake_quant_roundtrip_and_ste():
+    from paddlepaddle_tpu.quantization import FakeQuanterWithAbsMax
+
+    q = FakeQuanterWithAbsMax()
+    x = paddle.to_tensor(np.linspace(-1, 1, 11).astype(np.float32), stop_gradient=False)
+    out = q(x)
+    # quantized values close to original (8-bit on [-1,1])
+    assert float(np.abs(out.numpy() - x.numpy()).max()) < 1e-2
+    out.sum().backward()
+    assert x.grad is not None  # STE passes gradients
+
+
+def test_qat_quantize_wraps_linears():
+    from paddlepaddle_tpu.quantization import QAT, QuantConfig, QuantedWrapper
+
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = paddle.nn.Linear(4, 8)
+            self.act = paddle.nn.ReLU()
+            self.fc2 = paddle.nn.Linear(8, 2)
+
+        def forward(self, x):
+            return self.fc2(self.act(self.fc1(x)))
+
+    net = Net()
+    qat = QAT(QuantConfig())
+    qnet = qat.quantize(net)
+    assert isinstance(qnet.fc1, QuantedWrapper)
+    x = np.random.default_rng(0).standard_normal((2, 4)).astype(np.float32)
+    out = qnet(x)
+    assert out.shape == [2, 2]
+    # trains
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=qnet.parameters())
+    labels = np.array([0, 1])
+    l0 = None
+    for _ in range(5):
+        loss = paddle.nn.functional.cross_entropy(qnet(x), labels)
+        loss.backward(); opt.step(); opt.clear_grad()
+        l0 = l0 or float(loss.numpy())
+    assert float(loss.numpy()) < l0
+
+
+def test_ptq_calibration():
+    from paddlepaddle_tpu.quantization import PTQ
+
+    net = paddle.nn.Linear(4, 4)
+    ptq = PTQ()
+    qnet = ptq.quantize(net)        # returns a copy; the FP net stays intact
+    assert qnet is not net
+    for _ in range(3):
+        qnet(np.random.default_rng(0).standard_normal((2, 4)).astype(np.float32))
+    ptq.convert(qnet)
+    assert hasattr(qnet, "_ptq_input_scale") and qnet._ptq_input_scale > 0
+    assert not hasattr(net, "_ptq_input_scale")
+
+
+def test_check_numerics():
+    from paddlepaddle_tpu.amp.debugging import DebugMode, check_numerics
+
+    t = paddle.to_tensor(np.array([1.0, np.nan, np.inf, 0.0], np.float32))
+    with pytest.raises(FloatingPointError):
+        check_numerics(t, "op", "t")
+    n_nan, n_inf, n_zero = check_numerics(t, "op", "t", DebugMode.CHECK_NAN_INF)
+    assert int(n_nan.numpy()) == 1 and int(n_inf.numpy()) == 1 and int(n_zero.numpy()) == 1
+
+
+def test_tensor_checker_catches_nan_op():
+    from paddlepaddle_tpu.amp.debugging import (
+        TensorCheckerConfig,
+        disable_tensor_checker,
+        enable_tensor_checker,
+    )
+
+    enable_tensor_checker(TensorCheckerConfig(enable=True))
+    try:
+        x = paddle.to_tensor(np.array([0.0], np.float32))
+        with pytest.raises(FloatingPointError):
+            _ = x / x  # 0/0 -> NaN
+    finally:
+        disable_tensor_checker()
+    # after disable it must not raise
+    x = paddle.to_tensor(np.array([0.0], np.float32))
+    _ = x / x
+
+
+def test_operator_stats_collection(capsys):
+    from paddlepaddle_tpu.amp.debugging import collect_operator_stats
+
+    with collect_operator_stats():
+        a = paddle.to_tensor(np.ones((2, 2), np.float32))
+        _ = a @ a
+    out = capsys.readouterr().out
+    assert "op list" in out
