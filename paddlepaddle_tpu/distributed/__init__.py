@@ -29,6 +29,7 @@ from .env import (  # noqa: F401
 )
 from . import checkpoint  # noqa: F401
 from . import fleet  # noqa: F401
+from . import sharding  # noqa: F401
 from . import auto_parallel  # noqa: F401  (isort: after fleet to avoid cycle)
 from .auto_parallel import (  # noqa: F401
     ColWiseParallel,

@@ -90,6 +90,36 @@ class DataLoader:
         if self.num_workers == 0:
             yield from self._batches()
             return
+        if self._iterable:
+            # IterableDataset must be consumed sequentially; one producer
+            # thread gives prefetch overlap
+            yield from self._prefetch_single()
+            return
+        # map-style: N workers load batches concurrently, yielded in order
+        # (the reference's subprocess worker pool; threads suffice here since
+        # numpy/jnp release the GIL for array work)
+        from collections import deque
+        from concurrent.futures import ThreadPoolExecutor
+
+        def load(indices):
+            return self.collate_fn([self.dataset[i] for i in indices])
+
+        window = self.num_workers * self.prefetch_factor
+        with ThreadPoolExecutor(max_workers=self.num_workers) as ex:
+            pending = deque()
+            it = iter(self.batch_sampler)
+            try:
+                for indices in it:
+                    pending.append(ex.submit(load, indices))
+                    if len(pending) >= window:
+                        yield pending.popleft().result()
+                while pending:
+                    yield pending.popleft().result()
+            finally:
+                for f in pending:
+                    f.cancel()
+
+    def _prefetch_single(self):
         q: queue.Queue = queue.Queue(maxsize=self.num_workers * self.prefetch_factor)
         sentinel = object()
         error_holder = []

@@ -223,6 +223,76 @@ class LlamaForCausalLM(Layer):
             return logits
         return self.loss_from_logits(logits, labels)
 
+    def generate(self, input_ids, max_new_tokens=32, temperature=1.0, top_k=0,
+                 eos_token_id=None, seed=0):
+        """Autoregressive decoding (PaddleNLP-style generate).
+
+        TPU-shaped: the token buffer is padded to a STATIC length so the
+        whole decode loop reuses ONE compiled step (no per-length
+        recompiles); causal masking makes the padded tail inert for the row
+        that is read each step. O(L²) per sequence — a KV-cache decode
+        kernel is the planned optimization for serving."""
+        import numpy as np
+
+        from ..core import autograd as _ag
+        from ..core.dispatch import unwrap
+
+        ids = np.asarray(input_ids if not isinstance(input_ids, Tensor)
+                         else input_ids.numpy()).astype(np.int32)
+        b, prompt_len = ids.shape
+        if prompt_len >= self.config.max_position_embeddings:
+            raise ValueError(
+                f"prompt length {prompt_len} exceeds max_position_embeddings "
+                f"{self.config.max_position_embeddings}; truncate the prompt")
+        total = min(prompt_len + max_new_tokens, self.config.max_position_embeddings)
+        buf = np.zeros((b, total), np.int32)
+        buf[:, :prompt_len] = ids
+        state = self.functional_state()
+
+        def step(params, buf_arr, cur_len, key):
+            with _ag.no_grad(), self.bind_state(params):
+                logits = unwrap(self(buf_arr))              # [b, L, V]
+            row = jax.lax.dynamic_slice_in_dim(logits, cur_len - 1, 1, axis=1)[:, 0]
+            row = row.astype(jnp.float32)
+            if top_k and top_k > 0:
+                kth = jax.lax.top_k(row, top_k)[0][:, -1:]
+                row = jnp.where(row < kth, -jnp.inf, row)
+            if temperature and temperature != 1.0:
+                row = row / temperature
+            if temperature == 0.0:
+                nxt = jnp.argmax(row, axis=-1).astype(jnp.int32)
+            else:
+                nxt = jax.random.categorical(key, row).astype(jnp.int32)
+            buf_arr = jax.lax.dynamic_update_slice_in_dim(
+                buf_arr, nxt[:, None], cur_len, axis=1)
+            return buf_arr, nxt
+
+        step_jit = jax.jit(step, donate_argnums=(1,))
+        key = jax.random.PRNGKey(seed)
+        buf_arr = jnp.asarray(buf)
+        finished = np.zeros((b,), bool)
+        cur = prompt_len
+        while cur < total:
+            key, sub = jax.random.split(key)
+            # cur as a traced scalar: ONE compile serves every step
+            buf_arr, nxt = step_jit(state, buf_arr, jnp.asarray(cur, jnp.int32), sub)
+            cur += 1
+            if eos_token_id is not None:
+                finished |= np.asarray(nxt) == eos_token_id
+                if finished.all():
+                    break
+        out = np.asarray(buf_arr[:, :cur])
+        if eos_token_id is not None:
+            # pad everything after each row's first eos with eos (reference
+            # generate pads finished rows instead of keeping sampled garbage)
+            gen = out[:, prompt_len:]
+            hit = gen == eos_token_id
+            first = np.where(hit.any(1), hit.argmax(1), gen.shape[1])
+            pos = np.arange(gen.shape[1])[None, :]
+            gen = np.where(pos > first[:, None], eos_token_id, gen)
+            out = np.concatenate([out[:, :prompt_len], gen], axis=1)
+        return Tensor._from_data(jnp.asarray(out))
+
     @staticmethod
     def loss_from_logits(logits, labels):
         """Next-token CE in fp32 over bf16 logits; labels == -100 ignored.

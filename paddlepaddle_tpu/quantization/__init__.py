@@ -93,27 +93,34 @@ class FakeQuanterWithAbsMax(Layer):
 
 
 class QuantConfig:
-    """Reference: quantization/config.py QuantConfig."""
+    """Reference: quantization/config.py QuantConfig. Per-layer-type quanter
+    factories; the global activation/weight pair is the default."""
 
     def __init__(self, activation=None, weight=None):
         self.activation = activation or FakeQuanterWithAbsMax
         self.weight = weight or FakeQuanterWithAbsMax
-        self._layer_types = []
+        self._type_configs = []  # (types_tuple, act_factory, weight_factory)
 
     def add_type_config(self, layer_types, activation=None, weight=None):
-        types = layer_types if isinstance(layer_types, (list, tuple)) else [layer_types]
-        self._layer_types.extend(types)
-        if activation is not None:
-            self.activation = activation
-        if weight is not None:
-            self.weight = weight
+        types = tuple(layer_types) if isinstance(layer_types, (list, tuple)) else (layer_types,)
+        self._type_configs.append(
+            (types, activation or self.activation, weight or self.weight))
+
+    def quanters_for(self, layer):
+        """(act_factory, weight_factory) if the layer should be quantized."""
+        for types, act, wgt in self._type_configs:
+            if isinstance(layer, types):
+                return act, wgt
+        if not self._type_configs:
+            from ..nn.common import Linear
+            from ..nn.conv import _ConvNd
+
+            if isinstance(layer, (Linear, _ConvNd)):
+                return self.activation, self.weight
+        return None
 
     def matches(self, layer) -> bool:
-        from ..nn.common import Linear
-        from ..nn.conv import _ConvNd
-
-        types = tuple(self._layer_types) or (Linear, _ConvNd)
-        return isinstance(layer, types)
+        return self.quanters_for(layer) is not None
 
 
 class QuantedWrapper(Layer):
@@ -122,18 +129,22 @@ class QuantedWrapper(Layer):
     def __init__(self, inner: Layer, config: QuantConfig):
         super().__init__()
         self.inner = inner
-        self.act_quanter = config.activation()
-        self.weight_quanter = config.weight()
+        act_f, wgt_f = config.quanters_for(inner)
+        self.act_quanter = act_f()
+        self.weight_quanter = wgt_f()
 
     def forward(self, *args, **kwargs):
         x = self.act_quanter(args[0])
-        w = self.inner.weight
-        saved = w._data
+        # quantize THROUGH the tape: the fake-quanted tensor (with its STE
+        # grad node back to the real weight) temporarily replaces the
+        # parameter entry, so backward applies the clip mask to weight grads
+        w_q = self.weight_quanter(self.inner.weight)
+        saved = self.inner._parameters["weight"]
+        self.inner._parameters["weight"] = w_q
         try:
-            w._data = self.weight_quanter(Tensor._from_data(saved))._data
             return self.inner(x, *args[1:], **kwargs)
         finally:
-            w._data = saved
+            self.inner._parameters["weight"] = saved
 
 
 class QAT:
@@ -147,6 +158,9 @@ class QAT:
             import copy
 
             model = copy.deepcopy(model)  # reference keeps the FP model intact
+        if self.config.matches(model):
+            # the model IS a quantizable leaf (e.g. a bare Linear)
+            return QuantedWrapper(model, self.config)
         for name, sub in list(model.named_children()):
             if self.config.matches(sub):
                 model.add_sublayer(name, QuantedWrapper(sub, self.config))
@@ -198,4 +212,10 @@ class PTQ:
                 w._replace_data(np.asarray(
                     _fake_quant(w._data, jnp.asarray(float(np.max(np.abs(w.numpy())))))))
             sub._ptq_input_scale = scale
+            # activations ARE quantized with the calibrated scale: fake-quant
+            # every input with the observer's absmax from here on
+            sub.register_forward_pre_hook(
+                lambda l, inputs, _s=scale: tuple(
+                    apply_op(lambda a: _fake_quant(a, jnp.asarray(_s)), inputs[0]),
+                ) + tuple(inputs[1:]))
         return model
