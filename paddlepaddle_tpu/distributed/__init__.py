@@ -28,6 +28,7 @@ from .env import (  # noqa: F401
     parallel_device_count,
 )
 from . import checkpoint  # noqa: F401
+from . import communication  # noqa: F401
 from . import fleet  # noqa: F401
 from . import sharding  # noqa: F401
 from . import auto_parallel  # noqa: F401  (isort: after fleet to avoid cycle)

@@ -1,0 +1,35 @@
+"""paddle.distributed.communication namespace parity (reference:
+python/paddle/distributed/communication/): re-exports the collective API and
+provides the ``stream`` variants (stream-ordered in the reference; dispatch
+order under the single-controller XLA runtime)."""
+
+from ..collective import (  # noqa: F401
+    ReduceOp,
+    all_gather,
+    all_reduce,
+    all_to_all,
+    barrier,
+    broadcast,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+)
+from . import stream  # noqa: F401
+
+
+class P2POp:
+    """Reference: communication/batch_isend_irecv.py P2POp."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    raise NotImplementedError(
+        "host-level p2p batches require the multi-host runtime; within a mesh "
+        "use shard_map + ppermute (parallel.pipeline_spmd shows the pattern)")

@@ -1,0 +1,13 @@
+"""stream.* collective variants (reference: communication/stream/)."""
+
+from ..collective import (  # noqa: F401
+    all_gather,
+    all_reduce,
+    all_to_all,
+    broadcast,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+)

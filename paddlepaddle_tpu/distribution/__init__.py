@@ -496,3 +496,163 @@ def _kl_uniform(p, q):
 @register_kl(Exponential, Exponential)
 def _kl_exponential(p, q):
     return Tensor._from_data(jnp.log(p.rate / q.rate) + q.rate / p.rate - 1.0)
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _as_array(df)
+        self.loc = _as_array(loc)
+        self.scale = _as_array(scale)
+        super().__init__(jnp.broadcast_shapes(self.df.shape, self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        key = prandom.next_key()
+        return Tensor._from_data(
+            self.loc + self.scale * jax.random.t(key, self.df,
+                                                 _shape(shape, self.df, self.loc, self.scale)))
+
+    def log_prob(self, value):
+        def f(v):
+            df, loc, scale = self.df, self.loc, self.scale
+            z = (v - loc) / scale
+            lg = jax.scipy.special.gammaln
+            return (lg((df + 1) / 2) - lg(df / 2)
+                    - 0.5 * jnp.log(df * math.pi) - jnp.log(scale)
+                    - (df + 1) / 2 * jnp.log1p(z ** 2 / df))
+
+        return apply_op(f, value)
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self._loc_t = loc if isinstance(loc, Tensor) else None
+        self._scale_t = scale if isinstance(scale, Tensor) else None
+        self.loc = _as_array(loc)
+        self.scale = _as_array(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        key = prandom.next_key()
+        return Tensor._from_data(
+            self.loc + self.scale * jax.random.cauchy(key, _shape(shape, self.loc, self.scale)))
+
+    def rsample(self, shape=()):
+        noise = jax.random.cauchy(prandom.next_key(), _shape(shape, self.loc, self.scale))
+        return apply_op(lambda loc, scale: loc + scale * noise,
+                        self._loc_t if self._loc_t is not None else self.loc,
+                        self._scale_t if self._scale_t is not None else self.scale,
+                        op_name="cauchy_rsample")
+
+    def log_prob(self, value):
+        return apply_op(
+            lambda v: -jnp.log(math.pi * self.scale * (1 + ((v - self.loc) / self.scale) ** 2)),
+            value)
+
+    def entropy(self):
+        return Tensor._from_data(jnp.log(4 * math.pi * self.scale))
+
+
+class Chi2(Gamma):
+    def __init__(self, df, name=None):
+        self.df = _as_array(df)
+        super().__init__(self.df / 2.0, jnp.asarray(0.5))
+
+
+class ExponentialFamily(Distribution):
+    pass
+
+
+# -- transforms + TransformedDistribution (reference: distribution/transform.py)
+
+
+class Transform:
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _as_array(loc)
+        self.scale = _as_array(scale)
+
+    def forward(self, x):
+        return self.loc + self.scale * x
+
+    def inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), jnp.shape(x))
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return jnp.exp(x)
+
+    def inverse(self, y):
+        return jnp.log(y)
+
+    def forward_log_det_jacobian(self, x):
+        return x
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def forward(self, x):
+        return jnp.tanh(x)
+
+    def inverse(self, y):
+        return jnp.arctanh(y)
+
+    def forward_log_det_jacobian(self, x):
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base: Distribution, transforms):
+        self.base = base
+        self.transforms = list(transforms) if isinstance(transforms, (list, tuple)) else [transforms]
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = unwrap(self.base.sample(shape))
+        for t in self.transforms:
+            x = t.forward(x)
+        return Tensor._from_data(x)
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+
+        def f(a):
+            for t in self.transforms:
+                a = t.forward(a)
+            return a
+
+        return apply_op(f, x)
+
+    def log_prob(self, value):
+        def f(y):
+            ldj = jnp.zeros_like(y)
+            x = y
+            for t in reversed(self.transforms):
+                x = t.inverse(x)
+                ldj = ldj + t.forward_log_det_jacobian(x)
+            return unwrap(self.base.log_prob(Tensor._from_data(x))) - ldj
+
+        return apply_op(f, value)

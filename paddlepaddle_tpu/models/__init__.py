@@ -15,6 +15,12 @@ from .bert import (  # noqa: F401
     BertForSequenceClassification,
     BertModel,
 )
+from .gpt import (  # noqa: F401
+    GPTConfig,
+    GPTForCausalLM,
+    GPTModel,
+    gpt_sharding_rules,
+)
 from .llama import (  # noqa: F401
     LlamaConfig,
     LlamaForCausalLM,

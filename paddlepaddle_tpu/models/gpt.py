@@ -1,0 +1,144 @@
+"""GPT-style decoder LM — learned positions, pre-LN, gelu MLP.
+
+Reference recipe semantics: PaddleNLP GPT-2/3 configs (the reference
+framework surface is python/paddle/nn/layer/transformer.py decoder blocks).
+Covers the ERNIE/GPT side of the decoder-LM family next to Llama (rope/
+swiglu/RMSNorm) — together they span the architectures the reference's llm
+recipes pretrain.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+from ..nn import functional as F
+from ..nn.common import Dropout, Embedding, Linear
+from ..nn.container import LayerList
+from ..nn.layer import Layer
+from ..nn.norm import LayerNorm
+from .llama import LlamaForCausalLM
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 1024
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    layer_norm_epsilon: float = 1e-5
+    tie_word_embeddings: bool = True
+    dtype: str = "float32"
+
+    @staticmethod
+    def gpt2_small() -> "GPTConfig":
+        return GPTConfig()
+
+    @staticmethod
+    def tiny(vocab_size=128, hidden_size=32, layers=2, heads=4, max_len=64) -> "GPTConfig":
+        return GPTConfig(vocab_size=vocab_size, hidden_size=hidden_size,
+                         num_hidden_layers=layers, num_attention_heads=heads,
+                         intermediate_size=hidden_size * 4,
+                         max_position_embeddings=max_len,
+                         hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+
+
+class GPTAttention(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        h = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.head_dim = h // self.num_heads
+        self.qkv_proj = Linear(h, 3 * h)
+        self.out_proj = Linear(h, h)
+        self.dropout = Dropout(config.attention_probs_dropout_prob)
+
+    def forward(self, x):
+        b, s = x.shape[0], x.shape[1]
+        qkv = self.qkv_proj(x).reshape([b, s, 3, self.num_heads, self.head_dim])
+        q = qkv[:, :, 0]
+        k = qkv[:, :, 1]
+        v = qkv[:, :, 2]
+        out, _ = F.flash_attention(q, k, v, causal=True)
+        return self.dropout(self.out_proj(out.reshape([b, s, -1])))
+
+
+class GPTBlock(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln_1 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+        self.attn = GPTAttention(config)
+        self.ln_2 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+        self.fc_in = Linear(config.hidden_size, config.intermediate_size)
+        self.fc_out = Linear(config.intermediate_size, config.hidden_size)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x):
+        x = x + self.attn(self.ln_1(x))
+        h = self.fc_out(F.gelu(self.fc_in(self.ln_2(x)), approximate=True))
+        return x + self.dropout(h)
+
+
+class GPTModel(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.wte = Embedding(config.vocab_size, config.hidden_size)
+        self.wpe = Embedding(config.max_position_embeddings, config.hidden_size)
+        self.drop = Dropout(config.hidden_dropout_prob)
+        self.h = LayerList([GPTBlock(config) for _ in range(config.num_hidden_layers)])
+        self.ln_f = LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+
+    def forward(self, input_ids):
+        seq = input_ids.shape[1]
+        if seq > self.config.max_position_embeddings:
+            raise ValueError(
+                f"sequence length {seq} exceeds max_position_embeddings "
+                f"{self.config.max_position_embeddings} (position table gather "
+                f"would silently clamp)")
+        pos = apply_op(lambda: jnp.arange(seq, dtype=jnp.int64)[None, :])
+        x = self.drop(self.wte(input_ids) + self.wpe(pos))
+        for blk in self.h:
+            x = blk(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.transformer = GPTModel(config)
+        self.lm_head = None
+        if not config.tie_word_embeddings:
+            self.lm_head = Linear(config.hidden_size, config.vocab_size, bias_attr=False)
+
+    def forward(self, input_ids, labels=None):
+        hidden = self.transformer(input_ids)
+        if self.lm_head is None:
+            logits = apply_op(lambda h, w: h @ w.T, hidden, self.transformer.wte.weight)
+        else:
+            logits = self.lm_head(hidden)
+        if labels is None:
+            return logits
+        return LlamaForCausalLM.loss_from_logits(logits, labels)
+
+    # reuse the padded single-compile decode loop
+    generate = LlamaForCausalLM.generate
+
+
+def gpt_sharding_rules(tp_axis="tp", fsdp_axis="fsdp"):
+    return [
+        (r".*wte\.weight$", (tp_axis, fsdp_axis)),
+        (r".*wpe\.weight$", ()),
+        (r".*qkv_proj\.weight$", (fsdp_axis, tp_axis)),
+        (r".*out_proj\.weight$", (tp_axis, fsdp_axis)),
+        (r".*fc_in\.weight$", (fsdp_axis, tp_axis)),
+        (r".*fc_out\.weight$", (tp_axis, fsdp_axis)),
+        (r".*", ()),
+    ]

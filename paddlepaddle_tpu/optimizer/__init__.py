@@ -10,6 +10,7 @@ from .optimizers import (  # noqa: F401
     Adamax,
     AdamW,
     Lamb,
+    LBFGS,
     Momentum,
     RMSProp,
 )

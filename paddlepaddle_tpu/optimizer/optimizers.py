@@ -5,7 +5,9 @@ functions of fp32 params/grads/slots so they jit and shard cleanly."""
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
+from ..core.tensor import Tensor as _Tensor
 from .optimizer import Optimizer
 
 
@@ -221,3 +223,121 @@ class Lamb(Optimizer):
         trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
         new_p = p - lr * trust * r
         return new_p, {"moment1": m1, "moment2": m2, "beta1_pow": b1p, "beta2_pow": b2p}
+
+
+class LBFGS(Optimizer):
+    """Limited-memory BFGS with closure-based step (reference:
+    python/paddle/optimizer/lbfgs.py). ``line_search_fn`` (any non-None
+    value, e.g. 'strong_wolfe') enables backtracking-Armijo search; None
+    uses the fixed learning rate like the reference default. Returns the
+    INITIAL loss of the step, as the reference does."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self.max_iter = max_iter
+        self.max_eval = max_eval if max_eval is not None else max_iter * 5 // 4
+        self.tolerance_grad = tolerance_grad
+        self.tolerance_change = tolerance_change
+        self.history_size = history_size
+        self.line_search_fn = line_search_fn
+        self._s_hist = []
+        self._y_hist = []
+
+    def _flat(self, arrs):
+        return jnp.concatenate([jnp.ravel(a.astype(jnp.float32)) for a in arrs])
+
+    def _unflatten_apply(self, flat_update):
+        off = 0
+        for p in self._params:
+            n = int(np.prod(p.shape)) if p.shape else 1
+            upd = flat_update[off:off + n].reshape(p._data.shape)
+            p._replace_data((p._data.astype(jnp.float32) + upd).astype(p._data.dtype))
+            off += n
+
+    def _gather_grads(self):
+        params_grads = [(p, p._grad if p._grad is not None
+                         else jnp.zeros(p._data.shape, jnp.float32))
+                        for p in self._params]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(
+                [(p, _Tensor._from_data(g)) for p, g in params_grads])
+            params_grads = [(p, g._data if hasattr(g, "_data") else g)
+                            for p, g in params_grads]
+        gs = []
+        for p, g in params_grads:
+            g = jnp.asarray(g, jnp.float32).reshape(p._data.shape)
+            if self._weight_decay:
+                g = g + float(self._weight_decay) * p._data.astype(jnp.float32)
+            gs.append(g)
+        return self._flat(gs)
+
+    def step(self, closure):
+        n_evals = [0]
+
+        def eval_closure():
+            n_evals[0] += 1
+            return closure()
+
+        orig_loss = eval_closure()
+        loss_val = float(orig_loss.numpy())
+        flat_grad = self._gather_grads()
+        for _ in range(self.max_iter):
+            if n_evals[0] >= self.max_eval:
+                break
+            if float(jnp.max(jnp.abs(flat_grad))) <= self.tolerance_grad:
+                break
+            # two-loop recursion
+            q = flat_grad
+            alphas = []
+            for s_v, y_v in zip(reversed(self._s_hist), reversed(self._y_hist)):
+                rho = 1.0 / (jnp.dot(y_v, s_v) + 1e-10)
+                a = rho * jnp.dot(s_v, q)
+                alphas.append((a, rho, s_v, y_v))
+                q = q - a * y_v
+            if self._y_hist:
+                y_last, s_last = self._y_hist[-1], self._s_hist[-1]
+                gamma = jnp.dot(s_last, y_last) / (jnp.dot(y_last, y_last) + 1e-10)
+                q = q * gamma
+            for a, rho, s_v, y_v in reversed(alphas):
+                b = rho * jnp.dot(y_v, q)
+                q = q + (a - b) * s_v
+            direction = -q
+            step_size = self.get_lr()
+            if self.line_search_fn is not None:
+                # backtracking Armijo: shrink until sufficient decrease
+                g_dot_d = float(jnp.dot(flat_grad, direction))
+                for _bt in range(10):
+                    self._unflatten_apply(step_size * direction)
+                    self.clear_grad()
+                    trial = eval_closure()
+                    trial_val = float(trial.numpy())
+                    if trial_val <= loss_val + 1e-4 * step_size * g_dot_d:
+                        break
+                    self._unflatten_apply(-step_size * direction)  # undo
+                    step_size *= 0.5
+                    if n_evals[0] >= self.max_eval:
+                        break
+                update = step_size * direction
+                loss_val = trial_val
+            else:
+                update = step_size * direction
+                if float(jnp.max(jnp.abs(update))) <= self.tolerance_change:
+                    break
+                self._unflatten_apply(update)
+                self.clear_grad()
+                loss_val = float(eval_closure().numpy())
+            new_grad = self._gather_grads()
+            s_vec = update
+            y_vec = new_grad - flat_grad
+            if float(jnp.dot(s_vec, y_vec)) > 1e-10:  # curvature condition
+                self._s_hist.append(s_vec)
+                self._y_hist.append(y_vec)
+                if len(self._s_hist) > self.history_size:
+                    self._s_hist.pop(0)
+                    self._y_hist.pop(0)
+            flat_grad = new_grad
+        self._step_count += 1
+        return orig_loss

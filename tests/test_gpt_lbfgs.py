@@ -1,0 +1,96 @@
+"""GPT model family, LBFGS, new distributions, communication namespace."""
+
+import numpy as np
+import pytest
+from scipy import stats as sps
+
+import paddlepaddle_tpu as paddle
+from paddlepaddle_tpu.models import GPTConfig, GPTForCausalLM, gpt_sharding_rules
+
+
+def test_gpt_forward_train_generate():
+    from paddlepaddle_tpu.jit.train import TrainStep
+
+    m = GPTForCausalLM(GPTConfig.tiny())
+    ids = np.random.default_rng(0).integers(0, 128, (2, 16)).astype(np.int32)
+    logits = m(ids)
+    assert logits.shape == [2, 16, 128]
+
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2, parameters=m.parameters())
+    step = TrainStep(m, opt, lambda mm, ids, labels: mm(ids, labels=labels))
+    losses = [float(step(ids, ids).numpy()) for _ in range(6)]
+    assert losses[-1] < losses[0]
+    step.sync_to_model()
+    out = m.generate(ids[:1, :4], max_new_tokens=4, temperature=0.0)
+    assert out.shape == [1, 8]
+
+
+def test_gpt_sharded():
+    import jax
+
+    from paddlepaddle_tpu.distributed.mesh import ProcessMesh
+    from paddlepaddle_tpu.parallel import ShardedTrainStep
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    mesh = ProcessMesh(shape=[2, 2, 2], dim_names=["dp", "fsdp", "tp"])
+    m = GPTForCausalLM(GPTConfig.tiny())
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2, parameters=m.parameters())
+    step = ShardedTrainStep(m, opt, lambda mm, ids, labels: mm(ids, labels=labels),
+                            mesh=mesh, rules=gpt_sharding_rules())
+    ids = np.random.default_rng(0).integers(0, 128, (8, 16)).astype(np.int32)
+    losses = [float(step(ids, ids).numpy()) for _ in range(4)]
+    assert losses[-1] < losses[0]
+
+
+def test_lbfgs_quadratic():
+    from paddlepaddle_tpu.optimizer import LBFGS
+
+    A = np.random.default_rng(0).standard_normal((6, 3)).astype(np.float32)
+    b = np.random.default_rng(1).standard_normal((6,)).astype(np.float32)
+    x = paddle.to_tensor(np.zeros(3, np.float32), stop_gradient=False)
+    opt = LBFGS(learning_rate=0.5, max_iter=30, parameters=[x])
+
+    def closure():
+        opt.clear_grad()
+        r = paddle.to_tensor(A) @ x - paddle.to_tensor(b)
+        loss = (r * r).sum()
+        loss.backward()
+        return loss
+
+    opt.step(closure)
+    ref = np.linalg.lstsq(A, b, rcond=None)[0]
+    np.testing.assert_allclose(x.numpy(), ref, atol=1e-3)
+
+
+def test_new_distributions_match_scipy():
+    from paddlepaddle_tpu.distribution import (
+        Cauchy,
+        Chi2,
+        ExpTransform,
+        Normal,
+        StudentT,
+        TransformedDistribution,
+    )
+
+    checks = [
+        (StudentT(3.0, 0.0, 2.0), sps.t(3, 0, 2), 0.7),
+        (Cauchy(0.0, 2.0), sps.cauchy(0, 2), 0.7),
+        (Chi2(4.0), sps.chi2(4), 1.3),
+    ]
+    for dist, ref, x in checks:
+        lp = float(np.asarray(dist.log_prob(paddle.to_tensor(np.float32(x))).numpy()))
+        np.testing.assert_allclose(lp, ref.logpdf(x), rtol=1e-4)
+
+    td = TransformedDistribution(Normal(0.0, 1.0), [ExpTransform()])
+    lp = float(np.asarray(td.log_prob(paddle.to_tensor(np.float32(0.9))).numpy()))
+    np.testing.assert_allclose(lp, sps.lognorm.logpdf(0.9, 1.0), rtol=1e-4)
+
+
+def test_communication_namespace():
+    from paddlepaddle_tpu.distributed import communication
+
+    assert callable(communication.all_reduce)
+    assert callable(communication.stream.all_reduce)
+    op = communication.P2POp("isend", None, 1)
+    assert op.peer == 1
