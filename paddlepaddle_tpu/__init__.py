@@ -78,6 +78,7 @@ for _mod in _OP_MODULES:
 
 # submodules (populated as the build progresses)
 from . import amp  # noqa: E402,F401
+from . import audio  # noqa: E402,F401
 from . import autograd  # noqa: E402,F401
 from . import distributed  # noqa: E402,F401
 from . import distribution  # noqa: E402,F401
@@ -97,6 +98,7 @@ from . import profiler  # noqa: E402,F401
 from . import quantization  # noqa: E402,F401
 from . import sparse  # noqa: E402,F401
 from . import static  # noqa: E402,F401
+from . import text  # noqa: E402,F401
 from . import vision  # noqa: E402,F401
 from .framework.io_api import load, save  # noqa: E402,F401
 from .hapi import Model, summary  # noqa: E402,F401

@@ -1,0 +1,6 @@
+"""paddle.audio (reference: python/paddle/audio/ — features + functional).
+
+Spectrogram/MelSpectrogram/MFCC over the framework's fft ops (XLA-lowered).
+"""
+
+from . import features, functional  # noqa: F401

@@ -1,0 +1,73 @@
+"""Audio feature layers (reference: python/paddle/audio/features/layers.py —
+Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply_op
+from ..nn.layer import Layer
+from .functional import compute_fbank_matrix, stft_mag
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.kw = dict(n_fft=n_fft, hop_length=hop_length, win_length=win_length,
+                       window=window, center=center, power=power)
+
+    def forward(self, x):
+        return stft_mag(x, **self.kw)
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                       power, center, pad_mode)
+        self.fbank = compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max, htk, norm)
+
+    def forward(self, x):
+        spec = self.spectrogram(x)
+        return apply_op(lambda s: jnp.asarray(self.fbank) @ s, spec)
+
+
+class LogMelSpectrogram(MelSpectrogram):
+    def __init__(self, *args, ref_value=1.0, amin=1e-10, top_db=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.amin = amin
+        self.ref_value = ref_value
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = super().forward(x)
+
+        def f(m):
+            db = 10.0 * jnp.log10(jnp.maximum(m, self.amin) / self.ref_value)
+            if self.top_db is not None:
+                db = jnp.maximum(db, db.max() - self.top_db)
+            return db
+
+        return apply_op(f, mel)
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, n_mels=64, **kwargs):
+        super().__init__()
+        self.logmel = LogMelSpectrogram(sr=sr, n_fft=n_fft, n_mels=n_mels, **kwargs)
+        # DCT-II basis (orthonormal)
+        n = n_mels
+        basis = np.cos(np.pi / n * (np.arange(n) + 0.5)[None, :] * np.arange(n_mfcc)[:, None])
+        basis *= np.sqrt(2.0 / n)
+        basis[0] *= np.sqrt(0.5)
+        self.dct = basis.astype(np.float32)
+
+    def forward(self, x):
+        logmel = self.logmel(x)
+        return apply_op(lambda m: jnp.asarray(self.dct) @ m, logmel)

@@ -1,0 +1,92 @@
+"""Audio functional ops (reference: python/paddle/audio/functional/)."""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply_op
+
+
+def hz_to_mel(freq, htk=False):
+    if htk:
+        return 2595.0 * np.log10(1.0 + np.asarray(freq) / 700.0)
+    freq = np.asarray(freq, np.float64)
+    f_min, f_sp = 0.0, 200.0 / 3
+    mels = (freq - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    safe = np.maximum(freq, 1e-10)  # avoid log(0) in the unused branch
+    return np.where(freq >= min_log_hz,
+                    min_log_mel + np.log(safe / min_log_hz) / logstep, mels)
+
+
+def mel_to_hz(mel, htk=False):
+    if htk:
+        return 700.0 * (10.0 ** (np.asarray(mel) / 2595.0) - 1.0)
+    mel = np.asarray(mel, np.float64)
+    f_min, f_sp = 0.0, 200.0 / 3
+    freqs = f_min + f_sp * mel
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return np.where(mel >= min_log_mel,
+                    min_log_hz * np.exp(logstep * (mel - min_log_mel)), freqs)
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None, htk=False,
+                         norm="slaney", dtype="float32"):
+    f_max = f_max or sr / 2.0
+    n_freqs = n_fft // 2 + 1
+    fft_freqs = np.linspace(0, sr / 2, n_freqs)
+    mel_pts = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk), n_mels + 2)
+    hz_pts = mel_to_hz(mel_pts, htk)
+    fb = np.zeros((n_mels, n_freqs))
+    for m in range(n_mels):
+        lo, ctr, hi = hz_pts[m], hz_pts[m + 1], hz_pts[m + 2]
+        up = (fft_freqs - lo) / max(ctr - lo, 1e-10)
+        down = (hi - fft_freqs) / max(hi - ctr, 1e-10)
+        fb[m] = np.maximum(0.0, np.minimum(up, down))
+    if norm == "slaney":
+        enorm = 2.0 / (hz_pts[2:] - hz_pts[:-2])
+        fb *= enorm[:, None]
+    return fb.astype(dtype)
+
+
+def get_window(window, win_length, fftbins=True):
+    n = win_length
+    if window in ("hann", "hanning"):
+        return (0.5 - 0.5 * np.cos(2 * np.pi * np.arange(n) / n)).astype(np.float32)
+    if window == "hamming":
+        return (0.54 - 0.46 * np.cos(2 * np.pi * np.arange(n) / n)).astype(np.float32)
+    if window in ("rect", "ones", "boxcar"):
+        return np.ones(n, np.float32)
+    raise ValueError(window)
+
+
+def stft_mag(x, n_fft=512, hop_length=None, win_length=None, window="hann",
+             center=True, power=2.0):
+    """|STFT|^power of [..., T] signals -> [..., n_freqs, frames]."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    win = get_window(window, win_length)
+    if win_length < n_fft:
+        pad = (n_fft - win_length) // 2
+        win = np.pad(win, (pad, n_fft - win_length - pad))
+
+    def f(a):
+        if center:
+            pad = [(0, 0)] * (a.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            a = jnp.pad(a, pad, mode="reflect")
+        length = a.shape[-1]
+        n_frames = 1 + (length - n_fft) // hop_length
+        idx = (jnp.arange(n_frames)[:, None] * hop_length + jnp.arange(n_fft)[None, :])
+        frames = a[..., idx] * jnp.asarray(win)
+        spec = jnp.fft.rfft(frames, axis=-1)
+        mag = jnp.abs(spec) ** power
+        return jnp.swapaxes(mag, -1, -2)
+
+    return apply_op(f, x, op_name="stft")

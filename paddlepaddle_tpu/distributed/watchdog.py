@@ -1,0 +1,113 @@
+"""Training watchdog — hang detection for distributed steps.
+
+Reference surface: the collective watchdog (CommTaskManager
+paddle/phi/core/distributed/comm_task_manager.h:37 — every NCCL collective
+registers a CommTask; a loop detects timeout, logs the exact op, optionally
+aborts) and the launcher watch loop (launch/controllers/watcher.py).
+
+TPU-native: XLA collectives can't hang mid-program the way a lost NCCL rank
+can, but a *step* can hang on a wedged host, a dead DCN peer (store), or a
+stuck infeed. The watchdog wraps step execution: each step registers a task
+with a deadline; a monitor thread fires a timeout handler (log + optional
+abort) if the step doesn't retire in time — the launcher then restarts the
+worker (distributed/launch --max_restarts) and training resumes from the
+checkpoint (distributed/checkpoint).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, Optional
+
+
+class StepTimeout(RuntimeError):
+    pass
+
+
+class Watchdog:
+    def __init__(self, timeout: float = 1800.0, on_timeout: Optional[Callable] = None,
+                 abort: bool = True, poll_interval: float = 1.0):
+        self.timeout = timeout
+        self.on_timeout = on_timeout
+        self.abort = abort
+        self.poll_interval = poll_interval
+        self._lock = threading.Lock()
+        self._current = None  # (name, start_time)
+        self._stop = threading.Event()
+        self._fired = False
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- task registration (the CommTask analogue) --------------------------
+    def step(self, name: str = "train_step"):
+        wd = self
+
+        class _Task:
+            def __enter__(self):
+                with wd._lock:
+                    wd._current = (name, time.monotonic())
+                return self
+
+            def __exit__(self, *exc):
+                with wd._lock:
+                    wd._current = None
+                return False
+
+        return _Task()
+
+    def run(self, fn, *args, name: str = "train_step", **kwargs):
+        with self.step(name):
+            return fn(*args, **kwargs)
+
+    # -- monitor ------------------------------------------------------------
+    def _loop(self):
+        while not self._stop.wait(self.poll_interval):
+            with self._lock:
+                cur = self._current
+            if cur is None:
+                continue
+            name, start = cur
+            elapsed = time.monotonic() - start
+            if elapsed > self.timeout and not self._fired:
+                self._fired = True
+                self._dump(name, elapsed)
+                if self.on_timeout is not None:
+                    try:
+                        self.on_timeout(name, elapsed)
+                    except Exception:
+                        pass
+                if self.abort:
+                    # non-zero exit lets the launcher's watch loop restart us
+                    os._exit(114)
+
+    def _dump(self, name, elapsed):
+        sys.stderr.write(
+            f"[watchdog] step {name!r} exceeded {self.timeout:.0f}s "
+            f"(elapsed {elapsed:.0f}s); stacks of all threads:\n")
+        for tid, frame in sys._current_frames().items():
+            sys.stderr.write(f"--- thread {tid} ---\n")
+            sys.stderr.write("".join(traceback.format_stack(frame)))
+        sys.stderr.flush()

@@ -555,7 +555,7 @@ class Cauchy(Distribution):
 class Chi2(Gamma):
     def __init__(self, df, name=None):
         self.df = _as_array(df)
-        super().__init__(self.df / 2.0, jnp.asarray(0.5))
+        super().__init__(self.df / 2.0, 0.5)
 
 
 class ExponentialFamily(Distribution):

@@ -57,7 +57,8 @@ class GPTAttention(Layer):
         self.head_dim = h // self.num_heads
         self.qkv_proj = Linear(h, 3 * h)
         self.out_proj = Linear(h, h)
-        self.dropout = Dropout(config.attention_probs_dropout_prob)
+        self.attn_dropout_p = config.attention_probs_dropout_prob
+        self.resid_dropout = Dropout(config.hidden_dropout_prob)
 
     def forward(self, x):
         b, s = x.shape[0], x.shape[1]
@@ -65,8 +66,10 @@ class GPTAttention(Layer):
         q = qkv[:, :, 0]
         k = qkv[:, :, 1]
         v = qkv[:, :, 2]
-        out, _ = F.flash_attention(q, k, v, causal=True)
-        return self.dropout(self.out_proj(out.reshape([b, s, -1])))
+        out, _ = F.flash_attention(q, k, v, causal=True,
+                                   dropout=self.attn_dropout_p,
+                                   training=self.training)
+        return self.resid_dropout(self.out_proj(out.reshape([b, s, -1])))
 
 
 class GPTBlock(Layer):

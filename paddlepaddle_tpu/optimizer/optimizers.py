@@ -309,17 +309,23 @@ class LBFGS(Optimizer):
             if self.line_search_fn is not None:
                 # backtracking Armijo: shrink until sufficient decrease
                 g_dot_d = float(jnp.dot(flat_grad, direction))
+                accepted = False
                 for _bt in range(10):
                     self._unflatten_apply(step_size * direction)
                     self.clear_grad()
                     trial = eval_closure()
                     trial_val = float(trial.numpy())
                     if trial_val <= loss_val + 1e-4 * step_size * g_dot_d:
+                        accepted = True
                         break
                     self._unflatten_apply(-step_size * direction)  # undo
                     step_size *= 0.5
                     if n_evals[0] >= self.max_eval:
                         break
+                if not accepted:
+                    # params are back at the start point; recording the
+                    # rejected move would poison the curvature history
+                    break
                 update = step_size * direction
                 loss_val = trial_val
             else:

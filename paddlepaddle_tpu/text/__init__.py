@@ -1,0 +1,77 @@
+"""paddle.text (reference: python/paddle/text/ — dataset helpers).
+
+Zero-egress environment: datasets load from local files; a ByteTokenizer and
+synthetic LM dataset cover the smoke/training path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+
+class ByteTokenizer:
+    """UTF-8 byte-level tokenizer (vocab 256 + specials) — dependency-free."""
+
+    def __init__(self, bos_id: int = 256, eos_id: int = 257):
+        self.bos_id = bos_id
+        self.eos_id = eos_id
+        self.vocab_size = 258
+
+    def encode(self, text: str, add_bos=False, add_eos=False):
+        ids = list(text.encode("utf-8"))
+        if add_bos:
+            ids = [self.bos_id] + ids
+        if add_eos:
+            ids = ids + [self.eos_id]
+        return ids
+
+    def decode(self, ids):
+        return bytes(i for i in ids if i < 256).decode("utf-8", errors="replace")
+
+
+class LMDataset(Dataset):
+    """Fixed-length LM chunks from a text file or string (pretrain smoke)."""
+
+    def __init__(self, text=None, file_path=None, seq_len=128, tokenizer=None):
+        if file_path is not None:
+            with open(file_path, "r", encoding="utf-8") as f:
+                text = f.read()
+        if text is None:
+            raise ValueError("need text or file_path")
+        self.tokenizer = tokenizer or ByteTokenizer()
+        ids = np.asarray(self.tokenizer.encode(text), np.int32)
+        n = (len(ids) - 1) // seq_len
+        self.inputs = ids[: n * seq_len].reshape(n, seq_len)
+        self.labels = ids[1: n * seq_len + 1].reshape(n, seq_len)
+
+    def __getitem__(self, idx):
+        return self.inputs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.inputs)
+
+
+class Imdb(Dataset):
+    """IMDB sentiment from a local directory of {pos,neg} text files."""
+
+    def __init__(self, data_dir=None, mode="train", cutoff=150):
+        import os
+
+        if data_dir is None:
+            raise ValueError("downloads are disabled; pass data_dir")
+        self.samples = []
+        for label, sub in ((1, "pos"), (0, "neg")):
+            d = os.path.join(data_dir, mode, sub)
+            if not os.path.isdir(d):
+                continue
+            for fn in sorted(os.listdir(d)):
+                with open(os.path.join(d, fn), encoding="utf-8") as f:
+                    self.samples.append((f.read(), label))
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)

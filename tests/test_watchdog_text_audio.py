@@ -1,0 +1,86 @@
+"""Watchdog, text tokenizer/datasets, audio features."""
+
+import time
+
+import numpy as np
+import pytest
+
+import paddlepaddle_tpu as paddle
+
+
+def test_watchdog_fires_on_hang():
+    from paddlepaddle_tpu.distributed.watchdog import Watchdog
+
+    fired = []
+    wd = Watchdog(timeout=0.3, poll_interval=0.05, abort=False,
+                  on_timeout=lambda name, el: fired.append((name, el)))
+    with wd:
+        with wd.step("slow_step"):
+            time.sleep(0.8)
+    assert fired and fired[0][0] == "slow_step"
+
+
+def test_watchdog_quiet_on_fast_steps():
+    from paddlepaddle_tpu.distributed.watchdog import Watchdog
+
+    fired = []
+    wd = Watchdog(timeout=5.0, poll_interval=0.05, abort=False,
+                  on_timeout=lambda *a: fired.append(a))
+    with wd:
+        for _ in range(3):
+            with wd.step():
+                time.sleep(0.01)
+    assert not fired
+
+
+def test_byte_tokenizer_roundtrip():
+    from paddlepaddle_tpu.text import ByteTokenizer
+
+    tok = ByteTokenizer()
+    ids = tok.encode("héllo wörld", add_bos=True, add_eos=True)
+    assert ids[0] == tok.bos_id and ids[-1] == tok.eos_id
+    assert tok.decode(ids) == "héllo wörld"
+
+
+def test_lm_dataset_trains_llama():
+    from paddlepaddle_tpu.io.dataloader import DataLoader
+    from paddlepaddle_tpu.jit.train import TrainStep
+    from paddlepaddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddlepaddle_tpu.text import ByteTokenizer, LMDataset
+
+    tok = ByteTokenizer()
+    ds = LMDataset(text="hello world! " * 200, seq_len=32, tokenizer=tok)
+    loader = DataLoader(ds, batch_size=4, shuffle=True, drop_last=True)
+    m = LlamaForCausalLM(LlamaConfig.tiny(vocab_size=tok.vocab_size,
+                                          hidden_size=32, layers=2, heads=4,
+                                          kv_heads=2, max_len=32))
+    opt = paddle.optimizer.AdamW(learning_rate=5e-3, parameters=m.parameters())
+    step = TrainStep(m, opt, lambda mm, ids, labels: mm(ids, labels=labels))
+    losses = []
+    for epoch in range(2):
+        for ids, labels in loader:
+            losses.append(float(step(ids, labels).numpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_audio_features_shapes():
+    from paddlepaddle_tpu.audio.features import MFCC, LogMelSpectrogram, MelSpectrogram, Spectrogram
+
+    sig = np.sin(2 * np.pi * 440 * np.arange(8000) / 16000).astype(np.float32)
+    spec = Spectrogram(n_fft=256)(sig)
+    assert spec.shape[0] == 129  # n_fft//2+1
+    mel = MelSpectrogram(sr=16000, n_fft=256, n_mels=32)(sig)
+    assert mel.shape[0] == 32
+    logmel = LogMelSpectrogram(sr=16000, n_fft=256, n_mels=32)(sig)
+    assert np.isfinite(logmel.numpy()).all()
+    mfcc = MFCC(sr=16000, n_mfcc=13, n_fft=256, n_mels=32)(sig)
+    assert mfcc.shape[0] == 13
+
+
+def test_mel_filterbank_matches_librosa_shape():
+    from paddlepaddle_tpu.audio.functional import compute_fbank_matrix, hz_to_mel, mel_to_hz
+
+    fb = compute_fbank_matrix(16000, 512, n_mels=40)
+    assert fb.shape == (40, 257)
+    assert (fb >= 0).all()
+    np.testing.assert_allclose(mel_to_hz(hz_to_mel(440.0)), 440.0, rtol=1e-6)
