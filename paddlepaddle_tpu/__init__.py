@@ -84,6 +84,7 @@ from . import distributed  # noqa: E402,F401
 from . import distribution  # noqa: E402,F401
 from . import fft  # noqa: E402,F401
 from . import framework  # noqa: E402,F401
+from . import geometric  # noqa: E402,F401
 from . import hapi  # noqa: E402,F401
 from . import incubate  # noqa: E402,F401
 from . import inference  # noqa: E402,F401

@@ -29,6 +29,9 @@ from .env import (  # noqa: F401
 )
 from . import checkpoint  # noqa: F401
 from . import communication  # noqa: F401
+from . import rpc  # noqa: F401
+from .auto_tuner import AutoTuner, TuneConfig  # noqa: F401
+from .watchdog import Watchdog  # noqa: F401
 from . import fleet  # noqa: F401
 from . import sharding  # noqa: F401
 from . import auto_parallel  # noqa: F401  (isort: after fleet to avoid cycle)

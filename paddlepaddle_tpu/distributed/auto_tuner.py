@@ -1,0 +1,113 @@
+"""Auto-tuner — grid search over parallel configs with a memory model.
+
+Reference surface: python/paddle/distributed/auto_tuner/ (candidate config
+generation from dp/mp/pp/sharding degrees, memory-model pruning, recording
+of trial results).
+
+TPU-native: candidates are mesh shapes (dp × fsdp × tp × pp) over the chip
+count; the memory model estimates per-chip bytes for params, grads,
+optimizer state (Adam fp32 m/v + master) and activations under each
+placement, prunes configs over the HBM budget, and ranks survivors by a
+communication-cost heuristic (prefer fewer pp stages, then wider dp).
+``tune(run_fn)`` optionally measures real step time per surviving config.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+
+@dataclass
+class TuneConfig:
+    dp: int
+    fsdp: int
+    tp: int
+    pp: int
+    est_param_bytes_per_chip: float = 0.0
+    est_activation_bytes_per_chip: float = 0.0
+    est_total_bytes_per_chip: float = 0.0
+    measured_step_time: Optional[float] = None
+
+    @property
+    def degrees(self):
+        return {"dp_degree": self.dp, "sharding_degree": self.fsdp,
+                "mp_degree": self.tp, "pp_degree": self.pp}
+
+    def __repr__(self):
+        t = f", {self.measured_step_time * 1e3:.1f} ms" if self.measured_step_time else ""
+        return (f"TuneConfig(dp={self.dp} fsdp={self.fsdp} tp={self.tp} pp={self.pp}, "
+                f"~{self.est_total_bytes_per_chip / 2**30:.2f} GiB/chip{t})")
+
+
+def _divisors(n):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+class AutoTuner:
+    def __init__(self, num_devices: int, hbm_bytes: float = 16 * 2 ** 30,
+                 param_dtype_bytes: int = 2, master_weights: bool = True,
+                 optimizer_slots: int = 2):
+        self.num_devices = num_devices
+        self.hbm_bytes = hbm_bytes
+        self.param_bytes = param_dtype_bytes
+        # Adam: m+v fp32 (+ fp32 master when training low-precision)
+        self.state_bytes = 4 * optimizer_slots + (4 if master_weights else 0)
+
+    def candidates(self, max_tp: int = 8, max_pp: int = 8) -> List[TuneConfig]:
+        out = []
+        n = self.num_devices
+        for tp in _divisors(n):
+            if tp > max_tp:
+                continue
+            for pp in _divisors(n // tp):
+                if pp > max_pp:
+                    continue
+                rest = n // (tp * pp)
+                for fsdp in _divisors(rest):
+                    dp = rest // fsdp
+                    out.append(TuneConfig(dp=dp, fsdp=fsdp, tp=tp, pp=pp))
+        return out
+
+    def estimate(self, cfg: TuneConfig, num_params: int, batch_size: int,
+                 seq_len: int, hidden: int, layers: int) -> TuneConfig:
+        shard = cfg.tp * cfg.fsdp * cfg.pp  # params divided over these axes
+        p_bytes = num_params * self.param_bytes / shard
+        # grads same layout as params; optimizer state sharded like params
+        g_bytes = num_params * self.param_bytes / shard
+        s_bytes = num_params * self.state_bytes / (cfg.tp * cfg.fsdp * cfg.pp)
+        micro_b = max(1, batch_size // max(cfg.dp * cfg.fsdp, 1))
+        layers_per_stage = max(1, layers // cfg.pp)
+        # rough remat-style activation footprint: one boundary act per layer
+        act = (micro_b * seq_len * hidden * self.param_bytes
+               * layers_per_stage / max(cfg.tp, 1))
+        cfg.est_param_bytes_per_chip = p_bytes
+        cfg.est_activation_bytes_per_chip = act
+        cfg.est_total_bytes_per_chip = p_bytes + g_bytes + s_bytes + act
+        return cfg
+
+    def prune(self, cfgs: List[TuneConfig], headroom: float = 0.9) -> List[TuneConfig]:
+        return [c for c in cfgs if c.est_total_bytes_per_chip <= self.hbm_bytes * headroom]
+
+    @staticmethod
+    def rank(cfgs: List[TuneConfig]) -> List[TuneConfig]:
+        # heuristic: fewer pipeline stages (bubble), then less tp (collective
+        # latency), then plain dp over fsdp (no gather traffic)
+        return sorted(cfgs, key=lambda c: (c.pp, c.tp, -c.dp))
+
+    def tune(self, num_params: int, batch_size: int, seq_len: int, hidden: int,
+             layers: int, run_fn: Optional[Callable[[TuneConfig], float]] = None,
+             top_k: int = 3) -> List[TuneConfig]:
+        cfgs = [self.estimate(c, num_params, batch_size, seq_len, hidden, layers)
+                for c in self.candidates()]
+        survivors = self.rank(self.prune(cfgs))
+        if run_fn is None:
+            return survivors[:top_k]
+        measured = []
+        for c in survivors[:top_k]:
+            try:
+                c.measured_step_time = float(run_fn(c))
+                measured.append(c)
+            except Exception:
+                continue
+        return sorted(measured, key=lambda c: c.measured_step_time)

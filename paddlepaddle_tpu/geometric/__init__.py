@@ -1,0 +1,96 @@
+"""paddle.geometric — graph message passing (reference: python/paddle/geometric/
+send_u_recv/send_ue_recv/segment_{sum,mean,max,min}, sample_neighbors).
+
+TPU-native: gathers + jax segment reductions (XLA scatter) — static shapes
+via the required out_size/num_segments arguments.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+
+
+def _segment_reduce(msgs, dst_i, n, reduce_op):
+    if reduce_op == "sum":
+        return jax.ops.segment_sum(msgs, dst_i, num_segments=n)
+    if reduce_op == "mean":
+        tot = jax.ops.segment_sum(msgs, dst_i, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((msgs.shape[0],), msgs.dtype), dst_i,
+                                  num_segments=n)
+        return tot / jnp.maximum(cnt, 1)[(...,) + (None,) * (msgs.ndim - 1)]
+    if reduce_op == "max":
+        return jax.ops.segment_max(msgs, dst_i, num_segments=n)
+    if reduce_op == "min":
+        return jax.ops.segment_min(msgs, dst_i, num_segments=n)
+    raise ValueError(reduce_op)
+
+
+def segment_sum(data, segment_ids, num_segments=None):
+    def f(d, s):
+        n = num_segments if num_segments is not None else int(jnp.max(s)) + 1
+        return jax.ops.segment_sum(d, s.astype(jnp.int32), num_segments=n)
+
+    return apply_op(f, data, segment_ids, op_name="segment_sum")
+
+
+def segment_mean(data, segment_ids, num_segments=None):
+    def f(d, s):
+        n = num_segments if num_segments is not None else int(jnp.max(s)) + 1
+        s = s.astype(jnp.int32)
+        tot = jax.ops.segment_sum(d, s, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((d.shape[0],), d.dtype), s, num_segments=n)
+        return tot / jnp.maximum(cnt, 1)[(...,) + (None,) * (d.ndim - 1)]
+
+    return apply_op(f, data, segment_ids, op_name="segment_mean")
+
+
+def segment_max(data, segment_ids, num_segments=None):
+    def f(d, s):
+        n = num_segments if num_segments is not None else int(jnp.max(s)) + 1
+        return jax.ops.segment_max(d, s.astype(jnp.int32), num_segments=n)
+
+    return apply_op(f, data, segment_ids, op_name="segment_max")
+
+
+def segment_min(data, segment_ids, num_segments=None):
+    def f(d, s):
+        n = num_segments if num_segments is not None else int(jnp.max(s)) + 1
+        return jax.ops.segment_min(d, s.astype(jnp.int32), num_segments=n)
+
+    return apply_op(f, data, segment_ids, op_name="segment_min")
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None, name=None):
+    """Gather x[src] and segment-reduce onto dst (reference geometric API)."""
+
+    def f(xa, src, dst):
+        n = out_size if out_size is not None else xa.shape[0]
+        msgs = xa[src.astype(jnp.int32)]
+        return _segment_reduce(msgs, dst.astype(jnp.int32), n, reduce_op)
+
+    return apply_op(f, x, src_index, dst_index, op_name="send_u_recv")
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add", reduce_op="sum",
+                 out_size=None, name=None):
+    """Combine node features x[src] with edge features y, then reduce to dst."""
+
+    def f(xa, ya, src, dst):
+        msgs = xa[src.astype(jnp.int32)]
+        if message_op == "add":
+            msgs = msgs + ya
+        elif message_op == "sub":
+            msgs = msgs - ya
+        elif message_op == "mul":
+            msgs = msgs * ya
+        elif message_op == "div":
+            msgs = msgs / ya
+        else:
+            raise ValueError(message_op)
+        n = out_size if out_size is not None else xa.shape[0]
+        return _segment_reduce(msgs, dst.astype(jnp.int32), n, reduce_op)
+
+    return apply_op(f, x, y, src_index, dst_index, op_name="send_ue_recv")
