@@ -60,13 +60,14 @@ from .core.random import get_rng_state, seed, set_rng_state  # noqa: F401
 # ops namespace (also patches Tensor methods)
 from .ops import comparison as _cmp  # noqa: F401
 from .ops import creation as _creation
+from .ops import extras as _extras
 from .ops import linalg as _linalg
 from .ops import manipulation as _manip
 from .ops import math as _math
 from .ops import reduction as _reduction
 from .ops import search as _search
 
-_OP_MODULES = (_creation, _math, _reduction, _manip, _cmp, _linalg, _search)
+_OP_MODULES = (_creation, _math, _reduction, _manip, _cmp, _linalg, _search, _extras)
 _globals = globals()
 for _mod in _OP_MODULES:
     for _name in dir(_mod):

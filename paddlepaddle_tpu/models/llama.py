@@ -108,6 +108,36 @@ def _apply_rope(q, k, cos, sin, offset=0):
     return apply_op(f, q, k, cos, sin, op_name="fused_rope")
 
 
+def _cached_attention(q, k_new, v_new, k_cache, v_cache, pos, n_rep, scale):
+    """Write new K/V at [pos:pos+s] and attend q over the valid cache prefix.
+
+    q/k_new/v_new: [b, s, h(…kv), d]; caches [b, L, kvh, d]; pos traced scalar.
+    Returns (out [b, s, h, d], k_cache', v_cache')."""
+    b, s = q.shape[0], q.shape[1]
+    L = k_cache.shape[1]
+    pos = jnp.asarray(pos, jnp.int32)
+    zero = jnp.zeros((), jnp.int32)
+    k_cache = jax.lax.dynamic_update_slice(k_cache, k_new.astype(k_cache.dtype),
+                                           (zero, pos, zero, zero))
+    v_cache = jax.lax.dynamic_update_slice(v_cache, v_new.astype(v_cache.dtype),
+                                           (zero, pos, zero, zero))
+    kk, vv = k_cache, v_cache
+    if n_rep > 1:
+        kk = jnp.repeat(kk, n_rep, axis=2)
+        vv = jnp.repeat(vv, n_rep, axis=2)
+    qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * scale     # [b,h,s,d]
+    kt = jnp.swapaxes(kk, 1, 2).astype(jnp.float32)            # [b,h,L,d]
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt)
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, (s, L), 1)
+    q_pos = pos + jax.lax.broadcasted_iota(jnp.int32, (s, L), 0)
+    valid = k_pos <= q_pos                                      # causal + prefix
+    logits = jnp.where(valid[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(vv.dtype),
+                     jnp.swapaxes(vv, 1, 2))
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype), k_cache, v_cache
+
+
 class LlamaAttention(Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__(dtype=config.dtype)
@@ -122,11 +152,26 @@ class LlamaAttention(Layer):
         self.v_proj = Linear(h, kv, bias_attr=False)
         self.o_proj = Linear(h, h, bias_attr=False)
 
-    def forward(self, x, cos, sin, attn_mask=None):
+    def forward(self, x, cos, sin, attn_mask=None, cache=None, pos=None):
         b, s = x.shape[0], x.shape[1]
         q = self.q_proj(x).reshape([b, s, self.num_heads, self.head_dim])
         k = self.k_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
         v = self.v_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
+        if cache is not None:
+            if attn_mask is not None:
+                raise NotImplementedError(
+                    "KV-cache decoding supports causal masking only; strip "
+                    "padding (or use dense attention) when passing caches")
+            # KV-cache decode: rope at the true positions, write-through cache,
+            # attend over the valid prefix (one compiled step serves all pos)
+            q, k = _apply_rope(q, k, cos, sin, offset=pos)
+            rep = self.num_heads // self.num_kv_heads
+            scale = 1.0 / math.sqrt(self.head_dim)
+            out, kc, vc = apply_op(
+                lambda qa, ka, va, kca, vca: _cached_attention(
+                    qa, ka, va, kca, vca, pos, rep, scale),
+                q, k, v, cache[0], cache[1], op_name="cached_attention")
+            return self.o_proj(out.reshape([b, s, -1])), (kc, vc)
         q, k = _apply_rope(q, k, cos, sin)
         if self.num_kv_heads != self.num_heads:
             rep = self.num_heads // self.num_kv_heads
@@ -171,7 +216,13 @@ class LlamaDecoderLayer(Layer):
         self.post_attention_layernorm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
         self.mlp = LlamaMLP(config)
 
-    def forward(self, x, cos, sin, attn_mask=None):
+    def forward(self, x, cos, sin, attn_mask=None, cache=None, pos=None):
+        if cache is not None:
+            attn_out, new_cache = self.self_attn(self.input_layernorm(x), cos, sin,
+                                                 attn_mask, cache=cache, pos=pos)
+            x = x + attn_out
+            x = x + self.mlp(self.post_attention_layernorm(x))
+            return x, new_cache
         x = x + self.self_attn(self.input_layernorm(x), cos, sin, attn_mask)
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x
@@ -193,9 +244,15 @@ class LlamaModel(Layer):
         self.register_buffer("rope_cos", Tensor(cos), persistable=False)
         self.register_buffer("rope_sin", Tensor(sin), persistable=False)
 
-    def forward(self, input_ids, attn_mask=None):
+    def forward(self, input_ids, attn_mask=None, caches=None, pos=None):
         x = self.embed_tokens(input_ids)
         cos, sin = self.rope_cos, self.rope_sin
+        if caches is not None:
+            new_caches = []
+            for layer, cache in zip(self.layers, caches):
+                x, nc = layer(x, cos, sin, attn_mask, cache=cache, pos=pos)
+                new_caches.append(nc)
+            return self.norm(x), new_caches
         for layer in self.layers:
             x = layer(x, cos, sin, attn_mask)
         return self.norm(x)
@@ -222,6 +279,113 @@ class LlamaForCausalLM(Layer):
         if labels is None:
             return logits
         return self.loss_from_logits(logits, labels)
+
+    def generate_cached(self, input_ids, max_new_tokens=32, temperature=1.0,
+                        top_k=0, eos_token_id=None, seed=0):
+        """KV-cache decoding: prefill once over the prompt, then O(1)-per-token
+        single-position steps — the serving path (vs generate()'s O(L²) loop).
+        Two compiles total (prefill + decode step)."""
+        import numpy as np
+
+        from ..core import autograd as _ag
+        from ..core.dispatch import unwrap
+
+        cfg = self.config
+        ids = np.asarray(input_ids if not isinstance(input_ids, Tensor)
+                         else input_ids.numpy()).astype(np.int32)
+        b, prompt_len = ids.shape
+        if prompt_len >= cfg.max_position_embeddings:
+            raise ValueError(f"prompt length {prompt_len} exceeds "
+                             f"max_position_embeddings {cfg.max_position_embeddings}")
+        total = min(prompt_len + max_new_tokens, cfg.max_position_embeddings)
+        # bucket the cache length so calls with different max_new_tokens reuse
+        # the same compiled decode step (cache shape is part of the signature)
+        cache_len = min(-(-total // 128) * 128, cfg.max_position_embeddings)
+        state = self.functional_state()
+        kvh, hd = cfg.num_key_value_heads, cfg.head_dim
+        dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+        caches = [(jnp.zeros((b, cache_len, kvh, hd), dtype),
+                   jnp.zeros((b, cache_len, kvh, hd), dtype))
+                  for _ in range(cfg.num_hidden_layers)]
+
+        def sample(row, key):
+            if top_k and top_k > 0:
+                kth = jax.lax.top_k(row, top_k)[0][:, -1:]
+                row = jnp.where(row < kth, -jnp.inf, row)
+            if temperature == 0.0:
+                return jnp.argmax(row, axis=-1).astype(jnp.int32)
+            return jax.random.categorical(key, row / max(temperature, 1e-6)).astype(jnp.int32)
+
+        def step(params, toks, caches, pos, key):
+            with _ag.no_grad(), self.bind_state(params):
+                hidden, new_caches = self.model(toks, caches=caches, pos=pos)
+                if self.lm_head is None:
+                    logits = apply_op(lambda h, w: h @ w.T, hidden,
+                                      self.model.embed_tokens.weight)
+                else:
+                    logits = self.lm_head(hidden)
+            new_caches = [(unwrap(k), unwrap(v)) for k, v in new_caches]
+            row = unwrap(logits)[:, -1].astype(jnp.float32)
+            key, sub = jax.random.split(key)
+            nxt = sample(row, sub)
+            return nxt, new_caches, pos + jnp.int32(toks.shape[1]), key
+
+        # bucket gen length so nearby max_new_tokens values reuse the same
+        # compiled program; the result is trimmed to the requested length
+        gen_len = min(-(-(total - prompt_len) // 64) * 64,
+                      cache_len - prompt_len)
+
+        def run_all(params, prompt, caches, key):
+            # prefill + the whole token loop in ONE compiled program: a single
+            # dispatch per generate() call (per-call overhead over remote
+            # transports would otherwise dominate single-token steps)
+            nxt, caches, pos, key = step(params, prompt, caches, jnp.int32(0), key)
+            buf = jnp.zeros((b, gen_len), jnp.int32)
+            buf = buf.at[:, 0].set(nxt)
+            finished = (nxt == eos_token_id) if eos_token_id is not None \
+                else jnp.zeros((b,), bool)
+
+            def cond(carry):
+                i, nxt, caches, pos, key, buf, finished = carry
+                return (i < gen_len) & ~jnp.all(finished)
+
+            def body(carry):
+                i, nxt, caches, pos, key, buf, finished = carry
+                nxt, caches, pos, key = step(params, nxt[:, None], caches, pos, key)
+                buf = jax.lax.dynamic_update_slice(buf, nxt[:, None],
+                                                   (jnp.int32(0), i))
+                if eos_token_id is not None:
+                    finished = finished | (nxt == eos_token_id)
+                return i + 1, nxt, caches, pos, key, buf, finished
+
+            carry = (jnp.int32(1), nxt, caches, pos, key, buf, finished)
+            _, _, _, _, _, buf, _ = jax.lax.while_loop(cond, body, carry)
+            return buf
+
+        # cache the compiled program per signature — jax.jit identity is the
+        # function object, so a fresh jit per call would recompile every time
+        sig = (b, prompt_len, gen_len, cache_len, temperature, top_k,
+               eos_token_id)
+        if not hasattr(self, "_decode_fns"):
+            object.__setattr__(self, "_decode_fns", {})
+        fn = self._decode_fns.get(sig)
+        if fn is None:
+            if len(self._decode_fns) >= 8:  # bound pinned executables
+                self._decode_fns.pop(next(iter(self._decode_fns)))
+            fn = jax.jit(run_all)
+            self._decode_fns[sig] = fn
+        key = jax.random.PRNGKey(seed)
+        gen = np.asarray(fn(state, jnp.asarray(ids), caches, key))
+        gen = gen[:, : total - prompt_len]  # trim gen-length bucketing
+        if eos_token_id is not None:
+            hit = gen == eos_token_id
+            first = np.where(hit.any(1), hit.argmax(1), gen.shape[1] - 1)
+            posn = np.arange(gen.shape[1])[None, :]
+            gen = np.where(posn > first[:, None], eos_token_id, gen)
+            # match generate(): stop at the last row's first eos
+            gen = gen[:, : int(first.max()) + 1]
+        result = np.concatenate([ids, gen], axis=1)
+        return Tensor._from_data(jnp.asarray(result))
 
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0, top_k=0,
                  eos_token_id=None, seed=0):

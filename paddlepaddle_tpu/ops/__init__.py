@@ -10,9 +10,10 @@ import jax.numpy as jnp
 
 from ..core.dispatch import apply_op
 from ..core.tensor import Tensor
+from . import extras  # noqa: F401
 from . import comparison, creation, indexing, linalg, manipulation, math, reduction, search
 
-_MODULES = [math, reduction, manipulation, comparison, linalg, search]
+_MODULES = [math, reduction, manipulation, comparison, linalg, search, extras]
 
 _NOT_METHODS = {
     "broadcast_shape",

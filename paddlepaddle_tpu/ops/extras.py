@@ -1,0 +1,144 @@
+"""Long-tail tensor ops (reference: python/paddle/tensor/ assorted)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op, defop, unwrap
+from ..core.tensor import Tensor
+
+
+@defop
+def take(x, index, mode="raise"):
+    flat = jnp.ravel(x)
+    idx = index.astype(jnp.int64)
+    if mode == "wrap":
+        idx = idx % flat.shape[0]
+    elif mode == "clip":
+        idx = jnp.clip(idx, 0, flat.shape[0] - 1)
+    else:
+        idx = jnp.where(idx < 0, idx + flat.shape[0], idx)
+    return flat[idx]
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    def f(a):
+        if isinstance(num_or_indices, int):
+            return tuple(jnp.array_split(a, num_or_indices, axis=axis))
+        return tuple(jnp.split(a, list(num_or_indices), axis=axis))
+
+    return list(apply_op(f, x, op_name="tensor_split"))
+
+
+def hsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=1 if unwrap(x).ndim > 1 else 0)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+@defop
+def row_stack(x):
+    return jnp.vstack([unwrap(t) if isinstance(t, Tensor) else t for t in x]) \
+        if isinstance(x, (list, tuple)) else jnp.atleast_2d(x)
+
+
+@defop
+def sgn(x):
+    # complex-aware sign (reference paddle.sgn)
+    if jnp.iscomplexobj(x):
+        mag = jnp.abs(x)
+        return jnp.where(mag == 0, 0, x / jnp.maximum(mag, 1e-38))
+    return jnp.sign(x)
+
+
+@defop
+def signbit(x):
+    return jnp.signbit(x)
+
+
+@defop
+def sinc(x):
+    return jnp.sinc(x)
+
+
+@defop
+def trapezoid(y, x=None, dx=None, axis=-1):
+    if x is not None:
+        return jnp.trapezoid(y, x=x, axis=axis)
+    return jnp.trapezoid(y, dx=dx if dx is not None else 1.0, axis=axis)
+
+
+@defop
+def vander(x, n=None, increasing=False):
+    return jnp.vander(x, N=n, increasing=increasing)
+
+
+@defop
+def unflatten(x, axis, shape):
+    shp = list(x.shape)
+    axis = axis % x.ndim
+    new = shp[:axis] + list(shape) + shp[axis + 1:]
+    return x.reshape(new)
+
+
+@defop
+def slice_scatter(x, value, axes, starts, ends, strides=None, name=None):
+    idx = [slice(None)] * x.ndim
+    strides = strides or [1] * len(axes)
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = slice(st, en, sd)
+    return x.at[tuple(idx)].set(value)
+
+
+@defop
+def renorm(x, p, axis, max_norm):
+    moved = jnp.moveaxis(x, axis, 0)
+    flat = moved.reshape(moved.shape[0], -1)
+    norms = jnp.linalg.norm(flat, ord=p, axis=1)
+    factor = jnp.where(norms > max_norm, max_norm / jnp.maximum(norms, 1e-12), 1.0)
+    out = flat * factor[:, None]
+    return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+
+
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows along ``axis`` (reference paddle.unfold on Tensor)."""
+
+    def f(a):
+        length = a.shape[axis]
+        n = (length - size) // step + 1
+        idx = jnp.arange(n)[:, None] * step + jnp.arange(size)[None, :]
+        gathered = jnp.take(a, idx.reshape(-1), axis=axis)
+        shp = list(a.shape)
+        shp[axis:axis + 1] = [n, size]
+        out = gathered.reshape(shp)
+        # paddle layout: window dim appended at the end
+        return jnp.moveaxis(out, axis + 1, -1)
+
+    return apply_op(f, x, op_name="unfold_windows")
+
+
+def exponential_(x, lam=1.0, name=None):
+    """In-place exponential sampling (reference Tensor.exponential_)."""
+    from ..core import random as prandom
+
+    data = unwrap(x)
+    sample = jax.random.exponential(prandom.next_key(), data.shape).astype(data.dtype) / lam
+    if isinstance(x, Tensor):
+        x._replace_data(sample)
+        return x
+    return Tensor._from_data(sample)
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    from ..core import random as prandom
+
+    shape = shape or [1]
+    out = jnp.exp(mean + std * jax.random.normal(prandom.next_key(), tuple(shape)))
+    return Tensor._from_data(out.astype(jnp.float32))

@@ -57,7 +57,9 @@ def _blocks(sq, sk):
         block_kv //= 2
     block_q = max(block_q, 8)
     block_kv = max(block_kv, 8)
-    if sq % block_q or sk % block_kv:
+    # Mosaic needs sublane-aligned tiles: blocks (and hence seq) must be
+    # multiples of 8, else fall back to the XLA path
+    if sq % block_q or sk % block_kv or block_q % 8 or block_kv % 8:
         return None
     return block_q, block_kv
 

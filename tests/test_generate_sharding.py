@@ -89,3 +89,30 @@ def test_dataloader_multiworker_order_and_errors():
 
     with pytest.raises(RuntimeError, match="boom"):
         list(DataLoader(Bad(), batch_size=2, num_workers=2))
+
+
+def test_generate_cached_matches_full_greedy():
+    """KV-cache decode (single compiled while_loop) == full-forward decode."""
+    paddle.seed(0)
+    m = LlamaForCausalLM(LlamaConfig.tiny(vocab_size=64, hidden_size=32,
+                                          layers=2, heads=4, kv_heads=2, max_len=48))
+    ids = np.random.default_rng(0).integers(0, 64, (2, 8)).astype(np.int32)
+    full = m.generate(ids, max_new_tokens=8, temperature=0.0).numpy()
+    cached = m.generate_cached(ids, max_new_tokens=8, temperature=0.0).numpy()
+    np.testing.assert_array_equal(full, cached)
+    # second call reuses the compiled program (no error, same result)
+    cached2 = m.generate_cached(ids, max_new_tokens=8, temperature=0.0).numpy()
+    np.testing.assert_array_equal(cached, cached2)
+
+
+def test_generate_cached_eos_padding():
+    paddle.seed(0)
+    m = LlamaForCausalLM(LlamaConfig.tiny(vocab_size=16, hidden_size=16,
+                                          layers=1, heads=2, kv_heads=2, max_len=64))
+    ids = np.zeros((1, 4), np.int32)
+    greedy = m.generate_cached(ids, max_new_tokens=20, temperature=0.0)
+    first = int(greedy.numpy()[0, 4])
+    out = m.generate_cached(ids, max_new_tokens=20, temperature=0.0,
+                            eos_token_id=first)
+    tail = out.numpy()[0, 5:]
+    assert tail.size == 0 or (tail == first).all()
