@@ -29,6 +29,40 @@ def stack_stage_params(per_stage_params: Sequence[dict]) -> dict:
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, axis=0), *per_stage_params)
 
 
+def stack_virtual_stage_params(per_stage_params: Sequence[dict], n_devices: int) -> dict:
+    """[V*S trees] -> tree with leading dims [V, S, ...] for the interleaved
+    (VPP) schedule: global stage g = v*S + s lives on device s as chunk v —
+    the reference's virtual-pipeline layer assignment (pp_layers.py VPP)."""
+    total = len(per_stage_params)
+    if total % n_devices:
+        raise ValueError(f"{total} stages not divisible by {n_devices} devices")
+    v = total // n_devices
+    stacked = stack_stage_params(per_stage_params)      # [V*S, ...]
+    return jax.tree_util.tree_map(
+        lambda a: a.reshape((v, n_devices) + a.shape[1:]), stacked)
+
+
+def spmd_pipeline_interleaved(stacked_params, acts, block_fn, mesh: Mesh,
+                              n_microbatches: int, pp_axis: str = "pp",
+                              data_axis=None):
+    """Interleaved/virtual-stage pipeline (the reference's VPP schedule
+    semantics, pipeline_parallel.py:1179): each device owns V chunks; the
+    activation stream makes V laps around the device ring, applying chunk v
+    on lap v. Expressed as V chained single-lap pipelines — the inter-lap
+    transfer (last device -> device 0) is the same +1 ppermute the lap
+    already ends with, so XLA emits exactly the VPP communication pattern.
+
+    stacked_params leaves: [V, S, ...] (see stack_virtual_stage_params).
+    """
+    leaves = jax.tree_util.tree_leaves(stacked_params)
+    v = leaves[0].shape[0]
+    for lap in range(v):
+        params_lap = jax.tree_util.tree_map(lambda a: a[lap], stacked_params)
+        acts = spmd_pipeline(params_lap, acts, block_fn, mesh, n_microbatches,
+                             pp_axis=pp_axis, data_axis=data_axis)
+    return acts
+
+
 def spmd_pipeline(stacked_params, acts, block_fn: Callable, mesh: Mesh,
                   n_microbatches: int, pp_axis: str = "pp",
                   data_axis=None):
