@@ -501,32 +501,29 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
                      groups=1, dilation=1, data_format="NCHW", output_size=None, name=None):
     def f(a, w, b):
+        # gradient-of-conv formulation (matches the reference numerics):
+        # flip spatial dims, swap to OIHW, lhs-dilate by stride
         nd = 2
         p = _tup(padding, nd)
         s = _tup(stride, nd)
         d = _tup(dilation, nd)
-        # weight layout [in, out/groups, kh, kw] in paddle
-        dn = jax.lax.conv_dimension_numbers(a.shape, w.shape, ("NCHW", "IOHW", "NCHW"))
+        op = _tup(output_padding, nd)
+        cin, cog = w.shape[0], w.shape[1]  # paddle layout [in, out/groups, kh, kw]
+        wf = jnp.flip(w, axis=(2, 3))
+        if groups > 1:
+            wf = wf.reshape((groups, cin // groups, cog) + w.shape[2:])
+            wf = jnp.swapaxes(wf, 1, 2)
+            wf = wf.reshape((groups * cog, cin // groups) + w.shape[2:])
+        else:
+            wf = jnp.swapaxes(wf, 0, 1)  # -> [out, in, kh, kw]
         k = [(w.shape[2 + i] - 1) * d[i] + 1 for i in range(nd)]
-        pads = [(k[i] - 1 - p[i], k[i] - 1 - p[i] + _tup(output_padding, nd)[i]) for i in range(nd)]
+        pads = [(k[i] - 1 - p[i], k[i] - 1 - p[i] + op[i]) for i in range(nd)]
         out = jax.lax.conv_general_dilated(
-            a, jnp.flip(w, axis=(2, 3)).swapaxes(0, 1) if False else w,
-            window_strides=(1, 1),
-            padding=pads,
-            lhs_dilation=s,
-            rhs_dilation=d,
+            a, wf, window_strides=(1, 1), padding=pads,
+            lhs_dilation=s, rhs_dilation=d,
             dimension_numbers=jax.lax.conv_dimension_numbers(
-                a.shape, (w.shape[1] * groups, w.shape[0] // groups,) + w.shape[2:],
-                ("NCHW", "OIHW", "NCHW")),
-            feature_group_count=groups,
-            rhs=None,
-        ) if False else jax.lax.conv_transpose(
-            a, w, strides=s,
-            padding=[(p[i], p[i]) for i in range(nd)],
-            rhs_dilation=d,
-            dimension_numbers=dn,
-            transpose_kernel=True,
-        )
+                a.shape, wf.shape, ("NCHW", "OIHW", "NCHW")),
+            feature_group_count=groups)
         if b is not None:
             out = out + b.reshape(1, -1, 1, 1)
         return out
@@ -958,3 +955,344 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
         return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
 
     return apply_op(f, x)
+
+
+# ---------------------------------------------------------------------------
+# long-tail functional ops (coverage sweep vs reference nn/functional)
+# ---------------------------------------------------------------------------
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    def f(a, w, b):
+        a4 = a[:, :, None, :]          # NCL -> NCHW with H=1
+        w4 = w[:, :, None, :]
+        out = _unwrap_t(conv2d_transpose(a4, w4, None, stride=(1, _one(stride)),
+                                         padding=(0, _one(padding)),
+                                         output_padding=(0, _one(output_padding)),
+                                         groups=groups, dilation=(1, _one(dilation))))
+        out = out[:, :, 0, :]
+        if b is not None:
+            out = out + b[None, :, None]
+        return out
+
+    return apply_op(f, x, weight, bias, op_name="conv1d_transpose")
+
+
+def _one(v):
+    return v[0] if isinstance(v, (tuple, list)) else v
+
+
+def _unwrap_t(t):
+    from ..core.dispatch import unwrap as _u
+
+    return _u(t)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    def f(a, w, b):
+        # same gradient-of-conv formulation as conv2d_transpose
+        st = _tup(stride, 3)
+        p = _tup(padding, 3)
+        d = _tup(dilation, 3)
+        op = _tup(output_padding, 3)
+        cin, cog = w.shape[0], w.shape[1]
+        wf = jnp.flip(w, axis=(2, 3, 4))
+        if groups > 1:
+            wf = wf.reshape((groups, cin // groups, cog) + w.shape[2:])
+            wf = jnp.swapaxes(wf, 1, 2)
+            wf = wf.reshape((groups * cog, cin // groups) + w.shape[2:])
+        else:
+            wf = jnp.swapaxes(wf, 0, 1)
+        k = [(w.shape[2 + i] - 1) * d[i] + 1 for i in range(3)]
+        pads = [(k[i] - 1 - p[i], k[i] - 1 - p[i] + op[i]) for i in range(3)]
+        out = jax.lax.conv_general_dilated(
+            a, wf, window_strides=(1, 1, 1), padding=pads,
+            lhs_dilation=st, rhs_dilation=d,
+            dimension_numbers=jax.lax.conv_dimension_numbers(
+                a.shape, wf.shape, ("NCDHW", "OIDHW", "NCDHW")),
+            feature_group_count=groups)
+        if b is not None:
+            out = out + b[None, :, None, None, None]
+        return out
+
+    return apply_op(f, x, weight, bias, op_name="conv3d_transpose")
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW", name=None):
+    k = _tup(kernel_size, 3)
+    s = _tup(stride if stride is not None else kernel_size, 3)
+    p = _tup(padding, 3)
+
+    def f(a):
+        init = (jnp.asarray(-jnp.inf, a.dtype)
+                if jnp.issubdtype(a.dtype, jnp.floating)
+                else jnp.asarray(jnp.iinfo(a.dtype).min, a.dtype))
+        return jax.lax.reduce_window(
+            a, init, jax.lax.max, (1, 1) + k, (1, 1) + s,
+            [(0, 0), (0, 0)] + [(pp, pp) for pp in p])
+
+    return apply_op(f, x, op_name="max_pool3d")
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    k = _tup(kernel_size, 3)
+    s = _tup(stride if stride is not None else kernel_size, 3)
+    p = _tup(padding, 3)
+
+    def f(a):
+        pads = [(0, 0), (0, 0)] + [(pp, pp) for pp in p]
+        summed = jax.lax.reduce_window(
+            a, 0.0, jax.lax.add, (1, 1) + k, (1, 1) + s, pads)
+        if divisor_override:
+            return summed / divisor_override
+        if exclusive and any(p):
+            # divide by in-bounds element count, like avg_pool2d
+            counts = jax.lax.reduce_window(
+                jnp.ones_like(a), 0.0, jax.lax.add, (1, 1) + k, (1, 1) + s, pads)
+            return summed / counts
+        return summed / (k[0] * k[1] * k[2])
+
+    return apply_op(f, x, op_name="avg_pool3d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    out = _tup(output_size, 3)
+
+    def f(a):
+        n, c, d, h, w = a.shape
+        if d % out[0] == 0 and h % out[1] == 0 and w % out[2] == 0:
+            a = a.reshape(n, c, out[0], d // out[0], out[1], h // out[1],
+                          out[2], w // out[2])
+            return a.mean(axis=(3, 5, 7))
+        # variable windows (reference semantics) via per-axis segment means
+        def pool_axis(arr, axis, size):
+            length = arr.shape[axis]
+            starts = [(i * length) // size for i in range(size)]
+            ends = [-(-((i + 1) * length) // size) for i in range(size)]
+            pieces = [jnp.take(arr, jnp.arange(st, en), axis=axis).mean(axis=axis, keepdims=True)
+                      for st, en in zip(starts, ends)]
+            return jnp.concatenate(pieces, axis=axis)
+
+        a = pool_axis(a, 2, out[0])
+        a = pool_axis(a, 3, out[1])
+        return pool_axis(a, 4, out[2])
+
+    return apply_op(f, x, op_name="adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError("adaptive_max_pool1d(return_mask=True)")
+
+    def f(a):
+        n, c, l = a.shape
+        if l % output_size == 0:
+            return a.reshape(n, c, output_size, l // output_size).max(axis=-1)
+        starts = [(i * l) // output_size for i in range(output_size)]
+        ends = [-(-((i + 1) * l) // output_size) for i in range(output_size)]
+        return jnp.stack([a[:, :, st:en].max(axis=-1)
+                          for st, en in zip(starts, ends)], axis=-1)
+
+    return apply_op(f, x, op_name="adaptive_max_pool1d")
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    p = padding if isinstance(padding, (list, tuple)) else [padding] * 4
+    left, right, top, bottom = p
+
+    def f(a):
+        return jnp.pad(a, [(0, 0), (0, 0), (top, bottom), (left, right)])
+
+    return apply_op(f, x, op_name="zeropad2d")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def f(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, c, h // r, r, w // r, r)
+        a = jnp.transpose(a, (0, 1, 3, 5, 2, 4))
+        return a.reshape(n, c * r * r, h // r, w // r)
+
+    return apply_op(f, x, op_name="pixel_unshuffle")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def f(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, groups, c // groups, h, w)
+        return jnp.swapaxes(a, 1, 2).reshape(n, c, h, w)
+
+    return apply_op(f, x, op_name="channel_shuffle")
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    if len(out_shape) != 4:
+        raise NotImplementedError(
+            "affine_grid supports 4-D [N, C, H, W] output shapes; the 5-D "
+            "volumetric case is not implemented")
+
+    def f(th):
+        n, _, h, w = out_shape
+        if align_corners:
+            ys = jnp.linspace(-1, 1, h)
+            xs = jnp.linspace(-1, 1, w)
+        else:
+            ys = (jnp.arange(h) + 0.5) * 2 / h - 1
+            xs = (jnp.arange(w) + 0.5) * 2 / w - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1).reshape(1, h * w, 3)
+        grid = jnp.einsum("nhc,ndc->nhd", jnp.broadcast_to(base, (th.shape[0], h * w, 3)), th)
+        return grid.reshape(th.shape[0], h, w, 2)
+
+    return apply_op(f, theta, op_name="affine_grid")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros", align_corners=True, name=None):
+    def f(a, g):
+        n, c, h, w = a.shape
+        gx = g[..., 0]
+        gy = g[..., 1]
+        if align_corners:
+            fx = (gx + 1) * (w - 1) / 2
+            fy = (gy + 1) * (h - 1) / 2
+        else:
+            fx = ((gx + 1) * w - 1) / 2
+            fy = ((gy + 1) * h - 1) / 2
+        x0 = jnp.floor(fx).astype(jnp.int32)
+        y0 = jnp.floor(fy).astype(jnp.int32)
+        x1, y1 = x0 + 1, y0 + 1
+        wx = fx - x0
+        wy = fy - y0
+
+        def gather(yy, xx):
+            yc = jnp.clip(yy, 0, h - 1)
+            xc = jnp.clip(xx, 0, w - 1)
+            idx_n = jnp.arange(n)[:, None, None]
+            vals = a[idx_n, :, yc, xc]          # [n, gh, gw, c]
+            if padding_mode == "zeros":
+                inb = ((yy >= 0) & (yy < h) & (xx >= 0) & (xx < w))
+                vals = vals * inb[..., None]
+            return vals
+
+        out = (gather(y0, x0) * ((1 - wx) * (1 - wy))[..., None]
+               + gather(y0, x1) * (wx * (1 - wy))[..., None]
+               + gather(y1, x0) * ((1 - wx) * wy)[..., None]
+               + gather(y1, x1) * (wx * wy)[..., None])
+        return jnp.transpose(out, (0, 3, 1, 2))
+
+    return apply_op(f, x, grid, op_name="grid_sample")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    oh, ow = _tup(output_sizes, 2)
+    kh, kw = _tup(kernel_sizes, 2)
+    sh, sw = _tup(strides, 2)
+    ph, pw = _tup(paddings, 2)
+
+    dh, dw = _tup(dilations, 2)
+
+    def f(a):
+        n, ckk, l = a.shape
+        c = ckk // (kh * kw)
+        ekh = dh * (kh - 1) + 1  # dilated kernel extents
+        ekw = dw * (kw - 1) + 1
+        hh = (oh + 2 * ph - ekh) // sh + 1
+        ww = (ow + 2 * pw - ekw) // sw + 1
+        a = a.reshape(n, c, kh, kw, hh, ww)
+        out = jnp.zeros((n, c, oh + 2 * ph, ow + 2 * pw), a.dtype)
+        for i in range(kh):
+            for j in range(kw):
+                oi, oj = i * dh, j * dw
+                out = out.at[:, :, oi:oi + sh * hh:sh, oj:oj + sw * ww:sw].add(a[:, :, i, j])
+        return out[:, :, ph:ph + oh, pw:pw + ow]
+
+    return apply_op(f, x, op_name="fold")
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    def f(a, b):
+        diff = a - b
+        absd = jnp.abs(diff)
+        loss = jnp.where(absd <= delta, 0.5 * diff * diff,
+                         delta * (absd - 0.5 * delta))
+        return _reduce(loss, reduction)
+
+    return apply_op(f, input, label, op_name="huber_loss")
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    # softplus(-b*a) == log1p(exp(-b*a)) without float32 overflow
+    return apply_op(lambda a, b: _reduce(jax.nn.softplus(-b * a), reduction),
+                    input, label, op_name="soft_margin_loss")
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean", name=None):
+    def f(a, b, w):
+        loss = -(b * jax.nn.log_sigmoid(a) + (1 - b) * jax.nn.log_sigmoid(-a))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss.mean(axis=-1), reduction)
+
+    return apply_op(f, input, label, weight, op_name="multi_label_soft_margin_loss")
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def f(a, b):
+        if log_input:
+            loss = jnp.exp(a) - b * a
+        else:
+            loss = a - b * jnp.log(a + epsilon)
+        if full:
+            stirling = b * jnp.log(b + epsilon) - b + 0.5 * jnp.log(2 * jnp.pi * (b + epsilon))
+            loss = loss + jnp.where(b > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+
+    return apply_op(f, input, label, op_name="poisson_nll_loss")
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def f(a, b, v):
+        v = jnp.maximum(v, epsilon)
+        loss = 0.5 * (jnp.log(v) + (a - b) ** 2 / v)
+        if full:
+            loss = loss + 0.5 * jnp.log(jnp.asarray(2 * jnp.pi, a.dtype))
+        return _reduce(loss, reduction)
+
+    return apply_op(f, input, label, variance, op_name="gaussian_nll_loss")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def f(lg, lb, nm):
+        p = jax.nn.sigmoid(lg)
+        ce = -(lb * jax.nn.log_sigmoid(lg) + (1 - lb) * jax.nn.log_sigmoid(-lg))
+        p_t = p * lb + (1 - p) * (1 - lb)
+        mod = (1 - p_t) ** gamma
+        a_t = alpha * lb + (1 - alpha) * (1 - lb)
+        loss = a_t * mod * ce
+        if nm is not None:
+            loss = loss / nm
+        return _reduce(loss, reduction)
+
+    return apply_op(f, logit, label, normalizer, op_name="sigmoid_focal_loss")
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def f(a, b):
+        num_classes = a.shape[-1]
+        b1 = jax.nn.one_hot(b.astype(jnp.int32)[..., 0] if b.ndim == a.ndim else b.astype(jnp.int32),
+                            num_classes, dtype=a.dtype)
+        inter = jnp.sum(a * b1, axis=tuple(range(1, a.ndim)))
+        union = jnp.sum(a, axis=tuple(range(1, a.ndim))) + jnp.sum(b1, axis=tuple(range(1, a.ndim)))
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+
+    return apply_op(f, input, label, op_name="dice_loss")

@@ -1,0 +1,96 @@
+"""Long-tail nn.functional coverage: 3d pools, fold/grid_sample, losses,
+conv transpose numerics vs torch."""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as TF
+
+import paddlepaddle_tpu as paddle
+from paddlepaddle_tpu.nn import functional as F
+
+
+def test_conv2d_transpose_matches_torch():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((1, 3, 8, 8)).astype(np.float32)
+    w = rng.standard_normal((3, 2, 4, 4)).astype(np.float32)
+    for stride, pad in ((2, 1), (1, 0), (3, 2)):
+        ref = TF.conv_transpose2d(torch.tensor(x), torch.tensor(w),
+                                  stride=stride, padding=pad).numpy()
+        out = F.conv2d_transpose(paddle.to_tensor(x), paddle.to_tensor(w),
+                                 stride=stride, padding=pad)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_conv2d_transpose_groups_matches_torch():
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((1, 4, 6, 6)).astype(np.float32)
+    w = rng.standard_normal((4, 3, 3, 3)).astype(np.float32)  # groups=2: out=6
+    ref = TF.conv_transpose2d(torch.tensor(x), torch.tensor(w), stride=2, groups=2).numpy()
+    out = F.conv2d_transpose(paddle.to_tensor(x), paddle.to_tensor(w), stride=2, groups=2)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_conv1d_transpose_matches_torch():
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((1, 3, 10)).astype(np.float32)
+    w = rng.standard_normal((3, 2, 4)).astype(np.float32)
+    ref = TF.conv_transpose1d(torch.tensor(x), torch.tensor(w), stride=2).numpy()
+    out = F.conv1d_transpose(paddle.to_tensor(x), paddle.to_tensor(w), stride=2)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_pools_3d_and_adaptive():
+    x3 = np.random.default_rng(0).standard_normal((1, 2, 8, 8, 8)).astype(np.float32)
+    mp = F.max_pool3d(x3, 2)
+    ref = TF.max_pool3d(torch.tensor(x3), 2).numpy()
+    np.testing.assert_allclose(mp.numpy(), ref, rtol=1e-5)
+    ap = F.avg_pool3d(x3, 2)
+    ref = TF.avg_pool3d(torch.tensor(x3), 2).numpy()
+    np.testing.assert_allclose(ap.numpy(), ref, rtol=1e-5)
+    assert F.adaptive_avg_pool3d(x3, 2).shape == [1, 2, 2, 2, 2]
+    x1 = np.random.default_rng(1).standard_normal((1, 3, 8)).astype(np.float32)
+    assert F.adaptive_max_pool1d(x1, 4).shape == [1, 3, 4]
+
+
+def test_pixel_shuffle_roundtrip_and_channel_shuffle():
+    img = np.random.default_rng(0).standard_normal((1, 4, 8, 8)).astype(np.float32)
+    pu = F.pixel_unshuffle(img, 2)
+    assert pu.shape == [1, 16, 4, 4]
+    np.testing.assert_allclose(F.pixel_shuffle(pu, 2).numpy(), img, atol=1e-6)
+    np.testing.assert_allclose(
+        F.channel_shuffle(img, 2).numpy(),
+        TF.channel_shuffle(torch.tensor(img), 2).numpy(), atol=1e-6)
+
+
+def test_grid_sample_identity_and_fold_roundtrip():
+    img = np.random.default_rng(0).standard_normal((1, 4, 8, 8)).astype(np.float32)
+    theta = np.array([[[1., 0., 0.], [0., 1., 0.]]], np.float32)
+    grid = F.affine_grid(paddle.to_tensor(theta), [1, 4, 8, 8])
+    out = F.grid_sample(paddle.to_tensor(img), grid)
+    np.testing.assert_allclose(out.numpy(), img, atol=1e-4)
+    u = F.unfold(paddle.to_tensor(img), [2, 2], strides=2)
+    fb = F.fold(u, [8, 8], [2, 2], strides=2)
+    np.testing.assert_allclose(fb.numpy(), img, atol=1e-5)
+
+
+def test_new_losses_match_torch():
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((4, 3)).astype(np.float32)
+    b = rng.standard_normal((4, 3)).astype(np.float32)
+    np.testing.assert_allclose(
+        float(F.huber_loss(paddle.to_tensor(a), paddle.to_tensor(b), delta=1.0).numpy()),
+        TF.huber_loss(torch.tensor(a), torch.tensor(b), delta=1.0).item(), rtol=1e-5)
+    lb = np.sign(b).astype(np.float32)
+    np.testing.assert_allclose(
+        float(F.soft_margin_loss(paddle.to_tensor(a), paddle.to_tensor(lb)).numpy()),
+        TF.soft_margin_loss(torch.tensor(a), torch.tensor(lb)).item(), rtol=1e-5)
+    var = np.abs(b) + 0.1
+    np.testing.assert_allclose(
+        float(F.gaussian_nll_loss(paddle.to_tensor(a), paddle.to_tensor(b),
+                                  paddle.to_tensor(var)).numpy()),
+        TF.gaussian_nll_loss(torch.tensor(a), torch.tensor(b), torch.tensor(var)).item(),
+        rtol=1e-4)
+    np.testing.assert_allclose(
+        float(F.poisson_nll_loss(paddle.to_tensor(a), paddle.to_tensor(np.abs(b))).numpy()),
+        TF.poisson_nll_loss(torch.tensor(a), torch.tensor(np.abs(b))).item(), rtol=1e-5)
