@@ -271,14 +271,25 @@ class Client {
     return recv_blob(fd_, out);
   }
 
-  int get_nowait(const std::string& key, std::string* out) {
+  // Two-call protocol for arbitrary-size values. fetch blocks until the key
+  // exists, stages the value, and returns its size; drain copies it out and
+  // releases the staging memory. The caller must not interleave other
+  // fetches between the two calls (the Python wrapper serializes them).
+  long long fetch(const std::string& key) {
+    std::string val;
+    if (!get(key, &val)) return -1;
     std::lock_guard<std::mutex> g(mu_);
-    uint8_t op = GET_NOWAIT;
-    if (!send_all(fd_, &op, 1) || !send_blob(fd_, key)) return -1;
-    uint8_t found;
-    if (!recv_all(fd_, &found, 1)) return -1;
-    if (!found) return 0;
-    return recv_blob(fd_, out) ? 1 : -1;
+    last_ = std::move(val);
+    return static_cast<long long>(last_.size());
+  }
+
+  long long drain(char* buf, long long cap) {
+    std::lock_guard<std::mutex> g(mu_);
+    long long n = static_cast<long long>(last_.size());
+    if (n > cap) n = cap;
+    std::memcpy(buf, last_.data(), static_cast<size_t>(n));
+    std::string().swap(last_);  // return the staging allocation
+    return n;
   }
 
   bool add(const std::string& key, int64_t amount, int64_t* out) {
@@ -310,6 +321,7 @@ class Client {
  private:
   int fd_ = -1;
   std::mutex mu_;  // one request in flight per client
+  std::string last_;
 };
 
 }  // namespace
@@ -344,22 +356,16 @@ int tcpstore_set(void* c, const char* key, const char* val, int len) {
   return static_cast<Client*>(c)->set(key, std::string(val, len)) ? 0 : -1;
 }
 
-// caller passes a buffer; returns actual length or -1 (buffer too small -> -2)
-int tcpstore_get(void* c, const char* key, char* buf, int buflen) {
-  std::string out;
-  if (!static_cast<Client*>(c)->get(key, &out)) return -1;
-  if (static_cast<int>(out.size()) > buflen) return -2;
-  std::memcpy(buf, out.data(), out.size());
-  return static_cast<int>(out.size());
+// Two-call protocol for arbitrary-size values: fetch blocks until the key
+// exists, stages the value client-side, and returns its length (-1 on error);
+// copy then drains the staged value into the caller's buffer (and frees the
+// staging memory). 64-bit lengths throughout.
+long long tcpstore_fetch(void* c, const char* key) {
+  return static_cast<Client*>(c)->fetch(key);
 }
 
-int tcpstore_get_nowait(void* c, const char* key, char* buf, int buflen) {
-  std::string out;
-  int rc = static_cast<Client*>(c)->get_nowait(key, &out);
-  if (rc <= 0) return rc == 0 ? -3 : -1;  // -3 = not found
-  if (static_cast<int>(out.size()) > buflen) return -2;
-  std::memcpy(buf, out.data(), out.size());
-  return static_cast<int>(out.size());
+long long tcpstore_copy(void* c, char* buf, long long buflen) {
+  return static_cast<Client*>(c)->drain(buf, buflen);
 }
 
 long long tcpstore_add(void* c, const char* key, long long amount) {

@@ -54,10 +54,10 @@ def _load_lib():
         lib.tcpstore_client_destroy.argtypes = [ctypes.c_void_p]
         lib.tcpstore_set.restype = ctypes.c_int
         lib.tcpstore_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
-        lib.tcpstore_get.restype = ctypes.c_int
-        lib.tcpstore_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
-        lib.tcpstore_get_nowait.restype = ctypes.c_int
-        lib.tcpstore_get_nowait.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+        lib.tcpstore_fetch.restype = ctypes.c_longlong
+        lib.tcpstore_fetch.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.tcpstore_copy.restype = ctypes.c_longlong
+        lib.tcpstore_copy.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong]
         lib.tcpstore_add.restype = ctypes.c_longlong
         lib.tcpstore_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong]
         lib.tcpstore_check.restype = ctypes.c_int
@@ -81,6 +81,7 @@ class TCPStore:
             self.port = self._py.port
             return
         self._py = None
+        self._get_lock = threading.Lock()  # fetch+copy must not interleave
         if is_master:
             self._server = self._lib.tcpstore_server_create(port)
             if not self._server:
@@ -105,11 +106,15 @@ class TCPStore:
     def get(self, key: str) -> bytes:
         if self._py:
             return self._py.get(key)
-        buf = ctypes.create_string_buffer(1 << 20)
-        n = self._lib.tcpstore_get(self._client, key.encode(), buf, len(buf))
-        if n < 0:
-            raise RuntimeError(f"TCPStore.get({key!r}) failed ({n})")
-        return buf.raw[:n]
+        # two-call protocol: fetch stages the value natively and reports its
+        # exact size, copy drains it — values of arbitrary size round-trip
+        with self._get_lock:
+            n = self._lib.tcpstore_fetch(self._client, key.encode())
+            if n < 0:
+                raise RuntimeError(f"TCPStore.get({key!r}) failed ({n})")
+            buf = ctypes.create_string_buffer(max(int(n), 1))
+            got = self._lib.tcpstore_copy(self._client, buf, int(n))
+        return buf.raw[:got]
 
     def add(self, key: str, amount: int = 1) -> int:
         if self._py:

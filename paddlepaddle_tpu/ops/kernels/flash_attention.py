@@ -95,8 +95,12 @@ def _xla_attention(q, k, v, causal, mask, scale):
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
-                block_q, block_kv, seq_k):
+                block_q, block_kv, seq_q, seq_k):
+    # Causal masking is bottom-right aligned like the reference flashattn and
+    # the XLA fallback: query i sees keys j <= i + (seq_k - seq_q). For
+    # seq_q == seq_k this is the familiar lower triangle.
     qi = pl.program_id(1)
+    off = seq_k - seq_q
     q = q_ref[0].astype(jnp.float32) * scale          # [bq, d]
     d = q.shape[-1]
 
@@ -106,7 +110,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
 
     num_kv = seq_k // block_kv
     if causal:
-        num_visit = qi * block_q // block_kv + pl.cdiv(block_q, block_kv)
+        num_visit = jnp.minimum(pl.cdiv((qi + 1) * block_q + off, block_kv), num_kv)
     else:
         num_visit = num_kv
 
@@ -118,7 +122,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
             k_pos = j * block_kv + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            s = jnp.where(q_pos + off >= k_pos, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
@@ -133,18 +137,20 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               scale, causal, block_q, block_kv, seq_k):
+               scale, causal, block_q, block_kv, seq_q, seq_k):
     qi = pl.program_id(1)
+    off = seq_k - seq_q
     q = q_ref[0].astype(jnp.float32)                  # [bq, d]
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0]                                  # [bq, 1]
     delta = delta_ref[0]
     d = q.shape[-1]
 
+    num_kv = seq_k // block_kv
     if causal:
-        num_visit = qi * block_q // block_kv + pl.cdiv(block_q, block_kv)
+        num_visit = jnp.minimum(pl.cdiv((qi + 1) * block_q + off, block_kv), num_kv)
     else:
-        num_visit = seq_k // block_kv
+        num_visit = num_kv
 
     def body(j, dq):
         k = k_ref[0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
@@ -153,7 +159,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
             k_pos = j * block_kv + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            s = jnp.where(q_pos + off >= k_pos, s, NEG_INF)
         p = jnp.exp(s - lse)                          # [bq, bkv]
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
@@ -164,15 +170,16 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *,
-                scale, causal, block_q, block_kv, seq_q):
+                scale, causal, block_q, block_kv, seq_q, seq_k):
     ki = pl.program_id(1)
+    off = seq_k - seq_q
     k = k_ref[0].astype(jnp.float32)                  # [bkv, d]
     v = v_ref[0].astype(jnp.float32)
     d = k.shape[-1]
     num_q = seq_q // block_q
     if causal:
-        # q blocks at or after this kv block participate
-        start = (ki * block_kv) // block_q
+        # q rows with q_pos + off >= this block's first k index participate
+        start = jnp.maximum(ki * block_kv - off, 0) // block_q
     else:
         start = 0
 
@@ -186,7 +193,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         if causal:
             q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
             k_pos = ki * block_kv + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            s = jnp.where(q_pos + off >= k_pos, s, NEG_INF)
         p = jnp.exp(s - lse)
         dv_new = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
@@ -204,6 +211,8 @@ def _pallas_forward(q, k, v, causal, scale):
     """q,k,v: [bh, s, d]. Returns (out, lse) or None on unsupported shapes."""
     bh, sq, d = q.shape
     sk = k.shape[1]
+    if causal and sq > sk:
+        return None  # rows with no visible keys; XLA path defines semantics
     blocks = _blocks(sq, sk)
     if blocks is None:
         return None
@@ -211,7 +220,7 @@ def _pallas_forward(q, k, v, causal, scale):
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_kv=block_kv, seq_k=sk)
+        block_kv=block_kv, seq_q=sq, seq_k=sk)
     grid = (bh, sq // block_q)
     # Mosaic lowering has no int64/float64 path (jax 0.9 _convert_helper
     # recurses forever on unsupported casts); the package enables x64 globally
@@ -239,6 +248,8 @@ def _pallas_forward(q, k, v, causal, scale):
 def _pallas_backward(q, k, v, out, lse, do, causal, scale):
     bh, sq, d = q.shape
     sk = k.shape[1]
+    if causal and sq > sk:
+        return None
     blocks = _blocks(sq, sk)
     if blocks is None:
         return None
@@ -256,7 +267,7 @@ def _pallas_backward(q, k, v, out, lse, do, causal, scale):
     with jax.enable_x64(False):
         dq = pl.pallas_call(
             functools.partial(_dq_kernel, scale=scale, causal=causal,
-                              block_q=block_q, block_kv=block_kv, seq_k=sk),
+                              block_q=block_q, block_kv=block_kv, seq_q=sq, seq_k=sk),
             grid=(bh, sq // block_q),
             in_specs=[row_q, full_kv, full_kv, row_q, vec_q_block, vec_q_block],
             out_specs=row_q,
@@ -265,7 +276,7 @@ def _pallas_backward(q, k, v, out, lse, do, causal, scale):
 
         dk, dv = pl.pallas_call(
             functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                              block_q=block_q, block_kv=block_kv, seq_q=sq),
+                              block_q=block_q, block_kv=block_kv, seq_q=sq, seq_k=sk),
             grid=(bh, sk // block_kv),
             in_specs=[full_q, row_kv, row_kv, full_q, vec_q_full, vec_q_full],
             out_specs=[row_kv, row_kv],

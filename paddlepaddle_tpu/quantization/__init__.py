@@ -215,7 +215,7 @@ class PTQ:
             # activations ARE quantized with the calibrated scale: fake-quant
             # every input with the observer's absmax from here on
             sub.register_forward_pre_hook(
-                lambda l, inputs, _s=scale: tuple(
+                lambda l, inputs, _s=scale: (
                     apply_op(lambda a: _fake_quant(a, jnp.asarray(_s)), inputs[0]),
                 ) + tuple(inputs[1:]))
         return model

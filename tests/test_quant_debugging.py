@@ -62,6 +62,12 @@ def test_ptq_calibration():
     ptq.convert(qnet)
     assert hasattr(qnet, "_ptq_input_scale") and qnet._ptq_input_scale > 0
     assert not hasattr(net, "_ptq_input_scale")
+    # the converted net must still run forward (fake-quant pre-hook wraps
+    # the input in a 1-tuple, it must not iterate the Tensor's leading dim)
+    x = np.random.default_rng(1).standard_normal((2, 4)).astype(np.float32)
+    out = qnet(x)
+    assert out.shape == [2, 4]
+    assert np.isfinite(out.numpy()).all()
 
 
 def test_check_numerics():

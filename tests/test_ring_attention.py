@@ -76,6 +76,40 @@ def test_ring_attention_grads_match_dense():
                                rtol=2e-3, atol=2e-4)
 
 
+def test_causal_alignment_bottom_right():
+    """causal with sq != sk (chunked prefill) is bottom-right aligned: query i
+    attends keys j <= i + (sk - sq), matching the reference flash_attention."""
+    import jax.numpy as jnp
+
+    from paddlepaddle_tpu.ops.kernels import flash_attention as fa
+
+    rng = np.random.default_rng(0)
+    b, h, d, sq, sk = 1, 2, 16, 4, 8
+    q = jnp.asarray(rng.standard_normal((b, sq, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, sk, h, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, sk, h, d)), jnp.float32)
+    scale = 1.0 / np.sqrt(d)
+    out = np.asarray(fa._xla_attention(q, k, v, True, None, scale))
+
+    # numpy reference with explicit bottom-right mask
+    qn = np.swapaxes(np.asarray(q), 1, 2).astype(np.float64)
+    kn = np.swapaxes(np.asarray(k), 1, 2).astype(np.float64)
+    vn = np.swapaxes(np.asarray(v), 1, 2).astype(np.float64)
+    logits = np.einsum("bhqd,bhkd->bhqk", qn, kn) * scale
+    mask = np.tril(np.ones((sq, sk), bool), k=sk - sq)
+    logits = np.where(mask, logits, -1e30)
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = np.swapaxes(np.einsum("bhqk,bhkd->bhqd", p, vn), 1, 2)
+    np.testing.assert_allclose(out, ref, atol=1e-4)
+
+    # the Pallas path declines causal sq > sk (no-visible-key rows) so both
+    # paths always agree on semantics
+    assert fa._pallas_forward(
+        jnp.zeros((2, 16, d)), jnp.zeros((2, 8, d)), jnp.zeros((2, 8, d)),
+        True, scale) is None
+
+
 def test_recompute_layer_grads_match():
     from paddlepaddle_tpu.distributed.fleet.recompute import recompute
 

@@ -30,6 +30,36 @@ def test_tcp_store_native_roundtrip():
     assert res.get("v") == b"done"
 
 
+def test_tcp_store_large_value():
+    """Values beyond any fixed staging buffer round-trip exactly (the native
+    path uses a fetch/copy two-call protocol sized to the actual value)."""
+    from paddlepaddle_tpu.distributed.store import TCPStore
+
+    s = TCPStore(is_master=True)
+    big = bytes(range(256)) * (5 * 4096)  # 5 MiB
+    s.set("big", big)
+    assert s.get("big") == big
+    s.set("empty", b"")
+    assert s.get("empty") == b""
+
+    # concurrent gets on ONE store must not cross-contaminate (the native
+    # fetch/copy pair is serialized by a lock)
+    s.set("a", b"A" * 100_000)
+    s.set("b", b"B" * 50_000)
+    results = {}
+
+    def getter(key):
+        for _ in range(20):
+            results.setdefault(key, set()).add(s.get(key))
+
+    ts = [threading.Thread(target=getter, args=(k,)) for k in ("a", "b") * 2]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    assert results["a"] == {b"A" * 100_000} and results["b"] == {b"B" * 50_000}
+
+
 def test_tcp_store_rank_assignment():
     """The reference bootstrap pattern: ranks self-assign via atomic add."""
     from paddlepaddle_tpu.distributed.store import TCPStore
