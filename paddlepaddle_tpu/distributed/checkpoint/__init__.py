@@ -1,17 +1,29 @@
 """Distributed checkpoint — sharded save + reshard-on-load.
 
 Reference surface: python/paddle/distributed/checkpoint/
-(save_state_dict.py:46,63,145 — async save via host copy, dedup of replicated
-shards; load_state_dict.py — resharding across different meshes/strategies;
-metadata.py — tensor → (mesh, placements) mapping).
+(save_state_dict.py:46,63,145 — per-rank local shards + global metadata,
+async save via host staging, dedup of replicated shards; load_state_dict.py —
+resharding across different meshes/strategies; metadata.py — tensor →
+(mesh, placements) mapping).
 
-TPU-native design: the single controller owns the global value of every
-array, so "dedup of replicated shards" is free — each tensor is written once
-as its global value plus a metadata record of its live sharding. Load is
-reshard-on-load by construction: values are device_put against the TARGET
-tensor's sharding, whatever mesh/strategy the new job uses. Async save copies
-device→host first (non-blocking for the train loop) and writes in a
-background thread, matching the reference's async_save process.
+TPU-native design (format v2):
+
+* SAVE writes one file **per unique array shard** (each device's
+  ``addressable_shards`` slice; replicated copies are deduped by their global
+  index), never materializing the global value — an 8B model sharded over a
+  pod writes only each host's local bytes. Metadata records every shard's
+  global index box so any future mesh can find its bytes.
+* ASYNC save enqueues device→host DMA (``copy_to_host_async``) and returns;
+  a writer thread performs the (now cheap) host gets and file writes without
+  blocking the train loop — the reference's async_save process, minus the
+  process. ``wait_all_saves`` joins and re-raises write failures.
+* LOAD is partial-read reshard-on-load: for each target tensor the loader
+  maps the checkpoint's shard files (``np.load(mmap_mode='r')``) and
+  assembles ONLY the slices the target sharding asks for via
+  ``jax.make_array_from_callback`` — loading a dp4×tp2 checkpoint into a
+  dp2×fsdp2×tp2 job reads each byte once, no global gather.
+
+Format v1 (one global-value file per tensor) is still readable.
 """
 
 from __future__ import annotations
@@ -20,7 +32,7 @@ import json
 import os
 import re
 import threading
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -51,35 +63,78 @@ def _sharding_record(arr) -> Optional[dict]:
         return None
 
 
+def _index_box(index: Tuple[slice, ...], shape: Tuple[int, ...]) -> List[List[int]]:
+    """Normalize a shard's global index (tuple of slices) to [[start, stop], ...]."""
+    box = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        box.append([start, stop])
+    return box
+
+
+def _unique_shards(arr):
+    """(box, device_array) per distinct global index — replicas deduped
+    (the reference's save_state_dict.py:117 dedup of replicated shards)."""
+    shards = getattr(arr, "addressable_shards", None)
+    if not shards:
+        full = tuple(slice(0, d) for d in np.shape(arr))
+        return [(_index_box(full, np.shape(arr)), arr)]
+    seen = {}
+    for sh in shards:
+        box = _index_box(sh.index, arr.shape)
+        key = tuple(map(tuple, box))
+        if key not in seen:
+            seen[key] = (box, sh.data)
+    return list(seen.values())
+
+
 def save_state_dict(state_dict: Dict[str, object], path: str,
                     process_group=None, coordinator_rank: int = 0,
                     unique_name: bool = True, async_save: bool = False) -> None:
-    """Write one file per tensor (global value) + metadata.json."""
+    """Write per-shard files + metadata.json (format v2, see module doc)."""
     os.makedirs(path, exist_ok=True)
-    meta = {"tensors": {}, "format": "paddlepaddle_tpu.dist_ckpt.v1"}
-    host_items = []
+    meta = {"tensors": {}, "format": "paddlepaddle_tpu.dist_ckpt.v2"}
+    items = []  # (fpath, device_or_host_array)
     used_names = set()
     for key, val in state_dict.items():
         arr = val._data if isinstance(val, Tensor) else val
-        np_val = np.asarray(jax.device_get(arr))  # host copy (async-safe)
+        if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
+            raise ValueError(
+                f"{key}: non-addressable shards; multi-host save writes only "
+                "local shards per host — gather metadata across hosts first")
+        shards = _unique_shards(arr)
+
+        def _files(base):
+            return ([f"{base}.npy"] if len(shards) == 1
+                    else [f"{base}.s{i}.npy" for i in range(len(shards))])
+
+        # uniqueness must hold on the FINAL filenames: distinct keys may
+        # sanitize identically, and a key literally named "w.s0" must not
+        # collide with the shard files of a key named "w"
         base = _sanitize(key)
-        fname = base + ".npy"
         n = 0
-        while fname in used_names:  # distinct keys may sanitize identically
+        while any(f in used_names for f in _files(base)):
             n += 1
-            fname = f"{base}__{n}.npy"
-        used_names.add(fname)
+            base = f"{_sanitize(key)}__{n}"
+        used_names.update(_files(base))
+        shard_recs = []
+        for fname, (box, data) in zip(_files(base), shards):
+            shard_recs.append({"file": fname, "box": box})
+            if isinstance(data, jax.Array):
+                data.copy_to_host_async()  # enqueue d2h DMA; get later is cheap
+            items.append((os.path.join(path, fname), data))
         meta["tensors"][key] = {
-            "file": fname,
-            "shape": list(np_val.shape),
-            "dtype": str(np_val.dtype),
+            "shape": list(np.shape(arr)),
+            "dtype": str(arr.dtype if hasattr(arr, "dtype")
+                         else np.asarray(arr).dtype),
             "sharding": _sharding_record(arr),
+            "shards": shard_recs,
         }
-        host_items.append((os.path.join(path, fname), np_val))
 
     def write():
-        for fpath, np_val in host_items:
-            np.save(fpath, np_val)
+        for fpath, data in items:
+            np.save(fpath, np.asarray(jax.device_get(data)))
         with open(os.path.join(path, _META_NAME), "w") as f:
             json.dump(meta, f, indent=1)
 
@@ -119,12 +174,65 @@ def get_checkpoint_metadata(path: str) -> dict:
         return json.load(f)
 
 
+class _ShardReader:
+    """Partial reads over a tensor's checkpoint shard files (mmap-backed)."""
+
+    def __init__(self, path: str, rec: dict):
+        self.shape = tuple(rec["shape"])
+        if "shards" in rec:  # v2
+            self.shards = [(tuple(map(tuple, s["box"])),
+                            os.path.join(path, s["file"])) for s in rec["shards"]]
+        else:  # v1: one file holding the global value
+            self.shards = [(tuple((0, d) for d in self.shape),
+                            os.path.join(path, rec["file"]))]
+        self._maps = {}
+
+    def _mmap(self, fpath):
+        if fpath not in self._maps:
+            try:
+                self._maps[fpath] = np.load(fpath, mmap_mode="r")
+            except ValueError:  # dtypes numpy can't mmap (e.g. saved objects)
+                self._maps[fpath] = np.load(fpath)
+        return self._maps[fpath]
+
+    def read(self, index: Tuple[slice, ...]) -> np.ndarray:
+        """Assemble the requested global slice from overlapping shard files."""
+        want = tuple((0 if sl.start is None else int(sl.start),
+                      dim if sl.stop is None else int(sl.stop))
+                     for sl, dim in zip(index, self.shape))
+        out_shape = tuple(b - a for a, b in want)
+        out = None
+        for box, fpath in self.shards:
+            inter = [(max(a, c), min(b, d)) for (a, b), (c, d) in zip(want, box)]
+            if any(a >= b for a, b in inter):
+                continue
+            src = self._mmap(fpath)
+            src_sl = tuple(slice(a - c, b - c)
+                           for (a, b), (c, _) in zip(inter, box))
+            dst_sl = tuple(slice(a - wa, b - wa)
+                           for (a, b), (wa, _) in zip(inter, want))
+            piece = np.asarray(src[src_sl])
+            if out is None:
+                if all(s == o for s, o in zip(piece.shape, out_shape)):
+                    return piece  # single shard covers the request: zero copy
+                out = np.empty(out_shape, dtype=src.dtype)
+                covered = np.zeros(out_shape, dtype=bool)
+            out[dst_sl] = piece
+            covered[dst_sl] = True
+        if out is None:
+            raise ValueError(f"checkpoint shards do not cover slice {want}")
+        if not covered.all():
+            raise ValueError(f"checkpoint shards only partially cover {want}")
+        return out
+
+
 def load_state_dict(state_dict: Dict[str, object], path: str,
                     process_group=None, coordinator_rank: int = 0,
                     offload: bool = False) -> None:
-    """In-place load INTO ``state_dict``'s tensors: each value is placed with
-    the TARGET tensor's current sharding — resharding across changed
-    meshes/parallel strategies happens here (reference load_state_dict.py)."""
+    """In-place load INTO ``state_dict``'s tensors: each target's CURRENT
+    sharding pulls exactly the slices it needs from the shard files —
+    resharding across changed meshes/strategies is the read pattern itself
+    (reference load_state_dict.py)."""
     wait_all_saves()
     meta = get_checkpoint_metadata(path)
     missing = [k for k in state_dict if k not in meta["tensors"]]
@@ -132,16 +240,26 @@ def load_state_dict(state_dict: Dict[str, object], path: str,
         raise KeyError(f"checkpoint at {path} lacks keys: {missing[:5]}...")
     for key, target in state_dict.items():
         rec = meta["tensors"][key]
-        np_val = np.load(os.path.join(path, rec["file"]))
+        reader = _ShardReader(path, rec)
         if isinstance(target, Tensor):
             cur = target._data
-            if tuple(np_val.shape) != tuple(cur.shape):
+            if tuple(rec["shape"]) != tuple(cur.shape):
                 raise ValueError(
-                    f"shape mismatch for {key}: ckpt {np_val.shape} vs {tuple(cur.shape)}")
-            new = jax.numpy.asarray(np_val).astype(cur.dtype)
+                    f"shape mismatch for {key}: ckpt {tuple(rec['shape'])} "
+                    f"vs {tuple(cur.shape)}")
             sh = getattr(cur, "sharding", None)
-            if sh is not None and not isinstance(cur, jax.core.Tracer):
-                new = jax.device_put(new, sh)
+            dtype = cur.dtype
+            if (sh is not None and not isinstance(cur, jax.core.Tracer)
+                    and cur.shape != ()):
+                new = jax.make_array_from_callback(
+                    tuple(cur.shape), sh,
+                    lambda idx, _r=reader, _d=dtype: _r.read(idx).astype(_d))
+            else:
+                full = reader.read(tuple(slice(0, d) for d in rec["shape"]))
+                new = jax.numpy.asarray(full).astype(dtype)
             target._replace_data(new)
         else:
-            state_dict[key] = np_val
+            # copy: read() may return an mmap-backed read-only view, and v1
+            # semantics gave callers a writable in-memory array
+            state_dict[key] = np.array(reader.read(
+                tuple(slice(0, d) for d in rec["shape"])))

@@ -16,6 +16,19 @@ from .mpu import (  # noqa: F401
     VocabParallelEmbedding,
     mark_placement,
 )
+from .pipeline_spmd import (  # noqa: F401
+    spmd_pipeline,
+    spmd_pipeline_interleaved,
+    spmd_pipeline_train,
+    stack_stage_params,
+    stack_virtual_stage_params,
+)
+from .schedules import (  # noqa: F401
+    PipelineSchedule,
+    build_1f1b,
+    build_gpipe,
+    build_schedule,
+)
 from .sharded import (  # noqa: F401
     ShardedTrainStep,
     match_sharding_rules,

@@ -5,13 +5,18 @@ parallel_layers/pp_layers.py (LayerDesc:57, PipelineLayer:258 with segment
 partitioning and shared embeddings) and pipeline_parallel.py
 (forward_backward_pipeline:575 — 1F1B, interleave:1179, FthenB:2261).
 
-TPU-native design: the single-controller model owns every stage, so the
-schedule zoo (FThenB/1F1B/VPP/ZBH1) collapses to ONE semantics — microbatched
-forward/backward with gradient accumulation — which all reference schedules
-are algebraically equal to (they differ only in peak memory/bubble on a
-multi-process runtime). `train_batch` reproduces that contract. The
-multi-chip execution path is parallel.pipeline_spmd (shard_map + ppermute
-over a 'pp' mesh axis), which is what actually spreads stages over chips.
+TPU-native design, two layers:
+
+* This module: the fleet-facing API (LayerDesc/PipelineLayer/segmenting) and
+  a single-host `train_batch` whose RESULT equals every reference schedule
+  (microbatched grad accumulation) — it makes no claim about bubble or peak
+  memory.
+* parallel.pipeline_spmd + parallel.schedules: the multi-chip execution
+  path that DOES reproduce the reference schedule zoo's bubble/memory
+  behavior — static 1F1B / interleaved-VPP / FThenB instruction tables
+  executed as one lax.scan of shard_map+ppermute ops over a 'pp' mesh axis
+  (`spmd_pipeline_train`), with O(S) stashed activations for 1F1B vs O(M)
+  for FThenB and a ~(S-1)/V ramp for VPP.
 """
 
 from __future__ import annotations

@@ -1,26 +1,44 @@
 """SPMD pipeline — the multi-chip execution path for pipeline parallelism.
 
 This is the TPU-native replacement for the reference's NCCL p2p pipeline
-runtime (pipeline_parallel.py send/recv_forward + 1F1B scheduling): a
-``shard_map`` over the 'pp' mesh axis where every stage runs the SAME block
-program with ITS slice of stage-stacked weights, microbatch activations
-stream between neighbor stages via ``lax.ppermute`` over ICI, and the whole
-GPipe loop is one differentiable ``lax.scan`` — ``jax.grad`` of it IS the
-backward pipeline (reverse scan + reverse permutes), scheduled by XLA.
+runtime (pipeline_parallel.py send/recv_forward + 1F1B scheduling). Two
+execution styles:
 
+* ``spmd_pipeline`` — forward-only GPipe streaming loop; ``jax.grad``
+  through it gives an F-then-B training step (all M microbatch residuals
+  live at once, like the reference FThenB pass).
+* ``spmd_pipeline_train`` — schedule-driven forward+backward in ONE
+  ``lax.scan``: a static instruction table (parallel/schedules.py — 1F1B /
+  interleaved VPP / GPipe) tells each stage, slot by slot, whether to run a
+  forward, an inner backward (cotangent from the right neighbor), or the
+  last-virtual-stage backward (loss gradient computed in-op). Activations
+  are stashed O(schedule.stash_cap) per stage — O(S) for 1F1B vs O(M) for
+  GPipe — and backward recomputes the block under ``jax.vjp`` from the
+  stashed input (remat-style, like the reference's recompute+1F1B pairing).
+  This reproduces the *memory and bubble behavior* of the reference's
+  schedule zoo (pipeline_parallel.py:575 1F1B, :1179 interleaved;
+  passes/pipeline_scheduler_pass), not just its result.
+
+All styles run every stage as the SAME block program over a 'pp' mesh axis
+inside ``shard_map``, with ``lax.ppermute`` ring transfers over ICI.
 Requires homogeneous middle stages (identical block structure), which is how
-transformer LMs are pipelined in practice; embed/head run outside the loop.
+transformer LMs are pipelined in practice; embed runs outside the loop
+(its cotangent is returned), the head/loss runs inside the last stage's
+backward op so 1F1B can start draining before all forwards finish.
 """
 
 from __future__ import annotations
 
-from typing import Callable, Sequence
+from typing import Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from jax import shard_map as _shard_map
+
+from .schedules import (OP_B, OP_B_LAST, OP_F, OP_IDLE, PipelineSchedule,
+                        _arrival_tables, build_schedule)
 
 
 def stack_stage_params(per_stage_params: Sequence[dict]) -> dict:
@@ -45,12 +63,12 @@ def stack_virtual_stage_params(per_stage_params: Sequence[dict], n_devices: int)
 def spmd_pipeline_interleaved(stacked_params, acts, block_fn, mesh: Mesh,
                               n_microbatches: int, pp_axis: str = "pp",
                               data_axis=None):
-    """Interleaved/virtual-stage pipeline (the reference's VPP schedule
-    semantics, pipeline_parallel.py:1179): each device owns V chunks; the
-    activation stream makes V laps around the device ring, applying chunk v
-    on lap v. Expressed as V chained single-lap pipelines — the inter-lap
-    transfer (last device -> device 0) is the same +1 ppermute the lap
-    already ends with, so XLA emits exactly the VPP communication pattern.
+    """Forward-only virtual-stage placement: VPP *stage assignment* semantics
+    (global stage g = v*S + s on device s as chunk v) with a GPipe-per-lap
+    schedule — the V laps run sequentially, so this does NOT reproduce VPP's
+    bubble reduction. It exists for inference/forward parity; the real
+    interleaved schedule (overlapping chunks in one scan, bubble ~(S-1)/V)
+    is ``spmd_pipeline_train(..., schedule="interleaved")``.
 
     stacked_params leaves: [V, S, ...] (see stack_virtual_stage_params).
     """
@@ -61,6 +79,186 @@ def spmd_pipeline_interleaved(stacked_params, acts, block_fn, mesh: Mesh,
         acts = spmd_pipeline(params_lap, acts, block_fn, mesh, n_microbatches,
                              pp_axis=pp_axis, data_axis=data_axis)
     return acts
+
+
+def spmd_pipeline_train(stacked_params, head_params, acts, labels,
+                        block_fn: Callable, head_loss_fn: Callable, mesh: Mesh,
+                        schedule="1f1b", n_microbatches: Optional[int] = None,
+                        num_virtual: int = 1, pp_axis: str = "pp",
+                        data_axis=None):
+    """Schedule-driven pipeline training step: forward AND backward of all
+    microbatches in ONE ``lax.scan`` over schedule slots.
+
+    Per slot each device executes its instruction from the static schedule
+    table (parallel/schedules.py): F runs the block on an activation from
+    the left-neighbor ring (stashing its input), B recomputes the block
+    under ``jax.vjp`` from the stash and sends the input-cotangent down the
+    ring, B_LAST additionally runs ``head_loss_fn`` so the loss gradient is
+    produced as soon as the last virtual stage finishes that microbatch —
+    which is what lets 1F1B/VPP start draining early. Peak live activations
+    per device = schedule.stash_cap (S for 1F1B, M for GPipe, ~2S per chunk
+    for VPP), reproducing the reference schedules' memory/bubble behavior
+    (pipeline_parallel.py:575,1179; passes/pipeline_scheduler_pass).
+
+    Args:
+        stacked_params: pytree, leaves [S, ...] (num_virtual=1) or [V, S, ...]
+            stage-stacked (shard the S dim over ``pp_axis``).
+        head_params: pytree for the head/loss (replicated); may be empty.
+        acts: [B, ...] activations entering virtual stage 0 (post-embedding).
+        labels: [B, ...] targets, consumed by ``head_loss_fn`` per microbatch.
+        block_fn: (params_one_stage, acts_mb) -> acts_mb.
+        head_loss_fn: (head_params, acts_mb, labels_mb) -> scalar mean loss.
+        schedule: PipelineSchedule, or name ('1f1b'|'gpipe'|'interleaved');
+            names require ``n_microbatches`` (and ``num_virtual`` for VPP).
+    Returns:
+        (loss, grads_stacked, grads_head, dacts): loss is the mean over the
+        batch; grads_* match their params' structure; dacts is [B, ...], the
+        cotangent for ``acts`` (backpropagate the embedding outside).
+    """
+    S = mesh.shape[pp_axis]
+    if isinstance(schedule, str):
+        if n_microbatches is None:
+            raise ValueError("n_microbatches required with a schedule name")
+        schedule = build_schedule(schedule, S, int(n_microbatches), V=num_virtual)
+    sched: PipelineSchedule = schedule
+    if sched.S != S:
+        raise ValueError(f"schedule built for S={sched.S}, mesh has {S}")
+    M, V = sched.M, sched.V
+    B = acts.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    mb = B // M
+
+    # normalize param leaves to [V, S, ...]
+    added_v = V == 1
+    if added_v:
+        stacked_params = jax.tree_util.tree_map(lambda a: a[None], stacked_params)
+
+    x_mb = acts.reshape(M, mb, *acts.shape[1:])
+    y_mb = labels.reshape(M, mb, *labels.shape[1:])
+
+    ops_t = jnp.asarray(sched.ops)
+    mbs_t = jnp.asarray(sched.mbs)
+    chs_t = jnp.asarray(sched.chunks)
+    arr = tuple(jnp.asarray(a) for a in _arrival_tables(sched))
+    Cs, Cf, Cb = sched.stash_cap, sched.inbox_f_cap, sched.inbox_b_cap
+    up_perm = [(i, (i + 1) % S) for i in range(S)]
+    down_perm = [(i, (i - 1) % S) for i in range(S)]
+
+    def per_stage(params, hp, x_l, y_l):
+        p_local = jax.tree_util.tree_map(lambda a: a[:, 0], params)  # [V, ...]
+        s_idx = jax.lax.axis_index(pp_axis)
+        a_shape = x_l.shape[1:]
+        dtype = x_l.dtype
+        zero_act = jnp.zeros(a_shape, dtype)
+
+        def slot(carry, row):
+            stash, inf, inb, gacc, hg, dacts, loss, left_in, right_in = carry
+            op_r, m_r, c_r, fv, fm, fc, bv, bm, bc = row
+            # deposit last slot's ring arrivals into the chunk inboxes
+            inf = inf.at[fc[s_idx], fm[s_idx] % Cf].set(
+                jnp.where(fv[s_idx] == 1, left_in, inf[fc[s_idx], fm[s_idx] % Cf]))
+            inb = inb.at[bc[s_idx], bm[s_idx] % Cb].set(
+                jnp.where(bv[s_idx] == 1, right_in, inb[bc[s_idx], bm[s_idx] % Cb]))
+
+            op = op_r[s_idx]
+            m = m_r[s_idx]
+            c = c_r[s_idx]
+            g = c * S + s_idx
+            p_c = jax.tree_util.tree_map(lambda a: a[c], p_local)
+
+            def idle_fn(_):
+                return stash, gacc, hg, dacts, loss, zero_act, zero_act
+
+            def f_fn(_):
+                a_in = jnp.where(g == 0, x_l[m], inf[c, m % Cf])
+                stash2 = stash.at[c, m % Cs].set(a_in)
+                a_out = block_fn(p_c, a_in).astype(dtype)
+                return stash2, gacc, hg, dacts, loss, a_out, zero_act
+
+            def b_fn(_):
+                a_in = stash[c, m % Cs]
+                g_in = inb[c, m % Cb]
+                _, vjp = jax.vjp(block_fn, p_c, a_in)
+                dp, da = vjp(g_in.astype(dtype))
+                gacc2 = jax.tree_util.tree_map(
+                    lambda acc, d: acc.at[c].add(d), gacc, dp)
+                dacts2 = dacts.at[m].add(jnp.where(g == 0, da, jnp.zeros_like(da)))
+                return stash, gacc2, hg, dacts2, loss, zero_act, da.astype(dtype)
+
+            def blast_fn(_):
+                a_in = stash[c, m % Cs]
+
+                def fwd_loss(p_, hp_, a_):
+                    return head_loss_fn(hp_, block_fn(p_, a_), y_l[m])
+
+                loss_m, vjp = jax.vjp(fwd_loss, p_c, hp, a_in)
+                # seed 1/M: the step's loss is the mean over microbatches
+                dp, dhp, da = vjp(jnp.full_like(loss_m, 1.0 / M))
+                gacc2 = jax.tree_util.tree_map(
+                    lambda acc, d: acc.at[c].add(d), gacc, dp)
+                hg2 = jax.tree_util.tree_map(jnp.add, hg, dhp)
+                dacts2 = dacts.at[m].add(jnp.where(g == 0, da, jnp.zeros_like(da)))
+                return (stash, gacc2, hg2, dacts2,
+                        loss + loss_m.astype(jnp.float32), zero_act,
+                        da.astype(dtype))
+
+            branches = {OP_IDLE: idle_fn, OP_F: f_fn, OP_B: b_fn,
+                        OP_B_LAST: blast_fn}
+            stash, gacc, hg, dacts, loss, up_out, down_out = jax.lax.switch(
+                op, [branches[i] for i in sorted(branches)], None)
+            left_next = jax.lax.ppermute(up_out, pp_axis, up_perm)
+            right_next = jax.lax.ppermute(down_out, pp_axis, down_perm)
+            return (stash, inf, inb, gacc, hg, dacts, loss,
+                    left_next, right_next), None
+
+        carry0 = (
+            jnp.zeros((V, Cs) + a_shape, dtype),
+            jnp.zeros((V, Cf) + a_shape, dtype),
+            jnp.zeros((V, Cb) + a_shape, dtype),
+            jax.tree_util.tree_map(jnp.zeros_like, p_local),
+            jax.tree_util.tree_map(jnp.zeros_like, hp),
+            jnp.zeros((M,) + a_shape, dtype),
+            jnp.zeros((), jnp.float32),
+            zero_act, zero_act,
+        )
+        xs = (ops_t, mbs_t, chs_t) + arr
+        carry, _ = jax.lax.scan(slot, carry0, xs)
+        _, _, _, gacc, hg, dacts, loss, _, _ = carry
+
+        loss = jax.lax.psum(loss, pp_axis) / M
+        hg = jax.tree_util.tree_map(lambda a: jax.lax.psum(a, pp_axis), hg)
+        dacts = jax.lax.psum(dacts, pp_axis)
+        if data_axis is not None:
+            loss = jax.lax.pmean(loss, data_axis)
+            gacc = jax.tree_util.tree_map(
+                lambda a: jax.lax.pmean(a, data_axis), gacc)
+            hg = jax.tree_util.tree_map(
+                lambda a: jax.lax.pmean(a, data_axis), hg)
+            # dacts is per-example: local-loss cotangent / D == global-mean
+            # cotangent, so a plain jax.vjp(embed)(dacts) outside needs no
+            # further reduction
+            dacts = dacts / mesh.shape[data_axis]
+        # re-insert the stage dim for the [V, S, ...] out spec
+        gacc = jax.tree_util.tree_map(lambda a: a[:, None], gacc)
+        return loss, gacc, hg, dacts
+
+    ndim_rest = acts.ndim - 1
+    p_specs = jax.tree_util.tree_map(lambda _: P(None, pp_axis), stacked_params)
+    h_specs = jax.tree_util.tree_map(lambda _: P(), head_params)
+    x_spec = P(None, data_axis, *([None] * (ndim_rest - 1)))
+    y_spec = P(None, data_axis, *([None] * (labels.ndim - 1)))
+
+    loss, gacc, hg, dacts = _shard_map(
+        per_stage, mesh=mesh,
+        in_specs=(p_specs, h_specs, x_spec, y_spec),
+        out_specs=(P(), p_specs, h_specs, x_spec),
+        check_vma=False,
+    )(stacked_params, head_params, x_mb, y_mb)
+
+    if added_v:
+        gacc = jax.tree_util.tree_map(lambda a: a[0], gacc)
+    return loss, gacc, hg, dacts.reshape(B, *acts.shape[1:])
 
 
 def spmd_pipeline(stacked_params, acts, block_fn: Callable, mesh: Mesh,

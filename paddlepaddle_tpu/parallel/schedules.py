@@ -1,0 +1,323 @@
+"""Pipeline schedule builder — static instruction tables for the SPMD executor.
+
+Reference surface: the static-graph schedule passes
+(python/paddle/distributed/passes/pipeline_scheduler_pass/__init__.py:32-37 —
+FThenB / 1F1B / VPP; pipeline_zero_bubble.py:62) and the dygraph runtime
+schedules (fleet/meta_parallel/pipeline_parallel.py:575 forward_backward_pipeline,
+:1179 PipelineParallelWithInterleave). The reference builds per-rank
+instruction lists (jobs) that a runtime walks; the TPU-native equivalent
+builds a dense [T, S] opcode table that ``spmd_pipeline_train`` executes as
+ONE lax.scan over slots inside shard_map — each device reads its column.
+
+Schedules produced here differ in *bubble* and *peak activation memory*:
+
+* gpipe  (FThenB):   all forwards, then all backwards; stash O(M).
+* 1f1b:              warmup capped at S-s in-flight, then strict B/F
+                     alternation; stash O(S) — same bubble as GPipe when
+                     t_f == t_b but constant memory in M.
+* interleaved (VPP): V chunks per device (virtual stage g = c*S + s runs on
+                     device s); warmup (S-s-1)*2 + (V-1)*S; bubble shrinks
+                     toward (S-1)/V at the cost of V× stash entries.
+
+Zero-bubble (ZBH1) splits B into dx/dW ops to fill the cooldown; on TPU that
+split forces a second forward recompute per microbatch under vjp semantics
+(dW needs its own linearization), which costs more than the bubble it fills
+at t_f ≈ t_b — measured trade-off documented in tools/pipeline_bubble_bench.py,
+so it is intentionally not part of the zoo.
+
+Every built schedule is validated by an exact dependency simulator (arrival
+one slot after the producing op, one op per device per slot) and annotated
+with bubble fraction and the buffer capacities the executor must allocate.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+OP_IDLE = 0
+OP_F = 1
+OP_B = 2        # inner backward: cotangent arrives from the right neighbor
+OP_B_LAST = 3   # backward of the LAST virtual stage: cotangent from the head/loss
+
+OP_NAMES = {OP_IDLE: ".", OP_F: "F", OP_B: "B", OP_B_LAST: "L"}
+
+
+@dataclass
+class PipelineSchedule:
+    """Static schedule: op/mb/chunk tables [T, S] + executor buffer sizes."""
+
+    S: int
+    M: int
+    V: int
+    ops: np.ndarray      # [T, S] int32 opcode
+    mbs: np.ndarray      # [T, S] int32 microbatch index of the op
+    chunks: np.ndarray   # [T, S] int32 chunk index of the op
+    stash_cap: int = 0   # activation stash entries per (device, chunk)
+    inbox_f_cap: int = 0  # forward-arrival buffer entries per (device, chunk)
+    inbox_b_cap: int = 0  # cotangent-arrival buffer entries per (device, chunk)
+    stats: Dict = field(default_factory=dict)
+
+    @property
+    def T(self) -> int:
+        return self.ops.shape[0]
+
+    @property
+    def num_virtual(self) -> int:
+        return self.V * self.S
+
+    def pretty(self) -> str:
+        """Timeline diagram, one row per device (F3 = forward mb 3)."""
+        rows = []
+        for s in range(self.S):
+            cells = []
+            for t in range(self.T):
+                op = self.ops[t, s]
+                if op == OP_IDLE:
+                    cells.append("..")
+                else:
+                    tag = OP_NAMES[int(op)]
+                    if self.V > 1:
+                        tag += f"{self.chunks[t, s]}"
+                    cells.append(f"{tag}{self.mbs[t, s]}")
+            rows.append(f"s{s}: " + " ".join(f"{c:>4}" for c in cells))
+        return "\n".join(rows)
+
+
+def _arrival_tables(sched: PipelineSchedule):
+    """Derive, for each (t, s): does a forward activation / cotangent arrive
+    this slot (produced by a neighbor at t-1), and for which (mb, chunk).
+
+    Forward act: produced by F at virtual stage g on device g%S, consumed by
+    g+1 on device (g+1)%S — the up ring. Cotangent: produced by B at g,
+    consumed by g-1 — the down ring.
+    """
+    S, V, T = sched.S, sched.V, sched.T
+    G = sched.num_virtual
+    fin_v = np.zeros((T, S), np.int32)
+    fin_m = np.zeros((T, S), np.int32)
+    fin_c = np.zeros((T, S), np.int32)
+    bin_v = np.zeros((T, S), np.int32)
+    bin_m = np.zeros((T, S), np.int32)
+    bin_c = np.zeros((T, S), np.int32)
+    for t in range(1, T):
+        for s in range(S):
+            left = (s - 1) % S
+            op = sched.ops[t - 1, left]
+            if op == OP_F:
+                g = sched.chunks[t - 1, left] * S + left
+                if g + 1 < G and (g + 1) % S == s:
+                    fin_v[t, s] = 1
+                    fin_m[t, s] = sched.mbs[t - 1, left]
+                    fin_c[t, s] = (g + 1) // S
+            right = (s + 1) % S
+            op = sched.ops[t - 1, right]
+            if op in (OP_B, OP_B_LAST):
+                g = sched.chunks[t - 1, right] * S + right
+                if g - 1 >= 0 and (g - 1) % S == s:
+                    bin_v[t, s] = 1
+                    bin_m[t, s] = sched.mbs[t - 1, right]
+                    bin_c[t, s] = (g - 1) // S
+    return fin_v, fin_m, fin_c, bin_v, bin_m, bin_c
+
+
+def validate(sched: PipelineSchedule) -> PipelineSchedule:
+    """Exact dependency check + buffer sizing. Raises on an illegal schedule.
+
+    Rules (one-hop ring transport, one slot latency):
+      F(m, g):       g == 0, or F(m, g-1) done at slot <= t-1
+      B(m, G-1):     F(m, G-1) done at slot <= t-1 (loss grad computed in-op)
+      B(m, g<G-1):   F(m, g) done and B(m, g+1) done at slot <= t-1
+      one op per (t, device); every (m, g) gets exactly one F and one B.
+    """
+    S, M, V = sched.S, sched.M, sched.V
+    G = sched.num_virtual
+    doneF: Dict[Tuple[int, int], int] = {}
+    doneB: Dict[Tuple[int, int], int] = {}
+    stash = np.zeros((S, V), np.int64)    # outstanding F-not-B per (device, chunk)
+    inbox_f = np.zeros((S, V), np.int64)  # delivered acts not yet consumed
+    inbox_b = np.zeros((S, V), np.int64)
+    max_stash = max_if = max_ib = 0
+    fin_v, fin_m, fin_c, bin_v, bin_m, bin_c = _arrival_tables(sched)
+    for t in range(sched.T):
+        for s in range(S):
+            if fin_v[t, s]:
+                inbox_f[s, fin_c[t, s]] += 1
+            if bin_v[t, s]:
+                inbox_b[s, bin_c[t, s]] += 1
+        max_if = max(max_if, inbox_f.max())
+        max_ib = max(max_ib, inbox_b.max())
+        for s in range(S):
+            op = int(sched.ops[t, s])
+            if op == OP_IDLE:
+                continue
+            m, c = int(sched.mbs[t, s]), int(sched.chunks[t, s])
+            g = c * S + s
+            if not (0 <= m < M and 0 <= c < V):
+                raise ValueError(f"slot {t} dev {s}: bad (m={m}, c={c})")
+            if op == OP_F:
+                if (m, g) in doneF:
+                    raise ValueError(f"duplicate F(m={m}, g={g})")
+                if g > 0:
+                    if doneF.get((m, g - 1), t) > t - 1:
+                        raise ValueError(
+                            f"slot {t} dev {s}: F(m={m},g={g}) before upstream")
+                    inbox_f[s, c] -= 1
+                doneF[(m, g)] = t
+                stash[s, c] += 1
+            else:
+                want_last = (g == G - 1)
+                if (op == OP_B_LAST) != want_last:
+                    raise ValueError(
+                        f"slot {t} dev {s}: opcode {op} vs virtual stage {g}")
+                if (m, g) in doneB:
+                    raise ValueError(f"duplicate B(m={m}, g={g})")
+                if doneF.get((m, g), t) > t - 1:
+                    raise ValueError(f"slot {t} dev {s}: B(m={m},g={g}) before F")
+                if g < G - 1:
+                    if doneB.get((m, g + 1), t) > t - 1:
+                        raise ValueError(
+                            f"slot {t} dev {s}: B(m={m},g={g}) before downstream B")
+                    inbox_b[s, c] -= 1
+                doneB[(m, g)] = t
+                stash[s, c] -= 1
+        max_stash = max(max_stash, stash.max())
+        if (inbox_f < 0).any() or (inbox_b < 0).any():
+            raise ValueError(f"slot {t}: consumed an arrival that never came")
+    if len(doneF) != M * G or len(doneB) != M * G:
+        raise ValueError(
+            f"incomplete schedule: {len(doneF)}/{M * G} F, {len(doneB)}/{M * G} B")
+    sched.stash_cap = max(int(max_stash), 1)
+    sched.inbox_f_cap = max(int(max_if), 1)
+    sched.inbox_b_cap = max(int(max_ib), 1)
+    busy = int((sched.ops != OP_IDLE).sum())
+    sched.stats = {
+        "T": sched.T,
+        "busy_slots": busy,
+        "total_slots": sched.T * S,
+        "bubble_fraction": 1.0 - busy / (sched.T * S),
+        "stash_cap": sched.stash_cap,
+    }
+    return sched
+
+
+def _pack(events: List[Tuple[int, int, int, int, int]], S: int, M: int,
+          V: int) -> PipelineSchedule:
+    """events: (t, s, op, m, c) -> dense tables."""
+    T = max(t for t, *_ in events) + 1
+    ops = np.zeros((T, S), np.int32)
+    mbs = np.zeros((T, S), np.int32)
+    chunks = np.zeros((T, S), np.int32)
+    for t, s, op, m, c in events:
+        if ops[t, s] != OP_IDLE:
+            raise ValueError(f"two ops in slot {t} dev {s}")
+        ops[t, s], mbs[t, s], chunks[t, s] = op, m, c
+    return validate(PipelineSchedule(S=S, M=M, V=V, ops=ops, mbs=mbs, chunks=chunks))
+
+
+def build_gpipe(S: int, M: int) -> PipelineSchedule:
+    """FThenB: forward wavefront F(m,s)@(m+s), then reverse backward
+    wavefront. Stash grows to M per device — the memory cost 1F1B removes."""
+    events = []
+    for m in range(M):
+        for s in range(S):
+            events.append((m + s, s, OP_F, m, 0))
+    t0 = M + S - 1
+    for m in reversed(range(M)):
+        for s in reversed(range(S)):
+            t = t0 + (M - 1 - m) + (S - 1 - s)
+            events.append((t, s, OP_B_LAST if s == S - 1 else OP_B, m, 0))
+    return _pack(events, S, M, 1)
+
+
+def _device_order(S: int, M: int, V: int, s: int) -> List[Tuple[str, int, int]]:
+    """Per-device op sequence ('F'/'B', m, c) — warmup forwards, then strict
+    1F/1B alternation, then cooldown backwards (the reference's
+    forward_backward_pipeline / PipelineParallelWithInterleave order).
+    Forwards cycle chunks in groups of S microbatches; backwards mirror the
+    pattern with the chunk order reversed."""
+    if V == 1:
+        f_list = [(m, 0) for m in range(M)]
+        b_list = [(m, 0) for m in range(M)]
+        warm = min(M, S - 1 - s)
+    else:
+        f_list = [(r * S + i, c)
+                  for r in range(M // S) for c in range(V) for i in range(S)]
+        b_list = [(r * S + i, c)
+                  for r in range(M // S) for c in reversed(range(V)) for i in range(S)]
+        warm = min(M * V, (S - s - 1) * 2 + (V - 1) * S)
+    order: List[Tuple[str, int, int]] = []
+    fi = bi = 0
+    for _ in range(warm):
+        m, c = f_list[fi]
+        order.append(("F", m, c))
+        fi += 1
+    while fi < len(f_list):
+        m, c = f_list[fi]
+        order.append(("F", m, c))
+        fi += 1
+        m, c = b_list[bi]
+        order.append(("B", m, c))
+        bi += 1
+    while bi < len(b_list):
+        m, c = b_list[bi]
+        order.append(("B", m, c))
+        bi += 1
+    return order
+
+
+def build_1f1b(S: int, M: int, V: int = 1) -> PipelineSchedule:
+    """1F1B (V=1) / interleaved VPP (V>1): in-order execution of each
+    device's warmup/steady/cooldown sequence, stalling only on data
+    dependencies (one-slot ring latency). V=1 reproduces the classic 1F1B
+    timeline (T = 2(M+S-1), stash <= S-s); V>1 reproduces the interleaved
+    schedule whose bubble shrinks toward (S-1)/V ramp slots."""
+    if M % S and V > 1:
+        raise ValueError(f"interleaved schedule needs M % S == 0, got M={M} S={S}")
+    G = V * S
+    doneF: Dict[Tuple[int, int], int] = {}
+    doneB: Dict[Tuple[int, int], int] = {}
+    orders = [_device_order(S, M, V, s) for s in range(S)]
+    pos = [0] * S
+    events: List[Tuple[int, int, int, int, int]] = []
+    t = 0
+    limit = 8 * (M * G + S) + 64
+    while any(pos[s] < len(orders[s]) for s in range(S)) and t < limit:
+        for s in range(S):
+            if pos[s] >= len(orders[s]):
+                continue
+            kind, m, c = orders[s][pos[s]]
+            g = c * S + s
+            if kind == "F":
+                if g > 0 and doneF.get((m, g - 1), t) > t - 1:
+                    continue  # stall: upstream act not delivered yet
+                events.append((t, s, OP_F, m, c))
+                doneF[(m, g)] = t
+            else:
+                if doneF.get((m, g), t) > t - 1:
+                    continue
+                if g < G - 1 and doneB.get((m, g + 1), t) > t - 1:
+                    continue  # stall: cotangent not delivered yet
+                events.append((t, s, OP_B_LAST if g == G - 1 else OP_B, m, c))
+                doneB[(m, g)] = t
+            pos[s] += 1
+        t += 1
+    if any(pos[s] < len(orders[s]) for s in range(S)):
+        raise RuntimeError(f"pipeline scheduler deadlocked (S={S}, M={M}, V={V})")
+    return _pack(events, S, M, V)
+
+
+def build_schedule(name: str, S: int, M: int, V: int = 1) -> PipelineSchedule:
+    """Schedule zoo entry point: 'gpipe'/'FThenB', '1f1b', 'interleaved'/'vpp'."""
+    key = name.lower()
+    if key in ("gpipe", "fthenb", "f_then_b"):
+        if V != 1:
+            raise ValueError("gpipe has no virtual stages")
+        return build_gpipe(S, M)
+    if key == "1f1b":
+        return build_1f1b(S, M, V=1)
+    if key in ("interleaved", "vpp", "1f1b-interleaved"):
+        return build_1f1b(S, M, V=V)
+    raise ValueError(f"unknown schedule {name!r}")

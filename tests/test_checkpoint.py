@@ -55,3 +55,75 @@ def test_reshard_on_load_across_meshes(tmp_path):
     np.testing.assert_allclose(t2.numpy(), val)
     # sharding of the TARGET is preserved (reshard-on-load)
     assert t2._data.sharding.mesh.shape == {"x": 4, "y": 2}
+
+
+def test_sharded_save_writes_per_shard_files(tmp_path):
+    """v2 format: one file per unique shard, none holding the global value,
+    replicated shards deduped (reference save_state_dict.py:63,117)."""
+    import os
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddlepaddle_tpu.distributed.mesh import ProcessMesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+
+    mesh = ProcessMesh(shape=[4, 2], dim_names=["dp", "tp"]).to_jax()
+    val = np.arange(8 * 16, dtype=np.float32).reshape(8, 16)
+    t = paddle.to_tensor(val)
+    # sharded over tp only -> 2 unique shards, 4-way replicated each
+    t._replace_data(jax.device_put(t._data, NamedSharding(mesh, P(None, "tp"))))
+    dist_ckpt.save_state_dict({"w": t}, str(tmp_path / "ckpt"))
+
+    meta = dist_ckpt.get_checkpoint_metadata(str(tmp_path / "ckpt"))
+    rec = meta["tensors"]["w"]
+    assert meta["format"].endswith("v2")
+    assert len(rec["shards"]) == 2  # deduped: 8 device shards -> 2 unique
+    boxes = sorted(tuple(map(tuple, s["box"])) for s in rec["shards"])
+    assert boxes == [((0, 8), (0, 8)), ((0, 8), (8, 16))]
+    for s in rec["shards"]:
+        shard = np.load(os.path.join(tmp_path / "ckpt", s["file"]))
+        assert shard.shape == (8, 8)  # local bytes only, not the global value
+
+
+def test_reshard_hybrid_to_hybrid(tmp_path):
+    """dp4xtp2 -> dp2xfsdp2xtp2 round trip (the VERDICT's target case):
+    different axis count, different partition dims, values must survive."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddlepaddle_tpu.distributed.mesh import ProcessMesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+
+    rng = np.random.default_rng(7)
+    vals = {
+        "wq": rng.standard_normal((16, 8)).astype(np.float32),
+        "wo": rng.standard_normal((8, 16)).astype(np.float32),
+        "scale": rng.standard_normal((16,)).astype(np.float32),
+    }
+    mesh_a = ProcessMesh(shape=[4, 2], dim_names=["dp", "tp"]).to_jax()
+    specs_a = {"wq": P(None, "tp"), "wo": P("tp", None), "scale": P()}
+    sd = {}
+    for k, v in vals.items():
+        t = paddle.to_tensor(v.copy())
+        t._replace_data(jax.device_put(t._data, NamedSharding(mesh_a, specs_a[k])))
+        sd[k] = t
+    dist_ckpt.save_state_dict(sd, str(tmp_path / "ckpt"), async_save=True)
+    dist_ckpt.wait_all_saves()
+
+    mesh_b = ProcessMesh(shape=[2, 2, 2], dim_names=["dp", "fsdp", "tp"]).to_jax()
+    specs_b = {"wq": P(("dp", "fsdp"), "tp"), "wo": P("tp", "fsdp"),
+               "scale": P("fsdp")}
+    sd2 = {}
+    for k, v in vals.items():
+        t = paddle.to_tensor(np.zeros_like(v))
+        t._replace_data(jax.device_put(t._data, NamedSharding(mesh_b, specs_b[k])))
+        sd2[k] = t
+    dist_ckpt.load_state_dict(sd2, str(tmp_path / "ckpt"))
+    for k, v in vals.items():
+        np.testing.assert_allclose(sd2[k].numpy(), v)
+        assert sd2[k]._data.sharding.mesh.shape == {"dp": 2, "fsdp": 2, "tp": 2}
