@@ -79,6 +79,13 @@ class GradNode:
 
     ``inputs`` are exactly the differentiable input tensors the vjp closes
     over (the analogue of the reference's TensorWrapper-saved forward inputs).
+
+    ``pure_fn``/``out_treedef`` (set by the dispatcher) keep the op's pure
+    function of its differentiable primals so the backward itself can be
+    re-expressed as a taped op — that vjp-of-vjp recording is what makes
+    ``grad(create_graph=True)`` compose to arbitrary order (the analogue of
+    the reference's generated double-grad nodes, backward.yaml chains).
+    Nodes without it (e.g. PyLayer) still backward once, detached.
     """
 
     __slots__ = (
@@ -88,15 +95,20 @@ class GradNode:
         "out_avals",
         "out_grads",
         "released",
+        "pure_fn",
+        "out_treedef",
     )
 
-    def __init__(self, name, vjp_fn, inputs, out_avals):
+    def __init__(self, name, vjp_fn, inputs, out_avals, pure_fn=None,
+                 out_treedef=None):
         self.name = name
         self.vjp_fn = vjp_fn
         self.inputs: Tuple[Any, ...] = inputs
         self.out_avals = out_avals  # list of (shape, dtype) per output
         self.out_grads: List[Optional[jnp.ndarray]] = [None] * len(out_avals)
         self.released = False
+        self.pure_fn = pure_fn
+        self.out_treedef = out_treedef
 
     def accumulate(self, index: int, grad):
         cur = self.out_grads[index]
@@ -112,6 +124,7 @@ class GradNode:
 
     def release(self):
         self.vjp_fn = None
+        self.pure_fn = None
         self.out_grads = [None] * len(self.out_avals)
         self.released = True
 
@@ -199,6 +212,7 @@ def run_backward(
     target_tensors: Optional[Sequence] = None,
     only_inputs: bool = True,
     no_grad_tensors: Optional[Sequence] = None,
+    create_graph: bool = False,
 ):
     """Execute reverse accumulation from ``tensors`` seeded with ``grad_tensors``.
 
@@ -208,7 +222,15 @@ def run_backward(
     a target; ``no_grad_tensors`` sever gradient flow entirely. Mirrors
     RunBackward/GeneralGrad in the reference
     (paddle/fluid/eager/backward.cc:105, general_grad.h).
+
+    With ``create_graph`` every cotangent is a live Tensor and each node's
+    backward runs through the dispatcher as ``vjp(pure_fn)`` of the node's
+    primal inputs and cotangents — so the computed gradients carry their own
+    grad nodes and ``grad`` composes to arbitrary order (reference double-grad
+    chains, python/paddle/base/dygraph/base.py:656 create_graph).
     """
+    from .tensor import Tensor  # local import to avoid cycle
+
     target_ids = {}
     captured = None
     if target_tensors is not None:
@@ -216,6 +238,38 @@ def run_backward(
         for i, t in enumerate(target_tensors):
             target_ids.setdefault(id(t), []).append(i)
     no_grad_ids = frozenset(id(t) for t in (no_grad_tensors or ()))
+
+    def _exec_node(node):
+        """Run a node's backward; in create_graph mode this is ITSELF a taped
+        op over (primal inputs, cotangent tensors)."""
+        if not create_graph:
+            return node.vjp_fn(node.materialized_out_grads())
+        cts = []
+        for (shape, dtype), g in zip(node.out_avals, node.out_grads):
+            if g is None:
+                g = Tensor._from_data(jnp.zeros(shape, dtype), stop_gradient=True)
+            cts.append(g)
+        if node.pure_fn is None:
+            # e.g. PyLayer: backward once, detached (the reference likewise
+            # requires ops to provide double-grad nodes to go higher)
+            raw = node.vjp_fn(tuple(
+                c._data if isinstance(c, Tensor) else c for c in cts))
+            return tuple(
+                None if g is None else Tensor._from_data(g, stop_gradient=True)
+                for g in raw)
+        from .dispatch import apply_op
+
+        n_in = len(node.inputs)
+        pure_fn, treedef = node.pure_fn, node.out_treedef
+
+        def bwd(*vals):
+            xs, cvals = vals[:n_in], vals[n_in:]
+            _, vjp = jax.vjp(pure_fn, *xs)
+            return vjp(jax.tree_util.tree_unflatten(treedef, list(cvals)))
+
+        grads = apply_op(bwd, *node.inputs, *cts,
+                         op_name=node.name + "_grad")
+        return tuple(grads)
 
     def capture(tensor, g):
         if captured is not None and id(tensor) in target_ids:
@@ -261,12 +315,23 @@ def run_backward(
         g = t_acc.pop(id(t), (t, None))[1]
         if g is None:
             return
-        g = t._apply_grad_hooks(g)
+        if create_graph:
+            # cotangents are live Tensors here; hooks see (and may rewrite)
+            # the differentiable gradient
+            if t._hooks:
+                for hook in list(t._hooks.values()):
+                    out = hook(g)
+                    if out is not None:
+                        g = (out if isinstance(out, Tensor)
+                             else Tensor._from_data(jnp.asarray(out),
+                                                    stop_gradient=True))
+        else:
+            g = t._apply_grad_hooks(g)
         capture(t, g)
         prod = t._grad_node
         if prod is None:
             if accumulate_into_leaves and not t.stop_gradient:
-                t._accumulate_grad(g)
+                t._accumulate_grad(g._data if isinstance(g, Tensor) else g)
             return
         if allowed is not None and id(prod) not in allowed:
             return
@@ -293,7 +358,7 @@ def run_backward(
     seen_ready = set(id(n) for n in ready)
     while ready:
         node = ready.pop()
-        in_grads = node.vjp_fn(node.materialized_out_grads())
+        in_grads = _exec_node(node)
         for t, g in zip(node.inputs, in_grads):
             if id(t) in no_grad_ids:
                 continue
@@ -369,18 +434,13 @@ def grad(
 ):
     """``paddle.grad`` equivalent (python/paddle/base/dygraph/base.py:656).
 
-    ``create_graph=True`` (higher-order grad) is supported through the
-    functional path: recompute via jax.grad is recommended for higher-order;
-    the tape path raises for now.
+    ``create_graph=True`` records the backward pass itself on the tape
+    (vjp-of-vjp), so the returned gradients are differentiable and ``grad``
+    composes to arbitrary order — the reference's double-grad chains
+    (backward.yaml) with zero per-op backward code.
     """
     from .tensor import Tensor
 
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True on the eager tape is not supported yet; use "
-            "paddlepaddle_tpu.incubate.autograd (functional jax.grad/jacobian/"
-            "hessian) for higher-order derivatives."
-        )
     # Matches the reference: python/paddle/base/dygraph/base.py asserts
     # only_inputs=True ("only_inputs=False is not supported yet").
     assert only_inputs, "only_inputs=False is not supported yet"
@@ -392,13 +452,19 @@ def grad(
         grad_outputs = [grad_outputs]
     seeds = []
     for t, g in zip(outputs, grad_outputs):
-        if g is None:
-            g = jnp.ones_like(t._data)
+        if create_graph:
+            # live-Tensor cotangents: a grad_outputs tensor with a graph keeps
+            # its history, so d(grad)/d(grad_outputs) also works
+            if g is None:
+                g = Tensor._from_data(jnp.ones_like(t._data), stop_gradient=True)
+            elif not isinstance(g, Tensor):
+                g = Tensor._from_data(jnp.asarray(g), stop_gradient=True)
         else:
-            g = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+            g = (jnp.ones_like(t._data) if g is None
+                 else g._data if isinstance(g, Tensor) else jnp.asarray(g))
         seeds.append(g)
     if retain_graph is None:
-        retain_graph = False
+        retain_graph = bool(create_graph)
     # Run with the graph retained so an allow_unused error leaves it intact
     # (the caller may retry); release afterwards if not requested to keep it.
     if no_grad_vars is not None and not isinstance(no_grad_vars, (list, tuple, set)):
@@ -413,6 +479,7 @@ def grad(
         target_tensors=inputs,
         only_inputs=only_inputs,
         no_grad_tensors=no_grad_vars,
+        create_graph=create_graph,
     )
     results = []
     for t, g in zip(inputs, captured):
@@ -424,6 +491,8 @@ def grad(
                     "is intended."
                 )
             results.append(None)
+        elif isinstance(g, Tensor):
+            results.append(g)  # create_graph: keep the recorded history
         else:
             results.append(Tensor._from_data(g, stop_gradient=True))
     if not retain_graph:

@@ -95,6 +95,8 @@ def apply_op(fn: Callable, *args, op_name: str = None, **kwargs) -> Any:
         lambda cts: vjp_fn(jax.tree_util.tree_unflatten(out_treedef, list(cts))),
         tuple(leaves[p] for p in diff_pos),
         [(tuple(o.shape), o.dtype) for o in out_leaves],
+        pure_fn=pure,           # lets create_graph=True re-tape this op's vjp
+        out_treedef=out_treedef,
     )
     wrapped = []
     for i, o in enumerate(out_leaves):

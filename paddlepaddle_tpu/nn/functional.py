@@ -918,6 +918,21 @@ def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax
     return out, None
 
 
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q=None, max_seqlen_k=None, scale=None,
+                        dropout=0.0, causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """Packed varlen attention (reference flash_attention.py:762) — segment-
+    masked Pallas kernels on TPU (ops/kernels/flash_varlen.py)."""
+    from ..ops.kernels.flash_varlen import flash_attn_unpadded as _impl
+
+    return _impl(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                 max_seqlen_q=max_seqlen_q, max_seqlen_k=max_seqlen_k,
+                 scale=scale, dropout=dropout, causal=causal,
+                 return_softmax=return_softmax, training=training)
+
+
 def sequence_mask(x, maxlen=None, dtype="int64", name=None):
     def f(lens):
         m = maxlen or int(jnp.max(lens))

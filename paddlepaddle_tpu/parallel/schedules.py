@@ -192,6 +192,7 @@ def validate(sched: PipelineSchedule) -> PipelineSchedule:
     sched.stash_cap = max(int(max_stash), 1)
     sched.inbox_f_cap = max(int(max_if), 1)
     sched.inbox_b_cap = max(int(max_ib), 1)
+    _check_slot_collisions(sched, fin_v, fin_m, fin_c, bin_v, bin_m, bin_c)
     busy = int((sched.ops != OP_IDLE).sum())
     sched.stats = {
         "T": sched.T,
@@ -201,6 +202,50 @@ def validate(sched: PipelineSchedule) -> PipelineSchedule:
         "stash_cap": sched.stash_cap,
     }
     return sched
+
+
+def _check_slot_collisions(sched: PipelineSchedule, fin_v, fin_m, fin_c,
+                           bin_v, bin_m, bin_c) -> None:
+    """The executor addresses stash/inbox entries as ``m % cap``; bounding the
+    peak COUNT (stash_cap et al.) is not enough if a legal-but-out-of-order
+    schedule makes two live microbatches share a modular slot. Re-simulate
+    occupancy at the executor's addressing granularity and reject collisions.
+    """
+    S, V = sched.S, sched.V
+    stash: Dict[Tuple[int, int, int], int] = {}   # (s, c, m % cap) -> m
+    inf: Dict[Tuple[int, int, int], int] = {}
+    inb: Dict[Tuple[int, int, int], int] = {}
+
+    def occupy(buf, keyname, s, c, m, cap, t):
+        key = (s, c, m % cap)
+        prev = buf.get(key)
+        if prev is not None and prev != m:
+            raise ValueError(
+                f"slot {t} dev {s}: {keyname} collision — microbatches {prev} "
+                f"and {m} of chunk {c} both live in slot m%{cap}; the "
+                "executor's modular addressing needs a contiguous outstanding "
+                "window (reorder the schedule or grow its buffers)")
+        buf[key] = m
+
+    for t in range(sched.T):
+        for s in range(S):
+            if fin_v[t, s]:
+                occupy(inf, "forward-inbox", s, int(fin_c[t, s]),
+                       int(fin_m[t, s]), sched.inbox_f_cap, t)
+            if bin_v[t, s]:
+                occupy(inb, "cotangent-inbox", s, int(bin_c[t, s]),
+                       int(bin_m[t, s]), sched.inbox_b_cap, t)
+        for s in range(S):
+            op = int(sched.ops[t, s])
+            if op == OP_IDLE:
+                continue
+            m, c = int(sched.mbs[t, s]), int(sched.chunks[t, s])
+            if op == OP_F:
+                occupy(stash, "stash", s, c, m, sched.stash_cap, t)
+                inf.pop((s, c, m % sched.inbox_f_cap), None)
+            else:
+                stash.pop((s, c, m % sched.stash_cap), None)
+                inb.pop((s, c, m % sched.inbox_b_cap), None)
 
 
 def _pack(events: List[Tuple[int, int, int, int, int]], S: int, M: int,
@@ -317,6 +362,9 @@ def build_schedule(name: str, S: int, M: int, V: int = 1) -> PipelineSchedule:
             raise ValueError("gpipe has no virtual stages")
         return build_gpipe(S, M)
     if key == "1f1b":
+        if V != 1:
+            raise ValueError(
+                "1f1b has no virtual stages; use schedule='interleaved' for V>1")
         return build_1f1b(S, M, V=1)
     if key in ("interleaved", "vpp", "1f1b-interleaved"):
         return build_1f1b(S, M, V=V)

@@ -48,6 +48,33 @@ def test_1f1b_memory_and_bubble_vs_gpipe():
     assert build_gpipe(S, 32).stash_cap == 32
 
 
+def test_validate_rejects_modular_slot_collision():
+    """A dependency-legal but out-of-order schedule whose live microbatches
+    collide in the executor's m%cap addressing must be rejected, not
+    silently corrupt activations (found by review: S=1, F0 F1 B1 F2 B0 B2)."""
+    import pytest as _pytest
+
+    from paddlepaddle_tpu.parallel.schedules import (
+        OP_B_LAST, OP_F, PipelineSchedule, validate)
+
+    ops = np.array([[OP_F], [OP_F], [OP_B_LAST], [OP_F], [OP_B_LAST],
+                    [OP_B_LAST]], np.int32)
+    mbs = np.array([[0], [1], [1], [2], [0], [2]], np.int32)
+    chunks = np.zeros_like(mbs)
+    with _pytest.raises(ValueError, match="collision"):
+        validate(PipelineSchedule(S=1, M=3, V=1, ops=ops, mbs=mbs,
+                                  chunks=chunks))
+
+
+def test_build_schedule_rejects_virtual_1f1b():
+    import pytest as _pytest
+
+    from paddlepaddle_tpu.parallel.schedules import build_schedule
+
+    with _pytest.raises(ValueError, match="interleaved"):
+        build_schedule("1f1b", 4, 8, V=2)
+
+
 def test_interleaved_shrinks_bubble():
     from paddlepaddle_tpu.parallel.schedules import build_1f1b
 
