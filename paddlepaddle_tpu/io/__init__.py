@@ -3,7 +3,12 @@ io/dataloader/). Host-side input pipeline feeding the device; on TPU the
 prefetch thread overlaps host batch assembly with device steps (the analogue
 of the reference's per-device prefetch queues in data_feed.cc)."""
 
-from .dataloader import DataLoader  # noqa: F401
+from .dataloader import (  # noqa: F401
+    DataLoader,
+    WorkerInfo,
+    get_worker_info,
+    np_collate_fn,
+)
 from .dataset import (  # noqa: F401
     ChainDataset,
     ComposeDataset,

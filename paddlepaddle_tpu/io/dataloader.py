@@ -1,15 +1,25 @@
-"""DataLoader (reference: python/paddle/io/reader.py:262 + dataloader_iter.py).
+"""DataLoader (reference: python/paddle/io/reader.py:262 + dataloader/
+worker.py + dataloader_iter.py).
 
-Worker parallelism uses a thread pool + a bounded prefetch queue instead of
-the reference's subprocess workers with shared-memory transport: dataset code
-runs in threads (numpy releases the GIL for array work) and assembled batches
-are uploaded to the device ahead of consumption. ``num_workers=0`` is fully
-synchronous like the reference."""
+``num_workers>0`` spawns SUBPROCESS workers like the reference: each worker
+owns an index queue, runs ``dataset[i]`` + collate outside the parent's GIL
+(python-heavy transforms scale), and ships numpy batches back over a bounded
+data queue (pickle+pipe transport; the parent wraps leaves into Tensors and
+uploads to device, so forked children never touch the accelerator runtime).
+``worker_init_fn``/``persistent_workers`` are honored; iterable datasets see
+``get_worker_info()`` for self-sharding (reference worker.py WorkerInfo).
+``num_workers=0`` is fully synchronous; ``use_multiprocess=False`` keeps the
+legacy in-process thread pool (numpy-heavy datasets where fork cost loses).
+"""
 
 from __future__ import annotations
 
+import itertools
+import multiprocessing
+import os
 import queue
 import threading
+import traceback
 
 import numpy as np
 
@@ -17,6 +27,194 @@ from ..core.dispatch import wrap
 from ..core.tensor import Tensor
 from .dataset import IterableDataset
 from .sampler import BatchSampler
+
+
+class WorkerInfo:
+    """Visible to dataset code inside a worker (reference: paddle.io
+    get_worker_info, python/paddle/io/dataloader/worker.py)."""
+
+    def __init__(self, id, num_workers, seed, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.seed = seed
+        self.dataset = dataset
+
+    def __repr__(self):
+        return (f"WorkerInfo(id={self.id}, num_workers={self.num_workers}, "
+                f"seed={self.seed})")
+
+
+_worker_info = None
+
+
+def get_worker_info():
+    """Inside a worker process: that worker's WorkerInfo; None in the main
+    process. IterableDataset code uses it to shard itself across workers."""
+    return _worker_info
+
+
+def np_collate_fn(batch):
+    """Collate into plain numpy (runs inside workers — no jax there)."""
+    sample = batch[0]
+    if isinstance(sample, (list, tuple)):
+        return type(sample)(np_collate_fn([b[i] for b in batch])
+                            for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: np_collate_fn([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, np.float32)
+    if isinstance(sample, str):
+        return list(batch)
+    return np.asarray(batch)
+
+
+def _wrap_leaves(obj):
+    """numpy leaves -> device Tensors (parent-side upload)."""
+    if isinstance(obj, np.ndarray):
+        return wrap_np(obj)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_wrap_leaves(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _wrap_leaves(v) for k, v in obj.items()}
+    return obj
+
+
+class _RemoteTraceback(RuntimeError):
+    """Worker-side exception re-raised in the parent with the remote trace."""
+
+
+def _to_np_leaves(obj):
+    """Tensor/jax leaves -> numpy so batches pickle cleanly through the mp
+    queue even when a user collate_fn builds device arrays in the worker."""
+    if isinstance(obj, Tensor):
+        return np.asarray(obj.numpy())
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_np_leaves(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _to_np_leaves(v) for k, v in obj.items()}
+    if type(obj).__module__.startswith("jax"):
+        return np.asarray(obj)
+    return obj
+
+
+def _worker_loop(dataset, index_queue, data_queue, collate_fn, init_fn,
+                 worker_id, num_workers, seed, iterable, batch_size,
+                 drop_last):
+    """Reference: python/paddle/io/dataloader/worker.py _worker_loop.
+
+    Every message is tagged with the epoch id of the job that produced it so
+    the parent can discard leftovers from an abandoned epoch."""
+    global _worker_info
+    _worker_info = WorkerInfo(worker_id, num_workers, seed + worker_id,
+                              dataset)
+    np.random.seed(seed + worker_id)
+    try:
+        if init_fn is not None:
+            init_fn(worker_id)
+    except Exception:
+        data_queue.put(("error", 0, worker_id, traceback.format_exc()))
+        return
+    try:
+        if iterable:
+            # epochs arrive as ('epoch', id) messages; each runs this
+            # worker's self-sharded iterator to exhaustion
+            while True:
+                msg = index_queue.get()
+                if msg is None:
+                    break
+                _, epoch = msg
+                batch, seq = [], 0
+                for item in iter(dataset):
+                    batch.append(item)
+                    if len(batch) == batch_size:
+                        data_queue.put(("data", epoch, (worker_id, seq),
+                                        _to_np_leaves(collate_fn(batch))))
+                        batch, seq = [], seq + 1
+                if batch and not drop_last:
+                    data_queue.put(("data", epoch, (worker_id, seq),
+                                    _to_np_leaves(collate_fn(batch))))
+                data_queue.put(("end", epoch, worker_id, None))
+        else:
+            while True:
+                job = index_queue.get()
+                if job is None:
+                    break
+                epoch, bidx, indices = job
+                data_queue.put(
+                    ("data", epoch, bidx,
+                     _to_np_leaves(collate_fn([dataset[i] for i in indices]))))
+    except Exception:
+        data_queue.put(("error", 0, worker_id, traceback.format_exc()))
+
+
+class _WorkerPool:
+    """Subprocess pool: per-worker index queues + one bounded data queue
+    (backpressure) — the shape of the reference's _DataLoaderIterMultiProcess.
+    Holds no reference back to the DataLoader (no cycle); epoch ids let a
+    reused pool discard leftovers from an abandoned epoch."""
+
+    def __init__(self, dataset, collate_fn, worker_init_fn, num_workers,
+                 prefetch_factor, iterable, batch_size, drop_last):
+        self.num_workers = num_workers
+        self.prefetch_factor = prefetch_factor
+        self.epoch = 0
+        ctx = multiprocessing.get_context(
+            os.environ.get("PADDLE_TPU_MP_START_METHOD", "fork"))
+        self.index_queues = [ctx.Queue() for _ in range(num_workers)]
+        self.data_queue = ctx.Queue(maxsize=num_workers * prefetch_factor)
+        seed = int(np.random.randint(0, 2**31 - 1))
+        self.procs = []
+        for w in range(num_workers):
+            p = ctx.Process(
+                target=_worker_loop,
+                args=(dataset, self.index_queues[w], self.data_queue,
+                      collate_fn, worker_init_fn, w, num_workers, seed,
+                      iterable, batch_size, drop_last),
+                daemon=True)
+            p.start()
+            self.procs.append(p)
+        self.alive = True
+
+    def healthy(self) -> bool:
+        return self.alive and all(p.is_alive() for p in self.procs)
+
+    def get(self, timeout):
+        """One message for the CURRENT epoch (stale-epoch messages dropped)."""
+        while True:
+            try:
+                msg = self.data_queue.get(timeout=timeout or None)
+            except queue.Empty:
+                raise _RemoteTraceback(
+                    f"DataLoader timed out after {timeout}s waiting for "
+                    "worker data") from None
+            kind, epoch, key, payload = msg
+            if kind == "error" or epoch == self.epoch:
+                return kind, key, payload
+            # else: leftover from an abandoned epoch — discard
+
+    def shutdown(self):
+        if not self.alive:
+            return
+        self.alive = False
+        for q in self.index_queues:
+            try:
+                q.put(None)
+            except Exception:
+                pass
+        for p in self.procs:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass
 
 
 def default_collate_fn(batch):
@@ -51,21 +249,33 @@ class DataLoader:
                  batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
                  collate_fn=None, num_workers=0, use_buffer_reader=True,
                  prefetch_factor=2, use_shared_memory=True, timeout=0,
-                 worker_init_fn=None, persistent_workers=False):
+                 worker_init_fn=None, persistent_workers=False,
+                 use_multiprocess=True):
         self.dataset = dataset
+        self._user_collate = collate_fn
         self.collate_fn = collate_fn or default_collate_fn
+        self._worker_collate = collate_fn or np_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = max(prefetch_factor, 2)
+        self.worker_init_fn = worker_init_fn
+        self.persistent_workers = persistent_workers
+        self.use_multiprocess = use_multiprocess
+        self.timeout = timeout
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        self._pool = None
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
             self.batch_sampler = None
-            self.batch_size = batch_size
-            self.drop_last = drop_last
         elif batch_sampler is not None:
             self.batch_sampler = batch_sampler
         else:
             self.batch_sampler = BatchSampler(
                 dataset, shuffle=shuffle, batch_size=batch_size, drop_last=drop_last)
+
+    def __del__(self):
+        if getattr(self, "_pool", None) is not None:
+            self._pool.shutdown()
 
     def __len__(self):
         if self._iterable:
@@ -89,6 +299,10 @@ class DataLoader:
     def __iter__(self):
         if self.num_workers == 0:
             yield from self._batches()
+            return
+        if self.use_multiprocess:
+            yield from (self._iter_mp_iterable() if self._iterable
+                        else self._iter_mp_map())
             return
         if self._iterable:
             # IterableDataset must be consumed sequentially; one producer
@@ -118,6 +332,101 @@ class DataLoader:
             finally:
                 for f in pending:
                     f.cancel()
+
+    # -- subprocess workers (reference dataloader/worker.py) ----------------
+
+    def _get_pool(self):
+        if self._pool is not None:
+            if self._pool.healthy():
+                self._pool.epoch += 1
+                return self._pool
+            self._pool.shutdown()  # a worker died: never reuse a broken pool
+            self._pool = None
+        pool = _WorkerPool(self.dataset, self._worker_collate,
+                           self.worker_init_fn, self.num_workers,
+                           self.prefetch_factor, self._iterable,
+                           self.batch_size if self._iterable else 0,
+                           self.drop_last if self._iterable else False)
+        if self.persistent_workers:
+            self._pool = pool
+        return pool
+
+    def _raise_worker_error(self, pool, worker_id, tb):
+        # the failing worker's process has exited — tear the pool down so a
+        # retry gets fresh workers instead of hanging on a dead queue
+        pool.shutdown()
+        if self._pool is pool:
+            self._pool = None
+        raise _RemoteTraceback(f"DataLoader worker {worker_id} failed:\n{tb}")
+
+    def _iter_mp_map(self):
+        pool = self._get_pool()
+        epoch = pool.epoch
+        try:
+            jobs = list(self.batch_sampler)
+            # windowed feeding: at most W*prefetch_factor jobs outstanding,
+            # so parent-side reorder buffering stays bounded (the reference
+            # iterator keeps the same outstanding window)
+            window = pool.num_workers * pool.prefetch_factor
+            sent = 0
+
+            def feed():
+                nonlocal sent
+                while sent < len(jobs) and sent - done < window:
+                    pool.index_queues[sent % pool.num_workers].put(
+                        (epoch, sent, list(jobs[sent])))
+                    sent += 1
+
+            done = 0
+            buf = {}
+            feed()
+            for want in range(len(jobs)):
+                while want not in buf:
+                    kind, key, payload = pool.get(self.timeout)
+                    if kind == "error":
+                        self._raise_worker_error(pool, key, payload)
+                    buf[key] = payload
+                done += 1
+                feed()
+                yield _wrap_leaves(buf.pop(want))
+        finally:
+            if not self.persistent_workers:
+                pool.shutdown()
+
+    def _iter_mp_iterable(self):
+        """Each worker runs its own (self-sharded via get_worker_info)
+        iterator; batches interleave round-robin across workers."""
+        pool = self._get_pool()
+        W = pool.num_workers
+        try:
+            for q in pool.index_queues:
+                q.put(("epoch", pool.epoch))
+            pending = {w: {} for w in range(W)}
+            next_seq = [0] * W
+            ended = set()
+            rr = itertools.cycle(range(W))
+            while True:
+                if len(ended) == W and not any(pending.values()):
+                    break
+                target = next(rr)
+                if target in ended and not pending[target]:
+                    continue
+                while (next_seq[target] not in pending[target]
+                       and target not in ended):
+                    kind, key, payload = pool.get(self.timeout)
+                    if kind == "error":
+                        self._raise_worker_error(pool, key, payload)
+                    elif kind == "end":
+                        ended.add(key)
+                    else:
+                        wq, seq = key
+                        pending[wq][seq] = payload
+                if next_seq[target] in pending[target]:
+                    yield _wrap_leaves(pending[target].pop(next_seq[target]))
+                    next_seq[target] += 1
+        finally:
+            if not self.persistent_workers:
+                pool.shutdown()
 
     def _prefetch_single(self):
         q: queue.Queue = queue.Queue(maxsize=self.num_workers * self.prefetch_factor)

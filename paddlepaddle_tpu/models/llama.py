@@ -491,7 +491,12 @@ def llama_sharding_rules(tp_axis="tp", fsdp_axis="fsdp"):
     group_sharded_stage3.py) as compiler-inserted ICI collectives.
     """
     return [
-        (r".*embed_tokens\.weight$", (tp_axis, fsdp_axis)),
+        # embed: vocab over fsdp, hidden over tp — hidden-over-tp matches the
+        # activation-cotangent layout in backward, so the embedding VJP needs
+        # no "involuntary full rematerialization" reshard (the (tp, fsdp)
+        # orientation forced XLA to replicate the [b,s,h] cotangent when the
+        # batch is sharded over dp x fsdp)
+        (r".*embed_tokens\.weight$", (fsdp_axis, tp_axis)),
         (r".*(q|k|v)_proj\.weight$", (fsdp_axis, tp_axis)),   # column-parallel
         (r".*o_proj\.weight$", (tp_axis, fsdp_axis)),          # row-parallel
         (r".*(gate|up)_proj\.weight$", (fsdp_axis, tp_axis)),  # column-parallel
