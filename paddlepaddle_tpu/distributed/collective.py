@@ -175,5 +175,8 @@ def recv(tensor, src=0, group=None, sync_op=True):
 
 
 def barrier(group=None):
+    from .comm_task import comm_task
+
     # single-controller: dispatch is ordered; block host until devices finish
-    jax.effects_barrier()
+    with comm_task("barrier", group=getattr(group, "name", None) or "world"):
+        jax.effects_barrier()

@@ -44,9 +44,14 @@ def _parse_args(argv=None):
     return p.parse_args(argv)
 
 
-def _worker_env(args, local_rank: int, world_size: int, master_addr, master_port):
+def _worker_env(args, local_rank: int, world_size: int, master_addr,
+                master_port, node_index: int = None):
     env = dict(os.environ)
-    rank = args.node_rank * args.nproc_per_node + local_rank
+    # node_index: position in the elastic member list (falls back to the
+    # static --node_rank) — after a scale event ranks must stay contiguous
+    # within the committed world
+    node = args.node_rank if node_index is None else node_index
+    rank = node * args.nproc_per_node + local_rank
     env.update({
         "PADDLE_TRAINER_ID": str(rank),
         "PADDLE_TRAINERS_NUM": str(world_size),
@@ -69,7 +74,11 @@ def _worker_env(args, local_rank: int, world_size: int, master_addr, master_port
 
 def launch(argv=None) -> int:
     args = _parse_args(argv)
-    nnodes = int(str(args.nnodes).split(":")[0])
+    spec = str(args.nnodes)
+    lo = int(spec.split(":")[0])
+    hi = int(spec.split(":")[1]) if ":" in spec else lo
+    elastic = hi > lo
+    nnodes = lo
     world_size = nnodes * args.nproc_per_node
 
     # rendezvous store: rank0 node hosts it (native TCPStore)
@@ -86,6 +95,35 @@ def launch(argv=None) -> int:
                          master_port, is_master=True, world_size=world_size)
         master_port = store.port
 
+    # elastic membership (reference fleet/elastic/manager.py over etcd; here
+    # over the same TCPStore): register this node, master watches liveness,
+    # scale events relaunch workers with the new world
+    enode = manager = None
+    world_version = 0
+    if elastic:
+        from ..fleet.elastic import ElasticManager, ElasticNode
+        from ..store import TCPStore
+
+        client = store or TCPStore(master_addr, master_port)
+        enode = ElasticNode(client, node_id=f"node{args.node_rank}")
+        enode.register()
+        if store is not None:  # master node runs the membership watcher
+            manager = ElasticManager(client, (lo, hi)).start()
+            manager.wait_for_np(lo)
+        # all nodes wait for the first committed world
+        members = []
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            world_version, members = ElasticManager.read_world(client)
+            if world_version > 0:
+                break
+            time.sleep(0.2)
+        if not members:
+            raise RuntimeError(
+                "elastic rendezvous: no world committed within 60s "
+                "(is the master node up?)")
+        world_size = len(members) * args.nproc_per_node
+
     if args.log_dir:
         os.makedirs(args.log_dir, exist_ok=True)
 
@@ -93,7 +131,13 @@ def launch(argv=None) -> int:
     restarts = {i: 0 for i in range(args.nproc_per_node)}
 
     def spawn(local_rank):
-        env = _worker_env(args, local_rank, world_size, master_addr, master_port)
+        node_index = None
+        if enode is not None and members:
+            me = f"node{args.node_rank}"
+            node_index = members.index(me) if me in members else args.node_rank
+        env = _worker_env(args, local_rank, world_size, master_addr,
+                          master_port, node_index=node_index)
+        env["PADDLE_WORLD_VERSION"] = str(world_version)
         cmd = [sys.executable, args.training_script] + args.training_script_args
         stdout = None
         if args.log_dir:
@@ -124,6 +168,29 @@ def launch(argv=None) -> int:
     try:
         while procs:
             time.sleep(0.5)
+            # elastic scale event: membership changed -> relaunch every local
+            # worker against the new world (reference manager.py:237-316)
+            if enode is not None and enode.world_changed(world_version):
+                from ..fleet.elastic import ElasticManager
+
+                world_version, members = ElasticManager.read_world(
+                    enode.store)
+                world_size = len(members) * args.nproc_per_node
+                print(f"[launch] elastic scale event v{world_version}: "
+                      f"{len(members)} nodes; relaunching workers",
+                      file=sys.stderr)
+                for p in procs.values():
+                    if p.poll() is None:
+                        p.terminate()
+                for p in procs.values():
+                    try:
+                        p.wait(timeout=10)
+                    except Exception:
+                        p.kill()
+                procs.clear()
+                for i in range(args.nproc_per_node):
+                    spawn(i)
+                continue
             for lr, p in list(procs.items()):
                 rc = p.poll()
                 if rc is None:

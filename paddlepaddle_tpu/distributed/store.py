@@ -104,11 +104,14 @@ class TCPStore:
             raise RuntimeError("TCPStore.set failed")
 
     def get(self, key: str) -> bytes:
+        from .comm_task import comm_task
+
         if self._py:
-            return self._py.get(key)
+            with comm_task(f"store.get({key!r})", group="dcn"):
+                return self._py.get(key)
         # two-call protocol: fetch stages the value natively and reports its
         # exact size, copy drains it — values of arbitrary size round-trip
-        with self._get_lock:
+        with comm_task(f"store.get({key!r})", group="dcn"), self._get_lock:
             n = self._lib.tcpstore_fetch(self._client, key.encode())
             if n < 0:
                 raise RuntimeError(f"TCPStore.get({key!r}) failed ({n})")
@@ -131,11 +134,14 @@ class TCPStore:
         return all(self._lib.tcpstore_check(self._client, k.encode()) == 1 for k in keys)
 
     def wait(self, keys, timeout: Optional[float] = None) -> None:
+        from .comm_task import comm_task
+
         deadline = time.time() + (timeout if timeout is not None else self._timeout_ms / 1000)
-        while time.time() < deadline:
-            if self.check(keys):
-                return
-            time.sleep(0.05)
+        with comm_task(f"store.wait({keys!r})", group="dcn"):
+            while time.time() < deadline:
+                if self.check(keys):
+                    return
+                time.sleep(0.05)
         raise TimeoutError(f"TCPStore.wait timed out on {keys}")
 
     def __del__(self):

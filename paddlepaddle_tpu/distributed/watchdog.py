@@ -40,6 +40,7 @@ class Watchdog:
         self._stop = threading.Event()
         self._fired = False
         self._thread: Optional[threading.Thread] = None
+        self.last_in_flight = []  # populated at timeout for on_timeout consumers
 
     # -- lifecycle ----------------------------------------------------------
     def start(self):
@@ -93,6 +94,10 @@ class Watchdog:
             elapsed = time.monotonic() - start
             if elapsed > self.timeout and not self._fired:
                 self._fired = True
+                from .comm_task import in_flight
+
+                # snapshot for programmatic consumers (on_timeout handlers)
+                self.last_in_flight = in_flight()
                 self._dump(name, elapsed)
                 if self.on_timeout is not None:
                     try:
@@ -104,9 +109,16 @@ class Watchdog:
                     os._exit(114)
 
     def _dump(self, name, elapsed):
+        from .comm_task import format_in_flight
+
         sys.stderr.write(
             f"[watchdog] step {name!r} exceeded {self.timeout:.0f}s "
-            f"(elapsed {elapsed:.0f}s); stacks of all threads:\n")
+            f"(elapsed {elapsed:.0f}s)\n")
+        # per-collective/region attribution (the CommTaskManager report,
+        # comm_task_manager.cc:273): WHICH op on WHICH group is in flight
+        sys.stderr.write("[watchdog] in-flight communication/regions:\n")
+        sys.stderr.write(format_in_flight())
+        sys.stderr.write("[watchdog] stacks of all threads:\n")
         for tid, frame in sys._current_frames().items():
             sys.stderr.write(f"--- thread {tid} ---\n")
             sys.stderr.write("".join(traceback.format_stack(frame)))

@@ -52,6 +52,10 @@ class LlamaConfig:
     # ring attention over ICI (exceeds the reference, which has no ring attn)
     context_parallel_axis: Optional[str] = None
     data_parallel_axis: str = "dp"  # batch-dim axis inside the ring shard_map
+    # activation recompute per decoder layer (reference fleet recompute.py:459
+    # -> jax.checkpoint): trades one extra forward for O(layers) activation
+    # memory, what lets billion-param configs train on one chip
+    recompute: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -253,8 +257,14 @@ class LlamaModel(Layer):
                 x, nc = layer(x, cos, sin, attn_mask, cache=cache, pos=pos)
                 new_caches.append(nc)
             return self.norm(x), new_caches
-        for layer in self.layers:
-            x = layer(x, cos, sin, attn_mask)
+        if self.config.recompute:
+            from ..distributed.fleet.recompute import recompute
+
+            for layer in self.layers:
+                x = recompute(layer, x, cos, sin, attn_mask)
+        else:
+            for layer in self.layers:
+                x = layer(x, cos, sin, attn_mask)
         return self.norm(x)
 
 

@@ -829,6 +829,17 @@ def cosine_similarity(x1, x2, axis=1, eps=1e-8):
     return apply_op(f, x1, x2)
 
 
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """p-norm of (x - y) over the last dim (reference:
+    python/paddle/nn/functional/distance.py pairwise_distance)."""
+
+    def f(a, b):
+        d = a - b + epsilon
+        return jnp.sum(jnp.abs(d) ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+
+    return apply_op(f, x, y, op_name="pairwise_distance")
+
+
 def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean", name=None):
     sim = cosine_similarity(input1, input2, axis=-1)
 
@@ -887,8 +898,76 @@ def log_loss(input, label, epsilon=1e-4, name=None):
     )
 
 
-def ctc_loss(*a, **k):
-    raise NotImplementedError("ctc_loss lands with the audio kit")
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC loss (reference: python/paddle/nn/functional/loss.py:1907, warpctc).
+
+    Like the reference ("softmax with CTC"), ``log_probs`` are UNSCALED
+    logits [max_T, batch, num_classes]; softmax happens inside. The standard
+    log-space alpha recursion runs as one ``lax.scan`` over time (MXU-free
+    but fully vectorized over batch x extended-label positions), masked by
+    ``input_lengths``; gradients come from jax AD through the scan.
+    reduction='mean' divides each loss by its label length then averages.
+    """
+
+    def f(lp, lab, ilen, llen):
+        lp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)  # [T, B, C]
+        T, B, _ = lp.shape
+        S = lab.shape[1]
+        L = 2 * S + 1
+        NEG = -1e30
+        lab = lab.astype(jnp.int32)
+        ilen = ilen.astype(jnp.int32)
+        llen = llen.astype(jnp.int32)
+
+        # extended label sequence: blank, l1, blank, l2, ..., blank
+        ext = jnp.full((B, L), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lab)
+        # a diagonal skip (l-2 -> l) is legal only onto a label differing
+        # from the one two back
+        skip_ok = jnp.concatenate(
+            [jnp.zeros((B, 2), bool), ext[:, 2:] != ext[:, :-2]], axis=1)
+        # positions beyond this sample's 2*llen+1 extended length are dead
+        valid = jnp.arange(L)[None, :] < (2 * llen + 1)[:, None]
+
+        emit0 = jnp.take_along_axis(lp[0], ext, axis=1)
+        alpha0 = jnp.full((B, L), NEG, jnp.float32)
+        alpha0 = alpha0.at[:, 0].set(emit0[:, 0])
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(llen > 0, emit0[:, 1], NEG))
+
+        def step(carry, lp_t):
+            alpha, t = carry
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            s1 = jnp.concatenate(
+                [jnp.full((B, 1), NEG, jnp.float32), alpha[:, :-1]], axis=1)
+            s2 = jnp.concatenate(
+                [jnp.full((B, 2), NEG, jnp.float32), alpha[:, :-2]], axis=1)
+            s2 = jnp.where(skip_ok, s2, NEG)
+            new = jnp.logaddexp(jnp.logaddexp(alpha, s1), s2) + emit
+            new = jnp.where(valid, new, NEG)
+            # freeze finished sequences (t >= input length)
+            alpha = jnp.where((t < ilen)[:, None], new, alpha)
+            return (alpha, t + 1), None
+
+        (alpha, _), _ = jax.lax.scan(step, (alpha0, jnp.int32(1)), lp[1:])
+
+        idx_last = 2 * llen                      # final blank
+        a_blank = jnp.take_along_axis(alpha, idx_last[:, None], 1)[:, 0]
+        a_label = jnp.take_along_axis(
+            alpha, jnp.maximum(idx_last - 1, 0)[:, None], 1)[:, 0]
+        a_label = jnp.where(llen > 0, a_label, NEG)
+        loss = -jnp.logaddexp(a_blank, a_label)
+        if norm_by_times:
+            loss = loss / ilen.astype(loss.dtype)
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(llen, 1).astype(loss.dtype))
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+
+    return apply_op(f, log_probs, labels, input_lengths, label_lengths,
+                    op_name="ctc_loss")
 
 
 # ---------------------------------------------------------------------------
@@ -1100,17 +1179,18 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
 
 
 def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
-    if return_mask:
-        raise NotImplementedError("adaptive_max_pool1d(return_mask=True)")
-
     def f(a):
         n, c, l = a.shape
-        if l % output_size == 0:
-            return a.reshape(n, c, output_size, l // output_size).max(axis=-1)
         starts = [(i * l) // output_size for i in range(output_size)]
         ends = [-(-((i + 1) * l) // output_size) for i in range(output_size)]
-        return jnp.stack([a[:, :, st:en].max(axis=-1)
-                          for st, en in zip(starts, ends)], axis=-1)
+        pooled = jnp.stack([a[:, :, st:en].max(axis=-1)
+                            for st, en in zip(starts, ends)], axis=-1)
+        if not return_mask:
+            return pooled
+        # mask = index into the INPUT length dim (reference max_pool mask)
+        idx = jnp.stack([st + a[:, :, st:en].argmax(axis=-1)
+                         for st, en in zip(starts, ends)], axis=-1)
+        return pooled, idx.astype(jnp.int64)
 
     return apply_op(f, x, op_name="adaptive_max_pool1d")
 

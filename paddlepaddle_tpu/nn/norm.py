@@ -190,6 +190,57 @@ class LocalResponseNorm(Layer):
 
 
 class SpectralNorm(Layer):
-    def __init__(self, *a, **k):
+    """Spectral normalization: weight / sigma_max(weight), sigma estimated by
+    power iteration (reference: python/paddle/nn/layer/norm.py SpectralNorm —
+    forward(weight) returns the normalized weight; u/v are persistent
+    buffers updated without gradient each call)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 dtype="float32", name=None):
         super().__init__()
-        raise NotImplementedError("SpectralNorm lands with the GAN kit")
+        import numpy as _np
+
+        self.dim = int(dim)
+        self.power_iters = int(power_iters)
+        self.eps = float(eps)
+        self._shape = list(weight_shape)
+        h = self._shape[self.dim]
+        w = 1
+        for i, s in enumerate(self._shape):
+            if i != self.dim:
+                w *= s
+        rng = _np.random.default_rng(0)
+        from ..core.tensor import Tensor as _T
+
+        self.register_buffer("weight_u", _T(
+            (rng.standard_normal(h) / _np.sqrt(h)).astype(dtype)))
+        self.register_buffer("weight_v", _T(
+            (rng.standard_normal(w) / _np.sqrt(w)).astype(dtype)))
+
+    def forward(self, weight):
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.dispatch import apply_op
+
+        dim, iters, eps = self.dim, self.power_iters, self.eps
+
+        def f(w, u, v):
+            perm = [dim] + [i for i in range(w.ndim) if i != dim]
+            mat = jnp.transpose(w, perm).reshape(w.shape[dim], -1)  # [h, m]
+
+            def norm(x):
+                return x / (jnp.linalg.norm(x) + eps)
+
+            for _ in range(max(iters, 1)):
+                v = norm(jax.lax.stop_gradient(mat).T @ u)
+                u = norm(jax.lax.stop_gradient(mat) @ v)
+            sigma = u @ mat @ v
+            return w / sigma, u, v
+
+        out, u_new, v_new = apply_op(f, weight, self.weight_u, self.weight_v,
+                                     op_name="spectral_norm")
+        # buffer update (no grad): the reference's power-iteration state
+        self.weight_u._replace_data(jax.lax.stop_gradient(u_new._data))
+        self.weight_v._replace_data(jax.lax.stop_gradient(v_new._data))
+        return out

@@ -393,8 +393,6 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, 
         a = a.reshape(-1)
         axis = 0
     keep = np.ones(a.shape[axis], bool)
-    sl = [slice(None)] * a.ndim
-    prev = None
     vals = np.moveaxis(a, axis, 0)
     keep[1:] = np.any(vals[1:] != vals[:-1], axis=tuple(range(1, a.ndim)))
     out = np.compress(keep, a, axis=axis)

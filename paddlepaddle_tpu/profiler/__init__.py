@@ -68,11 +68,21 @@ class RecordEvent:
         self._t0 = None
 
     def begin(self):
+        from ..distributed import comm_task as _ct
+
         self._ann = jax.profiler.TraceAnnotation(self.name)
         self._ann.__enter__()
         self._t0 = time.perf_counter()
+        # registered in the comm-task registry so a watchdog timeout names
+        # the active region (CommTaskManager-style attribution)
+        self._task = _ct.begin_task(self.name, group="region")
 
     def end(self):
+        from ..distributed import comm_task as _ct
+
+        if getattr(self, "_task", None) is not None:
+            _ct.end_task(self._task)
+            self._task = None
         if self._t0 is not None:
             stats = _event_stats[self.name]
             stats[0] += 1

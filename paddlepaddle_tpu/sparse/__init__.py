@@ -145,6 +145,71 @@ def masked_matmul(x, y, mask: SparseCooTensor):
         jsparse.BCOO((vals, idx), shape=coo.shape))
 
 
+def subtract(x, y):
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        neg_y = jsparse.BCOO((-y._coo().data, y._coo().indices),
+                             shape=y._coo().shape)
+        return SparseCooTensor._from_bcoo(x._coo() + neg_y)
+    return Tensor._from_data(to_dense(x)._data - to_dense(y)._data)
+
+
+def multiply(x, y):
+    """Elementwise; sparse*sparse via dense (values align only if patterns
+    match — the reference densifies for mismatched patterns too)."""
+    return Tensor._from_data(to_dense(x)._data * to_dense(y)._data)
+
+
+def divide(x, y):
+    return Tensor._from_data(to_dense(x)._data / to_dense(y)._data)
+
+
+def mv(x, vec):
+    """sparse [m, n] @ dense [n] -> dense [m]."""
+    v = vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    return Tensor._from_data(x._coo() @ v)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    """beta*input + alpha*(x @ y), x sparse (reference sparse/binary.py)."""
+    prod = x._coo() @ (y._data if isinstance(y, Tensor) else jnp.asarray(y))
+    inp = input._data if isinstance(input, Tensor) else jnp.asarray(input)
+    return Tensor._from_data(beta * inp + alpha * prod)
+
+
+def _unary(np_name):
+    jfn = getattr(jnp, np_name)
+
+    def op(x):
+        if isinstance(x, SparseCooTensor):
+            coo = x._coo()
+            return SparseCooTensor._from_bcoo(
+                jsparse.BCOO((jfn(coo.data), coo.indices), shape=coo.shape))
+        return Tensor._from_data(jfn(unwrap(x)))
+
+    op.__name__ = np_name
+    op.__doc__ = f"Zero-preserving elementwise {np_name} on the stored values."
+    return op
+
+
+# the reference's sparse unary op set (python/paddle/sparse/unary.py) — all
+# zero-preserving, so they act on values only and keep the pattern
+sin = _unary("sin")
+tan = _unary("tan")
+asin = _unary("arcsin")
+atan = _unary("arctan")
+sinh = _unary("sinh")
+tanh = _unary("tanh")
+asinh = _unary("arcsinh")
+atanh = _unary("arctanh")
+sqrt = _unary("sqrt")
+square = _unary("square")
+log1p = _unary("log1p")
+abs = _unary("abs")
+expm1 = _unary("expm1")
+neg = _unary("negative")
+sign = _unary("sign")
+
+
 def relu(x):
     if isinstance(x, SparseCooTensor):
         coo = x._coo()
@@ -153,11 +218,136 @@ def relu(x):
     return Tensor._from_data(jax.nn.relu(unwrap(x)))
 
 
-class nn:  # namespace parity: paddle.sparse.nn
+def relu6(x):
+    coo = x._coo()
+    return SparseCooTensor._from_bcoo(
+        jsparse.BCOO((jnp.clip(jax.nn.relu(coo.data), 0, 6), coo.indices),
+                     shape=coo.shape))
+
+
+def leaky_relu(x, negative_slope=0.01):
+    coo = x._coo()
+    return SparseCooTensor._from_bcoo(
+        jsparse.BCOO((jax.nn.leaky_relu(coo.data, negative_slope),
+                      coo.indices), shape=coo.shape))
+
+
+def pow(x, factor):
+    coo = x._coo()
+    return SparseCooTensor._from_bcoo(
+        jsparse.BCOO((coo.data ** factor, coo.indices), shape=coo.shape))
+
+
+def scale(x, scale_val, bias=0.0, bias_after_scale=True):
+    coo = x._coo()
+    d = coo.data * scale_val + bias if bias_after_scale else (
+        coo.data + bias) * scale_val
+    return SparseCooTensor._from_bcoo(
+        jsparse.BCOO((d, coo.indices), shape=coo.shape))
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    coo = x._coo()
+    from ..core.dtype import convert_dtype
+
+    data = coo.data if value_dtype is None else coo.data.astype(
+        convert_dtype(value_dtype))
+    idx = coo.indices if index_dtype is None else coo.indices.astype(
+        convert_dtype(index_dtype))
+    return SparseCooTensor._from_bcoo(
+        jsparse.BCOO((data, idx), shape=coo.shape))
+
+
+def transpose(x, perm):
+    coo = x._coo()
+    return SparseCooTensor._from_bcoo(coo.transpose(tuple(perm)))
+
+
+def reshape(x, shape):
+    coo = x._coo()
+    return SparseCooTensor._from_bcoo(coo.reshape(tuple(int(s) for s in shape)))
+
+
+def coalesce(x):
+    """Merge duplicate indices (reference sparse_coo_tensor semantics)."""
+    coo = x._coo().sum_duplicates()
+    return SparseCooTensor._from_bcoo(coo)
+
+
+def nnz(x):
+    return int(x._coo().nse)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False):
+    dense = jnp.asarray(to_dense(x)._data)
+    out = jnp.sum(dense, axis=axis, keepdims=keepdim)
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+
+        out = out.astype(convert_dtype(dtype))
+    return Tensor._from_data(out)
+
+
+def softmax(x, axis=-1):
+    """Softmax over the stored values per row, zeros stay zero (reference
+    sparse softmax semantics: normalize within each row's nnz)."""
+    coo = x._coo().sum_duplicates()
+    if len(coo.shape) != 2 or axis not in (-1, 1):
+        raise ValueError("sparse softmax supports 2-D tensors over axis=-1")
+    rows = coo.indices[:, 0]
+    data = coo.data
+    n_rows = coo.shape[0]
+    row_max = jnp.full((n_rows,), -jnp.inf, data.dtype).at[rows].max(data)
+    ex = jnp.exp(data - row_max[rows])
+    row_sum = jnp.zeros((n_rows,), data.dtype).at[rows].add(ex)
+    out = ex / row_sum[rows]
+    return SparseCooTensor._from_bcoo(
+        jsparse.BCOO((out, coo.indices), shape=coo.shape))
+
+
+def mask_as(x, mask: SparseCooTensor):
+    """Sample dense ``x`` at ``mask``'s sparsity pattern."""
+    dense = jnp.asarray(unwrap(x))
+    coo = mask._coo()
+    idx = coo.indices
+    vals = dense[tuple(idx[:, d] for d in range(idx.shape[1]))]
+    return SparseCooTensor._from_bcoo(
+        jsparse.BCOO((vals, idx), shape=coo.shape))
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)
+
+
+def _to_sparse_coo(self, sparse_dim=None):
+    return SparseCooTensor._from_bcoo(jsparse.BCOO.fromdense(self._data))
+
+
+Tensor.to_sparse_coo = _to_sparse_coo
+
+
+class _UnaryLayer:
+    def __init__(self, fn, **kw):
+        self._fn = fn
+        self._kw = kw
+
+    def __call__(self, x):
+        return self._fn(x, **self._kw)
+
+
+class nn:  # namespace parity: paddle.sparse.nn (layer wrappers)
     @staticmethod
     def ReLU():
-        class _R:
-            def __call__(self, x):
-                return relu(x)
+        return _UnaryLayer(relu)
 
-        return _R()
+    @staticmethod
+    def ReLU6():
+        return _UnaryLayer(relu6)
+
+    @staticmethod
+    def LeakyReLU(negative_slope=0.01):
+        return _UnaryLayer(leaky_relu, negative_slope=negative_slope)
+
+    @staticmethod
+    def Softmax(axis=-1):
+        return _UnaryLayer(softmax, axis=axis)

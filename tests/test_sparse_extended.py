@@ -1,0 +1,83 @@
+"""Extended paddle.sparse surface (reference: python/paddle/sparse/ —
+unary zero-preserving ops, binary ops, spmm/sddmm/mv/addmm, softmax,
+transpose/reshape/coalesce, nn layer wrappers)."""
+
+import numpy as np
+
+import paddlepaddle_tpu as paddle
+from paddlepaddle_tpu import sparse as sp
+
+D = np.array([[0, 2.0, 0], [3.0, 0, 4.0]], np.float32)
+
+
+def _x():
+    return paddle.to_tensor(D.copy()).to_sparse_coo()
+
+
+def test_unary_zero_preserving():
+    x = _x()
+    mask = (D != 0)
+    for name in ("sin", "tanh", "sqrt", "square", "log1p", "abs", "expm1",
+                 "neg", "sign"):
+        out = getattr(sp, name)(x)
+        ref = getattr(np, {"neg": "negative"}.get(name, name))(D) * mask
+        np.testing.assert_allclose(out.to_dense().numpy(), ref, rtol=1e-6,
+                                   err_msg=name)
+        assert sp.nnz(out) == sp.nnz(x)  # pattern preserved
+
+
+def test_binary_and_matmul():
+    x = _x()
+    np.testing.assert_allclose(sp.add(x, x).to_dense().numpy(), 2 * D)
+    np.testing.assert_allclose(sp.subtract(x, x).to_dense().numpy(), 0 * D)
+    np.testing.assert_allclose(sp.multiply(x, x).numpy(), D * D)
+    y = np.ones((3, 2), np.float32)
+    np.testing.assert_allclose(sp.matmul(x, paddle.to_tensor(y)).numpy(), D @ y)
+    v = np.array([1.0, 2.0, 3.0], np.float32)
+    np.testing.assert_allclose(sp.mv(x, v).numpy(), D @ v)
+    i = np.ones((2, 2), np.float32)
+    np.testing.assert_allclose(
+        sp.addmm(paddle.to_tensor(i), x, paddle.to_tensor(y),
+                 beta=0.5, alpha=2.0).numpy(), 0.5 * i + 2.0 * (D @ y))
+
+
+def test_sddmm_and_mask_as():
+    x = _x()
+    a = np.random.default_rng(0).standard_normal((2, 4)).astype(np.float32)
+    b = np.random.default_rng(1).standard_normal((4, 3)).astype(np.float32)
+    out = sp.masked_matmul(paddle.to_tensor(a), paddle.to_tensor(b), x)
+    np.testing.assert_allclose(out.to_dense().numpy(), (a @ b) * (D != 0),
+                               rtol=1e-5)
+    np.testing.assert_allclose(
+        sp.mask_as(paddle.to_tensor(D * 3), x).to_dense().numpy(), 3 * D)
+
+
+def test_softmax_rows():
+    sm = sp.softmax(_x()).to_dense().numpy()
+    np.testing.assert_allclose(sm[0, 1], 1.0)  # single-nnz row
+    np.testing.assert_allclose(sm[1, 0] + sm[1, 2], 1.0)
+    assert sm[0, 0] == sm[0, 2] == 0.0  # zeros stay zero
+
+
+def test_layout_ops():
+    x = _x()
+    np.testing.assert_allclose(sp.transpose(x, [1, 0]).to_dense().numpy(), D.T)
+    np.testing.assert_allclose(
+        sp.reshape(x, [3, 2]).to_dense().numpy(), D.reshape(3, 2))
+    np.testing.assert_allclose(sp.sum(x, axis=1).numpy(), D.sum(1))
+    assert sp.nnz(sp.coalesce(x)) == 3
+    assert sp.is_same_shape(x, _x())
+    c = sp.cast(x, value_dtype="float64")
+    assert str(c.dtype) == "float64"
+
+
+def test_csr_roundtrip_and_nn():
+    crows = np.array([0, 1, 3])
+    cols = np.array([1, 0, 2])
+    vals = np.array([2.0, 3.0, 4.0], np.float32)
+    x = sp.sparse_csr_tensor(crows, cols, vals, shape=[2, 3])
+    np.testing.assert_allclose(x.to_dense().numpy(), D)
+    out = sp.nn.ReLU()(x)
+    np.testing.assert_allclose(out.to_dense().numpy(), np.maximum(D, 0))
+    out6 = sp.nn.ReLU6()(sp.scale(x, 4.0))
+    assert out6.to_dense().numpy().max() <= 6.0

@@ -20,6 +20,30 @@ def test_watchdog_fires_on_hang():
     assert fired and fired[0][0] == "slow_step"
 
 
+def test_watchdog_attributes_in_flight_collective(capsys):
+    """Timeout names the exact op + group in flight (CommTaskManager
+    semantics, comm_task_manager.cc:273), not just a stack dump."""
+    from paddlepaddle_tpu.distributed.comm_task import comm_task
+    from paddlepaddle_tpu.distributed.watchdog import Watchdog
+    from paddlepaddle_tpu.profiler import RecordEvent
+
+    wd = Watchdog(timeout=0.3, poll_interval=0.05, abort=False)
+    with wd:
+        with wd.step("hung_step"):
+            with RecordEvent("forward"), comm_task("store.get('peer/0')",
+                                                   group="dcn"):
+                time.sleep(0.8)
+    err = capsys.readouterr().err
+    assert "store.get('peer/0')" in err and "group=dcn" in err
+    assert "forward" in err and "group=region" in err
+    # programmatic snapshot for on_timeout consumers
+    names = [t[0] for t in wd.last_in_flight]
+    assert "store.get('peer/0')" in names and "forward" in names
+    # registry drains once the ops retire
+    from paddlepaddle_tpu.distributed.comm_task import in_flight
+    assert in_flight() == []
+
+
 def test_watchdog_quiet_on_fast_steps():
     from paddlepaddle_tpu.distributed.watchdog import Watchdog
 

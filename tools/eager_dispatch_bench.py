@@ -1,0 +1,64 @@
+"""Eager per-op dispatch micro-bench (VERDICT weak #8).
+
+Measures the cost of one eager op round-trip through core/dispatch.apply_op
+(unwrap -> amp hook -> jax.vjp capture -> wrap) against (a) raw jnp dispatch
+and (b) the same chain of ops under jit — quantifying exactly what moving a
+hot loop under jit/TrainStep buys. Run on any backend:
+  python tools/eager_dispatch_bench.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddlepaddle_tpu as paddle
+
+
+def _rate(fn, warmup=20, iters=500):
+    for _ in range(warmup):
+        out = fn()
+    jax.block_until_ready(out._data if hasattr(out, "_data") else out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    jax.block_until_ready(out._data if hasattr(out, "_data") else out)
+    return iters / (time.perf_counter() - t0)
+
+
+def main():
+    x = paddle.to_tensor(np.ones((128, 128), np.float32))
+    x.stop_gradient = False
+    y = paddle.to_tensor(np.ones((128, 128), np.float32))
+    xj = jnp.ones((128, 128), jnp.float32)
+
+    # one eager op: dispatch + vjp capture + tensor wrap
+    eager_ops = _rate(lambda: (x * y + y).tanh())  # 3 taped ops
+    with paddle.no_grad():
+        eager_nograd = _rate(lambda: (x * y + y).tanh())
+    raw = _rate(lambda: jnp.tanh(xj * xj + xj))
+
+    chain = jax.jit(lambda a, b: jnp.tanh(a * b + b))
+    jitted = _rate(lambda: chain(xj, xj))
+
+    out = {
+        "eager_3op_chains_per_sec": round(eager_ops, 1),
+        "eager_nograd_chains_per_sec": round(eager_nograd, 1),
+        "raw_jnp_chains_per_sec": round(raw, 1),
+        "jit_chains_per_sec": round(jitted, 1),
+        "tape_overhead_x": round(raw / eager_ops, 2),
+        "jit_speedup_over_eager_x": round(jitted / eager_ops, 2),
+        "device": str(jax.devices()[0].device_kind),
+    }
+    print(json.dumps({"eager_dispatch_bench": out}))
+
+
+if __name__ == "__main__":
+    main()
