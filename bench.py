@@ -119,7 +119,7 @@ def _bench_moe(peak, on_accel):
                     num_hidden_layers=8, num_attention_heads=16,
                     num_key_value_heads=8, num_experts=16,
                     num_experts_per_tok=2, max_position_embeddings=2048,
-                    dtype="bfloat16")
+                    dtype="bfloat16", dispatch_mode="sorted")  # 1-chip perf path
     model = MoEForCausalLM(cfg)
     opt = AdamW(learning_rate=1e-4, parameters=model.parameters(),
                 multi_precision=True)

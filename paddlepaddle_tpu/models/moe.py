@@ -36,6 +36,9 @@ class MoEConfig:
     rope_theta: float = 10000.0
     aux_loss_weight: float = 0.01
     dtype: str = "float32"
+    # "einsum" (GShard one-hot, cleanest ep-sharded SPMD lowering; default) |
+    # "sorted" (fused-MoE style, single-chip perf) — see parallel.moe.MoELayer
+    dispatch_mode: str = "einsum"
 
     def as_llama(self) -> LlamaConfig:
         return LlamaConfig(
@@ -70,7 +73,8 @@ class MoEDecoderLayer(Layer):
         self.mlp = MoELayer(
             config.hidden_size, config.intermediate_size, config.num_experts,
             gate=gate_cls(config.hidden_size, config.num_experts),
-            capacity_factor=config.capacity_factor)
+            capacity_factor=config.capacity_factor,
+            dispatch_mode=config.dispatch_mode)
         self.shared_mlp = None
         if config.num_shared_experts > 0:
             import dataclasses

@@ -73,3 +73,56 @@ def test_moe_expert_parallel_sharded():
     assert losses[-1] < losses[0]
     name = next(n for n in step.params if n.endswith("w_gate_proj"))
     assert not step.params[name].sharding.is_fully_replicated
+
+
+def test_sorted_dispatch_matches_einsum():
+    """The fused-MoE-style sorted path (fused_moe.py analogue) is numerically
+    identical to the GShard einsum path when capacity is ample, for both
+    top-2 (renormalized gates) and top-1 (raw Switch probability)."""
+    from paddlepaddle_tpu.parallel.moe import GShardGate
+
+    x = np.random.default_rng(0).standard_normal((2, 8, 16)).astype(np.float32)
+    for gate_cls, name in ((GShardGate, "top2"), (SwitchGate, "top1")):
+        paddle.seed(3)
+        m_s = MoELayer(16, 32, 4, gate=gate_cls(16, 4), capacity_factor=8.0,
+                       dispatch_mode="sorted")
+        paddle.seed(3)
+        m_e = MoELayer(16, 32, 4, gate=gate_cls(16, 4), capacity_factor=8.0,
+                       dispatch_mode="einsum")
+        for (_, p1), (_, p2) in zip(sorted(m_s.raw_state().items()),
+                                    sorted(m_e.raw_state().items())):
+            p2._replace_data(p1._data)
+        ys, ye = m_s(x), m_e(x)
+        np.testing.assert_allclose(ys.numpy(), ye.numpy(), atol=1e-5,
+                                   err_msg=name)
+        # aux-loss normalization matches across modes too
+        np.testing.assert_allclose(float(m_s.l_aux.numpy()),
+                                   float(m_e.l_aux.numpy()), rtol=0.5)
+
+    # router gradient flows through the gate weight in sorted mode (the
+    # top-1 case must use the raw probability, not a renormalized ~1.0)
+    m = MoELayer(16, 32, 4, gate=SwitchGate(16, 4), capacity_factor=8.0,
+                 dispatch_mode="sorted")
+    xt = paddle.to_tensor(x, stop_gradient=False)
+    m(xt).sum().backward()
+    g = m.gate.weight.grad
+    assert g is not None and np.abs(g.numpy()).sum() > 1e-6
+
+
+def test_sorted_dispatch_honors_custom_gate_by_fallback():
+    """A gate overriding routing() keeps its behavior (einsum fallback)."""
+    from paddlepaddle_tpu.parallel.moe import NaiveGate
+
+    calls = []
+
+    class MyGate(NaiveGate):
+        def routing(self, x_flat, capacity):
+            calls.append(1)
+            return super().routing(x_flat, capacity)
+
+    m = MoELayer(16, 32, 4, gate=MyGate(16, 4), dispatch_mode="sorted")
+    m(np.random.default_rng(0).standard_normal((1, 4, 16)).astype(np.float32))
+    assert calls  # custom routing ran
+
+    with pytest.raises(ValueError, match="dispatch_mode"):
+        MoELayer(16, 32, 4, dispatch_mode="Sorted")
