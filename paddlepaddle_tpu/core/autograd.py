@@ -97,18 +97,22 @@ class GradNode:
         "released",
         "pure_fn",
         "out_treedef",
+        "primal_data",
     )
 
     def __init__(self, name, vjp_fn, inputs, out_avals, pure_fn=None,
-                 out_treedef=None):
+                 out_treedef=None, primal_data=None):
         self.name = name
-        self.vjp_fn = vjp_fn
+        self.vjp_fn = vjp_fn  # None => built lazily from pure_fn at backward
         self.inputs: Tuple[Any, ...] = inputs
         self.out_avals = out_avals  # list of (shape, dtype) per output
         self.out_grads: List[Optional[jnp.ndarray]] = [None] * len(out_avals)
         self.released = False
         self.pure_fn = pure_fn
         self.out_treedef = out_treedef
+        # the forward-time input ARRAYS (immutable), so lazy vjp recompute is
+        # immune to later in-place updates of the input tensors
+        self.primal_data = primal_data
 
     def accumulate(self, index: int, grad):
         cur = self.out_grads[index]
@@ -125,6 +129,7 @@ class GradNode:
     def release(self):
         self.vjp_fn = None
         self.pure_fn = None
+        self.primal_data = None
         self.out_grads = [None] * len(self.out_avals)
         self.released = True
 
@@ -243,7 +248,14 @@ def run_backward(
         """Run a node's backward; in create_graph mode this is ITSELF a taped
         op over (primal inputs, cotangent tensors)."""
         if not create_graph:
-            return node.vjp_fn(node.materialized_out_grads())
+            cts = node.materialized_out_grads()
+            if node.vjp_fn is not None:  # e.g. PyLayer's explicit backward
+                return node.vjp_fn(cts)
+            # lazy path: linearize the recorded pure fn now (forward was run
+            # trace-free at dispatch time — tools/eager_dispatch_bench.py)
+            _, vjp = jax.vjp(node.pure_fn, *node.primal_data)
+            return vjp(jax.tree_util.tree_unflatten(node.out_treedef,
+                                                    list(cts)))
         cts = []
         for (shape, dtype), g in zip(node.out_avals, node.out_grads):
             if g is None:
@@ -258,6 +270,19 @@ def run_backward(
                 None if g is None else Tensor._from_data(g, stop_gradient=True)
                 for g in raw)
         from .dispatch import apply_op
+
+        # the taped backward differentiates at the CURRENT tensor values;
+        # if an input was overwritten since forward (set_value / inplace),
+        # that silently disagrees with the recorded computation — refuse,
+        # like the reference's inplace version-counter check
+        if node.primal_data is not None:
+            for t, pd in zip(node.inputs, node.primal_data):
+                if t._data is not pd:
+                    raise RuntimeError(
+                        f"create_graph=True backward through {node.name!r}: "
+                        "an input tensor was modified in place after the "
+                        "forward pass; higher-order gradients would be "
+                        "computed against the new value")
 
         n_in = len(node.inputs)
         pure_fn, treedef = node.pure_fn, node.out_treedef

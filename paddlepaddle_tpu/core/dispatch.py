@@ -85,18 +85,24 @@ def apply_op(fn: Callable, *args, op_name: str = None, **kwargs) -> Any:
             vals[p] = v
         return run(vals)
 
-    primal_out, vjp_fn = jax.vjp(pure, *[datas[p] for p in diff_pos])
+    # LAZY vjp: running the op directly skips jax.vjp's per-call tracing
+    # (~80x of eager dispatch cost, tools/eager_dispatch_bench.py); the node
+    # keeps the pure fn + primal ARRAYS (immutable — safe against set_value
+    # on the input tensors) and backward linearizes on demand.
+    primal_data = tuple(datas[p] for p in diff_pos)
+    primal_out = run(datas)
 
     out_leaves, out_treedef = jax.tree_util.tree_flatten(primal_out)
     if _op_observer is not None:
         _op_observer(name, out_leaves)
     node = ag.GradNode(
         name,
-        lambda cts: vjp_fn(jax.tree_util.tree_unflatten(out_treedef, list(cts))),
+        None,                   # vjp built lazily from pure_fn at backward
         tuple(leaves[p] for p in diff_pos),
         [(tuple(o.shape), o.dtype) for o in out_leaves],
-        pure_fn=pure,           # lets create_graph=True re-tape this op's vjp
+        pure_fn=pure,           # also lets create_graph=True re-tape the vjp
         out_treedef=out_treedef,
+        primal_data=primal_data,
     )
     wrapped = []
     for i, o in enumerate(out_leaves):

