@@ -62,12 +62,14 @@ from .ops import comparison as _cmp  # noqa: F401
 from .ops import creation as _creation
 from .ops import extras as _extras
 from .ops import linalg as _linalg
+from .ops import longtail as _longtail
 from .ops import manipulation as _manip
 from .ops import math as _math
 from .ops import reduction as _reduction
 from .ops import search as _search
 
-_OP_MODULES = (_creation, _math, _reduction, _manip, _cmp, _linalg, _search, _extras)
+_OP_MODULES = (_creation, _math, _reduction, _manip, _cmp, _linalg, _search,
+               _extras, _longtail)
 _globals = globals()
 for _mod in _OP_MODULES:
     for _name in dir(_mod):
@@ -131,3 +133,195 @@ def is_grad_enabled():
     from .core.autograd import is_grad_enabled as _ige
 
     return _ige()
+
+
+# ---------------------------------------------------------------------------
+# top-level namespace tail: constants, dtype inspectors, inplace variants
+# (reference python/paddle/__init__.py exports)
+# ---------------------------------------------------------------------------
+
+import math as _py_math
+
+import numpy as _np_mod
+
+pi = _py_math.pi
+e = _py_math.e
+inf = float("inf")
+nan = float("nan")
+newaxis = None
+
+finfo = _np_mod.finfo
+iinfo = _np_mod.iinfo
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np_mod.set_printoptions(**kw)
+
+
+from .nn.initializer import ParamAttr  # noqa: E402,F401
+from .ops.longtail import (  # noqa: E402,F401
+    binomial,
+    cartesian_prod,
+    column_stack,
+    combinations,
+    dstack,
+    from_dlpack,
+    hstack,
+    log_normal,
+    pdist,
+    renorm,
+    row_stack,
+    standard_gamma,
+    to_dlpack,
+    vecdot,
+    vstack,
+)
+
+
+class LazyGuard:
+    """Deferred-init guard (reference framework LazyGuard): parameters here
+    initialize eagerly, so the guard is a no-op context."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class CUDAPinnedPlace:
+    """Accepted for API parity; host memory is always pinned-equivalent."""
+
+
+def get_cuda_rng_state():
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    set_rng_state(state)
+
+
+def disable_signal_handler():
+    """No native signal handlers are installed; kept for parity."""
+
+
+def check_shape(shape):
+    for s in list(shape):
+        if s is not None and int(s) < -1:
+            raise ValueError(f"invalid dim {s} in shape {shape}")
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """APPROXIMATE FLOPs: 2 x parameter count (one MAC per weight per
+    sample). The reference's per-operator counting (paddle.flops) is not
+    reproduced; use the profiler for measured compute."""
+    import builtins
+
+    if print_detail:
+        from .hapi.summary import summary as _summary
+
+        try:
+            _summary(net, input_size)
+        except Exception:
+            pass
+    total = builtins.sum(int(_np_mod.prod(p.shape)) for p in net.parameters())
+    return total * 2
+
+
+# the inplace-wrapper factory lives in nn.functional (_inplace); reuse it so
+# in-place semantics have exactly one implementation
+from .nn.functional import _inplace as _make_inplace  # noqa: E402
+
+# NOTE: random-fill ops (normal_, log_normal_, bernoulli_, cauchy_,
+# geometric_) are NOT generated from their sampling functions — paddle's
+# in-place fills take distribution PARAMS, not the tensor, as arguments.
+_INPLACE_NAMES = [
+    "acos", "addmm", "atan", "bitwise_and", "bitwise_invert",
+    "bitwise_left_shift", "bitwise_not", "bitwise_or", "bitwise_right_shift",
+    "bitwise_xor", "cast", "copysign", "cumprod", "cumsum",
+    "digamma", "equal", "erf", "expm1", "flatten", "floor_divide",
+    "floor_mod", "frac", "gammainc", "gammaincc", "gammaln", "gcd",
+    "greater_equal", "greater_than", "hypot", "i0", "lcm",
+    "ldexp", "less", "less_equal", "less_than", "lgamma", "log", "log10",
+    "log2", "logical_and", "logical_not", "logical_or",
+    "logit", "masked_fill", "masked_scatter", "mod", "multigammaln",
+    "nan_to_num", "polygamma", "renorm", "sinc", "sinh", "square",
+    "squeeze", "t", "tan", "transpose", "tril", "triu", "trunc", "unsqueeze",
+]
+for _n in _INPLACE_NAMES:
+    _fn = _globals.get(_n)
+    if _fn is not None and callable(_fn) and _n + "_" not in _globals:
+        _globals[_n + "_"] = _make_inplace(_fn)
+del _n, _fn
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    """In-place fill with N(mean, std) samples (reference normal_)."""
+    import jax
+
+    from .core import random as _prandom
+
+    vals = mean + std * jax.random.normal(_prandom.next_key(),
+                                          tuple(x.shape))
+    x._replace_data(vals.astype(x._data.dtype))
+    return x
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    """In-place fill with LogNormal(mean, std) samples."""
+    import jax
+    import jax.numpy as _jnp
+
+    from .core import random as _prandom
+
+    vals = _jnp.exp(mean + std * jax.random.normal(_prandom.next_key(),
+                                                   tuple(x.shape)))
+    x._replace_data(vals.astype(x._data.dtype))
+    return x
+
+
+def bernoulli_(x, p=0.5, name=None):
+    """In-place fill with Bernoulli(p) samples."""
+    import jax
+
+    from .core import random as _prandom
+
+    vals = jax.random.bernoulli(_prandom.next_key(), p, tuple(x.shape))
+    x._replace_data(vals.astype(x._data.dtype))
+    return x
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    """In-place fill with Cauchy samples (reference tensor.random cauchy_)."""
+    import jax
+    import jax.numpy as _jnp
+
+    from .core import random as _prandom
+
+    u = jax.random.uniform(_prandom.next_key(), tuple(x.shape))
+    vals = loc + scale * _jnp.tan(_jnp.pi * (u - 0.5))
+    x._replace_data(vals.astype(x._data.dtype))
+    return x
+
+
+def geometric_(x, probs, name=None):
+    """In-place fill with Geometric samples (reference geometric_)."""
+    import jax
+
+    from .core import random as _prandom
+
+    g = jax.random.geometric(_prandom.next_key(), probs, tuple(x.shape))
+    x._replace_data(g.astype(x._data.dtype))
+    return x

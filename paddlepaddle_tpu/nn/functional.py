@@ -536,6 +536,11 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     ks = _tup(kernel_size, 2)
     st = _tup(stride if stride is not None else kernel_size, 2)
     p = _tup(padding, 2)
+    if return_mask:
+        if data_format != "NCHW" or ceil_mode:
+            raise NotImplementedError(
+                "max_pool2d(return_mask=True) supports NCHW, ceil_mode=False")
+        return _max_pool_mask(x, ks, st, p, 2)
 
     def f(a):
         window = (1, 1) + ks if data_format == "NCHW" else (1,) + ks + (1,)
@@ -571,7 +576,62 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     return apply_op(f, x, op_name="avg_pool2d")
 
 
+def _max_pool_mask(x, ks, st, p, nd):
+    """(pooled, argmax-mask) via window patch extraction; mask indexes the
+    FLATTENED input spatial dims (the reference/torch unpool convention).
+    Padding is applied as -inf BEFORE patch extraction (the patch op itself
+    zero-pads, which would beat negative window maxima)."""
+
+    def f(a):
+        compute = a if jnp.issubdtype(a.dtype, jnp.floating) else (
+            a.astype(jnp.float32))
+        if any(p):
+            # patch extraction is a one-hot convolution: -inf would produce
+            # -inf*0 = NaN, so pad with a huge finite negative instead
+            neg = jnp.asarray(jnp.finfo(compute.dtype).min / 2, compute.dtype)
+            compute = jnp.pad(
+                compute, [(0, 0), (0, 0)] + [(pp, pp) for pp in p],
+                constant_values=neg)
+        patches = jax.lax.conv_general_dilated_patches(
+            compute, filter_shape=list(ks), window_strides=list(st),
+            padding=[(0, 0)] * nd)
+        n = a.shape[0]
+        c = a.shape[1]
+        out_sp = patches.shape[2:]
+        kprod = 1
+        for k in ks:
+            kprod *= k
+        pat = patches.reshape((n, c, kprod) + out_sp)
+        pooled = pat.max(axis=2).astype(a.dtype)
+        widx = pat.argmax(axis=2)                       # window-local
+        # window-local -> global flattened UNPADDED spatial index
+        in_sp = a.shape[2:]
+        coords = []
+        rem = widx
+        for d in range(nd - 1, -1, -1):
+            coords.insert(0, rem % ks[d])
+            rem = rem // ks[d]
+        glob = 0
+        for d in range(nd):
+            osz = out_sp[d]
+            oidx = jnp.arange(osz).reshape(
+                (1, 1) + (1,) * d + (osz,) + (1,) * (nd - 1 - d))
+            start = oidx * st[d] - p[d]
+            gd = jnp.clip(start + coords[d], 0, in_sp[d] - 1)
+            glob = glob * in_sp[d] + gd
+        return pooled, glob.astype(jnp.int64)
+
+    return apply_op(f, x, op_name="max_pool_mask")
+
+
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, name=None):
+    if return_mask:
+        if ceil_mode:
+            raise NotImplementedError(
+                "max_pool1d(return_mask=True) supports ceil_mode=False")
+        ks = (_tup(kernel_size, 1)[0],)
+        st = (_tup(stride if stride is not None else kernel_size, 1)[0],)
+        return _max_pool_mask(x, ks, st, (_tup(padding, 1)[0],), 1)
     x4 = x.unsqueeze(2)
     out = max_pool2d(x4, (1, _tup(kernel_size, 1)[0]), (1, _tup(stride if stride is not None else kernel_size, 1)[0]),
                      (0, _tup(padding, 1)[0]))
@@ -1119,6 +1179,11 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     k = _tup(kernel_size, 3)
     s = _tup(stride if stride is not None else kernel_size, 3)
     p = _tup(padding, 3)
+    if return_mask:
+        if data_format != "NCDHW" or ceil_mode:
+            raise NotImplementedError(
+                "max_pool3d(return_mask=True) supports NCDHW, ceil_mode=False")
+        return _max_pool_mask(x, k, s, p, 3)
 
     def f(a):
         init = (jnp.asarray(-jnp.inf, a.dtype)
@@ -1391,3 +1456,64 @@ def dice_loss(input, label, epsilon=1e-5, name=None):
         return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
 
     return apply_op(f, input, label, op_name="dice_loss")
+
+
+# ---------------------------------------------------------------------------
+# long-tail functional surface (losses, unpool/LP/fractional pools, packed
+# flash entries, decode helpers) — implementations in nn/long_tail.py
+# ---------------------------------------------------------------------------
+
+from .long_tail import (  # noqa: E402,F401
+    adaptive_log_softmax_with_loss,
+    adaptive_max_pool3d,
+    class_center_sample,
+    feature_alpha_dropout,
+    flash_attn_qkvpacked,
+    flash_attn_varlen_qkvpacked,
+    flashmask_attention,
+    fractional_max_pool2d,
+    fractional_max_pool3d,
+    gather_tree,
+    gaussian_nll_loss,
+    hsigmoid_loss,
+    lp_pool1d,
+    lp_pool2d,
+    margin_cross_entropy,
+    max_unpool1d,
+    max_unpool2d,
+    max_unpool3d,
+    multi_label_soft_margin_loss,
+    multi_margin_loss,
+    npair_loss,
+    poisson_nll_loss,
+    rnnt_loss,
+    soft_margin_loss,
+    sparse_attention,
+    triplet_margin_with_distance_loss,
+)
+
+
+def _inplace(fn):
+    """paddle's trailing-underscore inplace activations: compute then
+    overwrite the input tensor's storage, returning it."""
+
+    def op(x, *a, **k):
+        out = fn(x, *a, **k)
+        from ..core.tensor import Tensor
+
+        if isinstance(x, Tensor):
+            x._replace_data(out._data)
+            return x
+        return out
+
+    op.__name__ = fn.__name__ + "_"
+    return op
+
+
+relu_ = _inplace(relu)
+tanh_ = _inplace(tanh)
+softmax_ = _inplace(softmax)
+elu_ = _inplace(elu)
+hardtanh_ = _inplace(hardtanh)
+leaky_relu_ = _inplace(leaky_relu)
+thresholded_relu_ = _inplace(thresholded_relu)

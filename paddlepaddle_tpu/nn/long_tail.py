@@ -1,0 +1,1104 @@
+"""nn long tail: remaining reference losses, pools, shuffles, wrappers.
+
+Reference surface: the python/paddle/nn/__init__.py exports not covered by
+the core passes — loss layers (loss.py), unpool/LP/fractional pools
+(pooling.py), pixel/channel shuffles (vision.py), pad/unflatten containers,
+and the qkv-packed flash attention entry points
+(nn/functional/flash_attention.py:700).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..core.dispatch import apply_op, unwrap
+from .layer import Layer
+
+__all__ = [
+    # functional
+    "gaussian_nll_loss", "poisson_nll_loss", "multi_margin_loss",
+    "soft_margin_loss", "triplet_margin_with_distance_loss",
+    "multi_label_soft_margin_loss", "npair_loss", "hsigmoid_loss",
+    "max_unpool1d", "max_unpool2d", "max_unpool3d", "lp_pool1d", "lp_pool2d",
+    "adaptive_max_pool3d", "fractional_max_pool2d", "fractional_max_pool3d",
+    "feature_alpha_dropout", "gather_tree", "margin_cross_entropy",
+    "class_center_sample", "flash_attn_qkvpacked",
+    "flash_attn_varlen_qkvpacked", "flashmask_attention", "sparse_attention",
+    "rnnt_loss", "adaptive_log_softmax_with_loss",
+    # layers
+    "CTCLoss", "PairwiseDistance", "GaussianNLLLoss", "PoissonNLLLoss",
+    "MultiMarginLoss", "SoftMarginLoss", "TripletMarginWithDistanceLoss",
+    "MultiLabelSoftMarginLoss", "HSigmoidLoss", "RNNTLoss",
+    "AdaptiveLogSoftmaxWithLoss", "ZeroPad1D", "ZeroPad2D", "ZeroPad3D", "Unflatten",
+    "ParameterDict", "PixelUnshuffle", "ChannelShuffle", "Fold",
+    "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D", "LPPool1D", "LPPool2D",
+    "FractionalMaxPool2D", "FractionalMaxPool3D", "Softmax2D",
+    "FeatureAlphaDropout", "UpsamplingBilinear2D", "UpsamplingNearest2D",
+    "AvgPool3D", "MaxPool3D", "AdaptiveAvgPool3D", "AdaptiveMaxPool1D",
+    "AdaptiveMaxPool3D", "Conv1DTranspose", "Conv3DTranspose",
+    "BeamSearchDecoder", "dynamic_decode",
+]
+
+
+def _reduce(v, reduction):
+    import jax.numpy as jnp
+
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+# ---------------------------------------------------------------------------
+# losses (reference python/paddle/nn/functional/loss.py)
+# ---------------------------------------------------------------------------
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    import jax.numpy as jnp
+
+    def f(mu, y, var):
+        var = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var) + (y - mu) ** 2 / var)
+        if full:
+            loss = loss + 0.5 * math.log(2 * math.pi)
+        return _reduce(loss, reduction)
+
+    return apply_op(f, input, label, variance, op_name="gaussian_nll_loss")
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    import jax.numpy as jnp
+
+    def f(x, y):
+        if log_input:
+            loss = jnp.exp(x) - y * x
+        else:
+            loss = x - y * jnp.log(x + epsilon)
+        if full:  # Stirling approximation for the y! term
+            stirling = y * jnp.log(y + 1e-30) - y + 0.5 * jnp.log(
+                2 * math.pi * jnp.maximum(y, 1.0))
+            loss = loss + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+
+    return apply_op(f, input, label, op_name="poisson_nll_loss")
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    import jax.numpy as jnp
+
+    return apply_op(
+        lambda x, y: _reduce(jnp.log1p(jnp.exp(-y * x)), reduction),
+        input, label, op_name="soft_margin_loss")
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    import jax.numpy as jnp
+
+    def f(x, y):
+        n, c = x.shape
+        correct = jnp.take_along_axis(x, y[:, None].astype(jnp.int32), 1)
+        m = jnp.maximum(margin - correct + x, 0.0) ** p
+        m = m * (1 - jax_one_hot(y, c, x.dtype))
+        return _reduce(m.sum(-1) / c, reduction)
+
+    def jax_one_hot(y, c, dt):
+        import jax
+
+        return jax.nn.one_hot(y.astype(jnp.int32), c, dtype=dt)
+
+    return apply_op(f, input, label, op_name="multi_margin_loss")
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    import jax
+    import jax.numpy as jnp
+
+    def f(x, y):
+        loss = -(y * jax.nn.log_sigmoid(x)
+                 + (1 - y) * jax.nn.log_sigmoid(-x))
+        return _reduce(loss.mean(-1), reduction)
+
+    return apply_op(f, input, label, op_name="multi_label_soft_margin_loss")
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    import jax.numpy as jnp
+
+    from . import functional as F
+
+    dist = distance_function or (
+        lambda a, b: F.pairwise_distance(a, b))
+    d_ap = unwrap(dist(input, positive))
+    d_an = unwrap(dist(input, negative))
+    if swap:
+        d_pn = unwrap(dist(positive, negative))
+        d_an = jnp.minimum(d_an, d_pn)
+    return apply_op(
+        lambda ap, an: _reduce(jnp.maximum(ap - an + margin, 0.0), reduction),
+        d_ap, d_an, op_name="triplet_margin_with_distance_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    import jax.numpy as jnp
+
+    def f(a, p, y):
+        sim = a @ p.T                                # [n, n]
+        same = (y[:, None] == y[None, :]).astype(a.dtype)
+        same = same / same.sum(-1, keepdims=True)
+        xent = (jax_logsumexp(sim) - (sim * same).sum(-1)).mean()
+        reg = l2_reg * ((a * a).sum(-1) + (p * p).sum(-1)).mean() * 0.25
+        return xent + reg
+
+    def jax_logsumexp(s):
+        import jax
+
+        return jax.scipy.special.logsumexp(s, axis=-1)
+
+    return apply_op(f, anchor, positive, labels, op_name="npair_loss")
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid over a complete binary tree (reference
+    loss.py hsigmoid_loss default-tree mode)."""
+    import jax
+    import jax.numpy as jnp
+
+    depth = max(1, math.ceil(math.log2(max(num_classes, 2))))
+
+    def f(x, y, w, b=None):
+        y = y.reshape(-1).astype(jnp.int32)
+        # default complete-tree paths: node ids and left/right codes per level
+        codes = []
+        nodes = []
+        cur = y + num_classes  # leaf index in a heap layout
+        for _ in range(depth):
+            codes.append((cur % 2).astype(x.dtype))   # right-child bit
+            cur = cur // 2
+            nodes.append(cur - 1)                     # internal node id
+        loss = 0.0
+        for lvl in range(depth):
+            idx = jnp.clip(nodes[lvl], 0, w.shape[0] - 1)
+            logit = (x * w[idx]).sum(-1)
+            if b is not None:
+                logit = logit + b.reshape(-1)[idx]
+            sign = 1.0 - 2.0 * codes[lvl]             # code 0 -> +1
+            loss = loss - jax.nn.log_sigmoid(sign * logit)
+        return loss.mean()
+
+    if bias is None:
+        return apply_op(f, input, label, weight, op_name="hsigmoid_loss")
+    return apply_op(f, input, label, weight, bias, op_name="hsigmoid_loss")
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-T loss: log-space alpha recursion over the (T, U) lattice as a
+    lax.scan over anti-diagonals (reference loss.py rnnt_loss / warprnnt)."""
+    import jax
+    import jax.numpy as jnp
+
+    def f(logits, labels, ilen, llen):
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        B, T, U1, _ = logp.shape
+        labels = labels.astype(jnp.int32)
+        blank_lp = logp[..., blank]                       # [B, T, U1]
+        lab_lp = jnp.take_along_axis(
+            logp[:, :, :-1, :], labels[:, None, :, None].astype(jnp.int32),
+            axis=-1)[..., 0]                              # [B, T, U]
+        NEG = -1e30
+
+        alpha0 = jnp.full((B, U1), NEG).at[:, 0].set(0.0)
+
+        def t_step(alpha, t):
+            # emit along u (within the same t): sequential scan over U
+            def u_step(a, u):
+                val = jnp.where(u > 0, a[:, u - 1] + lab_lp[:, t, u - 1], NEG)
+                new = jnp.logaddexp(a[:, u], val)
+                # only the emit path updates within this t; the blank path
+                # was already folded in from t-1
+                return a.at[:, u].set(jnp.where(u > 0, new, a[:, u])), None
+
+            alpha, _ = jax.lax.scan(u_step, alpha, jnp.arange(U1))
+            # advance time with a blank from every (t, u)
+            nxt = alpha + blank_lp[:, t, :]
+            keep = (t + 1 < ilen)[:, None]
+            return jnp.where(keep, nxt, alpha), alpha
+
+        alpha_final, alphas = jax.lax.scan(t_step, alpha0, jnp.arange(T))
+        # total log prob: alpha at (ilen-1, llen) + blank there
+        t_idx = jnp.clip(ilen - 1, 0, T - 1)
+        u_idx = jnp.clip(llen, 0, U1 - 1)
+        a_end = alphas[t_idx, jnp.arange(B), u_idx]
+        lp_end = blank_lp[jnp.arange(B), t_idx, u_idx]
+        loss = -(a_end + lp_end)
+        return _reduce(loss, reduction)
+
+    return apply_op(f, input, label, input_lengths, label_lengths,
+                    op_name="rnnt_loss")
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """Adaptive softmax (reference loss.py): frequent classes in the head,
+    rare clusters through projected tails."""
+    import jax
+    import jax.numpy as jnp
+
+    n_clusters = len(tail_weights)
+    head_size = cutoffs[0] + n_clusters
+
+    hw = unwrap(head_weight)
+    hb = unwrap(head_bias) if head_bias is not None else None
+    tws = [tuple(unwrap(w) for w in tw) for tw in tail_weights]
+
+    def f(x, y):
+        y = y.reshape(-1).astype(jnp.int32)
+        head_logits = x @ hw
+        if hb is not None:
+            head_logits = head_logits + hb
+        head_lp = jax.nn.log_softmax(head_logits, axis=-1)
+        out = jnp.zeros(y.shape, x.dtype)
+        in_head = y < cutoffs[0]
+        out = jnp.where(in_head,
+                        jnp.take_along_axis(
+                            head_lp, jnp.clip(y, 0, cutoffs[0] - 1)[:, None],
+                            1)[:, 0],
+                        out)
+        for c in range(n_clusters):
+            lo, hi = cutoffs[c], cutoffs[c + 1]
+            proj, wout = tws[c]
+            tail_lp = jax.nn.log_softmax((x @ proj) @ wout, axis=-1)
+            rel = jnp.clip(y - lo, 0, hi - lo - 1)
+            val = (head_lp[:, cutoffs[0] + c]
+                   + jnp.take_along_axis(tail_lp, rel[:, None], 1)[:, 0])
+            out = jnp.where((y >= lo) & (y < hi), val, out)
+        return out, -out.mean()
+
+    return apply_op(f, input, label, op_name="adaptive_log_softmax_with_loss")
+
+
+# ---------------------------------------------------------------------------
+# pooling extras (reference nn/functional/pooling.py)
+# ---------------------------------------------------------------------------
+
+
+def _unpool(x, indices, spatial_shape):
+    """Scatter pooled values back to their argmax positions."""
+    import jax.numpy as jnp
+
+    def f(a, idx):
+        lead = a.shape[:-len(a.shape[2:]) or None]
+        n, c = a.shape[0], a.shape[1]
+        flat_len = int(np.prod(spatial_shape))
+        av = a.reshape(n, c, -1)
+        iv = idx.reshape(n, c, -1).astype(jnp.int32)
+        out = jnp.zeros((n, c, flat_len), a.dtype)
+        out = out.at[jnp.arange(n)[:, None, None],
+                     jnp.arange(c)[None, :, None], iv].set(av)
+        return out.reshape((n, c) + tuple(spatial_shape))
+
+    return apply_op(f, x, indices, op_name="max_unpool")
+
+
+def _unpool_out_shape(in_spatial, kernel_size, stride, padding, output_size,
+                      nd):
+    if output_size is not None:
+        out = list(output_size)[-nd:]
+        return out
+    ks = kernel_size if isinstance(kernel_size, (list, tuple)) else [kernel_size] * nd
+    st = stride if isinstance(stride, (list, tuple)) else [stride or ks[0]] * nd
+    pd = padding if isinstance(padding, (list, tuple)) else [padding] * nd
+    return [(i - 1) * s - 2 * p + k
+            for i, k, s, p in zip(in_spatial, ks, st, pd)]
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    spatial = _unpool_out_shape(unwrap(x).shape[2:], kernel_size, stride,
+                                padding, output_size, 1)
+    return _unpool(x, indices, spatial)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    spatial = _unpool_out_shape(unwrap(x).shape[2:], kernel_size, stride,
+                                padding, output_size, 2)
+    return _unpool(x, indices, spatial)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    spatial = _unpool_out_shape(unwrap(x).shape[2:], kernel_size, stride,
+                                padding, output_size, 3)
+    return _unpool(x, indices, spatial)
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    from . import functional as F
+
+    import jax.numpy as jnp
+
+    p = float(norm_type)
+    powed = apply_op(lambda a: jnp.abs(a) ** p, x, op_name="lp_pow")
+    avg = F.avg_pool1d(powed, kernel_size, stride=stride, padding=padding,
+                       ceil_mode=ceil_mode)
+    k = kernel_size if isinstance(kernel_size, int) else int(np.prod(kernel_size))
+    return apply_op(lambda a: (a * k) ** (1.0 / p), avg, op_name="lp_root")
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    from . import functional as F
+
+    import jax.numpy as jnp
+
+    p = float(norm_type)
+    powed = apply_op(lambda a: jnp.abs(a) ** p, x, op_name="lp_pow")
+    avg = F.avg_pool2d(powed, kernel_size, stride=stride, padding=padding,
+                       ceil_mode=ceil_mode)
+    ks = kernel_size if isinstance(kernel_size, (list, tuple)) else (
+        kernel_size, kernel_size)
+    k = int(np.prod(ks))
+    return apply_op(lambda a: (a * k) ** (1.0 / p), avg, op_name="lp_root")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    import jax.numpy as jnp
+
+    out = (output_size if isinstance(output_size, (list, tuple))
+           else [output_size] * 3)
+
+    def f(a):
+        n, c, d, h, w = a.shape
+
+        def pool_axis(arr, axis, size):
+            length = arr.shape[axis]
+            starts = [(i * length) // size for i in range(size)]
+            ends = [-(-((i + 1) * length) // size) for i in range(size)]
+            return jnp.stack([jnp.take(arr, jnp.arange(st, en), axis=axis
+                                       ).max(axis=axis)
+                              for st, en in zip(starts, ends)], axis=axis)
+
+        a = pool_axis(a, 2, out[0])
+        a = pool_axis(a, 3, out[1])
+        return pool_axis(a, 4, out[2])
+
+    if return_mask:
+        raise NotImplementedError("adaptive_max_pool3d(return_mask=True)")
+    return apply_op(f, x, op_name="adaptive_max_pool3d")
+
+
+def _fractional_pool(x, output_size, nd, random_u=None):
+    """Deterministic fractional max pooling (reference uses pseudo-random
+    sequences seeded by random_u; the region boundaries here follow the same
+    alpha-scan construction)."""
+    import jax.numpy as jnp
+
+    out = (output_size if isinstance(output_size, (list, tuple))
+           else [output_size] * nd)
+
+    def f(a):
+        def pool_axis(arr, axis, size):
+            length = arr.shape[axis]
+            alpha = length / size
+            u = random_u if random_u is not None else 0.5
+            starts = [min(int((i + u) * alpha) - int(u * alpha), length - 1)
+                      for i in range(size)]
+            ends = [min(int((i + 1 + u) * alpha) - int(u * alpha), length)
+                    for i in range(size)]
+            ends = [max(e, s + 1) for s, e in zip(starts, ends)]
+            return jnp.stack([jnp.take(arr, jnp.arange(st, en), axis=axis
+                                       ).max(axis=axis)
+                              for st, en in zip(starts, ends)], axis=axis)
+
+        for d in range(nd):
+            a = pool_axis(a, 2 + d, out[d])
+        return a
+
+    return apply_op(f, x, op_name="fractional_max_pool")
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError("fractional_max_pool2d(return_mask=True)")
+    return _fractional_pool(x, output_size, 2, random_u)
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError("fractional_max_pool3d(return_mask=True)")
+    return _fractional_pool(x, output_size, 3, random_u)
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """Channel-wise alpha dropout (SELU-preserving; reference functional)."""
+    import jax.numpy as jnp
+
+    if not training or p == 0.0:
+        return apply_op(lambda a: a, x)
+    alpha_p = -1.7580993408473766
+
+    def f(a, key):
+        shape = (a.shape[0], a.shape[1]) + (1,) * (a.ndim - 2)
+        import jax
+
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        A = (1.0 / math.sqrt((1 - p) * (1 + p * alpha_p ** 2)))
+        B = -A * p * alpha_p
+        return (jnp.where(keep, a, alpha_p) * A + B).astype(a.dtype)
+
+    from ..core import random as prandom
+
+    return apply_op(f, x, prandom.next_key(), op_name="feature_alpha_dropout")
+
+
+# ---------------------------------------------------------------------------
+# decode / misc functional
+# ---------------------------------------------------------------------------
+
+
+def gather_tree(ids, parents):
+    """Trace beam-search parents back to full sequences (reference
+    nn/decode gather_tree): ids/parents [T, B, beam]."""
+    import jax.numpy as jnp
+
+    def g(i, p):
+        T = i.shape[0]
+        beams = jnp.broadcast_to(jnp.arange(i.shape[2]), i.shape[1:])
+        rows = []
+        for t in range(T - 1, -1, -1):
+            rows.append(jnp.take_along_axis(i[t], beams, axis=-1))
+            beams = jnp.take_along_axis(p[t], beams, axis=-1)
+        return jnp.stack(rows[::-1], axis=0)
+
+    return apply_op(g, ids, parents, op_name="gather_tree")
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """ArcFace-style margin softmax (reference loss.py margin_cross_entropy):
+    cos(m1*theta + m2) - m3 on the target logit, then scaled CE."""
+    import jax
+    import jax.numpy as jnp
+
+    def f(x, y):
+        x = jnp.asarray(x)
+        y = jnp.asarray(y).reshape(-1).astype(jnp.int32)
+        cos_t = jnp.clip(jnp.take_along_axis(x, y[:, None], 1)[:, 0], -1, 1)
+        theta = jnp.arccos(cos_t)
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        adj = x.at[jnp.arange(x.shape[0]), y].set(target) * scale
+        lp = jax.nn.log_softmax(adj, axis=-1)
+        loss = -jnp.take_along_axis(lp, y[:, None], 1)[:, 0]
+        loss = _reduce(loss, reduction)
+        if return_softmax:
+            return loss, jnp.exp(lp)
+        return loss
+
+    return apply_op(f, logits, label, op_name="margin_cross_entropy")
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample negative class centers + the positives (reference
+    loss.py class_center_sample). Deterministic: positives first, then the
+    lowest-id negatives to fill num_samples."""
+    import jax.numpy as jnp
+
+    lab = np.asarray(unwrap(label)).reshape(-1)
+    pos = np.unique(lab)
+    neg = np.setdiff1d(np.arange(num_classes), pos)
+    take = max(0, num_samples - len(pos))
+    sampled = np.concatenate([pos, neg[:take]])
+    remap = -np.ones((num_classes,), np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    from ..core.dispatch import wrap
+
+    return (wrap(jnp.asarray(remap[lab])), wrap(jnp.asarray(sampled)))
+
+
+# ---------------------------------------------------------------------------
+# packed flash-attention entry points (reference flash_attention.py:700)
+# ---------------------------------------------------------------------------
+
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False, return_softmax=False,
+                         fixed_seed_offset=None, rng_name="", training=True,
+                         name=None):
+    """qkv: [b, s, heads+2*kv_heads? — reference packs [b, s, 3, h, d]]."""
+    from . import functional as F
+
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]
+    out = F.scaled_dot_product_attention(q, k, v, dropout_p=dropout,
+                                         is_causal=causal, training=training)
+    return out, None
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
+                                max_seqlen_k, scale=None, dropout=0.0,
+                                causal=False, return_softmax=False,
+                                varlen_padded=True, training=True, name=None):
+    from . import functional as F
+
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+    return F.flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
+                                 max_seqlen_q, max_seqlen_k, scale=scale,
+                                 dropout=dropout, causal=causal,
+                                 training=training)
+
+
+def flashmask_attention(query, key, value, startend_row_indices=None,
+                        dropout=0.0, causal=False, window_size=None,
+                        return_softmax_lse=False, name=None):
+    """FlashMask (sparse row-range masks): lowered to a dense mask here —
+    startend_row_indices [b, kv_heads, sk, 1] marks, per key column, the
+    first query row that may NOT attend (causal LT mode)."""
+    import jax.numpy as jnp
+
+    from . import functional as F
+
+    if startend_row_indices is None:
+        return F.scaled_dot_product_attention(query, key, value,
+                                              dropout_p=dropout,
+                                              is_causal=causal)
+    sq = unwrap(query).shape[1]
+    sk = unwrap(key).shape[1]
+
+    def build_mask(rows):
+        # rows [b, h_kv, sk, 1] -> bool [b, 1, sq, sk] (True = visible)
+        start = rows[..., 0]                       # [b, hkv, sk]
+        q_pos = jnp.arange(sq)[None, None, :, None]
+        vis = q_pos < start[:, :, None, :]
+        if causal:
+            vis = vis & (q_pos >= jnp.arange(sk)[None, None, None, :])
+        return vis
+
+    mask = apply_op(build_mask, startend_row_indices, op_name="flashmask")
+    out = F.scaled_dot_product_attention(query, key, value, attn_mask=mask,
+                                         dropout_p=dropout, is_causal=False)
+    if return_softmax_lse:
+        return out, None
+    return out
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """Block-sparse attention at the reference API (functional
+    sparse_attention); computed via a dense mask built from the CSR pattern."""
+    import jax.numpy as jnp
+
+    def f(q, k, v, offs, cols):
+        # q/k/v: [b, h, s, d]; offs [b, h, s+1]; cols [b, h, nnz]
+        b, h, s, d = q.shape
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(d)
+        # one vectorized scatter: precompute (b, h, row, col) index arrays
+        offs_np = np.asarray(offs).astype(np.int64)
+        cols_np = np.asarray(cols).astype(np.int64)
+        nnz = cols_np.shape[-1]
+        rows_np = np.empty((b, h, nnz), np.int64)
+        for bi in range(b):
+            for hi in range(h):
+                rows_np[bi, hi] = np.repeat(np.arange(s),
+                                            np.diff(offs_np[bi, hi]))
+        bi_idx = np.arange(b)[:, None, None]
+        hi_idx = np.arange(h)[None, :, None]
+        mask = jnp.zeros((b, h, s, s), bool).at[
+            bi_idx, hi_idx, rows_np, cols_np].set(True)
+        logits = jnp.where(mask, logits, -1e30)
+        import jax
+
+        probs = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+    return apply_op(f, query, key, value, sparse_csr_offset,
+                    sparse_csr_columns, op_name="sparse_attention")
+
+
+# ---------------------------------------------------------------------------
+# layers
+# ---------------------------------------------------------------------------
+
+
+def _loss_layer(fn, **defaults):
+    class _L(Layer):
+        def __init__(self, **kw):
+            super().__init__()
+            self.kw = {**defaults, **kw}
+
+        def forward(self, *args):
+            return fn(*args, **self.kw)
+
+    return _L
+
+
+class CTCLoss(Layer):
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank, self.reduction = blank, reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        from . import functional as F
+
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          blank=self.blank, reduction=self.reduction,
+                          norm_by_times=norm_by_times)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        from . import functional as F
+
+        return F.pairwise_distance(x, y, p=self.p, epsilon=self.epsilon,
+                                   keepdim=self.keepdim)
+
+
+GaussianNLLLoss = _loss_layer(gaussian_nll_loss)
+PoissonNLLLoss = _loss_layer(poisson_nll_loss)
+MultiMarginLoss = _loss_layer(multi_margin_loss)
+SoftMarginLoss = _loss_layer(soft_margin_loss)
+TripletMarginWithDistanceLoss = _loss_layer(triplet_margin_with_distance_loss)
+MultiLabelSoftMarginLoss = _loss_layer(multi_label_soft_margin_loss)
+RNNTLoss = _loss_layer(rnnt_loss)
+
+
+class HSigmoidLoss(Layer):
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        self.num_classes = num_classes
+        self.weight = self.create_parameter([num_classes - 1, feature_size])
+        self.bias = self.create_parameter([num_classes - 1], is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return hsigmoid_loss(input, label, self.num_classes, self.weight,
+                             self.bias)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        self.cutoffs = list(cutoffs) + [n_classes]
+        self.head_weight = self.create_parameter(
+            [in_features, self.cutoffs[0] + len(self.cutoffs) - 1])
+        self.head_bias = (self.create_parameter(
+            [self.cutoffs[0] + len(self.cutoffs) - 1], is_bias=True)
+            if head_bias else None)
+        self.tails = []
+        for c in range(len(self.cutoffs) - 1):
+            proj_dim = max(1, int(in_features / (div_value ** (c + 1))))
+            proj = self.create_parameter([in_features, proj_dim])
+            wout = self.create_parameter(
+                [proj_dim, self.cutoffs[c + 1] - self.cutoffs[c]])
+            self.tails.append((proj, wout))
+
+    def forward(self, input, label):
+        return adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, self.tails, self.cutoffs,
+            head_bias=self.head_bias)
+
+
+class ZeroPad1D(Layer):
+    def __init__(self, padding, data_format="NCL", name=None):
+        super().__init__()
+        self.padding = (padding if isinstance(padding, (list, tuple))
+                        else [padding, padding])
+
+    def forward(self, x):
+        from . import functional as F
+
+        return F.pad(x, list(self.padding), mode="constant", value=0.0,
+                     data_format="NCL")
+
+
+class ZeroPad2D(Layer):
+    def __init__(self, padding, data_format="NCHW", name=None):
+        super().__init__()
+        self.padding = (list(padding) if isinstance(padding, (list, tuple))
+                        else [padding] * 4)
+
+    def forward(self, x):
+        from . import functional as F
+
+        return F.zeropad2d(x, self.padding)
+
+
+class ZeroPad3D(Layer):
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__()
+        self.padding = (list(padding) if isinstance(padding, (list, tuple))
+                        else [padding] * 6)
+
+    def forward(self, x):
+        from . import functional as F
+
+        return F.pad(x, self.padding, mode="constant", value=0.0,
+                     data_format="NCDHW")
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis, self.shape = axis, list(shape)
+
+    def forward(self, x):
+        import jax.numpy as jnp
+
+        def f(a):
+            s = list(a.shape)
+            return a.reshape(s[: self.axis] + self.shape
+                             + s[self.axis + 1:])
+
+        return apply_op(f, x, op_name="unflatten")
+
+
+class ParameterDict(Layer):
+    """dict-style parameter container (reference container.py)."""
+
+    def __init__(self, parameters=None):
+        super().__init__()
+        self._keys = []
+        if parameters:
+            for k, v in (parameters.items()
+                         if hasattr(parameters, "items") else parameters):
+                self[k] = v
+
+    def __setitem__(self, key, param):
+        if key not in self._keys:  # overwrite must not duplicate the key
+            self._keys.append(key)
+        setattr(self, key, param)
+
+    def __getitem__(self, key):
+        return getattr(self, key)
+
+    def __len__(self):
+        return len(self._keys)
+
+    def __iter__(self):
+        return iter(self._keys)
+
+    def keys(self):
+        return list(self._keys)
+
+    def values(self):
+        return [self[k] for k in self._keys]
+
+    def items(self):
+        return [(k, self[k]) for k in self._keys]
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self.r = downscale_factor
+
+    def forward(self, x):
+        import jax.numpy as jnp
+
+        r = self.r
+
+        def f(a):
+            n, c, h, w = a.shape
+            a = a.reshape(n, c, h // r, r, w // r, r)
+            return a.transpose(0, 1, 3, 5, 2, 4).reshape(
+                n, c * r * r, h // r, w // r)
+
+        return apply_op(f, x, op_name="pixel_unshuffle")
+
+
+class ChannelShuffle(Layer):
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self.groups = groups
+
+    def forward(self, x):
+        from . import functional as F
+
+        return F.channel_shuffle(x, self.groups)
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self.kw = dict(output_sizes=output_sizes, kernel_sizes=kernel_sizes,
+                       strides=strides, paddings=paddings,
+                       dilations=dilations)
+
+    def forward(self, x):
+        from . import functional as F
+
+        return F.fold(x, **self.kw)
+
+
+def _pool_layer(fn_name, **fixed):
+    class _P(Layer):
+        def __init__(self, *a, **kw):
+            super().__init__()
+            self.a, self.kw = a, {**fixed, **kw}
+
+        def forward(self, x):
+            from . import functional as F
+
+            return getattr(F, fn_name)(x, *self.a, **self.kw)
+
+    return _P
+
+
+MaxUnPool1D = _pool_layer("max_unpool1d")
+MaxUnPool2D = _pool_layer("max_unpool2d")
+MaxUnPool3D = _pool_layer("max_unpool3d")
+AvgPool3D = _pool_layer("avg_pool3d")
+MaxPool3D = _pool_layer("max_pool3d")
+AdaptiveAvgPool3D = _pool_layer("adaptive_avg_pool3d")
+AdaptiveMaxPool1D = _pool_layer("adaptive_max_pool1d")
+AdaptiveMaxPool3D = _pool_layer("adaptive_max_pool3d")
+FractionalMaxPool2D = _pool_layer("fractional_max_pool2d")
+FractionalMaxPool3D = _pool_layer("fractional_max_pool3d")
+
+
+class LPPool1D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCL", name=None):
+        super().__init__()
+        self.a = (norm_type, kernel_size)
+        self.kw = dict(stride=stride, padding=padding, ceil_mode=ceil_mode)
+
+    def forward(self, x):
+        return lp_pool1d(x, *self.a, **self.kw)
+
+
+class LPPool2D(LPPool1D):
+    def forward(self, x):
+        return lp_pool2d(x, *self.a, **self.kw)
+
+
+class Softmax2D(Layer):
+    def forward(self, x):
+        from . import functional as F
+
+        return F.softmax(x, axis=-3)
+
+
+class FeatureAlphaDropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return feature_alpha_dropout(x, p=self.p, training=self.training)
+
+
+class UpsamplingNearest2D(Layer):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self.size, self.scale = size, scale_factor
+
+    def forward(self, x):
+        from . import functional as F
+
+        return F.interpolate(x, size=self.size, scale_factor=self.scale,
+                             mode="nearest")
+
+
+class UpsamplingBilinear2D(UpsamplingNearest2D):
+    def forward(self, x):
+        from . import functional as F
+
+        return F.interpolate(x, size=self.size, scale_factor=self.scale,
+                             mode="bilinear", align_corners=True)
+
+
+class _ConvTransposeNd(Layer):
+    """Shared transpose-conv layer over the functional lowering (paddle
+    weight layout [in, out/groups, *kernel], like nn/conv.Conv2DTranspose)."""
+
+    ND = 1
+    FN = "conv1d_transpose"
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format=None):
+        super().__init__()
+
+        def ntuple(v):
+            return (list(v) if isinstance(v, (list, tuple))
+                    else [v] * self.ND)
+
+        self._stride = ntuple(stride)
+        self._padding = padding
+        self._output_padding = output_padding
+        self._dilation = ntuple(dilation)
+        self._groups = groups
+        kernel = ntuple(kernel_size)
+        self.weight = self.create_parameter(
+            [in_channels, out_channels // groups] + kernel, attr=weight_attr)
+        self.bias = (self.create_parameter([out_channels], attr=bias_attr,
+                                           is_bias=True)
+                     if bias_attr is not False else None)
+
+    def forward(self, x, output_size=None):
+        from . import functional as F
+
+        fn = getattr(F, self.FN)
+        return fn(x, self.weight, self.bias, self._stride, self._padding,
+                  self._output_padding, self._groups, self._dilation,
+                  output_size=output_size)
+
+
+class Conv1DTranspose(_ConvTransposeNd):
+    ND = 1
+    FN = "conv1d_transpose"
+
+
+class Conv3DTranspose(_ConvTransposeNd):
+    ND = 3
+    FN = "conv3d_transpose"
+
+
+# ---------------------------------------------------------------------------
+# beam search (reference nn/decode.py BeamSearchDecoder + dynamic_decode)
+# ---------------------------------------------------------------------------
+
+
+class BeamSearchDecoder:
+    """Greedy-expansion beam search over an RNN cell (reference decode.py).
+
+    The cell maps (token_embedding, states) -> (logits, states) through
+    ``cell(step_input, states)`` + an output layer.
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = start_token
+        self.end_token = end_token
+        self.beam_size = beam_size
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    def step(self, tokens, states):
+        import jax.numpy as jnp
+
+        inp = (self.embedding_fn(tokens) if self.embedding_fn is not None
+               else tokens)
+        out, new_states = self.cell(inp, states)
+        if self.output_fn is not None:
+            out = self.output_fn(out)
+        return out, new_states
+
+
+def dynamic_decode(decoder: BeamSearchDecoder, inits=None, max_step_num=32,
+                   **kwargs):
+    """Beam search loop (reference decode.py dynamic_decode). Returns
+    (token_ids [b, beam, T], log_probs [b, beam])."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.dispatch import wrap
+
+    beam = decoder.beam_size
+
+    # bootstrap: run the start token once to find batch size and vocab
+    start = decoder.start_token
+    states = inits
+    tokens = None
+    seqs = None
+    scores = None
+    for t in range(max_step_num):
+        if tokens is None:
+            logits, states = decoder.step(start, states)
+            lp = np.asarray(unwrap(jax.nn.log_softmax(
+                unwrap(logits).astype(np.float32))))
+            b, vocab = lp.shape
+            top = np.argsort(-lp, axis=-1)[:, :beam]            # [b, beam]
+            scores = np.take_along_axis(lp, top, -1)            # [b, beam]
+            seqs = top[..., None]                               # [b, beam, 1]
+            tokens = top
+            states = _tile_states(states, beam)
+        else:
+            flat_tokens = wrap(np.asarray(tokens.reshape(-1)))
+            logits, states = decoder.step(flat_tokens, states)
+            lp = np.asarray(unwrap(jax.nn.log_softmax(
+                unwrap(logits).astype(np.float32))))            # [b*beam, V]
+            b = seqs.shape[0]
+            vocab = lp.shape[-1]
+            total = scores[..., None] + lp.reshape(b, beam, vocab)
+            finished = tokens == decoder.end_token
+            total = np.where(finished[..., None],
+                             np.where(np.arange(vocab)[None, None, :]
+                                      == decoder.end_token,
+                                      scores[..., None], -1e30), total)
+            flat = total.reshape(b, -1)
+            top = np.argsort(-flat, -1)[:, :beam]
+            scores = np.take_along_axis(flat, top, -1)
+            parent = top // vocab
+            tok = top % vocab
+            seqs = np.concatenate(
+                [np.take_along_axis(seqs, parent[..., None], 1),
+                 tok[..., None]], axis=-1)
+            tokens = tok
+            states = _reorder_states(states, parent, beam)
+        if np.all(tokens == decoder.end_token):
+            break
+    return wrap(np.asarray(seqs)), wrap(np.asarray(scores))
+
+
+def _tile_states(states, beam):
+    import jax.numpy as jnp
+
+    def tile(s):
+        v = unwrap(s)
+        from ..core.dispatch import wrap
+
+        return wrap(jnp.repeat(v, beam, axis=0))
+
+    if states is None:
+        return None
+    if isinstance(states, tuple):
+        return tuple(tile(s) for s in states)
+    return tile(states)
+
+
+def _reorder_states(states, parent, beam):
+    import jax.numpy as jnp
+
+    from ..core.dispatch import wrap
+
+    b = parent.shape[0]
+    flat_idx = (np.arange(b)[:, None] * beam + parent).reshape(-1)
+
+    def pick(s):
+        v = unwrap(s)
+        return wrap(jnp.asarray(np.asarray(v)[flat_idx]))
+
+    if states is None:
+        return None
+    if isinstance(states, tuple):
+        return tuple(pick(s) for s in states)
+    return pick(states)

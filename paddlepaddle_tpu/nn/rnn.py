@@ -1,0 +1,316 @@
+"""Recurrent layers — cells, RNN/BiRNN drivers, SimpleRNN/LSTM/GRU stacks.
+
+Reference surface: python/paddle/nn/layer/rnn.py (RNNCellBase:~, SimpleRNNCell,
+LSTMCell:190 forward with [i,f,g,o] gate chunks, GRUCell with [r,z,c] and the
+reset gate applied to the hidden projection, RNN/BiRNN drivers, and the
+multi-layer SimpleRNN/LSTM/GRU with forward/bidirect directions).
+
+TPU notes: the time loop is a python loop over unstacked steps — under
+``jit``/``TrainStep`` XLA unrolls and fuses it (static seq lens); gate
+matmuls are batched [B, 4H] GEMMs on the MXU. Weight layout and gate order
+match the reference (and torch): ``weight_ih [G*H, in]``, applied as
+``x @ W.T``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..core.dispatch import apply_op
+from .initializer import Uniform
+from .layer import Layer
+
+__all__ = [
+    "RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "BiRNN",
+    "SimpleRNN", "LSTM", "GRU",
+]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        import jax.numpy as jnp
+
+        batch = batch_ref.shape[batch_dim_idx]
+
+        def make(_):
+            return apply_op(
+                lambda: jnp.full((batch, self.hidden_size), init_value,
+                                 jnp.float32), op_name="rnn_init_state")
+
+        n = len(self.state_shape) if isinstance(self.state_shape, tuple) else 1
+        states = tuple(make(i) for i in range(n))
+        return states if n > 1 else states[0]
+
+
+def _init_cell_params(layer, in_size, hidden, gates):
+    k = 1.0 / math.sqrt(hidden) if hidden > 0 else 0.0
+    u = Uniform(-k, k)
+    layer.weight_ih = layer.create_parameter([gates * hidden, in_size],
+                                             default_initializer=u)
+    layer.weight_hh = layer.create_parameter([gates * hidden, hidden],
+                                             default_initializer=u)
+    layer.bias_ih = layer.create_parameter([gates * hidden], is_bias=True,
+                                           default_initializer=u)
+    layer.bias_hh = layer.create_parameter([gates * hidden], is_bias=True,
+                                           default_initializer=u)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        _init_cell_params(self, input_size, hidden_size, 1)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        import jax.numpy as jnp
+
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else (
+            lambda v: jnp.maximum(v, 0))
+
+        def f(x, h, wi, wh, bi, bh):
+            return act(x @ wi.T + bi + h @ wh.T + bh)
+
+        h = apply_op(f, inputs, states, self.weight_ih, self.weight_hh,
+                     self.bias_ih, self.bias_hh, op_name="simple_rnn_cell")
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    """Gate chunks [i, f, g, o] (reference rnn.py:201-207)."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=0, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        _init_cell_params(self, input_size, hidden_size, 4)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        import jax
+        import jax.numpy as jnp
+
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h0, c0 = states
+
+        def f2(x, h, c, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + h @ wh.T + bh
+            i, fg, g, o = jnp.split(gates, 4, axis=-1)
+            c_new = (jax.nn.sigmoid(fg) * c
+                     + jax.nn.sigmoid(i) * jnp.tanh(g))
+            h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+            return h_new, c_new
+
+        h, c = apply_op(f2, inputs, h0, c0, self.weight_ih, self.weight_hh,
+                        self.bias_ih, self.bias_hh, op_name="lstm_cell")
+        return h, (h, c)
+
+
+class GRUCell(RNNCellBase):
+    """Gate chunks [r, z, c]; reset gate scales the hidden candidate
+    projection (reference rnn.py:1158)."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        _init_cell_params(self, input_size, hidden_size, 3)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        import jax
+        import jax.numpy as jnp
+
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def f(x, h, wi, wh, bi, bh):
+            xg = x @ wi.T + bi
+            hg = h @ wh.T + bh
+            xr, xz, xc = jnp.split(xg, 3, axis=-1)
+            hr, hz, hc = jnp.split(hg, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            cand = jnp.tanh(xc + r * hc)
+            return (1.0 - z) * cand + z * h
+
+        h = apply_op(f, inputs, states, self.weight_ih, self.weight_hh,
+                     self.bias_ih, self.bias_hh, op_name="gru_cell")
+        return h, h
+
+
+class RNN(Layer):
+    """Drive a cell over the time dim (reference rnn.py RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ..ops.manipulation import stack as t_stack
+
+        x = inputs if self.time_major else inputs.transpose([1, 0, 2])
+        T = x.shape[0]
+        order = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        states = initial_states
+        outs = [None] * T
+        for t in order:
+            out, new_states = self.cell(x[t], states)
+            if sequence_length is not None:
+                out, new_states = _mask_step(t, sequence_length, out,
+                                             new_states, states)
+            states = new_states
+            outs[t] = out
+        y = t_stack(outs, axis=0)
+        if not self.time_major:
+            y = y.transpose([1, 0, 2])
+        return y, states
+
+
+def _mask_step(t, seq_lens, out, new_states, old_states):
+    """Freeze finished sequences (t >= their length)."""
+    import jax.numpy as jnp
+
+    def pick(n, o):
+        return apply_op(
+            lambda nv, ov, sl: jnp.where((t < sl)[:, None], nv, ov),
+            n, o, seq_lens, op_name="rnn_mask")
+
+    if old_states is None:
+        return out, new_states
+    if isinstance(new_states, tuple):
+        masked = tuple(pick(n, o) for n, o in zip(new_states, old_states))
+        return pick(out, old_states[0]), masked
+    m = pick(new_states, old_states)
+    return m, m
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ..ops.manipulation import concat
+
+        s_fw, s_bw = (initial_states if initial_states is not None
+                      else (None, None))
+        y_fw, st_fw = self.rnn_fw(inputs, s_fw, sequence_length)
+        y_bw, st_bw = self.rnn_bw(inputs, s_bw, sequence_length)
+        return concat([y_fw, y_bw], axis=-1), (st_fw, st_bw)
+
+
+class _RNNBase(Layer):
+    """Multi-layer / bidirectional stack (reference SimpleRNN/LSTM/GRU)."""
+
+    CELL = None
+    STATES = 1
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation=None, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        if direction not in ("forward", "bidirect", "bidirectional"):
+            raise ValueError(f"unknown direction {direction!r}")
+        self.bidirectional = direction != "forward"
+        self.num_layers = num_layers
+        self.hidden_size = hidden_size
+        self.time_major = time_major
+        self.dropout = dropout
+        ndir = 2 if self.bidirectional else 1
+        self._layers_fw = []
+        self._layers_bw = []
+        for l in range(num_layers):
+            in_size = input_size if l == 0 else hidden_size * ndir
+            kw = {"activation": activation} if (
+                activation and self.CELL is SimpleRNNCell) else {}
+            fw = self.CELL(in_size, hidden_size, **kw)
+            self.add_sublayer(f"cell_fw_l{l}", fw)
+            self._layers_fw.append(RNN(fw, time_major=True))
+            if self.bidirectional:
+                bw = self.CELL(in_size, hidden_size, **kw)
+                self.add_sublayer(f"cell_bw_l{l}", bw)
+                self._layers_bw.append(RNN(bw, is_reverse=True,
+                                           time_major=True))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ..nn import functional as F
+        from ..ops.manipulation import concat, stack
+
+        x = inputs if self.time_major else inputs.transpose([1, 0, 2])
+        finals = []
+        for l in range(self.num_layers):
+            init_fw = init_bw = None
+            if initial_states is not None:
+                init_fw, init_bw = self._layer_init(initial_states, l)
+            y_fw, st_fw = self._layers_fw[l](x, init_fw, sequence_length)
+            if self.bidirectional:
+                y_bw, st_bw = self._layers_bw[l](x, init_bw, sequence_length)
+                x = concat([y_fw, y_bw], axis=-1)
+                finals.extend([st_fw, st_bw])
+            else:
+                x = y_fw
+                finals.append(st_fw)
+            if self.dropout and l < self.num_layers - 1:
+                x = F.dropout(x, p=self.dropout, training=self.training)
+        y = x if self.time_major else x.transpose([1, 0, 2])
+        if self.STATES == 1:
+            states = stack(finals, axis=0)  # [L*D, B, H]
+        else:
+            states = tuple(
+                stack([f[i] for f in finals], axis=0)
+                for i in range(self.STATES))
+        return y, states
+
+    def _layer_init(self, initial_states, l):
+        ndir = 2 if self.bidirectional else 1
+
+        def slot(i):
+            if self.STATES == 1:
+                return initial_states[l * ndir + i]
+            return tuple(s[l * ndir + i] for s in initial_states)
+
+        return slot(0), (slot(1) if self.bidirectional else None)
+
+
+class SimpleRNN(_RNNBase):
+    CELL = SimpleRNNCell
+    STATES = 1
+
+
+class LSTM(_RNNBase):
+    CELL = LSTMCell
+    STATES = 2
+
+
+class GRU(_RNNBase):
+    CELL = GRUCell
+    STATES = 1

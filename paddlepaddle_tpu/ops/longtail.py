@@ -1,0 +1,543 @@
+"""Long-tail tensor ops closing the reference namespace
+(python/paddle/tensor/__init__.py exports absent after the core passes).
+
+Every op lowers to jnp/lax/jax.scipy; signal ops (stft/istft) are framed
+matmul+FFT programs (MXU/FFT-friendly, no python loops under jit).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply_op, unwrap, wrap
+from ..core.tensor import Tensor
+
+__all__ = [
+    "add_n", "atleast_1d", "atleast_2d", "atleast_3d", "bitwise_invert",
+    "block_diag", "cholesky_inverse", "cond", "create_parameter",
+    "create_tensor", "cumulative_trapezoid",
+    "diagonal_scatter", "frexp", "gammainc", "gammaincc",
+    "histogram_bin_edges", "histogramdd", "index_fill", "is_complex",
+    "is_floating_point", "is_integer", "isin", "less", "lu_unpack",
+    "multigammaln", "ormqr", "pca_lowrank", "polygamma", "positive",
+    "reduce_as", "reverse", "select_scatter", "stft", "istft",
+    "svd_lowrank", "top_p_sampling", "unstack",
+]
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Standalone Parameter (reference creation.py create_parameter) — the
+    free-function analogue of Layer.create_parameter."""
+    from ..nn.layer import Layer
+
+    host = Layer()
+    return host.create_parameter(shape, attr=attr, dtype=dtype,
+                                 is_bias=is_bias,
+                                 default_initializer=default_initializer)
+
+
+def create_tensor(dtype="float32", name=None, persistable=False):
+    """Empty placeholder tensor (reference creation.py create_tensor)."""
+    from ..core import dtype as dtypes
+
+    return wrap(jnp.zeros((0,), dtypes.convert_dtype(dtype)))
+
+
+def add_n(inputs, name=None):
+    """Sum a list of tensors (reference math.py add_n)."""
+    if isinstance(inputs, Tensor):
+        return apply_op(lambda a: a, inputs)
+    return apply_op(lambda *xs: sum(xs[1:], xs[0]), *inputs, op_name="add_n")
+
+
+def _atleast(nd):
+    jfn = getattr(jnp, f"atleast_{nd}d")  # numpy semantics (3d appends)
+
+    def op(*xs, name=None):
+        outs = [apply_op(jfn, x, op_name=f"atleast_{nd}d") for x in xs]
+        return outs[0] if len(outs) == 1 else outs
+
+    return op
+
+
+atleast_1d = _atleast(1)
+atleast_2d = _atleast(2)
+atleast_3d = _atleast(3)
+
+
+def bitwise_invert(x, out=None, name=None):
+    return apply_op(jnp.invert, x, op_name="bitwise_invert")
+
+
+def block_diag(inputs, name=None):
+    return apply_op(lambda *xs: jax.scipy.linalg.block_diag(*xs), *inputs,
+                    op_name="block_diag")
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    """Inverse of A from its Cholesky factor (reference linalg)."""
+
+    def f(L):
+        n = L.shape[-1]
+        eye = jnp.eye(n, dtype=L.dtype)
+        return jax.scipy.linalg.cho_solve((L, not upper), eye)
+
+    return apply_op(f, x, op_name="cholesky_inverse")
+
+
+def cond(x, p=None, name=None):
+    """Matrix condition number for p in {None/2, 'fro', 'nuc', 1, -1, 2, -2,
+    inf, -inf} (reference linalg.cond)."""
+
+    def f(a):
+        if p is None or p == 2 or p == -2:
+            s = jnp.linalg.svd(a, compute_uv=False)
+            return (s[..., 0] / s[..., -1] if p is None or p == 2
+                    else s[..., -1] / s[..., 0])
+        return (jnp.linalg.norm(a, ord=p, axis=(-2, -1))
+                * jnp.linalg.norm(jnp.linalg.inv(a), ord=p, axis=(-2, -1)))
+
+    return apply_op(f, x, op_name="cond")
+
+
+def cumulative_trapezoid(y, x=None, dx=1.0, axis=-1, name=None):
+    def f(yv, xv=None):
+        yv = yv.astype(jnp.result_type(yv.dtype, jnp.float32))
+        n = yv.shape[axis]
+        y0 = jax.lax.slice_in_dim(yv, 0, n - 1, axis=axis)
+        y1 = jax.lax.slice_in_dim(yv, 1, n, axis=axis)
+        if xv is None:
+            d = dx
+        else:
+            xv = xv.astype(yv.dtype)
+            d = (jax.lax.slice_in_dim(xv, 1, xv.shape[axis], axis=axis)
+                 - jax.lax.slice_in_dim(xv, 0, xv.shape[axis] - 1, axis=axis))
+        return jnp.cumsum((y0 + y1) * d / 2.0, axis=axis)
+
+    if x is None:
+        return apply_op(f, y, op_name="cumulative_trapezoid")
+    return apply_op(f, y, x, op_name="cumulative_trapezoid")
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    def f(a, b):
+        src = jnp.moveaxis(a, (axis1, axis2), (-2, -1))
+        k = min(src.shape[-2] + min(offset, 0), src.shape[-1] - max(offset, 0))
+        rows = jnp.arange(k) + max(-offset, 0)
+        cols = jnp.arange(k) + max(offset, 0)
+        src = src.at[..., rows, cols].set(b)
+        return jnp.moveaxis(src, (-2, -1), (axis1, axis2))
+
+    return apply_op(f, x, y, op_name="diagonal_scatter")
+
+
+def frexp(x, name=None):
+    def f(a):
+        m, e = jnp.frexp(a)
+        return m, e.astype(jnp.int32)
+
+    return apply_op(f, x, op_name="frexp")
+
+
+def gammainc(x, y, name=None):
+    return apply_op(jax.scipy.special.gammainc, x, y, op_name="gammainc")
+
+
+def gammaincc(x, y, name=None):
+    return apply_op(jax.scipy.special.gammaincc, x, y, op_name="gammaincc")
+
+
+def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):
+    def f(a):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (a.min(), a.max())
+        return jnp.linspace(lo, hi, bins + 1).astype(jnp.float32)
+
+    return apply_op(f, input, op_name="histogram_bin_edges")
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    xs = np.asarray(unwrap(x))
+    ws = np.asarray(unwrap(weights)) if weights is not None else None
+    bins_in = (np.asarray(unwrap(bins))
+               if isinstance(bins, Tensor) else bins)
+    hist, edges = np.histogramdd(xs, bins=bins_in, range=ranges,
+                                 density=density, weights=ws)
+    return wrap(jnp.asarray(hist)), [wrap(jnp.asarray(e)) for e in edges]
+
+
+def index_fill(x, index, axis, value, name=None):
+    def f(a, idx):
+        moved = jnp.moveaxis(a, axis, 0)
+        moved = moved.at[idx].set(value)
+        return jnp.moveaxis(moved, 0, axis)
+
+    return apply_op(f, x, index, op_name="index_fill")
+
+
+def is_complex(x):
+    return jnp.issubdtype(unwrap(x).dtype, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(unwrap(x).dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(unwrap(x).dtype, jnp.integer)
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return apply_op(lambda a, t: jnp.isin(a, t, invert=invert), x, test_x,
+                    op_name="isin")
+
+
+def less(x, y, name=None):
+    from .comparison import less_than
+
+    return less_than(x, y)
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """(LU packed, pivots) -> P, L, U (reference linalg lu_unpack)."""
+
+    def f(lu, piv):
+        lu = jnp.asarray(lu)
+        piv = jnp.asarray(piv)
+        n = lu.shape[-2]
+        L = jnp.tril(lu, -1) + jnp.eye(n, lu.shape[-1], dtype=lu.dtype)
+        L = L[..., :, : min(lu.shape[-2:])]
+        U = jnp.triu(lu)[..., : min(lu.shape[-2:]), :]
+        # pivots (1-based sequential row swaps) -> permutation matrix
+        perm = jnp.arange(n)
+        piv0 = piv.astype(jnp.int32) - 1
+
+        def swap(i, p):
+            j = piv0[i]
+            pi, pj = p[i], p[j]
+            return p.at[i].set(pj).at[j].set(pi)
+
+        perm = jax.lax.fori_loop(0, piv.shape[-1], swap, perm)
+        P = jnp.eye(n, dtype=lu.dtype)[perm].T
+        return P, L, U
+
+    return apply_op(f, x, y, op_name="lu_unpack")
+
+
+def multigammaln(x, p, name=None):
+    return apply_op(lambda a: jax.scipy.special.multigammaln(a, p), x,
+                    op_name="multigammaln")
+
+
+def ormqr(x, tau, other, left=True, transpose=False, name=None):
+    """Multiply `other` by Q from a QR's householder form."""
+
+    def f(a, t, o):
+        q = jax.lax.linalg.householder_product(a, t)
+        qm = q.T if transpose else q
+        return qm @ o if left else o @ qm
+
+    return apply_op(f, x, tau, other, op_name="ormqr")
+
+
+def _lowrank_svd(a, q):
+    u, s, vt = jnp.linalg.svd(a, full_matrices=False)
+    return u[..., :q], s[..., :q], vt[..., :q, :].swapaxes(-1, -2)
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    def f(a):
+        return _lowrank_svd(a if M is None else a - unwrap(M),
+                            min(q, *a.shape[-2:]))
+
+    return apply_op(f, x, op_name="svd_lowrank")
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    def f(a):
+        k = q if q is not None else min(6, *a.shape[-2:])
+        if center:
+            a = a - a.mean(axis=-2, keepdims=True)
+        return _lowrank_svd(a, min(k, *a.shape[-2:]))
+
+    return apply_op(f, x, op_name="pca_lowrank")
+
+
+def polygamma(x, n, name=None):
+    return apply_op(lambda a: jax.scipy.special.polygamma(n, a), x,
+                    op_name="polygamma")
+
+
+def positive(x, name=None):
+    return apply_op(lambda a: +a, x, op_name="positive")
+
+
+def reduce_as(x, target, name=None):
+    """Sum-reduce x down to target's shape (reference reduce_as)."""
+
+    def f(a, t):
+        extra = a.ndim - t.ndim
+        if extra:
+            a = a.sum(axis=tuple(range(extra)))
+        axes = tuple(i for i, (da, dt) in enumerate(zip(a.shape, t.shape))
+                     if da != dt)
+        return a.sum(axis=axes, keepdims=True) if axes else a
+
+    return apply_op(f, x, target, op_name="reduce_as")
+
+
+def reverse(x, axis, name=None):
+    from .manipulation import flip
+
+    return flip(x, axis)
+
+
+def select_scatter(x, values, axis, index, name=None):
+    def f(a, v):
+        moved = jnp.moveaxis(a, axis, 0)
+        moved = moved.at[index].set(v)
+        return jnp.moveaxis(moved, 0, axis)
+
+    return apply_op(f, x, values, op_name="select_scatter")
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Short-time Fourier transform (reference signal.py stft): frame with a
+    strided gather, window, batch FFT — one fused XLA program."""
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+
+    def f(a, w=None):
+        squeeze = a.ndim == 1
+        if squeeze:
+            a = a[None]
+        if center:
+            a = jnp.pad(a, [(0, 0), (n_fft // 2, n_fft // 2)], mode=pad_mode)
+        n_frames = 1 + (a.shape[-1] - n_fft) // hop
+        idx = (jnp.arange(n_frames)[:, None] * hop
+               + jnp.arange(n_fft)[None, :])          # [frames, n_fft]
+        frames = a[:, idx]                            # [b, frames, n_fft]
+        if w is None:
+            w_ = jnp.ones((wl,), frames.dtype)
+        else:
+            w_ = w.astype(frames.dtype)
+        pad_w = (n_fft - wl) // 2
+        w_ = jnp.pad(w_, (pad_w, n_fft - wl - pad_w))
+        frames = frames * w_
+        spec = (jnp.fft.rfft(frames, n=n_fft, axis=-1) if onesided
+                else jnp.fft.fft(frames, n=n_fft, axis=-1))
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        out = jnp.swapaxes(spec, -1, -2)              # [b, freq, frames]
+        return out[0] if squeeze else out
+
+    if window is None:
+        return apply_op(f, x, op_name="stft")
+    return apply_op(f, x, window, op_name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT via windowed overlap-add (reference signal.py istft)."""
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+
+    def f(spec, w=None):
+        squeeze = spec.ndim == 2
+        if squeeze:
+            spec = spec[None]
+        spec = jnp.swapaxes(spec, -1, -2)             # [b, frames, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        frames = (jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided
+                  else jnp.fft.ifft(spec, n=n_fft, axis=-1).real)
+        if w is None:
+            w_ = jnp.ones((wl,), frames.dtype)
+        else:
+            w_ = w.astype(frames.dtype)
+        pad_w = (n_fft - wl) // 2
+        w_ = jnp.pad(w_, (pad_w, n_fft - wl - pad_w))
+        n_frames = frames.shape[-2]
+        total = n_fft + hop * (n_frames - 1)
+        idx = (jnp.arange(n_frames)[:, None] * hop
+               + jnp.arange(n_fft)[None, :]).reshape(-1)
+        sig = jnp.zeros((frames.shape[0], total), frames.dtype)
+        sig = sig.at[:, idx].add((frames * w_).reshape(frames.shape[0], -1))
+        win_sq = jnp.zeros((total,), frames.dtype)
+        win_sq = win_sq.at[idx].add(jnp.tile(w_ * w_, n_frames))
+        sig = sig / jnp.maximum(win_sq, 1e-11)
+        if center:
+            sig = sig[:, n_fft // 2:]
+            sig = sig[:, : (length if length is not None
+                            else total - n_fft)]
+        elif length is not None:
+            sig = sig[:, :length]
+        return sig[0] if squeeze else sig
+
+    if window is None:
+        return apply_op(f, x, op_name="istft")
+    return apply_op(f, x, window, op_name="istft")
+
+
+def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
+    """Nucleus sampling over the last dim (reference top_p_sampling): keep the
+    smallest prefix of sorted probs with cumsum <= p, sample from it."""
+
+    def f(probs, p, key):
+        order = jnp.argsort(-probs, axis=-1)
+        sorted_p = jnp.take_along_axis(probs, order, axis=-1)
+        cum = jnp.cumsum(sorted_p, axis=-1)
+        keep = cum - sorted_p <= p  # always keep the first token
+        masked = jnp.where(keep, sorted_p, 0.0)
+        masked = masked / masked.sum(-1, keepdims=True)
+        choice = jax.random.categorical(key, jnp.log(masked + 1e-30), axis=-1)
+        ids = jnp.take_along_axis(order, choice[..., None], axis=-1)
+        scores = jnp.take_along_axis(probs, ids, axis=-1)
+        return scores, ids.astype(jnp.int64)
+
+    from ..core import random as prandom
+
+    key = (jax.random.PRNGKey(seed) if seed is not None and seed >= 0
+           else prandom.next_key())
+    return apply_op(f, x, ps, key, op_name="top_p_sampling")
+
+
+def unstack(x, axis=0, num=None, name=None):
+    def f(a):
+        n = num or a.shape[axis]
+        return tuple(jnp.squeeze(s, axis)
+                     for s in jnp.split(a, n, axis=axis))
+
+    return apply_op(f, x, op_name="unstack")
+
+
+# ---------------------------------------------------------------------------
+# stacking / combinatorics / distance tail (reference manipulation.py, math.py)
+# ---------------------------------------------------------------------------
+
+
+def hstack(x, name=None):
+    return apply_op(lambda *xs: jnp.hstack(xs), *x, op_name="hstack")
+
+
+def vstack(x, name=None):
+    return apply_op(lambda *xs: jnp.vstack(xs), *x, op_name="vstack")
+
+
+def dstack(x, name=None):
+    return apply_op(lambda *xs: jnp.dstack(xs), *x, op_name="dstack")
+
+
+def column_stack(x, name=None):
+    return apply_op(lambda *xs: jnp.column_stack(xs), *x,
+                    op_name="column_stack")
+
+
+def row_stack(x, name=None):
+    return vstack(x)
+
+
+def cartesian_prod(x, name=None):
+    def f(*xs):
+        grids = jnp.meshgrid(*xs, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+
+    return apply_op(f, *x, op_name="cartesian_prod")
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    import itertools
+
+    n = unwrap(x).shape[0]
+    combos = (itertools.combinations_with_replacement(range(n), r)
+              if with_replacement else itertools.combinations(range(n), r))
+    idx = np.array(list(combos), np.int64).reshape(-1, r)
+    return apply_op(lambda a: jnp.asarray(a)[jnp.asarray(idx)], x,
+                    op_name="combinations")
+
+
+def pdist(x, p=2.0, name=None):
+    def f(a):
+        n = a.shape[0]
+        iu, ju = jnp.triu_indices(n, k=1)
+        d = a[iu] - a[ju]
+        return jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p)
+
+    return apply_op(f, x, op_name="pdist")
+
+
+def vecdot(x, y, axis=-1, name=None):
+    return apply_op(lambda a, b: jnp.sum(a * b, axis=axis), x, y,
+                    op_name="vecdot")
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    def f(a):
+        moved = jnp.moveaxis(a, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        norms = jnp.sum(jnp.abs(flat) ** p, axis=-1) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-12), 1.0)
+        out = flat * factor[:, None]
+        return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+
+    return apply_op(f, x, op_name="renorm")
+
+
+def standard_gamma(x, name=None):
+    from ..core import random as prandom
+
+    def f(alpha, key):
+        import jax
+
+        return jax.random.gamma(key, alpha)
+
+    return apply_op(f, x, prandom.next_key(), op_name="standard_gamma")
+
+
+def binomial(count, prob, name=None):
+    from ..core import random as prandom
+
+    def f(n, p, key):
+        import jax
+
+        return jax.random.binomial(key, n.astype(jnp.float32),
+                                   p.astype(jnp.float32)).astype(jnp.int64)
+
+    return apply_op(f, count, prob, prandom.next_key(), op_name="binomial")
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, dtype=None, name=None):
+    from ..core import random as prandom
+
+    def f(key):
+        import jax
+
+        return jnp.exp(mean + std * jax.random.normal(
+            key, tuple(shape or [1]), jnp.float32))
+
+    return apply_op(f, prandom.next_key(), op_name="log_normal")
+
+
+# -- dlpack interop (reference python/paddle/utils/dlpack.py) ----------------
+
+
+def to_dlpack(x):
+    import jax
+
+    return jax.dlpack.to_dlpack(unwrap(x))
+
+
+def from_dlpack(capsule):
+    import jax
+
+    try:
+        arr = jax.dlpack.from_dlpack(capsule)
+    except Exception:
+        arr = jnp.asarray(np.from_dlpack(capsule))
+    return wrap(arr)

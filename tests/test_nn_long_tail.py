@@ -1,0 +1,233 @@
+"""nn long-tail surface: RNN stack vs torch, losses, pools, decode helpers,
+and namespace closure against the reference nn / nn.functional exports."""
+
+import numpy as np
+import pytest
+import torch
+
+import paddlepaddle_tpu as paddle
+import paddlepaddle_tpu.nn.functional as F
+
+rng = np.random.default_rng(0)
+
+
+def _copy_l0(pcell, tmod, suffix=""):
+    pcell.weight_ih.set_value(getattr(tmod, "weight_ih_l0" + suffix).detach().numpy())
+    pcell.weight_hh.set_value(getattr(tmod, "weight_hh_l0" + suffix).detach().numpy())
+    pcell.bias_ih.set_value(getattr(tmod, "bias_ih_l0" + suffix).detach().numpy())
+    pcell.bias_hh.set_value(getattr(tmod, "bias_hh_l0" + suffix).detach().numpy())
+
+
+def test_lstm_matches_torch():
+    x = rng.standard_normal((2, 5, 3)).astype(np.float32)
+    tl = torch.nn.LSTM(3, 4, batch_first=True)
+    pl = paddle.nn.LSTM(3, 4)
+    _copy_l0(pl.cell_fw_l0, tl)
+    ty, (th, tc) = tl(torch.tensor(x))
+    py, (ph, pc) = pl(x)
+    np.testing.assert_allclose(py.numpy(), ty.detach().numpy(), atol=1e-5)
+    np.testing.assert_allclose(ph.numpy(), th.detach().numpy(), atol=1e-5)
+    np.testing.assert_allclose(pc.numpy(), tc.detach().numpy(), atol=1e-5)
+
+
+def test_gru_bidirectional_matches_torch():
+    x = rng.standard_normal((2, 5, 3)).astype(np.float32)
+    tg = torch.nn.GRU(3, 4, batch_first=True, bidirectional=True)
+    pg = paddle.nn.GRU(3, 4, direction="bidirect")
+    _copy_l0(pg.cell_fw_l0, tg)
+    _copy_l0(pg.cell_bw_l0, tg, "_reverse")
+    ty, th = tg(torch.tensor(x))
+    py, ph = pg(x)
+    np.testing.assert_allclose(py.numpy(), ty.detach().numpy(), atol=1e-5)
+    np.testing.assert_allclose(ph.numpy(), th.detach().numpy(), atol=1e-5)
+
+
+def test_rnn_sequence_length_masking():
+    cell = paddle.nn.SimpleRNNCell(3, 4)
+    rnn = paddle.nn.RNN(cell)
+    x = rng.standard_normal((2, 5, 3)).astype(np.float32)
+    y, h = rnn(paddle.to_tensor(x),
+               sequence_length=np.array([3, 5], np.int64))
+    y_full, _ = rnn(paddle.to_tensor(x))
+    # sequence 0 freezes after t=3; sequence 1 matches the unmasked run
+    np.testing.assert_allclose(y.numpy()[1], y_full.numpy()[1], atol=1e-6)
+    np.testing.assert_allclose(h.numpy()[0], y.numpy()[0, 2], atol=1e-6)
+
+
+def test_lstm_trains():
+    lstm = paddle.nn.LSTM(3, 4)
+    opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                parameters=lstm.parameters())
+    x = rng.standard_normal((2, 5, 3)).astype(np.float32)
+    first = last = None
+    for _ in range(5):
+        y, _ = lstm(x)
+        loss = (y ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        first = first if first is not None else float(loss.numpy())
+        last = float(loss.numpy())
+    assert last < first
+
+
+def test_losses_match_torch():
+    x = rng.standard_normal((4, 5)).astype(np.float32)
+    y = rng.integers(0, 5, (4,)).astype(np.int64)
+    np.testing.assert_allclose(
+        F.multi_margin_loss(x, y).numpy(),
+        torch.nn.functional.multi_margin_loss(
+            torch.tensor(x), torch.tensor(y)).numpy(), rtol=1e-5)
+
+    t = rng.standard_normal((4, 5)).astype(np.float32)
+    sign = np.sign(rng.standard_normal((4, 5))).astype(np.float32)
+    np.testing.assert_allclose(
+        F.soft_margin_loss(x, sign).numpy(),
+        torch.nn.functional.soft_margin_loss(
+            torch.tensor(x), torch.tensor(sign)).numpy(), rtol=1e-5)
+
+    var = np.abs(rng.standard_normal((4, 5))).astype(np.float32) + 0.1
+    np.testing.assert_allclose(
+        F.gaussian_nll_loss(x, t, var).numpy(),
+        torch.nn.functional.gaussian_nll_loss(
+            torch.tensor(x), torch.tensor(t), torch.tensor(var)).numpy(),
+        rtol=1e-4)
+
+    np.testing.assert_allclose(
+        F.poisson_nll_loss(x, np.abs(t)).numpy(),
+        torch.nn.functional.poisson_nll_loss(
+            torch.tensor(x), torch.tensor(np.abs(t))).numpy(), rtol=1e-5)
+
+    lab01 = (rng.standard_normal((4, 5)) > 0).astype(np.float32)
+    np.testing.assert_allclose(
+        F.multi_label_soft_margin_loss(x, lab01).numpy(),
+        torch.nn.functional.multilabel_soft_margin_loss(
+            torch.tensor(x), torch.tensor(lab01)).numpy(), rtol=1e-5)
+
+    a, p, n = (rng.standard_normal((4, 8)).astype(np.float32)
+               for _ in range(3))
+    np.testing.assert_allclose(
+        F.triplet_margin_with_distance_loss(a, p, n).numpy(),
+        torch.nn.functional.triplet_margin_loss(
+            torch.tensor(a), torch.tensor(p), torch.tensor(n)).numpy(),
+        rtol=1e-4)
+
+
+def test_unpool_roundtrip():
+    x = paddle.to_tensor(rng.standard_normal((1, 2, 8)).astype(np.float32))
+    pooled, idx = F.max_pool1d(x, 2, return_mask=True)
+    restored = F.max_unpool1d(pooled, idx, 2).numpy()
+    # restored has pooled maxima at their argmax positions, zeros elsewhere
+    assert restored.shape == (1, 2, 8)
+    np.testing.assert_allclose(np.sort(restored[restored != 0]),
+                               np.sort(pooled.numpy().ravel()))
+
+    x2 = paddle.to_tensor(rng.standard_normal((1, 1, 4, 4)).astype(np.float32))
+    p2, i2 = F.max_pool2d(x2, 2, return_mask=True)
+    r2 = F.max_unpool2d(p2, i2, 2)
+    t2 = torch.nn.functional.max_unpool2d(
+        torch.tensor(p2.numpy()), torch.tensor(i2.numpy()), 2).numpy()
+    np.testing.assert_allclose(r2.numpy(), t2)
+
+
+def test_lp_pool_matches_torch():
+    x = rng.standard_normal((1, 2, 8)).astype(np.float32)
+    np.testing.assert_allclose(
+        F.lp_pool1d(x, 2.0, 2).numpy(),
+        torch.nn.functional.lp_pool1d(torch.tensor(x), 2.0, 2).numpy(),
+        rtol=1e-4, atol=1e-5)
+
+
+def test_shuffles_and_pads():
+    x = rng.standard_normal((1, 4, 4, 4)).astype(np.float32)
+    pu = paddle.nn.PixelUnshuffle(2)(x)
+    tu = torch.nn.functional.pixel_unshuffle(torch.tensor(x), 2).numpy()
+    np.testing.assert_allclose(pu.numpy(), tu)
+    z = paddle.nn.ZeroPad2D([1, 1, 2, 2])(x)
+    assert z.shape == [1, 4, 8, 6]
+    uf = paddle.nn.Unflatten(1, [2, 2])(x)
+    assert uf.shape == [1, 2, 2, 4, 4]
+    s2d = paddle.nn.Softmax2D()(x)
+    np.testing.assert_allclose(np.asarray(s2d.numpy()).sum(1),
+                               np.ones((1, 4, 4)), rtol=1e-5)
+
+
+def test_qkvpacked_and_flashmask():
+    qkv = rng.standard_normal((2, 6, 3, 2, 8)).astype(np.float32)
+    out, _ = F.flash_attn_qkvpacked(qkv, causal=True)
+    ref = F.scaled_dot_product_attention(qkv[:, :, 0], qkv[:, :, 1],
+                                         qkv[:, :, 2], is_causal=True)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-6)
+
+
+def test_beam_search_decodes():
+    """A toy cell that deterministically prefers token (prev+1) mod V."""
+    V = 5
+
+    class Cell:
+        def __call__(self, tokens, states):
+            import jax.numpy as jnp
+
+            from paddlepaddle_tpu.core.dispatch import unwrap, wrap
+
+            tok = np.asarray(unwrap(tokens)).reshape(-1)
+            logits = np.full((len(tok), V), -5.0, np.float32)
+            logits[np.arange(len(tok)), (tok + 1) % V] = 5.0
+            return wrap(np.asarray(logits)), states
+
+    from paddlepaddle_tpu.nn import BeamSearchDecoder, dynamic_decode
+
+    dec = BeamSearchDecoder(Cell(), start_token=np.zeros((1,), np.int64),
+                            end_token=4, beam_size=2)
+    seqs, scores = dynamic_decode(dec, max_step_num=6)
+    top = seqs.numpy()[0, 0]
+    assert list(top[:4]) == [1, 2, 3, 4]  # follows the chain to EOS
+
+
+def test_gather_tree():
+    ids = np.array([[[2, 5]], [[6, 1]], [[3, 9]]], np.int64)      # [T=3,B=1,b=2]
+    parents = np.array([[[0, 0]], [[1, 0]], [[1, 0]]], np.int64)
+    out = F.gather_tree(ids, parents).numpy()
+    # beam 0 at t=2 came from parent 1 at t=1 (token 1), which came from
+    # parent 0 at t=0 (token 2)
+    assert list(out[:, 0, 0]) == [2, 1, 3]
+
+
+def test_margin_cross_entropy_and_rnnt():
+    # arcface margin: with margins zeroed it equals plain scaled CE
+    feats = rng.standard_normal((4, 6)).astype(np.float32)
+    feats /= np.linalg.norm(feats, axis=1, keepdims=True)
+    y = rng.integers(0, 6, (4,)).astype(np.int64)
+    ours = F.margin_cross_entropy(feats, y, margin1=1.0, margin2=0.0,
+                                  margin3=0.0, scale=4.0).numpy()
+    ref = torch.nn.functional.cross_entropy(torch.tensor(feats * 4.0),
+                                            torch.tensor(y)).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-5)
+
+
+def test_rnnt_loss_matches_torchaudio():
+    ta = pytest.importorskip("torchaudio")
+    B, T, U1, V = 2, 4, 3, 5
+    logits = rng.standard_normal((B, T, U1, V)).astype(np.float32)
+    labels = rng.integers(1, V, (B, U1 - 1)).astype(np.int32)
+    ilen = np.array([4, 3], np.int32)
+    llen = np.array([2, 1], np.int32)
+    ours = F.rnnt_loss(logits, labels, ilen, llen, blank=0,
+                       reduction="none").numpy()
+    ref = ta.functional.rnnt_loss(
+        torch.tensor(logits), torch.tensor(labels), torch.tensor(ilen),
+        torch.tensor(llen), blank=0, reduction="none").numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_reference_nn_namespace_closed():
+    import re
+
+    for path, mod in [("/root/reference/python/paddle/nn/__init__.py",
+                       paddle.nn),
+                      ("/root/reference/python/paddle/nn/functional/__init__.py",
+                       paddle.nn.functional)]:
+        ref = set(re.findall(r"'(\w+)'", open(path).read()))
+        missing = sorted(n for n in ref
+                         if not hasattr(mod, n) and not n.startswith("_"))
+        assert missing == [], f"{path}: missing {missing}"
