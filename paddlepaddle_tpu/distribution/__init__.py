@@ -656,3 +656,232 @@ class TransformedDistribution(Distribution):
             return unwrap(self.base.log_prob(Tensor._from_data(x))) - ldj
 
         return apply_op(f, value)
+
+
+class Binomial(Distribution):
+    """Reference python/paddle/distribution/binomial.py."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _as_array(total_count)
+        self.probs = _as_array(probs)
+        super().__init__(jnp.broadcast_shapes(jnp.shape(self.total_count),
+                                              jnp.shape(self.probs)))
+
+    @property
+    def mean(self):
+        return Tensor._from_data(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return Tensor._from_data(
+            self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        n = jnp.broadcast_to(self.total_count, self.batch_shape)
+        p = jnp.broadcast_to(self.probs, self.batch_shape)
+        out = jax.random.binomial(prandom.next_key(),
+                                  jnp.broadcast_to(n, tuple(shape) + n.shape),
+                                  p)
+        return Tensor._from_data(out)
+
+    def log_prob(self, value):
+        v = _as_array(value)
+        n, p = self.total_count, self.probs
+        logc = (jax.scipy.special.gammaln(n + 1)
+                - jax.scipy.special.gammaln(v + 1)
+                - jax.scipy.special.gammaln(n - v + 1))
+        return Tensor._from_data(
+            logc + v * jnp.log(p) + (n - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        n, p = self.total_count, self.probs
+        if jnp.ndim(n) == 0 and int(n) <= 1024:
+            # exact: -sum_k pmf(k) log pmf(k)
+            k = jnp.arange(int(n) + 1, dtype=jnp.float32)
+            logc = (jax.scipy.special.gammaln(n + 1.0)
+                    - jax.scipy.special.gammaln(k + 1)
+                    - jax.scipy.special.gammaln(n - k + 1))
+            lp = logc + k * jnp.log(p) + (n - k) * jnp.log1p(-p)
+            return Tensor._from_data(-jnp.sum(jnp.exp(lp) * lp, axis=-1))
+        # Gaussian approximation for large/batched n
+        return Tensor._from_data(
+            0.5 * jnp.log(2 * jnp.pi * jnp.e * n * p * (1 - p) + 1e-12))
+
+
+class ContinuousBernoulli(Distribution):
+    """Reference continuous_bernoulli.py: density proportional to
+    lambda^x (1-lambda)^(1-x) on [0, 1]."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = _as_array(probs)
+        self._lims = lims
+        super().__init__(jnp.shape(self.probs))
+
+    def _log_norm(self):
+        lam = self.probs
+        near_half = jnp.abs(lam - 0.5) < (self._lims[1] - 0.5)
+        safe = jnp.where(near_half, 0.25, lam)
+        c = jnp.log((2 * jnp.arctanh(1 - 2 * safe)) / (1 - 2 * safe))
+        taylor = jnp.log(2.0) + 4.0 / 3.0 * (lam - 0.5) ** 2
+        return jnp.where(near_half, taylor, c)
+
+    @property
+    def mean(self):
+        lam = self.probs
+        near_half = jnp.abs(lam - 0.5) < (self._lims[1] - 0.5)
+        safe = jnp.where(near_half, 0.25, lam)
+        m = safe / (2 * safe - 1) + 1 / (2 * jnp.arctanh(1 - 2 * safe))
+        return Tensor._from_data(jnp.where(near_half, 0.5, m))
+
+    def log_prob(self, value):
+        v = _as_array(value)
+        return Tensor._from_data(
+            v * jnp.log(self.probs) + (1 - v) * jnp.log1p(-self.probs)
+            + self._log_norm())
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(prandom.next_key(),
+                               tuple(shape) + self.batch_shape)
+        lam = self.probs
+        near_half = jnp.abs(lam - 0.5) < (self._lims[1] - 0.5)
+        safe = jnp.where(near_half, 0.25, lam)
+        # inverse CDF
+        x = (jnp.log1p(u * (2 * safe - 1) / (1 - safe))
+             / (jnp.log(safe) - jnp.log1p(-safe)))
+        return Tensor._from_data(jnp.where(near_half, u, x))
+
+
+class Independent(Distribution):
+    """Reinterpret batch dims as event dims (reference independent.py)."""
+
+    def __init__(self, base, reinterpreted_batch_rank=1):
+        self.base = base
+        self._rank = reinterpreted_batch_rank
+        bshape = tuple(base.batch_shape)
+        cut = len(bshape) - reinterpreted_batch_rank
+        super().__init__(bshape[:cut],
+                         bshape[cut:] + tuple(base.event_shape))
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        data = lp._data if isinstance(lp, Tensor) else jnp.asarray(lp)
+        axes = tuple(range(data.ndim - self._rank, data.ndim))
+        return Tensor._from_data(jnp.sum(data, axis=axes))
+
+    def entropy(self):
+        ent = self.base.entropy()
+        data = ent._data if isinstance(ent, Tensor) else jnp.asarray(ent)
+        axes = tuple(range(data.ndim - self._rank, data.ndim))
+        return Tensor._from_data(jnp.sum(data, axis=axes))
+
+
+class MultivariateNormal(Distribution):
+    """Reference multivariate_normal.py (loc + covariance_matrix)."""
+
+    def __init__(self, loc, covariance_matrix=None, scale_tril=None,
+                 name=None):
+        self.loc = _as_array(loc)
+        if scale_tril is not None:
+            self._tril = _as_array(scale_tril)
+            self.covariance_matrix = self._tril @ jnp.swapaxes(
+                self._tril, -1, -2)
+        else:
+            self.covariance_matrix = _as_array(covariance_matrix)
+            self._tril = jnp.linalg.cholesky(self.covariance_matrix)
+        super().__init__(jnp.shape(self.loc)[:-1], jnp.shape(self.loc)[-1:])
+
+    @property
+    def mean(self):
+        return Tensor._from_data(self.loc)
+
+    @property
+    def variance(self):
+        return Tensor._from_data(jnp.diagonal(self.covariance_matrix,
+                                              axis1=-2, axis2=-1))
+
+    def sample(self, shape=()):
+        d = self.loc.shape[-1]
+        eps = jax.random.normal(prandom.next_key(),
+                                tuple(shape) + self.loc.shape)
+        return Tensor._from_data(
+            self.loc + jnp.einsum("...ij,...j->...i", self._tril, eps))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _as_array(value)
+        d = self.loc.shape[-1]
+        diff = v - self.loc
+        sol = jax.scipy.linalg.solve_triangular(self._tril, diff[..., None],
+                                                lower=True)[..., 0]
+        maha = jnp.sum(sol ** 2, axis=-1)
+        logdet = 2 * jnp.sum(jnp.log(jnp.diagonal(self._tril, axis1=-2,
+                                                  axis2=-1)), axis=-1)
+        return Tensor._from_data(
+            -0.5 * (maha + d * jnp.log(2 * jnp.pi) + logdet))
+
+    def entropy(self):
+        d = self.loc.shape[-1]
+        logdet = 2 * jnp.sum(jnp.log(jnp.diagonal(self._tril, axis1=-2,
+                                                  axis2=-1)), axis=-1)
+        return Tensor._from_data(0.5 * (d * (1 + jnp.log(2 * jnp.pi))
+                                        + logdet))
+
+
+class LKJCholesky(Distribution):
+    """LKJ prior over correlation-matrix Cholesky factors (reference
+    lkj_cholesky.py), sampled with the onion method."""
+
+    def __init__(self, dim, concentration=1.0, sample_method="onion",
+                 name=None):
+        self.dim = int(dim)
+        self.concentration = float(concentration)
+        super().__init__((), (self.dim, self.dim))
+
+    def sample(self, shape=()):
+        d = self.dim
+        eta = self.concentration
+        key = prandom.next_key()
+        L = jnp.zeros(tuple(shape) + (d, d), jnp.float32)
+        L = L.at[..., 0, 0].set(1.0)
+        for i in range(1, d):
+            key, k1, k2 = jax.random.split(key, 3)
+            beta_val = jax.random.beta(k1, i / 2.0,
+                                       eta + (d - 1 - i) / 2.0,
+                                       tuple(shape)).astype(jnp.float32)
+            u = jax.random.normal(k2, tuple(shape) + (i,))
+            u = u / jnp.linalg.norm(u, axis=-1, keepdims=True)
+            w = jnp.sqrt(beta_val)[..., None] * u
+            L = L.at[..., i, :i].set(w)
+            L = L.at[..., i, i].set(jnp.sqrt(jnp.maximum(1 - beta_val, 0.0)))
+        return Tensor._from_data(L)
+
+    def log_prob(self, value):
+        v = _as_array(value)
+        d = self.dim
+        eta = self.concentration
+        diag = jnp.diagonal(v, axis1=-2, axis2=-1)[..., 1:]
+        orders = jnp.arange(d - 1, 0, -1, dtype=jnp.float32)
+        unnorm = jnp.sum((2 * (eta - 1) + orders - 1) * jnp.log(diag),
+                         axis=-1)
+        # normalization (Stan reference form)
+        i = jnp.arange(1, d, dtype=jnp.float32)
+        alpha = eta + (d - 1 - i) / 2.0
+        lognorm = jnp.sum(0.5 * i * jnp.log(jnp.pi)
+                          + jax.scipy.special.gammaln(alpha)
+                          - jax.scipy.special.gammaln(alpha + i / 2.0))
+        return Tensor._from_data(unnorm - lognorm)

@@ -81,3 +81,48 @@ def fftshift(x, axes=None, name=None):
 
 def ifftshift(x, axes=None, name=None):
     return apply_op(lambda a: jnp.fft.ifftshift(a, axes=axes), x)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    """Hermitian-input 2-D FFT (reference fft.py hfft2; scipy semantics)."""
+    return hfftn(x, s=s, axes=axes, norm=norm)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    """hfftn(a) == irfftn(conj(a)) scaled to forward-transform convention."""
+
+    def f(a):
+        ax = tuple(axes) if axes is not None else tuple(range(-a.ndim, 0))
+        out = jnp.fft.irfftn(jnp.conj(a), s=s, axes=ax, norm=_norm(norm))
+        scale = 1.0
+        for d in ax:
+            scale *= out.shape[d]
+        if norm in (None, "backward"):
+            out = out * scale          # forward-transform convention
+        elif norm == "forward":
+            out = out / scale          # numpy swaps the norm direction
+        return out
+
+    return apply_op(f, x)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ihfftn(x, s=s, axes=axes, norm=norm)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    """Inverse of hfftn: conj(rfftn(a)) with 1/N scaling."""
+
+    def f(a):
+        ax = tuple(axes) if axes is not None else tuple(range(-a.ndim, 0))
+        out = jnp.conj(jnp.fft.rfftn(a, s=s, axes=ax, norm=_norm(norm)))
+        scale = 1.0
+        for d in ax:
+            scale *= a.shape[d]
+        if norm in (None, "backward"):
+            out = out / scale
+        elif norm == "forward":
+            out = out * scale          # numpy swaps the norm direction
+        return out
+
+    return apply_op(f, x)

@@ -205,11 +205,18 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
 
 
 def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
-              fastemit_lambda=0.001, reduction="mean", name=None):
+              fastemit_lambda=0.0, reduction="mean", name=None):
     """RNN-T loss: log-space alpha recursion over the (T, U) lattice as a
-    lax.scan over anti-diagonals (reference loss.py rnnt_loss / warprnnt)."""
+    lax.scan over anti-diagonals (reference loss.py rnnt_loss / warprnnt).
+    FastEmit regularization is not implemented — nonzero fastemit_lambda
+    raises rather than silently training without the latency term."""
     import jax
     import jax.numpy as jnp
+
+    if fastemit_lambda:
+        raise NotImplementedError(
+            "fastemit_lambda != 0 (FastEmit gradient scaling) is not "
+            "implemented; pass fastemit_lambda=0")
 
     def f(logits, labels, ilen, llen):
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
@@ -260,6 +267,11 @@ def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
 
     n_clusters = len(tail_weights)
     head_size = cutoffs[0] + n_clusters
+    hw_cols = unwrap(head_weight).shape[-1]
+    if hw_cols != head_size:
+        raise ValueError(
+            f"head_weight trailing dim {hw_cols} != cutoff[0] + n_clusters "
+            f"= {head_size}")
 
     hw = unwrap(head_weight)
     hb = unwrap(head_bias) if head_bias is not None else None
@@ -301,7 +313,6 @@ def _unpool(x, indices, spatial_shape):
     import jax.numpy as jnp
 
     def f(a, idx):
-        lead = a.shape[:-len(a.shape[2:]) or None]
         n, c = a.shape[0], a.shape[1]
         flat_len = int(np.prod(spatial_shape))
         av = a.reshape(n, c, -1)

@@ -3,6 +3,7 @@
 from . import lr  # noqa: F401
 from .optimizer import Optimizer  # noqa: F401
 from .optimizers import (  # noqa: F401
+    ASGD,
     SGD,
     Adadelta,
     Adagrad,
@@ -12,5 +13,8 @@ from .optimizers import (  # noqa: F401
     Lamb,
     LBFGS,
     Momentum,
+    NAdam,
+    RAdam,
     RMSProp,
+    Rprop,
 )

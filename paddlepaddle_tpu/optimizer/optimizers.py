@@ -347,3 +347,135 @@ class LBFGS(Optimizer):
             flat_grad = new_grad
         self._step_count += 1
         return orig_loss
+
+
+class ASGD(Optimizer):
+    """Averaged SGD (reference python/paddle/optimizer/asgd.py): keeps a
+    running average of recent gradients in a circular buffer of size d and
+    steps with the average."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self._d = max(1, int(batch_num))
+
+    def _init_slots(self, p):
+        return {
+            "d": jnp.zeros_like(p, jnp.float32),       # running sum
+            "ys": jnp.zeros((self._d,) + tuple(p.shape), jnp.float32),
+            "n": jnp.zeros([], jnp.int32),
+        }
+
+    def _rule(self, p, g, slots, lr, wd_scale=1.0):
+        n = slots["n"]
+        idx = n % self._d
+        old = slots["ys"][idx]
+        d_new = slots["d"] - old + g
+        ys_new = slots["ys"].at[idx].set(g.astype(jnp.float32))
+        count = jnp.minimum(n + 1, self._d).astype(jnp.float32)
+        new_p = p - lr * d_new / count
+        return new_p, {"d": d_new, "ys": ys_new, "n": n + 1}
+
+
+class NAdam(Adam):
+    """Nesterov Adam (reference nadam.py): momentum schedule
+    mu_t = b1*(1 - 0.5*0.96^(t*0.004)) with the Nesterov lookahead update."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip,
+                         multi_precision=multi_precision)
+        self._psi = momentum_decay
+
+    def _init_slots(self, p):
+        return {
+            "moment1": jnp.zeros_like(p, jnp.float32),
+            "moment2": jnp.zeros_like(p, jnp.float32),
+            "mu_prod": jnp.ones([], jnp.float32),
+            "beta2_pow": jnp.ones([], jnp.float32),
+            "t": jnp.zeros([], jnp.float32),
+        }
+
+    def _rule(self, p, g, slots, lr, wd_scale=1.0):
+        b1, b2 = self._beta1, self._beta2
+        t = slots["t"] + 1.0
+        mu_t = b1 * (1.0 - 0.5 * 0.96 ** (t * self._psi))
+        mu_t1 = b1 * (1.0 - 0.5 * 0.96 ** ((t + 1.0) * self._psi))
+        mu_prod = slots["mu_prod"] * mu_t
+        b2p = slots["beta2_pow"] * b2
+        m1 = b1 * slots["moment1"] + (1 - b1) * g
+        m2 = b2 * slots["moment2"] + (1 - b2) * g * g
+        m1_hat = (mu_t1 * m1 / (1 - mu_prod * mu_t1)
+                  + (1 - mu_t) * g / (1 - mu_prod))
+        m2_hat = m2 / (1 - b2p)
+        new_p = p - lr * m1_hat / (jnp.sqrt(m2_hat) + self._eps)
+        return new_p, {"moment1": m1, "moment2": m2, "mu_prod": mu_prod,
+                       "beta2_pow": b2p, "t": t}
+
+
+class RAdam(Adam):
+    """Rectified Adam (reference radam.py): variance-rectification term
+    switches between SGD-with-momentum and Adam as rho_t grows."""
+
+    def _init_slots(self, p):
+        return {
+            "moment1": jnp.zeros_like(p, jnp.float32),
+            "moment2": jnp.zeros_like(p, jnp.float32),
+            "beta1_pow": jnp.ones([], jnp.float32),
+            "beta2_pow": jnp.ones([], jnp.float32),
+            "t": jnp.zeros([], jnp.float32),
+        }
+
+    def _rule(self, p, g, slots, lr, wd_scale=1.0):
+        b1, b2 = self._beta1, self._beta2
+        t = slots["t"] + 1.0
+        b1p = slots["beta1_pow"] * b1
+        b2p = slots["beta2_pow"] * b2
+        m1 = b1 * slots["moment1"] + (1 - b1) * g
+        m2 = b2 * slots["moment2"] + (1 - b2) * g * g
+        rho_inf = 2.0 / (1 - b2) - 1.0
+        rho_t = rho_inf - 2.0 * t * b2p / (1 - b2p)
+        m1_hat = m1 / (1 - b1p)
+        r = jnp.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf)
+                     / jnp.maximum((rho_inf - 4) * (rho_inf - 2) * rho_t,
+                                   1e-12))
+        adam_step = r * m1_hat / (jnp.sqrt(m2 / (1 - b2p)) + self._eps)
+        sgd_step = m1_hat
+        new_p = p - lr * jnp.where(rho_t > 4.0, adam_step, sgd_step)
+        return new_p, {"moment1": m1, "moment2": m2, "beta1_pow": b1p,
+                       "beta2_pow": b2p, "t": t}
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (reference rprop.py): per-weight step sizes grown
+    on consistent gradient signs, shrunk on sign flips (full-batch method)."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_neg, self._eta_pos = etas
+        self._init_lr = learning_rate
+
+    def _init_slots(self, p):
+        return {
+            "prev_grad": jnp.zeros_like(p, jnp.float32),
+            "step_size": jnp.full(p.shape, self._init_lr, jnp.float32),
+        }
+
+    def _rule(self, p, g, slots, lr, wd_scale=1.0):
+        sign = jnp.sign(g * slots["prev_grad"])
+        factor = jnp.where(sign > 0, self._eta_pos,
+                           jnp.where(sign < 0, self._eta_neg, 1.0))
+        step = jnp.clip(slots["step_size"] * factor, self._lr_min,
+                        self._lr_max)
+        g_eff = jnp.where(sign < 0, 0.0, g)  # sign flip: skip this update
+        new_p = p - step * jnp.sign(g_eff)
+        return new_p, {"prev_grad": g_eff, "step_size": step}

@@ -1,3 +1,42 @@
 """paddle.vision — models, transforms, datasets (reference: python/paddle/vision/)."""
 
 from . import datasets, models, transforms  # noqa: F401
+
+
+# image backend helpers (reference python/paddle/vision/image.py)
+_image_backend = "pil"
+
+
+def set_image_backend(backend):
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(
+            f"backend must be 'pil', 'cv2' or 'tensor', got {backend!r}")
+    _image_backend = backend
+
+
+def get_image_backend():
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image with the selected backend (reference image_load)."""
+    b = backend or _image_backend
+    if b == "cv2":
+        try:
+            import cv2
+
+            return cv2.imread(path)
+        except ImportError as e:
+            raise NotImplementedError("cv2 is not installed") from e
+    from PIL import Image
+
+    img = Image.open(path)
+    if b == "tensor":
+        import numpy as _np
+
+        from ..core.dispatch import wrap
+        import jax.numpy as _jnp
+
+        return wrap(_jnp.asarray(_np.asarray(img)))
+    return img

@@ -1,0 +1,144 @@
+"""Optimizer/distribution/fft/vision namespace tail vs torch/scipy
+references, plus closure checks for those reference export lists."""
+
+import numpy as np
+import pytest
+import scipy.fft
+import scipy.stats
+import torch
+
+import paddlepaddle_tpu as paddle
+
+rng = np.random.default_rng(0)
+
+
+def test_new_optimizers_train():
+    x = rng.standard_normal((8, 4)).astype(np.float32)
+    for name in ("ASGD", "NAdam", "RAdam", "Rprop"):
+        paddle.seed(0)
+        lin = paddle.nn.Linear(4, 1)
+        opt = getattr(paddle.optimizer, name)(learning_rate=0.01,
+                                              parameters=lin.parameters())
+        first = last = None
+        for _ in range(10):
+            loss = ((lin(x) - 1.0) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            first = first if first is not None else float(loss.numpy())
+            last = float(loss.numpy())
+        assert last < first, name
+
+
+def test_nadam_matches_torch():
+    import jax.numpy as jnp
+
+    w0 = np.array([1.5, -2.0], np.float32)
+    g_seq = [np.array([0.3, -0.1], np.float32),
+             np.array([-0.2, 0.4], np.float32),
+             np.array([0.1, 0.1], np.float32)]
+    tw = torch.tensor(w0.copy(), requires_grad=True)
+    topt = torch.optim.NAdam([tw], lr=0.01)
+    pw = paddle.to_tensor(w0.copy(), stop_gradient=False)
+    popt = paddle.optimizer.NAdam(learning_rate=0.01, parameters=[pw])
+    for g in g_seq:
+        tw.grad = torch.tensor(g)
+        topt.step()
+        pw._grad = jnp.asarray(g)
+        popt.step()
+        popt.clear_grad()
+    np.testing.assert_allclose(pw.numpy(), tw.detach().numpy(), rtol=1e-5)
+
+
+def test_binomial_and_mvn_vs_scipy():
+    from paddlepaddle_tpu.distribution import Binomial, MultivariateNormal
+
+    b = Binomial(10, 0.3)
+    np.testing.assert_allclose(b.log_prob(np.float32(3)).numpy(),
+                               scipy.stats.binom.logpmf(3, 10, 0.3),
+                               rtol=1e-5)
+    assert abs(float(b.mean.numpy()) - 3.0) < 1e-6
+
+    mvn = MultivariateNormal(np.zeros(2, np.float32),
+                             np.array([[2.0, 0.5], [0.5, 1.0]], np.float32))
+    np.testing.assert_allclose(
+        mvn.log_prob(np.array([0.5, -0.5], np.float32)).numpy(),
+        scipy.stats.multivariate_normal([0, 0],
+                                        [[2, .5], [.5, 1]]).logpdf([0.5, -0.5]),
+        rtol=1e-5)
+    sm = mvn.sample([4000]).numpy()
+    np.testing.assert_allclose(np.cov(sm.T), [[2, .5], [.5, 1]], atol=0.2)
+    np.testing.assert_allclose(
+        mvn.entropy().numpy(),
+        scipy.stats.multivariate_normal([0, 0], [[2, .5], [.5, 1]]).entropy(),
+        rtol=1e-5)
+
+
+def test_independent_and_lkj_and_cb():
+    from paddlepaddle_tpu.distribution import (ContinuousBernoulli,
+                                               Independent, LKJCholesky,
+                                               Normal)
+
+    ind = Independent(Normal(np.zeros(3, np.float32),
+                             np.ones(3, np.float32)), 1)
+    np.testing.assert_allclose(ind.log_prob(np.zeros(3, np.float32)).numpy(),
+                               3 * scipy.stats.norm.logpdf(0), rtol=1e-5)
+
+    L = LKJCholesky(3, 2.0).sample().numpy()
+    np.testing.assert_allclose(np.diag(L @ L.T), np.ones(3), atol=1e-5)
+    assert np.isfinite(
+        LKJCholesky(3, 2.0).log_prob(L.astype(np.float32)).numpy())
+
+    cb = ContinuousBernoulli(np.float32(0.3))
+    grid = np.linspace(1e-4, 1 - 1e-4, 2001).astype(np.float32)
+    dens = np.exp(cb.log_prob(grid).numpy())
+    np.testing.assert_allclose(np.trapezoid(dens, grid), 1.0, rtol=1e-3)
+    s = cb.sample([500]).numpy()
+    assert (s >= 0).all() and (s <= 1).all()
+
+
+def test_hermitian_fft_family_vs_scipy():
+    a = (rng.standard_normal((4, 5))
+         + 1j * rng.standard_normal((4, 5))).astype(np.complex64)
+    r = rng.standard_normal((4, 8)).astype(np.float32)
+    np.testing.assert_allclose(paddle.fft.hfft2(a).numpy(),
+                               scipy.fft.hfft2(a), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(paddle.fft.ihfft2(r).numpy(),
+                               scipy.fft.ihfft2(r), rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(paddle.fft.hfftn(a).numpy(),
+                               scipy.fft.hfftn(a), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(paddle.fft.ihfftn(r).numpy(),
+                               scipy.fft.ihfftn(r), rtol=1e-4, atol=1e-6)
+
+
+def test_vision_backend_helpers(tmp_path):
+    paddle.vision.set_image_backend("pil")
+    assert paddle.vision.get_image_backend() == "pil"
+    with pytest.raises(ValueError):
+        paddle.vision.set_image_backend("bogus")
+    from PIL import Image
+
+    p = tmp_path / "img.png"
+    Image.fromarray(np.zeros((4, 4, 3), np.uint8)).save(p)
+    img = paddle.vision.image_load(str(p))
+    assert img.size == (4, 4)
+    t = paddle.vision.image_load(str(p), backend="tensor")
+    assert t.shape == [4, 4, 3]
+
+
+def test_module_namespaces_closed():
+    import re
+
+    for path, mod in [
+        ("/root/reference/python/paddle/optimizer/__init__.py",
+         paddle.optimizer),
+        ("/root/reference/python/paddle/distribution/__init__.py",
+         paddle.distribution),
+        ("/root/reference/python/paddle/vision/__init__.py", paddle.vision),
+        ("/root/reference/python/paddle/io/__init__.py", paddle.io),
+        ("/root/reference/python/paddle/metric/__init__.py", paddle.metric),
+    ]:
+        ref = set(re.findall(r"'(\w+)'", open(path).read()))
+        missing = sorted(n for n in ref
+                         if not hasattr(mod, n) and not n.startswith("_"))
+        assert missing == [], f"{path}: {missing}"
