@@ -1,3 +1,143 @@
 """paddle.incubate — experimental API surface (reference: python/paddle/incubate/)."""
 
 from . import autograd, nn  # noqa: F401
+
+# top-level incubate surface (reference python/paddle/incubate/__init__.py)
+from ..geometric import (  # noqa: F401,E402  — graph ops live in geometric
+    segment_max,
+    segment_mean,
+    segment_min,
+    segment_sum,
+)
+from . import optimizer  # noqa: F401,E402
+
+
+def identity_loss(x, reduction="none"):
+    """Mark a value as a loss for IPU-style pipelining (reference
+    incubate.identity_loss): reduce-and-return here."""
+    if reduction in ("none", 2):
+        return x
+    if reduction in ("sum", 0):
+        return x.sum()
+    return x.mean()
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) fused by XLA (reference fused op)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply_op
+
+    return apply_op(lambda a, m: jax.nn.softmax(a + m, axis=-1), x, mask,
+                    op_name="softmax_mask_fuse")
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """Causal-masked softmax (reference fused upper-triangle mask op)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply_op
+
+    def f(a):
+        s = a.shape[-1]
+        mask = jnp.tril(jnp.ones((a.shape[-2], s), bool))
+        return jax.nn.softmax(jnp.where(mask, a, -1e30), axis=-1)
+
+    return apply_op(f, x, op_name="softmax_mask_fuse_upper_triangle")
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    from ..geometric import send_u_recv
+
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    """Compact global node ids to local ids (reference graph_reindex)."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from ..core.dispatch import unwrap, wrap
+
+    xs = np.asarray(unwrap(x)).reshape(-1)
+    nb = np.asarray(unwrap(neighbors)).reshape(-1)
+    cnt = np.asarray(unwrap(count)).reshape(-1)
+    uniq = list(dict.fromkeys(xs.tolist() + nb.tolist()))
+    remap = {v: i for i, v in enumerate(uniq)}
+    reindex_src = np.array([remap[v] for v in nb], np.int64)
+    reindex_dst = np.repeat(np.array([remap[v] for v in xs], np.int64), cnt)
+    return (wrap(jnp.asarray(reindex_src)), wrap(jnp.asarray(reindex_dst)),
+            wrap(jnp.asarray(np.array(uniq, np.int64))))
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    """CSC neighbor sampling (reference graph_sample_neighbors)."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from ..core.dispatch import unwrap, wrap
+
+    rows = np.asarray(unwrap(row)).reshape(-1)
+    cp = np.asarray(unwrap(colptr)).reshape(-1)
+    nodes = np.asarray(unwrap(input_nodes)).reshape(-1)
+    rng = np.random.default_rng(0)
+    out_n, out_count = [], []
+    for v in nodes:
+        lo, hi = int(cp[v]), int(cp[v + 1])
+        nbrs = rows[lo:hi]
+        if 0 <= sample_size < len(nbrs):
+            nbrs = rng.choice(nbrs, size=sample_size, replace=False)
+        out_n.append(nbrs)
+        out_count.append(len(nbrs))
+    flat = (np.concatenate(out_n) if out_n else np.zeros((0,), np.int64))
+    return (wrap(jnp.asarray(flat.astype(np.int64))),
+            wrap(jnp.asarray(np.array(out_count, np.int32))))
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop sampling built on graph_sample_neighbors + reindex."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from ..core.dispatch import unwrap, wrap
+
+    cur = np.asarray(unwrap(input_nodes)).reshape(-1)
+    all_src, all_dst = [], []
+    for size in sample_sizes:
+        nbrs, counts = graph_sample_neighbors(row, colptr, cur,
+                                              sample_size=size)
+        nb = np.asarray(unwrap(nbrs))
+        ct = np.asarray(unwrap(counts))
+        all_src.append(nb)
+        all_dst.append(np.repeat(cur, ct))
+        cur = np.unique(np.concatenate([cur, nb]))
+    src = np.concatenate(all_src) if all_src else np.zeros((0,), np.int64)
+    dst = np.concatenate(all_dst) if all_dst else np.zeros((0,), np.int64)
+    return (wrap(jnp.asarray(src)), wrap(jnp.asarray(dst)),
+            wrap(jnp.asarray(cur)))
+
+
+class inference:  # namespace parity: paddle.incubate.inference decorator kit
+    @staticmethod
+    def enable(func=None, **kwargs):
+        """Reference incubate.inference.enable: wrap a layer/function for
+        cached compiled inference — here jit IS the inference engine."""
+
+        def deco(f):
+            return f
+
+        return deco(func) if func is not None else deco
+
+from .optimizer import LookAhead, ModelAverage  # noqa: F401,E402

@@ -60,3 +60,40 @@ def vjp(func, xs, v=None):
 def grad(func, xs, v=None):
     _, g = vjp(func, xs, v)
     return g
+
+
+class Jacobian:
+    """Lazy row-indexable Jacobian object (reference incubate/autograd
+    functional.Jacobian): J[i, j] etc. materialize from jax.jacrev."""
+
+    def __init__(self, func, xs, is_batched=False):
+        self._mat = jacobian(func, xs)
+
+    def __getitem__(self, idx):
+        return self._mat[idx]
+
+    @property
+    def shape(self):
+        return self._mat.shape
+
+    def numpy(self):
+        return self._mat.numpy()
+
+
+class Hessian(Jacobian):
+    def __init__(self, func, xs, is_batched=False):
+        self._mat = hessian(func, xs)
+
+
+def forward_grad(func, xs, v=None):
+    """Forward-mode gradient (reference incubate.autograd.forward_grad):
+    jvp with an all-ones (or given) tangent."""
+    return jvp(func, xs, v)[1]
+
+
+def enable_prim():
+    """Primitive-decomposition mode: XLA always decomposes; no-op."""
+
+
+def disable_prim():
+    """No-op (see enable_prim)."""

@@ -12,7 +12,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from ....core.dispatch import apply_op
+from ....core.dispatch import apply_op, unwrap, wrap
 from ....nn import functional as F
 from ....nn.functional import swiglu  # noqa: F401  (already fused)
 
@@ -188,3 +188,110 @@ def fused_bias_act(x, bias=None, act_method="gelu", **kw):
 
 def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train", name=None):
     return F.dropout(x, p, training=training, mode=mode) + y
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """Reference incubate fused_matmul_bias — one XLA-fused matmul+add."""
+
+    def f(a, b, bb):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        out = a @ b
+        return out if bb is None else out + bb
+
+    return apply_op(f, x, y, bias, op_name="fused_matmul_bias")
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation="gelu", name=None):
+    out = fused_matmul_bias(x, y, bias, trans_x, trans_y)
+    from ....nn import functional as F
+
+    if activation in (None, "none", ""):
+        return out
+    return getattr(F, activation)(out)
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True, mode=
+        "upscale_in_train", name=None):
+    """residual + dropout(x + bias), then LayerNorm (reference fused op)."""
+    from ....nn import functional as F
+
+    y = x if bias is None else x + bias
+    y = F.dropout(y, p=dropout_rate, training=training, mode=mode)
+    out = residual + y
+    return F.layer_norm(out, out.shape[-1], weight=ln_scale, bias=ln_bias,
+                        epsilon=ln_epsilon)
+
+
+def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
+                            linear_weights, linear_biases, ffn_ln_scales,
+                            ffn_ln_biases, ffn1_weights, ffn1_biases,
+                            ffn2_weights, ffn2_biases, pre_layer_norm=True,
+                            epsilon=1e-5, cache_kvs=None, time_step=None,
+                            attn_mask=None, dropout_rate=0.0,
+                            activation="gelu", training=False, name=None):
+    """Stacked pre-LN transformer layers from raw weight lists (reference
+    fused_multi_transformer inference op); one fused XLA program under jit."""
+    from ....nn import functional as F
+
+    out = x
+    n_layers = len(qkv_weights)
+    for i in range(n_layers):
+        h = F.layer_norm(out, out.shape[-1], weight=ln_scales[i],
+                         bias=ln_biases[i], epsilon=epsilon) \
+            if pre_layer_norm else out
+        attn = fused_multi_head_attention(
+            h, qkv_weights[i], linear_weights[i],
+            pre_layer_norm=False, qkv_bias=qkv_biases[i],
+            linear_bias=linear_biases[i], attn_mask=attn_mask,
+            dropout_rate=dropout_rate, training=training)
+        out = out + attn
+        h2 = F.layer_norm(out, out.shape[-1], weight=ffn_ln_scales[i],
+                          bias=ffn_ln_biases[i], epsilon=epsilon) \
+            if pre_layer_norm else out
+        ffn = fused_feedforward(
+            h2, ffn1_weights[i], ffn2_weights[i], linear1_bias=ffn1_biases[i],
+            linear2_bias=ffn2_biases[i], activation=activation,
+            pre_layer_norm=False, training=training)
+        out = out + ffn
+    return out
+
+
+def variable_length_memory_efficient_attention(query, key, value, seq_lens,
+                                               kv_seq_lens, mask=None,
+                                               scale=None, causal=False,
+                                               pre_cache_length=0, name=None):
+    """Varlen attention at the incubate API (reference memory-efficient
+    kernel): lowered onto the segment-masked flash path. query/key/value are
+    [b, heads, s, d]; seq_lens give per-batch valid lengths."""
+    import numpy as _np
+
+    from ....nn import functional as F
+
+    q = unwrap(query)
+    b, h, sq, d = q.shape
+    lens_q = _np.asarray(unwrap(seq_lens)).reshape(-1)
+    lens_k = _np.asarray(unwrap(kv_seq_lens)).reshape(-1)
+    cu_q = _np.concatenate([[0], _np.cumsum(lens_q)]).astype(_np.int32)
+    cu_k = _np.concatenate([[0], _np.cumsum(lens_k)]).astype(_np.int32)
+
+    def pack(t, lens):
+        a = unwrap(t)
+        rows = [a[i, :, : lens[i]].swapaxes(0, 1) for i in range(b)]
+        return jnp.concatenate(rows, axis=0)  # [total, h, d]
+
+    qp, kp, vp = pack(query, lens_q), pack(key, lens_k), pack(value, lens_k)
+    out, _ = F.flash_attn_unpadded(qp, kp, vp, cu_q, cu_k, scale=scale,
+                                   causal=causal)
+    out_np = unwrap(out)
+    res = jnp.zeros((b, h, sq, d), out_np.dtype)
+    for i in range(b):
+        res = res.at[i, :, : lens_q[i]].set(
+            out_np[cu_q[i]:cu_q[i + 1]].swapaxes(0, 1))
+    return wrap(res)

@@ -1,0 +1,87 @@
+"""paddle.incubate.optimizer (reference: python/paddle/incubate/optimizer/ —
+LookAhead, ModelAverage, the functional LBFGS re-export)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...optimizer import LBFGS  # noqa: F401  (reference re-exports it here)
+from ...optimizer.optimizer import Optimizer
+
+
+class LookAhead(Optimizer):
+    """Lookahead wrapper (reference lookahead.py): every k steps the slow
+    weights move alpha toward the fast (inner-optimizer) weights."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner = inner_optimizer
+        self.alpha = alpha
+        self.k = int(k)
+        self._step_num = 0
+        self._slow = {}
+
+    @property
+    def _parameter_list(self):
+        return self.inner._parameter_list
+
+    def get_lr(self):
+        return self.inner.get_lr()
+
+    def step(self):
+        self.inner.step()
+        self._step_num += 1
+        if self._step_num % self.k:
+            return
+        for p in self.inner._parameter_list:
+            pid = id(p)
+            if pid not in self._slow:
+                self._slow[pid] = p._data
+                continue
+            slow = self._slow[pid] + self.alpha * (p._data - self._slow[pid])
+            self._slow[pid] = slow
+            p._replace_data(slow)
+
+    def clear_grad(self):
+        self.inner.clear_grad()
+
+    def state_dict(self):
+        return {"inner": self.inner.state_dict(), "step": self._step_num}
+
+    def set_state_dict(self, state):
+        self.inner.set_state_dict(state.get("inner", {}))
+        self._step_num = state.get("step", 0)
+
+
+class ModelAverage(Optimizer):
+    """Running average of parameters (reference model_average.py): apply()
+    swaps in the averaged weights, restore() swaps back."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        super().__init__(learning_rate=0.0, parameters=parameters)
+        self._sums = {}
+        self._counts = {}
+        self._backup = {}
+
+    def step(self):
+        for p in self._parameter_list:
+            pid = id(p)
+            self._sums[pid] = self._sums.get(pid, 0.0) + p._data
+            self._counts[pid] = self._counts.get(pid, 0) + 1
+
+    def apply(self, executor=None, need_restore=True):
+        for p in self._parameter_list:
+            pid = id(p)
+            if self._counts.get(pid):
+                self._backup[pid] = p._data
+                p._replace_data(self._sums[pid] / self._counts[pid])
+
+    def restore(self, executor=None):
+        for p in self._parameter_list:
+            pid = id(p)
+            if pid in self._backup:
+                p._replace_data(self._backup.pop(pid))
+
+    def minimize(self, loss):
+        self.step()

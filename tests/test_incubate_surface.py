@@ -1,0 +1,129 @@
+"""Incubate surface: LookAhead/ModelAverage, fused layers, graph ops,
+Jacobian/Hessian objects, and namespace closure vs the reference."""
+
+import numpy as np
+import pytest
+
+import paddlepaddle_tpu as paddle
+
+rng = np.random.default_rng(0)
+X = rng.standard_normal((8, 4)).astype(np.float32)
+
+
+def test_lookahead_trains():
+    lin = paddle.nn.Linear(4, 1)
+    inner = paddle.optimizer.Adam(learning_rate=0.02,
+                                  parameters=lin.parameters())
+    la = paddle.incubate.LookAhead(inner, alpha=0.5, k=2)
+    first = last = None
+    for _ in range(6):
+        loss = ((lin(X) - 1.0) ** 2).mean()
+        loss.backward()
+        la.step()
+        la.clear_grad()
+        first = first if first is not None else float(loss.numpy())
+        last = float(loss.numpy())
+    assert last < first
+
+
+def test_model_average_apply_restore():
+    lin = paddle.nn.Linear(4, 1)
+    ma = paddle.incubate.ModelAverage(parameters=lin.parameters())
+    w0 = lin.weight.numpy().copy()
+    ma.step()
+    lin.weight._replace_data(lin.weight._data * 2)
+    ma.step()
+    ma.apply()
+    avg = lin.weight.numpy().copy()
+    np.testing.assert_allclose(avg, 1.5 * w0, rtol=1e-6)
+    ma.restore()
+    np.testing.assert_allclose(lin.weight.numpy(), 2 * w0, rtol=1e-6)
+
+
+def test_fused_layers_forward():
+    fl = paddle.incubate.nn.FusedLinear(4, 3)
+    assert fl(X).shape == [8, 3]
+    src = rng.standard_normal((2, 5, 8)).astype(np.float32)
+    enc = paddle.incubate.nn.FusedTransformerEncoderLayer(
+        8, 2, 16, dropout_rate=0.0)
+    assert enc(src).shape == [2, 5, 8]
+    mt = paddle.incubate.nn.FusedMultiTransformer(8, 2, 16, num_layers=2)
+    mt.eval()
+    assert mt(src).shape == [2, 5, 8]
+    np.testing.assert_allclose(
+        paddle.incubate.nn.functional.fused_matmul_bias(
+            np.ones((2, 3), np.float32), np.ones((3, 4), np.float32),
+            np.ones(4, np.float32)).numpy(), 4.0)
+
+
+def test_softmax_mask_fuse_ops():
+    a = rng.standard_normal((1, 1, 4, 4)).astype(np.float32)
+    sm = paddle.incubate.softmax_mask_fuse_upper_triangle(a).numpy()
+    assert np.allclose(np.triu(np.asarray(sm)[0, 0], 1), 0, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(sm).sum(-1), 1.0, rtol=1e-5)
+    m = np.where(np.eye(4, dtype=bool), 0.0, -1e30).astype(np.float32)
+    sm2 = paddle.incubate.softmax_mask_fuse(a, m[None, None]).numpy()
+    np.testing.assert_allclose(np.asarray(sm2)[0, 0],
+                               np.eye(4), atol=1e-6)
+
+
+def test_graph_ops():
+    x = np.eye(3, dtype=np.float32)
+    src, dst = np.array([0, 1, 2], np.int64), np.array([1, 2, 0], np.int64)
+    out = paddle.incubate.graph_send_recv(x, src, dst).numpy()
+    expect = np.zeros_like(x)
+    for s, d in zip(src, dst):
+        expect[d] += x[s]
+    np.testing.assert_allclose(out, expect)
+
+    # CSC graph: 0->1,2  1->2  2->0 (cols = dst)
+    row = np.array([2, 0, 0, 1], np.int64)
+    colptr = np.array([0, 1, 2, 4], np.int64)
+    nbrs, counts = paddle.incubate.graph_sample_neighbors(
+        row, colptr, np.array([2], np.int64))
+    assert sorted(nbrs.numpy().tolist()) == [0, 1]
+    assert counts.numpy().tolist() == [2]
+
+    rsrc, rdst, keys = paddle.incubate.graph_reindex(
+        np.array([5, 9], np.int64), np.array([9, 7, 5], np.int64),
+        np.array([2, 1], np.int64))
+    assert keys.numpy().tolist() == [5, 9, 7]
+    assert rdst.numpy().tolist() == [0, 0, 1]
+
+
+def test_varlen_memory_efficient_attention():
+    q = rng.standard_normal((2, 2, 6, 8)).astype(np.float32)
+    out = paddle.incubate.nn.functional.\
+        variable_length_memory_efficient_attention(
+            q, q, q, np.array([4, 6], np.int32), np.array([4, 6], np.int32))
+    assert out.shape == [2, 2, 6, 8]
+    o = out.numpy()
+    np.testing.assert_allclose(o[0, :, 4:], 0.0)  # padding rows stay zero
+
+
+def test_jacobian_hessian_objects():
+    jac = paddle.incubate.autograd.Jacobian(
+        lambda a: (a ** 2).sum(), np.array([1.0, 2.0], np.float32))
+    np.testing.assert_allclose(np.asarray(jac.numpy()), [2.0, 4.0])
+    hes = paddle.incubate.autograd.Hessian(
+        lambda a: (a ** 2).sum(), np.array([1.0, 2.0], np.float32))
+    np.testing.assert_allclose(np.asarray(hes.numpy()), 2 * np.eye(2))
+    g = paddle.incubate.autograd.forward_grad(
+        lambda a: a * 3.0, np.array([1.0], np.float32))
+    np.testing.assert_allclose(np.asarray(g.numpy()
+                                          if hasattr(g, "numpy") else g), 3.0)
+
+
+def test_incubate_namespaces_closed():
+    import re
+
+    for sub in ["", "/nn", "/nn/functional", "/autograd"]:
+        path = f"/root/reference/python/paddle/incubate{sub}/__init__.py"
+        ref = set(re.findall(r"'(\w+)'", open(path).read()))
+        mod = paddle.incubate
+        for part in sub.strip("/").split("/"):
+            if part:
+                mod = getattr(mod, part)
+        missing = sorted(n for n in ref
+                         if not hasattr(mod, n) and not n.startswith("_"))
+        assert missing == [], f"incubate{sub}: {missing}"
