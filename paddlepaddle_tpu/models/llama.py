@@ -86,21 +86,30 @@ class LlamaConfig:
         return self.num_hidden_layers * per_layer + embed + h
 
 
-def _rope_cos_sin(config: LlamaConfig):
-    dim = config.head_dim
-    inv_freq = 1.0 / (config.rope_theta ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
-    t = jnp.arange(config.max_position_embeddings, dtype=jnp.float32)
+def rope_tables(head_dim: int, max_len: int, theta: float):
+    """fp32 cos/sin tables [max_len, head_dim] for NeoX-style rope — shared
+    by the Layer model and the hybrid-parallel functional stage."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_len, dtype=jnp.float32)
     freqs = jnp.outer(t, inv_freq)                       # [T, dim/2]
     emb = jnp.concatenate([freqs, freqs], axis=-1)       # [T, dim]
     return jnp.cos(emb), jnp.sin(emb)
 
 
+def rotate_half(x):
+    half = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+
+
+def _rope_cos_sin(config: LlamaConfig):
+    return rope_tables(config.head_dim, config.max_position_embeddings,
+                       config.rope_theta)
+
+
 def _apply_rope(q, k, cos, sin, offset=0):
     """NeoX-style rotate-half rope on BSHD tensors; cos/sin precomputed fp32."""
 
-    def rot(x):
-        half = x.shape[-1] // 2
-        return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+    rot = rotate_half
 
     def f(qa, ka, c, s):
         seq = qa.shape[1]

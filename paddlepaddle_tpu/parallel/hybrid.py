@@ -1,0 +1,274 @@
+"""4D hybrid parallelism — dp × fsdp × tp × pp composed in ONE mesh.
+
+Reference surface: fleet/base/topology.py:189 ``HybridCommunicateGroup``
+(data × pipe × sharding × sep × model — the reference's whole fleet stack
+exists to run these axes TOGETHER) and the end-to-end recipe
+test/auto_parallel/hybrid_strategy/semi_auto_llama.py. The TPU-native
+composition is one ``shard_map`` over a single 4-axis ``Mesh``:
+
+* **pp** — pipeline stages via the instruction-table executor
+  (``parallel.pipeline_spmd.spmd_pipeline_train``), ring ``ppermute`` over ICI;
+* **tp** — Megatron tensor parallel INSIDE each stage as explicit collectives:
+  column-parallel qkv/gate/up (no comm), row-parallel o/down followed by one
+  ``psum`` over 'tp' per sub-block (fleet/layers/mpu/mp_layers.py:336,543
+  semantics), plus a vocab-parallel cross-entropy head
+  (ParallelCrossEntropy, mp_layers.py) that never materializes full logits;
+* **fsdp** — ZeRO-3 parameter sharding as all-gather-at-use: weights live
+  sharded on the 'fsdp' axis and are gathered just-in-time inside the block.
+  The transpose of ``lax.all_gather`` is ``psum_scatter``, so the stage vjp
+  returns gradients already reduce-scattered into the same sharded layout
+  (group_sharded_stage3.py semantics, compiler-scheduled);
+* **dp** — batch over 'dp' (and 'fsdp': both are data axes for activations).
+
+Everything here is a pure function of jax arrays — it runs inside the
+pipeline executor's ``shard_map``/``lax.scan``, with per-layer remat
+(``jax.checkpoint``) inside the stage vjp and flash attention on the local
+TP head group.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..models.llama import rope_tables, rotate_half
+from ..ops.kernels.flash_attention import _flash_core, _use_pallas
+
+
+class HybridStageConfig(NamedTuple):
+    """Shape card for one homogeneous pipeline stage of a Llama-style LM."""
+
+    hidden_size: int
+    intermediate_size: int
+    num_heads: int
+    num_kv_heads: int
+    layers_per_stage: int
+    vocab_size: int
+    max_seq_len: int
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-6
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+def _rms(x, g, eps):
+    xf = x.astype(jnp.float32)
+    n = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (n * g.astype(jnp.float32)).astype(x.dtype)
+
+
+def _rope(x, cos, sin):
+    return x * cos + rotate_half(x) * sin
+
+
+def _fg_pair(tp_axis):
+    """Megatron's conjugate f/g operators (mp_layers.py c_identity /
+    mp_allreduce semantics) for manual-collective TP under shard_map with
+    replication checking off:
+
+    * ``f`` — identity forward, psum backward: placed where a REPLICATED
+      activation enters the tp-sharded region, so the cotangent sums each
+      member's partial contribution;
+    * ``g`` — psum forward, identity backward: the row-parallel output
+      reduction, whose incoming cotangent is already replicated/full.
+
+    A raw ``lax.psum`` would transpose to another psum (check_vma=False
+    cannot assume replication), over-counting by the tp size.
+    """
+    if tp_axis is None:
+        return (lambda x: x), (lambda x: x)
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    f.defvjp(lambda x: (x, None), lambda _, ct: (jax.lax.psum(ct, tp_axis),))
+
+    @jax.custom_vjp
+    def g(x):
+        return jax.lax.psum(x, tp_axis)
+
+    g.defvjp(lambda x: (jax.lax.psum(x, tp_axis), None), lambda _, ct: (ct,))
+    return f, g
+
+
+def init_llama_stage(cfg: HybridStageConfig, key, dtype=jnp.float32) -> dict:
+    """Full (unsharded) parameters for ONE pipeline stage: ``layers_per_stage``
+    decoder layers, leaves with a leading layer dim. Stack stages with
+    ``pipeline_spmd.stack_stage_params`` and shard with
+    ``llama_stage_specs()``."""
+    h, f = cfg.hidden_size, cfg.intermediate_size
+    hd = cfg.head_dim
+    L = cfg.layers_per_stage
+    ks = jax.random.split(key, 7)
+
+    def w(k, shape, fan_in):
+        return (jax.random.normal(k, (L,) + shape, jnp.float32)
+                / math.sqrt(fan_in)).astype(dtype)
+
+    return {
+        "ln1": jnp.ones((L, h), dtype),
+        "ln2": jnp.ones((L, h), dtype),
+        "wq": w(ks[0], (h, cfg.num_heads * hd), h),
+        "wk": w(ks[1], (h, cfg.num_kv_heads * hd), h),
+        "wv": w(ks[2], (h, cfg.num_kv_heads * hd), h),
+        "wo": w(ks[3], (cfg.num_heads * hd, h), cfg.num_heads * hd),
+        "wg": w(ks[4], (h, f), h),
+        "wu": w(ks[5], (h, f), h),
+        "wd": w(ks[6], (f, h), f),
+    }
+
+
+def init_llama_head(cfg: HybridStageConfig, key, dtype=jnp.float32) -> dict:
+    """Final-norm + vocab projection (the vocab-parallel loss head)."""
+    return {
+        "ln": jnp.ones((cfg.hidden_size,), dtype),
+        "w": (jax.random.normal(key, (cfg.hidden_size, cfg.vocab_size),
+                                jnp.float32)
+              / math.sqrt(cfg.hidden_size)).astype(dtype),
+    }
+
+
+def llama_stage_specs(tp_axis="tp", fsdp_axis="fsdp") -> dict:
+    """PartitionSpecs for one stage's leaves (per-stage dims only — the
+    pipeline executor prepends the V/S dims). Column-parallel weights shard
+    the output dim over tp, row-parallel the input dim; fsdp takes the other
+    matmul dim (ZeRO-3)."""
+    col = P(None, fsdp_axis, tp_axis)   # [L, h, f]: gather h, keep f local
+    row = P(None, tp_axis, fsdp_axis)   # [L, f, h]: keep f local, gather h
+    return {
+        "ln1": P(), "ln2": P(),
+        "wq": col, "wk": col, "wv": col, "wo": row,
+        "wg": col, "wu": col, "wd": row,
+    }
+
+
+def llama_head_specs(tp_axis="tp") -> dict:
+    """Head: vocab dim over tp (ParallelCrossEntropy layout); norm replicated."""
+    return {"ln": P(), "w": P(None, tp_axis)}
+
+
+def make_llama_block(cfg: HybridStageConfig, tp_axis="tp", fsdp_axis="fsdp",
+                     remat=True, use_flash=True):
+    """(stage_params_local, acts) -> acts: one pipeline stage =
+    ``layers_per_stage`` decoder layers with explicit tp/fsdp collectives.
+
+    Runs inside shard_map: ``stage_params_local`` leaves are the local tp/fsdp
+    shards (see ``llama_stage_specs``); activations are replicated over tp and
+    batch-sharded over the data axes by the caller."""
+    cos_t, sin_t = rope_tables(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+    hd = cfg.head_dim
+    eps = cfg.rms_norm_eps
+    scale = 1.0 / math.sqrt(hd)
+
+    f_in, g_out = _fg_pair(tp_axis)
+
+    def gather(wloc, axis):
+        if fsdp_axis is None:
+            return wloc
+        return jax.lax.all_gather(wloc, fsdp_axis, axis=axis, tiled=True)
+
+    def layer(x, lp):
+        b, s, h = x.shape
+        dt = x.dtype
+        # --- attention (column qkv, flash on local heads, row o + psum) ---
+        hn = f_in(_rms(x, lp["ln1"], eps))
+        wq, wk, wv = gather(lp["wq"], 0), gather(lp["wk"], 0), gather(lp["wv"], 0)
+        wo = gather(lp["wo"], 1)
+        q = (hn @ wq).reshape(b, s, -1, hd)
+        k = (hn @ wk).reshape(b, s, -1, hd)
+        v = (hn @ wv).reshape(b, s, -1, hd)
+        cos = cos_t[:s][None, :, None, :].astype(dt)
+        sin = sin_t[:s][None, :, None, :].astype(dt)
+        q, k = _rope(q, cos, sin), _rope(k, cos, sin)
+        rep = q.shape[2] // k.shape[2]
+        if rep > 1:
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        if use_flash:
+            out = _flash_core(q, k, v, True, scale, _use_pallas(q))
+        else:
+            qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * scale
+            kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+            lg = jnp.einsum("bhqd,bhkd->bhqk", qt, kt)
+            lg = jnp.where(jnp.tril(jnp.ones((s, s), bool)), lg, -1e30)
+            pr = jax.nn.softmax(lg, axis=-1).astype(v.dtype)
+            out = jnp.swapaxes(
+                jnp.einsum("bhqk,bhkd->bhqd", pr, jnp.swapaxes(v, 1, 2)), 1, 2)
+        attn = g_out(out.astype(dt).reshape(b, s, -1) @ wo)
+        x = x + attn
+        # --- MLP (column gate/up, row down + psum) ---
+        hm = f_in(_rms(x, lp["ln2"], eps))
+        wg, wu = gather(lp["wg"], 0), gather(lp["wu"], 0)
+        wd = gather(lp["wd"], 1)
+        y = g_out((jax.nn.silu(hm @ wg) * (hm @ wu)) @ wd)
+        return x + y
+
+    if remat:
+        layer = jax.checkpoint(layer)
+
+    def block(params, x):
+        def body(xc, lp):
+            return layer(xc, lp), None
+        x, _ = jax.lax.scan(body, x, params)
+        return x
+
+    return block
+
+
+def make_vocab_parallel_head(cfg: HybridStageConfig, tp_axis="tp"):
+    """(head_params_local, acts, labels) -> scalar mean next-token CE.
+
+    ParallelCrossEntropy semantics (fleet/layers/mpu/mp_layers.py — the
+    reference's c_softmax_with_cross_entropy): logits stay vocab-sharded over
+    tp; the softmax normalizer and the label logit are assembled with psum /
+    pmax so the full [b, s, V] tensor never exists. Same shift/mask
+    formulation as models.llama.LlamaForCausalLM.loss_from_logits."""
+    eps = cfg.rms_norm_eps
+    f_in, g_out = _fg_pair(tp_axis)
+
+    def head_loss(hp, x, labels):
+        xn = f_in(_rms(x, hp["ln"], eps))
+        logits = (xn @ hp["w"]).astype(jnp.float32)       # [b, s, V_local]
+        v_loc = logits.shape[-1]
+        s = logits.shape[1]
+        off = (jax.lax.axis_index(tp_axis) * v_loc) if tp_axis else 0
+        lbl = jnp.roll(labels, -1, axis=1)
+        # the max shift is numerical-stability only — keep the (non-
+        # differentiable) pmax out of the vjp graph
+        m_loc = jax.lax.stop_gradient(jnp.max(logits, axis=-1))
+        m = jax.lax.pmax(m_loc, tp_axis) if tp_axis else m_loc
+        m = jax.lax.stop_gradient(m)
+        se = g_out(jnp.sum(jnp.exp(logits - m[..., None]), axis=-1))
+        lse = m + jnp.log(se)
+        mine = (lbl >= off) & (lbl < off + v_loc)
+        safe = jnp.clip(lbl - off, 0, v_loc - 1)
+        lab = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+        lab = g_out(jnp.where(mine, lab, 0.0))
+        nll = lse - lab
+        pos = jax.lax.broadcasted_iota(jnp.int32, nll.shape, 1)
+        valid = ((lbl >= 0) & (pos < s - 1)).astype(jnp.float32)
+        return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+
+    return head_loss
+
+
+def reference_forward(cfg: HybridStageConfig, per_stage_params, head_params,
+                      acts, labels):
+    """Unsharded single-device forward — the parity oracle for tests: same
+    math as make_llama_block(tp=None, fsdp=None) chained over stages + the
+    head loss with the full vocab."""
+    block = make_llama_block(cfg, tp_axis=None, fsdp_axis=None, remat=False,
+                             use_flash=False)
+    head = make_vocab_parallel_head(cfg, tp_axis=None)
+    x = acts
+    for sp in per_stage_params:
+        x = block(sp, x)
+    return head(head_params, x, labels)

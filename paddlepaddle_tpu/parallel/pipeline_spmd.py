@@ -81,11 +81,32 @@ def spmd_pipeline_interleaved(stacked_params, acts, block_fn, mesh: Mesh,
     return acts
 
 
+def _spec_axes(spec) -> set:
+    """Mesh-axis names mentioned by a PartitionSpec."""
+    names = set()
+    for e in spec or ():
+        if e is None:
+            continue
+        if isinstance(e, (tuple, list)):
+            names.update(e)
+        else:
+            names.add(e)
+    return names
+
+
+def _merge_specs(tree, specs, prefix):
+    """Per-leaf specs for shard_map: ``prefix + spec`` (spec gives the
+    per-stage dims; ``prefix`` covers the leading V/S dims the executor
+    added)."""
+    return jax.tree_util.tree_map(
+        lambda _, s: P(*prefix, *(s or ())), tree, specs)
+
+
 def spmd_pipeline_train(stacked_params, head_params, acts, labels,
                         block_fn: Callable, head_loss_fn: Callable, mesh: Mesh,
                         schedule="1f1b", n_microbatches: Optional[int] = None,
                         num_virtual: int = 1, pp_axis: str = "pp",
-                        data_axis=None):
+                        data_axis=None, param_specs=None, head_specs=None):
     """Schedule-driven pipeline training step: forward AND backward of all
     microbatches in ONE ``lax.scan`` over schedule slots.
 
@@ -110,6 +131,17 @@ def spmd_pipeline_train(stacked_params, head_params, acts, labels,
         head_loss_fn: (head_params, acts_mb, labels_mb) -> scalar mean loss.
         schedule: PipelineSchedule, or name ('1f1b'|'gpipe'|'interleaved');
             names require ``n_microbatches`` (and ``num_virtual`` for VPP).
+        data_axis: mesh axis name (or tuple of names) the batch dim is
+            sharded over — dp, or (dp, fsdp) when ZeRO shards the batch too.
+        param_specs / head_specs: optional pytrees (matching the stage /
+            head param structure) of PartitionSpecs for the PER-STAGE leaf
+            dims — how each weight is sharded over tp/fsdp INSIDE a stage
+            (see parallel.hybrid.llama_stage_specs). The block/head fns are
+            then responsible for the matching collectives (all_gather at
+            use, psum after row-parallel matmuls). Gradients of a leaf whose
+            spec mentions a data axis (fsdp-sharded weights) arrive already
+            reduce-scattered by the vjp of the block's all_gather, so the
+            executor mean-reduces them only over the remaining data axes.
     Returns:
         (loss, grads_stacked, grads_head, dacts): loss is the mean over the
         batch; grads_* match their params' structure; dacts is [B, ...], the
@@ -128,6 +160,14 @@ def spmd_pipeline_train(stacked_params, head_params, acts, labels,
     if B % M:
         raise ValueError(f"batch {B} not divisible by {M} microbatches")
     mb = B // M
+    data_axes = () if data_axis is None else (
+        (data_axis,) if isinstance(data_axis, str) else tuple(data_axis))
+    stage_specs_tree = param_specs
+    head_specs_tree = head_specs
+    if stage_specs_tree is None:
+        stage_specs_tree = jax.tree_util.tree_map(lambda _: P(), stacked_params)
+    if head_specs_tree is None:
+        head_specs_tree = jax.tree_util.tree_map(lambda _: P(), head_params)
 
     # normalize param leaves to [V, S, ...]
     added_v = V == 1
@@ -229,25 +269,39 @@ def spmd_pipeline_train(stacked_params, head_params, acts, labels,
         loss = jax.lax.psum(loss, pp_axis) / M
         hg = jax.tree_util.tree_map(lambda a: jax.lax.psum(a, pp_axis), hg)
         dacts = jax.lax.psum(dacts, pp_axis)
-        if data_axis is not None:
-            loss = jax.lax.pmean(loss, data_axis)
-            gacc = jax.tree_util.tree_map(
-                lambda a: jax.lax.pmean(a, data_axis), gacc)
-            hg = jax.tree_util.tree_map(
-                lambda a: jax.lax.pmean(a, data_axis), hg)
+        if data_axes:
+            loss = jax.lax.pmean(loss, data_axes)
+
+            def reduce_grad(g, spec):
+                # a leaf sharded over a data axis (fsdp) arrives already
+                # SUMMED over that axis by the vjp of the block's all_gather
+                # (psum_scatter); mean-reduce only over the others and
+                # rescale the already-summed ones to a mean
+                inside = tuple(a for a in data_axes if a in _spec_axes(spec))
+                outside = tuple(a for a in data_axes if a not in _spec_axes(spec))
+                if outside:
+                    g = jax.lax.pmean(g, outside)
+                for a in inside:
+                    g = g / mesh.shape[a]
+                return g
+
+            gacc = jax.tree_util.tree_map(reduce_grad, gacc, stage_specs_tree)
+            hg = jax.tree_util.tree_map(reduce_grad, hg, head_specs_tree)
             # dacts is per-example: local-loss cotangent / D == global-mean
             # cotangent, so a plain jax.vjp(embed)(dacts) outside needs no
             # further reduction
-            dacts = dacts / mesh.shape[data_axis]
+            for a in data_axes:
+                dacts = dacts / mesh.shape[a]
         # re-insert the stage dim for the [V, S, ...] out spec
         gacc = jax.tree_util.tree_map(lambda a: a[:, None], gacc)
         return loss, gacc, hg, dacts
 
     ndim_rest = acts.ndim - 1
-    p_specs = jax.tree_util.tree_map(lambda _: P(None, pp_axis), stacked_params)
-    h_specs = jax.tree_util.tree_map(lambda _: P(), head_params)
-    x_spec = P(None, data_axis, *([None] * (ndim_rest - 1)))
-    y_spec = P(None, data_axis, *([None] * (labels.ndim - 1)))
+    p_specs = _merge_specs(stacked_params, stage_specs_tree, (None, pp_axis))
+    h_specs = _merge_specs(head_params, head_specs_tree, ())
+    batch_dim = data_axes if data_axes else None
+    x_spec = P(None, batch_dim, *([None] * (ndim_rest - 1)))
+    y_spec = P(None, batch_dim, *([None] * (labels.ndim - 1)))
 
     loss, gacc, hg, dacts = _shard_map(
         per_stage, mesh=mesh,
