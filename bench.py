@@ -115,11 +115,14 @@ def _bench_moe(peak, on_accel):
 
     if not on_accel:
         return None
-    cfg = MoEConfig(vocab_size=32000, hidden_size=1024, intermediate_size=704,
+    # intermediate 768 (not the 704 a naive Qwen2-MoE half-scale gives):
+    # MXU lanes are 128-wide and a non-multiple FFN width measured ~9x
+    # slower matmuls (tools/moe_dispatch_bench.py) — a TPU-first sizing rule
+    cfg = MoEConfig(vocab_size=32000, hidden_size=1024, intermediate_size=768,
                     num_hidden_layers=8, num_attention_heads=16,
                     num_key_value_heads=8, num_experts=16,
                     num_experts_per_tok=2, max_position_embeddings=2048,
-                    dtype="bfloat16", dispatch_mode="sorted")  # 1-chip perf path
+                    dtype="bfloat16")  # default dispatch: "sorted" capacity path
     model = MoEForCausalLM(cfg)
     opt = AdamW(learning_rate=1e-4, parameters=model.parameters(),
                 multi_precision=True)

@@ -36,9 +36,11 @@ class MoEConfig:
     rope_theta: float = 10000.0
     aux_loss_weight: float = 0.01
     dtype: str = "float32"
-    # "einsum" (GShard one-hot, cleanest ep-sharded SPMD lowering; default) |
-    # "sorted" (fused-MoE style, single-chip perf) — see parallel.moe.MoELayer
-    dispatch_mode: str = "einsum"
+    # "sorted" (counting-sort + static capacity buffers + batched einsum,
+    # single-chip perf; default) | "dropless" (ragged_dot, no token drops) |
+    # "einsum" (GShard one-hot, cleanest ep-sharded SPMD lowering — use for
+    # ep meshes) — see parallel.moe.MoELayer
+    dispatch_mode: str = "sorted"
 
     def as_llama(self) -> LlamaConfig:
         return LlamaConfig(
@@ -100,6 +102,12 @@ class MoEForCausalLM(Layer):
         self.layers = LayerList([MoEDecoderLayer(config) for _ in range(config.num_hidden_layers)])
         self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
         self.lm_head = Linear(config.hidden_size, config.vocab_size, bias_attr=False)
+        if config.dtype != "float32":
+            # cast the whole trunk like LlamaModel does — without this the
+            # f32 embedding promotes every downstream matmul (attention,
+            # expert FFNs) to f32, quartering MXU throughput
+            self.to(dtype=config.dtype)
+        # rope tables registered AFTER the cast: they must stay fp32
         cos, sin = _rope_cos_sin(config.as_llama())
         self.register_buffer("rope_cos", Tensor(cos), persistable=False)
         self.register_buffer("rope_sin", Tensor(sin), persistable=False)

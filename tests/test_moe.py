@@ -53,7 +53,12 @@ def test_moe_lm_train_decreases():
     assert losses[-1] < losses[0]
 
 
-def test_moe_expert_parallel_sharded():
+@pytest.mark.parametrize("mode", ["einsum", "sorted"])
+def test_moe_expert_parallel_sharded(mode):
+    """einsum is the documented ep-mesh lowering (keep it covered under
+    ShardedTrainStep even though the single-chip default is 'sorted')."""
+    import dataclasses
+
     import jax
 
     from paddlepaddle_tpu.distributed.mesh import ProcessMesh
@@ -63,7 +68,7 @@ def test_moe_expert_parallel_sharded():
     if len(jax.devices()) < 8:
         pytest.skip("needs 8 virtual devices")
     mesh = ProcessMesh(shape=[2, 4], dim_names=["dp", "ep"])
-    m = MoEForCausalLM(MoEConfig.tiny())
+    m = MoEForCausalLM(dataclasses.replace(MoEConfig.tiny(), dispatch_mode=mode))
     opt = AdamW(learning_rate=1e-2, parameters=m.parameters())
     step = ShardedTrainStep(m, opt, lambda mm, ids, labels: mm(ids, labels=labels),
                             mesh=mesh, rules=moe_sharding_rules(),
@@ -75,17 +80,19 @@ def test_moe_expert_parallel_sharded():
     assert not step.params[name].sharding.is_fully_replicated
 
 
-def test_sorted_dispatch_matches_einsum():
-    """The fused-MoE-style sorted path (fused_moe.py analogue) is numerically
-    identical to the GShard einsum path when capacity is ample, for both
-    top-2 (renormalized gates) and top-1 (raw Switch probability)."""
+@pytest.mark.parametrize("fast_mode", ["sorted", "dropless"])
+def test_fast_dispatch_matches_einsum(fast_mode):
+    """The fast paths (counting-sort capacity einsum / dropless ragged_dot —
+    fused_moe.py analogues) are numerically identical to the GShard einsum
+    path when capacity is ample, for both top-2 (renormalized gates) and
+    top-1 (raw Switch probability)."""
     from paddlepaddle_tpu.parallel.moe import GShardGate
 
     x = np.random.default_rng(0).standard_normal((2, 8, 16)).astype(np.float32)
     for gate_cls, name in ((GShardGate, "top2"), (SwitchGate, "top1")):
         paddle.seed(3)
         m_s = MoELayer(16, 32, 4, gate=gate_cls(16, 4), capacity_factor=8.0,
-                       dispatch_mode="sorted")
+                       dispatch_mode=fast_mode)
         paddle.seed(3)
         m_e = MoELayer(16, 32, 4, gate=gate_cls(16, 4), capacity_factor=8.0,
                        dispatch_mode="einsum")
@@ -99,10 +106,76 @@ def test_sorted_dispatch_matches_einsum():
         np.testing.assert_allclose(float(m_s.l_aux.numpy()),
                                    float(m_e.l_aux.numpy()), rtol=0.5)
 
-    # router gradient flows through the gate weight in sorted mode (the
-    # top-1 case must use the raw probability, not a renormalized ~1.0)
+
+def test_sorted_capacity_drop_priority_matches_einsum():
+    """Under capacity PRESSURE the sorted path must drop the same entries
+    as the einsum path: first choices fill capacity before any second
+    choice (the shared fill counter in _topk_routing) — round-major entry
+    order in the counting sort reproduces it."""
+    from paddlepaddle_tpu.parallel.moe import GShardGate
+
+    x = np.random.default_rng(1).standard_normal((1, 32, 16)).astype(np.float32)
+    paddle.seed(5)
+    m_s = MoELayer(16, 32, 4, gate=GShardGate(16, 4), capacity_factor=0.6,
+                   dispatch_mode="sorted")
+    paddle.seed(5)
+    m_e = MoELayer(16, 32, 4, gate=GShardGate(16, 4), capacity_factor=0.6,
+                   dispatch_mode="einsum")
+    for (_, p1), (_, p2) in zip(sorted(m_s.raw_state().items()),
+                                sorted(m_e.raw_state().items())):
+        p2._replace_data(p1._data)
+    np.testing.assert_allclose(m_s(x).numpy(), m_e(x).numpy(), atol=1e-5)
+
+
+@pytest.mark.parametrize("fast_mode", ["sorted", "dropless"])
+def test_fast_dispatch_blocked_prefix_sum_branch(fast_mode):
+    """N = T*k >= 512 exercises _counting_sort's blockwise tril-MATMUL
+    prefix-sum branch (bf16 one-hots + cross-block offset stitching), which
+    small parity tests never reach."""
+    from paddlepaddle_tpu.parallel.moe import GShardGate
+
+    x = np.random.default_rng(2).standard_normal((2, 128, 16)).astype(np.float32)
+    paddle.seed(7)
+    m_s = MoELayer(16, 32, 4, gate=GShardGate(16, 4), capacity_factor=8.0,
+                   dispatch_mode=fast_mode)
+    paddle.seed(7)
+    m_e = MoELayer(16, 32, 4, gate=GShardGate(16, 4), capacity_factor=8.0,
+                   dispatch_mode="einsum")
+    for (_, p1), (_, p2) in zip(sorted(m_s.raw_state().items()),
+                                sorted(m_e.raw_state().items())):
+        p2._replace_data(p1._data)
+    np.testing.assert_allclose(m_s(x).numpy(), m_e(x).numpy(), atol=1e-4)
+
+
+@pytest.mark.parametrize("fast_mode", ["sorted", "dropless"])
+def test_fast_dispatch_gradients_match_einsum(fast_mode):
+    """The hand-written gather-only custom vjps (_dispatch_gather /
+    _combine_gather / _slot_*) must produce the same expert-weight and
+    input gradients as autodiff through the einsum path; and the router
+    gradient must flow through the gate weight (the top-1 case uses the
+    raw probability, not a renormalized ~1.0)."""
+    from paddlepaddle_tpu.parallel.moe import GShardGate
+
+    x = np.random.default_rng(3).standard_normal((2, 16, 16)).astype(np.float32)
+    grads = {}
+    for mode in (fast_mode, "einsum"):
+        paddle.seed(9)
+        m = MoELayer(16, 32, 4, gate=GShardGate(16, 4), capacity_factor=8.0,
+                     dispatch_mode=mode)
+        xt = paddle.to_tensor(x, stop_gradient=False)
+        (m(xt) ** 2).sum().backward()
+        grads[mode] = {
+            "x": xt.grad.numpy(),
+            "w_gate": m.w_gate_proj.grad.numpy(),
+            "w_down": m.w_down_proj.grad.numpy(),
+            "gate": m.gate.weight.grad.numpy(),
+        }
+    for name in grads[fast_mode]:
+        np.testing.assert_allclose(grads[fast_mode][name], grads["einsum"][name],
+                                   rtol=1e-3, atol=1e-4, err_msg=name)
+
     m = MoELayer(16, 32, 4, gate=SwitchGate(16, 4), capacity_factor=8.0,
-                 dispatch_mode="sorted")
+                 dispatch_mode=fast_mode)
     xt = paddle.to_tensor(x, stop_gradient=False)
     m(xt).sum().backward()
     g = m.gate.weight.grad
