@@ -33,11 +33,13 @@ from typing import Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from jax import shard_map as _shard_map
 
-from .schedules import (OP_B, OP_B_LAST, OP_F, OP_IDLE, PipelineSchedule,
+from .schedules import (OP_B, OP_B_LAST, OP_BW, OP_BW_LAST, OP_BX,
+                        OP_BX_LAST, OP_F, OP_IDLE, PipelineSchedule,
                         _arrival_tables, build_schedule)
 
 
@@ -182,6 +184,7 @@ def spmd_pipeline_train(stacked_params, head_params, acts, labels,
     chs_t = jnp.asarray(sched.chunks)
     arr = tuple(jnp.asarray(a) for a in _arrival_tables(sched))
     Cs, Cf, Cb = sched.stash_cap, sched.inbox_f_cap, sched.inbox_b_cap
+    Cg = max(sched.gstash_cap, 1)
     up_perm = [(i, (i + 1) % S) for i in range(S)]
     down_perm = [(i, (i - 1) % S) for i in range(S)]
 
@@ -193,7 +196,8 @@ def spmd_pipeline_train(stacked_params, head_params, acts, labels,
         zero_act = jnp.zeros(a_shape, dtype)
 
         def slot(carry, row):
-            stash, inf, inb, gacc, hg, dacts, loss, left_in, right_in = carry
+            (stash, gstash, inf, inb, gacc, hg, dacts, loss,
+             left_in, right_in) = carry
             op_r, m_r, c_r, fv, fm, fc, bv, bm, bc = row
             # deposit last slot's ring arrivals into the chunk inboxes
             inf = inf.at[fc[s_idx], fm[s_idx] % Cf].set(
@@ -208,13 +212,13 @@ def spmd_pipeline_train(stacked_params, head_params, acts, labels,
             p_c = jax.tree_util.tree_map(lambda a: a[c], p_local)
 
             def idle_fn(_):
-                return stash, gacc, hg, dacts, loss, zero_act, zero_act
+                return stash, gstash, gacc, hg, dacts, loss, zero_act, zero_act
 
             def f_fn(_):
                 a_in = jnp.where(g == 0, x_l[m], inf[c, m % Cf])
                 stash2 = stash.at[c, m % Cs].set(a_in)
                 a_out = block_fn(p_c, a_in).astype(dtype)
-                return stash2, gacc, hg, dacts, loss, a_out, zero_act
+                return stash2, gstash, gacc, hg, dacts, loss, a_out, zero_act
 
             def b_fn(_):
                 a_in = stash[c, m % Cs]
@@ -224,7 +228,8 @@ def spmd_pipeline_train(stacked_params, head_params, acts, labels,
                 gacc2 = jax.tree_util.tree_map(
                     lambda acc, d: acc.at[c].add(d), gacc, dp)
                 dacts2 = dacts.at[m].add(jnp.where(g == 0, da, jnp.zeros_like(da)))
-                return stash, gacc2, hg, dacts2, loss, zero_act, da.astype(dtype)
+                return (stash, gstash, gacc2, hg, dacts2, loss, zero_act,
+                        da.astype(dtype))
 
             def blast_fn(_):
                 a_in = stash[c, m % Cs]
@@ -243,17 +248,79 @@ def spmd_pipeline_train(stacked_params, head_params, acts, labels,
                         loss + loss_m.astype(jnp.float32), zero_act,
                         da.astype(dtype))
 
+            def blast_wrap(_):
+                st, gacc2, hg2, dacts2, loss2, up, down = blast_fn(_)
+                return st, gstash, gacc2, hg2, dacts2, loss2, up, down
+
+            # --- zero-bubble split ops (ZBH1): BX = input grad only (the
+            # critical path; parks the cotangent for BW), BW = weight grad
+            # only (fills bubbles). Each re-linearizes the block (remat).
+            def bx_fn(_):
+                a_in = stash[c, m % Cs]
+                g_in = inb[c, m % Cb]
+                _, vjp = jax.vjp(lambda a_: block_fn(p_c, a_), a_in)
+                (da,) = vjp(g_in.astype(dtype))
+                gst2 = gstash.at[c, m % Cg].set(g_in)
+                dacts2 = dacts.at[m].add(jnp.where(g == 0, da, jnp.zeros_like(da)))
+                return (stash, gst2, gacc, hg, dacts2, loss, zero_act,
+                        da.astype(dtype))
+
+            def bw_fn(_):
+                a_in = stash[c, m % Cs]
+                g_in = gstash[c, m % Cg]
+                _, vjp = jax.vjp(lambda p_: block_fn(p_, a_in), p_c)
+                (dp,) = vjp(g_in.astype(dtype))
+                gacc2 = jax.tree_util.tree_map(
+                    lambda acc, d: acc.at[c].add(d), gacc, dp)
+                return stash, gstash, gacc2, hg, dacts, loss, zero_act, zero_act
+
+            def bxlast_fn(_):
+                a_in = stash[c, m % Cs]
+
+                def fwd_loss(a_):
+                    return head_loss_fn(hp, block_fn(p_c, a_), y_l[m])
+
+                loss_m, vjp = jax.vjp(fwd_loss, a_in)
+                (da,) = vjp(jnp.full_like(loss_m, 1.0 / M))
+                dacts2 = dacts.at[m].add(jnp.where(g == 0, da, jnp.zeros_like(da)))
+                return (stash, gstash, gacc, hg, dacts2,
+                        loss + loss_m.astype(jnp.float32), zero_act,
+                        da.astype(dtype))
+
+            def bwlast_fn(_):
+                a_in = stash[c, m % Cs]
+
+                def fwd_loss(p_, hp_):
+                    return head_loss_fn(hp_, block_fn(p_, a_in), y_l[m])
+
+                loss_m, vjp = jax.vjp(fwd_loss, p_c, hp)
+                dp, dhp = vjp(jnp.full_like(loss_m, 1.0 / M))
+                gacc2 = jax.tree_util.tree_map(
+                    lambda acc, d: acc.at[c].add(d), gacc, dp)
+                hg2 = jax.tree_util.tree_map(jnp.add, hg, dhp)
+                return stash, gstash, gacc2, hg2, dacts, loss, zero_act, zero_act
+
             branches = {OP_IDLE: idle_fn, OP_F: f_fn, OP_B: b_fn,
-                        OP_B_LAST: blast_fn}
-            stash, gacc, hg, dacts, loss, up_out, down_out = jax.lax.switch(
-                op, [branches[i] for i in sorted(branches)], None)
+                        OP_B_LAST: blast_wrap, OP_BX: bx_fn, OP_BW: bw_fn,
+                        OP_BX_LAST: bxlast_fn, OP_BW_LAST: bwlast_fn}
+            # lax.switch traces every branch it is given: substitute idle
+            # for opcodes this schedule never emits (a zbh1 table carries no
+            # fused B, a 1f1b table no split ops — each saves compiling two
+            # full block linearizations per chunk)
+            present = set(int(o) for o in np.unique(sched.ops))
+            branch_list = [branches[i] if i in present or i == OP_IDLE
+                           else idle_fn
+                           for i in range(max(present) + 1)]
+            (stash, gstash, gacc, hg, dacts, loss, up_out,
+             down_out) = jax.lax.switch(op, branch_list, None)
             left_next = jax.lax.ppermute(up_out, pp_axis, up_perm)
             right_next = jax.lax.ppermute(down_out, pp_axis, down_perm)
-            return (stash, inf, inb, gacc, hg, dacts, loss,
+            return (stash, gstash, inf, inb, gacc, hg, dacts, loss,
                     left_next, right_next), None
 
         carry0 = (
             jnp.zeros((V, Cs) + a_shape, dtype),
+            jnp.zeros((V, Cg) + a_shape, dtype),
             jnp.zeros((V, Cf) + a_shape, dtype),
             jnp.zeros((V, Cb) + a_shape, dtype),
             jax.tree_util.tree_map(jnp.zeros_like, p_local),
@@ -264,7 +331,7 @@ def spmd_pipeline_train(stacked_params, head_params, acts, labels,
         )
         xs = (ops_t, mbs_t, chs_t) + arr
         carry, _ = jax.lax.scan(slot, carry0, xs)
-        _, _, _, gacc, hg, dacts, loss, _, _ = carry
+        _, _, _, _, gacc, hg, dacts, loss, _, _ = carry
 
         loss = jax.lax.psum(loss, pp_axis) / M
         hg = jax.tree_util.tree_map(lambda a: jax.lax.psum(a, pp_axis), hg)

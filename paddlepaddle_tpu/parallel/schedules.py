@@ -18,12 +18,14 @@ Schedules produced here differ in *bubble* and *peak activation memory*:
 * interleaved (VPP): V chunks per device (virtual stage g = c*S + s runs on
                      device s); warmup (S-s-1)*2 + (V-1)*S; bubble shrinks
                      toward (S-1)/V at the cost of V× stash entries.
-
-Zero-bubble (ZBH1) splits B into dx/dW ops to fill the cooldown; on TPU that
-split forces a second forward recompute per microbatch under vjp semantics
-(dW needs its own linearization), which costs more than the bubble it fills
-at t_f ≈ t_b — measured trade-off documented in tools/pipeline_bubble_bench.py,
-so it is intentionally not part of the zoo.
+* zbh1 (zero-bubble): each inner backward SPLIT into BX (input grad, the
+                     critical path) and BW (weight grad, fills bubbles) —
+                     slot-count bubble drops well below 1F1B at stash S+1
+                     (e.g. S=4 M=16: 0.059 vs 0.158). Under this executor's
+                     remat semantics each split op re-linearizes the block,
+                     one extra forward per microbatch — the wall-clock
+                     trade-off is MEASURED, not assumed:
+                     tools/pipeline_bubble_bench.py runs both.
 
 Every built schedule is validated by an exact dependency simulator (arrival
 one slot after the producing op, one op per device per slot) and annotated
@@ -39,10 +41,18 @@ import numpy as np
 
 OP_IDLE = 0
 OP_F = 1
-OP_B = 2        # inner backward: cotangent arrives from the right neighbor
-OP_B_LAST = 3   # backward of the LAST virtual stage: cotangent from the head/loss
+OP_B = 2        # fused inner backward: cotangent arrives from the right neighbor
+OP_B_LAST = 3   # fused backward of the LAST virtual stage: loss grad in-op
+OP_BX = 4       # zero-bubble split: input-grad only (critical path)
+OP_BW = 5       # zero-bubble split: weight-grad only (fills bubbles)
+OP_BX_LAST = 6  # last stage input-grad + loss (loss grad computed in-op)
+OP_BW_LAST = 7  # last stage weight-grad (+ head-param grads)
 
-OP_NAMES = {OP_IDLE: ".", OP_F: "F", OP_B: "B", OP_B_LAST: "L"}
+OP_NAMES = {OP_IDLE: ".", OP_F: "F", OP_B: "B", OP_B_LAST: "L",
+            OP_BX: "X", OP_BW: "W", OP_BX_LAST: "Y", OP_BW_LAST: "Z"}
+
+_BX_OPS = (OP_B, OP_B_LAST, OP_BX, OP_BX_LAST)   # produce the input cotangent
+_BW_OPS = (OP_B, OP_B_LAST, OP_BW, OP_BW_LAST)   # produce the weight grads
 
 
 @dataclass
@@ -58,6 +68,7 @@ class PipelineSchedule:
     stash_cap: int = 0   # activation stash entries per (device, chunk)
     inbox_f_cap: int = 0  # forward-arrival buffer entries per (device, chunk)
     inbox_b_cap: int = 0  # cotangent-arrival buffer entries per (device, chunk)
+    gstash_cap: int = 1  # held cotangents between a split BX and its BW
     stats: Dict = field(default_factory=dict)
 
     @property
@@ -114,7 +125,7 @@ def _arrival_tables(sched: PipelineSchedule):
                     fin_c[t, s] = (g + 1) // S
             right = (s + 1) % S
             op = sched.ops[t - 1, right]
-            if op in (OP_B, OP_B_LAST):
+            if op in _BX_OPS:
                 g = sched.chunks[t - 1, right] * S + right
                 if g - 1 >= 0 and (g - 1) % S == s:
                     bin_v[t, s] = 1
@@ -128,18 +139,24 @@ def validate(sched: PipelineSchedule) -> PipelineSchedule:
 
     Rules (one-hop ring transport, one slot latency):
       F(m, g):       g == 0, or F(m, g-1) done at slot <= t-1
-      B(m, G-1):     F(m, G-1) done at slot <= t-1 (loss grad computed in-op)
-      B(m, g<G-1):   F(m, g) done and B(m, g+1) done at slot <= t-1
-      one op per (t, device); every (m, g) gets exactly one F and one B.
+      BX(m, G-1):    F(m, G-1) done at slot <= t-1 (loss grad computed in-op)
+      BX(m, g<G-1):  F(m, g) done and BX(m, g+1) done at slot <= t-1
+      BW(m, g):      BX(m, g) done at slot <= t-1 (same device)
+      fused B = BX+BW in one slot; one op per (t, device); every (m, g)
+      gets exactly one F and (one fused B) or (one BX and one BW).
+    The activation stash entry lives F -> BW (fused B frees it immediately);
+    a split BX parks its arrived cotangent in the gstash until its BW.
     """
     S, M, V = sched.S, sched.M, sched.V
     G = sched.num_virtual
     doneF: Dict[Tuple[int, int], int] = {}
-    doneB: Dict[Tuple[int, int], int] = {}
-    stash = np.zeros((S, V), np.int64)    # outstanding F-not-B per (device, chunk)
+    doneBX: Dict[Tuple[int, int], int] = {}
+    doneBW: Dict[Tuple[int, int], int] = {}
+    stash = np.zeros((S, V), np.int64)    # outstanding F-not-BW per (device, chunk)
+    gstash = np.zeros((S, V), np.int64)   # cotangents parked BX -> BW
     inbox_f = np.zeros((S, V), np.int64)  # delivered acts not yet consumed
     inbox_b = np.zeros((S, V), np.int64)
-    max_stash = max_if = max_ib = 0
+    max_stash = max_if = max_ib = max_gs = 0
     fin_v, fin_m, fin_c, bin_v, bin_m, bin_c = _arrival_tables(sched)
     for t in range(sched.T):
         for s in range(S):
@@ -157,6 +174,7 @@ def validate(sched: PipelineSchedule) -> PipelineSchedule:
             g = c * S + s
             if not (0 <= m < M and 0 <= c < V):
                 raise ValueError(f"slot {t} dev {s}: bad (m={m}, c={c})")
+            want_last = (g == G - 1)
             if op == OP_F:
                 if (m, g) in doneF:
                     raise ValueError(f"duplicate F(m={m}, g={g})")
@@ -167,31 +185,52 @@ def validate(sched: PipelineSchedule) -> PipelineSchedule:
                     inbox_f[s, c] -= 1
                 doneF[(m, g)] = t
                 stash[s, c] += 1
-            else:
-                want_last = (g == G - 1)
-                if (op == OP_B_LAST) != want_last:
+            elif op in (OP_B, OP_B_LAST, OP_BX, OP_BX_LAST):
+                if (op in (OP_B_LAST, OP_BX_LAST)) != want_last:
                     raise ValueError(
                         f"slot {t} dev {s}: opcode {op} vs virtual stage {g}")
-                if (m, g) in doneB:
-                    raise ValueError(f"duplicate B(m={m}, g={g})")
+                if (m, g) in doneBX:
+                    raise ValueError(f"duplicate BX(m={m}, g={g})")
                 if doneF.get((m, g), t) > t - 1:
                     raise ValueError(f"slot {t} dev {s}: B(m={m},g={g}) before F")
                 if g < G - 1:
-                    if doneB.get((m, g + 1), t) > t - 1:
+                    if doneBX.get((m, g + 1), t) > t - 1:
                         raise ValueError(
                             f"slot {t} dev {s}: B(m={m},g={g}) before downstream B")
                     inbox_b[s, c] -= 1
-                doneB[(m, g)] = t
+                doneBX[(m, g)] = t
+                if op in (OP_B, OP_B_LAST):      # fused: weight grad too
+                    doneBW[(m, g)] = t
+                    stash[s, c] -= 1
+                else:
+                    if op == OP_BX:              # park the cotangent for BW
+                        gstash[s, c] += 1
+            elif op in (OP_BW, OP_BW_LAST):  # see _BW_OPS
+                if (op == OP_BW_LAST) != want_last:
+                    raise ValueError(
+                        f"slot {t} dev {s}: opcode {op} vs virtual stage {g}")
+                if (m, g) in doneBW:
+                    raise ValueError(f"duplicate BW(m={m}, g={g})")
+                if doneBX.get((m, g), t) > t - 1:
+                    raise ValueError(f"slot {t} dev {s}: BW(m={m},g={g}) before BX")
+                doneBW[(m, g)] = t
                 stash[s, c] -= 1
+                if op == OP_BW:
+                    gstash[s, c] -= 1
+            else:
+                raise ValueError(f"slot {t} dev {s}: unknown opcode {op}")
         max_stash = max(max_stash, stash.max())
+        max_gs = max(max_gs, gstash.max())
         if (inbox_f < 0).any() or (inbox_b < 0).any():
             raise ValueError(f"slot {t}: consumed an arrival that never came")
-    if len(doneF) != M * G or len(doneB) != M * G:
+    if len(doneF) != M * G or len(doneBX) != M * G or len(doneBW) != M * G:
         raise ValueError(
-            f"incomplete schedule: {len(doneF)}/{M * G} F, {len(doneB)}/{M * G} B")
+            f"incomplete schedule: {len(doneF)}/{M * G} F, "
+            f"{len(doneBX)}/{M * G} BX, {len(doneBW)}/{M * G} BW")
     sched.stash_cap = max(int(max_stash), 1)
     sched.inbox_f_cap = max(int(max_if), 1)
     sched.inbox_b_cap = max(int(max_ib), 1)
+    sched.gstash_cap = max(int(max_gs), 1)
     _check_slot_collisions(sched, fin_v, fin_m, fin_c, bin_v, bin_m, bin_c)
     busy = int((sched.ops != OP_IDLE).sum())
     sched.stats = {
@@ -213,6 +252,7 @@ def _check_slot_collisions(sched: PipelineSchedule, fin_v, fin_m, fin_c,
     """
     S, V = sched.S, sched.V
     stash: Dict[Tuple[int, int, int], int] = {}   # (s, c, m % cap) -> m
+    gst: Dict[Tuple[int, int, int], int] = {}
     inf: Dict[Tuple[int, int, int], int] = {}
     inb: Dict[Tuple[int, int, int], int] = {}
 
@@ -243,9 +283,15 @@ def _check_slot_collisions(sched: PipelineSchedule, fin_v, fin_m, fin_c,
             if op == OP_F:
                 occupy(stash, "stash", s, c, m, sched.stash_cap, t)
                 inf.pop((s, c, m % sched.inbox_f_cap), None)
-            else:
+            elif op in (OP_BX, OP_BX_LAST):
+                inb.pop((s, c, m % sched.inbox_b_cap), None)
+                if op == OP_BX:
+                    occupy(gst, "gstash", s, c, m, sched.gstash_cap, t)
+            else:  # fused B / BW: the activation stash entry is released
                 stash.pop((s, c, m % sched.stash_cap), None)
                 inb.pop((s, c, m % sched.inbox_b_cap), None)
+                if op == OP_BW:
+                    gst.pop((s, c, m % sched.gstash_cap), None)
 
 
 def _pack(events: List[Tuple[int, int, int, int, int]], S: int, M: int,
@@ -354,8 +400,76 @@ def build_1f1b(S: int, M: int, V: int = 1) -> PipelineSchedule:
     return _pack(events, S, M, V)
 
 
+def build_zbh1(S: int, M: int) -> PipelineSchedule:
+    """ZBH1 (zero-bubble, handshake-1): each inner backward is SPLIT into
+    BX (input grad — stays on the 1F1B critical path) and BW (weight grad —
+    fills what would otherwise be bubble slots, especially the cooldown).
+
+    Reference: passes/pipeline_scheduler_pass/pipeline_zero_bubble.py:62
+    (PipelineZeroBubblePipelineParallel job order F/B/W). Built by a greedy
+    list scheduler per device with priority BX > F > BW, F admission capped
+    1F1B-style (at most S-s microbatches in flight before their BX), BW
+    forced when the activation stash would exceed the 1F1B bound (S+1) —
+    bubble drops below 1F1B at EQUAL memory cap, which the validator
+    certifies exactly.
+
+    Under this executor's remat semantics each of BX and BW re-linearizes
+    the block (one extra forward per microbatch vs fused B) — whether the
+    bubble win pays for that is measured, not assumed:
+    tools/pipeline_bubble_bench.py prints both the analytic bubble and the
+    executed wall-clock for 1f1b vs zbh1.
+    """
+    G = S
+    doneF: Dict[Tuple[int, int], int] = {}
+    doneBX: Dict[Tuple[int, int], int] = {}
+    fi = [0] * S                      # next microbatch to forward, per device
+    bx = [0] * S                      # next microbatch to BX, per device
+    pending_bw: List[List[int]] = [[] for _ in range(S)]
+    stash_now = [0] * S               # F-not-BW entries (activation memory)
+    stash_cap_target = S + 1
+    events: List[Tuple[int, int, int, int, int]] = []
+    t = 0
+    limit = 8 * (3 * M + S) + 64
+    while any(fi[s] < M or bx[s] < M or pending_bw[s] for s in range(S)) \
+            and t < limit:
+        for s in range(S):
+            g = s
+            # 1) BX if its inputs have arrived (critical path)
+            m = bx[s]
+            if m < M and doneF.get((m, g), t) <= t - 1 and (
+                    g == G - 1 or doneBX.get((m, g + 1), t) <= t - 1):
+                op = OP_BX_LAST if g == G - 1 else OP_BX
+                events.append((t, s, op, m, 0))
+                doneBX[(m, g)] = t
+                pending_bw[s].append(m)
+                bx[s] += 1
+                continue
+            # 2) forward, unless the 1F1B in-flight cap or stash bound says no
+            m = fi[s]
+            can_f = (m < M and (g == 0 or doneF.get((m, g - 1), t) <= t - 1)
+                     and (fi[s] - bx[s]) < max(S - s, 1)
+                     and stash_now[s] < stash_cap_target)
+            if can_f:
+                events.append((t, s, OP_F, m, 0))
+                doneF[(m, g)] = t
+                fi[s] += 1
+                stash_now[s] += 1
+                continue
+            # 3) fill the bubble with a weight grad
+            if pending_bw[s]:
+                m = pending_bw[s].pop(0)
+                op = OP_BW_LAST if g == G - 1 else OP_BW
+                events.append((t, s, op, m, 0))
+                stash_now[s] -= 1
+        t += 1
+    if any(fi[s] < M or bx[s] < M or pending_bw[s] for s in range(S)):
+        raise RuntimeError(f"zbh1 scheduler deadlocked (S={S}, M={M})")
+    return _pack(events, S, M, 1)
+
+
 def build_schedule(name: str, S: int, M: int, V: int = 1) -> PipelineSchedule:
-    """Schedule zoo entry point: 'gpipe'/'FThenB', '1f1b', 'interleaved'/'vpp'."""
+    """Schedule zoo entry point: 'gpipe'/'FThenB', '1f1b',
+    'interleaved'/'vpp', 'zbh1'/'zero-bubble'."""
     key = name.lower()
     if key in ("gpipe", "fthenb", "f_then_b"):
         if V != 1:
@@ -368,4 +482,8 @@ def build_schedule(name: str, S: int, M: int, V: int = 1) -> PipelineSchedule:
         return build_1f1b(S, M, V=1)
     if key in ("interleaved", "vpp", "1f1b-interleaved"):
         return build_1f1b(S, M, V=V)
+    if key in ("zbh1", "zb", "zero-bubble"):
+        if V != 1:
+            raise ValueError("zbh1 is a V=1 schedule (ZBV is not implemented)")
+        return build_zbh1(S, M)
     raise ValueError(f"unknown schedule {name!r}")

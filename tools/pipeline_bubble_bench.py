@@ -68,7 +68,8 @@ def main():
     mesh = Mesh(np.array(jax.devices()).reshape(2, S), ("dp", "pp"))
 
     results = []
-    for name, V in [("gpipe", 1), ("1f1b", 1), ("interleaved", 2)]:
+    for name, V in [("gpipe", 1), ("1f1b", 1), ("interleaved", 2),
+                    ("zbh1", 1)]:
         G = V * S
         per_virtual = depth // G  # layers per virtual stage: equal total depth
         layers = [mklayer(g) for g in range(G)]
