@@ -56,6 +56,17 @@ class GradScaler:
                 g = p._grad.astype(jnp.float32) * inv
                 found = found or bool(jnp.any(~jnp.isfinite(g)))
                 p._grad = g.astype(p._grad.dtype)
+        # multi-host jobs must agree on skip-vs-step (the reference
+        # all-reduces found_inf across the world, process_group.h:48): a
+        # host-side MAX over the DCN group settles it
+        from ..distributed.host_collectives import get_host_group
+
+        hg = get_host_group()
+        if hg is not None:
+            import numpy as np
+
+            found = bool(hg.all_reduce(
+                np.asarray(found, np.float32), op="max") > 0)
         self._found_inf = found
         self._unscaled = True
 

@@ -7,7 +7,9 @@ the explicit-collective python API maps to shard_map + psum/all_gather/
 ppermute over mesh axes."""
 
 from .collective import (  # noqa: F401
+    ReduceOp,
     all_gather,
+    all_gather_object,
     all_reduce,
     all_to_all,
     barrier,

@@ -28,10 +28,13 @@ Format v1 (one global-value file per tensor) is still readable.
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import re
 import threading
+import time
+from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -41,6 +44,19 @@ from ...core.tensor import Tensor
 
 _META_NAME = "metadata.json"
 _pending_saves = []
+
+
+@dataclass
+class LocalShards:
+    """One host's view of a globally-sharded tensor: the shards whose bytes
+    live here (multi-host save writes these; the coordinator merges every
+    host's records into one metadata.json). Built automatically from a
+    non-addressable jax.Array; constructible directly for tests/tools."""
+
+    global_shape: Tuple[int, ...]
+    dtype: str
+    shards: List = field(default_factory=list)  # [(box [[lo,hi],...], array)]
+    sharding: Optional[dict] = None
 
 
 def _sanitize(key: str) -> str:
@@ -74,14 +90,20 @@ def _index_box(index: Tuple[slice, ...], shape: Tuple[int, ...]) -> List[List[in
 
 
 def _unique_shards(arr):
-    """(box, device_array) per distinct global index — replicas deduped
-    (the reference's save_state_dict.py:117 dedup of replicated shards)."""
+    """(box, device_array) per distinct global index that THIS process owns.
+
+    Replicas are deduped by ``replica_id == 0`` — on one host that keeps a
+    single copy per box (save_state_dict.py:117 semantics); across hosts it
+    elects exactly one owner host per box, so a multi-host save writes each
+    byte once globally with no coordination beyond the metadata merge."""
     shards = getattr(arr, "addressable_shards", None)
     if not shards:
         full = tuple(slice(0, d) for d in np.shape(arr))
         return [(_index_box(full, np.shape(arr)), arr)]
     seen = {}
     for sh in shards:
+        if getattr(sh, "replica_id", 0) != 0:
+            continue
         box = _index_box(sh.index, arr.shape)
         key = tuple(map(tuple, box))
         if key not in seen:
@@ -89,25 +111,98 @@ def _unique_shards(arr):
     return list(seen.values())
 
 
+def _rank_meta_name(rank: int) -> str:
+    return f"{_META_NAME}.rank{rank}"
+
+
+def _merge_rank_metadata(path: str, world: int, timeout: float) -> None:
+    """Coordinator: wait for every host's rank-metadata file, merge shard
+    lists (dedup by global index box — replicated tensors are recorded by
+    several hosts), write the final metadata.json
+    (save_state_dict.py:46,63,145 semantics: local writes + coordinator
+    metadata gather)."""
+    deadline = time.monotonic() + timeout
+    ranks = {}
+    while len(ranks) < world:
+        for r in range(world):
+            if r in ranks:
+                continue
+            fp = os.path.join(path, _rank_meta_name(r))
+            if os.path.exists(fp):
+                try:
+                    with open(fp) as f:
+                        ranks[r] = json.load(f)
+                except (json.JSONDecodeError, OSError):
+                    pass  # mid-write; retry
+        if len(ranks) < world:
+            if time.monotonic() > deadline:
+                missing = [r for r in range(world) if r not in ranks]
+                raise TimeoutError(
+                    f"multi-host checkpoint merge: ranks {missing} never "
+                    f"wrote {path}/{_META_NAME}.rank*")
+            time.sleep(0.05)
+    # consume the rank records: a later save to the SAME path must wait for
+    # fresh ones, not merge these stale files while ranks still write data
+    for r in range(world):
+        try:
+            os.remove(os.path.join(path, _rank_meta_name(r)))
+        except OSError:
+            pass
+    meta = {"tensors": {}, "format": "paddlepaddle_tpu.dist_ckpt.v2",
+            "world_size": world}
+    for r in sorted(ranks):
+        for key, rec in ranks[r]["tensors"].items():
+            tgt = meta["tensors"].setdefault(key, {
+                "shape": rec["shape"], "dtype": rec["dtype"],
+                "sharding": rec.get("sharding"), "shards": []})
+            if tuple(tgt["shape"]) != tuple(rec["shape"]):
+                raise ValueError(
+                    f"{key}: rank {r} reports shape {rec['shape']} vs "
+                    f"{tgt['shape']}")
+            have = {tuple(map(tuple, s["box"])) for s in tgt["shards"]}
+            for s in rec["shards"]:
+                if tuple(map(tuple, s["box"])) not in have:
+                    tgt["shards"].append(s)
+    with open(os.path.join(path, _META_NAME), "w") as f:
+        json.dump(meta, f, indent=1)
+
+
 def save_state_dict(state_dict: Dict[str, object], path: str,
                     process_group=None, coordinator_rank: int = 0,
-                    unique_name: bool = True, async_save: bool = False) -> None:
-    """Write per-shard files + metadata.json (format v2, see module doc)."""
+                    unique_name: bool = True, async_save: bool = False,
+                    process_index: Optional[int] = None,
+                    process_count: Optional[int] = None,
+                    merge_timeout: float = 300.0) -> None:
+    """Write per-shard files + metadata (format v2, see module doc).
+
+    Multi-host: each host writes ONLY its addressable shards (replica-0
+    owners) plus a ``metadata.json.rankN`` record; the coordinator rank
+    merges all rank records into the final ``metadata.json`` once every
+    host's record appears on the (shared) checkpoint path. Values may also
+    be ``LocalShards`` (explicit per-host shard lists)."""
+    pid = jax.process_index() if process_index is None else process_index
+    world = jax.process_count() if process_count is None else process_count
     os.makedirs(path, exist_ok=True)
     meta = {"tensors": {}, "format": "paddlepaddle_tpu.dist_ckpt.v2"}
     items = []  # (fpath, device_or_host_array)
     used_names = set()
     for key, val in state_dict.items():
         arr = val._data if isinstance(val, Tensor) else val
-        if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
-            raise ValueError(
-                f"{key}: non-addressable shards; multi-host save writes only "
-                "local shards per host — gather metadata across hosts first")
-        shards = _unique_shards(arr)
+        if isinstance(arr, LocalShards):
+            shape, dtype = tuple(arr.global_shape), arr.dtype
+            sharding = arr.sharding
+            shards = [(list(map(list, b)), d) for b, d in arr.shards]
+        else:
+            shape = tuple(np.shape(arr))
+            dtype = str(arr.dtype if hasattr(arr, "dtype")
+                        else np.asarray(arr).dtype)
+            sharding = _sharding_record(arr)
+            shards = _unique_shards(arr)
 
         def _files(base):
-            return ([f"{base}.npy"] if len(shards) == 1
-                    else [f"{base}.s{i}.npy" for i in range(len(shards))])
+            tag = f".p{pid}" if world > 1 else ""
+            return ([f"{base}{tag}.npy"] if len(shards) == 1 and world == 1
+                    else [f"{base}{tag}.s{i}.npy" for i in range(len(shards))])
 
         # uniqueness must hold on the FINAL filenames: distinct keys may
         # sanitize identically, and a key literally named "w.s0" must not
@@ -125,18 +220,27 @@ def save_state_dict(state_dict: Dict[str, object], path: str,
                 data.copy_to_host_async()  # enqueue d2h DMA; get later is cheap
             items.append((os.path.join(path, fname), data))
         meta["tensors"][key] = {
-            "shape": list(np.shape(arr)),
-            "dtype": str(arr.dtype if hasattr(arr, "dtype")
-                         else np.asarray(arr).dtype),
-            "sharding": _sharding_record(arr),
+            "shape": list(shape),
+            "dtype": str(dtype),
+            "sharding": sharding,
             "shards": shard_recs,
         }
 
     def write():
         for fpath, data in items:
             np.save(fpath, np.asarray(jax.device_get(data)))
-        with open(os.path.join(path, _META_NAME), "w") as f:
+        if world == 1:
+            with open(os.path.join(path, _META_NAME), "w") as f:
+                json.dump(meta, f, indent=1)
+            return
+        # rank record LAST: its existence tells the coordinator this
+        # host's data files are durably on the shared path
+        tmp = os.path.join(path, _rank_meta_name(pid) + ".tmp")
+        with open(tmp, "w") as f:
             json.dump(meta, f, indent=1)
+        os.replace(tmp, os.path.join(path, _rank_meta_name(pid)))
+        if pid == coordinator_rank:
+            _merge_rank_metadata(path, world, merge_timeout)
 
     if async_save:
         box = {}
@@ -167,6 +271,23 @@ def wait_all_saves():
             first_error = err
     if first_error is not None:
         raise first_error
+
+
+def _wait_all_saves_at_exit():
+    """Process-exit flush: daemon writer threads would otherwise be killed
+    mid-write, silently dropping a checkpoint the train loop believes it
+    saved. Registered at import; failures are reported, not raised (raising
+    in atexit only prints anyway, and must not mask the real exit path)."""
+    try:
+        wait_all_saves()
+    except BaseException as e:  # pragma: no cover - exit-path reporting
+        import sys
+
+        print(f"[paddlepaddle_tpu.checkpoint] async save failed at exit: {e!r}",
+              file=sys.stderr)
+
+
+atexit.register(_wait_all_saves_at_exit)
 
 
 def get_checkpoint_metadata(path: str) -> dict:

@@ -123,60 +123,142 @@ def _single_controller_identity(tensor):
     return tensor
 
 
+def _hg(group=None):
+    """Cross-host eager group (None when single-process). Reference
+    semantics: process_group.h:48 — eager ops on a multi-process group;
+    here the transport is the native TCPStore over DCN for small host-side
+    tensors (see distributed.host_collectives). Only the WORLD group is
+    implemented: a proper-subgroup collective would deadlock the
+    non-members' sequence counters, so it raises instead."""
+    from .host_collectives import get_host_group
+
+    g = get_host_group()
+    if g is not None and group is not None:
+        ranks = getattr(group, "ranks", None)
+        if ranks is not None and sorted(ranks) != list(range(g.world_size)):
+            raise NotImplementedError(
+                f"eager collectives over a proper subgroup {ranks} are not "
+                "supported on the host transport; use the world group or a "
+                "mesh-axis functional collective (f_*) inside shard_map")
+    return g
+
+
+def _np(tensor):
+    import numpy as np
+
+    return np.asarray(unwrap(tensor))
+
+
+def _set_inplace(tensor, value):
+    if isinstance(tensor, Tensor):
+        tensor.set_value(value)
+        return tensor
+    return wrap(jnp.asarray(value))
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
-    return _single_controller_identity(tensor)
+    g = _hg(group)
+    if g is None:
+        return _single_controller_identity(tensor)
+    return _set_inplace(tensor, g.all_reduce(_np(tensor), op))
 
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True):
-    tensor_list.append(tensor)
+    g = _hg(group)
+    if g is None:
+        tensor_list.append(tensor)
+        return tensor_list
+    tensor_list.extend(wrap(jnp.asarray(a)) for a in g.all_gather(_np(tensor)))
     return tensor_list
 
 
 def all_gather_object(object_list, obj, group=None):
-    object_list.append(obj)
+    g = _hg(group)
+    if g is None:
+        object_list.append(obj)
+        return object_list
+    object_list.extend(g.gather_object(obj))
     return object_list
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
-    return tensor
+    g = _hg(group)
+    if g is None:
+        return tensor
+    return _set_inplace(tensor, g.broadcast(_np(tensor), src=src))
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
-    return tensor
+    g = _hg(group)
+    if g is None:
+        return tensor
+    out = g.all_reduce(_np(tensor), op)  # result guaranteed on dst; set everywhere
+    return _set_inplace(tensor, out)
 
 
 def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=True):
-    if tensor_list:
-        tensor.set_value(tensor_list[0])
-    return tensor
+    g = _hg(group)
+    if g is None:
+        if tensor_list:
+            tensor.set_value(tensor_list[0])
+        return tensor
+    # one all_to_all (rank r ships part d to rank d) + a local reduce:
+    # O(world) messages instead of world full all_reduces
+    import numpy as np
+
+    mine = g.all_to_all([_np(t) for t in tensor_list])
+    from .host_collectives import _REDUCERS
+
+    return _set_inplace(tensor, _REDUCERS[op](np.stack(mine)))
 
 
 def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
-    out_tensor_list.extend(in_tensor_list)
+    g = _hg(group)
+    if g is None:
+        out_tensor_list.extend(in_tensor_list)
+        return out_tensor_list
+    outs = g.all_to_all([_np(t) for t in in_tensor_list])
+    out_tensor_list.extend(wrap(jnp.asarray(a)) for a in outs)
     return out_tensor_list
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
-    if tensor_list:
-        tensor.set_value(tensor_list[0])
-    return tensor
+    g = _hg(group)
+    if g is None:
+        if tensor_list:
+            tensor.set_value(tensor_list[0])
+        return tensor
+    parts = [_np(t) for t in tensor_list] if tensor_list else None
+    return _set_inplace(tensor, g.scatter(parts, src=src))
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "point-to-point send/recv between hosts requires the multi-host "
-        "runtime (jax.distributed); within a mesh use shard_map + ppermute")
+    g = _hg(group)
+    if g is None:
+        raise NotImplementedError(
+            "point-to-point send/recv needs a multi-process job (set "
+            "PADDLE_TRAINERS_NUM / MASTER_ADDR, e.g. via distributed.launch); "
+            "within a mesh use shard_map + ppermute")
+    g.send(_np(tensor), dst=dst)
+    return tensor
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "point-to-point send/recv between hosts requires the multi-host "
-        "runtime (jax.distributed); within a mesh use shard_map + ppermute")
+    g = _hg(group)
+    if g is None:
+        raise NotImplementedError(
+            "point-to-point send/recv needs a multi-process job (set "
+            "PADDLE_TRAINERS_NUM / MASTER_ADDR, e.g. via distributed.launch); "
+            "within a mesh use shard_map + ppermute")
+    return _set_inplace(tensor, g.recv(src=src))
 
 
 def barrier(group=None):
     from .comm_task import comm_task
 
-    # single-controller: dispatch is ordered; block host until devices finish
+    g = _hg()
     with comm_task("barrier", group=getattr(group, "name", None) or "world"):
+        if g is not None:
+            g.barrier()
+        # dispatch is ordered; block host until local devices finish
         jax.effects_barrier()

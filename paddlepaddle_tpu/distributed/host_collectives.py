@@ -1,0 +1,177 @@
+"""Eager cross-host collectives over the TCPStore (the DCN control plane).
+
+Reference surface: paddle/phi/core/distributed/collective/process_group.h:48
+— eager all_reduce / broadcast / all_gather / send / recv on a multi-process
+group. TPU-native split: the DATA plane for model tensors is XLA collectives
+over ICI (GSPMD), so what legitimately remains at the python level is
+host-side coordination of SMALL tensors across hosts over DCN — found_inf
+flags, metric aggregation, elastic rendezvous. Those are gather-style over
+the native TCPStore (native/tcp_store.cpp): O(world) small messages per op,
+the right transport at the sizes involved (bytes to KBs). Large-tensor
+cross-host reduction belongs in a jit'ed program over a multi-host mesh, not
+here — the wrappers in ``distributed.collective`` pick the path.
+
+Every process must issue the same collectives in the same order (the
+standard process-group contract); a per-group sequence number keys each
+op's slots in the store.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import pickle
+from typing import List, Optional
+
+import numpy as np
+
+
+def _dumps(arr: np.ndarray) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, np.asarray(arr), allow_pickle=False)
+    return buf.getvalue()
+
+
+def _loads(raw: bytes) -> np.ndarray:
+    return np.load(io.BytesIO(raw), allow_pickle=False)
+
+
+_REDUCERS = {
+    "sum": lambda xs: np.sum(xs, axis=0),
+    "avg": lambda xs: np.mean(xs, axis=0),
+    "max": lambda xs: np.max(xs, axis=0),
+    "min": lambda xs: np.min(xs, axis=0),
+    "prod": lambda xs: np.prod(xs, axis=0),
+}
+
+
+_SLOT_WINDOW = 64
+
+
+class HostProcessGroup:
+    """Eager collectives for one process per host, keyed through the store.
+
+    Key space is BOUNDED: collective slots are addressed ``seq % 64``. Every
+    collective involves all ranks, so a rank can be at most one op ahead in
+    posting before it must wait on the others — lap distance 2 << 64, no
+    slot can be re-read stale, and the master store's memory stays O(window)
+    instead of growing with step count. Point-to-point send/recv is
+    one-sided (a sender may run arbitrarily far ahead), so p2p keys carry
+    the full per-pair sequence and the receiver tombstones each payload
+    after reading it.
+    """
+
+    def __init__(self, store, rank: int, world_size: int, gid: int = 0):
+        self.store = store
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        self.gid = gid
+        self._seq = 0
+        self._p2p: dict = {}          # (src, dst) -> per-pair sequence
+
+    def _key(self, seq: int, tag: str) -> str:
+        return f"hcoll/{self.gid}/{seq % _SLOT_WINDOW}/{tag}"
+
+    def _next(self) -> int:
+        self._seq += 1
+        return self._seq
+
+    # -- primitives ---------------------------------------------------------
+    def all_gather(self, arr: np.ndarray) -> List[np.ndarray]:
+        seq = self._next()
+        self.store.set(self._key(seq, f"r{self.rank}"), _dumps(arr))
+        keys = [self._key(seq, f"r{r}") for r in range(self.world_size)]
+        self.store.wait(keys)
+        return [_loads(self.store.get(k)) for k in keys]
+
+    def all_reduce(self, arr: np.ndarray, op: str = "sum") -> np.ndarray:
+        parts = self.all_gather(arr)
+        return _REDUCERS[op](np.stack(parts))
+
+    def broadcast(self, arr: np.ndarray, src: int = 0) -> np.ndarray:
+        seq = self._next()
+        key = self._key(seq, f"src{src}")
+        if self.rank == src:
+            self.store.set(key, _dumps(arr))
+            return np.asarray(arr)
+        self.store.wait([key])
+        return _loads(self.store.get(key))
+
+    def scatter(self, parts: Optional[List[np.ndarray]], src: int = 0) -> np.ndarray:
+        seq = self._next()
+        if self.rank == src:
+            assert parts is not None and len(parts) == self.world_size
+            for r, p in enumerate(parts):
+                self.store.set(self._key(seq, f"d{r}"), _dumps(p))
+        key = self._key(seq, f"d{self.rank}")
+        self.store.wait([key])
+        return _loads(self.store.get(key))
+
+    def all_to_all(self, parts: List[np.ndarray]) -> List[np.ndarray]:
+        seq = self._next()
+        assert len(parts) == self.world_size
+        for r, p in enumerate(parts):
+            self.store.set(self._key(seq, f"{self.rank}to{r}"), _dumps(p))
+        keys = [self._key(seq, f"{r}to{self.rank}")
+                for r in range(self.world_size)]
+        self.store.wait(keys)
+        return [_loads(self.store.get(k)) for k in keys]
+
+    def _p2p_key(self, src: int, dst: int) -> str:
+        # per-pair counter: p2p must NOT touch the group sequence (only the
+        # pair participates; bumping _seq would desync the other ranks)
+        n = self._p2p.get((src, dst), 0) + 1
+        self._p2p[(src, dst)] = n
+        return f"hp2p/{self.gid}/{src}to{dst}/{n}"
+
+    def send(self, arr: np.ndarray, dst: int) -> None:
+        self.store.set(self._p2p_key(self.rank, dst), _dumps(arr))
+
+    def recv(self, src: int) -> np.ndarray:
+        key = self._p2p_key(src, self.rank)
+        self.store.wait([key])
+        out = _loads(self.store.get(key))
+        self.store.set(key, b"")      # tombstone: bound master memory
+        return out
+
+    def gather_object(self, obj) -> List[object]:
+        seq = self._next()
+        self.store.set(self._key(seq, f"o{self.rank}"), pickle.dumps(obj))
+        keys = [self._key(seq, f"o{r}") for r in range(self.world_size)]
+        self.store.wait(keys)
+        return [pickle.loads(self.store.get(k)) for k in keys]
+
+    def barrier(self) -> None:
+        seq = self._next()
+        count = self.store.add(self._key(seq, "bar"), 1)
+        if count >= self.world_size:
+            self.store.set(self._key(seq, "bar_done"), b"1")
+        self.store.wait([self._key(seq, "bar_done")])
+
+
+_host_group: Optional[HostProcessGroup] = None
+_probed = False
+
+
+def get_host_group() -> Optional[HostProcessGroup]:
+    """The world host-group, or None when this job is single-process (the
+    eager wrappers then use single-controller semantics)."""
+    global _host_group, _probed
+    if _probed:
+        return _host_group
+    _probed = True
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM")
+                or os.environ.get("WORLD_SIZE") or 1)
+    if world > 1:
+        rank = int(os.environ.get("PADDLE_TRAINER_ID")
+                   or os.environ.get("RANK") or 0)
+        # the global store factory reads only the PADDLE_* names — pin them
+        # so torch-style RANK/WORLD_SIZE jobs configure the SAME store
+        # (rank 0 hosting, everyone else connecting)
+        os.environ.setdefault("PADDLE_TRAINER_ID", str(rank))
+        os.environ.setdefault("PADDLE_TRAINERS_NUM", str(world))
+        from .store import create_or_get_global_tcp_store
+
+        _host_group = HostProcessGroup(create_or_get_global_tcp_store(),
+                                       rank, world)
+    return _host_group

@@ -127,6 +127,11 @@ class Model:
                 break
         for c in cbks:
             c.on_train_end()
+        # a trailing distributed async checkpoint must be durable before fit
+        # returns (the atexit hook is the last resort, not the contract)
+        from ..distributed.checkpoint import wait_all_saves
+
+        wait_all_saves()
         return history
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
@@ -175,8 +180,10 @@ class Model:
 
     # -- persistence ----------------------------------------------------------
     def save(self, path, training=True):
+        from ..distributed.checkpoint import wait_all_saves
         from ..framework.io_api import save
 
+        wait_all_saves()  # don't interleave with an in-flight async ckpt
         self._sync_weights()
         save(self.network.state_dict(), path + ".pdparams")
         if training and self._optimizer is not None and hasattr(self._optimizer, "state_dict"):

@@ -127,3 +127,76 @@ def test_reshard_hybrid_to_hybrid(tmp_path):
     for k, v in vals.items():
         np.testing.assert_allclose(sd2[k].numpy(), v)
         assert sd2[k]._data.sharding.mesh.shape == {"dp": 2, "fsdp": 2, "tp": 2}
+
+
+def test_multihost_save_merges_rank_metadata(tmp_path):
+    """Two simulated hosts (save_state_dict.py:46,63,145 semantics): each
+    writes only its local shards + a rank record; the coordinator merges
+    them (deduping boxes both hosts replicate) into one metadata.json that
+    loads as the full global state."""
+    import numpy as np
+
+    from paddlepaddle_tpu.distributed.checkpoint import LocalShards
+
+    w = np.arange(12, dtype=np.float32).reshape(4, 3)
+    b = np.arange(3, dtype=np.float32)
+    ck = str(tmp_path / "ckpt")
+    # non-coordinator host 1 first: rows 2:4 of w + its replica of b
+    dist_ckpt.save_state_dict(
+        {"w": LocalShards((4, 3), "float32", [([[2, 4], [0, 3]], w[2:4])]),
+         "b": LocalShards((3,), "float32", [([[0, 3]], b)])},
+        ck, process_index=1, process_count=2)
+    # coordinator host 0: rows 0:2 + its replica of b; merges on return
+    dist_ckpt.save_state_dict(
+        {"w": LocalShards((4, 3), "float32", [([[0, 2], [0, 3]], w[0:2])]),
+         "b": LocalShards((3,), "float32", [([[0, 3]], b)])},
+        ck, process_index=0, process_count=2)
+
+    meta = dist_ckpt.get_checkpoint_metadata(ck)
+    assert meta["world_size"] == 2
+    assert len(meta["tensors"]["w"]["shards"]) == 2
+    assert len(meta["tensors"]["b"]["shards"]) == 1  # replica deduped
+    out = {"w": np.zeros((4, 3), np.float32), "b": np.zeros((3,), np.float32)}
+    dist_ckpt.load_state_dict(out, ck)
+    np.testing.assert_allclose(out["w"], w)
+    np.testing.assert_allclose(out["b"], b)
+
+
+def test_multihost_merge_times_out_on_missing_rank(tmp_path):
+    from paddlepaddle_tpu.distributed.checkpoint import LocalShards
+
+    with pytest.raises(TimeoutError, match="rank"):
+        dist_ckpt.save_state_dict(
+            {"w": LocalShards((2,), "float32",
+                              [([[0, 2]], np.zeros(2, np.float32))])},
+            str(tmp_path / "ckpt"), process_index=0, process_count=2,
+            merge_timeout=0.3)
+
+
+def test_async_save_flushed_at_process_exit(tmp_path):
+    """A process that async-saves and exits WITHOUT calling wait_all_saves
+    must still leave a complete checkpoint (the atexit flush)."""
+    import subprocess
+    import sys
+
+    ck = str(tmp_path / "ckpt")
+    code = (
+        "import os, sys\n"
+        f"sys.path.insert(0, {repr(str(__import__('pathlib').Path(__file__).resolve().parent.parent))})\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "import numpy as np\n"
+        "import paddlepaddle_tpu as paddle\n"
+        "from paddlepaddle_tpu.distributed import checkpoint as dist_ckpt\n"
+        "m = paddle.nn.Linear(64, 64)\n"
+        f"dist_ckpt.save_state_dict(m.state_dict(), {ck!r}, async_save=True)\n"
+        "sys.exit(0)\n"  # no wait_all_saves: atexit must flush
+    )
+    env = dict(__import__("os").environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    m2 = paddle.nn.Linear(64, 64)
+    sd2 = m2.state_dict()
+    dist_ckpt.load_state_dict(sd2, ck)  # raises if torn/missing

@@ -1,0 +1,104 @@
+"""Cross-host eager collectives: 2 real processes over the TCPStore.
+
+Reference: paddle/phi/core/distributed/collective/process_group.h:48 —
+eager all_reduce/broadcast/all_gather/send/recv on a multi-process group.
+Here two OS processes rendezvous through the (native C++ or python) store
+and must produce identical, correct collective results.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_WORKER = r"""
+import os, sys
+sys.path.insert(0, os.environ["REPO_DIR"])
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+import paddlepaddle_tpu as paddle
+import paddlepaddle_tpu.distributed as dist
+
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+
+# all_reduce (sum): in-place on the tensor
+t = paddle.to_tensor(np.asarray([1.0 + rank, 2.0 * (rank + 1)], np.float32))
+dist.all_reduce(t)
+np.testing.assert_allclose(t.numpy(), [3.0, 6.0])
+
+# all_reduce max
+t = paddle.to_tensor(np.asarray([float(rank)], np.float32))
+dist.all_reduce(t, op=dist.ReduceOp.MAX)
+np.testing.assert_allclose(t.numpy(), [1.0])
+
+# broadcast from rank 0
+t = paddle.to_tensor(np.full((3,), float(rank), np.float32))
+dist.broadcast(t, src=0)
+np.testing.assert_allclose(t.numpy(), [0.0, 0.0, 0.0])
+
+# all_gather
+outs = []
+dist.all_gather(outs, paddle.to_tensor(np.asarray([rank], np.int64)))
+assert [int(o.numpy()[0]) for o in outs] == [0, 1]
+
+# all_gather_object
+objs = []
+dist.all_gather_object(objs, {"rank": rank})
+assert [o["rank"] for o in objs] == [0, 1]
+
+# send / recv ping-pong
+if rank == 0:
+    dist.send(paddle.to_tensor(np.asarray([42.0], np.float32)), dst=1)
+else:
+    t = paddle.to_tensor(np.zeros((1,), np.float32))
+    dist.recv(t, src=0)
+    np.testing.assert_allclose(t.numpy(), [42.0])
+
+# barrier then scatter from rank 1
+dist.barrier()
+parts = ([paddle.to_tensor(np.asarray([10.0], np.float32)),
+          paddle.to_tensor(np.asarray([20.0], np.float32))]
+         if rank == 1 else None)
+t = paddle.to_tensor(np.zeros((1,), np.float32))
+dist.scatter(t, parts, src=1)
+np.testing.assert_allclose(t.numpy(), [10.0 if rank == 0 else 20.0])
+
+print(f"WORKER_{rank}_OK")
+"""
+
+
+def test_two_process_eager_collectives(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update({
+            "REPO_DIR": repo,
+            "JAX_PLATFORMS": "cpu",
+            "MASTER_ADDR": "127.0.0.1",
+            "MASTER_PORT": str(port),
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": "2",
+        })
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        procs.append(subprocess.Popen([sys.executable, "-c", _WORKER],
+                                      env=env, stdout=subprocess.PIPE,
+                                      stderr=subprocess.PIPE, text=True))
+    outs = []
+    for rank, p in enumerate(procs):
+        try:
+            out, err = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail(f"rank {rank} hung")
+        outs.append((p.returncode, out, err))
+    for rank, (rc, out, err) in enumerate(outs):
+        assert rc == 0 and f"WORKER_{rank}_OK" in out, (
+            f"rank {rank} failed:\n{out[-1000:]}\n{err[-2000:]}")
