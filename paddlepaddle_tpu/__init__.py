@@ -114,19 +114,38 @@ from .core import device  # noqa: E402,F401
 DataParallel = distributed.DataParallel
 
 
+_static_mode = False
+
+
 def disable_static(place=None):
-    """Dygraph is the only eager mode; kept for API compatibility."""
+    """Return to dygraph (the native mode)."""
+    global _static_mode
+    _static_mode = False
+    from .core.dispatch import set_static_capture
+
+    set_static_capture(False)
 
 
 def enable_static():
-    raise NotImplementedError(
-        "The legacy static-graph mode is not provided; use "
-        "paddlepaddle_tpu.jit.to_static (XLA compilation) instead."
-    )
+    """Static-graph compatibility mode (reference: paddle.enable_static).
+
+    TPU-native design: there is no separate graph IR — ops still execute
+    eagerly at build time, but the autograd tape they record doubles as the
+    Program's op graph. ``static.Executor.run(prog, feed, fetch_list)``
+    REPLAYS that tape with the feed substituted for the
+    ``static.data`` placeholders (and applies any ``minimize`` update), so
+    the reference's basic static examples run unchanged while XLA remains
+    the compiler underneath.
+    """
+    global _static_mode
+    _static_mode = True
+    from .core.dispatch import set_static_capture
+
+    set_static_capture(True)
 
 
 def in_dynamic_mode():
-    return True
+    return not _static_mode
 
 
 def is_grad_enabled():

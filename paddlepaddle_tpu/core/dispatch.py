@@ -33,6 +33,17 @@ _amp_cast_hook = None
 # the per-op NaN/Inf scan (FLAGS_check_nan_inf analogue) and op-stats.
 _op_observer = None
 
+# static-graph capture (paddle.enable_static): when on, every op records a
+# replay closure over ALL tensor inputs — including non-differentiable ints
+# (labels, indices) the autograd tape would not track — so
+# static.Executor.run can re-execute the graph with feeds substituted.
+_static_capture = False
+
+
+def set_static_capture(on: bool):
+    global _static_capture
+    _static_capture = bool(on)
+
 
 def set_amp_cast_hook(hook):
     global _amp_cast_hook
@@ -71,13 +82,39 @@ def apply_op(fn: Callable, *args, op_name: str = None, **kwargs) -> Any:
         a, k = jax.tree_util.tree_unflatten(treedef, vals)
         return fn(*a, **k)
 
+    def make_replay(node):
+        """Attach the all-tensor-inputs replay closure (static mode only)."""
+        if not (_static_capture and tensor_pos):
+            return
+
+        def replay(*tvals):
+            vals = list(datas)
+            for p, v in zip(tensor_pos, tvals):
+                vals[p] = v
+            return run(vals)
+
+        node.replay_fn = replay
+        node.replay_inputs = tuple(leaves[p] for p in tensor_pos)
+
     if not diff_pos:
         out = run(datas)
         if _op_observer is not None:
             _op_observer(name, jax.tree_util.tree_leaves(out))
-        return jax.tree_util.tree_map(
+        wrapped = jax.tree_util.tree_map(
             lambda x: Tensor._from_data(x, stop_gradient=True), out
         )
+        if _static_capture and tensor_pos:
+            # no autograd node, but the static replay still needs the edge
+            # (e.g. one_hot(labels) — int-only inputs)
+            out_leaves_, out_treedef_ = jax.tree_util.tree_flatten(
+                wrapped, is_leaf=lambda o: isinstance(o, Tensor))
+            node = ag.GradNode(name, None, (), [], out_treedef=out_treedef_)
+            make_replay(node)
+            for i, t in enumerate(out_leaves_):
+                if isinstance(t, Tensor):
+                    t._grad_node = node
+                    t._out_index = i
+        return wrapped
 
     def pure(*diff_vals):
         vals = list(datas)
@@ -104,6 +141,7 @@ def apply_op(fn: Callable, *args, op_name: str = None, **kwargs) -> Any:
         out_treedef=out_treedef,
         primal_data=primal_data,
     )
+    make_replay(node)
     wrapped = []
     for i, o in enumerate(out_leaves):
         t = Tensor._from_data(o, stop_gradient=False)

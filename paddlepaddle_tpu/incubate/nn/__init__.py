@@ -88,7 +88,9 @@ class FusedMultiHeadAttention(_Layer):
             x = self.ln(x)
         out = self.attn(x, x, x, attn_mask=attn_mask)
         out = _F.dropout(out, p=self.dropout_rate, training=self.training)
-        out = residual + out
+        # Tensor-on-the-left: a numpy residual would otherwise consume the
+        # Tensor via __array__ and return a bare ndarray
+        out = out + residual
         if not self.normalize_before:
             out = self.ln(out)
         return out
@@ -123,7 +125,7 @@ class FusedFeedForward(_Layer):
         h = _F.dropout(h, p=self.act_dropout, training=self.training)
         h = self.linear2(h)
         h = _F.dropout(h, p=self.dropout_rate, training=self.training)
-        out = residual + h
+        out = h + residual  # Tensor-on-the-left (see FusedMultiHeadAttention)
         if not self.normalize_before:
             out = self.ln(out)
         return out

@@ -109,6 +109,21 @@ class Optimizer:
     clear_gradients = clear_grad
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        import paddlepaddle_tpu as _paddle
+
+        if not _paddle.in_dynamic_mode():
+            # static-graph build phase (executor.py:1247 semantics): record
+            # the (optimizer, loss) pair on the program; Executor.run
+            # replays the graph and applies the update per run. Reference
+            # static optimizers are built WITHOUT parameters= — collect the
+            # trainable leaves from the loss's recorded graph instead.
+            from ..static import _collect_parameters, default_main_program
+
+            prog = default_main_program()
+            if self._parameter_list is None:
+                self._parameter_list = _collect_parameters(loss, prog)
+            prog._minimize_ops.append((self, loss))
+            return None, None
         loss.backward()
         self.step()
         return None, None

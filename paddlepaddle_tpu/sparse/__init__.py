@@ -87,6 +87,97 @@ class SparseCooTensor(Tensor):
                 f"dtype={self.dtype})")
 
 
+class SparseCsrTensor(SparseCooTensor):
+    """CSR-format sparse tensor backed by jax.experimental.sparse.BCSR
+    (reference: paddle.sparse.sparse_csr_tensor / SparseCsrTensor — the
+    second of the two formats sparse_ops.yaml kernels accept). Interops
+    with COO both ways; ops that keep the sparsity pattern return CSR when
+    given CSR (the ``_like`` helper)."""
+
+    __slots__ = ("_bcsr",)
+
+    @classmethod
+    def _from_bcsr(cls, bcsr):
+        t = cls.__new__(cls)
+        t._bcsr = None
+        Tensor.__init__(t, jnp.zeros([], jnp.float32))
+        t._bcsr = bcsr
+        t._bcoo = None
+        t._dense_cache = None
+        return t
+
+    def _csr(self):
+        if self._bcsr is None:
+            base = (self._bcoo if self._bcoo is not None
+                    else jsparse.BCOO.fromdense(self._dense_cache))
+            self._bcsr = jsparse.BCSR.from_bcoo(base.sum_duplicates())
+        return self._bcsr
+
+    def _coo(self):
+        if self._bcoo is None:
+            self._bcoo = self._csr().to_bcoo()
+        return self._bcoo
+
+    @property
+    def _data(self):
+        if self._dense_cache is None and self._bcsr is not None:
+            self._dense_cache = self._csr().todense()
+        return self._dense_cache
+
+    @_data.setter
+    def _data(self, value):
+        self._dense_cache = value
+        if getattr(self, "_bcsr", None) is not None and value is not None:
+            self._bcsr = None
+            self._bcoo = None
+
+    # -- CSR accessors (reference Tensor.crows/cols/values) -----------------
+    def crows(self):
+        return Tensor._from_data(self._csr().indptr)
+
+    def cols(self):
+        return Tensor._from_data(self._csr().indices)
+
+    def values(self):
+        return Tensor._from_data(self._csr().data)
+
+    def to_dense(self):
+        return Tensor._from_data(self._csr().todense())
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def to_sparse_coo(self, sparse_dim=None):
+        return SparseCooTensor._from_bcoo(self._csr().to_bcoo())
+
+    @property
+    def shape(self):
+        if self._bcsr is not None:
+            return list(self._bcsr.shape)
+        return super().shape
+
+    @property
+    def dtype(self):
+        if self._bcsr is not None:
+            return self._bcsr.dtype
+        return super().dtype
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self._csr().nse}, "
+                f"dtype={self.dtype})")
+
+
+def _like(x, bcoo):
+    """Wrap a result BCOO in x's format (CSR stays CSR, COO stays COO)."""
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor._from_bcsr(
+            jsparse.BCSR.from_bcoo(bcoo.sum_duplicates()))
+    return SparseCooTensor._from_bcoo(bcoo)
+
+
 def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
                       stop_gradient=True):
     idx = np.asarray(indices._data if isinstance(indices, Tensor) else indices)
@@ -103,14 +194,16 @@ def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
 
 def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
                       stop_gradient=True):
-    """CSR accepted at the API, stored as BCOO (XLA-preferred layout)."""
-    crows = np.asarray(unwrap(crows)).astype(np.int64)
-    cols = np.asarray(unwrap(cols)).astype(np.int64)
+    """Real CSR storage (jax BCSR): indptr/indices/data as given."""
+    crows = jnp.asarray(np.asarray(unwrap(crows)).astype(np.int32))
+    cols = jnp.asarray(np.asarray(unwrap(cols)).astype(np.int32))
     vals = jnp.asarray(unwrap(values))
-    rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
-    idx = np.stack([rows, cols], axis=1)
-    bcoo = jsparse.BCOO((vals, jnp.asarray(idx)), shape=tuple(shape))
-    return SparseCooTensor._from_bcoo(bcoo)
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+
+        vals = vals.astype(convert_dtype(dtype))
+    bcsr = jsparse.BCSR((vals, cols, crows), shape=tuple(shape))
+    return SparseCsrTensor._from_bcsr(bcsr)
 
 
 def is_sparse(x):
@@ -182,8 +275,8 @@ def _unary(np_name):
     def op(x):
         if isinstance(x, SparseCooTensor):
             coo = x._coo()
-            return SparseCooTensor._from_bcoo(
-                jsparse.BCOO((jfn(coo.data), coo.indices), shape=coo.shape))
+            return _like(x, jsparse.BCOO((jfn(coo.data), coo.indices),
+                                         shape=coo.shape))
         return Tensor._from_data(jfn(unwrap(x)))
 
     op.__name__ = np_name
@@ -213,37 +306,34 @@ sign = _unary("sign")
 def relu(x):
     if isinstance(x, SparseCooTensor):
         coo = x._coo()
-        return SparseCooTensor._from_bcoo(
-            jsparse.BCOO((jax.nn.relu(coo.data), coo.indices), shape=coo.shape))
+        return _like(x, jsparse.BCOO((jax.nn.relu(coo.data), coo.indices),
+                                     shape=coo.shape))
     return Tensor._from_data(jax.nn.relu(unwrap(x)))
 
 
 def relu6(x):
     coo = x._coo()
-    return SparseCooTensor._from_bcoo(
-        jsparse.BCOO((jnp.clip(jax.nn.relu(coo.data), 0, 6), coo.indices),
-                     shape=coo.shape))
+    return _like(x, jsparse.BCOO((jnp.clip(jax.nn.relu(coo.data), 0, 6),
+                                  coo.indices), shape=coo.shape))
 
 
 def leaky_relu(x, negative_slope=0.01):
     coo = x._coo()
-    return SparseCooTensor._from_bcoo(
-        jsparse.BCOO((jax.nn.leaky_relu(coo.data, negative_slope),
-                      coo.indices), shape=coo.shape))
+    return _like(x, jsparse.BCOO((jax.nn.leaky_relu(coo.data, negative_slope),
+                                  coo.indices), shape=coo.shape))
 
 
 def pow(x, factor):
     coo = x._coo()
-    return SparseCooTensor._from_bcoo(
-        jsparse.BCOO((coo.data ** factor, coo.indices), shape=coo.shape))
+    return _like(x, jsparse.BCOO((coo.data ** factor, coo.indices),
+                                 shape=coo.shape))
 
 
 def scale(x, scale_val, bias=0.0, bias_after_scale=True):
     coo = x._coo()
     d = coo.data * scale_val + bias if bias_after_scale else (
         coo.data + bias) * scale_val
-    return SparseCooTensor._from_bcoo(
-        jsparse.BCOO((d, coo.indices), shape=coo.shape))
+    return _like(x, jsparse.BCOO((d, coo.indices), shape=coo.shape))
 
 
 def cast(x, index_dtype=None, value_dtype=None):
@@ -254,8 +344,7 @@ def cast(x, index_dtype=None, value_dtype=None):
         convert_dtype(value_dtype))
     idx = coo.indices if index_dtype is None else coo.indices.astype(
         convert_dtype(index_dtype))
-    return SparseCooTensor._from_bcoo(
-        jsparse.BCOO((data, idx), shape=coo.shape))
+    return _like(x, jsparse.BCOO((data, idx), shape=coo.shape))
 
 
 def transpose(x, perm):
@@ -301,8 +390,7 @@ def softmax(x, axis=-1):
     ex = jnp.exp(data - row_max[rows])
     row_sum = jnp.zeros((n_rows,), data.dtype).at[rows].add(ex)
     out = ex / row_sum[rows]
-    return SparseCooTensor._from_bcoo(
-        jsparse.BCOO((out, coo.indices), shape=coo.shape))
+    return _like(x, jsparse.BCOO((out, coo.indices), shape=coo.shape))
 
 
 def mask_as(x, mask: SparseCooTensor):
@@ -311,8 +399,7 @@ def mask_as(x, mask: SparseCooTensor):
     coo = mask._coo()
     idx = coo.indices
     vals = dense[tuple(idx[:, d] for d in range(idx.shape[1]))]
-    return SparseCooTensor._from_bcoo(
-        jsparse.BCOO((vals, idx), shape=coo.shape))
+    return _like(mask, jsparse.BCOO((vals, idx), shape=coo.shape))
 
 
 def is_same_shape(x, y):
@@ -323,7 +410,17 @@ def _to_sparse_coo(self, sparse_dim=None):
     return SparseCooTensor._from_bcoo(jsparse.BCOO.fromdense(self._data))
 
 
+def _to_sparse_csr(self):
+    if isinstance(self, SparseCsrTensor):
+        return self
+    if isinstance(self, SparseCooTensor):
+        return SparseCsrTensor._from_bcsr(
+            jsparse.BCSR.from_bcoo(self._coo().sum_duplicates()))
+    return SparseCsrTensor._from_bcsr(jsparse.BCSR.fromdense(self._data))
+
+
 Tensor.to_sparse_coo = _to_sparse_coo
+Tensor.to_sparse_csr = _to_sparse_csr
 
 
 class _UnaryLayer:
@@ -351,3 +448,59 @@ class nn:  # namespace parity: paddle.sparse.nn (layer wrappers)
     @staticmethod
     def Softmax(axis=-1):
         return _UnaryLayer(softmax, axis=axis)
+
+
+def _attention_2d(q, k, v, mask_coo, scale, kpm=None, amask=None):
+    """scores sampled at the mask pattern (SDDMM) -> row softmax -> spmm.
+
+    kpm: [s_k] key-padding mask (nonzero/True = PAD, excluded);
+    amask: dense [s_q, s_k] additive attention mask, sampled at the pattern.
+    """
+    idx = mask_coo.indices
+    s = (q[idx[:, 0]] * k[idx[:, 1]]).sum(-1) * scale
+    if amask is not None:
+        s = s + amask[idx[:, 0], idx[:, 1]].astype(s.dtype)
+    if kpm is not None:
+        s = jnp.where(kpm.astype(bool)[idx[:, 1]], -1e30, s)
+    n_rows = mask_coo.shape[0]
+    rows = idx[:, 0]
+    row_max = jnp.full((n_rows,), -jnp.inf, s.dtype).at[rows].max(s)
+    ex = jnp.exp(s - row_max[rows])
+    row_sum = jnp.zeros((n_rows,), s.dtype).at[rows].add(ex)
+    p = ex / jnp.maximum(row_sum[rows], 1e-30)
+    probs = jsparse.BCOO((p.astype(v.dtype), idx), shape=mask_coo.shape)
+    return probs @ v
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Sparse attention: softmax(QK^T·d^-1/2 at ``sparse_mask``'s pattern)@V.
+
+    Reference: paddle.sparse.nn.functional.attention
+    (python/paddle/sparse/nn/functional/transformer.py) — q/k/v
+    [batch, heads, seq, head_dim] with a shared CSR mask [seq, seq]. The
+    score matrix only ever exists at the mask's nnz (SDDMM + sparse
+    softmax + spmm), the sparse-transformer memory win."""
+    q = jnp.asarray(unwrap(query))
+    k = jnp.asarray(unwrap(key))
+    v = jnp.asarray(unwrap(value))
+    kpm = None if key_padding_mask is None else jnp.asarray(
+        unwrap(key_padding_mask))
+    am = None if attn_mask is None else jnp.asarray(unwrap(attn_mask))
+    coo = sparse_mask._coo().sum_duplicates()
+    scale = 1.0 / float(np.sqrt(q.shape[-1]))
+    if q.ndim == 2:
+        return Tensor._from_data(_attention_2d(q, k, v, coo, scale,
+                                               kpm=kpm, amask=am))
+    if q.ndim == 4:
+        b, h = q.shape[0], q.shape[1]
+        outs = [
+            [_attention_2d(q[i, j], k[i, j], v[i, j], coo, scale,
+                           kpm=None if kpm is None else kpm[i],
+                           amask=am)
+             for j in range(h)] for i in range(b)]
+        return Tensor._from_data(jnp.stack([jnp.stack(o) for o in outs]))
+    raise ValueError("attention expects [s, d] or [b, h, s, d] inputs")
+
+
+nn.functional = type("functional", (), {"attention": staticmethod(attention)})

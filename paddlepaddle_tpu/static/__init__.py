@@ -37,6 +37,7 @@ class Program:
         self._feed_targets: Dict[str, "Variable"] = {}
         self._fetch_list: List = []
         self._fn = None
+        self._minimize_ops: List = []   # (optimizer, loss_var) from minimize
         self.random_seed = 0
 
     def global_block(self):
@@ -73,33 +74,131 @@ def program_guard(main_program: Program, startup_program: Optional[Program] = No
 
 
 def data(name, shape, dtype="float32", lod_level=0):
-    """Declare a feed placeholder (eager: returns a zero tensor template)."""
+    """Declare a feed placeholder. The returned tensor participates in the
+    autograd tape (stop_gradient=False) so every op downstream records it as
+    a producer edge — that tape IS the Program graph Executor.run replays
+    with the feed substituted (executor.py:1247 feed/fetch contract)."""
     shape = [1 if (s is None or s < 0) else s for s in shape]
-    t = Tensor(np.zeros(shape, dtype="float32" if dtype is None else dtype))
+    t = Tensor(np.zeros(shape, dtype="float32" if dtype is None else dtype),
+               stop_gradient=False)
     t.name = name
     prog = default_main_program()
     prog._feed_targets[name] = t
     return t
 
 
+def _replay(var, env):
+    """Re-execute the tape that produced ``var`` with placeholder tensors
+    substituted from ``env`` (id(placeholder) -> feed Tensor). Leaf tensors
+    (parameters) evaluate to THEMSELVES, so gradients from a replayed loss
+    flow to the live parameters; every replayed op goes back through
+    apply_op, re-taping it for backward/minimize."""
+    from ..core.dispatch import apply_op
+
+    key = id(var)
+    if key in env:
+        return env[key]
+    node = getattr(var, "_grad_node", None)
+    fn = getattr(node, "replay_fn", None) if node is not None else None
+    fin = getattr(node, "replay_inputs", ()) if node is not None else ()
+    if fn is None and node is not None:  # pre-capture tape (grad-only edges)
+        fn, fin = node.pure_fn, node.inputs
+    if node is None or fn is None:
+        if getattr(var, "name", None) in env.get("_placeholders", ()):
+            raise KeyError(
+                f"static.data placeholder '{var.name}' was not fed "
+                f"(executor.py feed contract): pass it in `feed=`")
+        return var  # parameter / constant leaf
+    cache_key = ("node", id(node))
+    if cache_key in env:
+        outs = env[cache_key]
+    else:
+        ins = [_replay(t, env) for t in fin]
+        out_tree = apply_op(fn, *ins, op_name=f"replay_{node.name}")
+        import jax
+
+        # Tensor is itself a registered pytree: stop flattening AT tensors
+        outs = jax.tree_util.tree_leaves(
+            out_tree, is_leaf=lambda o: isinstance(o, Tensor))
+        env[cache_key] = outs
+    out = outs[getattr(var, "_out_index", 0)]
+    env[key] = out
+    return out
+
+
+def _collect_parameters(loss, program) -> List[Tensor]:
+    """Trainable leaf tensors of the recorded graph (the static analogue of
+    a Program's parameter list): DFS the tape; a leaf with
+    stop_gradient=False that is not a feed placeholder is a parameter."""
+    placeholder_ids = {id(t) for t in program._feed_targets.values()}
+    seen, out, stack = set(), [], [loss]
+    while stack:
+        t = stack.pop()
+        if id(t) in seen:
+            continue
+        seen.add(id(t))
+        node = getattr(t, "_grad_node", None)
+        if node is None:
+            if not t.stop_gradient and id(t) not in placeholder_ids:
+                out.append(t)
+        else:
+            stack.extend(node.inputs)
+    return out
+
+
 class Executor:
-    """Reference: python/paddle/base/executor.py:1247. In the shim, ``run``
-    invokes ``program._fn`` (a python callable traced by jit) with the feeds;
-    programs without a function echo the fetch_list (startup programs)."""
+    """Reference: python/paddle/base/executor.py:1247,1935.
+
+    ``run(program, feed, fetch_list)`` replays the program's recorded op
+    tape with the feed dict bound to the ``static.data`` placeholders,
+    applies any ``optimizer.minimize`` registered at build time (backward +
+    step on the replayed loss, updating the live parameters), and returns
+    the fetched values. Unknown feed names and un-fed placeholders raise
+    (the reference feed contract). The ``_ExecutorCache`` role
+    (executor.py:1935) is filled by the taped-op graph itself — replay
+    memoizes per-node within a run, and XLA caches each op's compilation
+    across runs."""
 
     def __init__(self, place=None):
         self.place = place
+
+    def _feed_env(self, program, feed):
+        unknown = [k for k in feed if k not in program._feed_targets]
+        if unknown:
+            raise KeyError(
+                f"feed names {unknown} match no static.data placeholder "
+                f"(have: {sorted(program._feed_targets)})")
+        env = {"_placeholders": frozenset(
+            n for n in program._feed_targets if n not in feed)}
+        for name, value in feed.items():
+            ph = program._feed_targets[name]
+            t = value if isinstance(value, Tensor) else Tensor(
+                np.asarray(value))
+            t.stop_gradient = True
+            env[id(ph)] = t
+        return env
 
     def run(self, program: Optional[Program] = None, feed=None, fetch_list=None,
             return_numpy=True, **kwargs):
         program = program or default_main_program()
         feed = feed or {}
-        if program._fn is None:
+        if program._fn is not None:  # jit-traced program (to_static path)
+            out = program._fn(**feed)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+        elif fetch_list or program._minimize_ops:
+            env = self._feed_env(program, feed)
+            outs = [_replay(v, env) if isinstance(v, Tensor) else v
+                    for v in (fetch_list or [])]
+            for opt, loss_var in program._minimize_ops:
+                loss_t = _replay(loss_var, env)
+                loss_t.backward()
+                opt.step()
+                opt.clear_grad()
+        else:
             return [None for _ in (fetch_list or [])]
-        out = program._fn(**feed)
-        outs = out if isinstance(out, (list, tuple)) else [out]
         if return_numpy:
-            return [o.numpy() if isinstance(o, Tensor) else np.asarray(o) for o in outs]
+            return [o.numpy() if isinstance(o, Tensor) else np.asarray(o)
+                    for o in outs]
         return list(outs)
 
     def close(self):
@@ -114,7 +213,24 @@ class nn:  # noqa: N801 — module-like namespace
 
         in_features = int(np.prod(x.shape[num_flatten_dims:]))
         layer = Linear(in_features, size)
-        out = layer(x.reshape(list(x.shape[:num_flatten_dims]) + [in_features]))
+        if len(x.shape) == num_flatten_dims + 1:
+            out = layer(x)
+        else:
+            # contract the trailing dims WITHOUT reshape so no batch dim is
+            # baked into the tape — Executor.run can then replay with any
+            # fed batch size (static.data None dims are placeholder-1)
+            from ..core.dispatch import apply_op
+
+            k = len(x.shape) - num_flatten_dims
+            w = layer.weight.reshape(list(x.shape[num_flatten_dims:]) + [size])
+
+            def contract(xa, wa, ba):
+                import jax.numpy as jnp
+
+                out = jnp.tensordot(xa, wa, axes=k)
+                return out + ba if ba is not None else out
+
+            out = apply_op(contract, x, w, layer.bias, op_name="fc_tensordot")
         if activation == "relu":
             out = F.relu(out)
         elif activation == "softmax":

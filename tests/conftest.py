@@ -30,3 +30,28 @@ def _seed_all():
     paddle.seed(2024)
     np.random.seed(2024)
     yield
+
+
+@pytest.fixture(autouse=True)
+def _per_test_timeout():
+    """Per-test wall-clock watchdog (the reference pins per-test TIMEOUT
+    labels in CMake, test/collective/CMakeLists.txt:1-4): a hung collective
+    or runaway compile fails THAT test instead of stalling the whole run."""
+    import signal
+
+    seconds = int(os.environ.get("PADDLE_TPU_TEST_TIMEOUT", "300"))
+    armed = seconds > 0 and hasattr(signal, "SIGALRM")
+
+    def _on_timeout(signum, frame):
+        raise TimeoutError(f"test exceeded {seconds}s watchdog "
+                           f"(PADDLE_TPU_TEST_TIMEOUT to adjust)")
+
+    old = signal.signal(signal.SIGALRM, _on_timeout) if armed else None
+    if armed:
+        signal.alarm(seconds)
+    try:
+        yield
+    finally:
+        if armed:
+            signal.alarm(0)
+            signal.signal(signal.SIGALRM, old)

@@ -142,3 +142,109 @@ def test_dist_to_static():
     ev = float(dist_model(x, y).numpy())
     assert np.isfinite(ev)
     set_mesh(None)
+
+
+def test_static_executor_runs_reference_example():
+    """The reference's canonical static workflow runs UNCHANGED
+    (executor.py:1247 feed/fetch contract + minimize): build under
+    enable_static, run startup, then exe.run(feed=..., fetch_list=[loss])
+    trains to convergence by replaying the recorded op tape."""
+    import numpy as np
+
+    import paddlepaddle_tpu as paddle
+
+    paddle.enable_static()
+    try:
+        prog = paddle.static.Program()
+        with paddle.static.program_guard(prog):
+            x = paddle.static.data(name='x', shape=[None, 4], dtype='float32')
+            y = paddle.static.data(name='y', shape=[None, 1], dtype='float32')
+            pred = paddle.static.nn.fc(x, size=1)
+            loss = ((pred - y) ** 2).mean()
+            opt = paddle.optimizer.SGD(learning_rate=0.1)
+            opt.minimize(loss)
+        exe = paddle.static.Executor()
+        exe.run(paddle.static.default_startup_program())
+        rng = np.random.default_rng(0)
+        w_true = np.asarray([[1.], [2.], [-1.], [0.5]], np.float32)
+        losses = []
+        for _ in range(25):
+            xb = rng.standard_normal((16, 4)).astype(np.float32)
+            out, = exe.run(prog, feed={'x': xb, 'y': xb @ w_true},
+                           fetch_list=[loss])
+            losses.append(float(out))
+        assert losses[-1] < losses[0] * 0.2, losses[::6]
+        # fetch without minimize side-effects: same program, eval fetch
+        out2, = exe.run(prog, feed={'x': np.ones((3, 4), np.float32),
+                                    'y': np.zeros((3, 1), np.float32)},
+                        fetch_list=[pred])
+        assert out2.shape == (3, 1)
+    finally:
+        paddle.disable_static()
+
+
+def test_static_mode_flags():
+    import paddlepaddle_tpu as paddle
+
+    assert paddle.in_dynamic_mode()
+    paddle.enable_static()
+    try:
+        assert not paddle.in_dynamic_mode()
+    finally:
+        paddle.disable_static()
+    assert paddle.in_dynamic_mode()
+
+
+def test_static_executor_int_labels_and_feed_errors():
+    """Int (non-differentiable) placeholders must be fed through replay too
+    — the autograd tape alone would bake them as build-time zeros — and the
+    feed contract raises on unknown names and un-fed placeholders."""
+    import numpy as np
+    import pytest
+
+    import paddlepaddle_tpu as paddle
+
+    paddle.enable_static()
+    try:
+        prog = paddle.static.Program()
+        with paddle.static.program_guard(prog):
+            x = paddle.static.data(name='x', shape=[None, 4], dtype='float32')
+            lbl = paddle.static.data(name='lbl', shape=[None], dtype='int64')
+            logits = paddle.static.nn.fc(x, size=3)
+            loss = paddle.nn.functional.cross_entropy(logits, lbl).mean()
+        exe = paddle.static.Executor()
+        rng = np.random.default_rng(0)
+        xb = rng.standard_normal((8, 4)).astype(np.float32)
+        y0 = np.zeros((8,), np.int64)
+        y2 = np.full((8,), 2, np.int64)
+        l0, = exe.run(prog, feed={'x': xb, 'lbl': y0}, fetch_list=[loss])
+        l2, = exe.run(prog, feed={'x': xb, 'lbl': y2}, fetch_list=[loss])
+        assert abs(float(l0) - float(l2)) > 1e-6, (
+            "labels fed through replay must change the loss")
+        with pytest.raises(KeyError, match="no static.data placeholder"):
+            exe.run(prog, feed={'X_typo': xb, 'lbl': y0}, fetch_list=[loss])
+        with pytest.raises(KeyError, match="was not fed"):
+            exe.run(prog, feed={'x': xb}, fetch_list=[loss])
+    finally:
+        paddle.disable_static()
+
+
+def test_static_fc_flatten_dims_batch_polymorphic():
+    import numpy as np
+
+    import paddlepaddle_tpu as paddle
+
+    paddle.enable_static()
+    try:
+        prog = paddle.static.Program()
+        with paddle.static.program_guard(prog):
+            x = paddle.static.data(name='x', shape=[None, 2, 3, 4],
+                                   dtype='float32')
+            out = paddle.static.nn.fc(x, size=5, num_flatten_dims=2)
+        exe = paddle.static.Executor()
+        xb = np.random.default_rng(0).standard_normal((7, 2, 3, 4)).astype(
+            np.float32)
+        o, = exe.run(prog, feed={'x': xb}, fetch_list=[out])
+        assert o.shape == (7, 2, 5)
+    finally:
+        paddle.disable_static()

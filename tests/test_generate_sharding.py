@@ -1,5 +1,9 @@
 """Llama generate() + group_sharded_parallel + multi-worker DataLoader."""
 
+import pytest
+
+pytestmark = pytest.mark.slow  # compile-heavy; fast tier covers this module via test_fast_smokes.py
+
 import numpy as np
 import pytest
 

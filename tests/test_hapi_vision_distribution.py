@@ -1,5 +1,9 @@
 """hapi Model.fit, vision zoo/transforms/datasets, distribution package."""
 
+import pytest
+
+pytestmark = pytest.mark.slow  # compile-heavy; fast tier covers this module via test_fast_smokes.py
+
 import numpy as np
 import pytest
 from scipy import stats as sps

@@ -11,6 +11,10 @@ places them from shardings, so these tests pin the *compiled artifact*:
 * the pipeline's scan body carries exactly its two ring collective-permutes.
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow  # compile-heavy; fast tier covers this module via test_fast_smokes.py
+
 import numpy as np
 import pytest
 

@@ -8,6 +8,10 @@ is the unsharded single-device forward (parallel.hybrid.reference_forward):
 loss AND per-leaf gradients must match across the 4-axis decomposition.
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow  # compile-heavy; fast tier covers this module via test_fast_smokes.py
+
 import jax
 import jax.numpy as jnp
 import numpy as np

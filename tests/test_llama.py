@@ -1,5 +1,9 @@
 """Flagship Llama model tests: forward shape, loss decrease, sharded step."""
 
+import pytest
+
+pytestmark = pytest.mark.slow  # compile-heavy; fast tier covers this module via test_fast_smokes.py
+
 import numpy as np
 import pytest
 

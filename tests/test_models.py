@@ -1,5 +1,9 @@
 """BERT (BASELINE config 1) and ResNet (config 2) smoke + training tests."""
 
+import pytest
+
+pytestmark = pytest.mark.slow  # compile-heavy; fast tier covers this module via test_fast_smokes.py
+
 import numpy as np
 
 import paddlepaddle_tpu as paddle

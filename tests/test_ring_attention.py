@@ -1,5 +1,9 @@
 """Ring attention (context parallel) vs dense reference; recompute tests."""
 
+import pytest
+
+pytestmark = pytest.mark.slow  # compile-heavy; fast tier covers this module via test_fast_smokes.py
+
 import numpy as np
 import pytest
 

@@ -81,3 +81,123 @@ def test_csr_roundtrip_and_nn():
     np.testing.assert_allclose(out.to_dense().numpy(), np.maximum(D, 0))
     out6 = sp.nn.ReLU6()(sp.scale(x, 4.0))
     assert out6.to_dense().numpy().max() <= 6.0
+
+
+# --------------------------------------------------------------------------
+# CSR format (round 3): real BCSR storage, COO interop, attention
+# --------------------------------------------------------------------------
+
+
+def test_csr_roundtrip_and_accessors():
+    import numpy as np
+
+    import paddlepaddle_tpu as paddle
+    import paddlepaddle_tpu.sparse as sp
+
+    dense = np.array([[1., 0, 2], [0, 0, 3], [4, 5, 0]], np.float32)
+    t = sp.sparse_csr_tensor([0, 2, 3, 5], [0, 2, 2, 0, 1],
+                             [1., 2., 3., 4., 5.], shape=[3, 3])
+    assert t.is_sparse_csr() and not t.is_sparse_coo()
+    np.testing.assert_allclose(t.to_dense().numpy(), dense)
+    np.testing.assert_array_equal(t.crows().numpy(), [0, 2, 3, 5])
+    np.testing.assert_array_equal(t.cols().numpy(), [0, 2, 2, 0, 1])
+    np.testing.assert_allclose(t.values().numpy(), [1., 2., 3., 4., 5.])
+    # dense -> csr -> coo -> csr
+    t2 = paddle.to_tensor(dense).to_sparse_csr()
+    assert t2.is_sparse_csr()
+    coo = t2.to_sparse_coo()
+    assert coo.is_sparse_coo()
+    back = coo.to_sparse_csr()
+    np.testing.assert_allclose(back.to_dense().numpy(), dense)
+    assert sp.nnz(back) == 5
+
+
+def test_csr_ops_preserve_format_and_match_dense():
+    import numpy as np
+
+    import paddlepaddle_tpu as paddle
+    import paddlepaddle_tpu.sparse as sp
+
+    rng = np.random.default_rng(0)
+    dense = rng.standard_normal((4, 5)).astype(np.float32)
+    dense[rng.random((4, 5)) < 0.5] = 0.0
+    t = paddle.to_tensor(dense).to_sparse_csr()
+    # zero-preserving unary keeps CSR and matches dense
+    out = sp.sin(t)
+    assert out.is_sparse_csr()
+    np.testing.assert_allclose(out.to_dense().numpy(), np.sin(dense),
+                               rtol=1e-6, atol=1e-6)
+    # spmm vs dense
+    w = rng.standard_normal((5, 3)).astype(np.float32)
+    np.testing.assert_allclose(sp.matmul(t, w).numpy(), dense @ w,
+                               rtol=1e-5, atol=1e-5)
+    # sparse softmax vs dense row-softmax over the nnz pattern
+    sm = sp.softmax(t)
+    assert sm.is_sparse_csr()
+    ref = np.zeros_like(dense)
+    for i in range(dense.shape[0]):
+        nz = dense[i] != 0
+        if nz.any():
+            e = np.exp(dense[i][nz] - dense[i][nz].max())
+            ref[i][nz] = e / e.sum()
+    np.testing.assert_allclose(sm.to_dense().numpy(), ref, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_sparse_attention_matches_dense_masked():
+    import numpy as np
+
+    import paddlepaddle_tpu as paddle
+    import paddlepaddle_tpu.sparse as sp
+
+    rng = np.random.default_rng(1)
+    s, d = 8, 16
+    q = rng.standard_normal((s, d)).astype(np.float32)
+    k = rng.standard_normal((s, d)).astype(np.float32)
+    v = rng.standard_normal((s, d)).astype(np.float32)
+    mask = np.tril(np.ones((s, s), np.float32))  # causal pattern
+    mcsr = paddle.to_tensor(mask).to_sparse_csr()
+    out = sp.nn.functional.attention(q, k, v, mcsr).numpy()
+    logits = (q @ k.T) / np.sqrt(d)
+    logits[mask == 0] = -1e30
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    np.testing.assert_allclose(out, p @ v, rtol=1e-4, atol=1e-5)
+
+    # batched [b, h, s, d]
+    qb = rng.standard_normal((2, 2, s, d)).astype(np.float32)
+    kb = rng.standard_normal((2, 2, s, d)).astype(np.float32)
+    vb = rng.standard_normal((2, 2, s, d)).astype(np.float32)
+    outb = sp.nn.functional.attention(qb, kb, vb, mcsr).numpy()
+    assert outb.shape == (2, 2, s, d)
+    lb = np.einsum("bhsd,bhtd->bhst", qb, kb) / np.sqrt(d)
+    lb[..., mask == 0] = -1e30
+    pb = np.exp(lb - lb.max(-1, keepdims=True))
+    pb /= pb.sum(-1, keepdims=True)
+    np.testing.assert_allclose(outb, np.einsum("bhst,bhtd->bhsd", pb, vb),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_attention_key_padding_and_attn_mask():
+    import numpy as np
+
+    import paddlepaddle_tpu as paddle
+    import paddlepaddle_tpu.sparse as sp
+
+    rng = np.random.default_rng(3)
+    s, d = 6, 8
+    q = rng.standard_normal((s, d)).astype(np.float32)
+    k = rng.standard_normal((s, d)).astype(np.float32)
+    v = rng.standard_normal((s, d)).astype(np.float32)
+    mask = np.ones((s, s), np.float32)
+    mcsr = paddle.to_tensor(mask).to_sparse_csr()
+    kpm = np.zeros((s,), np.float32)
+    kpm[-2:] = 1.0  # last two keys padded
+    am = rng.standard_normal((s, s)).astype(np.float32)
+    out = sp.nn.functional.attention(q, k, v, mcsr, key_padding_mask=kpm,
+                                     attn_mask=am).numpy()
+    logits = (q @ k.T) / np.sqrt(d) + am
+    logits[:, kpm.astype(bool)] = -1e30
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    np.testing.assert_allclose(out, p @ v, rtol=1e-4, atol=1e-5)
