@@ -3,4 +3,4 @@
 Spectrogram/MelSpectrogram/MFCC over the framework's fft ops (XLA-lowered).
 """
 
-from . import features, functional  # noqa: F401
+from . import backends, features, functional  # noqa: F401

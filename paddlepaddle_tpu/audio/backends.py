@@ -1,0 +1,65 @@
+"""paddle.audio.backends — wav I/O (reference: audio/backends/: load/save/
+info over soundfile). TPU-native: the stdlib ``wave`` module + numpy for
+16-bit PCM, no extra dependency."""
+
+from __future__ import annotations
+
+import wave
+from typing import NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from ..core.dispatch import unwrap
+from ..core.tensor import Tensor
+
+
+class AudioInfo(NamedTuple):
+    sample_rate: int
+    num_samples: int
+    num_channels: int
+    bits_per_sample: int
+    encoding: str
+
+
+def info(filepath: str) -> AudioInfo:
+    with wave.open(filepath, "rb") as f:
+        return AudioInfo(f.getframerate(), f.getnframes(), f.getnchannels(),
+                         f.getsampwidth() * 8, "PCM_S")
+
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True
+         ) -> Tuple[Tensor, int]:
+    """(waveform [C, T] or [T, C], sample_rate) — 16-bit PCM wav."""
+    with wave.open(filepath, "rb") as f:
+        sr, nch, width = f.getframerate(), f.getnchannels(), f.getsampwidth()
+        if width != 2:
+            raise ValueError(f"only 16-bit PCM wav supported, got {8*width}-bit")
+        f.setpos(frame_offset)
+        n = f.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(n)
+    data = np.frombuffer(raw, dtype="<i2").reshape(-1, nch)
+    if normalize:
+        data = (data / 32768.0).astype(np.float32)
+    wav = data.T if channels_first else data
+    return Tensor(np.ascontiguousarray(wav)), sr
+
+
+def save(filepath: str, src, sample_rate: int, channels_first: bool = True,
+         encoding: str = "PCM_S", bits_per_sample: Optional[int] = 16) -> None:
+    if bits_per_sample not in (None, 16):
+        raise ValueError("only 16-bit PCM wav supported")
+    data = np.asarray(unwrap(src))
+    if channels_first:
+        data = data.T                              # -> [T, C]
+    if np.issubdtype(data.dtype, np.floating):
+        data = np.clip(data, -1.0, 1.0)
+        data = (data * 32767.0).astype("<i2")
+    elif data.dtype != np.dtype("<i2"):
+        raise ValueError(
+            f"save expects float (normalized) or int16 samples, got {data.dtype}")
+    with wave.open(filepath, "wb") as f:
+        f.setnchannels(data.shape[1] if data.ndim == 2 else 1)
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(np.ascontiguousarray(data).tobytes())

@@ -90,3 +90,56 @@ def stft_mag(x, n_fft=512, hop_length=None, win_length=None, window="hann",
         return jnp.swapaxes(mag, -1, -2)
 
     return apply_op(f, x, op_name="stft")
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    """n_mels frequencies evenly spaced on the mel scale between f_min and
+    f_max (reference audio/functional/functional.py:126 — pass n_mels+2 for
+    the filterbank edge-point convention)."""
+    from ..core.tensor import Tensor
+
+    mels = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk), n_mels)
+    return Tensor(mel_to_hz(mels, htk).astype(dtype))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    """Bin center frequencies (functional.py:166)."""
+    from ..core.tensor import Tensor
+
+    return Tensor(np.linspace(0, sr / 2, 1 + n_fft // 2).astype(dtype))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    """10·log10(x/ref), floored at amin, optionally capped at top_db below
+    peak (functional.py:262)."""
+    if amin <= 0:
+        raise Exception("amin must be strictly positive")
+    if ref_value <= 0:
+        raise Exception("ref_value must be strictly positive")
+
+    def f(s):
+        log_spec = 10.0 * jnp.log10(jnp.maximum(amin, s))
+        log_spec = log_spec - 10.0 * math.log10(max(ref_value, amin))
+        if top_db is not None:
+            if top_db < 0:
+                raise Exception("top_db must be non-negative")
+            log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+        return log_spec
+
+    return apply_op(f, spect, op_name="power_to_db")
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """DCT-II basis [n_mels, n_mfcc] (functional.py:306)."""
+    from ..core.tensor import Tensor
+
+    n = np.arange(n_mels, dtype=np.float64)
+    k = np.arange(n_mfcc, dtype=np.float64)[None, :]
+    dct = np.cos(math.pi / n_mels * (n[:, None] + 0.5) * k) * 2.0
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / math.sqrt(2.0)
+        dct *= math.sqrt(1.0 / (2.0 * n_mels))
+    elif norm is not None:
+        raise ValueError(f"unsupported norm {norm!r}")
+    return Tensor(dct.astype(dtype))

@@ -94,3 +94,152 @@ def send_ue_recv(x, y, src_index, dst_index, message_op="add", reduce_op="sum",
         return _segment_reduce(msgs, dst.astype(jnp.int32), n, reduce_op)
 
     return apply_op(f, x, y, src_index, dst_index, op_name="send_ue_recv")
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-EDGE messages combining x[src] with y[dst] — no reduction
+    (reference: geometric/message_passing/send_recv.py send_uv)."""
+
+    def f(xa, ya, src, dst):
+        xs = xa[src.astype(jnp.int32)]
+        yd = ya[dst.astype(jnp.int32)]
+        if message_op == "add":
+            return xs + yd
+        if message_op == "sub":
+            return xs - yd
+        if message_op == "mul":
+            return xs * yd
+        if message_op == "div":
+            return xs / yd
+        raise ValueError(message_op)
+
+    return apply_op(f, x, y, src_index, dst_index, op_name="send_uv")
+
+
+# ---------------------------------------------------------------------------
+# graph sampling / reindex — host-side ops (reference: geometric/reindex.py,
+# geometric/sampling/neighbors.py). These run in the INPUT PIPELINE: their
+# output shapes are data-dependent (counts), so like the reference's CPU
+# kernels they execute eagerly on host and feed static-shape device steps.
+# ---------------------------------------------------------------------------
+
+
+def _np(t):
+    import numpy as np
+
+    from ..core.dispatch import unwrap
+
+    return np.asarray(unwrap(t))
+
+
+def _wrap_i(a, like_dtype):
+    from ..core.tensor import Tensor
+
+    return Tensor._from_data(jnp.asarray(a.astype(like_dtype)))
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Relabel sampled nodes to a dense id space: out_nodes puts the input
+    nodes first, then first-seen-order unique neighbors; returns
+    (reindex_src, reindex_dst, out_nodes) — geometric/reindex.py:34."""
+    import numpy as np
+
+    xs, nb, ct = _np(x), _np(neighbors), _np(count)
+    order = {int(v): i for i, v in enumerate(xs)}
+    for v in nb:
+        v = int(v)
+        if v not in order:
+            order[v] = len(order)
+    out_nodes = np.fromiter(order.keys(), dtype=xs.dtype, count=len(order))
+    reindex_src = np.asarray([order[int(v)] for v in nb], dtype=xs.dtype)
+    reindex_dst = np.repeat(np.arange(len(xs), dtype=xs.dtype), ct)
+    return (_wrap_i(reindex_src, xs.dtype), _wrap_i(reindex_dst, xs.dtype),
+            _wrap_i(out_nodes, xs.dtype))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """reindex_graph over several edge types sharing ONE id mapping
+    (geometric/reindex.py:153): neighbors/count are lists per type."""
+    import numpy as np
+
+    xs = _np(x)
+    nbs = [_np(n) for n in neighbors]
+    cts = [_np(c) for c in count]
+    order = {int(v): i for i, v in enumerate(xs)}
+    for nb in nbs:
+        for v in nb:
+            v = int(v)
+            if v not in order:
+                order[v] = len(order)
+    out_nodes = np.fromiter(order.keys(), dtype=xs.dtype, count=len(order))
+    srcs = [np.asarray([order[int(v)] for v in nb], dtype=xs.dtype)
+            for nb in nbs]
+    dsts = [np.repeat(np.arange(len(xs), dtype=xs.dtype), ct) for ct in cts]
+    return ([_wrap_i(s, xs.dtype) for s in srcs],
+            [_wrap_i(d, xs.dtype) for d in dsts],
+            _wrap_i(out_nodes, xs.dtype))
+
+
+def _sample_csc(row, colptr, nodes, sample_size, eids, weight, seed=None):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    out_nb, out_ct, out_eid = [], [], []
+    for v in nodes:
+        lo, hi = int(colptr[int(v)]), int(colptr[int(v) + 1])
+        idx = np.arange(lo, hi)
+        if 0 <= sample_size < len(idx):
+            if weight is None:
+                idx = rng.choice(idx, size=sample_size, replace=False)
+            else:
+                # Efraimidis–Spirakis: weighted sampling without replacement
+                w = np.maximum(weight[idx].astype(np.float64), 1e-30)
+                keys = rng.random(len(idx)) ** (1.0 / w)
+                idx = idx[np.argsort(keys)[::-1][:sample_size]]
+        out_nb.append(row[idx])
+        out_ct.append(len(idx))
+        if eids is not None:
+            out_eid.append(eids[idx])
+    nb = (np.concatenate(out_nb) if out_nb else
+          np.empty((0,), row.dtype)).astype(row.dtype)
+    ct = np.asarray(out_ct, np.int32)
+    eo = None
+    if eids is not None:
+        eo = (np.concatenate(out_eid) if out_eid
+              else np.empty((0,), row.dtype)).astype(row.dtype)
+    return nb, ct, eo
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Uniform neighbor sampling over a CSC graph
+    (geometric/sampling/neighbors.py:30): returns (out_neighbors, out_count
+    [, out_eids])."""
+    if return_eids and eids is None:
+        raise ValueError("`eids` should not be None if `return_eids` is True.")
+    r, cp, nodes = _np(row).reshape(-1), _np(colptr).reshape(-1), _np(input_nodes).reshape(-1)
+    e = _np(eids).reshape(-1) if eids is not None else None
+    nb, ct, eo = _sample_csc(r, cp, nodes, int(sample_size), e, None)
+    outs = (_wrap_i(nb, r.dtype), _wrap_i(ct, ct.dtype))
+    if return_eids:
+        outs = outs + (_wrap_i(eo, r.dtype),)
+    return outs
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Weight-proportional sampling without replacement
+    (geometric/sampling/neighbors.py:218)."""
+    if return_eids and eids is None:
+        raise ValueError("`eids` should not be None if `return_eids` is True.")
+    r, cp, nodes = _np(row).reshape(-1), _np(colptr).reshape(-1), _np(input_nodes).reshape(-1)
+    w = _np(edge_weight).reshape(-1)
+    e = _np(eids).reshape(-1) if eids is not None else None
+    nb, ct, eo = _sample_csc(r, cp, nodes, int(sample_size), e, w)
+    outs = (_wrap_i(nb, r.dtype), _wrap_i(ct, ct.dtype))
+    if return_eids:
+        outs = outs + (_wrap_i(eo, r.dtype),)
+    return outs

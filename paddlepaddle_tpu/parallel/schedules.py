@@ -79,6 +79,28 @@ class PipelineSchedule:
     def num_virtual(self) -> int:
         return self.V * self.S
 
+    def memory_estimate(self, act_shape: Tuple[int, ...],
+                        dtype_bytes: int = 2) -> Dict[str, int]:
+        """Executor buffer bytes PER DEVICE for a microbatch activation of
+        ``act_shape`` (e.g. (mb, seq, hidden)): the stash/inbox/gstash
+        allocations spmd_pipeline_train actually makes, so a config can be
+        memory-checked BEFORE compiling (the reference sizes its p2p and
+        recompute buffers the same way, pipeline_parallel.py send/recv
+        caches). dacts ([M] cotangents) is included — it scales with M."""
+        import math as _m
+
+        act = int(_m.prod(act_shape)) * dtype_bytes
+        out = {
+            "stash": self.V * self.stash_cap * act,
+            "inbox_f": self.V * self.inbox_f_cap * act,
+            "inbox_b": self.V * self.inbox_b_cap * act,
+            "gstash": (self.V * self.gstash_cap * act
+                       if int(self.ops.max()) >= OP_BX else 0),
+            "dacts": self.M * act,
+        }
+        out["total"] = sum(out.values())
+        return out
+
     def pretty(self) -> str:
         """Timeline diagram, one row per device (F3 = forward mb 3)."""
         rows = []

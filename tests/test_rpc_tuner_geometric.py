@@ -197,3 +197,92 @@ def test_auto_tuner_recorder_scoped_by_model(tmp_path):
     t.tune(num_params=100_000_000, batch_size=8, seq_len=256, hidden=256,
            layers=4, run_fn=run_fn, top_k=1)
     assert len(calls) == n + 1
+
+
+def test_reindex_graph_reference_example():
+    """The docstring example from geometric/reindex.py:34, verbatim."""
+    import numpy as np
+
+    import paddlepaddle_tpu.geometric as g
+
+    src, dst, nodes = g.reindex_graph(
+        np.asarray([0, 1, 2], np.int64),
+        np.asarray([8, 9, 0, 4, 7, 6, 7], np.int64),
+        np.asarray([2, 3, 2], np.int32))
+    np.testing.assert_array_equal(src.numpy(), [3, 4, 0, 5, 6, 7, 6])
+    np.testing.assert_array_equal(dst.numpy(), [0, 0, 1, 1, 1, 2, 2])
+    np.testing.assert_array_equal(nodes.numpy(), [0, 1, 2, 8, 9, 4, 7, 6])
+
+
+def test_reindex_heter_graph_shared_mapping():
+    import numpy as np
+
+    import paddlepaddle_tpu.geometric as g
+
+    srcs, dsts, nodes = g.reindex_heter_graph(
+        np.asarray([0, 1], np.int64),
+        [np.asarray([5, 0], np.int64), np.asarray([5, 7], np.int64)],
+        [np.asarray([1, 1], np.int32), np.asarray([2, 0], np.int32)])
+    np.testing.assert_array_equal(nodes.numpy(), [0, 1, 5, 7])
+    np.testing.assert_array_equal(srcs[0].numpy(), [2, 0])
+    np.testing.assert_array_equal(dsts[0].numpy(), [0, 1])
+    np.testing.assert_array_equal(srcs[1].numpy(), [2, 3])
+    np.testing.assert_array_equal(dsts[1].numpy(), [0, 0])
+
+
+def test_sample_neighbors_csc():
+    import numpy as np
+
+    import paddlepaddle_tpu.geometric as g
+
+    row = np.asarray([3, 7, 0, 9, 1, 4, 2, 9, 3, 9, 1, 9, 7], np.int64)
+    colptr = np.asarray([0, 2, 4, 5, 6, 7, 9, 11, 11, 13, 13], np.int64)
+    nodes = np.asarray([0, 8, 1, 2], np.int64)
+    nb, ct = g.sample_neighbors(row, colptr, nodes, sample_size=2)
+    np.testing.assert_array_equal(ct.numpy(), [2, 2, 2, 1])
+    # sampled neighbors are actual neighbors of each node
+    offs = np.concatenate([[0], np.cumsum(ct.numpy())])
+    for i, v in enumerate(nodes):
+        got = set(nb.numpy()[offs[i]:offs[i + 1]])
+        allowed = set(row[colptr[v]:colptr[v + 1]])
+        assert got <= allowed, (v, got, allowed)
+    # sample_size=-1 returns all neighbors
+    nb_all, ct_all = g.sample_neighbors(row, colptr, nodes)
+    np.testing.assert_array_equal(ct_all.numpy(), [2, 2, 2, 1])
+    # eids passthrough
+    eids = np.arange(13, dtype=np.int64)
+    nb2, ct2, eo = g.sample_neighbors(row, colptr, nodes, sample_size=-1,
+                                      eids=eids, return_eids=True)
+    np.testing.assert_array_equal(eo.numpy(), [0, 1, 11, 12, 2, 3, 4])
+
+
+def test_weighted_sample_neighbors_prefers_heavy_edges():
+    import numpy as np
+
+    import paddlepaddle_tpu.geometric as g
+
+    # node 0 has 4 neighbors; weight mass concentrated on edges 2,3
+    row = np.asarray([10, 11, 12, 13], np.int64)
+    colptr = np.asarray([0, 4], np.int64)
+    w = np.asarray([1e-6, 1e-6, 1.0, 1.0], np.float32)
+    hits = {10: 0, 11: 0, 12: 0, 13: 0}
+    for _ in range(30):
+        nb, ct = g.weighted_sample_neighbors(row, colptr, w,
+                                             np.asarray([0], np.int64),
+                                             sample_size=2)
+        for v in nb.numpy():
+            hits[int(v)] += 1
+    assert hits[12] + hits[13] > hits[10] + hits[11]
+
+
+def test_send_uv_edge_messages():
+    import numpy as np
+
+    import paddlepaddle_tpu.geometric as g
+
+    x = np.asarray([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    y = np.asarray([[10.0, 10.0], [20.0, 20.0]], np.float32)
+    src = np.asarray([0, 1], np.int32)
+    dst = np.asarray([1, 0], np.int32)
+    out = g.send_uv(x, y, src, dst, message_op="add")
+    np.testing.assert_allclose(out.numpy(), [[21.0, 22.0], [13.0, 14.0]])

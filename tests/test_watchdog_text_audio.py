@@ -108,3 +108,41 @@ def test_mel_filterbank_matches_librosa_shape():
     assert fb.shape == (40, 257)
     assert (fb >= 0).all()
     np.testing.assert_allclose(mel_to_hz(hz_to_mel(440.0)), 440.0, rtol=1e-6)
+
+
+def test_audio_functional_tail():
+    """mel/fft frequency grids, power_to_db (matches the reference
+    docstring's 10*log10(3) = 4.77...), DCT-II orthonormal basis."""
+    import numpy as np
+
+    import paddlepaddle_tpu.audio as audio
+
+    mf = audio.functional.mel_frequencies(n_mels=10, f_max=8000.0).numpy()
+    assert mf.shape == (10,) and mf[0] == 0.0 and np.all(np.diff(mf) > 0)
+    ff = audio.functional.fft_frequencies(16000, 512).numpy()
+    assert ff.shape == (257,) and ff[-1] == 8000.0
+    db = float(audio.functional.power_to_db(
+        np.asarray([3.0], np.float32)).numpy()[0])
+    np.testing.assert_allclose(db, 10.0 * np.log10(3.0), rtol=1e-5)
+    dct = audio.functional.create_dct(6, 16).numpy()
+    # ortho norm: columns are orthonormal under the DCT-II inner product
+    gram = dct.T @ dct
+    np.testing.assert_allclose(gram, np.eye(6), atol=1e-5)
+
+
+def test_audio_wav_backend_roundtrip(tmp_path):
+    import numpy as np
+
+    import paddlepaddle_tpu.audio as audio
+
+    t = (np.sin(np.linspace(0, 50, 800))[None, :] * 0.5).astype(np.float32)
+    fp = str(tmp_path / "a.wav")
+    audio.backends.save(fp, t, 8000)
+    meta = audio.backends.info(fp)
+    assert meta.sample_rate == 8000 and meta.num_samples == 800
+    wav, sr = audio.backends.load(fp)
+    assert sr == 8000
+    np.testing.assert_allclose(wav.numpy(), t, atol=1e-3)
+    # offset + frame window
+    part, _ = audio.backends.load(fp, frame_offset=100, num_frames=200)
+    np.testing.assert_allclose(part.numpy(), t[:, 100:300], atol=1e-3)
