@@ -1,0 +1,298 @@
+// paddle_inference_c — the C inference API, TPU-native edition.
+//
+// Reference surface: paddle/fluid/inference/capi_exp/ (pd_inference_api.h:
+// PD_Config / PD_Predictor / PD_Tensor with PD_PredictorCreate / Run /
+// GetInput*/Output* / PD_TensorCopyFrom/ToCpu*). The reference's C API wraps
+// an in-process C++ predictor; on TPU the predictor is an XLA program owned
+// by the Python runtime (inference.Predictor over a saved StableHLO model),
+// so this library is the NATIVE CLIENT half of a local split: it speaks a
+// length-prefixed binary protocol over a Unix domain socket to
+// paddlepaddle_tpu.inference.c_api_server, which executes the program on the
+// chip. Same call shapes, C ABI (cgo-compatible — the role of the Go API),
+// zero Python in the client process.
+//
+// Build: g++ -O2 -fPIC -shared -o libpaddle_inference_c.so paddle_inference_c.cpp
+// Protocol (little-endian):
+//   request : u32 magic 'PDC1' | u8 op (1=RUN, 2=INFO) | body
+//   RUN body: u32 n | n * tensor      tensor: u32 name_len | name |
+//             u8 dtype (0 f32, 1 i64, 2 i32, 3 u8) | u32 ndim |
+//             i64 dims[ndim] | payload
+//   reply   : u32 magic | u8 status (0 ok) | RUN: u32 n | tensors
+//                                          | INFO: u32 n_in | names | u32 n_out | names
+//             status!=0: u32 msg_len | msg
+
+#include <cstdint>
+#include <cstring>
+#include <cstdio>
+#include <string>
+#include <vector>
+
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x50444331u;  // 'PDC1'
+
+enum PdDType : uint8_t { kF32 = 0, kI64 = 1, kI32 = 2, kU8 = 3 };
+
+size_t dtype_size(uint8_t d) {
+  switch (d) {
+    case kF32: return 4;
+    case kI64: return 8;
+    case kI32: return 4;
+    default:   return 1;
+  }
+}
+
+struct Buf {
+  std::vector<uint8_t> d;
+  void u8(uint8_t v) { d.push_back(v); }
+  void u32(uint32_t v) { const uint8_t* p = reinterpret_cast<uint8_t*>(&v); d.insert(d.end(), p, p + 4); }
+  void i64(int64_t v) { const uint8_t* p = reinterpret_cast<uint8_t*>(&v); d.insert(d.end(), p, p + 8); }
+  void bytes(const void* p, size_t n) { const uint8_t* q = static_cast<const uint8_t*>(p); d.insert(d.end(), q, q + n); }
+};
+
+bool read_exact(int fd, void* out, size_t n) {
+  uint8_t* p = static_cast<uint8_t*>(out);
+  while (n) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r; n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_exact(int fd, const void* in, size_t n) {
+  const uint8_t* p = static_cast<const uint8_t*>(in);
+  while (n) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r; n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+}  // namespace
+
+typedef struct PD_Config {
+  std::string socket_path;
+} PD_Config;
+
+typedef struct PD_Tensor {
+  std::string name;
+  uint8_t dtype = kF32;
+  std::vector<int64_t> dims;
+  std::vector<uint8_t> data;
+  size_t numel() const {
+    size_t n = 1;
+    for (int64_t d : dims) n *= static_cast<size_t>(d);
+    return n;
+  }
+} PD_Tensor;
+
+typedef struct PD_Predictor {
+  int fd = -1;
+  std::vector<std::string> input_names;
+  std::vector<std::string> output_names;
+  std::vector<PD_Tensor*> inputs;    // one handle per input name
+  std::vector<PD_Tensor*> outputs;   // refreshed by PD_PredictorRun
+  std::string last_error;
+} PD_Predictor;
+
+typedef struct PD_OneDimArrayCstr {
+  size_t size;
+  char** data;
+} PD_OneDimArrayCstr;
+
+extern "C" void PD_PredictorDestroy(PD_Predictor* p);
+
+static bool pd_roundtrip(PD_Predictor* p, const Buf& req, std::vector<uint8_t>* reply) {
+  uint64_t len = req.d.size();
+  if (!write_exact(p->fd, &len, 8) || !write_exact(p->fd, req.d.data(), req.d.size())) return false;
+  uint64_t rlen = 0;
+  if (!read_exact(p->fd, &rlen, 8)) return false;
+  reply->resize(rlen);
+  return read_exact(p->fd, reply->data(), rlen);
+}
+
+struct Cursor {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok = true;
+  template <typename T> T get() {
+    T v{};
+    if (p + sizeof(T) > end) { ok = false; return v; }
+    std::memcpy(&v, p, sizeof(T)); p += sizeof(T);
+    return v;
+  }
+  std::string str(size_t n) {
+    if (p + n > end) { ok = false; return ""; }
+    std::string s(reinterpret_cast<const char*>(p), n); p += n;
+    return s;
+  }
+};
+
+extern "C" {
+
+// -- Config ----------------------------------------------------------------
+
+PD_Config* PD_ConfigCreate() { return new PD_Config(); }
+void PD_ConfigDestroy(PD_Config* c) { delete c; }
+
+// model path = the c_api_server's unix socket (params arg kept for call-shape
+// parity with the reference's SetModel(prog_file, params_file))
+void PD_ConfigSetModel(PD_Config* c, const char* socket_path, const char* /*params*/) {
+  c->socket_path = socket_path ? socket_path : "";
+}
+void PD_ConfigSetModelDir(PD_Config* c, const char* socket_path) {
+  c->socket_path = socket_path ? socket_path : "";
+}
+const char* PD_ConfigGetModelDir(PD_Config* c) { return c->socket_path.c_str(); }
+
+// -- Predictor -------------------------------------------------------------
+
+PD_Predictor* PD_PredictorCreate(PD_Config* config) {
+  PD_Predictor* p = new PD_Predictor();
+  p->fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  std::snprintf(addr.sun_path, sizeof(addr.sun_path), "%s", config->socket_path.c_str());
+  if (p->fd < 0 || ::connect(p->fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    PD_PredictorDestroy(p);  // closes the fd — a retry loop must not leak
+    delete config;  // __pd_take semantics: Create consumes the config
+    return nullptr;
+  }
+  Buf req;
+  req.u32(kMagic); req.u8(2);  // INFO
+  std::vector<uint8_t> reply;
+  if (!pd_roundtrip(p, req, &reply)) { PD_PredictorDestroy(p); delete config; return nullptr; }
+  Cursor c{reply.data(), reply.data() + reply.size()};
+  if (c.get<uint32_t>() != kMagic || c.get<uint8_t>() != 0) { PD_PredictorDestroy(p); delete config; return nullptr; }
+  uint32_t n_in = c.get<uint32_t>();
+  for (uint32_t i = 0; i < n_in; ++i) p->input_names.push_back(c.str(c.get<uint32_t>()));
+  uint32_t n_out = c.get<uint32_t>();
+  for (uint32_t i = 0; i < n_out; ++i) p->output_names.push_back(c.str(c.get<uint32_t>()));
+  for (const auto& n : p->input_names) {
+    PD_Tensor* t = new PD_Tensor(); t->name = n; p->inputs.push_back(t);
+  }
+  delete config;
+  return c.ok ? p : (PD_PredictorDestroy(p), nullptr);
+}
+
+void PD_PredictorDestroy(PD_Predictor* p) {
+  if (!p) return;
+  if (p->fd >= 0) ::close(p->fd);
+  for (auto* t : p->inputs) delete t;
+  for (auto* t : p->outputs) delete t;
+  delete p;
+}
+
+size_t PD_PredictorGetInputNum(PD_Predictor* p) { return p->input_names.size(); }
+size_t PD_PredictorGetOutputNum(PD_Predictor* p) { return p->output_names.size(); }
+
+static PD_OneDimArrayCstr* make_names(const std::vector<std::string>& v) {
+  PD_OneDimArrayCstr* a = new PD_OneDimArrayCstr();
+  a->size = v.size();
+  a->data = new char*[v.size()];
+  for (size_t i = 0; i < v.size(); ++i) a->data[i] = ::strdup(v[i].c_str());
+  return a;
+}
+
+PD_OneDimArrayCstr* PD_PredictorGetInputNames(PD_Predictor* p) { return make_names(p->input_names); }
+PD_OneDimArrayCstr* PD_PredictorGetOutputNames(PD_Predictor* p) { return make_names(p->output_names); }
+
+void PD_OneDimArrayCstrDestroy(PD_OneDimArrayCstr* a) {
+  if (!a) return;
+  for (size_t i = 0; i < a->size; ++i) ::free(a->data[i]);
+  delete[] a->data;
+  delete a;
+}
+
+PD_Tensor* PD_PredictorGetInputHandle(PD_Predictor* p, const char* name) {
+  for (size_t i = 0; i < p->input_names.size(); ++i)
+    if (p->input_names[i] == name) return p->inputs[i];
+  return nullptr;
+}
+
+PD_Tensor* PD_PredictorGetOutputHandle(PD_Predictor* p, const char* name) {
+  for (size_t i = 0; i < p->output_names.size(); ++i)
+    if (p->output_names[i] == name && i < p->outputs.size()) return p->outputs[i];
+  return nullptr;
+}
+
+const char* PD_PredictorGetLastError(PD_Predictor* p) { return p->last_error.c_str(); }
+
+// -- Tensor ----------------------------------------------------------------
+
+void PD_TensorReshape(PD_Tensor* t, size_t ndim, int32_t* shape) {
+  t->dims.assign(shape, shape + ndim);
+}
+
+static void copy_from(PD_Tensor* t, const void* src, uint8_t dtype) {
+  t->dtype = dtype;
+  t->data.resize(t->numel() * dtype_size(dtype));
+  std::memcpy(t->data.data(), src, t->data.size());
+}
+
+void PD_TensorCopyFromCpuFloat(PD_Tensor* t, const float* v) { copy_from(t, v, kF32); }
+void PD_TensorCopyFromCpuInt64(PD_Tensor* t, const int64_t* v) { copy_from(t, v, kI64); }
+void PD_TensorCopyFromCpuInt32(PD_Tensor* t, const int32_t* v) { copy_from(t, v, kI32); }
+void PD_TensorCopyFromCpuUint8(PD_Tensor* t, const uint8_t* v) { copy_from(t, v, kU8); }
+
+void PD_TensorCopyToCpuFloat(PD_Tensor* t, float* out) { std::memcpy(out, t->data.data(), t->data.size()); }
+void PD_TensorCopyToCpuInt64(PD_Tensor* t, int64_t* out) { std::memcpy(out, t->data.data(), t->data.size()); }
+void PD_TensorCopyToCpuInt32(PD_Tensor* t, int32_t* out) { std::memcpy(out, t->data.data(), t->data.size()); }
+void PD_TensorCopyToCpuUint8(PD_Tensor* t, uint8_t* out) { std::memcpy(out, t->data.data(), t->data.size()); }
+
+size_t PD_TensorGetNumDims(PD_Tensor* t) { return t->dims.size(); }
+void PD_TensorGetShape(PD_Tensor* t, int32_t* out) {
+  for (size_t i = 0; i < t->dims.size(); ++i) out[i] = static_cast<int32_t>(t->dims[i]);
+}
+int32_t PD_TensorGetDataType(PD_Tensor* t) { return t->dtype; }
+const char* PD_TensorGetName(PD_Tensor* t) { return t->name.c_str(); }
+void PD_TensorDestroy(PD_Tensor* /*t*/) { /* handles are owned by the predictor */ }
+
+// -- Run -------------------------------------------------------------------
+
+int PD_PredictorRun(PD_Predictor* p) {
+  Buf req;
+  req.u32(kMagic); req.u8(1);  // RUN
+  req.u32(static_cast<uint32_t>(p->inputs.size()));
+  for (PD_Tensor* t : p->inputs) {
+    req.u32(static_cast<uint32_t>(t->name.size()));
+    req.bytes(t->name.data(), t->name.size());
+    req.u8(t->dtype);
+    req.u32(static_cast<uint32_t>(t->dims.size()));
+    for (int64_t d : t->dims) req.i64(d);
+    req.bytes(t->data.data(), t->data.size());
+  }
+  std::vector<uint8_t> reply;
+  if (!pd_roundtrip(p, req, &reply)) { p->last_error = "transport failure"; return 0; }
+  Cursor c{reply.data(), reply.data() + reply.size()};
+  if (c.get<uint32_t>() != kMagic) { p->last_error = "bad reply magic"; return 0; }
+  if (c.get<uint8_t>() != 0) {
+    p->last_error = c.str(c.get<uint32_t>());
+    return 0;
+  }
+  for (auto* t : p->outputs) delete t;
+  p->outputs.clear();
+  uint32_t n = c.get<uint32_t>();
+  for (uint32_t i = 0; i < n && c.ok; ++i) {
+    PD_Tensor* t = new PD_Tensor();
+    t->name = c.str(c.get<uint32_t>());
+    t->dtype = c.get<uint8_t>();
+    uint32_t nd = c.get<uint32_t>();
+    for (uint32_t j = 0; j < nd; ++j) t->dims.push_back(c.get<int64_t>());
+    size_t bytes = t->numel() * dtype_size(t->dtype);
+    if (c.p + bytes > c.end) { c.ok = false; delete t; break; }
+    t->data.assign(c.p, c.p + bytes);
+    c.p += bytes;
+    p->outputs.push_back(t);
+  }
+  if (!c.ok) { p->last_error = "truncated reply"; return 0; }
+  return 1;
+}
+
+}  // extern "C"

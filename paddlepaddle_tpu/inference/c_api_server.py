@@ -1,0 +1,194 @@
+"""Server half of the C inference API (native/paddle_inference_c.cpp).
+
+Reference surface: paddle/fluid/inference/capi_exp/ — there the C API calls
+into the in-process C++ predictor; here the predictor is an XLA program
+owned by this Python runtime, so the C library is a native client speaking
+a length-prefixed binary protocol over a Unix domain socket, and this
+module is the listener that executes the program on the chip. One thread
+per connection; tensors cross as raw little-endian buffers (f32/i64/i32/u8).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_MAGIC = 0x50444331
+_DTYPES = [np.dtype("<f4"), np.dtype("<i8"), np.dtype("<i4"), np.dtype("u1")]
+_OP_RUN, _OP_INFO = 1, 2
+
+
+def _pack_tensor(name: str, arr: np.ndarray) -> bytes:
+    arr = np.ascontiguousarray(arr)
+    matches = [i for i, d in enumerate(_DTYPES) if d == arr.dtype.newbyteorder("<")]
+    if not matches:
+        raise ValueError(
+            f"tensor {name!r} has dtype {arr.dtype}, which the C API wire "
+            f"format does not carry (supported: float32, int64, int32, "
+            f"uint8) — cast the model output first")
+    code = matches[0]
+    head = struct.pack("<I", len(name)) + name.encode()
+    head += struct.pack("<B", code) + struct.pack("<I", arr.ndim)
+    head += b"".join(struct.pack("<q", d) for d in arr.shape)
+    return head + arr.tobytes()
+
+
+class _Cursor:
+    def __init__(self, buf: bytes):
+        self.b, self.o = buf, 0
+
+    def take(self, fmt: str):
+        v = struct.unpack_from("<" + fmt, self.b, self.o)
+        self.o += struct.calcsize("<" + fmt)
+        return v if len(v) > 1 else v[0]
+
+    def raw(self, n: int) -> bytes:
+        out = self.b[self.o:self.o + n]
+        self.o += n
+        return out
+
+
+def _unpack_tensor(c: _Cursor) -> Tuple[str, np.ndarray]:
+    name = c.raw(c.take("I")).decode()
+    code = c.take("B")
+    ndim = c.take("I")
+    dims = [c.take("q") for _ in range(ndim)]
+    dt = _DTYPES[code]
+    n = int(np.prod(dims)) if dims else 1
+    arr = np.frombuffer(c.raw(n * dt.itemsize), dtype=dt).reshape(dims)
+    return name, arr
+
+
+class CApiServer:
+    """Serves a Predictor (or any (named inputs) -> [outputs] callable)."""
+
+    def __init__(self, predictor, socket_path: str,
+                 input_names: Optional[Sequence[str]] = None,
+                 output_names: Optional[Sequence[str]] = None):
+        self.predictor = predictor
+        self.path = socket_path
+        self.input_names = list(input_names if input_names is not None
+                                else predictor.get_input_names())
+        self.output_names = list(output_names if output_names is not None
+                                 else predictor.get_output_names())
+        self._sock: Optional[socket.socket] = None
+        self._threads: List[threading.Thread] = []
+        self._conns: List[socket.socket] = []
+        self._stop = threading.Event()
+
+    # -- protocol -----------------------------------------------------------
+    def _reply_ok(self, body: bytes) -> bytes:
+        return struct.pack("<IB", _MAGIC, 0) + body
+
+    def _reply_err(self, msg: str) -> bytes:
+        m = msg.encode()[:4096]
+        return struct.pack("<IB", _MAGIC, 1) + struct.pack("<I", len(m)) + m
+
+    def _handle(self, req: bytes) -> bytes:
+        c = _Cursor(req)
+        if c.take("I") != _MAGIC:
+            return self._reply_err("bad magic")
+        op = c.take("B")
+        if op == _OP_INFO:
+            body = struct.pack("<I", len(self.input_names))
+            for n in self.input_names:
+                body += struct.pack("<I", len(n)) + n.encode()
+            body += struct.pack("<I", len(self.output_names))
+            for n in self.output_names:
+                body += struct.pack("<I", len(n)) + n.encode()
+            return self._reply_ok(body)
+        if op != _OP_RUN:
+            return self._reply_err(f"unknown op {op}")
+        try:
+            n = c.take("I")
+            named = dict(_unpack_tensor(c) for _ in range(n))
+            inputs = [named[k] for k in self.input_names]
+            outs = self.predictor.run(inputs)
+            # the name snapshot may predate the first run (Predictor only
+            # knows its real output arity after running) — never let the
+            # declared count and the serialized tensors disagree
+            names = (self.output_names if len(self.output_names) == len(outs)
+                     else [f"output_{i}" for i in range(len(outs))])
+            self.output_names = names
+            body = struct.pack("<I", len(outs))
+            for name, o in zip(names, outs):
+                body += _pack_tensor(name, np.asarray(o))
+            return self._reply_ok(body)
+        except Exception as e:  # surfaced as PD_PredictorGetLastError
+            return self._reply_err(f"{type(e).__name__}: {e}")
+
+    # -- transport ----------------------------------------------------------
+    def _serve_conn(self, conn: socket.socket):
+        with conn:
+            while not self._stop.is_set():
+                head = b""
+                while len(head) < 8:
+                    chunk = conn.recv(8 - len(head))
+                    if not chunk:
+                        return
+                    head += chunk
+                (length,) = struct.unpack("<Q", head)
+                buf = b""
+                while len(buf) < length:
+                    chunk = conn.recv(min(1 << 20, length - len(buf)))
+                    if not chunk:
+                        return
+                    buf += chunk
+                reply = self._handle(buf)
+                conn.sendall(struct.pack("<Q", len(reply)) + reply)
+
+    def start(self):
+        if os.path.exists(self.path):
+            os.unlink(self.path)
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.bind(self.path)
+        self._sock.listen(8)
+
+        def accept_loop():
+            while not self._stop.is_set():
+                try:
+                    conn, _ = self._sock.accept()
+                except OSError:
+                    return
+                t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                     daemon=True)
+                t.start()
+                self._conns.append(conn)
+                # prune finished handlers so a long-lived server does not
+                # accumulate dead Thread objects per connection
+                self._threads = [x for x in self._threads if x.is_alive()]
+                self._threads.append(t)
+
+        t = threading.Thread(target=accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._sock is not None:
+            self._sock.close()
+        for conn in self._conns:      # unblock handlers waiting in recv
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        self._conns.clear()
+        if os.path.exists(self.path):
+            os.unlink(self.path)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def serve_predictor(predictor, socket_path: str) -> CApiServer:
+    """Start serving ``predictor`` for native clients; returns the server."""
+    return CApiServer(predictor, socket_path).start()
