@@ -316,7 +316,11 @@ def _flash_fwd_impl(q, k, v, causal, scale, use_pallas):
                               _bshd_to_flat(v), causal, scale)
         if res is not None:
             out_flat, lse = res
-            return _flat_to_bshd(out_flat, b, h), lse
+            # keep the RESIDUAL compact: the kernel's [bh, sq, 1] output is
+            # lane-padded 128x by Mosaic tiling (64 MB/layer at bench
+            # shapes); squeezing to 2-D lets XLA free the padded temp while
+            # only 2 MB/layer survives to the backward pass
+            return _flat_to_bshd(out_flat, b, h), lse[:, :, 0]
     return _xla_attention(q, k, v, causal, None, scale), None
 
 
@@ -334,7 +338,8 @@ def _flash_bwd(causal, scale, use_pallas, res, g):
         b, s, h, d = q.shape
         grads = _pallas_backward(
             _bshd_to_flat(q), _bshd_to_flat(k), _bshd_to_flat(v),
-            _bshd_to_flat(out), lse, _bshd_to_flat(g), causal, scale)
+            _bshd_to_flat(out), lse[:, :, None], _bshd_to_flat(g), causal,
+            scale)
         if grads is not None:
             dq, dk, dv = grads
             return (_flat_to_bshd(dq, b, h), _flat_to_bshd(dk, b, h),
