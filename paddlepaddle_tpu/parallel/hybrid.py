@@ -38,6 +38,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..models.llama import rope_tables, rotate_half
 from ..ops.kernels.flash_attention import _flash_core, _use_pallas
+from ..ops.kernels.ring_attention import _block_attn_update
 
 
 class HybridStageConfig(NamedTuple):
@@ -156,13 +157,20 @@ def llama_head_specs(tp_axis="tp") -> dict:
 
 
 def make_llama_block(cfg: HybridStageConfig, tp_axis="tp", fsdp_axis="fsdp",
-                     remat=True, use_flash=True):
+                     sp_axis=None, sp_size=1, remat=True, use_flash=True):
     """(stage_params_local, acts) -> acts: one pipeline stage =
     ``layers_per_stage`` decoder layers with explicit tp/fsdp collectives.
 
     Runs inside shard_map: ``stage_params_local`` leaves are the local tp/fsdp
     shards (see ``llama_stage_specs``); activations are replicated over tp and
-    batch-sharded over the data axes by the caller."""
+    batch-sharded over the data axes by the caller. With ``sp_axis`` the
+    SEQUENCE dim of the activations is additionally sharded over a context-
+    parallel axis and attention runs blockwise over the gathered K/V
+    (``_sp_blockwise_attention`` — allgather-KV context parallelism; the
+    standalone ring lives in ops/kernels/ring_attention.py but ppermute is
+    not branch-safe inside the schedule executor): the full 5-D
+    dp x fsdp x tp x pp x sp composition. ``sp_size`` must be the static
+    mesh size of ``sp_axis``."""
     cos_t, sin_t = rope_tables(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
     hd = cfg.head_dim
     eps = cfg.rms_norm_eps
@@ -185,23 +193,44 @@ def make_llama_block(cfg: HybridStageConfig, tp_axis="tp", fsdp_axis="fsdp",
         q = (hn @ wq).reshape(b, s, -1, hd)
         k = (hn @ wk).reshape(b, s, -1, hd)
         v = (hn @ wv).reshape(b, s, -1, hd)
-        cos = cos_t[:s][None, :, None, :].astype(dt)
-        sin = sin_t[:s][None, :, None, :].astype(dt)
+        if sp_axis is not None:
+            # rope needs GLOBAL positions: this shard holds rows
+            # [rank*s, rank*s + s) of the full sequence. Fail loudly like
+            # the non-sp path does — dynamic_slice would silently CLAMP an
+            # out-of-range offset to position 0
+            if sp_size * s > cfg.max_seq_len:
+                raise ValueError(
+                    f"global sequence {sp_size * s} exceeds max_seq_len "
+                    f"{cfg.max_seq_len} (s_local={s} x sp_size={sp_size})")
+            off = jax.lax.axis_index(sp_axis) * s
+            cos = jax.lax.dynamic_slice_in_dim(cos_t, off, s, axis=0)
+            sin = jax.lax.dynamic_slice_in_dim(sin_t, off, s, axis=0)
+        else:
+            cos, sin = cos_t[:s], sin_t[:s]
+        cos = cos[None, :, None, :].astype(dt)
+        sin = sin[None, :, None, :].astype(dt)
         q, k = _rope(q, cos, sin), _rope(k, cos, sin)
         rep = q.shape[2] // k.shape[2]
-        if rep > 1:
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
-        if use_flash:
-            out = _flash_core(q, k, v, True, scale, _use_pallas(q))
+        if sp_axis is not None:
+            # gather the UN-repeated KV heads (1/rep the collective volume);
+            # the blockwise attention repeats after the gather
+            out = _sp_blockwise_attention(q, k, v, sp_axis, sp_size, scale,
+                                          rep)
         else:
-            qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * scale
-            kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
-            lg = jnp.einsum("bhqd,bhkd->bhqk", qt, kt)
-            lg = jnp.where(jnp.tril(jnp.ones((s, s), bool)), lg, -1e30)
-            pr = jax.nn.softmax(lg, axis=-1).astype(v.dtype)
-            out = jnp.swapaxes(
-                jnp.einsum("bhqk,bhkd->bhqd", pr, jnp.swapaxes(v, 1, 2)), 1, 2)
+            if rep > 1:
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+            if use_flash:
+                out = _flash_core(q, k, v, True, scale, _use_pallas(q))
+            else:
+                qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * scale
+                kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+                lg = jnp.einsum("bhqd,bhkd->bhqk", qt, kt)
+                lg = jnp.where(jnp.tril(jnp.ones((s, s), bool)), lg, -1e30)
+                pr = jax.nn.softmax(lg, axis=-1).astype(v.dtype)
+                out = jnp.swapaxes(
+                    jnp.einsum("bhqk,bhkd->bhqd", pr,
+                               jnp.swapaxes(v, 1, 2)), 1, 2)
         attn = g_out(out.astype(dt).reshape(b, s, -1) @ wo)
         x = x + attn
         # --- MLP (column gate/up, row down + psum) ---
@@ -223,16 +252,71 @@ def make_llama_block(cfg: HybridStageConfig, tp_axis="tp", fsdp_axis="fsdp",
     return block
 
 
-def make_vocab_parallel_head(cfg: HybridStageConfig, tp_axis="tp"):
+def _sp_blockwise_attention(q, k, v, sp_axis, n_shards, scale, rep=1):
+    """Context-parallel causal attention INSIDE the pipeline executor:
+    all-gather the K/V shards over sp, then blockwise online-softmax against
+    the local Q shard (global position offsets), O(s_local x s_global)
+    scores never materialized at once.
+
+    Why not the true ring (ops/kernels/ring_attention.py): XLA lowers
+    ``collective-permute`` on ONE global channel, so a ppermute inside a
+    ``lax.switch`` branch deadlocks when pipeline stages execute different
+    opcodes in the same slot (observed as an 8-way rendezvous stuck at 4).
+    All-reduce-family collectives (psum / all_gather / psum_scatter) lower
+    per replica-group and are branch-safe — the same reason the Megatron
+    'allgather-KV' context-parallel variant exists. Memory: O(s_global) K/V
+    per chip vs the ring's O(s_local); the scores stay blocked."""
+    my = jax.lax.axis_index(sp_axis)
+    b, s_loc, h, d = q.shape
+    kg = jax.lax.all_gather(k, sp_axis)          # [n, b, s_loc, kvh, d]
+    vg = jax.lax.all_gather(v, sp_axis)
+    m = jnp.full((b, h, s_loc, 1), -1e30, jnp.float32)
+    l = jnp.zeros((b, h, s_loc, 1), jnp.float32)
+    acc = jnp.zeros((b, h, s_loc, d), jnp.float32)
+    q_off = my * s_loc
+    for j in range(n_shards):
+        kj, vj = kg[j], vg[j]
+        if rep > 1:                              # GQA repeat AFTER the gather
+            kj = jnp.repeat(kj, rep, axis=2)
+            vj = jnp.repeat(vj, rep, axis=2)
+        m2, l2, a2 = _block_attn_update(q, kj, vj, m, l, acc,
+                                        q_off, j * s_loc, True, scale)
+        skip = j > my                            # block fully in the future
+        m = jnp.where(skip, m, m2)
+        l = jnp.where(skip, l, l2)
+        acc = jnp.where(skip, acc, a2)
+    out = acc / jnp.maximum(l, 1e-30)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+def make_vocab_parallel_head(cfg: HybridStageConfig, tp_axis="tp",
+                             sp_axis=None):
     """(head_params_local, acts, labels) -> scalar mean next-token CE.
 
     ParallelCrossEntropy semantics (fleet/layers/mpu/mp_layers.py — the
     reference's c_softmax_with_cross_entropy): logits stay vocab-sharded over
     tp; the softmax normalizer and the label logit are assembled with psum /
     pmax so the full [b, s, V] tensor never exists. Same shift/mask
-    formulation as models.llama.LlamaForCausalLM.loss_from_logits."""
+    formulation as models.llama.LlamaForCausalLM.loss_from_logits. With
+    ``sp_axis`` the sequence dim is context-sharded: the next-token label
+    shift crosses shard boundaries via ppermute, positions/valid masks use
+    GLOBAL indices, and the mean reduces numerator and denominator with
+    psum over sp."""
     eps = cfg.rms_norm_eps
     f_in, g_out = _fg_pair(tp_axis)
+    _, g_sp = _fg_pair(sp_axis)
+
+    def _shift_labels(labels):
+        """labels for position t = token t+1, across sp shard boundaries."""
+        if sp_axis is None:
+            return jnp.roll(labels, -1, axis=1)
+        # branch-safe shift (no ppermute, see _sp_blockwise_attention): every
+        # shard gathers the first columns and takes its RIGHT neighbor's
+        n = jax.lax.psum(1, sp_axis)
+        firsts = jax.lax.all_gather(labels[:, :1], sp_axis)  # [n, b, 1]
+        my = jax.lax.axis_index(sp_axis)
+        incoming = jnp.take(firsts, (my + 1) % n, axis=0)
+        return jnp.concatenate([labels[:, 1:], incoming], axis=1)
 
     def head_loss(hp, x, labels):
         xn = f_in(_rms(x, hp["ln"], eps))
@@ -240,7 +324,7 @@ def make_vocab_parallel_head(cfg: HybridStageConfig, tp_axis="tp"):
         v_loc = logits.shape[-1]
         s = logits.shape[1]
         off = (jax.lax.axis_index(tp_axis) * v_loc) if tp_axis else 0
-        lbl = jnp.roll(labels, -1, axis=1)
+        lbl = _shift_labels(labels)
         # the max shift is numerical-stability only — keep the (non-
         # differentiable) pmax out of the vjp graph
         m_loc = jax.lax.stop_gradient(jnp.max(logits, axis=-1))
@@ -254,8 +338,18 @@ def make_vocab_parallel_head(cfg: HybridStageConfig, tp_axis="tp"):
         lab = g_out(jnp.where(mine, lab, 0.0))
         nll = lse - lab
         pos = jax.lax.broadcasted_iota(jnp.int32, nll.shape, 1)
-        valid = ((lbl >= 0) & (pos < s - 1)).astype(jnp.float32)
-        return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+        if sp_axis is not None:
+            n = jax.lax.psum(1, sp_axis)
+            pos = pos + jax.lax.axis_index(sp_axis) * s
+            s_total = s * n
+        else:
+            s_total = s
+        valid = ((lbl >= 0) & (pos < s_total - 1)).astype(jnp.float32)
+        # g-style psum (identity backward): a raw psum would transpose to
+        # another psum and overcount each shard's cotangent by sp_size
+        num = g_sp(jnp.sum(nll * valid))
+        den = g_sp(jnp.sum(valid))
+        return num / jnp.maximum(den, 1.0)
 
     return head_loss
 

@@ -108,7 +108,8 @@ def spmd_pipeline_train(stacked_params, head_params, acts, labels,
                         block_fn: Callable, head_loss_fn: Callable, mesh: Mesh,
                         schedule="1f1b", n_microbatches: Optional[int] = None,
                         num_virtual: int = 1, pp_axis: str = "pp",
-                        data_axis=None, param_specs=None, head_specs=None):
+                        data_axis=None, param_specs=None, head_specs=None,
+                        seq_axis=None):
     """Schedule-driven pipeline training step: forward AND backward of all
     microbatches in ONE ``lax.scan`` over schedule slots.
 
@@ -135,6 +136,13 @@ def spmd_pipeline_train(stacked_params, head_params, acts, labels,
             names require ``n_microbatches`` (and ``num_virtual`` for VPP).
         data_axis: mesh axis name (or tuple of names) the batch dim is
             sharded over — dp, or (dp, fsdp) when ZeRO shards the batch too.
+        seq_axis: mesh axis the SEQUENCE dim (acts/labels dim 1) is sharded
+            over — context parallelism inside the stages (the block must
+            run a branch-safe context-parallel attention over this axis,
+            e.g. parallel.hybrid's allgather-KV blockwise attention, and
+            the head must reduce its token sums over it). Parameter
+            gradients are psum'd over it (each shard's tokens contribute
+            additively to the same weights).
         param_specs / head_specs: optional pytrees (matching the stage /
             head param structure) of PartitionSpecs for the PER-STAGE leaf
             dims — how each weight is sharded over tp/fsdp INSIDE a stage
@@ -336,6 +344,13 @@ def spmd_pipeline_train(stacked_params, head_params, acts, labels,
         loss = jax.lax.psum(loss, pp_axis) / M
         hg = jax.tree_util.tree_map(lambda a: jax.lax.psum(a, pp_axis), hg)
         dacts = jax.lax.psum(dacts, pp_axis)
+        if seq_axis is not None:
+            # sp shards hold disjoint tokens of the SAME batch rows: weight
+            # grads are partial sums over local tokens
+            gacc = jax.tree_util.tree_map(
+                lambda a: jax.lax.psum(a, seq_axis), gacc)
+            hg = jax.tree_util.tree_map(
+                lambda a: jax.lax.psum(a, seq_axis), hg)
         if data_axes:
             loss = jax.lax.pmean(loss, data_axes)
 
@@ -367,8 +382,16 @@ def spmd_pipeline_train(stacked_params, head_params, acts, labels,
     p_specs = _merge_specs(stacked_params, stage_specs_tree, (None, pp_axis))
     h_specs = _merge_specs(head_params, head_specs_tree, ())
     batch_dim = data_axes if data_axes else None
-    x_spec = P(None, batch_dim, *([None] * (ndim_rest - 1)))
-    y_spec = P(None, batch_dim, *([None] * (labels.ndim - 1)))
+    if seq_axis is not None and ndim_rest < 2:
+        raise ValueError(
+            f"seq_axis={seq_axis!r} needs activations [B, seq, ...]; got "
+            f"rank {acts.ndim}")
+    seq_rest = [seq_axis] + [None] * (ndim_rest - 2) if ndim_rest >= 2 else []
+    x_spec = P(None, batch_dim, *(seq_rest if seq_axis is not None
+                                  else [None] * (ndim_rest - 1)))
+    y_spec = P(None, batch_dim, *([seq_axis] + [None] * (labels.ndim - 2)
+                                  if seq_axis is not None and labels.ndim >= 2
+                                  else [None] * (labels.ndim - 1)))
 
     loss, gacc, hg, dacts = _shard_map(
         per_stage, mesh=mesh,
