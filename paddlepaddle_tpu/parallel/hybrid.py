@@ -172,10 +172,7 @@ def make_llama_block(cfg: HybridStageConfig, tp_axis="tp", fsdp_axis="fsdp",
     dp x fsdp x tp x pp x sp composition. ``sp_size`` must be the static
     mesh size of ``sp_axis``."""
     cos_t, sin_t = rope_tables(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
-    hd = cfg.head_dim
     eps = cfg.rms_norm_eps
-    scale = 1.0 / math.sqrt(hd)
-
     f_in, g_out = _fg_pair(tp_axis)
 
     def gather(wloc, axis):
@@ -184,55 +181,10 @@ def make_llama_block(cfg: HybridStageConfig, tp_axis="tp", fsdp_axis="fsdp",
         return jax.lax.all_gather(wloc, fsdp_axis, axis=axis, tiled=True)
 
     def layer(x, lp):
-        b, s, h = x.shape
-        dt = x.dtype
-        # --- attention (column qkv, flash on local heads, row o + psum) ---
-        hn = f_in(_rms(x, lp["ln1"], eps))
-        wq, wk, wv = gather(lp["wq"], 0), gather(lp["wk"], 0), gather(lp["wv"], 0)
-        wo = gather(lp["wo"], 1)
-        q = (hn @ wq).reshape(b, s, -1, hd)
-        k = (hn @ wk).reshape(b, s, -1, hd)
-        v = (hn @ wv).reshape(b, s, -1, hd)
-        if sp_axis is not None:
-            # rope needs GLOBAL positions: this shard holds rows
-            # [rank*s, rank*s + s) of the full sequence. Fail loudly like
-            # the non-sp path does — dynamic_slice would silently CLAMP an
-            # out-of-range offset to position 0
-            if sp_size * s > cfg.max_seq_len:
-                raise ValueError(
-                    f"global sequence {sp_size * s} exceeds max_seq_len "
-                    f"{cfg.max_seq_len} (s_local={s} x sp_size={sp_size})")
-            off = jax.lax.axis_index(sp_axis) * s
-            cos = jax.lax.dynamic_slice_in_dim(cos_t, off, s, axis=0)
-            sin = jax.lax.dynamic_slice_in_dim(sin_t, off, s, axis=0)
-        else:
-            cos, sin = cos_t[:s], sin_t[:s]
-        cos = cos[None, :, None, :].astype(dt)
-        sin = sin[None, :, None, :].astype(dt)
-        q, k = _rope(q, cos, sin), _rope(k, cos, sin)
-        rep = q.shape[2] // k.shape[2]
-        if sp_axis is not None:
-            # gather the UN-repeated KV heads (1/rep the collective volume);
-            # the blockwise attention repeats after the gather
-            out = _sp_blockwise_attention(q, k, v, sp_axis, sp_size, scale,
-                                          rep)
-        else:
-            if rep > 1:
-                k = jnp.repeat(k, rep, axis=2)
-                v = jnp.repeat(v, rep, axis=2)
-            if use_flash:
-                out = _flash_core(q, k, v, True, scale, _use_pallas(q))
-            else:
-                qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * scale
-                kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
-                lg = jnp.einsum("bhqd,bhkd->bhqk", qt, kt)
-                lg = jnp.where(jnp.tril(jnp.ones((s, s), bool)), lg, -1e30)
-                pr = jax.nn.softmax(lg, axis=-1).astype(v.dtype)
-                out = jnp.swapaxes(
-                    jnp.einsum("bhqk,bhkd->bhqd", pr,
-                               jnp.swapaxes(v, 1, 2)), 1, 2)
-        attn = g_out(out.astype(dt).reshape(b, s, -1) @ wo)
-        x = x + attn
+        x = _attention_residual(
+            x, lp, cfg=cfg, cos_t=cos_t, sin_t=sin_t, f_in=f_in,
+            g_out=g_out, gather=gather, sp_axis=sp_axis, sp_size=sp_size,
+            use_flash=use_flash)
         # --- MLP (column gate/up, row down + psum) ---
         hm = f_in(_rms(x, lp["ln2"], eps))
         wg, wu = gather(lp["wg"], 0), gather(lp["wu"], 0)
@@ -366,3 +318,204 @@ def reference_forward(cfg: HybridStageConfig, per_stage_params, head_params,
     for sp in per_stage_params:
         x = block(sp, x)
     return head(head_params, x, labels)
+
+
+# ---------------------------------------------------------------------------
+# MoE stage: expert parallelism composed with the pipeline (ep × tp × pp —
+# the ERNIE/DeepSeek hybrid layout, fleet/base/topology.py + moe_layer.py)
+# ---------------------------------------------------------------------------
+
+
+def init_moe_stage(cfg: HybridStageConfig, key, num_experts: int,
+                   expert_hidden: int, dtype=jnp.float32) -> dict:
+    """One pipeline stage whose MLP is an expert bank: llama attention
+    params + gate [h, E] + stacked expert FFNs [L, E, ...]."""
+    h = cfg.hidden_size
+    L = cfg.layers_per_stage
+    base = init_llama_stage(cfg, key, dtype)
+    for k_ in ("wg", "wu", "wd"):
+        del base[k_]
+    ks = jax.random.split(jax.random.fold_in(key, 17), 4)
+
+    def w(k_, shape, fan_in):
+        return (jax.random.normal(k_, (L,) + shape, jnp.float32)
+                / math.sqrt(fan_in)).astype(dtype)
+
+    base["gate"] = w(ks[0], (h, num_experts), h)
+    base["eg"] = w(ks[1], (num_experts, h, expert_hidden), h)
+    base["eu"] = w(ks[2], (num_experts, h, expert_hidden), h)
+    base["ed"] = w(ks[3], (num_experts, expert_hidden, h), expert_hidden)
+    return base
+
+
+def moe_stage_specs(tp_axis="tp", fsdp_axis="fsdp", ep_axis="ep") -> dict:
+    """Attention sharded like the dense stage; expert banks over ep; the
+    router replicated (every ep member routes identically)."""
+    specs = llama_stage_specs(tp_axis=tp_axis, fsdp_axis=fsdp_axis)
+    for k_ in ("wg", "wu", "wd"):
+        del specs[k_]
+    specs["gate"] = P()
+    specs["eg"] = P(None, ep_axis)
+    specs["eu"] = P(None, ep_axis)
+    specs["ed"] = P(None, ep_axis)
+    return specs
+
+
+def _inject_aux_grad(y, aux, weight):
+    """Identity on ``y`` whose backward ALSO seeds ``aux``'s cotangent with
+    ``weight`` — how a scalar auxiliary objective rides through a block
+    whose contract only returns activations."""
+
+    @jax.custom_vjp
+    def f(y_, aux_):
+        return y_
+
+    f.defvjp(lambda y_, aux_: (y_, aux_),
+             lambda aux_res, dy: (dy, jnp.full_like(aux_res, weight)))
+    return f(y, aux)
+
+
+def make_moe_block(cfg: HybridStageConfig, num_experts: int, topk: int = 2,
+                   capacity_factor: float = 2.0, tp_axis="tp",
+                   fsdp_axis="fsdp", ep_axis="ep", ep_size: int = 1,
+                   aux_loss_weight: float = 0.0, remat=True, use_flash=True):
+    """(stage_params_local, acts) -> acts: llama attention + an
+    EXPERT-PARALLEL MoE MLP, branch-safe for the pipeline executor.
+
+    GShard semantics with explicit collectives: tokens stay replicated over
+    ep, every member routes identically (replicated gate), each member
+    einsum-dispatches only to its LOCAL expert slice, and the combined
+    outputs meet in one g-style psum over ep (the role of the reference's
+    MoEScatter/MoEGather alltoall pair, moe_layer.py:149,263 — a psum is
+    branch-safe inside lax.switch, an alltoall channel may not be). The
+    token cotangent sums each member's partial path via the f-operator.
+    """
+    from .moe import _top1_routing, _topk_routing
+
+    if ep_axis is not None and ep_size <= 1:
+        raise ValueError(
+            "ep_axis set but ep_size<=1 — pass the mesh's STATIC ep axis "
+            "size (a wrong ep_size makes dynamic_slice silently clamp and "
+            "double-count experts in the psum)")
+    if num_experts % max(ep_size, 1):
+        raise ValueError(
+            f"num_experts={num_experts} not divisible by ep_size={ep_size}")
+    eps = cfg.rms_norm_eps
+    cos_t, sin_t = rope_tables(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+    f_tp, g_tp = _fg_pair(tp_axis)
+    f_ep, g_ep = _fg_pair(ep_axis)
+
+    def gather(wloc, axis):
+        if fsdp_axis is None:
+            return wloc
+        return jax.lax.all_gather(wloc, fsdp_axis, axis=axis, tiled=True)
+
+    def layer(x, lp):
+        b, s, h = x.shape
+        dt = x.dtype
+        # --- attention: the shared residual sub-block ---
+        x = _attention_residual(
+            x, lp, cfg=cfg, cos_t=cos_t, sin_t=sin_t, f_in=f_tp, g_out=g_tp,
+            gather=gather, use_flash=use_flash)
+        # --- MoE MLP (ep-parallel GShard einsum) ---
+        hm = f_ep(_rms(x, lp["ln2"], eps))
+        E = num_experts
+        el = E // max(ep_size, 1)
+        T = b * s
+        cap = max(4, int(math.ceil(T * topk / E * capacity_factor)))
+        xf = hm.reshape(T, h)
+        # the gate's cotangent arrives as a per-member PARTIAL (each ep
+        # member backprops only through its local expert slice) — the
+        # f-operator's psum-backward assembles the full router gradient
+        gate_w = f_ep(lp["gate"].astype(jnp.float32))
+        logits = xf.astype(jnp.float32) @ gate_w
+        if topk == 1:
+            disp, comb, aux = _top1_routing(logits, cap)
+        else:
+            disp, comb, aux = _topk_routing(logits, cap, topk)
+        # routing is replicated over ep; each member dispatches only to its
+        # LOCAL expert slice and the partial outputs meet in ONE psum
+        my = jax.lax.axis_index(ep_axis) if ep_axis else 0
+        d_loc = jax.lax.dynamic_slice_in_dim(disp, my * el, el, axis=1)
+        c_loc = jax.lax.dynamic_slice_in_dim(comb, my * el, el, axis=1)
+        xin = jnp.einsum("tec,td->ecd", d_loc.astype(dt), xf)
+        hmid = jax.nn.silu(jnp.einsum("ecd,edh->ech", xin, lp["eg"]))
+        hmid = hmid * jnp.einsum("ecd,edh->ech", xin, lp["eu"])
+        outp = jnp.einsum("ech,ehd->ecd", hmid, lp["ed"])
+        y = jnp.einsum("tec,ecd->td", c_loc.astype(dt), outp)
+        y = g_ep(y).reshape(b, s, h)
+        # router load-balance loss: the executor's block contract returns
+        # only activations, so the aux term enters through its GRADIENT —
+        # identity-forward, constant-cotangent backward. NOTE the weight is
+        # PER MICROBATCH: the CE loss is seeded 1/M per microbatch, so pass
+        # aux_loss_weight = desired_total_weight / n_microbatches
+        if aux_loss_weight:
+            y = _inject_aux_grad(y, aux, aux_loss_weight)
+        return x + y
+
+    if remat:
+        layer = jax.checkpoint(layer)
+
+    def block(params, x):
+        def body(xc, lp):
+            return layer(xc, lp), None
+        x, _ = jax.lax.scan(body, x, params)
+        return x
+
+    return block
+
+
+def _attention_residual(x, lp, *, cfg, cos_t, sin_t, f_in, g_out, gather,
+                        sp_axis=None, sp_size=1, use_flash=True):
+    """x + attention(x): the residual attention sub-block SHARED by the
+    dense (make_llama_block) and MoE (make_moe_block) stages — column qkv,
+    rope at global positions, flash / plain-softmax / context-parallel
+    allgather-KV attention, row o-proj + tp psum."""
+    b, s, h = x.shape
+    dt = x.dtype
+    hd = cfg.head_dim
+    scale = 1.0 / math.sqrt(hd)
+    eps = cfg.rms_norm_eps
+    hn = f_in(_rms(x, lp["ln1"], eps))
+    wq, wk, wv = gather(lp["wq"], 0), gather(lp["wk"], 0), gather(lp["wv"], 0)
+    wo = gather(lp["wo"], 1)
+    q = (hn @ wq).reshape(b, s, -1, hd)
+    k = (hn @ wk).reshape(b, s, -1, hd)
+    v = (hn @ wv).reshape(b, s, -1, hd)
+    if sp_axis is not None:
+        # rope needs GLOBAL positions: this shard holds rows
+        # [rank*s, rank*s + s) of the full sequence. Fail loudly — a
+        # dynamic_slice would silently CLAMP an out-of-range offset to 0
+        if sp_size * s > cfg.max_seq_len:
+            raise ValueError(
+                f"global sequence {sp_size * s} exceeds max_seq_len "
+                f"{cfg.max_seq_len} (s_local={s} x sp_size={sp_size})")
+        off = jax.lax.axis_index(sp_axis) * s
+        cos = jax.lax.dynamic_slice_in_dim(cos_t, off, s, axis=0)
+        sin = jax.lax.dynamic_slice_in_dim(sin_t, off, s, axis=0)
+    else:
+        cos, sin = cos_t[:s], sin_t[:s]
+    cos = cos[None, :, None, :].astype(dt)
+    sin = sin[None, :, None, :].astype(dt)
+    q, k = _rope(q, cos, sin), _rope(k, cos, sin)
+    rep = q.shape[2] // k.shape[2]
+    if sp_axis is not None:
+        # gather the UN-repeated KV heads (1/rep the collective volume);
+        # the blockwise attention repeats after the gather
+        out = _sp_blockwise_attention(q, k, v, sp_axis, sp_size, scale, rep)
+    else:
+        if rep > 1:
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        if use_flash:
+            out = _flash_core(q, k, v, True, scale, _use_pallas(q))
+        else:
+            qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * scale
+            kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+            lg = jnp.einsum("bhqd,bhkd->bhqk", qt, kt)
+            lg = jnp.where(jnp.tril(jnp.ones((s, s), bool)), lg, -1e30)
+            pr = jax.nn.softmax(lg, axis=-1).astype(v.dtype)
+            out = jnp.swapaxes(
+                jnp.einsum("bhqk,bhkd->bhqd", pr,
+                           jnp.swapaxes(v, 1, 2)), 1, 2)
+    return x + g_out(out.astype(dt).reshape(b, s, -1) @ wo)

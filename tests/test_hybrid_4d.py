@@ -217,3 +217,54 @@ def test_5d_hybrid_with_allgather_kv_context_parallel():
     _assert_tree_close(g_st, stack_stage_params(ref_st), what="stage grads")
     _assert_tree_close(g_h, ref_h, what="head grads")
     _assert_tree_close(dacts, ref_a, what="embed cotangent")
+
+
+def test_moe_experts_inside_pipeline_stages():
+    """Expert parallelism COMPOSED with the pipeline (+tp): one
+    dp x fsdp x ep x tp x pp mesh runs MoE transformer stages through the
+    1F1B executor — the ERNIE/DeepSeek hybrid layout (fleet topology +
+    incubate moe_layer). Loss and all grads (expert banks ep-sharded,
+    router assembled across members) match the unsharded oracle."""
+    from paddlepaddle_tpu.parallel.hybrid import (init_moe_stage,
+                                                  make_moe_block,
+                                                  moe_stage_specs)
+
+    E, topk, eh = 4, 2, 48
+    devs = np.array(jax.devices()[:8])
+    mesh = Mesh(devs.reshape(1, 1, 2, 2, 2), ("dp", "fsdp", "ep", "tp", "pp"))
+    keys = jax.random.split(jax.random.PRNGKey(4), 5)
+    stages = [init_moe_stage(CFG, keys[i], E, eh) for i in range(2)]
+    head = init_llama_head(CFG, keys[2])
+    embed = jax.random.normal(keys[3], (CFG.vocab_size, CFG.hidden_size),
+                              jnp.float32)
+    ids = jax.random.randint(keys[4], (8, 16), 0, CFG.vocab_size, jnp.int32)
+    acts = embed[ids]
+
+    block = make_moe_block(CFG, E, topk=topk, capacity_factor=8.0,
+                           ep_size=2, remat=True)
+    head_fn = make_vocab_parallel_head(CFG)
+
+    loss, g_st, g_h, dacts = spmd_pipeline_train(
+        stack_stage_params(stages), head, acts, ids, block, head_fn, mesh,
+        schedule="1f1b", n_microbatches=4, pp_axis="pp",
+        data_axis=("dp", "fsdp"), param_specs=moe_stage_specs(),
+        head_specs=llama_head_specs())
+
+    # oracle: same math, all axes off
+    oracle_block = make_moe_block(CFG, E, topk=topk, capacity_factor=8.0,
+                                  tp_axis=None, fsdp_axis=None, ep_axis=None,
+                                  ep_size=1, remat=False)
+    oracle_head = make_vocab_parallel_head(CFG, tp_axis=None)
+
+    def oracle(st, hp, a):
+        x = a
+        for sp in st:
+            x = oracle_block(sp, x)
+        return oracle_head(hp, x, ids)
+
+    ref_loss, (ref_st, ref_h, ref_a) = jax.value_and_grad(
+        oracle, argnums=(0, 1, 2))(stages, head, acts)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-4)
+    _assert_tree_close(g_st, stack_stage_params(ref_st), what="stage grads")
+    _assert_tree_close(g_h, ref_h, what="head grads")
+    _assert_tree_close(dacts, ref_a, what="embed cotangent")
