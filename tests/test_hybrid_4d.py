@@ -35,21 +35,21 @@ def _mesh4(dp=1, fsdp=2, tp=2, pp=2):
     return Mesh(devs.reshape(dp, fsdp, tp, pp), ("dp", "fsdp", "tp", "pp"))
 
 
-def _problem(n_stages, seed=0, batch=8, seq=16):
+def _problem(n_stages, seed=0, batch=8, seq=16, cfg=CFG):
     keys = jax.random.split(jax.random.PRNGKey(seed), n_stages + 3)
-    stages = [init_llama_stage(CFG, keys[i]) for i in range(n_stages)]
-    head = init_llama_head(CFG, keys[n_stages])
+    stages = [init_llama_stage(cfg, keys[i]) for i in range(n_stages)]
+    head = init_llama_head(cfg, keys[n_stages])
     embed = jax.random.normal(keys[n_stages + 1],
-                              (CFG.vocab_size, CFG.hidden_size), jnp.float32)
+                              (cfg.vocab_size, cfg.hidden_size), jnp.float32)
     ids = jax.random.randint(keys[n_stages + 2], (batch, seq), 0,
-                             CFG.vocab_size, jnp.int32)
+                             cfg.vocab_size, jnp.int32)
     acts = embed[ids]
     return stages, head, acts, ids
 
 
-def _reference(stages, head, acts, labels):
+def _reference(stages, head, acts, labels, cfg=CFG):
     def f(st, hp, a):
-        return reference_forward(CFG, st, hp, a, labels)
+        return reference_forward(cfg, st, hp, a, labels)
 
     loss, (g_st, g_h, g_a) = jax.value_and_grad(f, argnums=(0, 1, 2))(
         stages, head, acts)
@@ -65,11 +65,14 @@ def _assert_tree_close(got, want, rtol=2e-3, atol=2e-4, what=""):
             err_msg=f"{what} mismatch at {jax.tree_util.keystr(kp)}")
 
 
-@pytest.mark.parametrize("dp,fsdp", [(1, 2), (2, 1)])
-def test_4d_hybrid_1f1b_matches_unpipelined(dp, fsdp):
-    """dp×fsdp×tp2×pp2 (both data-axis splits): loss, stage grads (fsdp
+@pytest.mark.parametrize("dp,fsdp,sched", [(1, 2, "1f1b"), (2, 1, "1f1b"),
+                                           (1, 2, "zbh1")])
+def test_4d_hybrid_schedule_matches_unpipelined(dp, fsdp, sched):
+    """dp×fsdp×tp2×pp2 (both data-axis splits, 1F1B AND the zero-bubble
+    ZBH1 split-backward schedule): loss, stage grads (fsdp
     reduce-scattered), head grads (vocab-parallel), and embedding cotangent
-    all match the unsharded single-device oracle."""
+    all match the unsharded single-device oracle — ZBH1's BX/BW split ops
+    re-linearize REAL transformer blocks here, not toy matmuls."""
     mesh = _mesh4(dp=dp, fsdp=fsdp)
     stages, head, acts, ids = _problem(n_stages=2)
     block = make_llama_block(CFG, remat=True)
@@ -77,7 +80,7 @@ def test_4d_hybrid_1f1b_matches_unpipelined(dp, fsdp):
 
     loss, g_st, g_h, dacts = spmd_pipeline_train(
         stack_stage_params(stages), head, acts, ids, block, head_fn, mesh,
-        schedule="1f1b", n_microbatches=4, pp_axis="pp",
+        schedule=sched, n_microbatches=4, pp_axis="pp",
         data_axis=("dp", "fsdp"), param_specs=llama_stage_specs(),
         head_specs=llama_head_specs())
 
@@ -264,6 +267,29 @@ def test_moe_experts_inside_pipeline_stages():
 
     ref_loss, (ref_st, ref_h, ref_a) = jax.value_and_grad(
         oracle, argnums=(0, 1, 2))(stages, head, acts)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-4)
+    _assert_tree_close(g_st, stack_stage_params(ref_st), what="stage grads")
+    _assert_tree_close(g_h, ref_h, what="head grads")
+    _assert_tree_close(dacts, ref_a, what="embed cotangent")
+
+
+def test_4d_hybrid_multi_layer_stages():
+    """layers_per_stage > 1: the stage block scans over its layer dim with
+    remat per layer — grads must still match the oracle."""
+    cfg2 = CFG._replace(layers_per_stage=2)
+    mesh = _mesh4()
+    stages, head, acts, ids = _problem(n_stages=2, seed=7, cfg=cfg2)
+    block = make_llama_block(cfg2, remat=True)
+    head_fn = make_vocab_parallel_head(cfg2)
+
+    loss, g_st, g_h, dacts = spmd_pipeline_train(
+        stack_stage_params(stages), head, acts, ids, block, head_fn, mesh,
+        schedule="1f1b", n_microbatches=4, pp_axis="pp",
+        data_axis=("dp", "fsdp"), param_specs=llama_stage_specs(),
+        head_specs=llama_head_specs())
+
+    ref_loss, ref_st, ref_h, ref_a = _reference(stages, head, acts, ids,
+                                                cfg=cfg2)
     np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-4)
     _assert_tree_close(g_st, stack_stage_params(ref_st), what="stage grads")
     _assert_tree_close(g_h, ref_h, what="head grads")
