@@ -314,6 +314,15 @@ class Client {
     return found;
   }
 
+  int del_key(const std::string& key) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint8_t op = DEL;
+    if (!send_all(fd_, &op, 1) || !send_blob(fd_, key)) return -1;
+    uint8_t erased;
+    if (!recv_all(fd_, &erased, 1)) return -1;
+    return erased;
+  }
+
   ~Client() {
     if (fd_ >= 0) ::close(fd_);
   }
@@ -376,6 +385,10 @@ long long tcpstore_add(void* c, const char* key, long long amount) {
 
 int tcpstore_check(void* c, const char* key) {
   return static_cast<Client*>(c)->check(key);
+}
+
+int tcpstore_del(void* c, const char* key) {
+  return static_cast<Client*>(c)->del_key(key);
 }
 
 }  // extern "C"

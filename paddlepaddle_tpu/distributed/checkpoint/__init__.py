@@ -44,6 +44,8 @@ from ...core.tensor import Tensor
 
 _META_NAME = "metadata.json"
 _pending_saves = []
+_path_last_save: Dict[str, threading.Thread] = {}  # write-order chain per path
+_path_last_lock = threading.Lock()
 
 
 @dataclass
@@ -111,11 +113,19 @@ def _unique_shards(arr):
     return list(seen.values())
 
 
-def _rank_meta_name(rank: int) -> str:
-    return f"{_META_NAME}.rank{rank}"
+_save_epochs: Dict[Tuple[str, int], int] = {}  # (path, rank) -> saves issued
 
 
-def _merge_rank_metadata(path: str, world: int, timeout: float) -> None:
+def _rank_meta_name(rank: int, epoch: int = 0) -> str:
+    # epoch-namespaced: two back-to-back saves to the SAME path must not mix
+    # rank records — a coordinator still merging save N could otherwise
+    # consume a fast rank's save-N+1 record (round-3 advisor). save is
+    # collective, so every rank's local per-path counter agrees.
+    return f"{_META_NAME}.e{epoch}.rank{rank}"
+
+
+def _merge_rank_metadata(path: str, world: int, timeout: float,
+                         epoch: int = 0) -> None:
     """Coordinator: wait for every host's rank-metadata file, merge shard
     lists (dedup by global index box — replicated tensors are recorded by
     several hosts), write the final metadata.json
@@ -127,7 +137,7 @@ def _merge_rank_metadata(path: str, world: int, timeout: float) -> None:
         for r in range(world):
             if r in ranks:
                 continue
-            fp = os.path.join(path, _rank_meta_name(r))
+            fp = os.path.join(path, _rank_meta_name(r, epoch))
             if os.path.exists(fp):
                 try:
                     with open(fp) as f:
@@ -139,13 +149,13 @@ def _merge_rank_metadata(path: str, world: int, timeout: float) -> None:
                 missing = [r for r in range(world) if r not in ranks]
                 raise TimeoutError(
                     f"multi-host checkpoint merge: ranks {missing} never "
-                    f"wrote {path}/{_META_NAME}.rank*")
+                    f"wrote {path}/{_META_NAME}.e{epoch}.rank*")
             time.sleep(0.05)
     # consume the rank records: a later save to the SAME path must wait for
     # fresh ones, not merge these stale files while ranks still write data
     for r in range(world):
         try:
-            os.remove(os.path.join(path, _rank_meta_name(r)))
+            os.remove(os.path.join(path, _rank_meta_name(r, epoch)))
         except OSError:
             pass
     meta = {"tensors": {}, "format": "paddlepaddle_tpu.dist_ckpt.v2",
@@ -182,6 +192,8 @@ def save_state_dict(state_dict: Dict[str, object], path: str,
     be ``LocalShards`` (explicit per-host shard lists)."""
     pid = jax.process_index() if process_index is None else process_index
     world = jax.process_count() if process_count is None else process_count
+    epoch = _save_epochs.get((path, pid), 0)
+    _save_epochs[(path, pid)] = epoch + 1
     os.makedirs(path, exist_ok=True)
     meta = {"tensors": {}, "format": "paddlepaddle_tpu.dist_ckpt.v2"}
     items = []  # (fpath, device_or_host_array)
@@ -235,27 +247,47 @@ def save_state_dict(state_dict: Dict[str, object], path: str,
             return
         # rank record LAST: its existence tells the coordinator this
         # host's data files are durably on the shared path
-        tmp = os.path.join(path, _rank_meta_name(pid) + ".tmp")
+        tmp = os.path.join(path, _rank_meta_name(pid, epoch) + ".tmp")
         with open(tmp, "w") as f:
             json.dump(meta, f, indent=1)
-        os.replace(tmp, os.path.join(path, _rank_meta_name(pid)))
+        os.replace(tmp, os.path.join(path, _rank_meta_name(pid, epoch)))
         if pid == coordinator_rank:
-            _merge_rank_metadata(path, world, merge_timeout)
+            _merge_rank_metadata(path, world, merge_timeout, epoch)
 
     if async_save:
         box = {}
-
+        # serialize writers PER PATH: the epoch tag keeps rank *records*
+        # apart, but data files (w.pN.npy) are shared names — a stalled
+        # save-N thread must not overwrite files save-N+1 already declared
+        # final. Each writer joins its predecessor on the same path first.
         def run():
             try:
+                if prev is not None:
+                    prev.join()
                 write()
             except BaseException as e:  # surfaced by wait_all_saves
                 box["error"] = e
+            finally:
+                # don't retain one finished Thread per path forever (the
+                # common save-to-fresh-dir-per-step pattern never chains);
+                # locked check-then-pop so a successor's freshly-registered
+                # entry can't be removed by a finishing predecessor
+                with _path_last_lock:
+                    if _path_last_save.get(path) is t:
+                        _path_last_save.pop(path, None)
 
         t = threading.Thread(target=run, daemon=True)
         t._error_box = box
+        with _path_last_lock:
+            prev = _path_last_save.get(path)
+            _path_last_save[path] = t
         t.start()
         _pending_saves.append(t)
     else:
+        with _path_last_lock:
+            prev = _path_last_save.get(path)
+        if prev is not None:
+            prev.join()  # a sync save must also order after pending async ones
         write()
 
 

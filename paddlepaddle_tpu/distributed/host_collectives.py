@@ -51,14 +51,26 @@ _SLOT_WINDOW = 64
 class HostProcessGroup:
     """Eager collectives for one process per host, keyed through the store.
 
-    Key space is BOUNDED: collective slots are addressed ``seq % 64``. Every
-    collective involves all ranks, so a rank can be at most one op ahead in
-    posting before it must wait on the others — lap distance 2 << 64, no
-    slot can be re-read stale, and the master store's memory stays O(window)
-    instead of growing with step count. Point-to-point send/recv is
-    one-sided (a sender may run arbitrarily far ahead), so p2p keys carry
-    the full per-pair sequence and the receiver tombstones each payload
-    after reading it.
+    Keys carry the FULL group sequence number — two different collectives can
+    never alias, so a fast rank can never read a stale payload (the previous
+    ``seq % window`` addressing broke exactly when a writer lapped a slot
+    whose old key still satisfied the existence-based ``wait``). Memory on
+    the master stays bounded by a windowed garbage-collection protocol:
+
+    * every participant ACKs op ``seq`` once it is done with its payloads
+      (readers after reading; one-sided writers such as a broadcast source
+      right after posting);
+    * the LAST acker — the rank whose atomic ``add`` reaches world_size —
+      deletes the op's data keys, then marks ``done/{seq}``;
+    * before starting op ``seq``, every rank gates on ``done/{seq - window}``,
+      so at most ``window`` ops are ever outstanding, even for one-sided
+      writers (a broadcast source can no longer run unboundedly ahead);
+    * the last acker of op ``seq`` also deletes ``done/{seq - window}`` —
+      by then every rank has passed that gate, so nobody waits on it again.
+
+    Point-to-point send/recv is one-sided (only the pair participates), so
+    p2p keys carry the full per-pair sequence and the receiver deletes each
+    payload after reading it.
     """
 
     def __init__(self, store, rank: int, world_size: int, gid: int = 0):
@@ -68,13 +80,39 @@ class HostProcessGroup:
         self.gid = gid
         self._seq = 0
         self._p2p: dict = {}          # (src, dst) -> per-pair sequence
+        self._posted: dict = {}       # seq -> data tags THIS rank wrote
 
     def _key(self, seq: int, tag: str) -> str:
-        return f"hcoll/{self.gid}/{seq % _SLOT_WINDOW}/{tag}"
+        return f"hcoll/{self.gid}/{seq}/{tag}"
 
     def _next(self) -> int:
+        """Advance the group sequence, gating on the retirement of the op one
+        window back so outstanding state on the master stays O(window).
+
+        Data-key GC rides the gate: ``done/{seq-window}`` existing proves
+        every rank acked that op (all reads finished), so each rank retires
+        the keys IT posted for it here — O(own posts) deletes spread across
+        ranks, off the collective's critical path, instead of one last-acker
+        paying O(world) serial round-trips inside the op."""
         self._seq += 1
+        if self._seq > _SLOT_WINDOW:
+            old = self._seq - _SLOT_WINDOW
+            self.store.wait([self._key(old, "done")])
+            for tag in self._posted.pop(old, ()):
+                self.store.delete_key(self._key(old, tag))
         return self._seq
+
+    def _finish(self, seq: int, posted_tags: List[str]) -> None:
+        """ACK op ``seq``, recording the tags this rank posted for deferred
+        GC; the last acker retires the op's control keys."""
+        if posted_tags:
+            self._posted[seq] = posted_tags
+        n = self.store.add(self._key(seq, "ack"), 1)
+        if n >= self.world_size:
+            self.store.delete_key(self._key(seq, "ack"))
+            self.store.set(self._key(seq, "done"), b"1")
+            if seq > _SLOT_WINDOW:
+                self.store.delete_key(self._key(seq - _SLOT_WINDOW, "done"))
 
     # -- primitives ---------------------------------------------------------
     def all_gather(self, arr: np.ndarray) -> List[np.ndarray]:
@@ -82,7 +120,9 @@ class HostProcessGroup:
         self.store.set(self._key(seq, f"r{self.rank}"), _dumps(arr))
         keys = [self._key(seq, f"r{r}") for r in range(self.world_size)]
         self.store.wait(keys)
-        return [_loads(self.store.get(k)) for k in keys]
+        out = [_loads(self.store.get(k)) for k in keys]
+        self._finish(seq, [f"r{self.rank}"])
+        return out
 
     def all_reduce(self, arr: np.ndarray, op: str = "sum") -> np.ndarray:
         parts = self.all_gather(arr)
@@ -93,9 +133,12 @@ class HostProcessGroup:
         key = self._key(seq, f"src{src}")
         if self.rank == src:
             self.store.set(key, _dumps(arr))
+            self._finish(seq, [f"src{src}"])
             return np.asarray(arr)
         self.store.wait([key])
-        return _loads(self.store.get(key))
+        out = _loads(self.store.get(key))
+        self._finish(seq, [])
+        return out
 
     def scatter(self, parts: Optional[List[np.ndarray]], src: int = 0) -> np.ndarray:
         seq = self._next()
@@ -105,7 +148,10 @@ class HostProcessGroup:
                 self.store.set(self._key(seq, f"d{r}"), _dumps(p))
         key = self._key(seq, f"d{self.rank}")
         self.store.wait([key])
-        return _loads(self.store.get(key))
+        out = _loads(self.store.get(key))
+        self._finish(seq, [f"d{r}" for r in range(self.world_size)]
+                     if self.rank == src else [])
+        return out
 
     def all_to_all(self, parts: List[np.ndarray]) -> List[np.ndarray]:
         seq = self._next()
@@ -115,7 +161,9 @@ class HostProcessGroup:
         keys = [self._key(seq, f"{r}to{self.rank}")
                 for r in range(self.world_size)]
         self.store.wait(keys)
-        return [_loads(self.store.get(k)) for k in keys]
+        out = [_loads(self.store.get(k)) for k in keys]
+        self._finish(seq, [f"{self.rank}to{r}" for r in range(self.world_size)])
+        return out
 
     def _p2p_key(self, src: int, dst: int) -> str:
         # per-pair counter: p2p must NOT touch the group sequence (only the
@@ -131,7 +179,7 @@ class HostProcessGroup:
         key = self._p2p_key(src, self.rank)
         self.store.wait([key])
         out = _loads(self.store.get(key))
-        self.store.set(key, b"")      # tombstone: bound master memory
+        self.store.delete_key(key)    # retire the payload: bound master memory
         return out
 
     def gather_object(self, obj) -> List[object]:
@@ -139,14 +187,16 @@ class HostProcessGroup:
         self.store.set(self._key(seq, f"o{self.rank}"), pickle.dumps(obj))
         keys = [self._key(seq, f"o{r}") for r in range(self.world_size)]
         self.store.wait(keys)
-        return [pickle.loads(self.store.get(k)) for k in keys]
+        out = [pickle.loads(self.store.get(k)) for k in keys]
+        self._finish(seq, [f"o{self.rank}"])
+        return out
 
     def barrier(self) -> None:
+        # the ack/done machinery IS a barrier: done/{seq} appears only after
+        # every rank has acked, and the window gate retires it later
         seq = self._next()
-        count = self.store.add(self._key(seq, "bar"), 1)
-        if count >= self.world_size:
-            self.store.set(self._key(seq, "bar_done"), b"1")
-        self.store.wait([self._key(seq, "bar_done")])
+        self._finish(seq, [])
+        self.store.wait([self._key(seq, "done")])
 
 
 _host_group: Optional[HostProcessGroup] = None

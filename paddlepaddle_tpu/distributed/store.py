@@ -62,6 +62,8 @@ def _load_lib():
         lib.tcpstore_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong]
         lib.tcpstore_check.restype = ctypes.c_int
         lib.tcpstore_check.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.tcpstore_del.restype = ctypes.c_int
+        lib.tcpstore_del.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         _lib = lib
         return _lib
 
@@ -132,6 +134,16 @@ class TCPStore:
         if self._py:
             return all(self._py.check(k) for k in keys)
         return all(self._lib.tcpstore_check(self._client, k.encode()) == 1 for k in keys)
+
+    def delete_key(self, key: str) -> bool:
+        """Erase a key (reference: tcp_store.h deleteKey). Returns True if it
+        existed. Used by host collectives to garbage-collect retired slots."""
+        if self._py:
+            return self._py.delete_key(key)
+        out = self._lib.tcpstore_del(self._client, key.encode())
+        if out < 0:  # transport failure, not 'key absent' — GC must not
+            raise RuntimeError(f"TCPStore.delete_key({key!r}) failed")
+        return out == 1
 
     def wait(self, keys, timeout: Optional[float] = None) -> None:
         from .comm_task import comm_task
@@ -205,6 +217,10 @@ class _PyStore:
                         elif op[0] == 4:  # CHECK
                             with outer._cv:
                                 f.write(b"\x01" if key in outer._data else b"\x00")
+                        elif op[0] == 6:  # DELETE
+                            with outer._cv:
+                                existed = outer._data.pop(key, None) is not None
+                            f.write(b"\x01" if existed else b"\x00")
                         f.flush()
 
             self._srv = socketserver.ThreadingTCPServer((host, port), H)
@@ -242,7 +258,7 @@ class _PyStore:
             if op in (2, 3):
                 (n,) = s.unpack(">I", self._f.read(4))
                 return self._f.read(n)
-            if op == 4:
+            if op in (4, 6):
                 return self._f.read(1)
 
     def set(self, key, value):
@@ -258,6 +274,9 @@ class _PyStore:
 
     def check(self, key):
         return self._req(4, key) == b"\x01"
+
+    def delete_key(self, key):
+        return self._req(6, key) == b"\x01"
 
 
 _global_store: Optional[TCPStore] = None

@@ -94,7 +94,9 @@ class PipelineSchedule:
             "stash": self.V * self.stash_cap * act,
             "inbox_f": self.V * self.inbox_f_cap * act,
             "inbox_b": self.V * self.inbox_b_cap * act,
-            "gstash": (self.V * self.gstash_cap * act
+            # mirrors the executor: V*max(cap,1) entries when the table has
+            # split BX/BW ops, a zero-size buffer otherwise
+            "gstash": (self.V * max(self.gstash_cap, 1) * act
                        if int(self.ops.max()) >= OP_BX else 0),
             "dacts": self.M * act,
         }

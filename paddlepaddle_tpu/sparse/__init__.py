@@ -416,7 +416,11 @@ def _to_sparse_csr(self):
     if isinstance(self, SparseCooTensor):
         return SparseCsrTensor._from_bcsr(
             jsparse.BCSR.from_bcoo(self._coo().sum_duplicates()))
-    return SparseCsrTensor._from_bcsr(jsparse.BCSR.fromdense(self._data))
+    # >2-D: batched CSR, leading dims are batch (reference: 3-D SparseCsrTensor
+    # with per-batch crows, python/paddle/sparse/creation.py)
+    nb = max(0, jnp.ndim(self._data) - 2)
+    return SparseCsrTensor._from_bcsr(
+        jsparse.BCSR.fromdense(self._data, n_batch=nb))
 
 
 Tensor.to_sparse_coo = _to_sparse_coo
@@ -478,8 +482,10 @@ def attention(query, key, value, sparse_mask, key_padding_mask=None,
 
     Reference: paddle.sparse.nn.functional.attention
     (python/paddle/sparse/nn/functional/transformer.py) — q/k/v
-    [batch, heads, seq, head_dim] with a shared CSR mask [seq, seq]. The
-    score matrix only ever exists at the mask's nnz (SDDMM + sparse
+    [batch, heads, seq, head_dim] with a CSR mask of dense shape
+    [batch*heads, seq, seq] (the reference contract); a shared 2-D
+    [seq, seq] mask is also accepted and broadcast over (batch, heads).
+    The score matrix only ever exists at the mask's nnz (SDDMM + sparse
     softmax + spmm), the sparse-transformer memory win."""
     q = jnp.asarray(unwrap(query))
     k = jnp.asarray(unwrap(key))
@@ -487,18 +493,63 @@ def attention(query, key, value, sparse_mask, key_padding_mask=None,
     kpm = None if key_padding_mask is None else jnp.asarray(
         unwrap(key_padding_mask))
     am = None if attn_mask is None else jnp.asarray(unwrap(attn_mask))
-    coo = sparse_mask._coo().sum_duplicates()
+    coo = sparse_mask._coo()
+    if getattr(coo, "n_batch", 0) == 0:
+        coo = coo.sum_duplicates()
     scale = 1.0 / float(np.sqrt(q.shape[-1]))
     if q.ndim == 2:
+        if len(coo.shape) != 2:
+            raise ValueError(
+                f"2-D q/k/v need a 2-D sparse_mask, got shape {coo.shape}")
         return Tensor._from_data(_attention_2d(q, k, v, coo, scale,
                                                kpm=kpm, amask=am))
     if q.ndim == 4:
         b, h = q.shape[0], q.shape[1]
-        outs = [
-            [_attention_2d(q[i, j], k[i, j], v[i, j], coo, scale,
-                           kpm=None if kpm is None else kpm[i],
-                           amask=am)
-             for j in range(h)] for i in range(b)]
+        if len(coo.shape) == 3:
+            # reference contract: per-(batch*head) pattern, first dense dim
+            # indexes the flattened (batch, head) pair
+            if coo.shape[0] != b * h:
+                raise ValueError(
+                    f"3-D sparse_mask first dim {coo.shape[0]} != "
+                    f"batch*heads {b}*{h}")
+            idx = np.asarray(coo.indices)
+            s_q, s_k = coo.shape[1], coo.shape[2]
+            slices = []
+            if getattr(coo, "n_batch", 0) >= 1:
+                # batched layout (from a batched BCSR): indices [bh, nse, 2],
+                # jax pads ragged batches with OUT-OF-RANGE indices — range
+                # alone identifies padding (explicit stored zeros must stay
+                # in the pattern, matching the 2-D path)
+                for bh in range(b * h):
+                    sl = idx[bh]
+                    keep = (sl[:, 0] < s_q) & (sl[:, 1] < s_k)
+                    uniq = np.unique(sl[keep], axis=0)  # dedup like the 2-D
+                    slices.append(jsparse.BCOO(      # path's sum_duplicates
+                        (jnp.ones(len(uniq), q.dtype),
+                         jnp.asarray(uniq)), shape=(s_q, s_k)))
+            else:
+                # flat layout: indices [nnz, 3] = (bh, row, col)
+                for bh in range(b * h):
+                    sel = idx[:, 0] == bh
+                    slices.append(jsparse.BCOO(
+                        (jnp.ones(int(sel.sum()), q.dtype),
+                         jnp.asarray(idx[sel, 1:3])), shape=(s_q, s_k)))
+            outs = [
+                [_attention_2d(q[i, j], k[i, j], v[i, j], slices[i * h + j],
+                               scale,
+                               kpm=None if kpm is None else kpm[i],
+                               amask=am)
+                 for j in range(h)] for i in range(b)]
+        elif len(coo.shape) == 2:
+            outs = [
+                [_attention_2d(q[i, j], k[i, j], v[i, j], coo, scale,
+                               kpm=None if kpm is None else kpm[i],
+                               amask=am)
+                 for j in range(h)] for i in range(b)]
+        else:
+            raise ValueError(
+                f"sparse_mask must be 2-D [s,s] or 3-D [b*h,s,s], got "
+                f"shape {coo.shape}")
         return Tensor._from_data(jnp.stack([jnp.stack(o) for o in outs]))
     raise ValueError("attention expects [s, d] or [b, h, s, d] inputs")
 

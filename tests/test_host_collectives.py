@@ -66,6 +66,32 @@ t = paddle.to_tensor(np.zeros((1,), np.float32))
 dist.scatter(t, parts, src=1)
 np.testing.assert_allclose(t.numpy(), [10.0 if rank == 0 else 20.0])
 
+# LAP REGRESSION (round-3 advisor, high): >window same-tag collectives must
+# return the CURRENT step's payload, never a window-old one. This is the
+# GradScaler pattern — one tiny MAX all_reduce per step, many steps.
+from paddlepaddle_tpu.distributed.host_collectives import get_host_group, _SLOT_WINDOW
+import time
+g = get_host_group()
+steps = _SLOT_WINDOW * 2 + 5
+for step in range(steps):
+    if rank == 1 and step == 0:
+        time.sleep(0.3)               # skew: rank 0 runs ahead into the gate
+    out = g.all_reduce(np.asarray([float(step * 2 + rank)], np.float32), op="max")
+    np.testing.assert_allclose(out, [float(step * 2 + 1)], err_msg=f"step {step}")
+
+# one-sided writer lap: broadcast source posts without reading; the window
+# gate must keep it bounded and every reader must see its own step's value.
+for step in range(steps):
+    if rank == 1 and step == 0:
+        time.sleep(0.3)
+    val = np.asarray([float(step)], np.float32) if rank == 0 else np.zeros(1, np.float32)
+    out = g.broadcast(val, src=0)
+    np.testing.assert_allclose(out, [float(step)], err_msg=f"step {step}")
+
+# barrier must be fresh per invocation (stale bar_done regression)
+for _ in range(3):
+    g.barrier()
+
 print(f"WORKER_{rank}_OK")
 """
 

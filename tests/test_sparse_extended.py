@@ -201,3 +201,38 @@ def test_sparse_attention_key_padding_and_attn_mask():
     p = np.exp(logits - logits.max(-1, keepdims=True))
     p /= p.sum(-1, keepdims=True)
     np.testing.assert_allclose(out, p @ v, rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_attention_3d_mask_per_head():
+    """Reference contract (round-3 advisor, medium): 3-D CSR mask of dense
+    shape [batch*heads, seq, seq] — each (batch, head) slice carries its OWN
+    sparsity pattern (python/paddle/sparse/nn/functional/transformer.py)."""
+    import numpy as np
+
+    import paddlepaddle_tpu as paddle
+    import paddlepaddle_tpu.sparse as sp
+
+    rng = np.random.default_rng(7)
+    b, h, s, d = 2, 2, 8, 16
+    q = rng.standard_normal((b, h, s, d)).astype(np.float32)
+    k = rng.standard_normal((b, h, s, d)).astype(np.float32)
+    v = rng.standard_normal((b, h, s, d)).astype(np.float32)
+    # distinct random pattern per (batch, head); every row keeps >=1 key
+    masks = (rng.random((b * h, s, s)) < 0.5).astype(np.float32)
+    masks[:, np.arange(s), np.arange(s)] = 1.0
+    mcsr = paddle.to_tensor(masks).to_sparse_csr()
+    out = sp.nn.functional.attention(q, k, v, mcsr).numpy()
+    lb = np.einsum("bhsd,bhtd->bhst", q, k) / np.sqrt(d)
+    lb[masks.reshape(b, h, s, s) == 0] = -1e30
+    p = np.exp(lb - lb.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    np.testing.assert_allclose(out, np.einsum("bhst,bhtd->bhsd", p, v),
+                               rtol=1e-4, atol=1e-5)
+
+    # wrong leading dim must raise, not silently misread indices
+    bad = paddle.to_tensor(masks[: b * h - 1]).to_sparse_csr()
+    try:
+        sp.nn.functional.attention(q, k, v, bad)
+        raise AssertionError("expected ValueError for mismatched mask dim")
+    except ValueError:
+        pass
