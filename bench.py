@@ -42,22 +42,69 @@ def _sync(loss):
     return float(loss.numpy() if hasattr(loss, "numpy") else loss)
 
 
-def _time_steps(step, ids, iters):
+def _time_steps(step, ids, iters, batch=None):
+    """Time `iters` train steps, robust to the tunnel's per-call latency.
+
+    Steps are chained INSIDE one jit with lax.scan over the TrainStep's pure
+    step function (a device training loop — standard jax practice), and the
+    per-step time is taken from the SLOPE between a short and a long chain:
+    round 4 measured the tunnel's per-call/sync floor at ~80-130 ms (up from
+    2.8 ms in round 3), so single-dispatch-per-step timing measures the
+    link, not the chip. Inputs stay device-resident (uploads ~16-31 MB/s).
+
+    Params/opt-state are donated through every call and rebound, so peak
+    memory matches the plain step-by-step loop.
+    """
     import jax.numpy as jnp
 
-    # device-resident inputs: the tunnel uploads at ~16-31 MB/s, so a
-    # host->device input transfer inside the timed loop measures the link,
-    # not the chip (real input pipelines prefetch to device; io.DataLoader
-    # does the same on TPU)
-    ids = jnp.asarray(ids)
-    for _ in range(2):  # compile + warm
-        loss = step(ids, ids)
-    _sync(loss)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        loss = step(ids, ids)
-    _sync(loss)
-    return time.perf_counter() - t0, loss
+    if batch is None:
+        ids = jnp.asarray(ids)
+        batch = (ids, ids)
+    else:
+        batch = tuple(jnp.asarray(b) for b in batch)
+    lr = jnp.asarray(step.optimizer.get_lr(), jnp.float32)
+    key0 = jax.random.PRNGKey(0)
+
+    def make(k_steps):
+        def f(p, o):
+            def body(carry, kk):
+                p_, o_ = carry
+                p2, o2, loss = step._step_impl(p_, o_, batch, kk, lr)
+                return (p2, o2), loss
+
+            (pf, of), losses = jax.lax.scan(
+                body, (p, o), jax.random.split(key0, k_steps))
+            return pf, of, losses[-1]
+
+        return jax.jit(f, donate_argnums=(0, 1))
+
+    k_lo, k_hi = 2, max(iters, 4)
+    f_lo, f_hi = make(k_lo), make(k_hi)
+    p, o = step.params, step.opt_state
+
+    def run(f):
+        nonlocal p, o
+        t0 = time.perf_counter()
+        p, o, loss = f(p, o)
+        _sync(loss)
+        return time.perf_counter() - t0, loss
+
+    run(f_lo)  # compile + warm
+    run(f_hi)
+    best_lo, best_hi = float("inf"), float("inf")
+    for _ in range(3):
+        d_lo, loss = run(f_lo)
+        d_hi, loss = run(f_hi)
+        best_lo = min(best_lo, d_lo)
+        best_hi = min(best_hi, d_hi)
+    step.params, step.opt_state = p, o  # keep the TrainStep consistent
+    per_step = (best_hi - best_lo) / (k_hi - k_lo)
+    if per_step <= 0:
+        # contention noise beat the slope — fall back to the long chain's
+        # per-step average (includes one call floor: a conservative
+        # UPPER bound on step time, never an inflated rate)
+        per_step = best_hi / k_hi
+    return per_step * iters, loss
 
 
 def _bench_llama(cfg, batch, seq, iters, peak):
@@ -81,7 +128,7 @@ def _bench_llama(cfg, batch, seq, iters, peak):
         "params": n,
         "tokens_per_sec": round(tokens_per_sec, 1),
         "mfu": round(tokens_per_sec * model_flops / peak, 4),
-        "final_loss": round(float(loss.numpy()), 4),
+        "final_loss": round(_sync(loss), 4),
         "batch": batch, "seq": seq,
     }
     if cfg.recompute:
@@ -162,19 +209,18 @@ def _bench_moe(peak, on_accel):
         "params_total": total, "params_active": active,
         "tokens_per_sec": round(tokens_per_sec, 1),
         "mfu_active": round(tokens_per_sec * flops_per_token / peak, 4),
-        "final_loss": round(float(loss.numpy()), 4),
+        "final_loss": round(_sync(loss), 4),
         "experts": cfg.num_experts, "topk": cfg.num_experts_per_tok,
     }
 
 
 def _bench_resnet50(peak, on_accel):
-    """bf16 b128: the knobs that moved it (all measured, see BASELINE.md):
-    hard-sync timing + device-resident inputs (round-2's 14.6% was an async
-    artifact; the tunnel uploads at ~16 MB/s), bf16 cast (~1.35x), batch
-    128 (~2.2x over b32 — amortizes fixed per-op cost and fills the MXU).
-    ~10% model-MFU saturates this platform's conv emitter: chained-conv
-    microbench ceilings at 14-23 TF/s bf16 across ResNet stage shapes while
-    plain matmuls reach 73+ TF/s, and im2col-as-matmul does not beat it."""
+    """bf16 b128, measured honestly (BASELINE.md + tools/resnet_ablation.py):
+    device-resident inputs, scan-chained steps, slope timing. Round-4 wins:
+    one-pass fused BatchNorm stats (BN was ~30 ms of the 56 ms step; the
+    convs themselves run at 150-200 TF/s here — the old '14-23 TF/s conv
+    emitter ceiling' was a round-3 mismeasurement) and reusing the forward
+    stats for the running-average update instead of recomputing them."""
     from paddlepaddle_tpu.jit.train import TrainStep
     from paddlepaddle_tpu.models.resnet import resnet50
     from paddlepaddle_tpu.nn.functional import cross_entropy
@@ -188,24 +234,17 @@ def _bench_resnet50(peak, on_accel):
                    parameters=model.parameters())
     step = TrainStep(model, opt,
                      lambda m, x, y: cross_entropy(m(x), y).mean())
-    batch, iters = 128, 5
+    batch, iters = 128, 6
     rng = np.random.default_rng(0)
     imgs = rng.standard_normal((batch, 3, 224, 224)).astype(np.float32)
     labels = rng.integers(0, 1000, (batch,)).astype(np.int64)
 
     import jax.numpy as jnp
 
-    imgs = jnp.asarray(imgs, jnp.bfloat16)  # match the model dtype
-    labels = jnp.asarray(labels)
     try:
-        for _ in range(2):
-            loss = step(imgs, labels)
-        _sync(loss)
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            loss = step(imgs, labels)
-        _sync(loss)
-        dt = time.perf_counter() - t0
+        dt, loss = _time_steps(
+            step, None, iters,
+            batch=(jnp.asarray(imgs, jnp.bfloat16), jnp.asarray(labels)))
     except Exception as e:
         if _is_oom(e):
             return {"error": "OOM"}
@@ -217,7 +256,7 @@ def _bench_resnet50(peak, on_accel):
         "images_per_sec": round(imgs_per_sec, 1),
         "step_ms": round(step_ms, 2),
         "mfu_approx": round(imgs_per_sec * 3 * 4.1e9 / peak, 4),
-        "final_loss": round(float(loss.numpy()), 4),
+        "final_loss": round(_sync(loss), 4),
         "batch": batch,
     }
 

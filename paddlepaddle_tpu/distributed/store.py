@@ -276,7 +276,11 @@ class _PyStore:
         return self._req(4, key) == b"\x01"
 
     def delete_key(self, key):
-        return self._req(6, key) == b"\x01"
+        out = self._req(6, key)
+        if out not in (b"\x00", b"\x01"):  # short read = transport failure,
+            raise RuntimeError(            # never 'key absent' (GC relies on it)
+                f"PyStore.delete_key({key!r}) transport failure")
+        return out == b"\x01"
 
 
 _global_store: Optional[TCPStore] = None

@@ -325,33 +325,48 @@ def batch_norm(
 ):
     ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
     use_batch_stats = training and not use_global_stats
+    stats_box = {}
 
     def f(a, w, b, rm, rv):
         sh = [1] * a.ndim
         sh[ch_axis] = a.shape[ch_axis]
         axes = tuple(i for i in range(a.ndim) if i != ch_axis)
         if use_batch_stats:
-            mean = jnp.mean(a.astype(jnp.float32), axis=axes)
-            var = jnp.var(a.astype(jnp.float32), axis=axes)
+            # ONE data pass for both stats (multi-output reduction fusion):
+            # var = E[x^2] - E[x]^2, the classic fused-BN trade
+            # (cuDNN/TF fused_batch_norm use the same formula) — jnp.var
+            # would re-read the activation a second time. Accumulation is
+            # f32; cancellation only bites when |mean| >> std, which
+            # post-conv activations don't exhibit (and bf16 inputs carry
+            # 8 mantissa bits anyway). Reference role:
+            # paddle/phi/kernels/gpu/batch_norm_kernel.cu block reduce.
+            af = a.astype(jnp.float32)
+            mean = jnp.mean(af, axis=axes)
+            sq = jnp.mean(af * af, axis=axes)
+            var = jnp.maximum(sq - mean * mean, 0.0)
+            stats_box["mean"], stats_box["var"] = mean, var
         else:
             mean, var = rm, rv
-        out = (a.astype(jnp.float32) - mean.reshape(sh)) * jax.lax.rsqrt(var.reshape(sh) + epsilon)
-        out = out.astype(a.dtype)
+        # fold (mean, var, gamma, beta) into per-channel scale/shift so the
+        # normalize is ONE fused multiply-add pass over the activation
+        scale = jax.lax.rsqrt(var + epsilon)
         if w is not None:
-            out = out * w.reshape(sh)
+            scale = scale * w.astype(jnp.float32)
+        shift = -mean * scale
         if b is not None:
-            out = out + b.reshape(sh)
-        return out
+            shift = shift + b.astype(jnp.float32)
+        return (a.astype(jnp.float32) * scale.reshape(sh)
+                + shift.reshape(sh)).astype(a.dtype)
 
     out = apply_op(f, x, weight, bias, running_mean, running_var, op_name="batch_norm")
 
     if use_batch_stats and isinstance(running_mean, Tensor):
-        # update running stats in place (reference batch_norm_kernel semantics)
-        a = unwrap(x).astype(jnp.float32)
-        axes = tuple(i for i in range(a.ndim) if i != ch_axis)
-        mean = jnp.mean(a, axis=axes)
-        n = np.prod([a.shape[i] for i in axes])
-        var_unbiased = jnp.var(a, axis=axes) * (n / max(n - 1, 1))
+        # update running stats in place (reference batch_norm_kernel
+        # semantics), REUSING the stats already computed in the forward pass
+        axes = tuple(i for i in range(unwrap(x).ndim) if i != ch_axis)
+        n = np.prod([unwrap(x).shape[i] for i in axes])
+        mean = stats_box["mean"]
+        var_unbiased = stats_box["var"] * (n / max(n - 1, 1))
         running_mean._replace_data(
             (momentum * running_mean._data + (1 - momentum) * mean).astype(running_mean.dtype)
         )

@@ -192,9 +192,9 @@ def spmd_pipeline_train(stacked_params, head_params, acts, labels,
     chs_t = jnp.asarray(sched.chunks)
     arr = tuple(jnp.asarray(a) for a in _arrival_tables(sched))
     Cs, Cf, Cb = sched.stash_cap, sched.inbox_f_cap, sched.inbox_b_cap
-    # schedules without split BX/BW ops never touch the gstash — give them a
-    # zero-size buffer instead of V*max(cap,1) live activation entries
-    Cg = max(sched.gstash_cap, 1) if int(sched.ops.max()) >= OP_BX else 0
+    # schedules without split BX/BW ops never touch the gstash — zero-size
+    # buffer (gstash_entries is the shared executor/estimate predicate)
+    Cg = sched.gstash_entries
     up_perm = [(i, (i + 1) % S) for i in range(S)]
     down_perm = [(i, (i - 1) % S) for i in range(S)]
 

@@ -79,6 +79,19 @@ class PipelineSchedule:
     def num_virtual(self) -> int:
         return self.V * self.S
 
+    @property
+    def has_split_backward(self) -> bool:
+        """True when the table carries zero-bubble BX/BW ops — the single
+        predicate both the executor's gstash allocation and
+        memory_estimate key off (keep them in lockstep)."""
+        return int(self.ops.max()) >= OP_BX
+
+    @property
+    def gstash_entries(self) -> int:
+        """Gstash entries per (device, chunk) the executor actually
+        allocates: max(cap, 1) with split ops, zero-size otherwise."""
+        return max(self.gstash_cap, 1) if self.has_split_backward else 0
+
     def memory_estimate(self, act_shape: Tuple[int, ...],
                         dtype_bytes: int = 2) -> Dict[str, int]:
         """Executor buffer bytes PER DEVICE for a microbatch activation of
@@ -94,10 +107,7 @@ class PipelineSchedule:
             "stash": self.V * self.stash_cap * act,
             "inbox_f": self.V * self.inbox_f_cap * act,
             "inbox_b": self.V * self.inbox_b_cap * act,
-            # mirrors the executor: V*max(cap,1) entries when the table has
-            # split BX/BW ops, a zero-size buffer otherwise
-            "gstash": (self.V * max(self.gstash_cap, 1) * act
-                       if int(self.ops.max()) >= OP_BX else 0),
+            "gstash": self.V * self.gstash_entries * act,
             "dacts": self.M * act,
         }
         out["total"] = sum(out.values())
@@ -491,9 +501,100 @@ def build_zbh1(S: int, M: int) -> PipelineSchedule:
     return _pack(events, S, M, 1)
 
 
+def build_zbvpp(S: int, M: int, V: int) -> PipelineSchedule:
+    """ZBVPP (zero-bubble interleaved): VPP's virtual-stage order with every
+    inner backward SPLIT into BX (input grad, critical path) and BW (weight
+    grad, fills bubbles) — the last entry in the reference's schedule zoo
+    (passes/pipeline_scheduler_pass/pipeline_zero_bubble.py:151,
+    VPP job order + F/B/W split).
+
+    Construction: each device walks its VPP order (warmup forwards, then
+    F/B alternation over chunks — _device_order), with B meaning BX; a slot
+    where the ordered op must stall on a dependency is filled with the
+    oldest pending BW instead of idling, and a BW is forced ahead of a
+    forward whenever the activation stash would exceed the VPP bound + 1 —
+    the ZBH1 memory contract lifted to V chunks. The exact validator
+    certifies dependencies and computes the true buffer caps.
+
+    Same remat economics as ZBH1 (each split op re-linearizes the block);
+    tools/pipeline_bubble_bench.py measures both bubble and wall-clock.
+    """
+    if M % S:
+        raise ValueError(f"zbvpp needs M % S == 0, got M={M} S={S}")
+    if V < 2:
+        raise ValueError("zbvpp is the V>1 zero-bubble schedule; use zbh1 for V=1")
+    G = V * S
+    # memory contract: per-(device, chunk) stash bound = VPP's + 1, so the
+    # executor buffers match interleaved 1F1B's up to one extra entry
+    vpp_cap = build_1f1b(S, M, V=V).stash_cap
+    stash_target = vpp_cap + 1
+    orders = [_device_order(S, M, V, s) for s in range(S)]
+    pos = [0] * S
+    doneF: Dict[Tuple[int, int], int] = {}
+    doneBX: Dict[Tuple[int, int], int] = {}
+    pending_bw: List[List[Tuple[int, int]]] = [[] for _ in range(S)]
+    stash_now = [[0] * V for _ in range(S)]   # per (device, chunk)
+    events: List[Tuple[int, int, int, int, int]] = []
+    t = 0
+    limit = 8 * (3 * M * V + S) + 64
+
+    def emit_bw(t, s, chunk=None):
+        """Retire the oldest pending weight-grad (preferring ``chunk`` when a
+        specific chunk's stash needs shrinking)."""
+        i = 0
+        if chunk is not None:
+            for j, (_, cj) in enumerate(pending_bw[s]):
+                if cj == chunk:
+                    i = j
+                    break
+        m, c = pending_bw[s].pop(i)
+        g = c * S + s
+        events.append((t, s, OP_BW_LAST if g == G - 1 else OP_BW, m, c))
+        stash_now[s][c] -= 1
+
+    while any(pos[s] < len(orders[s]) or pending_bw[s] for s in range(S)) \
+            and t < limit:
+        for s in range(S):
+            if pos[s] >= len(orders[s]):
+                if pending_bw[s]:
+                    emit_bw(t, s)
+                continue
+            kind, m, c = orders[s][pos[s]]
+            g = c * S + s
+            if kind == "B":
+                ready = (doneF.get((m, g), t) <= t - 1
+                         and (g == G - 1 or doneBX.get((m, g + 1), t) <= t - 1))
+                if ready:
+                    events.append(
+                        (t, s, OP_BX_LAST if g == G - 1 else OP_BX, m, c))
+                    doneBX[(m, g)] = t
+                    pending_bw[s].append((m, c))
+                    pos[s] += 1
+                elif pending_bw[s]:
+                    emit_bw(t, s)   # fill the stall with weight-grad work
+                continue
+            # kind == "F"
+            if stash_now[s][c] >= stash_target and any(
+                    cj == c for _, cj in pending_bw[s]):
+                emit_bw(t, s, chunk=c)  # memory bound: retire this chunk first
+                continue
+            ready = g == 0 or doneF.get((m, g - 1), t) <= t - 1
+            if ready:
+                events.append((t, s, OP_F, m, c))
+                doneF[(m, g)] = t
+                stash_now[s][c] += 1
+                pos[s] += 1
+            elif pending_bw[s]:
+                emit_bw(t, s)
+        t += 1
+    if any(pos[s] < len(orders[s]) or pending_bw[s] for s in range(S)):
+        raise RuntimeError(f"zbvpp scheduler deadlocked (S={S}, M={M}, V={V})")
+    return _pack(events, S, M, V)
+
+
 def build_schedule(name: str, S: int, M: int, V: int = 1) -> PipelineSchedule:
     """Schedule zoo entry point: 'gpipe'/'FThenB', '1f1b',
-    'interleaved'/'vpp', 'zbh1'/'zero-bubble'."""
+    'interleaved'/'vpp', 'zbh1'/'zero-bubble', 'zbvpp'."""
     key = name.lower()
     if key in ("gpipe", "fthenb", "f_then_b"):
         if V != 1:
@@ -508,6 +609,9 @@ def build_schedule(name: str, S: int, M: int, V: int = 1) -> PipelineSchedule:
         return build_1f1b(S, M, V=V)
     if key in ("zbh1", "zb", "zero-bubble"):
         if V != 1:
-            raise ValueError("zbh1 is a V=1 schedule (ZBV is not implemented)")
+            raise ValueError("zbh1 is a V=1 schedule; use 'zbvpp' for V>1")
         return build_zbh1(S, M)
+    if key in ("zbvpp", "zbv", "zero-bubble-vpp"):
+        return build_zbvpp(S, M, V=V)  # V<2 raises: the caller's stage
+        # layout must match the chunk count, so no silent coercion
     raise ValueError(f"unknown schedule {name!r}")

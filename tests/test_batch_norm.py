@@ -1,0 +1,106 @@
+"""BatchNorm numerics: training/eval forward, running stats, backward.
+
+Reference semantics: python/paddle/nn/functional/norm.py batch_norm +
+paddle/phi/kernels/batch_norm_kernel (biased batch var normalizes the
+output; the running-var update uses the unbiased estimate)."""
+
+import numpy as np
+import pytest
+
+
+def _np_bn_train(x, gamma, beta, eps):
+    axes = (0, 2, 3)
+    mean = x.mean(axes)
+    var = x.var(axes)  # biased
+    xhat = (x - mean[None, :, None, None]) / np.sqrt(var[None, :, None, None] + eps)
+    return xhat * gamma[None, :, None, None] + beta[None, :, None, None], mean, var
+
+
+def test_batch_norm_train_forward_and_running_stats():
+    import paddlepaddle_tpu as paddle
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((4, 3, 5, 5)).astype(np.float32) * 2 + 1.5
+    bn = paddle.nn.BatchNorm2D(3, momentum=0.8)
+    bn.train()
+    gamma = bn.weight.numpy()
+    beta = bn.bias.numpy()
+    out = bn(paddle.to_tensor(x)).numpy()
+    ref, mean, var = _np_bn_train(x, gamma, beta, 1e-5)
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+    # running stats: momentum * old + (1-momentum) * batch (var unbiased)
+    n = x.shape[0] * x.shape[2] * x.shape[3]
+    np.testing.assert_allclose(bn._mean.numpy(), 0.2 * mean, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(bn._variance.numpy(),
+                               0.8 * 1.0 + 0.2 * var * n / (n - 1),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_batch_norm_eval_uses_running_stats():
+    import paddlepaddle_tpu as paddle
+
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((2, 3, 4, 4)).astype(np.float32)
+    bn = paddle.nn.BatchNorm2D(3)
+    bn.eval()
+    out = bn(paddle.to_tensor(x)).numpy()
+    # fresh running stats are mean 0 / var 1 -> identity (gamma=1, beta=0)
+    np.testing.assert_allclose(out, x / np.sqrt(1 + 1e-5), rtol=1e-5, atol=1e-5)
+
+
+def test_batch_norm_backward_matches_autodiff_reference():
+    import jax
+    import jax.numpy as jnp
+
+    import paddlepaddle_tpu as paddle
+    import paddlepaddle_tpu.nn.functional as F
+
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((4, 3, 6, 6)).astype(np.float32)
+    g = rng.standard_normal(3).astype(np.float32)
+    b = rng.standard_normal(3).astype(np.float32)
+
+    def ours(xx):
+        xt = paddle.to_tensor(xx)
+        xt.stop_gradient = False
+        out = F.batch_norm(xt, paddle.to_tensor(np.zeros(3, np.float32)),
+                           paddle.to_tensor(np.ones(3, np.float32)),
+                           paddle.to_tensor(g), paddle.to_tensor(b),
+                           training=True)
+        loss = (out * out).sum()
+        loss.backward()
+        return xt.grad.numpy()
+
+    def ref_loss(xx):
+        axes = (0, 2, 3)
+        mean = jnp.mean(xx, axis=axes, keepdims=True)
+        var = jnp.mean((xx - mean) ** 2, axis=axes, keepdims=True)
+        xhat = (xx - mean) * jax.lax.rsqrt(var + 1e-5)
+        out = xhat * g[None, :, None, None] + b[None, :, None, None]
+        return (out * out).sum()
+
+    got = ours(x)
+    want = jax.grad(ref_loss)(jnp.asarray(x))
+    np.testing.assert_allclose(got, np.asarray(want), rtol=2e-3, atol=2e-3)
+
+
+def test_batch_norm_nhwc_and_1d():
+    import paddlepaddle_tpu as paddle
+
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((4, 5, 5, 3)).astype(np.float32)
+    bn = paddle.nn.BatchNorm2D(3, data_format="NHWC")
+    bn.train()
+    out = bn(paddle.to_tensor(x)).numpy()
+    ref, _, _ = _np_bn_train(np.transpose(x, (0, 3, 1, 2)),
+                             bn.weight.numpy(), bn.bias.numpy(), 1e-5)
+    np.testing.assert_allclose(out, np.transpose(ref, (0, 2, 3, 1)),
+                               rtol=2e-4, atol=2e-4)
+
+    x1 = rng.standard_normal((8, 3)).astype(np.float32)
+    bn1 = paddle.nn.BatchNorm1D(3)
+    bn1.train()
+    out1 = bn1(paddle.to_tensor(x1)).numpy()
+    m, v = x1.mean(0), x1.var(0)
+    ref1 = (x1 - m) / np.sqrt(v + 1e-5) * bn1.weight.numpy() + bn1.bias.numpy()
+    np.testing.assert_allclose(out1, ref1, rtol=2e-4, atol=2e-4)

@@ -70,6 +70,27 @@ def test_zbh1_beats_1f1b_bubble_at_near_equal_memory():
         assert ((ops == OP_BW) | (ops == OP_BW_LAST)).sum() == M * S
 
 
+def test_zbvpp_beats_vpp_bubble_at_near_equal_memory():
+    """ZBVPP = interleaved VPP with split B, the last schedule in the
+    reference zoo (pipeline_zero_bubble.py:151): bubble strictly below
+    VPP's at the same per-chunk stash bound + 1, with complete F/BX/BW
+    coverage certified by the exact validator."""
+    from paddlepaddle_tpu.parallel.schedules import (OP_BW, OP_BW_LAST,
+                                                     OP_BX, OP_BX_LAST,
+                                                     OP_F, build_schedule)
+
+    for S, M, V in [(2, 4, 2), (4, 8, 2), (4, 16, 2), (4, 16, 4), (2, 8, 3)]:
+        z = build_schedule("zbvpp", S, M, V)
+        v = build_schedule("vpp", S, M, V)
+        assert z.stats["bubble_fraction"] < v.stats["bubble_fraction"], (S, M, V)
+        assert z.stash_cap <= v.stash_cap + 1, (S, M, V, z.stash_cap)
+        ops = z.ops
+        G = S * V
+        assert (ops == OP_F).sum() == M * G
+        assert ((ops == OP_BX) | (ops == OP_BX_LAST)).sum() == M * G
+        assert ((ops == OP_BW) | (ops == OP_BW_LAST)).sum() == M * G
+
+
 def test_validate_rejects_modular_slot_collision():
     """A dependency-legal but out-of-order schedule whose live microbatches
     collide in the executor's m%cap addressing must be rejected, not
@@ -149,7 +170,8 @@ def _serial(stages, hp, x, y):
 
 
 @pytest.mark.parametrize("name,V", [("1f1b", 1), ("gpipe", 1),
-                                    ("interleaved", 2), ("zbh1", 1)])
+                                    ("interleaved", 2), ("zbh1", 1),
+                                    ("zbvpp", 2)])
 def test_pipeline_train_matches_serial(name, V):
     import jax
     import jax.numpy as jnp

@@ -69,7 +69,7 @@ def main():
 
     results = []
     for name, V in [("gpipe", 1), ("1f1b", 1), ("interleaved", 2),
-                    ("zbh1", 1)]:
+                    ("zbh1", 1), ("zbvpp", 2)]:
         G = V * S
         per_virtual = depth // G  # layers per virtual stage: equal total depth
         layers = [mklayer(g) for g in range(G)]
