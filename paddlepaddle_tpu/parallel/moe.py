@@ -347,8 +347,11 @@ def _gathered_capacity_moe_ffn(x, logits, wg, wu, wd, topk, capacity):
     slot_entry = sidx[jnp.clip(offs[e_of_slot] + c_of_slot, 0, N - 1)]
     xin = _slot_dispatch(x, slot_entry, slot_valid, slots_of_entry,
                          topk).reshape(E, C, d)
-    hmid = jax.nn.silu(jnp.einsum("ecd,edh->ech", xin, wg)) \
-        * jnp.einsum("ecd,edh->ech", xin, wu)
+    # gate+up fused into ONE batched matmul (halves dispatch/epilogue count;
+    # the concat is a cheap weight-side copy XLA folds into the operand read)
+    h = wg.shape[-1]
+    gu = jnp.einsum("ecd,edh->ech", xin, jnp.concatenate([wg, wu], axis=-1))
+    hmid = jax.nn.silu(gu[..., :h]) * gu[..., h:]
     out = jnp.einsum("ech,ehd->ecd", hmid, wd).reshape(E * C, d)
     contrib = _slot_combine(out, slots_of_entry, slot_entry, slot_valid)
     y = (contrib * jnp.swapaxes(gate_vals, 0, 1).astype(x.dtype)[..., None]
@@ -356,31 +359,97 @@ def _gathered_capacity_moe_ffn(x, logits, wg, wu, wd, topk, capacity):
     return y, aux
 
 
-def _dropless_moe_ffn(x, logits, wg, wu, wd, topk):
-    """Dropless grouped-matmul dispatch — the single-chip perf path.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _dispatch_gather_pad(x, sidx_pad, dest_pad, k):
+    """Padded-slot dispatch: slot s holds x[token of entry sidx_pad[s]],
+    zeros in alignment-padding slots (sidx_pad == N sentinel). Both
+    directions are gathers, like _dispatch_gather."""
+    T = x.shape[0]
+    N = T * k
+    valid = sidx_pad < N
+    return jnp.where(valid[:, None], x[sidx_pad % T], 0)
+
+
+def _dispatch_gather_pad_fwd(x, sidx_pad, dest_pad, k):
+    return _dispatch_gather_pad(x, sidx_pad, dest_pad, k), dest_pad
+
+
+def _dispatch_gather_pad_bwd(k, dest_pad, dxin):
+    dx = dxin[dest_pad].reshape(k, -1, dxin.shape[-1]).sum(0)
+    return dx.astype(dxin.dtype), None, None
+
+
+_dispatch_gather_pad.defvjp(_dispatch_gather_pad_fwd, _dispatch_gather_pad_bwd)
+
+
+@jax.custom_vjp
+def _combine_gather_pad(out, sidx_pad, dest_pad):
+    """entry i reads its padded slot; vjp scatters entry cotangents back to
+    slots as a gather by sidx_pad (zero into padding slots)."""
+    return out[dest_pad]
+
+
+def _combine_gather_pad_fwd(out, sidx_pad, dest_pad):
+    return out[dest_pad], sidx_pad
+
+
+def _combine_gather_pad_bwd(sidx_pad, dy):
+    dpad = jnp.concatenate([dy, jnp.zeros((1, dy.shape[1]), dy.dtype)])
+    idx = jnp.minimum(sidx_pad, dy.shape[0])       # sentinel -> zero row
+    return dpad[idx].astype(dy.dtype), None, None
+
+
+_combine_gather_pad.defvjp(_combine_gather_pad_fwd, _combine_gather_pad_bwd)
+
+
+def _dropless_moe_ffn(x, logits, wg, wu, wd, topk, align=1):
+    """Dropless grouped-matmul dispatch (no capacity bound, no token drops).
 
     Megablox/dropless-MoE formulation (arXiv:2211.15841): tokens sorted by
     expert via counting sort, expert FFNs as ``lax.ragged_dot`` grouped
-    matmuls over the contiguous groups (no capacity buffers, no token
-    dropping), combine by inverse-permutation gather. Every index op is a
-    gather in BOTH directions (custom vjps above), and routing avoids
-    lax.sort/top_k entirely. Full-model: 125.1 ms/step vs einsum's 179.2;
-    the capacity path below is faster still (110.9) because ragged_dot
-    carries ~2.5 ms/layer of per-group overhead vs a static batched einsum
-    (tools/moe_dispatch_bench.py).
+    matmuls over the contiguous groups, combine by inverse-permutation
+    gather. Every index op is a gather in BOTH directions (custom vjps
+    above), and routing avoids lax.sort/top_k entirely.
+
+    ``align`` > 1 pads group boundaries to multiples of ``align`` (zero
+    rows) so each ragged group starts on an MXU tile boundary — megablox
+    pads its block-diagonal groups the same way. Measured NEUTRAL at 128
+    on the full model (the 12.5% extra rows offset the tile win), so the
+    default is 1; the knob stays because the trade-off is shape-dependent
+    (parity across aligns is tested in tests/test_moe.py).
 
     Returns (y [T, d], aux_loss).
     """
     T, d = x.shape
     E = wg.shape[0]
+    N = T * topk
     gate_vals, expert_idx, aux = _route_topk_iter(logits, topk, E)
     fe = expert_idx.T.reshape(-1)          # round-major (j = r*T + t)
-    dest, sidx, counts, _ = _counting_sort(fe, E)
-    xin = _dispatch_gather(x, sidx, dest, topk)
+    dest, sidx, counts, offs = _counting_sort(fe, E)
+    if align > 1:
+        n_pad = N + E * align              # static upper bound
+        counts_p = ((counts + align - 1) // align) * align
+        counts_p = counts_p.at[-1].add(
+            jnp.int32(n_pad) - counts_p.sum().astype(jnp.int32))  # absorb slack
+        offs_p = jnp.concatenate([jnp.zeros((1,), counts_p.dtype),
+                                  jnp.cumsum(counts_p)[:-1]]).astype(jnp.int32)
+        dest = (offs_p[fe] + (dest - offs[fe])).astype(jnp.int32)
+        sidx = jnp.full((n_pad,), N, jnp.int32).at[dest].set(
+            jnp.arange(N, dtype=jnp.int32))
+        counts = counts_p
+        xin = _dispatch_gather_pad(x, sidx, dest, topk)
+    else:
+        xin = _dispatch_gather(x, sidx, dest, topk)
+    # NOT fused gate|up here: a concatenated [E, d, 2h] ragged_dot measured
+    # SLOWER than two separate calls (97.8 vs 90.9 ms/step full-model),
+    # unlike the capacity path's batched einsum where the fusion wins
     hmid = jax.nn.silu(jax.lax.ragged_dot(xin, wg, counts)) \
         * jax.lax.ragged_dot(xin, wu, counts)
     out = jax.lax.ragged_dot(hmid, wd, counts)
-    contrib = _combine_gather(out, sidx, dest).reshape(topk, T, d)
+    if align > 1:
+        contrib = _combine_gather_pad(out, sidx, dest).reshape(topk, T, d)
+    else:
+        contrib = _combine_gather(out, sidx, dest).reshape(topk, T, d)
     y = (contrib * jnp.swapaxes(gate_vals, 0, 1).astype(x.dtype)[..., None]
          ).sum(0)
     return y, aux
@@ -392,16 +461,22 @@ class MoELayer(Layer):
     Expert weights are stacked Parameters [E, ...] with dist_spec ('ep', ...)
     so ShardedTrainStep places one expert group per ep shard.
 
-    ``dispatch_mode`` (full-model 16e/top-2 train-step numbers from
-    tools/moe_dispatch_bench.py, TPU v5e, bf16):
+    ``dispatch_mode`` (full-model 16e/top-2 train-step numbers, TPU v5e
+    bf16, round-4 slope-timed harness — see BASELINE.md):
       * "sorted" (default) — counting-sort routing into STATIC capacity
-        buffers run as batched einsums, gather-only vjps (the reference
-        fused-MoE capacity semantics, 110.9 ms/step): the single-chip perf
-        path. Tokens beyond ``capacity_factor`` per expert are dropped.
+        buffers run as batched einsums with a fused gate|up projection,
+        gather-only vjps (the reference fused-MoE capacity semantics,
+        85.2 ms/step): the single-chip perf path. Tokens beyond
+        ``capacity_factor`` per expert are dropped.
       * "dropless" — same routing, ``lax.ragged_dot`` grouped matmuls, no
-        capacity bound / no drops (125.1 ms/step) — trade ~13% step time
-        for exact routing.
-      * "einsum" — GShard one-hot dispatch/combine einsums (179.2 ms/step);
+        capacity bound / no drops (91-98 ms/step) — trade ~10% step time
+        for exact routing. Attacked in round 4 and kept non-default on
+        the numbers: 128-aligned group boundaries measured neutral,
+        a fused gate|up concat measured SLOWER (97.8 vs 90.9), and a
+        fixed-assignment ablation shows routing+dispatch costs 11.5
+        ms/step for EITHER path — ragged_dot's remaining deficit vs the
+        static batched einsum is intrinsic on this platform.
+      * "einsum" — GShard one-hot dispatch/combine einsums (~2x sorted);
         XLA's SPMD partitioner turns the token-expert contraction into the
         ICI all_to_all, the cleanest multi-chip ep-sharded lowering — use
         this when sharding the expert bank over an ep mesh axis.
