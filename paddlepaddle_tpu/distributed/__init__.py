@@ -15,6 +15,8 @@ from .collective import (  # noqa: F401
     barrier,
     broadcast,
     get_group,
+    irecv,
+    isend,
     new_group,
     recv,
     reduce,
@@ -31,6 +33,7 @@ from .env import (  # noqa: F401
 )
 from . import checkpoint  # noqa: F401
 from . import communication  # noqa: F401
+from .communication import P2POp, batch_isend_irecv  # noqa: F401
 from . import rpc  # noqa: F401
 from .auto_tuner import AutoTuner, TuneConfig  # noqa: F401
 from .watchdog import Watchdog  # noqa: F401

@@ -253,6 +253,41 @@ def recv(tensor, src=0, group=None, sync_op=True):
     return _set_inplace(tensor, g.recv(src=src))
 
 
+class _P2PTask:
+    """Reference task handle (core.task). ``work`` runs lazily on the first
+    wait(); a send completes eagerly (the store-buffered transport never
+    blocks a sender) so its task carries no work."""
+
+    def __init__(self, work=None):
+        self._work = work
+        self._done = work is None
+
+    def wait(self):
+        if not self._done:
+            self._work()
+            self._done = True
+        return True
+
+    def is_completed(self):
+        return self._done
+
+
+def isend(tensor, dst=0, group=None):
+    """Reference: communication/send.py isend — returns a waitable task.
+    The store-buffered send never blocks, so it completes eagerly."""
+    send(tensor, dst=dst, group=group, sync_op=False)
+    return _P2PTask()
+
+
+def irecv(tensor, src=0, group=None):
+    """Reference: communication/recv.py irecv — returns a task whose wait()
+    performs the (blocking) receive. Deferring matters: the canonical
+    ``t = irecv(...); isend(...); t.wait()`` exchange would deadlock on a
+    blocking transport if irecv received inline before the local send."""
+    return _P2PTask(lambda: recv(tensor, src=src, group=group,
+                                 sync_op=False))
+
+
 def barrier(group=None):
     from .comm_task import comm_task
 

@@ -10,6 +10,8 @@ from ..collective import (  # noqa: F401
     all_to_all,
     barrier,
     broadcast,
+    irecv,
+    isend,
     recv,
     reduce,
     reduce_scatter,
@@ -19,10 +21,21 @@ from ..collective import (  # noqa: F401
 from . import stream  # noqa: F401
 
 
+_OP_NAMES = {"isend": isend, "irecv": irecv, "send": send, "recv": recv}
+
+
 class P2POp:
-    """Reference: communication/batch_isend_irecv.py P2POp."""
+    """Reference: communication/batch_isend_irecv.py P2POp — op is
+    ``isend``/``irecv`` (or ``send``/``recv``; name strings accepted),
+    tensor the buffer, peer the remote rank."""
 
     def __init__(self, op, tensor, peer, group=None):
+        if isinstance(op, str):
+            op = _OP_NAMES.get(op)
+        if op not in (isend, irecv, send, recv):
+            raise ValueError(
+                "P2POp.op must be paddle.distributed isend/irecv (or "
+                f"send/recv), got {op!r}")
         self.op = op
         self.tensor = tensor
         self.peer = peer
@@ -30,6 +43,34 @@ class P2POp:
 
 
 def batch_isend_irecv(p2p_op_list):
-    raise NotImplementedError(
-        "host-level p2p batches require the multi-host runtime; within a mesh "
-        "use shard_map + ppermute (parallel.pipeline_spmd shows the pattern)")
+    """Execute a batch of P2P ops (reference:
+    communication/batch_isend_irecv.py). The reference groups the NCCL
+    calls so intra-batch ordering cannot deadlock; the TPU-native host
+    transport buffers sends in the TCPStore (a send never blocks), so the
+    same guarantee holds by issuing every send in the batch before any
+    recv — recvs then drain already-posted (or soon-posted) payloads
+    regardless of how the two ranks ordered their lists.
+
+    Returns a list of completed task handles, one per op.
+    """
+    if not p2p_op_list:
+        raise ValueError("batch_isend_irecv expects a non-empty op list")
+    for p in p2p_op_list:
+        if not isinstance(p, P2POp):
+            raise ValueError(f"expected P2POp, got {type(p).__name__}")
+    for p in p2p_op_list:
+        if p.op in (isend, send):
+            isend(p.tensor, dst=p.peer, group=p.group)
+    # recvs drain eagerly: every send in the batch is already posted, so
+    # list order cannot deadlock
+    from ..collective import _P2PTask
+
+    tasks = []
+    for p in p2p_op_list:
+        if p.op in (irecv, recv):
+            t = irecv(p.tensor, src=p.peer, group=p.group)
+            t.wait()
+            tasks.append(t)
+        else:
+            tasks.append(_P2PTask())
+    return tasks

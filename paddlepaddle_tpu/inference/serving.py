@@ -1,18 +1,25 @@
-"""Serving engine — request queue + dynamic batching over KV-cache decode.
+"""Serving engine — request queue + batched KV-cache decode.
 
 Reference surface: the Predictor/predictor-pool deployment layer
 (paddle/fluid/inference/api/paddle_inference_api.h:52,229 — config,
-zero-copy handles, a pool of predictors serving concurrent callers).
+zero-copy handles, a pool of predictors serving concurrent callers) and the
+serving-grade batched attention
+(paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu via
+python/paddle/incubate/nn/functional/block_multihead_attention.py).
 
 TPU-native: one engine thread owns the chip; concurrent callers submit
-GenerationRequests into a queue; the scheduler groups compatible requests
-(same prompt length bucket and sampling params — XLA shapes are static) into
-one batched ``generate_cached`` call, so B concurrent clients cost one
-compiled decode program instead of B. Per-request results come back through
-futures. This is iteration-batched serving one level below continuous
-batching (slot-level admission needs per-slot cache positions — noted for a
-later round); the reference ships no serving engine at all (deployment is
-external FastDeploy), so this exceeds L11 parity.
+GenerationRequests into a queue; futures deliver per-request results. Two
+schedulers:
+
+* ``mode="continuous"`` (default) — slot-based continuous batching over
+  the BatchDecodeEngine (decode_engine.py): ragged prompt lengths, mixed
+  sampling params and budgets share ONE compiled multi-step decode program
+  with per-slot cache positions; finished slots retire and free slots admit
+  queued requests mid-flight. The TPU-native equivalent of the reference's
+  paged block_multi_head_attention serving path.
+* ``mode="static"`` — groups compatible requests (same prompt-length
+  bucket and sampling params) into one batched ``generate_cached`` call;
+  simpler, kept for models without the cache-vector-position path.
 """
 
 from __future__ import annotations
@@ -44,7 +51,9 @@ class GenerationResult:
         return self._output
 
     def _set(self, output=None, error=None):
-        self._output = output
+        if self._event.is_set():
+            return  # first outcome wins: a late writer (e.g. a retiring
+        self._output = output   # slot racing stop()) must not flip a result
         self._error = error
         self._event.set()
 
@@ -78,15 +87,27 @@ class ServingEngine:
     """Batched generation server over a model exposing ``generate_cached``."""
 
     def __init__(self, model, max_batch_size: int = 8,
-                 max_wait_ms: float = 5.0):
+                 max_wait_ms: float = 5.0, mode: str = "continuous",
+                 max_len: Optional[int] = None, decode_chunk: int = 16):
+        if mode not in ("continuous", "static"):
+            raise ValueError(f"mode must be 'continuous' or 'static', got {mode!r}")
         self.model = model
+        self.mode = mode
         self.max_batch_size = max_batch_size
         self.max_wait = max_wait_ms / 1e3
         self._queue: "queue.Queue[GenerationRequest]" = queue.Queue()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._stats_lock = threading.Lock()
-        self.stats = {"requests": 0, "batches": 0, "batched_requests": 0}
+        self.stats = {"requests": 0, "batches": 0, "batched_requests": 0,
+                      "decode_tokens": 0}
+        self._engine = None
+        if mode == "continuous":
+            from .decode_engine import BatchDecodeEngine
+
+            self._engine = BatchDecodeEngine(
+                model, max_slots=max_batch_size, max_len=max_len,
+                chunk=decode_chunk)
 
     def _bump(self, key, n=1):
         with self._stats_lock:
@@ -116,23 +137,47 @@ class ServingEngine:
 
     def stop(self):
         self._stop.set()
+        overran = False
         if self._thread is not None:
             self._thread.join(timeout=30)
-            self._thread = None
-        # fail whatever is still queued: a caller must never block on a
-        # future no server will serve
+            if self._thread.is_alive():
+                # a mid-compile loop can overrun the join: keep the handle
+                # so a later submit() cannot start a SECOND loop over the
+                # same slot state; futures are still failed below so no
+                # caller blocks, and we raise only after the cleanup
+                overran = True
+            else:
+                self._thread = None
+        # fail whatever is still queued or mid-decode: a caller must never
+        # block on a future no server will serve
         while True:
             try:
                 req = self._queue.get_nowait()
             except queue.Empty:
                 break
             req.result._set(error=RuntimeError("serving engine stopped"))
+        if self._engine is not None:
+            for i, s in enumerate(self._engine._host_slots):
+                if s.req is not None and not s.req.result.done():
+                    s.req.result._set(
+                        error=RuntimeError("serving engine stopped"))
+                    self._engine._host_slots[i] = type(s)()
+            self._engine.reset_slots()  # no phantom active device lanes
+        if overran:
+            raise RuntimeError(
+                "serving engine thread did not stop within 30s (likely "
+                "mid-compile); outstanding futures were failed; call "
+                "stop() again to re-wait")
 
     def __enter__(self):
         return self.start()
 
-    def __exit__(self, *exc):
-        self.stop()
+    def __exit__(self, exc_type, exc, tb):
+        try:
+            self.stop()
+        except RuntimeError:
+            if exc_type is None:
+                raise  # don't mask the with-body's original exception
         return False
 
     # -- scheduler -----------------------------------------------------------
@@ -163,6 +208,8 @@ class ServingEngine:
         return batch
 
     def _loop(self):
+        if self.mode == "continuous":
+            return self._loop_continuous()
         while not self._stop.is_set():
             batch = self._collect_batch()
             if not batch:
@@ -185,3 +232,49 @@ class ServingEngine:
             except BaseException as e:  # noqa: BLE001 — deliver to callers
                 for req in batch:
                     req.result._set(error=e)
+
+    def _loop_continuous(self):
+        """Continuous batching: admit queued requests into free decode slots,
+        run multi-step decode chunks, retire finished slots mid-flight. The
+        BatchDecodeEngine delivers each request's future on retirement."""
+        eng = self._engine
+        waiting = None  # FIFO head that found no free slot — NOT re-queued
+        # behind newer arrivals (that would rotate the queue every chunk and
+        # starve early requests under sustained load)
+        while not self._stop.is_set():
+            admitted = False
+            busy = any(s.req is not None for s in eng._host_slots)
+            while True:
+                if waiting is not None:
+                    req, waiting = waiting, None
+                else:
+                    try:
+                        req = self._queue.get(timeout=0.05 if not busy else 0)
+                    except queue.Empty:
+                        break
+                try:
+                    if eng._admit(req):
+                        admitted = True
+                        busy = True
+                        self._bump("batched_requests")
+                    else:
+                        waiting = req   # hold the head; decode to free a slot
+                        break
+                except BaseException as e:  # noqa: BLE001
+                    req.result._set(error=e)
+            if busy:
+                before = eng.stats["tokens_out"]
+                try:
+                    eng._decode_chunk()
+                except BaseException as e:  # noqa: BLE001 — fail the slots
+                    for i, s in enumerate(eng._host_slots):
+                        if s.req is not None:
+                            s.req.result._set(error=e)
+                            eng._host_slots[i] = type(s)()
+                    eng.reset_slots()  # clear phantom device lanes too
+                    continue
+                self._bump("decode_tokens", eng.stats["tokens_out"] - before)
+                if admitted:
+                    self._bump("batches")
+        if waiting is not None:
+            waiting.result._set(error=RuntimeError("serving engine stopped"))

@@ -25,7 +25,12 @@ from ..nn.layer import Layer
 
 class StaticFunction:
     def __init__(self, function, layer=None, input_spec=None, jit_kwargs=None):
-        self._function = function
+        from .dy2static import convert_function
+
+        self._original = function
+        # tensor `if`/`while` -> lax.cond/while_loop (dy2static subset);
+        # None means the transform does not apply and plain tracing is used
+        self._function = convert_function(function) or function
         self._layer = layer
         self._input_spec = input_spec
         self._jit_kwargs = jit_kwargs or {}
@@ -50,8 +55,20 @@ class StaticFunction:
             state = {n: t for n, t in self._layer.raw_state().items()}
         else:
             state = {}
-        return apply_op(self._compiled, state, *args,
-                        op_name=f"jit_{getattr(self._function, '__name__', 'fn')}", **kwargs)
+        try:
+            return apply_op(
+                self._compiled, state, *args,
+                op_name=f"jit_{getattr(self._function, '__name__', 'fn')}",
+                **kwargs)
+        except (jax.errors.TracerBoolConversionError,
+                jax.errors.TracerArrayConversionError) as e:
+            raise TypeError(
+                "to_static traced a python bool() of a Tensor the dy2static "
+                "subset could not convert (supported: tensor `if` with "
+                "branch assignments or both-branch returns, tensor `while` "
+                "with a static-shape carry; closures and break/continue "
+                "are not converted — see jit/dy2static.py). Original: "
+                f"{e}") from None
 
     @property
     def code(self):
@@ -66,7 +83,29 @@ class StaticFunction:
 
 def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
               full_graph=True, **kwargs):
-    """Decorator/wrapper compiling a function or a Layer's forward with XLA."""
+    """Decorator/wrapper compiling a function or a Layer's forward with XLA.
+
+    Tensor-valued `if`/`while` are AST-converted to lax.cond/lax.while_loop
+    (the dy2static subset, jit/dy2static.py); python-valued control flow is
+    traced as usual. ``backend``/``build_strategy`` are validated, not
+    silently swallowed: XLA is the one compiler here, so the only accepted
+    values are the defaults (None) or backend='CINN' whose fusion role XLA
+    already plays (a warning records the mapping)."""
+    import warnings
+
+    if backend not in (None, "CINN"):
+        raise ValueError(
+            f"to_static backend must be None or 'CINN', got {backend!r}; "
+            "XLA is the compiler on this platform")
+    if backend == "CINN":
+        warnings.warn("to_static(backend='CINN'): XLA plays the fusion-"
+                      "compiler role here; the flag has no further effect",
+                      stacklevel=2)
+    if build_strategy is not None:
+        warnings.warn(
+            "to_static(build_strategy=...) configures PIR pass selection in "
+            "the reference; XLA's pipeline is not user-configurable, so the "
+            "strategy is recorded but has no effect", stacklevel=2)
 
     def decorate(obj):
         if isinstance(obj, Layer):

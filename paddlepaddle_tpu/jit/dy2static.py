@@ -1,0 +1,382 @@
+"""dy2static control-flow conversion — tensor `if`/`while` → lax.cond/while.
+
+Reference surface: python/paddle/jit/dy2static/transformers/transform.py:68
+(the AST transformer pipeline — IfElse/Loop/Return transformers) and the
+canonical example programs in test/dygraph_to_static/ifelse_simple_func.py.
+
+TPU-native scope: XLA already traces PYTHON-VALUED control flow for free, so
+the only thing a transformer must rescue is control flow on TENSOR values.
+This module implements that subset with one small AST pass:
+
+* ``if <tensor>:`` with assignments in the branches  -> ``lax.cond``
+* ``if <tensor>:`` where BOTH branches end in ``return`` -> ``lax.cond``
+  whose value is returned
+* ``while <tensor>:`` with assignments in the body    -> ``lax.while_loop``
+* everything on python values stays untouched (trace-time control flow)
+
+Unsupported remainders raise ``Dy2StaticUnsupportedError`` with the pattern
+named — never silence (the reference SOT's graph-break fallback re-executes
+in eager; here eager execution IS the fallback the user already has).
+The predicate is examined at RUNTIME: a python bool takes the plain python
+path, a traced/array value takes the lax path — the same function object
+serves both.
+"""
+
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+_HELPERS = "__jst__"
+
+
+class Dy2StaticUnsupportedError(Exception):
+    """A tensor-dependent construct outside the supported subset."""
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _is_traced(p) -> bool:
+    return isinstance(p, (jax.Array, jax.core.Tracer)) \
+        or type(p).__module__.startswith("jax")
+
+
+def _tree_unwrap(tree):
+    return jax.tree_util.tree_map(_unwrap, tree,
+                                  is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def _tree_wrap(tree):
+    return jax.tree_util.tree_map(
+        lambda x: Tensor._from_data(x) if isinstance(
+            x, (jax.Array, jax.core.Tracer)) else x, tree)
+
+
+class _Undefined:
+    """Placeholder for a name not yet bound before a tensor-`if` (reference:
+    dy2static UndefinedVar). Any use raises with the variable's story."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name="<var>"):
+        self.name = name
+
+    def _die(self, *a, **k):
+        raise Dy2StaticUnsupportedError(
+            f"variable {self.name!r} is assigned in only one branch of a "
+            "tensor-`if` and was undefined before it; define it in both "
+            "branches (or before the if)")
+
+    __add__ = __radd__ = __mul__ = __call__ = __getattr__ = _die
+    __bool__ = _die
+
+
+UNDEF = _Undefined()
+
+
+def ifelse(pred, true_fn: Callable, false_fn: Callable, operands=()):
+    """Runtime If: python path for python preds, lax.cond for traced ones.
+    ``operands`` are the current values of the branch-assigned names —
+    passed as ARGUMENTS (read-only) so branch tracing has no side effects
+    on the enclosing frame (a nonlocal-style write would leak one branch's
+    tracers into the other's trace)."""
+    p = _unwrap(pred)
+    if not _is_traced(p):
+        return true_fn(*operands) if p else false_fn(*operands)
+    p = jnp.asarray(p)
+    if p.ndim:
+        p = p.reshape(())  # [1]-shaped preds (paddle-style) act as scalars
+    try:
+        return _tree_wrap(jax.lax.cond(
+            p.astype(bool),
+            lambda _: _tree_unwrap(true_fn(*operands)),
+            lambda _: _tree_unwrap(false_fn(*operands)), None))
+    except TypeError as e:
+        raise Dy2StaticUnsupportedError(
+            "tensor-`if` branches must produce matching shapes/dtypes for "
+            f"every assigned variable (lax.cond contract): {e}") from None
+
+
+def while_(cond_fn: Callable, body_fn: Callable, carry):
+    """Runtime While: python loop for python preds, lax.while_loop when the
+    predicate is traced. Carried variables must keep static shapes."""
+    first = cond_fn(*carry)
+    p = _unwrap(first)
+    if not _is_traced(p):
+        while cond_fn(*carry):
+            carry = body_fn(*carry)
+        return carry
+    uw = _tree_unwrap(tuple(carry))
+    try:
+        out = jax.lax.while_loop(
+            lambda c: jnp.asarray(_unwrap(cond_fn(*c))).reshape(()).astype(bool),
+            lambda c: _tree_unwrap(body_fn(*c)), uw)
+    except TypeError as e:
+        raise Dy2StaticUnsupportedError(
+            "tensor-`while` carried variables must keep static shape/dtype "
+            f"across iterations (lax.while_loop contract): {e}") from None
+    return _tree_wrap(out)
+
+
+# ---------------------------------------------------------------------------
+# AST pass
+# ---------------------------------------------------------------------------
+
+
+def _assigned_names(stmts: List[ast.stmt]) -> List[str]:
+    """Plain names assigned anywhere in the statement list (document order,
+    deduped) — the variables an If/While must thread through the lax op."""
+    out: List[str] = []
+
+    class V(ast.NodeVisitor):
+        def _add(self, t):
+            if isinstance(t, ast.Name):
+                if t.id not in out:
+                    out.append(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    self._add(e)
+
+        def visit_Assign(self, node):
+            for t in node.targets:
+                self._add(t)
+            self.generic_visit(node)
+
+        def visit_AugAssign(self, node):
+            self._add(node.target)
+            self.generic_visit(node)
+
+        def visit_AnnAssign(self, node):
+            self._add(node.target)
+            self.generic_visit(node)
+
+        def visit_For(self, node):
+            self._add(node.target)
+            self.generic_visit(node)
+
+        def visit_FunctionDef(self, node):
+            pass  # nested defs have their own scope
+
+    for s in stmts:
+        V().visit(s)
+    return out
+
+
+def _loaded_names(node: ast.AST) -> List[str]:
+    return [n.id for n in ast.walk(node)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)]
+
+
+def _walk_scope(node):
+    """ast.walk that does NOT descend into function definitions (the node
+    itself included) — a Return inside an already-generated branch function
+    is not an early return of the enclosing block."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _has(stmts, kinds) -> bool:
+    return any(isinstance(n, kinds) for s in stmts for n in _walk_scope(s))
+
+
+def _ends_in_return(stmts) -> bool:
+    return bool(stmts) and isinstance(stmts[-1], ast.Return)
+
+
+class _CtlFlow(ast.NodeTransformer):
+    """Rewrites If/While into calls of the runtime helpers above. Bottom-up:
+    children are transformed first so nesting composes."""
+
+    def __init__(self):
+        self.n = 0
+
+    def _name(self, kind):
+        self.n += 1
+        return f"__jst_{kind}_{self.n}"
+
+    # -- If ------------------------------------------------------------------
+    def visit_If(self, node: ast.If):
+        self.generic_visit(node)
+        body, orelse = node.body, node.orelse
+        ret_b, ret_e = _ends_in_return(body), _ends_in_return(orelse)
+        if _has(body + orelse, (ast.Break, ast.Continue)) \
+                or ret_b != ret_e \
+                or (_has(body + orelse, ast.Return) and not (ret_b and ret_e)):
+            # outside the convertible subset: LEAVE the statement as python
+            # control flow. Predicate tensor-ness is only knowable at
+            # runtime — a python-valued predicate here must keep working
+            # (trace-time control flow); a tensor-valued one will raise
+            # jax's bool-conversion error, which StaticFunction maps to a
+            # message naming this subset.
+            return node
+        tname, fname = self._name("true"), self._name("false")
+        if ret_b:
+            # both branches return: replace the If with `return helper(...)`
+            tdef = _fn_def(tname, body)
+            fdef = _fn_def(fname, orelse)
+            call = _helper_call("ifelse", node.test, tname, fname)
+            return [tdef, fdef, ast.Return(value=call)]
+        assigned = _assigned_names(body + orelse)
+        ret_tuple = ast.Tuple(
+            elts=[ast.Name(id=v, ctx=ast.Load()) for v in assigned],
+            ctx=ast.Load())
+        # branch-assigned names become branch-fn PARAMETERS carrying their
+        # pre-if values (read-only — a nonlocal write would leak one
+        # branch's tracers into the other's trace); names unbound before
+        # the if are pre-initialized to an UndefinedVar placeholder, the
+        # reference's dy2static pattern
+        params = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=v) for v in assigned],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        tdef = _fn_def(tname, body + [ast.Return(value=ret_tuple)], params)
+        fdef = _fn_def(fname, (orelse or [ast.Pass()])
+                       + [ast.Return(value=ret_tuple)], params)
+        guards = [_undef_guard(v) for v in assigned]
+        call = _helper_call("ifelse", node.test, tname, fname,
+                            operands=assigned)
+        if not assigned:
+            return [tdef, fdef, ast.Expr(value=call)]
+        target = ast.Tuple(
+            elts=[ast.Name(id=v, ctx=ast.Store()) for v in assigned],
+            ctx=ast.Store())
+        return guards + [tdef, fdef,
+                         ast.Assign(targets=[target], value=call)]
+
+    # -- While ---------------------------------------------------------------
+    def visit_While(self, node: ast.While):
+        self.generic_visit(node)
+        if _has(node.body, (ast.Break, ast.Continue, ast.Return)) \
+                or node.orelse:
+            return node  # not convertible: keep python control flow (see
+            # visit_If) — tensor predicates get the runtime subset error
+        carried = _assigned_names(node.body)
+        for v in _loaded_names(node.test):
+            if v not in carried:
+                carried.append(v)
+        cname, bname = self._name("cond"), self._name("body")
+        args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=v) for v in carried],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        cdef = ast.FunctionDef(
+            name=cname, args=args,
+            body=[ast.Return(value=node.test)], decorator_list=[])
+        ret_tuple = ast.Tuple(
+            elts=[ast.Name(id=v, ctx=ast.Load()) for v in carried],
+            ctx=ast.Load())
+        bdef = ast.FunctionDef(
+            name=bname, args=args,
+            body=list(node.body) + [ast.Return(value=ret_tuple)],
+            decorator_list=[])
+        call = ast.Call(
+            func=ast.Attribute(
+                value=ast.Name(id=_HELPERS, ctx=ast.Load()),
+                attr="while_", ctx=ast.Load()),
+            args=[ast.Name(id=cname, ctx=ast.Load()),
+                  ast.Name(id=bname, ctx=ast.Load()),
+                  ast.Tuple(elts=[ast.Name(id=v, ctx=ast.Load())
+                                  for v in carried], ctx=ast.Load())],
+            keywords=[])
+        target = ast.Tuple(
+            elts=[ast.Name(id=v, ctx=ast.Store()) for v in carried],
+            ctx=ast.Store())
+        return [cdef, bdef, ast.Assign(targets=[target], value=call)]
+
+
+def _fn_def(name, body, args=None):
+    return ast.FunctionDef(
+        name=name,
+        args=args or ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                   kw_defaults=[], defaults=[]),
+        body=list(body) or [ast.Pass()], decorator_list=[])
+
+
+def _undef_guard(name):
+    """try: name\nexcept UnboundLocalError: name = __jst__.UNDEF"""
+    return ast.Try(
+        body=[ast.Expr(value=ast.Name(id=name, ctx=ast.Load()))],
+        handlers=[ast.ExceptHandler(
+            type=ast.Name(id="UnboundLocalError", ctx=ast.Load()),
+            name=None,
+            body=[ast.Assign(
+                targets=[ast.Name(id=name, ctx=ast.Store())],
+                value=ast.Attribute(
+                    value=ast.Name(id=_HELPERS, ctx=ast.Load()),
+                    attr="UNDEF", ctx=ast.Load()))])],
+        orelse=[], finalbody=[])
+
+
+def _helper_call(attr, test, tname, fname, operands=()):
+    args = [test, ast.Name(id=tname, ctx=ast.Load()),
+            ast.Name(id=fname, ctx=ast.Load())]
+    if operands:
+        args.append(ast.Tuple(
+            elts=[ast.Name(id=v, ctx=ast.Load()) for v in operands],
+            ctx=ast.Load()))
+    return ast.Call(
+        func=ast.Attribute(value=ast.Name(id=_HELPERS, ctx=ast.Load()),
+                           attr=attr, ctx=ast.Load()),
+        args=args, keywords=[])
+
+
+class _Helpers:
+    ifelse = staticmethod(ifelse)
+    while_ = staticmethod(while_)
+    UNDEF = UNDEF
+
+
+def convert_function(fn) -> Optional[Callable]:
+    """AST-transform ``fn``'s tensor control flow. Returns the rewritten
+    function, or None when the transform does not apply (no source, a
+    closure we cannot rebuild, or no If/While at all — callers fall back to
+    plain tracing, where tensor control flow raises jax's tracer error)."""
+    if getattr(fn, "_not_to_static", False):
+        return None
+    bound_self = getattr(fn, "__self__", None)
+    f0 = getattr(fn, "__func__", fn)
+    try:
+        src = textwrap.dedent(inspect.getsource(f0))
+        tree = ast.parse(src)
+    except (OSError, TypeError, IndentationError, SyntaxError):
+        return None
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    if not any(isinstance(n, (ast.If, ast.While)) for n in ast.walk(fdef)):
+        return None
+    if f0.__closure__:
+        # exec cannot rebuild the original closure cells; the subset keeps
+        # to module-level / method functions (the reference's SOT covers
+        # closures via bytecode, out of scope here)
+        return None
+    fdef.decorator_list = []   # don't re-apply to_static on exec
+    new_tree = _CtlFlow().visit(tree)
+    ast.fix_missing_locations(new_tree)
+    code = compile(new_tree, filename=f"<dy2static {f0.__qualname__}>",
+                   mode="exec")
+    glb = dict(f0.__globals__)
+    glb[_HELPERS] = _Helpers
+    loc: dict = {}
+    exec(code, glb, loc)
+    out = loc[fdef.name]
+    out.__defaults__ = f0.__defaults__
+    out.__kwdefaults__ = f0.__kwdefaults__
+    out.__wrapped__ = f0
+    if bound_self is not None:
+        import types
+
+        out = types.MethodType(out, bound_self)
+    return out

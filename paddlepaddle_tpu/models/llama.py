@@ -107,14 +107,23 @@ def _rope_cos_sin(config: LlamaConfig):
 
 
 def _apply_rope(q, k, cos, sin, offset=0):
-    """NeoX-style rotate-half rope on BSHD tensors; cos/sin precomputed fp32."""
+    """NeoX-style rotate-half rope on BSHD tensors; cos/sin precomputed fp32.
+
+    ``offset``: scalar start position, or a PER-ROW [b] vector (ragged
+    continuous batching — each sequence sits at its own position)."""
 
     rot = rotate_half
 
     def f(qa, ka, c, s):
         seq = qa.shape[1]
-        c = jax.lax.dynamic_slice_in_dim(c, offset, seq, axis=0)[None, :, None, :]
-        s = jax.lax.dynamic_slice_in_dim(s, offset, seq, axis=0)[None, :, None, :]
+        if jnp.ndim(offset) == 0:
+            c = jax.lax.dynamic_slice_in_dim(c, offset, seq, axis=0)[None, :, None, :]
+            s = jax.lax.dynamic_slice_in_dim(s, offset, seq, axis=0)[None, :, None, :]
+        else:
+            idx = jnp.asarray(offset, jnp.int32)[:, None] \
+                + jnp.arange(seq, dtype=jnp.int32)[None, :]       # [b, seq]
+            c = c[idx][:, :, None, :]
+            s = s[idx][:, :, None, :]
         c, s = c.astype(qa.dtype), s.astype(qa.dtype)
         return (qa * c + rot(qa) * s, ka * c + rot(ka) * s)
 
@@ -124,31 +133,46 @@ def _apply_rope(q, k, cos, sin, offset=0):
 def _cached_attention(q, k_new, v_new, k_cache, v_cache, pos, n_rep, scale):
     """Write new K/V at [pos:pos+s] and attend q over the valid cache prefix.
 
-    q/k_new/v_new: [b, s, h(…kv), d]; caches [b, L, kvh, d]; pos traced scalar.
+    q/k_new/v_new: [b, s, h(…kv), d]; caches [b, L, kvh, d]; pos is a traced
+    scalar, or a PER-ROW [b] vector for ragged continuous batching (each
+    sequence writes and attends at its own length — the TPU-native role of
+    the reference's paged block_multi_head_attention, with slot-contiguous
+    static caches instead of block tables).
     Returns (out [b, s, h, d], k_cache', v_cache')."""
     b, s = q.shape[0], q.shape[1]
     L = k_cache.shape[1]
     pos = jnp.asarray(pos, jnp.int32)
-    zero = jnp.zeros((), jnp.int32)
-    k_cache = jax.lax.dynamic_update_slice(k_cache, k_new.astype(k_cache.dtype),
-                                           (zero, pos, zero, zero))
-    v_cache = jax.lax.dynamic_update_slice(v_cache, v_new.astype(v_cache.dtype),
-                                           (zero, pos, zero, zero))
-    kk, vv = k_cache, v_cache
-    if n_rep > 1:
-        kk = jnp.repeat(kk, n_rep, axis=2)
-        vv = jnp.repeat(vv, n_rep, axis=2)
-    qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * scale     # [b,h,s,d]
-    kt = jnp.swapaxes(kk, 1, 2).astype(jnp.float32)            # [b,h,L,d]
-    logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt)
-    k_pos = jax.lax.broadcasted_iota(jnp.int32, (s, L), 1)
-    q_pos = pos + jax.lax.broadcasted_iota(jnp.int32, (s, L), 0)
-    valid = k_pos <= q_pos                                      # causal + prefix
-    logits = jnp.where(valid[None, None], logits, -1e30)
+    if pos.ndim == 0:
+        zero = jnp.zeros((), jnp.int32)
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k_new.astype(k_cache.dtype), (zero, pos, zero, zero))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v_new.astype(v_cache.dtype), (zero, pos, zero, zero))
+        q_pos = pos + jax.lax.broadcasted_iota(jnp.int32, (s, L), 0)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (s, L), 1)
+        valid = (k_pos <= q_pos)[None]                  # [1, s, L] broadcast b
+    else:
+        rows = jnp.arange(b, dtype=jnp.int32)[:, None]          # [b, 1]
+        cols = pos[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]  # [b, s]
+        k_cache = k_cache.at[rows, cols].set(k_new.astype(k_cache.dtype))
+        v_cache = v_cache.at[rows, cols].set(v_new.astype(v_cache.dtype))
+        q_pos = cols[:, :, None]                                # [b, s, 1]
+        k_pos = jnp.arange(L, dtype=jnp.int32)[None, None, :]   # [1, 1, L]
+        valid = k_pos <= q_pos                                  # [b, s, L]
+    # GQA without materialization: q regrouped [b, s, kvh, rep, d] contracts
+    # straight against the UNREPEATED bf16 cache with f32 MXU accumulation —
+    # jnp.repeat + .astype(f32) would write 4x the cache bytes every decode
+    # step (the whole pool, per layer), which dominated serving step time
+    h = q.shape[2]
+    kvh = k_cache.shape[2]
+    qg = q.reshape(b, s, kvh, n_rep, q.shape[3])
+    logits = jnp.einsum("bskrd,blkd->bkrsl", qg, k_cache,
+                        preferred_element_type=jnp.float32) * scale
+    logits = jnp.where(valid[:, None, None], logits, -1e30)    # causal+prefix
     probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(vv.dtype),
-                     jnp.swapaxes(vv, 1, 2))
-    return jnp.swapaxes(out, 1, 2).astype(q.dtype), k_cache, v_cache
+    out = jnp.einsum("bkrsl,blkd->bskrd", probs.astype(v_cache.dtype),
+                     v_cache, preferred_element_type=jnp.float32)
+    return out.reshape(b, s, h, q.shape[3]).astype(q.dtype), k_cache, v_cache
 
 
 class LlamaAttention(Layer):

@@ -13,6 +13,7 @@ of the reference's dy2static ProgramTranslator, with XLA in place of PIR.
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 from contextlib import contextmanager
 from typing import Iterator, Optional, Tuple
@@ -23,6 +24,9 @@ import numpy as np
 from ..core import dtype as dtypes
 from ..core.tensor import Parameter, Tensor
 from .initializer import Constant, XavierNormal, _resolve_initializer
+
+# serializes bind_state swaps across threads (see Layer.bind_state)
+_BIND_LOCK = threading.RLock()
 
 
 class Layer:
@@ -334,20 +338,29 @@ class Layer:
 
         Values may be jax.Arrays or tracers; forward run inside this context
         traces against them, enabling jax.jit/grad/vmap over the layer.
+
+        Serialized by a global re-entrant lock: the swap mutates the SHARED
+        Tensor handles, so two threads tracing the same (or overlapping)
+        layers concurrently would interleave save/restore and leak tracers
+        into each other's graphs (seen with serving-engine decode tracing
+        racing a client thread's generate_cached). The lock is held only
+        while tracing — compiled executions never re-enter here — so
+        steady-state concurrency is unaffected.
         """
-        handles = self.raw_state()
-        saved = {}
-        try:
-            for name, val in tree.items():
-                t = handles.get(name)
-                if t is None:
-                    continue
-                saved[name] = t._data
-                t._data = val._data if isinstance(val, Tensor) else val
-            yield self
-        finally:
-            for name, val in saved.items():
-                handles[name]._data = val
+        with _BIND_LOCK:
+            handles = self.raw_state()
+            saved = {}
+            try:
+                for name, val in tree.items():
+                    t = handles.get(name)
+                    if t is None:
+                        continue
+                    saved[name] = t._data
+                    t._data = val._data if isinstance(val, Tensor) else val
+                yield self
+            finally:
+                for name, val in saved.items():
+                    handles[name]._data = val
 
 
 class _HookHandle:

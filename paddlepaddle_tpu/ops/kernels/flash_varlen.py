@@ -59,8 +59,11 @@ def _seg_info(cu, total):
     return seg, pos.astype(jnp.int32), valid
 
 
-def _varlen_xla(q, k, v, cu_q, cu_k, causal, scale):
-    """Dense-mask reference path. q,k,v: [t, h, d] packed."""
+def _varlen_xla(q, k, v, cu_q, cu_k, causal, scale, dropout=0.0,
+                dropout_key=None):
+    """Dense-mask reference path. q,k,v: [t, h, d] packed. ``dropout`` is
+    applied to the attention probabilities (inverted scaling), matching the
+    reference kernel's semantics."""
     tq, tk = q.shape[0], k.shape[0]
     seg_q, pos_q, valid_q = _seg_info(cu_q, tq)
     seg_k, pos_k, valid_k = _seg_info(cu_k, tk)
@@ -80,6 +83,9 @@ def _varlen_xla(q, k, v, cu_q, cu_k, causal, scale):
     # rows with no visible key (padding / empty segments) -> exactly zero
     row_ok = jnp.any(mask, axis=-1)
     probs = jnp.where(row_ok[None, :, None], probs, 0.0)
+    if dropout and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout), 0.0)
     out = jnp.einsum("hqk,hkd->hqd", probs.astype(vt.dtype), vt)
     return jnp.transpose(out, (1, 0, 2))
 
@@ -331,6 +337,12 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
         max_seqlen_q/k: accepted for API parity (shapes are static here).
         scale: softmax scale; default 1/sqrt(head_dim).
         causal: per-segment bottom-right-aligned causal masking.
+        dropout: attention-probability dropout rate (reference
+            flash_attention.py:762 semantics). A non-zero rate routes
+            through the dense-mask XLA path — probability dropout defeats
+            the flash recomputation trick (the bwd would need the exact
+            mask), so the trade is memory for exactness, applied only when
+            ``training`` and the rate is non-zero.
     Returns:
         (out, None) — softmax is never materialized on TPU
         (return_softmax=True raises, as the flash path does upstream).
@@ -339,21 +351,29 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
         raise ValueError(
             "return_softmax=True requires materializing the [tq, tk] matrix; "
             "the flash path does not support it")
-    if dropout:
-        raise NotImplementedError("dropout in flash_attn_unpadded")
+    drop = float(dropout) if training else 0.0
+    dropout_key = None
+    if drop:
+        from ...core import random as prandom
+
+        if fixed_seed_offset is not None:
+            dropout_key = jax.random.PRNGKey(int(fixed_seed_offset))
+        else:
+            dropout_key = prandom.next_key()
 
     def f(q, k, v, cu_q, cu_k):
         s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
         cu_q32 = cu_q.astype(jnp.int32)
         cu_k32 = cu_k.astype(jnp.int32)
-        if (_HAS_PALLAS and _use_pallas(q)
+        if (not drop and _HAS_PALLAS and _use_pallas(q)
                 and _blocks(q.shape[0], k.shape[0]) is not None):
             qt = jnp.transpose(q, (1, 0, 2))
             kt = jnp.transpose(k, (1, 0, 2))
             vt = jnp.transpose(v, (1, 0, 2))
             out = _varlen_core(qt, kt, vt, cu_q32, cu_k32, causal, s)
             return jnp.transpose(out, (1, 0, 2))
-        return _varlen_xla(q, k, v, cu_q32, cu_k32, causal, s)
+        return _varlen_xla(q, k, v, cu_q32, cu_k32, causal, s,
+                           dropout=drop, dropout_key=dropout_key)
 
     out = apply_op(f, query, key, value, cu_seqlens_q, cu_seqlens_k,
                    op_name="flash_attn_unpadded")

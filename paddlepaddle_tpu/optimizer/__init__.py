@@ -11,6 +11,8 @@ from .optimizers import (  # noqa: F401
     Adamax,
     AdamW,
     Lamb,
+    Lars,
+    LarsMomentumOptimizer,
     LBFGS,
     Momentum,
     NAdam,

@@ -63,6 +63,13 @@ class Optimizer:
     def _decoupled_weight_decay(self) -> bool:
         return False
 
+    def _wd_scale_for(self, name: str) -> float:
+        """Per-parameter weight-decay scale hook (1.0 = full decay). The
+        eager path passes the Parameter name, the functional path the
+        pytree key path — optimizers with name-based exclusions (Lars)
+        override this; stateless, so traces stay thread-safe."""
+        return 1.0
+
     # -- eager step ----------------------------------------------------------
     @property
     def _params(self) -> List[Tensor]:
@@ -96,7 +103,9 @@ class Optimizer:
         if self._weight_decay and not self._decoupled_weight_decay():
             gf = gf + float(self._weight_decay) * pf
         param_lr = p.optimize_attr.get("learning_rate", 1.0) if hasattr(p, "optimize_attr") else 1.0
-        new_pf, new_slots = self._rule(pf, gf, self._accumulators[pid], lr * param_lr)
+        new_pf, new_slots = self._rule(
+            pf, gf, self._accumulators[pid], lr * param_lr,
+            wd_scale=self._wd_scale_for(getattr(p, "name", "") or ""))
         self._accumulators[pid] = new_slots
         if use_master:
             self._masters[pid] = new_pf
@@ -151,17 +160,21 @@ class Optimizer:
         lr_val = jnp.asarray(lr if lr is not None else self.get_lr(), jnp.float32)
         if self._grad_clip is not None and hasattr(self._grad_clip, "clip_tree"):
             grads_tree = self._grad_clip.clip_tree(grads_tree)
-        g_leaves, treedef = jax.tree_util.tree_flatten(grads_tree)
+        paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(grads_tree)
+        paths = [jax.tree_util.keystr(kp) for kp, _ in paths_leaves]
+        g_leaves = [leaf for _, leaf in paths_leaves]
         p_leaves = jax.tree_util.tree_leaves(params_tree)
         s_leaves = treedef.flatten_up_to(state["slots"])
         m_leaves = treedef.flatten_up_to(state["master"])
         new_p, new_s, new_m = [], [], []
-        for p, g, s, m in zip(p_leaves, g_leaves, s_leaves, m_leaves):
+        for path, p, g, s, m in zip(paths, p_leaves, g_leaves, s_leaves,
+                                    m_leaves):
             pf = m if m is not None else p.astype(jnp.float32)
             gf = g.astype(jnp.float32)
             if self._weight_decay and not self._decoupled_weight_decay():
                 gf = gf + float(self._weight_decay) * pf
-            npf, ns = self._rule(pf, gf, s, lr_val)
+            npf, ns = self._rule(pf, gf, s, lr_val,
+                                 wd_scale=self._wd_scale_for(path))
             if skip_update is not None:
                 npf = jnp.where(skip_update, pf, npf)
                 ns = jax.tree_util.tree_map(

@@ -225,6 +225,54 @@ class Lamb(Optimizer):
         return new_p, {"moment1": m1, "moment2": m2, "beta1_pow": b1p, "beta2_pow": b2p}
 
 
+class Lars(Optimizer):
+    """LARS momentum (reference:
+    python/paddle/incubate/optimizer/lars_momentum.py LarsMomentumOptimizer):
+
+        local_lr = lr * lars_coeff * ||p|| / (||g|| + wd * ||p|| + eps)
+        velocity = mu * velocity + local_lr * (g + wd * p)
+        p        = p - velocity
+
+    ``exclude_from_weight_decay``: name substrings whose parameters skip the
+    LARS weight decay (honored on BOTH the eager step() path, by Parameter
+    name, and the functional apply() path, by pytree key)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay=None, epsilon=0.0,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision)
+        self._mu = float(momentum)
+        self._coeff = float(lars_coeff)
+        self._lars_wd = float(lars_weight_decay)
+        self._eps = float(epsilon)
+        self._exclude = list(exclude_from_weight_decay or [])
+
+    def _init_slots(self, p):
+        return {"velocity": jnp.zeros_like(p, jnp.float32)}
+
+    def _wd_scale_for(self, name: str) -> float:
+        # stateless per-parameter exclusion (the base passes the Parameter
+        # name on the eager path and the pytree key on the functional path)
+        return 0.0 if any(t in name for t in self._exclude) else 1.0
+
+    def _rule(self, p, g, slots, lr, wd_scale=1.0):
+        wd = self._lars_wd * wd_scale
+        p_norm = jnp.sqrt(jnp.sum(p * p))
+        g_norm = jnp.sqrt(jnp.sum(g * g))
+        local = jnp.where(
+            (p_norm > 0) & (g_norm > 0),
+            lr * self._coeff * p_norm / (g_norm + wd * p_norm + self._eps
+                                         + 1e-30),
+            lr)
+        v = self._mu * slots["velocity"] + local * (g + wd * p)
+        return p - v, {"velocity": v}
+
+
+LarsMomentumOptimizer = Lars  # reference incubate alias
+
+
 class LBFGS(Optimizer):
     """Limited-memory BFGS with closure-based step (reference:
     python/paddle/optimizer/lbfgs.py). ``line_search_fn`` (any non-None

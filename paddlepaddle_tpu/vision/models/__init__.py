@@ -13,6 +13,8 @@ from ...models.resnet import (  # noqa: F401
     wide_resnet101_2,
 )
 from .alexnet import AlexNet, alexnet  # noqa: F401
+from .googlenet import GoogLeNet, googlenet  # noqa: F401
+from .inceptionv3 import InceptionV3, inception_v3  # noqa: F401
 from .densenet import (  # noqa: F401
     DenseNet,
     densenet121,

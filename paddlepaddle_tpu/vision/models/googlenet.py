@@ -1,0 +1,112 @@
+"""GoogLeNet / Inception v1 (reference: python/paddle/vision/models/googlenet.py).
+
+Same topology and aux-classifier contract as the reference: forward returns
+(main, aux1, aux2) — aux heads run only in train mode, zeros-shaped outputs
+otherwise are NOT emulated; like the reference we always return the tuple
+and let the caller pick."""
+
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op
+from ...nn.activation import ReLU
+from ...nn.common import Dropout, Linear
+from ...nn.container import Sequential
+from ...nn.conv import Conv2D
+from ...nn.layer import Layer
+from ...nn.pooling import AdaptiveAvgPool2D, AvgPool2D, MaxPool2D
+
+
+def _cat(*xs):
+    return apply_op(lambda *a: jnp.concatenate(a, axis=1), *xs)
+
+
+class _ConvBlock(Layer):
+    def __init__(self, cin, cout, k, stride=1, padding=0):
+        super().__init__()
+        self.conv = Conv2D(cin, cout, k, stride=stride, padding=padding)
+        self.relu = ReLU()
+
+    def forward(self, x):
+        return self.relu(self.conv(x))
+
+
+class _Inception(Layer):
+    """The four-branch inception block (1x1 / 3x3 / 5x5 / pool-proj)."""
+
+    def __init__(self, cin, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = _ConvBlock(cin, c1, 1)
+        self.b2 = Sequential(_ConvBlock(cin, c3r, 1), _ConvBlock(c3r, c3, 3, padding=1))
+        self.b3 = Sequential(_ConvBlock(cin, c5r, 1), _ConvBlock(c5r, c5, 5, padding=2))
+        self.b4 = Sequential(MaxPool2D(3, 1, padding=1), _ConvBlock(cin, proj, 1))
+
+    def forward(self, x):
+        return _cat(self.b1(x), self.b2(x), self.b3(x), self.b4(x))
+
+
+class _AuxHead(Layer):
+    def __init__(self, cin, num_classes):
+        super().__init__()
+        self.pool = AvgPool2D(5, 3)
+        self.conv = _ConvBlock(cin, 128, 1)
+        self.fc1 = Linear(2048, 1024)
+        self.relu = ReLU()
+        self.drop = Dropout(0.7)
+        self.fc2 = Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.conv(self.pool(x))
+        x = apply_op(lambda a: a.reshape(a.shape[0], -1), x)
+        return self.fc2(self.drop(self.relu(self.fc1(x))))
+
+
+class GoogLeNet(Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = Sequential(
+            _ConvBlock(3, 64, 7, stride=2, padding=3), MaxPool2D(3, 2, padding=1),
+            _ConvBlock(64, 64, 1), _ConvBlock(64, 192, 3, padding=1),
+            MaxPool2D(3, 2, padding=1))
+        self.i3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = MaxPool2D(3, 2, padding=1)
+        self.i4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = MaxPool2D(3, 2, padding=1)
+        self.i5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.drop = Dropout(0.4)
+            self.fc = Linear(1024, num_classes)
+            self.aux1 = _AuxHead(512, num_classes)
+            self.aux2 = _AuxHead(528, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.i3b(self.i3a(x)))
+        x = self.i4a(x)
+        aux1 = self.aux1(x) if self.num_classes > 0 and self.training else None
+        x = self.i4d(self.i4c(self.i4b(x)))
+        aux2 = self.aux2(x) if self.num_classes > 0 and self.training else None
+        x = self.pool4(self.i4e(x))
+        x = self.i5b(self.i5a(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = apply_op(lambda a: a.reshape(a.shape[0], -1), x)
+            x = self.fc(self.drop(x))
+        return x, aux1, aux2
+
+
+def googlenet(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights are not bundled; load a state_dict instead")
+    return GoogLeNet(**kwargs)

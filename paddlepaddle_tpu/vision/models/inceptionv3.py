@@ -1,0 +1,148 @@
+"""Inception v3 (reference: python/paddle/vision/models/inceptionv3.py).
+
+The five reference block families (InceptionA..E) with the same channel
+plans and BN-convs; 299x299 inputs. Aux head omitted at inference like the
+reference default (aux_logits exists only for training builds there too)."""
+
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op
+from ...nn.activation import ReLU
+from ...nn.common import Dropout, Linear
+from ...nn.container import Sequential
+from ...nn.conv import Conv2D
+from ...nn.layer import Layer
+from ...nn.norm import BatchNorm2D
+from ...nn.pooling import AdaptiveAvgPool2D, AvgPool2D, MaxPool2D
+
+
+def _cat(*xs):
+    return apply_op(lambda *a: jnp.concatenate(a, axis=1), *xs)
+
+
+class _ConvBN(Layer):
+    def __init__(self, cin, cout, k, stride=1, padding=0):
+        super().__init__()
+        self.conv = Conv2D(cin, cout, k, stride=stride, padding=padding,
+                           bias_attr=False)
+        self.bn = BatchNorm2D(cout)
+        self.relu = ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))
+
+
+class _InceptionA(Layer):
+    def __init__(self, cin, pool_features):
+        super().__init__()
+        self.b1 = _ConvBN(cin, 64, 1)
+        self.b5 = Sequential(_ConvBN(cin, 48, 1), _ConvBN(48, 64, 5, padding=2))
+        self.b3 = Sequential(_ConvBN(cin, 64, 1), _ConvBN(64, 96, 3, padding=1),
+                             _ConvBN(96, 96, 3, padding=1))
+        self.bp = Sequential(AvgPool2D(3, 1, padding=1),
+                             _ConvBN(cin, pool_features, 1))
+
+    def forward(self, x):
+        return _cat(self.b1(x), self.b5(x), self.b3(x), self.bp(x))
+
+
+class _InceptionB(Layer):
+    def __init__(self, cin):
+        super().__init__()
+        self.b3 = _ConvBN(cin, 384, 3, stride=2)
+        self.b3d = Sequential(_ConvBN(cin, 64, 1), _ConvBN(64, 96, 3, padding=1),
+                              _ConvBN(96, 96, 3, stride=2))
+        self.pool = MaxPool2D(3, 2)
+
+    def forward(self, x):
+        return _cat(self.b3(x), self.b3d(x), self.pool(x))
+
+
+class _InceptionC(Layer):
+    def __init__(self, cin, c7):
+        super().__init__()
+        self.b1 = _ConvBN(cin, 192, 1)
+        self.b7 = Sequential(_ConvBN(cin, c7, 1),
+                             _ConvBN(c7, c7, (1, 7), padding=(0, 3)),
+                             _ConvBN(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7d = Sequential(_ConvBN(cin, c7, 1),
+                              _ConvBN(c7, c7, (7, 1), padding=(3, 0)),
+                              _ConvBN(c7, c7, (1, 7), padding=(0, 3)),
+                              _ConvBN(c7, c7, (7, 1), padding=(3, 0)),
+                              _ConvBN(c7, 192, (1, 7), padding=(0, 3)))
+        self.bp = Sequential(AvgPool2D(3, 1, padding=1), _ConvBN(cin, 192, 1))
+
+    def forward(self, x):
+        return _cat(self.b1(x), self.b7(x), self.b7d(x), self.bp(x))
+
+
+class _InceptionD(Layer):
+    def __init__(self, cin):
+        super().__init__()
+        self.b3 = Sequential(_ConvBN(cin, 192, 1), _ConvBN(192, 320, 3, stride=2))
+        self.b7 = Sequential(_ConvBN(cin, 192, 1),
+                             _ConvBN(192, 192, (1, 7), padding=(0, 3)),
+                             _ConvBN(192, 192, (7, 1), padding=(3, 0)),
+                             _ConvBN(192, 192, 3, stride=2))
+        self.pool = MaxPool2D(3, 2)
+
+    def forward(self, x):
+        return _cat(self.b3(x), self.b7(x), self.pool(x))
+
+
+class _InceptionE(Layer):
+    def __init__(self, cin):
+        super().__init__()
+        self.b1 = _ConvBN(cin, 320, 1)
+        self.b3_1 = _ConvBN(cin, 384, 1)
+        self.b3_2a = _ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b3_2b = _ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.bd_1 = Sequential(_ConvBN(cin, 448, 1), _ConvBN(448, 384, 3, padding=1))
+        self.bd_2a = _ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.bd_2b = _ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.bp = Sequential(AvgPool2D(3, 1, padding=1), _ConvBN(cin, 192, 1))
+
+    def forward(self, x):
+        a = self.b3_1(x)
+        d = self.bd_1(x)
+        return _cat(self.b1(x), self.b3_2a(a), self.b3_2b(a),
+                    self.bd_2a(d), self.bd_2b(d), self.bp(x))
+
+
+class InceptionV3(Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = Sequential(
+            _ConvBN(3, 32, 3, stride=2), _ConvBN(32, 32, 3),
+            _ConvBN(32, 64, 3, padding=1), MaxPool2D(3, 2),
+            _ConvBN(64, 80, 1), _ConvBN(80, 192, 3), MaxPool2D(3, 2))
+        self.blocks = Sequential(
+            _InceptionA(192, 32), _InceptionA(256, 64), _InceptionA(288, 64),
+            _InceptionB(288),
+            _InceptionC(768, 128), _InceptionC(768, 160),
+            _InceptionC(768, 160), _InceptionC(768, 192),
+            _InceptionD(768),
+            _InceptionE(1280), _InceptionE(2048))
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.dropout = Dropout(0.5)
+            self.fc = Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = apply_op(lambda a: a.reshape(a.shape[0], -1), x)
+            x = self.fc(self.dropout(x))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights are not bundled; load a state_dict instead")
+    return InceptionV3(**kwargs)

@@ -1,0 +1,182 @@
+"""dy2static control-flow subset: tensor if/while under to_static.
+
+Mirrors the reference example programs
+(test/dygraph_to_static/ifelse_simple_func.py patterns, transformers at
+python/paddle/jit/dy2static/transformers/transform.py): the SAME python
+source must run eagerly and compile under to_static with tensor-dependent
+control flow converted to lax.cond / lax.while_loop."""
+
+import numpy as np
+import pytest
+
+import paddlepaddle_tpu as paddle
+
+
+# -- module-level dyfuncs (the transform needs source, like the reference) --
+
+def dyfunc_with_if_else(x_v):
+    if paddle.mean(x_v) > 5:
+        x_v = x_v - 1
+    else:
+        x_v = x_v + 1
+    return x_v
+
+
+def dyfunc_new_var_in_branches(x):
+    if paddle.mean(x) > 0:
+        y = x + 1
+    else:
+        y = x - 1
+    return y * 2
+
+
+def dyfunc_early_return_both(x):
+    if paddle.mean(x) > 0:
+        return x + 10
+    else:
+        return x - 10
+
+
+def dyfunc_python_if(x, flag=True):
+    if flag:                      # python bool: trace-time control flow
+        x = x * 2
+    if paddle.mean(x) > 100:      # tensor: becomes lax.cond
+        x = x - 1
+    else:
+        x = x + 1
+    return x
+
+
+def dyfunc_while(x):
+    i = paddle.to_tensor(np.asarray(0, np.int32))
+    s = paddle.zeros_like(x)
+    while i < 5:
+        s = s + x
+        i = i + 1
+    return s
+
+
+def dyfunc_nested(x):
+    if paddle.mean(x) > 0:
+        if paddle.mean(x) > 100:
+            y = x * 3
+        else:
+            y = x * 2
+    else:
+        y = x
+    return y
+
+
+def dyfunc_early_return_mixed(x):
+    if paddle.mean(x) > 0:
+        return x
+    return x - 1
+
+
+def dyfunc_break(x):
+    i = paddle.to_tensor(np.asarray(0, np.int32))
+    while i < 5:
+        if False:
+            pass
+        break
+    return x
+
+
+def _run_both(fn, x):
+    eager = fn(paddle.to_tensor(x)).numpy()
+    static = paddle.jit.to_static(fn)(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(eager, static, rtol=1e-6)
+    return static
+
+
+def test_tensor_ifelse_matches_eager():
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    out = _run_both(dyfunc_with_if_else, x)           # mean=2.5 -> +1 branch
+    np.testing.assert_allclose(out, x + 1)
+    out = _run_both(dyfunc_with_if_else, x + 10)      # mean>5 -> -1 branch
+    np.testing.assert_allclose(out, x + 9)
+
+
+def test_branch_creates_new_var():
+    x = np.ones((2, 2), np.float32)
+    out = _run_both(dyfunc_new_var_in_branches, x)
+    np.testing.assert_allclose(out, (x + 1) * 2)
+    out = _run_both(dyfunc_new_var_in_branches, -x)
+    np.testing.assert_allclose(out, (-x - 1) * 2)
+
+
+def test_both_branch_early_return():
+    x = np.full((3,), 2.0, np.float32)
+    np.testing.assert_allclose(_run_both(dyfunc_early_return_both, x), x + 10)
+    np.testing.assert_allclose(_run_both(dyfunc_early_return_both, -x), -x - 10)
+
+
+def test_python_if_stays_python():
+    x = np.full((2,), 3.0, np.float32)
+    out = paddle.jit.to_static(dyfunc_python_if)(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out, x * 2 + 1)
+
+
+def test_tensor_while_loop():
+    x = np.asarray([1.0, 2.0], np.float32)
+    out = _run_both(dyfunc_while, x)
+    np.testing.assert_allclose(out, x * 5)
+
+
+def test_nested_tensor_if():
+    x = np.full((2,), 60.0, np.float32)
+    np.testing.assert_allclose(_run_both(dyfunc_nested, x), x * 2)
+    np.testing.assert_allclose(_run_both(dyfunc_nested, x * 3), x * 9)
+    np.testing.assert_allclose(_run_both(dyfunc_nested, -x), -x)
+
+
+def test_grad_flows_through_cond():
+    def f(x):
+        if paddle.mean(x) > 0:
+            y = x * 3
+        else:
+            y = x * 5
+        return y.sum()
+
+    xt = paddle.to_tensor(np.ones((3,), np.float32))
+    xt.stop_gradient = False
+    loss = paddle.jit.to_static(f)(xt)
+    loss.backward()
+    np.testing.assert_allclose(xt.grad.numpy(), np.full((3,), 3.0))
+
+
+def test_unsupported_patterns_raise_clearly():
+    # outside the subset the statement stays python: a TENSOR predicate then
+    # raises the runtime error naming the subset...
+    x = paddle.to_tensor(np.ones((2,), np.float32))
+    with pytest.raises(TypeError, match="dy2static"):
+        paddle.jit.to_static(dyfunc_early_return_mixed)(x)
+    with pytest.raises(TypeError, match="dy2static"):
+        paddle.jit.to_static(dyfunc_break)(x)
+
+
+def dyfunc_python_break(x):
+    for i in range(4):
+        if i == 2:
+            break
+        x = x + 1
+    if x is None:
+        return None
+    return x
+
+
+def test_python_control_flow_with_break_still_works():
+    # ...while PYTHON predicates with break/early-return keep tracing fine
+    # (regression: the transform must skip, not reject, these statements)
+    x = np.ones((2,), np.float32)
+    out = paddle.jit.to_static(dyfunc_python_break)(
+        paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out, x + 2)
+
+
+def test_build_strategy_and_backend_not_silent():
+    with pytest.raises(ValueError, match="backend"):
+        paddle.jit.to_static(dyfunc_with_if_else, backend="TensorRT")
+    with pytest.warns(UserWarning, match="build_strategy"):
+        paddle.jit.to_static(dyfunc_with_if_else,
+                             build_strategy=object())

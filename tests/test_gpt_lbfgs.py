@@ -98,3 +98,61 @@ def test_communication_namespace():
     assert callable(communication.stream.all_reduce)
     op = communication.P2POp("isend", None, 1)
     assert op.peer == 1
+
+
+def test_lars_rule_and_exclude():
+    """LARS trust-ratio update (reference incubate LarsMomentumOptimizer):
+    local_lr = lr*coeff*||p||/(||g||+wd*||p||+eps); velocity/momentum step;
+    exclude_from_weight_decay honored by name on the eager path and by
+    pytree key on the functional path."""
+    import numpy as np
+
+    import paddlepaddle_tpu as paddle
+    from paddlepaddle_tpu.optimizer import Lars
+
+    rng = np.random.default_rng(0)
+    w0 = rng.standard_normal((4, 3)).astype(np.float32)
+    g0 = rng.standard_normal((4, 3)).astype(np.float32)
+
+    # eager: one step vs the hand-computed formula
+    p = paddle.to_tensor(w0.copy())
+    p.stop_gradient = False
+    p.name = "w"
+    opt = Lars(learning_rate=0.1, momentum=0.9, lars_coeff=0.001,
+               lars_weight_decay=0.0005, parameters=[p])
+    p._grad = paddle.to_tensor(g0.copy())
+    opt.step()
+    wd, lr, coeff = 0.0005, 0.1, 0.001
+    pn, gn = np.linalg.norm(w0), np.linalg.norm(g0)
+    local = lr * coeff * pn / (gn + wd * pn + 1e-30)
+    v = local * (g0 + wd * w0)
+    np.testing.assert_allclose(p.numpy(), w0 - v, rtol=1e-5, atol=1e-6)
+
+    # exclude: a bias named in the list skips weight decay
+    b = paddle.to_tensor(g0[0].copy())
+    b.stop_gradient = False
+    b.name = "layer.bias"
+    opt2 = Lars(learning_rate=0.1, parameters=[b],
+                exclude_from_weight_decay=["bias"])
+    b._grad = paddle.to_tensor(g0[1].copy())
+    opt2.step()
+    bn, gn2 = np.linalg.norm(g0[0]), np.linalg.norm(g0[1])
+    local2 = 0.1 * 0.001 * bn / (gn2 + 1e-30)       # wd term absent
+    np.testing.assert_allclose(b.numpy(), g0[0] - local2 * g0[1],
+                               rtol=1e-5, atol=1e-6)
+
+    # functional apply: same rule, exclusion by key substring
+    params = {"w": paddle.to_tensor(w0.copy())._data,
+              "head.bias": paddle.to_tensor(g0[2].copy())._data}
+    opt3 = Lars(learning_rate=0.1, parameters=[p],
+                exclude_from_weight_decay=["bias"])
+    state = opt3.init_state(params)
+    grads = {"w": paddle.to_tensor(g0.copy())._data,
+             "head.bias": paddle.to_tensor(g0[3].copy())._data}
+    new_p, _ = opt3.apply(grads, state, params)
+    np.testing.assert_allclose(np.asarray(new_p["w"]), w0 - v,
+                               rtol=1e-5, atol=1e-6)
+    bn3, gn3 = np.linalg.norm(g0[2]), np.linalg.norm(g0[3])
+    local3 = 0.1 * 0.001 * bn3 / (gn3 + 1e-30)
+    np.testing.assert_allclose(np.asarray(new_p["head.bias"]),
+                               g0[2] - local3 * g0[3], rtol=1e-5, atol=1e-6)

@@ -92,6 +92,17 @@ for step in range(steps):
 for _ in range(3):
     g.barrier()
 
+# batch_isend_irecv (reference: communication/batch_isend_irecv.py): each
+# rank sends to the other and receives, with recv ORDERED BEFORE send in
+# the op list — the batch semantics must not deadlock on list order.
+send_buf = paddle.to_tensor(np.asarray([float(100 + rank)], np.float32))
+recv_buf = paddle.to_tensor(np.zeros((1,), np.float32))
+ops = [dist.P2POp(dist.irecv, recv_buf, 1 - rank),
+       dist.P2POp(dist.isend, send_buf, 1 - rank)]
+for t in dist.batch_isend_irecv(ops):
+    t.wait()
+np.testing.assert_allclose(recv_buf.numpy(), [float(100 + (1 - rank))])
+
 print(f"WORKER_{rank}_OK")
 """
 

@@ -79,3 +79,25 @@ def test_vision_zoo_extras_forward():
                 shufflenet_v2_x0_5(num_classes=6)):
         out = net(x)
         assert out.shape == [1, 6], type(net).__name__
+
+
+def test_googlenet_and_inception_v3_forward():
+    """Round-4 zoo tail (reference python/paddle/vision/models/{googlenet,
+    inceptionv3}.py): GoogLeNet returns (main, aux1, aux2) with aux heads
+    active only in train mode; InceptionV3 runs the 299 input contract."""
+    from paddlepaddle_tpu.vision.models import googlenet, inception_v3
+
+    rng = np.random.default_rng(0)
+    g = googlenet(num_classes=6)
+    x = rng.standard_normal((1, 3, 224, 224)).astype(np.float32)
+    g.eval()
+    out, a1, a2 = g(x)
+    assert out.shape == [1, 6] and a1 is None and a2 is None
+    g.train()
+    out, a1, a2 = g(x)
+    assert out.shape == [1, 6] and a1.shape == [1, 6] and a2.shape == [1, 6]
+
+    m = inception_v3(num_classes=6)
+    m.eval()
+    out = m(rng.standard_normal((1, 3, 299, 299)).astype(np.float32))
+    assert out.shape == [1, 6]

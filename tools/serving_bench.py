@@ -1,0 +1,82 @@
+"""Serving throughput: continuous batching vs single-sequence decode.
+
+The BASELINE.md serving card: N concurrent ragged requests on the 254M
+flagship, aggregate new tokens/sec. Single-sequence generate_cached was
+293 tok/s in round 3 (and the per-call floor makes it worse today); the
+slot-based continuous engine amortizes all slots into one multi-step
+compiled decode program.
+
+Run on the TPU: python tools/serving_bench.py [--slots 16] [--reqs 32]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--slots", type=int, default=16)
+    ap.add_argument("--reqs", type=int, default=32)
+    ap.add_argument("--new-tokens", type=int, default=64)
+    ap.add_argument("--chunk", type=int, default=32)
+    args = ap.parse_args()
+
+    from paddlepaddle_tpu.inference.serving import ServingEngine
+    from paddlepaddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                      intermediate_size=4096, num_hidden_layers=12,
+                      num_attention_heads=16, num_key_value_heads=8,
+                      max_position_embeddings=2048, dtype="bfloat16")
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size,
+                            (int(rng.integers(32, 256)),)).astype(np.int32)
+               for _ in range(args.reqs)]
+
+    # single-sequence baseline (one request, same budget)
+    t0 = time.perf_counter()
+    model.generate_cached(prompts[0][None], max_new_tokens=args.new_tokens,
+                          temperature=0.0)
+    t0 = time.perf_counter()  # second call: compiled
+    model.generate_cached(prompts[0][None], max_new_tokens=args.new_tokens,
+                          temperature=0.0)
+    single_dt = time.perf_counter() - t0
+    single_tps = args.new_tokens / single_dt
+    print(f"single-sequence: {single_tps:8.1f} tok/s "
+          f"({args.new_tokens} tokens in {single_dt:.2f}s)", flush=True)
+
+    with ServingEngine(model, max_batch_size=args.slots,
+                       decode_chunk=args.chunk) as eng:
+        # warm EVERY prefill bucket the prompts will hit + the decode program
+        for blen in sorted({-(-len(p) // 128) * 128 for p in prompts}):
+            eng.generate(rng.integers(0, cfg.vocab_size,
+                                      (blen - 1,)).astype(np.int32),
+                         max_new_tokens=4)
+        t0 = time.perf_counter()
+        futs = [eng.submit(p, max_new_tokens=args.new_tokens)
+                for p in prompts]
+        outs = [f.result(900) for f in futs]
+        dt = time.perf_counter() - t0
+    new_tokens = sum(len(o) - len(p) for o, p in zip(outs, prompts))
+    agg = new_tokens / dt
+    print(f"continuous x{args.slots} slots, {args.reqs} reqs: "
+          f"{agg:8.1f} tok/s aggregate ({new_tokens} tokens in {dt:.2f}s, "
+          f"{agg / max(single_tps, 1e-9):.1f}x single)")
+    import json
+
+    print(json.dumps({"serving_bench": {
+        "slots": args.slots, "requests": args.reqs,
+        "new_tokens_per_req": args.new_tokens,
+        "single_tok_s": round(single_tps, 1),
+        "aggregate_tok_s": round(agg, 1)}}))
+
+
+if __name__ == "__main__":
+    main()
