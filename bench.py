@@ -234,7 +234,7 @@ def _bench_resnet50(peak, on_accel):
                    parameters=model.parameters())
     step = TrainStep(model, opt,
                      lambda m, x, y: cross_entropy(m(x), y).mean())
-    batch, iters = 128, 6
+    batch, iters = 128, 10  # longer chains: better slope SNR vs contention
     rng = np.random.default_rng(0)
     imgs = rng.standard_normal((batch, 3, 224, 224)).astype(np.float32)
     labels = rng.integers(0, 1000, (batch,)).astype(np.int64)

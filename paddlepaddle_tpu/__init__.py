@@ -103,6 +103,7 @@ from . import quantization  # noqa: E402,F401
 from . import sparse  # noqa: E402,F401
 from . import static  # noqa: E402,F401
 from . import text  # noqa: E402,F401
+from . import utils  # noqa: E402,F401
 from . import vision  # noqa: E402,F401
 from .framework.io_api import load, save  # noqa: E402,F401
 from .hapi import Model, summary  # noqa: E402,F401

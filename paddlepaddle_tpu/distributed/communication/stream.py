@@ -1,13 +1,60 @@
-"""stream.* collective variants (reference: communication/stream/)."""
+"""stream.* collective variants (reference: communication/stream/).
 
-from ..collective import (  # noqa: F401
-    all_gather,
-    all_reduce,
-    all_to_all,
-    broadcast,
-    recv,
-    reduce,
-    reduce_scatter,
-    scatter,
-    send,
-)
+The reference's stream ops differ from the plain ones in TWO contract
+points: they accept ``sync_op``/``use_calc_stream`` (False = enqueue on the
+comm stream and return immediately) and they return a waitable task. Under
+the single-controller XLA runtime the "comm stream" is the runtime's
+dispatch queue — enqueue order IS stream order, and jax dispatch is already
+asynchronous — so the faithful mapping is: issue the op (it enqueues), and
+hand back a task whose wait() drains the local queue. ``use_calc_stream=
+True`` (the reference's fuse-into-compute-stream mode) waits inline, same
+as the plain wrappers.
+"""
+
+from __future__ import annotations
+
+from .. import collective as _c
+
+
+class _StreamTask:
+    """Reference task contract: wait() blocks until the op's effects are
+    visible; the result tensor was updated in place at issue time."""
+
+    def __init__(self, sync: bool):
+        self._done = sync
+
+    def wait(self):
+        if not self._done:
+            import jax
+
+            jax.effects_barrier()   # drain the dispatch ("comm") queue
+            self._done = True
+        return True
+
+    def is_completed(self):
+        return self._done
+
+
+def _stream_op(fn):
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(*args, sync_op=True, use_calc_stream=False, **kwargs):
+        fn(*args, **kwargs)
+        return _StreamTask(sync=bool(sync_op or use_calc_stream))
+
+    wrapper.__doc__ = (f"stream variant of collective.{fn.__name__}: returns "
+                       "a waitable task; sync_op=False defers the queue "
+                       "drain to task.wait()")
+    return wrapper
+
+
+all_gather = _stream_op(_c.all_gather)
+all_reduce = _stream_op(_c.all_reduce)
+all_to_all = _stream_op(_c.all_to_all)
+broadcast = _stream_op(_c.broadcast)
+recv = _stream_op(_c.recv)
+reduce = _stream_op(_c.reduce)
+reduce_scatter = _stream_op(_c.reduce_scatter)
+scatter = _stream_op(_c.scatter)
+send = _stream_op(_c.send)

@@ -115,6 +115,12 @@ def while_(cond_fn: Callable, body_fn: Callable, carry):
         while cond_fn(*carry):
             carry = body_fn(*carry)
         return carry
+    for c in carry:
+        if isinstance(c, _Undefined):
+            raise Dy2StaticUnsupportedError(
+                f"variable {c.name!r} is a loop-body temporary that is "
+                "undefined before a tensor-`while`; lax.while_loop needs a "
+                "typed initial carry — assign it before the loop")
     uw = _tree_unwrap(tuple(carry))
     try:
         out = jax.lax.while_loop(
@@ -197,12 +203,26 @@ def _ends_in_return(stmts) -> bool:
     return bool(stmts) and isinstance(stmts[-1], ast.Return)
 
 
+def _has_nonname_store(stmts) -> bool:
+    """Stores to attributes/subscripts (obj.x = …, d[k] = …) — side effects
+    the branch extraction cannot thread through lax.cond/while."""
+    for s in stmts:
+        for n in _walk_scope(s):
+            if isinstance(n, (ast.Attribute, ast.Subscript)) \
+                    and isinstance(n.ctx, ast.Store):
+                return True
+    return False
+
+
 class _CtlFlow(ast.NodeTransformer):
     """Rewrites If/While into calls of the runtime helpers above. Bottom-up:
-    children are transformed first so nesting composes."""
+    children are transformed first so nesting composes. ``fn_locals`` is the
+    enclosing function's local-name set — loop/branch carries must never
+    capture globals (paddle, builtins) as carried variables."""
 
-    def __init__(self):
+    def __init__(self, fn_locals=frozenset()):
         self.n = 0
+        self.fn_locals = set(fn_locals)
 
     def _name(self, kind):
         self.n += 1
@@ -214,6 +234,7 @@ class _CtlFlow(ast.NodeTransformer):
         body, orelse = node.body, node.orelse
         ret_b, ret_e = _ends_in_return(body), _ends_in_return(orelse)
         if _has(body + orelse, (ast.Break, ast.Continue)) \
+                or _has_nonname_store(body + orelse) \
                 or ret_b != ret_e \
                 or (_has(body + orelse, ast.Return) and not (ret_b and ret_e)):
             # outside the convertible subset: LEAVE the statement as python
@@ -260,12 +281,15 @@ class _CtlFlow(ast.NodeTransformer):
     def visit_While(self, node: ast.While):
         self.generic_visit(node)
         if _has(node.body, (ast.Break, ast.Continue, ast.Return)) \
-                or node.orelse:
+                or _has_nonname_store(node.body) or node.orelse:
             return node  # not convertible: keep python control flow (see
             # visit_If) — tensor predicates get the runtime subset error
         carried = _assigned_names(node.body)
         for v in _loaded_names(node.test):
-            if v not in carried:
+            # only FUNCTION LOCALS join the carry — a test like
+            # `paddle.mean(x) > 0` loads the global `paddle`, which must
+            # stay a closure read, not become an (unbound) carried local
+            if v not in carried and v in self.fn_locals:
                 carried.append(v)
         cname, bname = self._name("cond"), self._name("body")
         args = ast.arguments(
@@ -293,7 +317,13 @@ class _CtlFlow(ast.NodeTransformer):
         target = ast.Tuple(
             elts=[ast.Name(id=v, ctx=ast.Store()) for v in carried],
             ctx=ast.Store())
-        return [cdef, bdef, ast.Assign(targets=[target], value=call)]
+        # loop-body temporaries may be unbound before the loop: pre-bind to
+        # UNDEF like visit_If (the python-pred path then works — the body
+        # assigns before reading; the tensor-pred path raises the subset
+        # error from the while_ helper instead of UnboundLocalError)
+        guards = [_undef_guard(v) for v in carried]
+        return guards + [cdef, bdef,
+                         ast.Assign(targets=[target], value=call)]
 
 
 def _fn_def(name, body, args=None):
@@ -363,7 +393,14 @@ def convert_function(fn) -> Optional[Callable]:
         # closures via bytecode, out of scope here)
         return None
     fdef.decorator_list = []   # don't re-apply to_static on exec
-    new_tree = _CtlFlow().visit(tree)
+    fn_locals = {a.arg for a in (fdef.args.posonlyargs + fdef.args.args
+                                 + fdef.args.kwonlyargs)}
+    if fdef.args.vararg:
+        fn_locals.add(fdef.args.vararg.arg)
+    if fdef.args.kwarg:
+        fn_locals.add(fdef.args.kwarg.arg)
+    fn_locals |= set(_assigned_names(fdef.body))
+    new_tree = _CtlFlow(fn_locals).visit(tree)
     ast.fix_missing_locations(new_tree)
     code = compile(new_tree, filename=f"<dy2static {f0.__qualname__}>",
                    mode="exec")

@@ -6,6 +6,7 @@ fused_bias_act / fused_dropout_add CUDA kernels)."""
 
 from __future__ import annotations
 
+import functools
 import math as _math
 
 import jax
@@ -310,6 +311,66 @@ def rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1, name=N
     return apply_op(f, x, weight, bias, op_name="rms_norm")
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _bn_train_core(a, w, b, eps, ch_axis):
+    """Training-mode BN with hand-written forward AND backward.
+
+    Forward: ONE data pass computes E[x] and E[x^2] (multi-output reduction
+    fusion; var = E[x^2]-E[x]^2, the classic fused-BN trade cuDNN/TF use —
+    accumulation is f32, and cancellation only bites when |mean| >> std,
+    which post-conv activations don't exhibit), then one fused
+    multiply-add normalize pass.
+
+    Backward: the standard fused formula —
+        dgamma = sum(ct * xhat),  dbeta = sum(ct)
+        dx = gamma * rsqrt(var+eps) * (ct - mean(ct) - xhat * mean(ct*xhat))
+    i.e. ONE reduction pass over (ct, x) + one elementwise pass, where
+    jax's autodiff of the forward emits extra full-size passes (measured
+    on ResNet-50 b128; reference role:
+    paddle/phi/kernels/gpu/batch_norm_grad_kernel.cu).
+    Returns (y, mean, var) so the caller reuses the stats for the
+    running-average update without recomputing them. The stats outputs feed
+    only the non-differentiated running-average update, so their cotangents
+    are zero and the backward ignores them."""
+    out, _ = _bn_train_fwd(a, w, b, eps, ch_axis)
+    return out
+
+
+def _bn_train_fwd(a, w, b, eps, ch_axis):
+    axes = tuple(i for i in range(a.ndim) if i != ch_axis)
+    sh = [1] * a.ndim
+    sh[ch_axis] = a.shape[ch_axis]
+    af = a.astype(jnp.float32)
+    mean = jnp.mean(af, axis=axes)
+    sq = jnp.mean(af * af, axis=axes)
+    var = jnp.maximum(sq - mean * mean, 0.0)
+    scale = jax.lax.rsqrt(var + eps) * w
+    shift = b - mean * scale
+    y = (af * scale.reshape(sh) + shift.reshape(sh)).astype(a.dtype)
+    return (y, mean, var), (a, w, mean, var)
+
+
+def _bn_train_bwd(eps, ch_axis, res, cts):
+    a, w, mean, var = res
+    ct = cts[0].astype(jnp.float32)   # cotangents of (y, mean, var); the
+    axes = tuple(i for i in range(a.ndim) if i != ch_axis)  # stats outputs
+    sh = [1] * a.ndim                 # feed only the (non-diff) running avg
+    sh[ch_axis] = a.shape[ch_axis]
+    n = 1.0
+    for i in axes:
+        n *= a.shape[i]
+    r = jax.lax.rsqrt(var + eps)
+    xhat = (a.astype(jnp.float32) - mean.reshape(sh)) * r.reshape(sh)
+    ct_sum = jnp.sum(ct, axis=axes)
+    ctxhat_sum = jnp.sum(ct * xhat, axis=axes)
+    dx = (w * r).reshape(sh) * (
+        ct - (ct_sum / n).reshape(sh) - xhat * (ctxhat_sum / n).reshape(sh))
+    return dx.astype(a.dtype), ctxhat_sum, ct_sum
+
+
+_bn_train_core.defvjp(_bn_train_fwd, _bn_train_bwd)
+
+
 def batch_norm(
     x,
     running_mean,
@@ -332,27 +393,20 @@ def batch_norm(
         sh[ch_axis] = a.shape[ch_axis]
         axes = tuple(i for i in range(a.ndim) if i != ch_axis)
         if use_batch_stats:
-            # ONE data pass for both stats (multi-output reduction fusion):
-            # var = E[x^2] - E[x]^2, the classic fused-BN trade
-            # (cuDNN/TF fused_batch_norm use the same formula) — jnp.var
-            # would re-read the activation a second time. Accumulation is
-            # f32; cancellation only bites when |mean| >> std, which
-            # post-conv activations don't exhibit (and bf16 inputs carry
-            # 8 mantissa bits anyway). Reference role:
-            # paddle/phi/kernels/gpu/batch_norm_kernel.cu block reduce.
-            af = a.astype(jnp.float32)
-            mean = jnp.mean(af, axis=axes)
-            sq = jnp.mean(af * af, axis=axes)
-            var = jnp.maximum(sq - mean * mean, 0.0)
+            wf = jnp.ones((a.shape[ch_axis],), jnp.float32) if w is None \
+                else jnp.asarray(w).astype(jnp.float32)
+            bf = jnp.zeros((a.shape[ch_axis],), jnp.float32) if b is None \
+                else jnp.asarray(b).astype(jnp.float32)
+            y, mean, var = _bn_train_core(a, wf, bf, epsilon, ch_axis)
             stats_box["mean"], stats_box["var"] = mean, var
-        else:
-            mean, var = rm, rv
-        # fold (mean, var, gamma, beta) into per-channel scale/shift so the
-        # normalize is ONE fused multiply-add pass over the activation
-        scale = jax.lax.rsqrt(var + epsilon)
+            return y
+        mean, var = rm, rv
+        # inference: fold (mean, var, gamma, beta) into per-channel
+        # scale/shift — ONE fused multiply-add pass over the activation
+        scale = jax.lax.rsqrt(jnp.asarray(var).astype(jnp.float32) + epsilon)
         if w is not None:
             scale = scale * w.astype(jnp.float32)
-        shift = -mean * scale
+        shift = -jnp.asarray(mean).astype(jnp.float32) * scale
         if b is not None:
             shift = shift + b.astype(jnp.float32)
         return (a.astype(jnp.float32) * scale.reshape(sh)

@@ -1,0 +1,21 @@
+"""paddle.utils namespace (reference: python/paddle/utils/). The
+cpp_extension role — user-registered ops with autograd and SPMD — is the
+pure-function registry in ``custom_op`` (see docs/custom_ops.md)."""
+
+from . import custom_op  # noqa: F401
+from .custom_op import CustomOp, get_op, register_op, registered_ops  # noqa: F401
+
+
+class cpp_extension:
+    """Reference namespace shim: the C++ toolchain path does not exist on
+    this backend — extensions are jnp/Pallas pure functions. load()/setup()
+    point at the replacement instead of silently failing."""
+
+    @staticmethod
+    def load(*a, **k):
+        raise NotImplementedError(
+            "cpp_extension.load compiles CUDA/C++ kernels in the reference; "
+            "on this backend write the kernel as a jnp/Pallas pure function "
+            "and register it with paddle.utils.register_op (docs/custom_ops.md)")
+
+    setup = load

@@ -1,0 +1,111 @@
+"""Public custom-op API (reference: python/paddle/utils/cpp_extension/ +
+op_meta_info.h): an op registered FROM OUTSIDE the package works under the
+eager tape, jit.to_static, grad, and a sharded train step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddlepaddle_tpu as paddle
+
+
+def _fwd(x, w):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return ((x.astype(jnp.float32) * jax.lax.rsqrt(var + 1e-6))
+            .astype(x.dtype) * w)
+
+
+def _bwd(ct, x, w, out=None):
+    xf = x.astype(jnp.float32)
+    ctf = (ct * w).astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True) + 1e-6
+    r = jax.lax.rsqrt(var)
+    dx = (ctf - xf * jnp.mean(ctf * xf, axis=-1, keepdims=True) / var) * r
+    xhat = xf * r
+    dw = jnp.sum((ct.astype(jnp.float32) * xhat).reshape(-1, x.shape[-1]), 0)
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
+def _register(name, **kw):
+    return paddle.utils.register_op(name, _fwd, override=True, **kw)
+
+
+def test_eager_tape_and_custom_backward():
+    op = _register("t_rms", backward=_bwd)
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+    w = paddle.to_tensor(np.ones((8,), np.float32))
+    x.stop_gradient = False
+    w.stop_gradient = False
+    y = op(x, w)
+    y.sum().backward()
+    # grads match jax autodiff of the plain body
+    ref_dx, ref_dw = jax.grad(
+        lambda a, b: jnp.sum(_fwd(a, b)), argnums=(0, 1))(
+        jnp.asarray(x.numpy()), jnp.asarray(w.numpy()))
+    np.testing.assert_allclose(x.grad.numpy(), np.asarray(ref_dx),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(w.grad.numpy(), np.asarray(ref_dw),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_under_jit_and_registry():
+    op = _register("t_rms_jit", backward=_bwd)
+    rng = np.random.default_rng(1)
+    x = paddle.to_tensor(rng.standard_normal((2, 8)).astype(np.float32))
+    w = paddle.to_tensor(np.full((8,), 2.0, np.float32))
+    eager = op(x, w).numpy()
+    static = paddle.jit.to_static(lambda a, b: op(a, b))(x, w).numpy()
+    np.testing.assert_allclose(eager, static, rtol=1e-6)
+    assert paddle.utils.get_op("t_rms_jit") is op
+    with pytest.raises(ValueError, match="already registered"):
+        paddle.utils.register_op("t_rms_jit", _fwd)
+    with pytest.raises(KeyError, match="no custom op"):
+        paddle.utils.get_op("nope")
+
+
+def test_inside_sharded_train_step():
+    from paddlepaddle_tpu.jit.train import TrainStep
+
+    op = _register("t_rms_train", backward=_bwd)
+
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = paddle.nn.Linear(8, 8)
+            self.w = self.create_parameter([8])
+
+        def forward(self, x):
+            return op(self.lin(x), self.w)
+
+    net = Net()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=net.parameters())
+    step = TrainStep(net, opt, lambda m, x, y: ((m(x) - y) ** 2).mean())
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((8, 8)).astype(np.float32)
+    y = rng.standard_normal((8, 8)).astype(np.float32)
+    losses = [float(step(x, y).numpy()) for _ in range(4)]
+    assert losses[-1] < losses[0]        # the custom op trains end-to-end
+
+
+def test_shard_map_form_with_collective():
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    def rowsum_psum(x):
+        return jax.lax.psum(jnp.sum(x, -1), "tp")
+
+    op = paddle.utils.register_op(
+        "t_rowsum", rowsum_psum, override=True,
+        sharding_rule=((P(None, "tp"),), P(None)))
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs a multi-device mesh")
+    mesh = Mesh(np.array(devs[:2]).reshape(2), ("tp",))
+    x = np.arange(8, dtype=np.float32).reshape(2, 4)
+    with mesh:
+        out = op.shard()(paddle.to_tensor(x))
+    np.testing.assert_allclose(out.numpy(), x.sum(-1))
+    with pytest.raises(ValueError, match="sharding_rule"):
+        _register("t_plain").shard(mesh)

@@ -180,3 +180,57 @@ def test_build_strategy_and_backend_not_silent():
     with pytest.warns(UserWarning, match="build_strategy"):
         paddle.jit.to_static(dyfunc_with_if_else,
                              build_strategy=object())
+
+
+def dyfunc_while_global_in_test(x):
+    while paddle.mean(x) > 0:
+        x = x - 1.0
+    return x
+
+
+def dyfunc_while_body_temp(x):
+    n = 0
+    while n < 3:
+        t = x + 1
+        x = t
+        n = n + 1
+    return x
+
+
+_state = {}
+
+
+def dyfunc_dict_store(x):
+    if paddle.mean(x) > 0:
+        _state["y"] = x + 1
+    else:
+        _state["y"] = x - 1
+    return _state["y"]
+
+
+def test_while_test_loading_globals():
+    """Names loaded by the loop test that are NOT function locals (paddle,
+    builtins) must stay closure reads, not become unbound carried locals."""
+    x = np.asarray([2.5], np.float32)
+    out = paddle.jit.to_static(dyfunc_while_global_in_test)(
+        paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(
+        out, dyfunc_while_global_in_test(paddle.to_tensor(x)).numpy())
+
+
+def test_while_python_pred_with_body_temp():
+    """A loop-body temporary unbound before a PYTHON-predicate while must
+    keep working (regression: the carry guards)."""
+    x = np.ones((2,), np.float32)
+    out = paddle.jit.to_static(dyfunc_while_body_temp)(
+        paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out, x + 3)
+
+
+def test_attribute_subscript_stores_not_converted():
+    """Stores to dict/attr targets cannot thread through lax.cond: the
+    statement stays python, and a tensor predicate raises the subset error
+    instead of leaking tracers."""
+    x = paddle.to_tensor(np.ones((2,), np.float32))
+    with pytest.raises(TypeError, match="dy2static"):
+        paddle.jit.to_static(dyfunc_dict_store)(x)
