@@ -55,6 +55,82 @@ from .core.dtype import (  # noqa: F401
     uint8,
 )
 from .core.flags import get_flags, set_flags  # noqa: F401
+
+# dtype class shim (reference: paddle.dtype — paddle.float32 etc. are its
+# instances): our canonical dtype objects are jax/numpy scalar types, so
+# the class is a constructor + isinstance gate over that set.
+
+
+class _DTypeMeta(type):
+    def __instancecheck__(cls, obj):
+        # dtype OBJECTS only — not None, not string SPECS, and not VALUES
+        # that merely carry a .dtype (tensors, arrays, numpy scalars), so
+        # `isinstance(arg, paddle.dtype)` dispatch branches behave as in
+        # the reference. Canonical dtypes here are numpy scalar TYPES
+        # (paddle.float32 is a class) or np.dtype instances.
+        import numpy as _np
+
+        if not isinstance(obj, (type, _np.dtype)):
+            return False
+        from .core.dtype import convert_dtype as _cd
+
+        try:
+            return _cd(obj) is not None
+        except (TypeError, ValueError, KeyError):
+            return False
+
+
+class dtype(metaclass=_DTypeMeta):
+    """paddle.dtype: dtype('float32') -> the canonical dtype object
+    (paddle.float32 itself); isinstance(paddle.float32, paddle.dtype) is
+    True."""
+
+    def __new__(cls, name):
+        from .core import dtype as _dt
+
+        d = _dt.convert_dtype(name)
+        return getattr(_dt, {"bool": "bool_"}.get(d.name, d.name), d)
+
+
+bool = bool8  # noqa: A001  (the reference exports `paddle.bool` likewise)
+
+
+class _ExoticDType:
+    """Placeholder dtypes the reference exposes for PIR string/raw tensors
+    (paddle.pstring / paddle.raw) — not materializable as array dtypes on
+    this backend; usable only as markers."""
+
+    def __init__(self, name):
+        self.name = name
+
+    def __repr__(self):
+        return f"paddle.{self.name}"
+
+
+pstring = _ExoticDType("pstring")
+raw = _ExoticDType("raw")
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Deprecated reader combinator (reference: paddle.batch,
+    python/paddle/reader/decorator.py): wraps a sample reader into a
+    batched reader. Kept for API parity; io.DataLoader is the real path."""
+
+    batch_size = int(batch_size)
+    if batch_size <= 0:
+        raise ValueError(f"batch_size must be a positive int, got {batch_size}")
+
+    def batched():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched
 from .core.random import get_rng_state, seed, set_rng_state  # noqa: F401
 
 # ops namespace (also patches Tensor methods)

@@ -47,9 +47,7 @@ def setitem(x, item, value):
             v = jnp.asarray(v, a.dtype)
         return a.at[idx].set(v.astype(a.dtype))
 
-    out = apply_op(f, x, value, op_name="setitem")
-    x._data = out._data
-    x._grad_node = out._grad_node
-    x._out_index = out._out_index
-    x._version += 1
-    return x
+    from .math import _inplace
+
+    return _inplace(lambda a, v: apply_op(f, a, v, op_name="setitem"),
+                    op_name="setitem (tensor[...] = value)")(x, value)

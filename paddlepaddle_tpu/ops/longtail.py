@@ -541,3 +541,4 @@ def from_dlpack(capsule):
     except Exception:
         arr = jnp.asarray(np.from_dlpack(capsule))
     return wrap(arr)
+

@@ -38,9 +38,9 @@ def reshape(x, shape, name=None):
 
 
 def reshape_(x, shape, name=None):
-    out = reshape(x, shape)
-    x._data, x._grad_node, x._out_index = out._data, out._grad_node, out._out_index
-    return x
+    from .math import _inplace
+
+    return _inplace(reshape)(x, shape)
 
 
 def transpose(x, perm, name=None):
@@ -226,9 +226,9 @@ def scatter(x, index, updates, overwrite=True, name=None):
 
 
 def scatter_(x, index, updates, overwrite=True, name=None):
-    out = scatter(x, index, updates, overwrite)
-    x._data, x._grad_node, x._out_index = out._data, out._grad_node, out._out_index
-    return x
+    from .math import _inplace
+
+    return _inplace(scatter)(x, index, updates, overwrite)
 
 
 def scatter_nd_add(x, index, updates, name=None):
@@ -306,9 +306,10 @@ def where(condition, x=None, y=None, name=None):
 
 
 def where_(condition, x, y, name=None):
-    out = where(condition, x, y)
-    x._data, x._grad_node, x._out_index = out._data, out._grad_node, out._out_index
-    return x
+    from .math import _inplace
+
+    return _inplace(lambda xx, cond, yy: where(cond, xx, yy),
+                    op_name="where_")(x, condition, y)
 
 
 def take_along_axis(arr, indices, axis, broadcast=True, name=None):
@@ -570,3 +571,17 @@ def chunk_eval(*a, **k):
 
 def tolist(x):
     return x.tolist()
+
+
+# in-place index variants (reference: paddle.index_add_/index_put_/
+# index_fill_) — rebind through math._inplace so the tape sees the new node
+from .math import _inplace as __inpl  # noqa: E402
+
+index_add_ = __inpl(index_add)
+index_put_ = __inpl(index_put)
+
+# index_fill lives in longtail.py, but its in-place form must patch onto
+# Tensor like its siblings — longtail is not in the method-patch list
+from .longtail import index_fill as _index_fill  # noqa: E402
+
+index_fill_ = __inpl(_index_fill)

@@ -250,11 +250,43 @@ def broadcast_shape(x_shape, y_shape):
 # ---- in-place variants (mutate by rebinding; tape picks up the new node) ---
 
 
-def _inplace(fn):
+def _inplace(fn, op_name=None):
+    name = op_name or getattr(fn, "__name__", "op")
+
     def op(x, *args, **kwargs):
+        from ..core import autograd as _ag
+
+        if (not x.stop_gradient) and x._grad_node is None \
+                and _ag.is_grad_enabled():
+            # reference dygraph semantics (same as the eager GradNode
+            # runtime): mutating a LEAF that requires grad would orphan the
+            # accumulation target — the rebind makes the leaf look like an
+            # intermediate and its .grad silently stays None
+            raise RuntimeError(
+                f"in-place {name} on a leaf Tensor that requires "
+                "grad is not allowed; use the out-of-place op (or wrap in "
+                "no_grad for a raw value update)")
         out = fn(x, *args, **kwargs)
+        node = out._grad_node
+        if node is not None:
+            # the node recorded X ITSELF as a producer input; after the
+            # rebind x's _grad_node would point at this very node, making
+            # the edge a self-loop that silently drops upstream grads (and
+            # infinitely recurses the static replay). Swap the edges —
+            # autograd inputs AND static replay_inputs — to a shadow tensor
+            # carrying x's PRE-mutation tape position (the reference's
+            # TensorWrapper role).
+            from ..core.tensor import Tensor as _T
+
+            old = _T._from_data(x._data, stop_gradient=x.stop_gradient)
+            old._grad_node = x._grad_node
+            old._out_index = x._out_index
+            node.inputs = tuple(old if t is x else t for t in node.inputs)
+            if node.replay_inputs:
+                node.replay_inputs = tuple(
+                    old if t is x else t for t in node.replay_inputs)
         x._data = out._data
-        x._grad_node = out._grad_node
+        x._grad_node = node
         x._out_index = out._out_index
         x._version += 1
         return x

@@ -256,3 +256,39 @@ def test_interior_hook_affects_upstream():
     z = h * 4 + h
     z.backward()
     assert float(x.grad.numpy()[0]) == 100.0
+
+
+def test_inplace_ops_autograd_semantics():
+    """Reference dygraph semantics for in-place ops (round-4 fix): mutating
+    a LEAF that requires grad raises; an intermediate keeps exact grads
+    through (and across chains of) in-place mutations — previously the
+    rebind created a tape self-loop and .grad silently stayed None."""
+    import numpy as np
+    import pytest
+
+    import paddlepaddle_tpu as paddle
+
+    x = paddle.to_tensor(np.ones((3,), np.float32))
+    x.stop_gradient = False
+    with pytest.raises(RuntimeError, match="leaf"):
+        paddle.add_(x, x)
+
+    y = x * 2
+    paddle.add_(y, x)                    # y = 3x
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), 3.0)
+
+    x2 = paddle.to_tensor(np.full((2,), 2.0, np.float32))
+    x2.stop_gradient = False
+    z = x2 * 1.0
+    paddle.multiply_(z, x2)              # z = x^2
+    paddle.add_(z, x2)                   # z = x^2 + x
+    z.sum().backward()
+    np.testing.assert_allclose(x2.grad.numpy(), 2 * 2.0 + 1)
+
+    # no_grad leaf mutation stays allowed (raw value update)
+    w = paddle.to_tensor(np.zeros((2,), np.float32))
+    w.stop_gradient = False
+    with paddle.no_grad():
+        paddle.add_(w, paddle.to_tensor(np.ones((2,), np.float32)))
+    np.testing.assert_allclose(w.numpy(), 1.0)

@@ -142,3 +142,27 @@ def test_module_namespaces_closed():
         missing = sorted(n for n in ref
                          if not hasattr(mod, n) and not n.startswith("_"))
         assert missing == [], f"{path}: {missing}"
+
+
+def test_top_level_namespace_closed():
+    """EVERY name in the reference's top-level __all__
+    (python/paddle/__init__.py) resolves here — 438/438 as of round 4
+    (dtype/bool/pstring/raw/batch/index_*_ closed the last 8)."""
+    import ast
+    import os
+
+    path = "/root/reference/python/paddle/__init__.py"
+    if not os.path.exists(path):
+        import pytest as _pytest
+
+        _pytest.skip("reference tree not present")
+    tree = ast.parse(open(path).read())
+    ref_all = [e.value for node in ast.walk(tree)
+               if isinstance(node, ast.Assign)
+               for t in node.targets
+               if isinstance(t, ast.Name) and t.id == "__all__"
+               for e in ast.walk(node.value)
+               if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+    assert len(ref_all) > 400
+    missing = sorted(n for n in ref_all if not hasattr(paddle, n))
+    assert missing == [], missing
