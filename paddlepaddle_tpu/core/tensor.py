@@ -307,7 +307,7 @@ class Parameter(Tensor):
     """Trainable tensor (reference: python/paddle/base/framework.py EagerParamBase)."""
 
     __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip",
-                 "is_distributed", "dist_spec")
+                 "is_distributed", "dist_spec", "_asp_mask")
 
     def __init__(self, data, trainable=True, name=None):
         data = data._data if isinstance(data, Tensor) else jnp.asarray(data)

@@ -24,6 +24,9 @@ from .topology import (  # noqa: F401
 )
 
 
+from .localsgd import LocalSGD  # noqa: F401
+
+
 class DistributedStrategy:
     """Reference: fleet/base/distributed_strategy.py:284 (protobuf-backed
     there; a plain attribute bag here — same knob names)."""
@@ -49,6 +52,10 @@ class DistributedStrategy:
         self.tensor_parallel = False
         self.tensor_parallel_configs = {}
         self.find_unused_parameters = False
+        self.localsgd = False                 # wrap with fleet.LocalSGD
+        self.localsgd_configs = {"k_steps": 1, "begin_step": 1}
+        self.dgc = False                      # absorbed: see localsgd.py doc
+        self.dgc_configs = {}
 
     def __repr__(self):
         return f"DistributedStrategy(hybrid_configs={self.hybrid_configs})"
@@ -106,7 +113,13 @@ class _Fleet:
         """The reference's HybridParallelOptimizer rewrites grad-clip to
         aggregate the global norm across mp/pp/sharding groups; the functional
         optimizer already computes the clip norm over ALL params of the one
-        process (= the global model under GSPMD), so semantics match."""
+        process (= the global model under GSPMD), so semantics match.
+        strategy.localsgd wraps with the LocalSGD meta-optimizer."""
+        strategy = strategy or self._strategy
+        if strategy is not None and getattr(strategy, "localsgd", False):
+            cfg = getattr(strategy, "localsgd_configs", {}) or {}
+            return LocalSGD(optimizer, k_steps=int(cfg.get("k_steps", 1)),
+                            begin_step=int(cfg.get("begin_step", 1)))
         return optimizer
 
     init_server = None

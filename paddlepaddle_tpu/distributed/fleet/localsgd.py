@@ -1,0 +1,71 @@
+"""LocalSGD meta-optimizer — periodic cross-host parameter averaging.
+
+Reference surface: fleet/meta_optimizers/localsgd_optimizer.py (LocalSGD
+and adaptive LocalSGD: run k local steps, then average parameters across
+data-parallel workers).
+
+TPU-native split: inside a mesh, data parallelism is GSPMD — gradients are
+globally reduced every step and LocalSGD is meaningless. The configuration
+where it IS meaningful here is the same one the reference targets: eager
+MULTI-PROCESS training over a slow interconnect (DCN), where averaging
+parameters every k steps instead of gradients every step cuts communication
+k-fold. This wrapper runs the inner optimizer locally and averages
+parameters over the host process group every ``k_steps``.
+
+DGC (deep gradient compression) from the same meta-optimizer family is
+documented ABSORBED: its purpose is taming slow-ethernet gradient traffic,
+while the data plane here is XLA collectives over ICI where compression
+would cost more than it saves; the DCN control plane ships small tensors
+only. (PARITY.md §2.7 records the decision.)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class LocalSGD:
+    """Wrap an optimizer: k local steps, then parameter averaging over the
+    host group (no-op in single-process jobs, so the same script runs
+    anywhere).
+
+    begin_step semantics follow the reference: averaging starts once the
+    global step passes ``begin_step`` (warmup trains fully synchronously?
+    no — the reference's warmup runs LOCAL; we match that: before
+    begin_step, steps are purely local too, averaging just never fires)."""
+
+    def __init__(self, optimizer, k_steps: int = 1, begin_step: int = 1):
+        if k_steps < 1:
+            raise ValueError(f"k_steps must be >= 1, got {k_steps}")
+        self._optimizer = optimizer
+        self._k = int(k_steps)
+        self._begin = int(begin_step)
+        self._step_count = 0
+
+    def __getattr__(self, name):
+        return getattr(self._optimizer, name)
+
+    def _average(self):
+        from ..host_collectives import get_host_group
+
+        g = get_host_group()
+        if g is None:
+            return  # single process: local IS global
+        for p in getattr(self._optimizer, "_parameter_list", None) or []:
+            import jax.numpy as jnp
+
+            avg = g.all_reduce(np.asarray(p.numpy(), np.float32), op="avg")
+            p._replace_data(jnp.asarray(avg, dtype=p._data.dtype))
+
+    def step(self):
+        self._optimizer.step()
+        self._step_count += 1
+        if self._step_count >= self._begin and self._step_count % self._k == 0:
+            self._average()
+
+    def minimize(self, loss, *a, **k):
+        out = self._optimizer.minimize(loss, *a, **k)
+        self._step_count += 1
+        if self._step_count >= self._begin and self._step_count % self._k == 0:
+            self._average()
+        return out

@@ -1,5 +1,6 @@
 """paddle.incubate — experimental API surface (reference: python/paddle/incubate/)."""
 
+from . import asp  # noqa: F401
 from . import autograd, nn  # noqa: F401
 
 # top-level incubate surface (reference python/paddle/incubate/__init__.py)

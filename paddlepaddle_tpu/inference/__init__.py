@@ -119,4 +119,39 @@ def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
 
 
+def _predictor_clone(src: Predictor) -> Predictor:
+    """Construction stays in ONE place: a clone shares the compiled program
+    (stateless under XLA) but owns its handle sets."""
+    clone = Predictor.__new__(Predictor)
+    clone.__dict__.update(src.__dict__)
+    clone._inputs = {}
+    clone._outputs = []
+    clone._input_names = list(src._input_names)
+    return clone
+
+
+class PredictorPool:
+    """Pool of predictors for concurrent callers (reference:
+    paddle_inference_api.h:229 PredictorPool / python inference.wrapper).
+    One model load, ``size`` handle sets: retrive(i) hands thread i its own
+    input/output handles while the compiled program (stateless under XLA)
+    is shared — the TPU-native meaning of a predictor clone."""
+
+    def __init__(self, config: Config, size: int = 1):
+        if size < 1:
+            raise ValueError(f"pool size must be >= 1, got {size}")
+        first = Predictor(config)
+        self._preds = [first]
+        for _ in range(size - 1):
+            self._preds.append(_predictor_clone(first))
+
+    def retrive(self, idx: int) -> Predictor:    # reference spelling
+        return self._preds[idx]
+
+    retrieve = retrive
+
+    def __len__(self):
+        return len(self._preds)
+
+
 from .serving import GenerationResult, ServingEngine  # noqa: F401,E402

@@ -92,6 +92,21 @@ for step in range(steps):
 for _ in range(3):
     g.barrier()
 
+# LocalSGD: k local steps then parameter averaging across the two ranks
+from paddlepaddle_tpu.distributed.fleet import LocalSGD
+lin = paddle.nn.Linear(2, 1)
+lin.weight.set_value(np.full((2, 1), float(rank + 1), np.float32))
+lin.bias.set_value(np.zeros((1,), np.float32))
+lsgd = LocalSGD(paddle.optimizer.SGD(learning_rate=0.0,
+                                     parameters=lin.parameters()), k_steps=2)
+xloc = paddle.to_tensor(np.ones((1, 2), np.float32))
+for s in range(2):   # lr=0: weights unchanged locally; avg fires at step 2
+    loss = lin(xloc).mean()
+    loss.backward()
+    lsgd.step()
+    lsgd.clear_grad()
+np.testing.assert_allclose(lin.weight.numpy(), 1.5)  # avg of 1 and 2
+
 # batch_isend_irecv (reference: communication/batch_isend_irecv.py): each
 # rank sends to the other and receives, with recv ORDERED BEFORE send in
 # the op list — the batch semantics must not deadlock on list order.

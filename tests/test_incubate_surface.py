@@ -127,3 +127,33 @@ def test_incubate_namespaces_closed():
         missing = sorted(n for n in ref
                          if not hasattr(mod, n) and not n.startswith("_"))
         assert missing == [], f"incubate{sub}: {missing}"
+
+
+def test_asp_prune_and_sparsity_guarantee():
+    """2:4 structured sparsity (reference python/paddle/incubate/asp):
+    prune_model halves density with the n:m invariant, and a decorated
+    optimizer keeps pruned coordinates at zero across real train steps."""
+    import numpy as np
+
+    import paddlepaddle_tpu as paddle
+    from paddlepaddle_tpu.incubate import asp
+
+    rng = np.random.default_rng(0)
+    lin = paddle.nn.Linear(8, 8)
+    lin.weight.set_value(rng.standard_normal((8, 8)).astype(np.float32))
+    opt = asp.decorate(paddle.optimizer.SGD(learning_rate=0.1,
+                                            parameters=lin.parameters()))
+    asp.prune_model(lin)
+    w = lin.weight.numpy()
+    assert abs(asp.calculate_density(w) - 0.5) < 1e-6
+    assert asp.check_mask_1d(w)
+    x = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+    for _ in range(3):
+        loss = (lin(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    w2 = lin.weight.numpy()
+    assert asp.check_mask_1d(w2), "mask not preserved through steps"
+    assert abs(asp.calculate_density(w2) - 0.5) < 0.01
+    assert not np.allclose(w, w2)      # training actually moved the weights

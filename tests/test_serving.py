@@ -124,3 +124,40 @@ def test_continuous_batching_eos_mix():
     # engine keeps tokens up to and including eos, budget-trimmed like ref
     assert list(o1[:len(r1)]) == list(r1[:len(o1)])
     assert o2 is not None and len(o2) >= len(p2)
+
+
+def test_predictor_pool_and_stream_variants():
+    """PredictorPool (reference paddle_inference_api.h:229): one model
+    load, per-slot handles, shared compiled program; stream.* collectives
+    carry the sync_op/task contract."""
+    import tempfile
+
+    import numpy as np
+
+    import paddlepaddle_tpu as paddle
+    from paddlepaddle_tpu.inference import Config, PredictorPool
+
+    lin = paddle.nn.Linear(4, 2)
+    with tempfile.TemporaryDirectory() as td:
+        prefix = td + "/m"
+        paddle.jit.save(lin, prefix,
+                        input_spec=[paddle.static.InputSpec([2, 4], "float32")])
+        pool = PredictorPool(Config(prefix), size=3)
+        assert len(pool) == 3
+        x = np.ones((2, 4), np.float32)
+        outs = []
+        for i in range(3):
+            p = pool.retrive(i)
+            h = p.get_input_handle(p.get_input_names()[0])
+            h.copy_from_cpu(x)
+            outs.append(p.run()[0])
+        np.testing.assert_allclose(outs[0], outs[1])
+        assert pool.retrive(0)._layer is pool.retrive(2)._layer
+
+    import paddlepaddle_tpu.distributed as dist
+
+    t = paddle.to_tensor(np.asarray([1.0], np.float32))
+    task = dist.communication.stream.all_reduce(t, sync_op=False)
+    assert not task.is_completed()
+    task.wait()
+    assert task.is_completed()
