@@ -43,6 +43,8 @@ class LocalSGD:
         self._step_count = 0
 
     def __getattr__(self, name):
+        if name == "_optimizer":   # bare instance (copy/pickle probes):
+            raise AttributeError(name)  # avoid __getattr__ recursion
         return getattr(self._optimizer, name)
 
     def _average(self):
@@ -51,11 +53,21 @@ class LocalSGD:
         g = get_host_group()
         if g is None:
             return  # single process: local IS global
-        for p in getattr(self._optimizer, "_parameter_list", None) or []:
-            import jax.numpy as jnp
+        params = getattr(self._optimizer, "_parameter_list", None) or []
+        if not params:
+            return
+        import jax.numpy as jnp
 
-            avg = g.all_reduce(np.asarray(p.numpy(), np.float32), op="avg")
-            p._replace_data(jnp.asarray(avg, dtype=p._data.dtype))
+        # ONE collective for the whole model: the store transport pays a
+        # per-op round-trip, so flatten-concat / all_reduce / split instead
+        # of one all_reduce per tensor
+        flats = [np.asarray(p.numpy(), np.float32).ravel() for p in params]
+        avg = g.all_reduce(np.concatenate(flats), op="avg")
+        off = 0
+        for p, f in zip(params, flats):
+            chunk = avg[off:off + f.size].reshape(p.shape)
+            off += f.size
+            p._replace_data(jnp.asarray(chunk, dtype=p._data.dtype))
 
     def step(self):
         self._optimizer.step()

@@ -21,9 +21,17 @@ import numpy as np
 from ..core.tensor import Tensor
 
 
-def _supported(p: Tensor) -> bool:
-    # reference supported_layer_list: FC/conv weights, i.e. >=2-D params
-    return p is not None and len(p.shape) >= 2 and int(p.shape[-1]) >= 4
+def _supported(p: Tensor, m: int = 4) -> bool:
+    # reference supported_layer_list: FC/conv weights, i.e. >=2-D params.
+    # Conv weights (out, in, kh, kw) are masked over the FLATTENED trailing
+    # dims (the reference reshapes to 2-D the same way), so the gate is the
+    # flattened width, not the raw last axis.
+    if p is None or len(p.shape) < 2:
+        return False
+    flat = 1
+    for d in p.shape[1:]:
+        flat *= int(d)
+    return flat >= m
 
 
 def get_mask_1d(weight: np.ndarray, n: int = 2, m: int = 4) -> np.ndarray:
@@ -75,10 +83,13 @@ def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
             "downgrading would be wrong)")
     pruned = []
     for p in model.parameters():
-        if not _supported(p):
+        if not _supported(p, m):
             continue
         w = np.asarray(p.numpy())
-        mask = get_mask_1d(w, n=n, m=m)
+        # conv (out, in, kh, kw) and any >=2-D weight: n:m over the
+        # flattened trailing dims, the reference's reshape-to-2D semantics
+        w2 = w.reshape(w.shape[0], -1)
+        mask = get_mask_1d(w2, n=n, m=m).reshape(w.shape)
         import jax.numpy as jnp
 
         p._replace_data(jnp.asarray(w * mask, dtype=p._data.dtype))
@@ -100,6 +111,8 @@ class OptimizerWithSparsityGuarantee:
         self._optimizer = optimizer
 
     def __getattr__(self, name):
+        if name == "_optimizer":   # not yet set (e.g. copy/pickle probing a
+            raise AttributeError(name)  # bare instance) — avoid recursion
         return getattr(self._optimizer, name)
 
     def step(self):

@@ -23,7 +23,16 @@ from .densenet import (  # noqa: F401
     densenet201,
 )
 from .lenet import LeNet  # noqa: F401
-from .mobilenet import MobileNetV2, mobilenet_v2  # noqa: F401
+from .mobilenet import (  # noqa: F401
+    MobileNetV1,
+    MobileNetV2,
+    MobileNetV3Small,
+    MobileNetV3Large,
+    mobilenet_v1,
+    mobilenet_v2,
+    mobilenet_v3_large,
+    mobilenet_v3_small,
+)
 from .shufflenetv2 import (  # noqa: F401
     ShuffleNetV2,
     shufflenet_v2_x0_5,

@@ -83,3 +83,175 @@ class MobileNetV2(Layer):
 
 def mobilenet_v2(scale=1.0, **kwargs):
     return MobileNetV2(scale=scale, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# MobileNetV1 (reference: python/paddle/vision/models/mobilenetv1.py —
+# depthwise-separable stacks) and MobileNetV3 small/large (mobilenetv3.py —
+# inverted residuals with squeeze-excite and hardswish).
+# ---------------------------------------------------------------------------
+
+from ...core.dispatch import apply_op as _apply_op
+from ...nn.activation import Hardsigmoid, Hardswish, ReLU
+
+
+def _dw_sep(inp, oup, stride):
+    """depthwise 3x3 + pointwise 1x1, each conv-bn-relu."""
+    return Sequential(
+        Conv2D(inp, inp, 3, stride=stride, padding=1, groups=inp,
+               bias_attr=False),
+        BatchNorm2D(inp), ReLU(),
+        Conv2D(inp, oup, 1, bias_attr=False),
+        BatchNorm2D(oup), ReLU(),
+    )
+
+
+class MobileNetV1(Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return max(8, int(ch * scale))
+
+        cfg = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+               (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2),
+               (1024, 1)]
+        layers = [Sequential(Conv2D(3, c(32), 3, stride=2, padding=1,
+                                    bias_attr=False),
+                             BatchNorm2D(c(32)), ReLU())]
+        inp = c(32)
+        for ch, s in cfg:
+            layers.append(_dw_sep(inp, c(ch), s))
+            inp = c(ch)
+        self.features = Sequential(*layers)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = Linear(inp, num_classes)
+        self._out_c = inp
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def mobilenet_v1(scale=1.0, **kwargs):
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+class _SqueezeExcite(Layer):
+    def __init__(self, ch, squeeze=4):
+        super().__init__()
+        mid = _make_divisible(ch // squeeze)
+        self.pool = AdaptiveAvgPool2D((1, 1))
+        self.fc1 = Conv2D(ch, mid, 1)
+        self.relu = ReLU()
+        self.fc2 = Conv2D(mid, ch, 1)
+        self.hsig = Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return _apply_op(lambda a, b: a * b, x, s)
+
+
+class _V3Block(Layer):
+    def __init__(self, inp, mid, oup, kernel, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and inp == oup
+        Act = Hardswish if act == "hs" else ReLU
+        layers = []
+        if mid != inp:
+            layers += [Conv2D(inp, mid, 1, bias_attr=False),
+                       BatchNorm2D(mid), Act()]
+        layers += [Conv2D(mid, mid, kernel, stride=stride,
+                          padding=kernel // 2, groups=mid, bias_attr=False),
+                   BatchNorm2D(mid), Act()]
+        if use_se:
+            layers.append(_SqueezeExcite(mid))
+        layers += [Conv2D(mid, oup, 1, bias_attr=False), BatchNorm2D(oup)]
+        self.conv = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+_V3_SMALL = [  # kernel, mid, out, se, act, stride
+    (3, 16, 16, True, "re", 2), (3, 72, 24, False, "re", 2),
+    (3, 88, 24, False, "re", 1), (5, 96, 40, True, "hs", 2),
+    (5, 240, 40, True, "hs", 1), (5, 240, 40, True, "hs", 1),
+    (5, 120, 48, True, "hs", 1), (5, 144, 48, True, "hs", 1),
+    (5, 288, 96, True, "hs", 2), (5, 576, 96, True, "hs", 1),
+    (5, 576, 96, True, "hs", 1),
+]
+_V3_LARGE = [
+    (3, 16, 16, False, "re", 1), (3, 64, 24, False, "re", 2),
+    (3, 72, 24, False, "re", 1), (5, 72, 40, True, "re", 2),
+    (5, 120, 40, True, "re", 1), (5, 120, 40, True, "re", 1),
+    (3, 240, 80, False, "hs", 2), (3, 200, 80, False, "hs", 1),
+    (3, 184, 80, False, "hs", 1), (3, 184, 80, False, "hs", 1),
+    (3, 480, 112, True, "hs", 1), (3, 672, 112, True, "hs", 1),
+    (5, 672, 160, True, "hs", 2), (5, 960, 160, True, "hs", 1),
+    (5, 960, 160, True, "hs", 1),
+]
+
+
+class MobileNetV3(Layer):
+    def __init__(self, cfg, last_c, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return _make_divisible(ch * scale)
+
+        inp = c(16)
+        layers = [Sequential(Conv2D(3, inp, 3, stride=2, padding=1,
+                                    bias_attr=False),
+                             BatchNorm2D(inp), Hardswish())]
+        for k, mid, out, se, act, s in cfg:
+            layers.append(_V3Block(inp, c(mid), c(out), k, s, se, act))
+            inp = c(out)
+        head_c = c(cfg[-1][1])
+        layers.append(Sequential(Conv2D(inp, head_c, 1, bias_attr=False),
+                                 BatchNorm2D(head_c), Hardswish()))
+        self.features = Sequential(*layers)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.classifier = Sequential(
+                Linear(head_c, last_c), Hardswish(), Dropout(0.2),
+                Linear(last_c, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+class MobileNetV3Small(MobileNetV3):
+    def __init__(self, scale=1.0, **kw):
+        super().__init__(_V3_SMALL, 1024, scale=scale, **kw)
+
+
+class MobileNetV3Large(MobileNetV3):
+    def __init__(self, scale=1.0, **kw):
+        super().__init__(_V3_LARGE, 1280, scale=scale, **kw)
+
+
+def mobilenet_v3_small(scale=1.0, **kwargs):
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(scale=1.0, **kwargs):
+    return MobileNetV3Large(scale=scale, **kwargs)

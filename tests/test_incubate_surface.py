@@ -157,3 +157,11 @@ def test_asp_prune_and_sparsity_guarantee():
     assert asp.check_mask_1d(w2), "mask not preserved through steps"
     assert abs(asp.calculate_density(w2) - 0.5) < 0.01
     assert not np.allclose(w, w2)      # training actually moved the weights
+
+    # conv weights (out, in, kh, kw): n:m over the flattened trailing dims
+    conv = paddle.nn.Conv2D(4, 8, 3)
+    conv.weight.set_value(rng.standard_normal((8, 4, 3, 3)).astype(np.float32))
+    asp.prune_model(conv)
+    cw = conv.weight.numpy()
+    assert abs(asp.calculate_density(cw) - 0.5) < 0.03, asp.calculate_density(cw)
+    assert asp.check_mask_1d(cw.reshape(8, -1))

@@ -101,3 +101,19 @@ def test_googlenet_and_inception_v3_forward():
     m.eval()
     out = m(rng.standard_normal((1, 3, 299, 299)).astype(np.float32))
     assert out.shape == [1, 6]
+
+
+def test_mobilenet_v1_and_v3_forward():
+    """Zoo completion (reference mobilenetv1.py / mobilenetv3.py):
+    depthwise-separable V1 and SE+hardswish V3 small/large."""
+    from paddlepaddle_tpu.vision.models import (mobilenet_v1,
+                                                mobilenet_v3_large,
+                                                mobilenet_v3_small)
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((1, 3, 96, 96)).astype(np.float32)
+    for net in (mobilenet_v1(num_classes=5, scale=0.5),
+                mobilenet_v3_small(num_classes=5, scale=0.5),
+                mobilenet_v3_large(num_classes=5, scale=0.5)):
+        out = net(x)
+        assert out.shape == [1, 5], type(net).__name__
