@@ -61,13 +61,16 @@ class StaticFunction:
                 op_name=f"jit_{getattr(self._function, '__name__', 'fn')}",
                 **kwargs)
         except (jax.errors.TracerBoolConversionError,
-                jax.errors.TracerArrayConversionError) as e:
+                jax.errors.TracerArrayConversionError,
+                jax.errors.TracerIntegerConversionError) as e:
             raise TypeError(
-                "to_static traced a python bool() of a Tensor the dy2static "
-                "subset could not convert (supported: tensor `if` with "
-                "branch assignments or both-branch returns, tensor `while` "
-                "with a static-shape carry; closures and break/continue "
-                "are not converted — see jit/dy2static.py). Original: "
+                "to_static hit tensor-dependent python control flow the "
+                "dy2static subset could not convert (supported: tensor "
+                "`if` with branch assignments or both-branch returns, "
+                "tensor `while`, and `for i in range(<tensor>)` with a "
+                "static-shape carry; closures, break/continue/early-return "
+                "and attribute/subscript stores inside such blocks are not "
+                "converted — see jit/dy2static.py). Original: "
                 f"{e}") from None
 
     @property

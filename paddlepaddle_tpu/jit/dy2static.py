@@ -72,9 +72,10 @@ class _Undefined:
 
     def _die(self, *a, **k):
         raise Dy2StaticUnsupportedError(
-            f"variable {self.name!r} is assigned in only one branch of a "
-            "tensor-`if` and was undefined before it; define it in both "
-            "branches (or before the if)")
+            f"variable {self.name!r} was read while undefined: it is either "
+            "assigned in only one branch of a tensor-`if` (define it in both "
+            "branches or before the if), or read after a tensor loop that "
+            "could not carry it (assign it before the loop)")
 
     __add__ = __radd__ = __mul__ = __call__ = __getattr__ = _die
     __bool__ = _die
@@ -108,29 +109,47 @@ def ifelse(pred, true_fn: Callable, false_fn: Callable, operands=()):
 
 def while_(cond_fn: Callable, body_fn: Callable, carry):
     """Runtime While: python loop for python preds, lax.while_loop when the
-    predicate is traced. Carried variables must keep static shapes."""
+    predicate is traced. Carried variables must keep static shapes.
+
+    Carry entries that are UNDEFINED before the loop (e.g. the locals a
+    nested inner loop synthesizes each iteration) cannot enter the lax
+    carry — they have no typed initial value. They are threaded as
+    per-iteration body locals instead: the body must assign them before
+    reading (or the UNDEF placeholder raises with the name), their value
+    does not persist across iterations, and reading them AFTER the loop
+    yields the same named error — python's unbound-local semantics,
+    enforced."""
+    carry = tuple(carry)
     first = cond_fn(*carry)
     p = _unwrap(first)
     if not _is_traced(p):
         while cond_fn(*carry):
             carry = body_fn(*carry)
         return carry
-    for c in carry:
-        if isinstance(c, _Undefined):
-            raise Dy2StaticUnsupportedError(
-                f"variable {c.name!r} is a loop-body temporary that is "
-                "undefined before a tensor-`while`; lax.while_loop needs a "
-                "typed initial carry — assign it before the loop")
-    uw = _tree_unwrap(tuple(carry))
+    defined = [k for k, c in enumerate(carry)
+               if not isinstance(c, _Undefined)]
+
+    def full(dc):
+        out = list(carry)
+        for slot, v in zip(defined, dc):
+            out[slot] = v
+        return out
+
+    uw = _tree_unwrap(tuple(carry[k] for k in defined))
     try:
         out = jax.lax.while_loop(
-            lambda c: jnp.asarray(_unwrap(cond_fn(*c))).reshape(()).astype(bool),
-            lambda c: _tree_unwrap(body_fn(*c)), uw)
+            lambda dc: jnp.asarray(
+                _unwrap(cond_fn(*full(dc)))).reshape(()).astype(bool),
+            lambda dc: _tree_unwrap(tuple(
+                body_fn(*full(dc))[k] for k in defined)), uw)
     except TypeError as e:
         raise Dy2StaticUnsupportedError(
             "tensor-`while` carried variables must keep static shape/dtype "
             f"across iterations (lax.while_loop contract): {e}") from None
-    return _tree_wrap(out)
+    result = list(carry)                 # undefined slots stay UNDEF
+    for slot, v in zip(defined, _tree_wrap(out)):
+        result[slot] = v
+    return tuple(result)
 
 
 # ---------------------------------------------------------------------------
@@ -277,6 +296,77 @@ class _CtlFlow(ast.NodeTransformer):
         return guards + [tdef, fdef,
                          ast.Assign(targets=[target], value=call)]
 
+    # -- For over range(...) -------------------------------------------------
+    def visit_For(self, node: ast.For):
+        """``for i in range(n)`` (1–3 args, positive constant step) lowers to
+        a While over an INTERNAL counter so a TENSOR bound converts to
+        lax.while_loop (the reference's LoopTransformer role):
+
+            __k = start; while __k < stop: i = __k; <body>; __k += step
+
+        Python bounds keep python semantics (the While helper's python path
+        re-executes the body eagerly, exactly like tracing the original
+        for). The internal counter keeps the USER loop variable at its
+        last-iteration value after the loop, matching python — the one
+        deviation is an EMPTY range, which leaves ``i`` unset here where
+        python leaves it unbound (reading it raises either way). Bounds are
+        hoisted in source order and evaluated once, like range() itself.
+        Anything else — non-name targets, starred/keyword args, break/
+        continue/return, attribute stores — is left as a python loop."""
+        self.generic_visit(node)
+        it = node.iter
+        if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "range" and 1 <= len(it.args) <= 3
+                and not it.keywords
+                and not any(isinstance(a, ast.Starred) for a in it.args)
+                and isinstance(node.target, ast.Name)
+                and not node.orelse
+                and not _has(node.body, (ast.Break, ast.Continue, ast.Return))
+                and not _has_nonname_store(node.body)):
+            return node
+        i = node.target.id
+        if len(it.args) == 1:
+            start, stop, step = ast.Constant(value=0), it.args[0], None
+        elif len(it.args) == 2:
+            start, stop, step = it.args[0], it.args[1], None
+        else:
+            start, stop, step = it.args
+            if not (isinstance(step, ast.Constant) and isinstance(
+                    step.value, int) and step.value > 0):
+                return node  # negative/dynamic step: keep the python loop
+        step = step or ast.Constant(value=1)
+        k_name = self._name("k")
+        start_name = self._name("start")
+        stop_name = self._name("stop")
+        self.fn_locals.update((k_name, start_name, stop_name))
+
+        def _n(name, ctx=ast.Load):
+            return ast.Name(id=name, ctx=ctx())
+
+        def _asn(name, value):
+            return ast.Assign(targets=[_n(name, ast.Store)], value=value)
+
+        # source-order, evaluated-once bounds: start first, then stop
+        hoists = [_undef_guard(i),       # lets final_loopvar read prior i
+                  _asn(start_name, start), _asn(stop_name, stop),
+                  _asn(k_name, _n(start_name))]
+        test = ast.Compare(left=_n(k_name), ops=[ast.Lt()],
+                           comparators=[_n(stop_name)])
+        set_i = _asn(i, _n(k_name))
+        bump = ast.AugAssign(target=_n(k_name, ast.Store), op=ast.Add(),
+                             value=step)
+        wh = ast.While(test=test, body=[set_i] + list(node.body) + [bump],
+                       orelse=[])
+        out = self.visit_While(wh)
+        # python leaves the loop var at its LAST value: recover it from the
+        # carried counter (the in-body `i` itself is an undefined-entry
+        # carry slot that lax cannot thread past the loop)
+        fin = _asn(i, ast.Call(
+            func=ast.Attribute(value=_n(_HELPERS), attr="final_loopvar",
+                               ctx=ast.Load()),
+            args=[_n(k_name), _n(start_name), step, _n(i)], keywords=[]))
+        return hoists + (out if isinstance(out, list) else [out]) + [fin]
+
     # -- While ---------------------------------------------------------------
     def visit_While(self, node: ast.While):
         self.generic_visit(node)
@@ -335,7 +425,7 @@ def _fn_def(name, body, args=None):
 
 
 def _undef_guard(name):
-    """try: name\nexcept UnboundLocalError: name = __jst__.UNDEF"""
+    """try: name\nexcept UnboundLocalError: name = __jst__.undef('name')"""
     return ast.Try(
         body=[ast.Expr(value=ast.Name(id=name, ctx=ast.Load()))],
         handlers=[ast.ExceptHandler(
@@ -343,9 +433,11 @@ def _undef_guard(name):
             name=None,
             body=[ast.Assign(
                 targets=[ast.Name(id=name, ctx=ast.Store())],
-                value=ast.Attribute(
-                    value=ast.Name(id=_HELPERS, ctx=ast.Load()),
-                    attr="UNDEF", ctx=ast.Load()))])],
+                value=ast.Call(
+                    func=ast.Attribute(
+                        value=ast.Name(id=_HELPERS, ctx=ast.Load()),
+                        attr="undef", ctx=ast.Load()),
+                    args=[ast.Constant(value=name)], keywords=[]))])],
         orelse=[], finalbody=[])
 
 
@@ -362,10 +454,23 @@ def _helper_call(attr, test, tname, fname, operands=()):
         args=args, keywords=[])
 
 
+def final_loopvar(k, start, step, prev):
+    """Post-loop value of a converted for's loop variable: python leaves the
+    LAST iteration value (k - step once k passed stop), or the pre-loop
+    binding when the loop never ran. Traced bounds cannot branch on
+    emptiness, so they always yield k - step (documented deviation for
+    empty traced ranges)."""
+    if _is_traced(_unwrap(k)) or _is_traced(_unwrap(start)):
+        return k - step
+    return k - step if k > start else prev
+
+
 class _Helpers:
     ifelse = staticmethod(ifelse)
     while_ = staticmethod(while_)
     UNDEF = UNDEF
+    undef = staticmethod(_Undefined)
+    final_loopvar = staticmethod(final_loopvar)
 
 
 def convert_function(fn) -> Optional[Callable]:
@@ -385,7 +490,17 @@ def convert_function(fn) -> Optional[Callable]:
     fdef = tree.body[0]
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         return None
-    if not any(isinstance(n, (ast.If, ast.While)) for n in ast.walk(fdef)):
+    def _convertible(n):
+        if isinstance(n, (ast.If, ast.While)):
+            return True
+        # a For matters only when it iterates a bare range() call — loops
+        # over lists/zip/enumerate are never converted, so a function whose
+        # only control flow is those keeps the cheap untransformed path
+        return (isinstance(n, ast.For) and isinstance(n.iter, ast.Call)
+                and isinstance(n.iter.func, ast.Name)
+                and n.iter.func.id == "range")
+
+    if not any(_convertible(n) for n in ast.walk(fdef)):
         return None
     if f0.__closure__:
         # exec cannot rebuild the original closure cells; the subset keeps

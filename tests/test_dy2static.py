@@ -234,3 +234,107 @@ def test_attribute_subscript_stores_not_converted():
     x = paddle.to_tensor(np.ones((2,), np.float32))
     with pytest.raises(TypeError, match="dy2static"):
         paddle.jit.to_static(dyfunc_dict_store)(x)
+
+
+def dyfunc_for_simple(x, n):
+    s = paddle.zeros_like(x)
+    for i in range(n):
+        s = s + x
+    return s
+
+
+def dyfunc_for_python(x):
+    s = paddle.zeros_like(x)
+    for i in range(3):
+        s = s + x * (i + 1)
+    return s
+
+
+def test_for_over_tensor_range():
+    """for i in range(<tensor>) lowers through the While conversion (the
+    reference LoopTransformer role); python ranges keep python semantics."""
+    x = np.asarray([1.0, 2.0], np.float32)
+    n = paddle.to_tensor(np.asarray(4, np.int32))
+    out = paddle.jit.to_static(dyfunc_for_simple)(paddle.to_tensor(x), n)
+    np.testing.assert_allclose(out.numpy(), x * 4)
+    # eager parity
+    np.testing.assert_allclose(
+        dyfunc_for_simple(paddle.to_tensor(x), n).numpy(), x * 4)
+    # python bound unchanged
+    out2 = paddle.jit.to_static(dyfunc_for_python)(paddle.to_tensor(x))
+    np.testing.assert_allclose(out2.numpy(), x * 6)
+
+
+def dyfunc_loopvar_after(x, n):
+    for i in range(n):
+        x = x + 1.0
+    return x * i
+
+
+def dyfunc_nested_for(x, n):
+    s = paddle.zeros_like(x)
+    for i in range(n):
+        for j in range(n):
+            s = s + x
+    return s
+
+
+_order_calls = []
+
+
+def _order_start():
+    _order_calls.append("start")
+    return 5
+
+
+def _order_stop():
+    _order_calls.append("stop")
+    return 0
+
+
+def dyfunc_order(x):
+    for i in range(_order_start(), _order_stop()):
+        x = x + 1.0
+    return x
+
+
+def test_for_loopvar_final_value_matches_python():
+    x = np.ones((2,), np.float32)
+    n = paddle.to_tensor(np.asarray(3, np.int32))
+    eager = dyfunc_loopvar_after(paddle.to_tensor(x), n).numpy()
+    static = paddle.jit.to_static(dyfunc_loopvar_after)(
+        paddle.to_tensor(x), n).numpy()
+    np.testing.assert_allclose(eager, static)     # i == 2 after the loop
+    np.testing.assert_allclose(static, (x + 3) * 2)
+
+
+def test_nested_for_over_tensor_bounds():
+    x = np.asarray([1.0], np.float32)
+    n = paddle.to_tensor(np.asarray(3, np.int32))
+    out = paddle.jit.to_static(dyfunc_nested_for)(paddle.to_tensor(x), n)
+    np.testing.assert_allclose(out.numpy(), x * 9)
+
+
+def test_for_bound_evaluation_order():
+    # python evaluates range's args left-to-right, exactly once
+    x = np.ones((2,), np.float32)
+    _order_calls.clear()
+    static = paddle.jit.to_static(dyfunc_order)(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(static, x)         # range(5, 0) is empty
+    assert _order_calls == ["start", "stop"], _order_calls
+
+
+_BOUNDS = (0, 2)
+
+
+def dyfunc_starred(x):
+    y = x
+    for i in range(*_BOUNDS):
+        y = y + 1.0
+    return y
+
+
+def test_for_starred_args_stay_python():
+    x = np.ones((2,), np.float32)
+    out = paddle.jit.to_static(dyfunc_starred)(paddle.to_tensor(x))
+    np.testing.assert_allclose(out.numpy(), x + 2)
