@@ -161,3 +161,22 @@ def test_predictor_pool_and_stream_variants():
     assert not task.is_completed()
     task.wait()
     assert task.is_completed()
+
+
+def test_static_mode_batching_still_works():
+    """mode='static' (the equal-shape scheduler) kept as an option."""
+    import numpy as np
+
+    m = _model()
+    rng = np.random.default_rng(5)
+    with ServingEngine(m, mode="static", max_batch_size=4,
+                       max_wait_ms=30.0) as eng:
+        prompts = [rng.integers(0, 128, (7,)).astype(np.int32)
+                   for _ in range(3)]
+        futs = [eng.submit(p, max_new_tokens=4) for p in prompts]
+        outs = [f.result(120) for f in futs]
+    for p, o in zip(prompts, outs):
+        ref = m.generate_cached(p[None], max_new_tokens=4,
+                                temperature=0.0).numpy()[0]
+        np.testing.assert_array_equal(o, ref)
+    assert eng.stats["batches"] >= 1
