@@ -15,7 +15,6 @@ pytestmark = pytest.mark.slow  # compile-heavy; fast tier covers this module via
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 from jax.sharding import Mesh
 
 from paddlepaddle_tpu.parallel.hybrid import (
@@ -91,17 +90,20 @@ def test_4d_hybrid_schedule_matches_unpipelined(dp, fsdp, sched):
     _assert_tree_close(dacts, ref_a, what="embed cotangent")
 
 
-def test_4d_hybrid_interleaved_vpp():
-    """Same composition under the interleaved (VPP) schedule: 4 virtual
-    stages on pp=2 devices, chunks [V=2, S=2]."""
+@pytest.mark.parametrize("sched,seed", [("interleaved", 1), ("zbvpp", 3)])
+def test_4d_hybrid_interleaved_schedules(sched, seed):
+    """The composition under the V=2 interleaved schedules — plain VPP and
+    ZBVPP (zero-bubble, r4, split BX/BW re-linearizing each chunk): 4
+    virtual stages of REAL transformer blocks on pp=2 devices, grads vs
+    the unsharded oracle."""
     mesh = _mesh4()
-    stages, head, acts, ids = _problem(n_stages=4, seed=1)
+    stages, head, acts, ids = _problem(n_stages=4, seed=seed)
     block = make_llama_block(CFG, remat=True)
     head_fn = make_vocab_parallel_head(CFG)
 
     loss, g_st, g_h, dacts = spmd_pipeline_train(
         stack_virtual_stage_params(stages, 2), head, acts, ids, block,
-        head_fn, mesh, schedule="interleaved", n_microbatches=4,
+        head_fn, mesh, schedule=sched, n_microbatches=4,
         num_virtual=2, pp_axis="pp", data_axis=("dp", "fsdp"),
         param_specs=llama_stage_specs(), head_specs=llama_head_specs())
 
