@@ -25,6 +25,8 @@ from .topology import (  # noqa: F401
 
 
 from .localsgd import LocalSGD  # noqa: F401
+from . import meta_optimizers, meta_parallel, utils  # noqa: F401  (reference
+# fleet/__init__ imports these, so attribute access fleet.utils.recompute works)
 
 
 class DistributedStrategy:

@@ -1,0 +1,7 @@
+"""fleet.meta_optimizers namespace (reference:
+python/paddle/distributed/fleet/meta_optimizers/). The static-graph
+meta-optimizer zoo is mostly absorbed (XLA/GSPMD); what remains are the
+dygraph wrappers scripts import from here plus LocalSGD."""
+
+from . import dygraph_optimizer  # noqa: F401
+from ..localsgd import LocalSGD  # noqa: F401

@@ -1,0 +1,37 @@
+"""dygraph_optimizer (reference: fleet/meta_optimizers/dygraph_optimizer/):
+HybridParallelOptimizer wraps an optimizer for hybrid runs — under the
+single-controller GSPMD runtime the functional optimizer already computes
+global clip norms over the whole model, so the wrapper is the identity on
+semantics; HybridParallelGradScaler likewise delegates to amp.GradScaler,
+whose found_inf already MAX-reduces across hosts."""
+
+from .....amp import GradScaler as _GradScaler
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        self._inner_opt = optimizer
+
+    def __getattr__(self, name):
+        if name == "_inner_opt":
+            raise AttributeError(name)
+        return getattr(self._inner_opt, name)
+
+    def step(self):
+        return self._inner_opt.step()
+
+    def minimize(self, *a, **k):
+        return self._inner_opt.minimize(*a, **k)
+
+
+class HybridParallelGradScaler(_GradScaler):
+    def __init__(self, scaler=None, hcg=None, **kw):
+        if scaler is None:
+            super().__init__(**kw)
+        elif isinstance(scaler, _GradScaler):
+            self.__dict__.update(scaler.__dict__)
+        else:
+            raise TypeError(
+                f"scaler must be an amp.GradScaler, got {type(scaler).__name__}"
+                " — wrapping an unknown scaler would silently replace its "
+                "loss-scale schedule")

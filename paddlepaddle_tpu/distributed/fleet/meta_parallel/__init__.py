@@ -1,0 +1,18 @@
+"""fleet.meta_parallel namespace (reference:
+python/paddle/distributed/fleet/meta_parallel/__init__.py) — the layer
+classes reference training scripts import from this path. Implementations
+live in parallel/ (mpu TP layers, pipeline LayerDesc/PipelineLayer) and
+fleet/random (RNG tracker); this module is the faithful import surface."""
+
+from ....parallel.mpu import (  # noqa: F401
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from ....parallel.pipeline import (  # noqa: F401
+    LayerDesc,
+    PipelineLayer,
+    SharedLayerDesc,
+)
+from ..random import get_rng_state_tracker, model_parallel_random_seed  # noqa: F401

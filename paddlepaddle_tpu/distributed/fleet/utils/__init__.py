@@ -1,0 +1,5 @@
+"""fleet.utils namespace (reference:
+python/paddle/distributed/fleet/utils/__init__.py): recompute and the
+sequential helper re-exported from the recompute module."""
+
+from ..recompute import recompute, recompute_sequential  # noqa: F401

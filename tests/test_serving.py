@@ -183,37 +183,26 @@ def test_static_mode_batching_still_works():
 
 
 def test_decode_engine_edges():
-    """Boundary behavior: a request filling max_len exactly, temperature
-    sampling with per-slot seeds, and the top_k cap validation."""
+    """Boundary behavior: a request filling max_len exactly, a sampled
+    (temperature + top_k) request completing with the right length, and
+    the top_k cap validation — using the REAL GenerationRequest object."""
     from paddlepaddle_tpu.inference.decode_engine import BatchDecodeEngine
+    from paddlepaddle_tpu.inference.serving import GenerationRequest
 
     m = _model()
     L = m.config.max_position_embeddings
     eng = BatchDecodeEngine(m, max_slots=2, max_len=L, chunk=4)
 
-    class Req:
-        def __init__(self, ids, n, temp=0.0, top_k=0):
-            self.prompt_ids = np.asarray(ids, np.int32)
-            self.max_new_tokens = n
-            self.temperature = temp
-            self.top_k = top_k
-            self.eos_token_id = None
-
-            class R:
-                def _set(self, output=None, error=None):
-                    self.output, self.error = output, error
-
-                def done(self):
-                    return hasattr(self, "output")
-
-            self.result = R()
+    def Req(ids, n, temp=0.0, top_k=0):
+        return GenerationRequest(ids, n, temp, top_k, None)
 
     rng = np.random.default_rng(0)
     V = m.config.vocab_size
     # exactly fills max_len: prompt + new == L is admitted, +1 rejected
     fit = Req(rng.integers(0, V, (L - 4,)), 4)
     eng.serve([fit])
-    assert fit.result.output is not None and len(fit.result.output) == L
+    out = fit.result.result(timeout=1)
+    assert out is not None and len(out) == L
     over = Req(rng.integers(0, V, (L - 4,)), 5)
     with pytest.raises(ValueError, match="max_len"):
         eng._admit(over)
@@ -221,7 +210,7 @@ def test_decode_engine_edges():
     # temperature sampling runs and respects the top_k filter cap
     warm = Req(rng.integers(0, V, (5,)), 6, temp=0.8, top_k=16)
     eng.serve([warm])
-    assert warm.result.output is not None and len(warm.result.output) == 11
+    assert len(warm.result.result(timeout=1)) == 11
     too_big = Req(rng.integers(0, V, (5,)), 2, temp=0.8,
                   top_k=BatchDecodeEngine.TOP_K_CAP + 1)
     with pytest.raises(ValueError, match="top_k"):
