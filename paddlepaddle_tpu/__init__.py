@@ -176,6 +176,9 @@ from . import nn  # noqa: E402,F401
 from . import optimizer  # noqa: E402,F401
 from . import profiler  # noqa: E402,F401
 from . import quantization  # noqa: E402,F401
+from . import regularizer  # noqa: E402,F401
+from . import signal  # noqa: E402,F401
+from . import callbacks  # noqa: E402,F401
 from . import sparse  # noqa: E402,F401
 from . import static  # noqa: E402,F401
 from . import text  # noqa: E402,F401
@@ -185,8 +188,8 @@ from .framework.io_api import load, save  # noqa: E402,F401
 from .hapi import Model, summary  # noqa: E402,F401
 from .jit.api import to_static  # noqa: E402,F401
 
-# paddle.device module alias
-from .core import device  # noqa: E402,F401
+# paddle.device package (cuda/xpu submodules + place API)
+from . import device  # noqa: E402,F401
 
 DataParallel = distributed.DataParallel
 

@@ -1,0 +1,10 @@
+"""paddle.callbacks namespace (reference: python/paddle/callbacks.py is a
+re-export of hapi.callbacks)."""
+
+from .hapi.callbacks import (  # noqa: F401
+    Callback,
+    EarlyStopping,
+    LRScheduler,
+    ModelCheckpoint,
+    ProgBarLogger,
+)

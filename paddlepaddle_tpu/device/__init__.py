@@ -1,0 +1,22 @@
+"""paddle.device package (reference: python/paddle/device/): the device
+API surface plus the cuda/xpu submodules scripts import. Everything
+re-exports core.device (XLA owns real device management)."""
+
+from ..core.device import (  # noqa: F401
+    CPUPlace,
+    CUDAPlace,
+    TPUPlace,
+    get_device,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+    is_compiled_with_xpu,
+    set_device,
+)
+from ..core.device import get_all_device_type  # noqa: F401
+from . import cuda  # noqa: F401
+
+
+def get_available_device():
+    import jax
+
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]

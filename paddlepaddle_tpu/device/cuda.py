@@ -1,0 +1,40 @@
+"""paddle.device.cuda shim: CUDA does not exist on this backend; the
+query APIs answer honestly (0 devices) and the stream/event APIs raise
+with the XLA story instead of silently lying."""
+
+
+def device_count():
+    return 0
+
+
+def is_available():
+    return False
+
+
+def synchronize(device=None):
+    import jax
+
+    jax.effects_barrier()   # drain the dispatch queue (the honest analogue)
+
+
+def empty_cache():
+    pass  # XLA's allocator owns memory
+
+
+def max_memory_allocated(device=None):
+    return 0
+
+
+def max_memory_reserved(device=None):
+    return 0
+
+
+class Stream:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "CUDA streams do not exist on this backend; XLA orders "
+            "dispatches — see distributed.communication.stream for the "
+            "async-collective contract")
+
+
+Event = Stream

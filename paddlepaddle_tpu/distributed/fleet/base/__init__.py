@@ -1,0 +1,5 @@
+"""fleet.base namespace (reference: fleet/base/) — role_maker and the
+strategy re-export."""
+
+from . import role_maker  # noqa: F401
+from .. import DistributedStrategy  # noqa: F401

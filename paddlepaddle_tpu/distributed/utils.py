@@ -1,0 +1,24 @@
+"""paddle.distributed.utils (reference: distributed/utils/): the MoE
+global_scatter/global_gather helpers and misc launch utilities.
+
+TPU-native note: expert dispatch here is parallel/moe.py (einsum mode for
+ep meshes — XLA's SPMD partitioner emits the all_to_all the reference
+implements by hand); the one-sided NCCL-style global_scatter/gather would
+bypass the compiler, so they point at the supported path instead of
+pretending."""
+
+from __future__ import annotations
+
+
+def global_scatter(x, local_count, global_count, group=None, use_calc_stream=True):
+    raise NotImplementedError(
+        "global_scatter is the reference's hand-rolled MoE all_to_all; on "
+        "this backend use parallel.moe.MoELayer(dispatch_mode='einsum') "
+        "over an 'ep' mesh axis — XLA emits the equivalent collective")
+
+
+def global_gather(x, local_count, global_count, group=None, use_calc_stream=True):
+    raise NotImplementedError(
+        "global_gather is the reference's hand-rolled MoE all_to_all; on "
+        "this backend use parallel.moe.MoELayer(dispatch_mode='einsum') "
+        "over an 'ep' mesh axis — XLA emits the equivalent collective")

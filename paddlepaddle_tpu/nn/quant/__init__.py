@@ -1,0 +1,35 @@
+"""paddle.nn.quant namespace (reference: python/paddle/nn/quant/): the
+quantization layers/observers live in the quantization package here."""
+
+from ...quantization import PTQ, QAT, QuantConfig  # noqa: F401
+
+
+def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
+    """Reference: nn/quant/quantized_linear.py weight_quantize — symmetric
+    per-channel int8 weight quantization returning (quantized, scales)."""
+    import jax.numpy as jnp
+
+    from ...core.dispatch import apply_op
+
+    if algo not in ("weight_only_int8", "llm.int8"):
+        raise NotImplementedError(f"weight_quantize algo {algo!r}: int8 "
+                                  "per-channel is the supported scheme")
+
+    def f(w):
+        scale = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0) / 127.0
+        safe = jnp.maximum(scale, 1e-10)   # all-zero channel: quantize to 0,
+        q = jnp.clip(jnp.round(w.astype(jnp.float32) / safe), -127, 127)
+        return q.astype(jnp.int8), scale   # not NaN (0/0)
+
+    return apply_op(f, x, op_name="weight_quantize")
+
+
+def weight_dequantize(x, scale, algo="weight_only_int8", out_dtype="float32"):
+    import jax.numpy as jnp
+
+    from ...core.dispatch import apply_op
+
+    def f(q, s):
+        return (q.astype(jnp.float32) * s).astype(out_dtype)
+
+    return apply_op(f, x, scale, op_name="weight_dequantize")

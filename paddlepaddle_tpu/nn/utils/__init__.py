@@ -1,0 +1,139 @@
+"""paddle.nn.utils (reference: python/paddle/nn/utils/): weight_norm /
+spectral_norm reparameterizations, grad clipping, parameter flattening."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import apply_op
+from ...core.tensor import Tensor
+from ..clip import clip_grad_norm_  # noqa: F401
+from ..layer import Layer
+
+
+def parameters_to_vector(parameters, name=None) -> Tensor:
+    """Concatenate parameters into one flat vector (reference
+    transform_parameters.py)."""
+    vals = [jnp.ravel(p._data) for p in parameters]
+    return Tensor._from_data(jnp.concatenate(vals))
+
+
+def vector_to_parameters(vec: Tensor, parameters, name=None) -> None:
+    data = vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    off = 0
+    for p in parameters:
+        n = int(np.prod(p.shape))
+        p._replace_data(data[off:off + n].reshape(p.shape).astype(p._data.dtype))
+        off += n
+
+
+def _norm_except_dim(v, dim):
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(v.astype(jnp.float32) ** 2, axis=axes,
+                            keepdims=True))
+
+
+def weight_norm(layer: Layer, name: str = "weight", dim: int = 0) -> Layer:
+    """Reparameterize ``layer.<name>`` as g * v/||v|| (reference
+    weight_norm_hook.py): adds <name>_g and <name>_v parameters and
+    recomputes the weight in a forward pre-hook."""
+    from ...core.tensor import Parameter
+
+    w = getattr(layer, name)
+    if dim is None:
+        dim = -1  # whole-tensor norm
+    v0 = jnp.asarray(w._data)
+    if dim == -1:
+        g0 = jnp.sqrt(jnp.sum(v0.astype(jnp.float32) ** 2)).reshape(1)
+    else:
+        g0 = _norm_except_dim(v0, dim).reshape(-1)
+    layer.add_parameter(name + "_g", Parameter(g0.astype(v0.dtype)))
+    layer.add_parameter(name + "_v", Parameter(v0))
+    del layer._parameters[name]
+
+    def _compute(lyr):
+        g = lyr._parameters[name + "_g"]
+        v = lyr._parameters[name + "_v"]
+
+        def f(gv, vv):
+            if dim == -1:
+                nrm = jnp.sqrt(jnp.sum(vv.astype(jnp.float32) ** 2))
+                return (vv / nrm * gv.reshape(())).astype(vv.dtype)
+            nrm = _norm_except_dim(vv, dim)
+            sh = [1] * vv.ndim
+            sh[dim] = -1
+            return (vv / nrm * gv.reshape(sh)).astype(vv.dtype)
+
+        return apply_op(f, g, v)
+
+    # expose the computed weight under the original attribute — a PURE
+    # function of (g, v), so computing on access (once per forward: the
+    # layer reads self.<name> exactly once) needs no pre-hook or cache
+    cls = type(layer)
+
+    class _WN(cls):
+        pass
+
+    def _get(self):
+        return _compute(self)
+
+    setattr(_WN, name, property(_get))
+    _WN.__name__ = cls.__name__
+    layer.__class__ = _WN
+    return layer
+
+
+def remove_weight_norm(layer: Layer, name: str = "weight") -> Layer:
+    """Materialize the current weight and drop the reparameterization."""
+    from ...core.tensor import Parameter
+
+    w = getattr(layer, name)           # computed via the property
+    for suffix in ("_g", "_v"):
+        layer._parameters.pop(name + suffix, None)
+    layer.__class__ = type(layer).__mro__[1]   # undo the property subclass
+    layer.add_parameter(name, Parameter(w._data if isinstance(w, Tensor)
+                                        else jnp.asarray(w)))
+    return layer
+
+
+def spectral_norm(layer: Layer, name: str = "weight", n_power_iterations: int = 1,
+                  eps: float = 1e-12, dim: Optional[int] = None) -> Layer:
+    """Functional spectral_norm (reference utils/spectral_norm_hook.py):
+    wraps the layer's weight with power-iteration normalization on each
+    forward via the SpectralNorm module's math."""
+    from ..norm import SpectralNorm
+
+    w = getattr(layer, name)
+    dim = 0 if dim is None else dim
+    sn = SpectralNorm(list(w.shape), dim=dim, power_iters=n_power_iterations,
+                      eps=eps)
+
+    def _apply(lyr, inputs):
+        object.__setattr__(lyr, "_sn_" + name, sn(lyr._parameters[name + "_orig"]))
+        return None
+
+    from ...core.tensor import Parameter
+
+    layer.add_parameter(name + "_orig", Parameter(jnp.asarray(w._data)))
+    del layer._parameters[name]
+    layer.register_forward_pre_hook(_apply)
+    cls = type(layer)
+
+    class _SN(cls):
+        pass
+
+    def _get(self):
+        cached = self.__dict__.get("_sn_" + name)
+        if cached is None:     # lazy first compute; afterwards the pre-hook
+            _apply(self, ())   # is the only power-iteration advance, so
+            cached = self.__dict__.get("_sn_" + name)  # reads don't mutate
+        return cached
+
+    setattr(_SN, name, property(_get))
+    _SN.__name__ = cls.__name__
+    layer.__class__ = _SN
+    return layer
+

@@ -63,6 +63,17 @@ class Optimizer:
     def _decoupled_weight_decay(self) -> bool:
         return False
 
+    def _decay_term(self, pf):
+        """Coupled decay gradient term: L1Decay folds coeff*sign(w), L2Decay
+        (or a plain float coefficient) folds coeff*w (reference
+        regularizer.py semantics)."""
+        from ..regularizer import L1Decay
+
+        coeff = float(self._weight_decay)
+        if isinstance(self._weight_decay, L1Decay):
+            return coeff * jnp.sign(pf)
+        return coeff * pf
+
     def _wd_scale_for(self, name: str) -> float:
         """Per-parameter weight-decay scale hook (1.0 = full decay). The
         eager path passes the Parameter name, the functional path the
@@ -99,9 +110,10 @@ class Optimizer:
         master = self._masters.get(pid, None)
         pf = master if master is not None else p._data.astype(jnp.float32)
         gf = g.astype(jnp.float32)
-        # coupled L2 weight decay (non-decoupled optimizers fold into grad)
+        # coupled weight decay (non-decoupled optimizers fold into grad):
+        # L2Decay/float -> coeff*w; L1Decay -> coeff*sign(w)
         if self._weight_decay and not self._decoupled_weight_decay():
-            gf = gf + float(self._weight_decay) * pf
+            gf = gf + self._decay_term(pf)
         param_lr = p.optimize_attr.get("learning_rate", 1.0) if hasattr(p, "optimize_attr") else 1.0
         new_pf, new_slots = self._rule(
             pf, gf, self._accumulators[pid], lr * param_lr,
@@ -172,7 +184,7 @@ class Optimizer:
             pf = m if m is not None else p.astype(jnp.float32)
             gf = g.astype(jnp.float32)
             if self._weight_decay and not self._decoupled_weight_decay():
-                gf = gf + float(self._weight_decay) * pf
+                gf = gf + self._decay_term(pf)
             npf, ns = self._rule(pf, gf, s, lr_val,
                                  wd_scale=self._wd_scale_for(path))
             if skip_update is not None:

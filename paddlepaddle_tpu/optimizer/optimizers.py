@@ -318,7 +318,7 @@ class LBFGS(Optimizer):
         for p, g in params_grads:
             g = jnp.asarray(g, jnp.float32).reshape(p._data.shape)
             if self._weight_decay:
-                g = g + float(self._weight_decay) * p._data.astype(jnp.float32)
+                g = g + self._decay_term(p._data.astype(jnp.float32))
             gs.append(g)
         return self._flat(gs)
 

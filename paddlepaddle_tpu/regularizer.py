@@ -1,0 +1,25 @@
+"""paddle.regularizer (reference: python/paddle/regularizer.py): weight
+decay config objects optimizers accept via ``weight_decay=``. The base
+optimizer folds a float coefficient into the gradient (coupled L2) —
+these classes carry the coefficient plus the L1/L2 flavor."""
+
+from __future__ import annotations
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff: float = 0.0):
+        self._coeff = float(coeff)
+
+    def __float__(self):
+        return self._coeff
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self._coeff})"
+
+
+class L1Decay(WeightDecayRegularizer):
+    """|w| penalty: grad += coeff * sign(w)."""
+
+
+class L2Decay(WeightDecayRegularizer):
+    """0.5*coeff*||w||^2 penalty: grad += coeff * w (the optimizer default)."""

@@ -1,0 +1,6 @@
+"""paddle.signal namespace (reference: python/paddle/signal.py — stft/istft
+live both at paddle.signal.* and paddle.*)."""
+
+from .ops.longtail import istft, stft  # noqa: F401
+
+__all__ = ["stft", "istft"]

@@ -1,0 +1,74 @@
+"""paddle.static.nn (reference: python/paddle/static/nn/): the static-graph
+layer builders. Under the replay-graph static mode, ops execute eagerly at
+build time and the tape doubles as the Program, so a builder is: create
+Parameters, apply the functional op — the recorded node replays with feeds
+substituted exactly like any other op."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ...core.tensor import Parameter
+from ...nn import functional as F
+from ...nn.initializer import XavierNormal, _resolve_initializer
+from .. import default_main_program
+
+
+def _param(shape, attr, is_bias=False):
+    init = None
+    if attr is not None and not isinstance(attr, bool):
+        init = _resolve_initializer(getattr(attr, "initializer", attr))
+    if init is None:
+        from ...nn.initializer import Constant
+
+        init = Constant(0.0) if is_bias else XavierNormal()
+    p = Parameter(init(tuple(shape), "float32"))
+    prog = default_main_program()
+    if hasattr(prog, "_static_params"):
+        prog._static_params.append(p)
+    else:
+        prog._static_params = [p]
+    return p
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """Reference: static/nn/common.py fc — y = act(x @ W + b), creating the
+    parameters in the program."""
+    in_dim = 1
+    for d in x.shape[num_flatten_dims:]:
+        in_dim *= int(d)
+    xf = x.reshape([*x.shape[:num_flatten_dims], in_dim]) \
+        if len(x.shape) > num_flatten_dims + 1 else x
+    w = _param([in_dim, size], weight_attr)
+    out = xf @ w
+    if bias_attr is not False:
+        b = _param([size], bias_attr, is_bias=True)
+        out = out + b
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32"):
+    """Reference: static/nn/common.py embedding."""
+    w = _param(list(size), param_attr)
+    return w[input]
+
+
+def batch_norm(input, is_test=False, momentum=0.9, epsilon=1e-5, **kwargs):
+    """Reference: static/nn/common.py batch_norm — thin over the functional
+    op with freshly created scale/shift/running stats."""
+    import numpy as np
+
+    from ...core.tensor import Tensor
+
+    c = int(input.shape[1])
+    w = _param([c], None)
+    w._replace_data(w._data * 0 + 1)      # scale init 1
+    b = _param([c], None, is_bias=True)
+    rm = Tensor._from_data(np.zeros(c, np.float32))
+    rv = Tensor._from_data(np.ones(c, np.float32))
+    return F.batch_norm(input, rm, rv, w, b, training=not is_test,
+                        momentum=momentum, epsilon=epsilon)

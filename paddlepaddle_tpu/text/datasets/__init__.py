@@ -1,0 +1,4 @@
+"""paddle.text.datasets namespace (reference: python/paddle/text/datasets/):
+the dataset classes live in the parent text module here."""
+
+from .. import Imdb, LMDataset  # noqa: F401

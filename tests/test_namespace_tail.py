@@ -195,3 +195,89 @@ def test_fleet_submodule_import_paths():
     assert np.isfinite(float(loss.numpy()))
     scaler = HybridParallelGradScaler(init_loss_scaling=8.0)
     assert scaler is not None
+
+
+def test_round4_namespace_additions():
+    """signal/regularizer/callbacks/device/nn.utils/nn.quant/vision.ops/
+    static.nn/fleet.base import paths (reference user-script surface)."""
+    import numpy as np
+
+    import paddlepaddle_tpu.device as dev
+    import paddlepaddle_tpu.signal as sig
+    from paddlepaddle_tpu.callbacks import EarlyStopping  # noqa: F401
+    from paddlepaddle_tpu.distributed.fleet.base.role_maker import (
+        PaddleCloudRoleMaker)
+    from paddlepaddle_tpu.nn.quant import weight_dequantize, weight_quantize
+    from paddlepaddle_tpu.nn.utils import (parameters_to_vector,
+                                           vector_to_parameters, weight_norm)
+    from paddlepaddle_tpu.regularizer import L1Decay, L2Decay
+    from paddlepaddle_tpu.vision.ops import box_coder, nms, roi_align
+
+    assert not dev.cuda.is_available() and dev.cuda.device_count() == 0
+    x = paddle.to_tensor(np.random.randn(1, 128).astype(np.float32))
+    assert sig.stft(x, n_fft=32).shape[1] == 17
+    assert PaddleCloudRoleMaker().worker_num() >= 1
+
+    # L1 vs L2 decay fold semantics through a real SGD step
+    for reg, expect in ((L1Decay(0.1), lambda w: w - 0.01 * np.sign(w)),
+                        (L2Decay(0.1), lambda w: w - 0.01 * w)):
+        lin = paddle.nn.Linear(3, 2)
+        w0 = lin.weight.numpy().copy()
+        opt = paddle.optimizer.SGD(learning_rate=0.1, weight_decay=reg,
+                                   parameters=[lin.weight])
+        lin.weight._grad = paddle.to_tensor(np.zeros_like(w0))
+        opt.step()
+        np.testing.assert_allclose(lin.weight.numpy(), expect(w0),
+                                   rtol=1e-5, atol=1e-6)
+
+    # weight_norm: identity at init, g rescales, vector roundtrip
+    lin = paddle.nn.Linear(4, 3)
+    w0 = lin.weight.numpy().copy()
+    weight_norm(lin, "weight", dim=0)
+    np.testing.assert_allclose(lin.weight.numpy(), w0, rtol=1e-5, atol=1e-6)
+    vec = parameters_to_vector(lin.parameters())
+    vector_to_parameters(vec, lin.parameters())
+
+    # int8 weight quantize roundtrip
+    w = paddle.to_tensor(np.random.randn(8, 4).astype(np.float32))
+    q, s = weight_quantize(w)
+    back = weight_dequantize(q, s)
+    assert np.abs(back.numpy() - w.numpy()).max() < 0.05
+
+    # nms + box_coder basics
+    boxes = np.asarray([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]],
+                       np.float32)
+    keep = nms(paddle.to_tensor(boxes), 0.3,
+               paddle.to_tensor(np.asarray([0.9, 0.8, 0.7], np.float32)))
+    assert keep.numpy().tolist() == [0, 2]
+    feat = paddle.to_tensor(np.ones((1, 2, 8, 8), np.float32))
+    rois = paddle.to_tensor(np.asarray([[0, 0, 4, 4]], np.float32))
+    out = roi_align(feat, rois, paddle.to_tensor(np.asarray([1], np.int32)), 2)
+    np.testing.assert_allclose(out.numpy(), 1.0, rtol=1e-5)
+
+
+def test_static_nn_fc_trains():
+    """The reference's canonical static fc example under the replay
+    executor (static/nn/common.py fc)."""
+    import numpy as np
+
+    paddle.enable_static()
+    try:
+        import paddlepaddle_tpu.static as static
+        from paddlepaddle_tpu.static.nn import fc
+
+        x = static.data("x", [4, 3], "float32")
+        y = static.data("y", [4, 1], "float32")
+        pred = fc(fc(x, 8, activation="relu"), 1)
+        loss = ((pred - y) ** 2).mean()
+        opt = paddle.optimizer.SGD(learning_rate=0.05)
+        opt.minimize(loss)
+        exe = static.Executor()
+        rng = np.random.default_rng(0)
+        xv = rng.standard_normal((4, 3)).astype(np.float32)
+        yv = np.ones((4, 1), np.float32)
+        losses = [float(exe.run(feed={"x": xv, "y": yv},
+                                fetch_list=[loss])[0]) for _ in range(5)]
+        assert losses[-1] < losses[0], losses
+    finally:
+        paddle.disable_static()
