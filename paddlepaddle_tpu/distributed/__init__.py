@@ -34,6 +34,41 @@ from .env import (  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import communication  # noqa: F401
 from .communication import P2POp, batch_isend_irecv  # noqa: F401
+from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
+from .compat import (  # noqa: F401
+    CountFilterEntry,
+    DistAttr,
+    ReduceType,
+    ShardingStage1,
+    ShardingStage2,
+    ShardingStage3,
+    Strategy,
+    dtensor_from_fn,
+    shard_scaler,
+    unshard_dtensor,
+    InMemoryDataset,
+    ParallelEnv,
+    ParallelMode,
+    ProbabilityEntry,
+    QueueDataset,
+    ShowClickEntry,
+    alltoall,
+    alltoall_single,
+    broadcast_object_list,
+    destroy_process_group,
+    gather,
+    get_backend,
+    gloo_barrier,
+    gloo_init_parallel_env,
+    gloo_release,
+    is_available,
+    scatter_object_list,
+    spawn,
+    split,
+    wait,
+)
+from . import io  # noqa: F401
+from . import launch  # noqa: F401
 from . import rpc  # noqa: F401
 from .auto_tuner import AutoTuner, TuneConfig  # noqa: F401
 from .watchdog import Watchdog  # noqa: F401

@@ -190,8 +190,14 @@ def save_state_dict(state_dict: Dict[str, object], path: str,
     merges all rank records into the final ``metadata.json`` once every
     host's record appears on the (shared) checkpoint path. Values may also
     be ``LocalShards`` (explicit per-host shard lists)."""
-    pid = jax.process_index() if process_index is None else process_index
-    world = jax.process_count() if process_count is None else process_count
+    from ..env import get_rank, get_world_size
+
+    # env-aware rank/world (distributed.env): a spawn/launch-started eager
+    # job has per-process ranks while each process is a single-process jax
+    # runtime — jax.process_index() alone would make every child rank 0 and
+    # corrupt the shared save path
+    pid = get_rank() if process_index is None else process_index
+    world = get_world_size() if process_count is None else process_count
     epoch = _save_epochs.get((path, pid), 0)
     _save_epochs[(path, pid)] = epoch + 1
     os.makedirs(path, exist_ok=True)

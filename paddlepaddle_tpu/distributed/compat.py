@@ -1,0 +1,342 @@
+"""Reference-parity tail of the paddle.distributed namespace.
+
+Small APIs reference scripts use that map thinly onto the existing
+machinery (aliases, object-variant collectives, env info, a spawn
+launcher), plus presence-with-story stubs for the PS-only dataset classes
+SURVEY §2.7 documents out of TPU scope.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from enum import IntEnum
+from typing import List, Optional
+
+import numpy as np
+
+from . import collective as _c
+from .env import get_rank, get_world_size
+
+
+# -- aliases / simple variants ----------------------------------------------
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    """Reference alias of all_to_all."""
+    return _c.all_to_all(out_tensor_list, in_tensor_list, group=group,
+                         sync_op=sync_op)
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    """Single-tensor all_to_all: rows split evenly (or by split sizes)
+    across ranks (reference communication/all_to_all.py alltoall_single)."""
+    world = get_world_size()
+    if world <= 1:
+        out_tensor.set_value(in_tensor)
+        return out_tensor
+    n = in_tensor.shape[0]
+    if in_split_sizes is None:
+        if n % world:
+            raise ValueError(
+                f"alltoall_single: {n} rows not divisible by world size "
+                f"{world}; pass in_split_sizes")
+        in_split_sizes = [n // world] * world
+    if sum(in_split_sizes) != n:
+        raise ValueError(f"in_split_sizes {in_split_sizes} != {n} rows")
+    parts, off = [], 0
+    for sz in in_split_sizes:
+        parts.append(in_tensor[off:off + sz])
+        off += sz
+    outs = []            # all_to_all APPENDS received tensors
+    _c.all_to_all(outs, parts, group=group)
+    import paddlepaddle_tpu as paddle
+
+    out_tensor.set_value(paddle.concat(outs, axis=0))
+    return out_tensor
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """Collective gather to ``dst`` (reference communication/gather.py):
+    implemented as all_gather with non-dst ranks discarding."""
+    outs: List = []
+    _c.all_gather(outs, tensor, group=group)
+    if get_rank() == dst and gather_list is not None:
+        gather_list.clear()
+        gather_list.extend(outs)
+    return gather_list
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """Reference communication/broadcast.py broadcast_object_list."""
+    import paddlepaddle_tpu as paddle
+
+    payload = pickle.dumps(object_list) if get_rank() == src else b""
+    arr = np.frombuffer(payload, np.uint8).copy()
+    n = paddle.to_tensor(np.asarray([len(arr)], np.int64))
+    _c.broadcast(n, src=src, group=group)
+    buf = paddle.to_tensor(np.resize(arr, int(n.numpy()[0])).astype(np.uint8))
+    _c.broadcast(buf, src=src, group=group)
+    if get_rank() != src:
+        object_list[:] = pickle.loads(buf.numpy().tobytes())
+    return object_list
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """Reference communication/scatter.py scatter_object_list: src sends one
+    object per rank."""
+    world = get_world_size()
+    if world <= 1:
+        out_object_list[:] = [in_object_list[0]] if in_object_list else []
+        return out_object_list
+    all_objs: List = [None]
+    if get_rank() == src:
+        all_objs = [list(in_object_list)]
+    broadcast_object_list(all_objs, src=src, group=group)
+    out_object_list[:] = [all_objs[0][get_rank()]]
+    return out_object_list
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """Reference communication/wait.py: block until the tensor's producing
+    ops are visible — the dispatch queue drain under XLA."""
+    import jax
+
+    jax.effects_barrier()
+    return tensor
+
+
+def is_available() -> bool:
+    return True
+
+
+def get_backend(group=None) -> str:
+    return "xla"  # ICI/DCN via XLA collectives (the NCCL/GLOO role)
+
+
+def destroy_process_group(group=None):
+    """Reference parallel.py destroy_process_group: drop the host group so a
+    fresh init can rebuild it."""
+    from . import host_collectives as hc
+
+    hc._host_group = None
+    hc._probed = False
+
+
+class ParallelMode(IntEnum):
+    """Reference parallel.py ParallelMode."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+class ParallelEnv:
+    """Reference parallel.py ParallelEnv: launcher-environment view."""
+
+    @property
+    def rank(self) -> int:
+        return get_rank()
+
+    @property
+    def world_size(self) -> int:
+        return get_world_size()
+
+    @property
+    def local_rank(self) -> int:
+        return int(os.environ.get("PADDLE_LOCAL_RANK", self.rank))
+
+    @property
+    def device_id(self) -> int:
+        return self.local_rank
+
+    nranks = world_size
+    dev_id = device_id
+
+    @property
+    def current_endpoint(self) -> str:
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:0")
+
+    @property
+    def trainer_endpoints(self) -> List[str]:
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else []
+
+
+# -- gloo shims (host-group over the native store plays the gloo role) ------
+
+def gloo_init_parallel_env(rank_id=None, rank_num=None, server_endpoint=None):
+    from .host_collectives import get_host_group
+
+    if rank_id is not None:
+        os.environ.setdefault("PADDLE_TRAINER_ID", str(rank_id))
+    if rank_num is not None:
+        os.environ.setdefault("PADDLE_TRAINERS_NUM", str(rank_num))
+    get_host_group()
+
+
+def gloo_barrier():
+    from .host_collectives import get_host_group
+
+    g = get_host_group()
+    if g is not None:
+        g.barrier()
+
+
+def gloo_release():
+    destroy_process_group()
+
+
+# -- spawn launcher ----------------------------------------------------------
+
+def spawn(func, args=(), nprocs=1, join=True, daemon=False, **options):
+    """Reference spawn.py: run ``func`` in ``nprocs`` processes with the
+    launch environment set per rank (MASTER_ADDR/PORT + rank/world), using
+    the multiprocessing spawn context so jax state is not forked."""
+    import multiprocessing as mp
+    import socket
+
+    if nprocs <= 1:
+        func(*args)
+        return None
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        env = {
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(nprocs),
+            "MASTER_ADDR": "127.0.0.1",
+            "MASTER_PORT": str(port),
+            "PADDLE_LOCAL_RANK": str(rank),
+        }
+        p = ctx.Process(target=_spawn_entry, args=(func, args, env),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        bad = [p.exitcode for p in procs if p.exitcode]
+        if bad:
+            raise RuntimeError(f"spawn: child exit codes {bad}")
+    return procs
+
+
+def _spawn_entry(func, args, env):
+    os.environ.update(env)
+    func(*args)
+
+
+# -- TP layer splitter (legacy static-graph API) -----------------------------
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    raise NotImplementedError(
+        "paddle.distributed.split is the legacy static-graph TP builder; "
+        "use parallel.mpu.ColumnParallelLinear / RowParallelLinear / "
+        "VocabParallelEmbedding (dist_spec sharding does the splitting)")
+
+
+class DistAttr:
+    """Legacy dist attr (reference auto_parallel/api.py DistAttr): carries
+    (mesh, sharding_specs); shard_tensor consumes the modern placements
+    form, so this is a thin record."""
+
+    def __init__(self, mesh=None, sharding_specs=None):
+        self.process_mesh = mesh
+        self.sharding_specs = sharding_specs
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    """Reference auto_parallel/api.py dtensor_from_fn: build locally, then
+    shard onto the mesh."""
+    from .sharding_api import shard_tensor
+
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+# -- PS-only dataset surface (documented out of TPU scope, SURVEY §2.7) ------
+
+def _ps_stub(name):
+    class _Stub:
+        def __init__(self, *a, **k):
+            raise NotImplementedError(
+                f"{name} belongs to the parameter-server stack "
+                "(paddle/fluid/distributed/ps/), documented out of the "
+                "TPU-v1 scope — see SURVEY.md §2.7 / PARITY.md")
+
+    _Stub.__name__ = name
+    return _Stub
+
+
+QueueDataset = _ps_stub("QueueDataset")
+InMemoryDataset = _ps_stub("InMemoryDataset")
+CountFilterEntry = _ps_stub("CountFilterEntry")
+ShowClickEntry = _ps_stub("ShowClickEntry")
+ProbabilityEntry = _ps_stub("ProbabilityEntry")
+
+
+# -- auto-parallel API tail ---------------------------------------------------
+
+class ReduceType:
+    """Reference auto_parallel ReduceType (Partial placement reduce kinds)."""
+
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+from .sharding_api import (  # noqa: F401  (one hierarchy, re-exported)
+    ShardingStage1,
+    ShardingStage2,
+    ShardingStage3,
+)
+
+
+class _StrategyBag:
+    def __init__(self):
+        self.enable = False
+
+
+class Strategy:
+    """Reference auto_parallel Strategy: config bag for to_static/engine
+    flows (sharding/amp/recompute knobs)."""
+
+    def __init__(self, config=None):
+        self.sharding = _StrategyBag()
+        self.amp = _StrategyBag()
+        self.recompute = _StrategyBag()
+        self.pipeline = _StrategyBag()
+        self.gradient_merge = _StrategyBag()
+        if config:
+            for k, v in dict(config).items():
+                setattr(self, k, v)
+
+
+def shard_scaler(scaler):
+    """Reference auto_parallel shard_scaler: the GradScaler's found_inf is
+    already MAX-reduced across hosts here, so sharding it is the identity."""
+    return scaler
+
+
+def unshard_dtensor(dist_tensor):
+    """Reference auto_parallel unshard_dtensor: gather a sharded tensor to a
+    replicated local value."""
+    import jax
+
+    from ..core.tensor import Tensor
+
+    arr = dist_tensor._data if isinstance(dist_tensor, Tensor) else dist_tensor
+    import numpy as _np
+
+    return Tensor._from_data(_np.asarray(jax.device_get(arr)))

@@ -40,10 +40,22 @@ def is_initialized():
 
 
 def get_rank(group=None) -> int:
+    # launcher env first (reference parallel.py semantics): a spawned /
+    # launched eager job has per-process ranks even though each process is
+    # its own single-process jax runtime. Only OUR launcher's PADDLE_* names
+    # are trusted — a stale torchrun RANK/WORLD_SIZE in the shell must not
+    # lie about the world (host_collectives pins PADDLE_* from RANK when a
+    # torch-style job actually rendezvouses).
+    r = os.environ.get("PADDLE_TRAINER_ID")
+    if r is not None:
+        return int(r)
     return jax.process_index()
 
 
 def get_world_size(group=None) -> int:
+    w = os.environ.get("PADDLE_TRAINERS_NUM")
+    if w is not None:
+        return int(w)
     return jax.process_count()
 
 

@@ -1,0 +1,62 @@
+"""paddle.distributed.io (reference: python/paddle/distributed/io.py —
+save/load of (distributed) persistables for the static/fleet flows). The
+sharded-checkpoint machinery (distributed/checkpoint) is the real path;
+these wrappers keep the reference call shapes, with a shape manifest so a
+reordered program cannot silently load weights into the wrong
+parameters."""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def save_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None, **kw):
+    """Save every trainable parameter recorded on the (replay) program,
+    with a manifest of shapes/dtypes for load-time validation."""
+    import numpy as np
+
+    from ..static import default_main_program
+
+    prog = main_program or default_main_program()
+    params = getattr(prog, "_static_params", []) or []
+    os.makedirs(dirname, exist_ok=True)
+    manifest = []
+    for i, p in enumerate(params):
+        arr = np.asarray(p.numpy())
+        np.save(os.path.join(dirname, f"param_{i}.npy"), arr)
+        manifest.append({"index": i, "shape": list(arr.shape),
+                         "dtype": str(arr.dtype)})
+    with open(os.path.join(dirname, "persistables.json"), "w") as f:
+        json.dump(manifest, f)
+    return len(params)
+
+
+def load_persistables(executor=None, dirname=None, main_program=None, **kw):
+    """Load parameters saved by save_persistables; raises on a count or
+    shape mismatch instead of silently loading into the wrong weights."""
+    import numpy as np
+
+    from ..static import default_main_program
+
+    prog = main_program or default_main_program()
+    params = getattr(prog, "_static_params", []) or []
+    mf_path = os.path.join(dirname, "persistables.json")
+    if not os.path.exists(mf_path):
+        raise FileNotFoundError(f"no persistables manifest in {dirname}")
+    with open(mf_path) as f:
+        manifest = json.load(f)
+    if len(manifest) != len(params):
+        raise ValueError(
+            f"checkpoint has {len(manifest)} persistables but the program "
+            f"created {len(params)} — programs must match to load")
+    for rec, p in zip(manifest, params):
+        if list(p.shape) != rec["shape"]:
+            raise ValueError(
+                f"param_{rec['index']}: checkpoint shape {rec['shape']} != "
+                f"program shape {list(p.shape)} — parameter creation order "
+                "differs; rebuild the program to match the save")
+        p.set_value(np.load(os.path.join(dirname,
+                                         f"param_{rec['index']}.npy")))
+    return len(params)

@@ -281,3 +281,32 @@ def test_static_nn_fc_trains():
         assert losses[-1] < losses[0], losses
     finally:
         paddle.disable_static()
+
+
+
+def test_major_submodule_namespaces_closed():
+    """nn / nn.functional / distributed / incubate __all__ closure vs the
+    reference (438-name top-level closure is the sibling test)."""
+    import ast
+    import os
+
+    def ref_all(path):
+        tree = ast.parse(open(path).read())
+        return [e.value for n in ast.walk(tree) if isinstance(n, ast.Assign)
+                for t in n.targets
+                if isinstance(t, ast.Name) and t.id == "__all__"
+                for e in ast.walk(n.value)
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+
+    base = "/root/reference/python/paddle"
+    if not os.path.exists(base):
+        import pytest as _pytest
+
+        _pytest.skip("reference tree not present")
+    for rel, mod in [("nn/__init__.py", paddle.nn),
+                     ("nn/functional/__init__.py", paddle.nn.functional),
+                     ("distributed/__init__.py", paddle.distributed),
+                     ("incubate/__init__.py", paddle.incubate)]:
+        ra = ref_all(f"{base}/{rel}")
+        missing = sorted(n for n in ra if not hasattr(mod, n))
+        assert missing == [], f"{rel}: {missing}"
