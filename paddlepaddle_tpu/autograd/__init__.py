@@ -107,3 +107,21 @@ def functional_hessian(func, *xs):
     f = lambda *a: unwrap(func(*[wrap(x) for x in a]))
     h = jax.hessian(f, argnums=tuple(range(len(xs))))(*[unwrap(x) for x in xs])
     return jax.tree_util.tree_map(wrap, h)
+
+
+from ..incubate.autograd import hessian  # noqa: F401,E402
+
+
+class saved_tensors_hooks:
+    """Reference autograd/saved_tensors_hooks: pack/unpack hooks over
+    forward residuals (CPU-offload tricks). The lazy-vjp tape keeps primal
+    ARRAYS on device and XLA owns their lifetime, so rewriting residual
+    storage is not supported — use recompute (fleet.utils.recompute /
+    jax.checkpoint) for the memory trade instead."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        raise NotImplementedError(
+            "saved_tensors_hooks rewrites autograd residual storage; on "
+            "this backend use recompute (fleet.utils.recompute or "
+            "distributed.fleet.recompute over jax.checkpoint) for "
+            "activation-memory trades")

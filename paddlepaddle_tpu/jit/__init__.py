@@ -1,5 +1,13 @@
 """``jit`` — XLA compilation of dygraph code (reference: python/paddle/jit/)."""
 
-from .api import StaticFunction, enable_to_static, ignore_module, not_to_static, to_static  # noqa: F401
+from .api import (  # noqa: F401
+    StaticFunction,
+    enable_to_static,
+    ignore_module,
+    not_to_static,
+    set_code_level,
+    set_verbosity,
+    to_static,
+)
 from .save_load import TranslatedLayer, load, save  # noqa: F401
 from .train import TrainStep  # noqa: F401

@@ -135,3 +135,21 @@ def enable_to_static(enable):
 
 def ignore_module(modules):
     """No-op: there is no AST transformer to exclude modules from."""
+
+
+
+_verbosity = 0
+_code_level = 0
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """Reference dy2static logging verbosity knob (transform logging here
+    is minimal; the level is stored and honored by future diagnostics)."""
+    global _verbosity
+    _verbosity = int(level)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Reference knob: how much transformed code to log."""
+    global _code_level
+    _code_level = int(level)

@@ -287,6 +287,18 @@ def _unary(np_name):
 # the reference's sparse unary op set (python/paddle/sparse/unary.py) — all
 # zero-preserving, so they act on values only and keep the pattern
 sin = _unary("sin")
+deg2rad = _unary("deg2rad")
+rad2deg = _unary("rad2deg")
+
+
+def isnan(x, name=None):
+    """NaN mask with the input's sparsity pattern. Stored as uint8 (jax's
+    BCOO todense scatter-adds, which rejects bool data); truthiness
+    semantics match the reference's bool mask."""
+    coo = x._coo().sum_duplicates()
+    out = jsparse.BCOO((jnp.isnan(coo.data).astype(jnp.uint8), coo.indices),
+                       shape=coo.shape)
+    return _like(x, out)
 tan = _unary("tan")
 asin = _unary("arcsin")
 atan = _unary("arctan")
@@ -555,3 +567,46 @@ def attention(query, key, value, sparse_mask, key_padding_mask=None,
 
 
 nn.functional = type("functional", (), {"attention": staticmethod(attention)})
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001
+    """Sparse slice (reference sparse/unary.py slice): filter the COO
+    pattern to the window and shift indices."""
+    import numpy as _np
+
+    coo = x._coo().sum_duplicates()
+    idx = _np.asarray(coo.indices)
+    vals = jnp.asarray(coo.data)
+    shape = list(coo.shape)
+    keep = _np.ones(idx.shape[0], bool)
+    new_shape = list(shape)
+    offs = _np.zeros(len(shape), _np.int64)
+    for ax, st, en in zip(axes, starts, ends):
+        ax = int(ax)
+        st = int(st) if st >= 0 else int(st) + shape[ax]
+        en = int(en) if en >= 0 else int(en) + shape[ax]
+        st = min(max(st, 0), shape[ax])          # reference clamps the window
+        en = min(max(en, st), shape[ax])
+        keep &= (idx[:, ax] >= st) & (idx[:, ax] < en)
+        offs[ax] = st
+        new_shape[ax] = en - st
+    nidx = idx[keep] - offs[None, :]
+    out = jsparse.BCOO((vals[_np.where(keep)[0]], jnp.asarray(nidx)),
+                       shape=tuple(new_shape))
+    return _like(x, out)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Reference sparse/multiary? (python/paddle/sparse) pca_lowrank: the
+    factorization itself is dense math — materialize, then thin SVD."""
+    dense = x.to_dense() if hasattr(x, "to_dense") else x
+    a = dense._data.astype(jnp.float32)
+    if q is None:
+        q = min(6, a.shape[-2], a.shape[-1])
+    if center:
+        a = a - jnp.mean(a, axis=-2, keepdims=True)
+    u, s_, vt = jnp.linalg.svd(a, full_matrices=False)
+    from ..core.tensor import Tensor as _T
+
+    return (_T._from_data(u[..., :q]), _T._from_data(s_[..., :q]),
+            _T._from_data(jnp.swapaxes(vt, -1, -2)[..., :q]))

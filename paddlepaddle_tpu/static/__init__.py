@@ -205,34 +205,44 @@ class Executor:
         pass
 
 
-# re-exported nn helpers the reference keeps under paddle.static.nn
-class nn:  # noqa: N801 — module-like namespace
-    @staticmethod
-    def fc(x, size, num_flatten_dims=1, activation=None, name=None):
-        from ..nn.common import Linear
-
-        in_features = int(np.prod(x.shape[num_flatten_dims:]))
-        layer = Linear(in_features, size)
-        if len(x.shape) == num_flatten_dims + 1:
-            out = layer(x)
-        else:
-            # contract the trailing dims WITHOUT reshape so no batch dim is
-            # baked into the tape — Executor.run can then replay with any
-            # fed batch size (static.data None dims are placeholder-1)
-            from ..core.dispatch import apply_op
-
-            k = len(x.shape) - num_flatten_dims
-            w = layer.weight.reshape(list(x.shape[num_flatten_dims:]) + [size])
-
-            def contract(xa, wa, ba):
-                import jax.numpy as jnp
-
-                out = jnp.tensordot(xa, wa, axes=k)
-                return out + ba if ba is not None else out
-
-            out = apply_op(contract, x, w, layer.bias, op_name="fc_tensordot")
-        if activation == "relu":
-            out = F.relu(out)
-        elif activation == "softmax":
-            out = F.softmax(out)
-        return out
+from . import nn  # noqa: F401,E402
+from .compat import (  # noqa: F401,E402
+    BuildStrategy,
+    CompiledProgram,
+    ExponentialMovingAverage,
+    IpuCompiledProgram,
+    IpuStrategy,
+    Print,
+    Scope,
+    WeightNormParamAttr,
+    accuracy,
+    append_backward,
+    auc,
+    cpu_places,
+    create_global_var,
+    create_parameter,
+    ctr_metric_bundle,
+    cuda_places,
+    deserialize_persistables,
+    deserialize_program,
+    device_guard,
+    global_scope,
+    gradients,
+    ipu_shard_guard,
+    load,
+    load_from_file,
+    load_inference_model,
+    load_program_state,
+    name_scope,
+    normalize_program,
+    py_func,
+    save,
+    save_inference_model,
+    save_to_file,
+    scope_guard,
+    serialize_persistables,
+    serialize_program,
+    set_ipu_shard,
+    set_program_state,
+    xpu_places,
+)

@@ -1,0 +1,337 @@
+"""Static-graph namespace tail (reference: python/paddle/static/__init__.py).
+
+The replay-graph executor (static/__init__.py) carries the training
+semantics; this module fills the rest of the reference surface — program
+serialization over the replay-param manifest, scopes/places/guards that
+map onto the single-runtime model, metrics, EMA — and raises with the
+story for the IPU- and PS-specific leftovers."""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+# -- scopes / places / guards ------------------------------------------------
+
+class Scope:
+    """Variable scope (reference global_scope): name -> Tensor."""
+
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        return self._vars.setdefault(name, Tensor(np.zeros((), np.float32)))
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope: Scope):
+    global _global_scope
+    old, _global_scope = _global_scope, scope
+    try:
+        yield scope
+    finally:
+        _global_scope = old
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    """Reference name_scope: op-name prefixes are cosmetic here (XLA names
+    come from the dispatcher); kept as a no-op context."""
+    yield
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """Reference device_guard: XLA owns placement; a context no-op."""
+    yield
+
+
+def cpu_places(device_count=None):
+    from ..core.device import CPUPlace
+
+    n = device_count or int(os.environ.get("CPU_NUM", 1))
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    return []  # no CUDA devices on this backend (honest, like device.cuda)
+
+
+def xpu_places(device_ids=None):
+    return []
+
+
+# -- program compilation shims ----------------------------------------------
+
+class BuildStrategy:
+    """Reference BuildStrategy: pass-selection knobs — XLA's pipeline is
+    fixed, so the bag records settings without effect (to_static warns the
+    same way)."""
+
+    def __init__(self):
+        self.memory_optimize = None
+        self.enable_inplace = None
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.build_cinn_pass = False
+
+
+class CompiledProgram:
+    """Reference CompiledProgram(program): under the replay executor a
+    program is already executable; the wrapper keeps the call shape."""
+
+    def __init__(self, program, build_strategy: Optional[BuildStrategy] = None):
+        self._program = program
+        self._build_strategy = build_strategy
+
+    def __getattr__(self, name):
+        if name == "_program":
+            raise AttributeError(name)
+        return getattr(self._program, name)
+
+
+# -- ops ----------------------------------------------------------------------
+
+def Print(input, first_n=-1, message=None, summarize=20, **kw):
+    """Reference static Print op: eager print at build/replay time."""
+    val = np.asarray(input.numpy() if isinstance(input, Tensor) else input)
+    flat = val.ravel() if summarize < 0 else val.ravel()[:summarize]
+    msg = f"{message or 'Variable'}: {np.array2string(flat)}"
+    print(msg)
+    return input
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Reference py_func: run a python function as an op. Routed through the
+    dispatcher so the replay graph records it; the optional backward_func
+    becomes a custom vjp."""
+    from ..utils.custom_op import CustomOp
+
+    op = CustomOp(getattr(func, "__name__", "py_func"), func,
+                  backward=(lambda ct, *args, out=None:
+                            backward_func(*args, ct)) if backward_func else None)
+    result = op(*(x if isinstance(x, (list, tuple)) else [x]))
+    if out is not None and isinstance(out, Tensor):
+        out.set_value(result if not isinstance(result, (list, tuple))
+                      else result[0])
+    return result
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    from ..core.dtype import convert_dtype
+
+    return Tensor(np.full(shape, value, dtype=convert_dtype(dtype)))
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from .nn import _param
+
+    return _param(list(shape), attr, is_bias=is_bias, dtype=dtype,
+                  default_initializer=default_initializer)
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    from ..metric import accuracy as _acc
+
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, **kw):
+    """Returns (auc, batch_auc, states) like the reference static.auc."""
+    from ..metric import Auc
+
+    m = Auc(num_thresholds=min(num_thresholds, 4095))
+    m.update(np.asarray(input.numpy()), np.asarray(label.numpy()))
+    val = Tensor(np.asarray(m.accumulate(), np.float32))
+    return val, val, []
+
+
+# -- save/load ----------------------------------------------------------------
+
+def save(program, model_path, protocol=4, **configs):
+    """Reference static.save: persistables + program manifest."""
+    from ..distributed.io import save_persistables
+
+    save_persistables(dirname=model_path + ".pdparams.d",
+                      main_program=program)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    from ..distributed.io import load_persistables
+
+    load_persistables(dirname=model_path + ".pdparams.d",
+                      main_program=program)
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
+                         program=None, **kwargs):
+    raise NotImplementedError(
+        "static save_inference_model serializes a ProgramDesc; the portable "
+        "artifact here is StableHLO — use paddle.jit.save(layer, path, "
+        "input_spec=...) (jit/save_load.py), which inference.Config/"
+        "create_predictor and the C API consume")
+
+
+def load_inference_model(path_prefix, executor, **kwargs):
+    raise NotImplementedError(
+        "use paddle.jit.load(path) / inference.create_predictor for "
+        "StableHLO artifacts (see save_inference_model)")
+
+
+def _stablehlo_story(name):
+    def f(*a, **k):
+        raise NotImplementedError(
+            f"static.{name} serializes PIR ProgramDescs; programs here are "
+            "replay graphs + StableHLO exports (paddle.jit.save/load)")
+
+    f.__name__ = name
+    return f
+
+
+serialize_program = _stablehlo_story("serialize_program")
+serialize_persistables = _stablehlo_story("serialize_persistables")
+deserialize_program = _stablehlo_story("deserialize_program")
+deserialize_persistables = _stablehlo_story("deserialize_persistables")
+normalize_program = _stablehlo_story("normalize_program")
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content if isinstance(content, bytes) else bytes(content))
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def load_program_state(model_path, var_list=None):
+    import json
+
+    d = model_path + ".pdparams.d"
+    with open(os.path.join(d, "persistables.json")) as f:
+        manifest = json.load(f)
+    return {f"param_{r['index']}": np.load(
+        os.path.join(d, f"param_{r['index']}.npy")) for r in manifest}
+
+
+def set_program_state(program, state_dict):
+    params = getattr(program, "_static_params", []) or []
+    for i, p in enumerate(params):
+        key = f"param_{i}"
+        if key in state_dict:
+            p.set_value(state_dict[key])
+
+
+# -- gradients / EMA ----------------------------------------------------------
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Reference append_backward: under the replay model the executor
+    differentiates at run time (minimize records the pair); building an
+    explicit grad-op list has no replay-graph meaning."""
+    raise NotImplementedError(
+        "append_backward builds explicit grad ops into a ProgramDesc; the "
+        "replay executor differentiates at run time — use "
+        "optimizer.minimize(loss) (static/__init__.py) or eager "
+        "loss.backward()")
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    raise NotImplementedError(
+        "static.gradients queries a ProgramDesc grad graph; use "
+        "paddle.grad(outputs, inputs) on the eager tape (same math, "
+        "run-time differentiation)")
+
+
+class WeightNormParamAttr:
+    """Reference WeightNormParamAttr (static weight-norm config): carried
+    for API parity; the dygraph path is nn.utils.weight_norm."""
+
+    def __init__(self, dim=None, name=None, initializer=None, **kw):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+
+
+class ExponentialMovingAverage:
+    """Reference static ExponentialMovingAverage: shadow weights updated as
+    ema = decay*ema + (1-decay)*param, with apply/restore swaps."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = float(decay)
+        self._shadow = {}
+        self._backup = {}
+        self._params = []
+
+    def _track(self, params):
+        for p in params:
+            if id(p) not in self._shadow:
+                self._params.append(p)
+                self._shadow[id(p)] = jnp.asarray(p._data,
+                                                  jnp.float32)
+
+    def update(self, parameters=None):
+        if parameters is None:
+            from . import default_main_program
+
+            parameters = getattr(default_main_program(), "_static_params",
+                                 []) or []
+        self._track(parameters)
+        d = self._decay
+        for p in self._params:
+            self._shadow[id(p)] = (d * self._shadow[id(p)]
+                                   + (1 - d) * p._data.astype(jnp.float32))
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        for p in self._params:
+            self._backup[id(p)] = p._data
+            p._replace_data(self._shadow[id(p)].astype(p._data.dtype))
+        try:
+            yield self
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._replace_data(self._backup.pop(id(p)))
+
+
+def _ipu_story(name):
+    def f(*a, **k):
+        raise NotImplementedError(
+            f"{name} is Graphcore-IPU-specific in the reference; no IPU "
+            "path exists on this backend")
+
+    f.__name__ = name
+    return f
+
+
+ipu_shard_guard = _ipu_story("ipu_shard_guard")
+IpuCompiledProgram = _ipu_story("IpuCompiledProgram")
+IpuStrategy = _ipu_story("IpuStrategy")
+set_ipu_shard = _ipu_story("set_ipu_shard")
+ctr_metric_bundle = _ipu_story("ctr_metric_bundle")  # PS metric bundle

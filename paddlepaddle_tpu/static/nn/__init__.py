@@ -14,15 +14,20 @@ from ...nn.initializer import XavierNormal, _resolve_initializer
 from .. import default_main_program
 
 
-def _param(shape, attr, is_bias=False):
+def _param(shape, attr, is_bias=False, dtype="float32",
+           default_initializer=None):
     init = None
     if attr is not None and not isinstance(attr, bool):
         init = _resolve_initializer(getattr(attr, "initializer", attr))
     if init is None:
+        init = default_initializer
+    if init is None:
         from ...nn.initializer import Constant
 
         init = Constant(0.0) if is_bias else XavierNormal()
-    p = Parameter(init(tuple(shape), "float32"))
+    from ...core.dtype import convert_dtype
+
+    p = Parameter(init(tuple(shape), convert_dtype(dtype or "float32")))
     prog = default_main_program()
     if hasattr(prog, "_static_params"):
         prog._static_params.append(p)
@@ -34,17 +39,31 @@ def _param(shape, attr, is_bias=False):
 def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
        activation=None, name=None):
     """Reference: static/nn/common.py fc — y = act(x @ W + b), creating the
-    parameters in the program."""
+    parameters in the program. The trailing dims are contracted with
+    tensordot instead of reshape so NO batch dim is baked into the replay
+    tape — Executor.run replays with any fed batch size (static.data None
+    dims are placeholder-1)."""
+    from ...core.dispatch import apply_op
+
+    k = len(x.shape) - num_flatten_dims
+    trailing = [int(d) for d in x.shape[num_flatten_dims:]]
     in_dim = 1
-    for d in x.shape[num_flatten_dims:]:
-        in_dim *= int(d)
-    xf = x.reshape([*x.shape[:num_flatten_dims], in_dim]) \
-        if len(x.shape) > num_flatten_dims + 1 else x
+    for d in trailing:
+        in_dim *= d
+    # weight stays 2-D [prod(trailing), size] — the reference's fc layout,
+    # so checkpoints match — and reshapes to N-D inside the op (weight dims
+    # are static, only the BATCH dim must stay un-baked)
     w = _param([in_dim, size], weight_attr)
-    out = xf @ w
-    if bias_attr is not False:
-        b = _param([size], bias_attr, is_bias=True)
-        out = out + b
+    b = _param([size], bias_attr, is_bias=True) if bias_attr is not False \
+        else None
+
+    def contract(xa, wa, ba):
+        import jax.numpy as jnp
+
+        out = jnp.tensordot(xa, wa.reshape(trailing + [size]), axes=k)
+        return out + ba if ba is not None else out
+
+    out = apply_op(contract, x, w, b, op_name="fc_tensordot")
     if activation:
         out = getattr(F, activation)(out)
     return out

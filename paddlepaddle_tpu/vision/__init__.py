@@ -40,3 +40,5 @@ def image_load(path, backend=None):
 
         return wrap(_jnp.asarray(_np.asarray(img)))
     return img
+
+from . import ops  # noqa: F401

@@ -228,3 +228,187 @@ def yolo_box(*a, **k):
 
 
 yolo_loss = yolo_box
+
+
+
+class RoIAlign:
+    """Layer form of roi_align (reference vision/ops.py RoIAlign)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale)
+
+
+class RoIPool:
+    """Layer form of roi_pool (reference vision/ops.py RoIPool)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    """Reference vision/ops.py distribute_fpn_proposals: assign each roi to
+    an FPN level by sqrt(area) (FPN paper eq. 1), returning per-level roi
+    lists + the restore index."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+
+    rois = np.asarray(fpn_rois.numpy() if isinstance(fpn_rois, Tensor)
+                      else fpn_rois)
+    off = 1.0 if pixel_offset else 0.0
+    w = rois[:, 2] - rois[:, 0] + off
+    h = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.maximum(w * h, 1e-12))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-9)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    outs, nums, order = [], [], []
+    for L in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == L)[0]
+        order.append(idx)
+        outs.append(Tensor._from_data(jnp.asarray(rois[idx])))
+        nums.append(Tensor._from_data(jnp.asarray(
+            np.asarray([len(idx)], np.int32))))
+    restore = np.argsort(np.concatenate(order)) if order else np.zeros(0)
+    return outs, Tensor._from_data(jnp.asarray(restore.astype(np.int32))), nums
+
+
+def read_file(filename, name=None):
+    """Reference vision/ops.py read_file: raw bytes as a uint8 tensor."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+
+    with open(filename, "rb") as f:
+        data = f.read()
+    return Tensor._from_data(jnp.asarray(np.frombuffer(data, np.uint8)))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Reference vision/ops.py decode_jpeg (nvjpeg there): decoded via PIL
+    when available — CHW uint8 like the reference."""
+    import io as _io
+
+    import numpy as np
+
+    from ..core.tensor import Tensor
+
+    try:
+        from PIL import Image
+    except ImportError:
+        raise NotImplementedError(
+            "decode_jpeg needs Pillow (the reference needs nvjpeg); install "
+            "pillow or decode outside the framework") from None
+    raw = np.asarray(x.numpy() if isinstance(x, Tensor) else x,
+                     np.uint8).tobytes()
+    img = Image.open(_io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    # mode == "unchanged": keep the file's native channel count
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor._from_data(jnp.asarray(arr))
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """Reference vision/ops.py prior_box (SSD anchors): one (box, variance)
+    pair per feature-map cell x anchor shape."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+
+    H, W = int(input.shape[2]), int(input.shape[3])
+    img_h, img_w = int(image.shape[2]), int(image.shape[3])
+    step_w = steps[0] or img_w / W
+    step_h = steps[1] or img_h / H
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if ar != 1.0:
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    boxes = []
+    for y in range(H):
+        for x_ in range(W):
+            cx = (x_ + offset) * step_w
+            cy = (y + offset) * step_h
+            cell = []
+            for k, ms in enumerate(min_sizes):
+                def _box(half_w, half_h):
+                    return [(cx - half_w) / img_w, (cy - half_h) / img_h,
+                            (cx + half_w) / img_w, (cy + half_h) / img_h]
+
+                cell.append(_box(ms / 2, ms / 2))        # ar = 1 min box
+                max_box = None
+                if max_sizes:
+                    s = np.sqrt(ms * max_sizes[k]) / 2
+                    max_box = _box(s, s)
+                if min_max_aspect_ratios_order and max_box is not None:
+                    cell.append(max_box)                 # reference order A
+                for ar in ars:
+                    if ar == 1.0:
+                        continue
+                    cell.append(_box(ms * np.sqrt(ar) / 2,
+                                     ms / np.sqrt(ar) / 2))
+                if not min_max_aspect_ratios_order and max_box is not None:
+                    cell.append(max_box)                 # reference order B
+            boxes.append(cell)
+    out = np.asarray(boxes, np.float32).reshape(H, W, -1, 4)
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    return Tensor._from_data(jnp.asarray(out)), Tensor._from_data(
+        jnp.asarray(var))
+
+
+def _detector_stub(name, why):
+    def f(*a, **k):
+        raise NotImplementedError(f"{name}: {why}")
+
+    f.__name__ = name
+    return f
+
+
+matrix_nms = _detector_stub(
+    "matrix_nms", "soft-suppression variant; compose nms + score decay or "
+    "register the decay math as a custom op (paddle.utils.register_op)")
+generate_proposals = _detector_stub(
+    "generate_proposals", "RPN decode pipeline; compose box_coder + clip + "
+    "nms (all implemented) for the same result")
+psroi_pool = _detector_stub(
+    "psroi_pool", "position-sensitive pooling is R-FCN-specific; roi_align "
+    "covers the modern detector path")
+
+
+class PSRoIPool:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "PSRoIPool: position-sensitive pooling is R-FCN-specific; "
+            "RoIAlign covers the modern detector path")
+
+
+class DeformConv2D:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "DeformConv2D's data-dependent sampling offsets defeat XLA's "
+            "static-gather lowering (CUDA-specific in the reference)")

@@ -306,7 +306,15 @@ def test_major_submodule_namespaces_closed():
     for rel, mod in [("nn/__init__.py", paddle.nn),
                      ("nn/functional/__init__.py", paddle.nn.functional),
                      ("distributed/__init__.py", paddle.distributed),
-                     ("incubate/__init__.py", paddle.incubate)]:
+                     ("incubate/__init__.py", paddle.incubate),
+                     ("static/__init__.py", paddle.static),
+                     ("vision/ops.py", paddle.vision.ops),
+                     ("sparse/__init__.py", paddle.sparse),
+                     ("jit/__init__.py", paddle.jit),
+                     ("autograd/__init__.py", paddle.autograd),
+                     ("amp/__init__.py", paddle.amp),
+                     ("fft.py", paddle.fft),
+                     ("signal.py", paddle.signal)]:
         ra = ref_all(f"{base}/{rel}")
         missing = sorted(n for n in ra if not hasattr(mod, n))
         assert missing == [], f"{rel}: {missing}"
