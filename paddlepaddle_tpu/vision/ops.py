@@ -389,12 +389,157 @@ def _detector_stub(name, why):
     return f
 
 
-matrix_nms = _detector_stub(
-    "matrix_nms", "soft-suppression variant; compose nms + score decay or "
-    "register the decay math as a custom op (paddle.utils.register_op)")
-generate_proposals = _detector_stub(
-    "generate_proposals", "RPN decode pipeline; compose box_coder + clip + "
-    "nms (all implemented) for the same result")
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Matrix NMS (reference vision/ops.py matrix_nms, SOLOv2): instead of
+    hard suppression, every box's score decays by the most-suppressive
+    higher-scored box of its class — one IoU matrix, no sequential loop.
+    bboxes [N, M, 4], scores [N, C, M]. Returns (out [K, 6] rows of
+    (label, score, x1, y1, x2, y2), rois_num, index?) like the reference."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+
+    bx = np.asarray(bboxes.numpy() if isinstance(bboxes, Tensor) else bboxes,
+                    np.float32)
+    sc = np.asarray(scores.numpy() if isinstance(scores, Tensor) else scores,
+                    np.float32)
+    norm = 0.0 if normalized else 1.0
+    outs, idxs, nums = [], [], []
+    for n in range(bx.shape[0]):
+        rows = []
+        ridx = []
+        for c in range(sc.shape[1]):
+            if c == background_label:
+                continue
+            s = sc[n, c]
+            keep = np.nonzero(s > score_threshold)[0]
+            if keep.size == 0:
+                continue
+            order = keep[np.argsort(-s[keep])]
+            if nms_top_k > -1:              # -1 = keep all (reference)
+                order = order[:nms_top_k]
+            b = bx[n, order]
+            ss = s[order]
+            x1, y1, x2, y2 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+            area = np.maximum(x2 - x1 + norm, 0) * np.maximum(y2 - y1 + norm, 0)
+            ix1 = np.maximum(x1[:, None], x1[None, :])
+            iy1 = np.maximum(y1[:, None], y1[None, :])
+            ix2 = np.minimum(x2[:, None], x2[None, :])
+            iy2 = np.minimum(y2[:, None], y2[None, :])
+            inter = (np.maximum(ix2 - ix1 + norm, 0)
+                     * np.maximum(iy2 - iy1 + norm, 0))
+            iou = inter / np.maximum(area[:, None] + area[None, :] - inter,
+                                     1e-10)
+            iou = np.triu(iou, k=1)         # iou[i, j], i higher-scored
+            # comp_i: how suppressed the SUPPRESSOR i itself is (its max IoU
+            # with any higher-scored box) — the matrix-NMS compensation term
+            comp = iou.max(axis=0)
+            if use_gaussian:
+                # reference kernel: exp((comp^2 - iou^2) * sigma)
+                decay = np.exp((comp[:, None] ** 2 - iou ** 2)
+                               * gaussian_sigma)
+            else:
+                decay = (1 - iou) / np.maximum(1 - comp[:, None], 1e-10)
+            decay = np.where(np.triu(np.ones_like(iou), k=1) > 0, decay, 1.0)
+            decay = decay.min(axis=0)
+            ds = ss * decay
+            ok = ds > post_threshold
+            for j in np.nonzero(ok)[0]:
+                rows.append([float(c), float(ds[j]), *b[j].tolist()])
+                ridx.append(int(order[j]))
+        if rows:
+            arr = np.asarray(rows, np.float32)
+            top = np.argsort(-arr[:, 1])
+            if keep_top_k > -1:             # -1 = keep all (reference)
+                top = top[:keep_top_k]
+            arr = arr[top]
+            ridx = np.asarray(ridx, np.int64)[top]
+        else:
+            arr = np.zeros((0, 6), np.float32)
+            ridx = np.zeros((0,), np.int64)
+        outs.append(arr)
+        idxs.append(ridx + n * bx.shape[1])
+        nums.append(len(arr))
+    out = Tensor._from_data(jnp.asarray(np.concatenate(outs, 0)))
+    rois = Tensor._from_data(jnp.asarray(np.asarray(nums, np.int32))) \
+        if return_rois_num else None
+    index = Tensor._from_data(jnp.asarray(np.concatenate(idxs))) \
+        if return_index else None
+    return out, rois, index     # always a 3-tuple, like the reference
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=True, name=None):
+    """RPN proposal generation (reference vision/ops.py generate_proposals):
+    decode anchor deltas (box_coder math), clip to the image, drop tiny
+    boxes, top-k -> NMS -> top-k. scores [N, A, H, W],
+    bbox_deltas [N, 4A, H, W], anchors/variances [H, W, A, 4]."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+
+    sc = np.asarray(scores.numpy() if isinstance(scores, Tensor) else scores,
+                    np.float32)
+    dl = np.asarray(bbox_deltas.numpy() if isinstance(bbox_deltas, Tensor)
+                    else bbox_deltas, np.float32)
+    an = np.asarray(anchors.numpy() if isinstance(anchors, Tensor)
+                    else anchors, np.float32).reshape(-1, 4)
+    va = np.asarray(variances.numpy() if isinstance(variances, Tensor)
+                    else variances, np.float32).reshape(-1, 4)
+    imgs = np.asarray(img_size.numpy() if isinstance(img_size, Tensor)
+                      else img_size, np.float32)
+    off = 1.0 if pixel_offset else 0.0
+    N, A = sc.shape[0], sc.shape[1]
+    outs, probs, nums = [], [], []
+    for n in range(N):
+        s = sc[n].transpose(1, 2, 0).reshape(-1)              # [H*W*A]
+        d = dl[n].reshape(A, 4, *dl.shape[2:]).transpose(2, 3, 0, 1)             .reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, d, a, v = s[order], d[order], an[order], va[order]
+        aw = a[:, 2] - a[:, 0] + off
+        ah = a[:, 3] - a[:, 1] + off
+        ax = a[:, 0] + aw * 0.5
+        ay = a[:, 1] + ah * 0.5
+        cx = v[:, 0] * d[:, 0] * aw + ax
+        cy = v[:, 1] * d[:, 1] * ah + ay
+        w = np.exp(np.minimum(v[:, 2] * d[:, 2], 10.0)) * aw
+        h = np.exp(np.minimum(v[:, 3] * d[:, 3], 10.0)) * ah
+        boxes = np.stack([cx - w * 0.5, cy - h * 0.5,
+                          cx + w * 0.5 - off, cy + h * 0.5 - off], -1)
+        H, W = imgs[n, 0], imgs[n, 1]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, W - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, H - off)
+        ms = max(float(min_size), 1.0)   # reference FilterBoxes clamp
+        bw_ = boxes[:, 2] - boxes[:, 0] + off
+        bh_ = boxes[:, 3] - boxes[:, 1] + off
+        keep = (bw_ >= ms) & (bh_ >= ms)
+        if pixel_offset:
+            cx_ = boxes[:, 0] + bw_ * 0.5
+            cy_ = boxes[:, 1] + bh_ * 0.5
+            keep &= (cx_ <= W) & (cy_ <= H)  # center inside the image
+        boxes, s = boxes[keep], s[keep]
+        if len(boxes):
+            kept = nms(Tensor._from_data(jnp.asarray(boxes)), nms_thresh,
+                       Tensor._from_data(jnp.asarray(s))).numpy()
+            kept = kept[:post_nms_top_n]
+            boxes, s = boxes[kept], s[kept]
+        outs.append(boxes)
+        probs.append(s)
+        nums.append(len(boxes))
+    rois = Tensor._from_data(jnp.asarray(
+        np.concatenate(outs, 0) if outs else np.zeros((0, 4), np.float32)))
+    roi_probs = Tensor._from_data(jnp.asarray(
+        (np.concatenate(probs, 0) if probs
+         else np.zeros((0,), np.float32)).reshape(-1, 1)))  # [K, 1] like ref
+    nums_t = Tensor._from_data(jnp.asarray(np.asarray(nums, np.int32)))
+    if return_rois_num:
+        return rois, roi_probs, nums_t
+    return rois, roi_probs
 psroi_pool = _detector_stub(
     "psroi_pool", "position-sensitive pooling is R-FCN-specific; roi_align "
     "covers the modern detector path")

@@ -318,3 +318,45 @@ def test_major_submodule_namespaces_closed():
         ra = ref_all(f"{base}/{rel}")
         missing = sorted(n for n in ra if not hasattr(mod, n))
         assert missing == [], f"{rel}: {missing}"
+
+
+def test_matrix_nms_and_generate_proposals():
+    """Matrix-NMS decay math (SOLOv2 eq. 3: linear decay with suppressor
+    compensation) and the RPN proposal pipeline (decode/clip/filter/nms)."""
+    import numpy as np
+
+    from paddlepaddle_tpu.vision.ops import generate_proposals, matrix_nms
+
+    bboxes = np.asarray([[[0, 0, 10, 10], [1, 1, 11, 11], [30, 30, 40, 40]]],
+                        np.float32)
+    scores = np.zeros((1, 2, 3), np.float32)
+    scores[0, 1] = [0.9, 0.85, 0.8]
+    out, rois, index = matrix_nms(paddle.to_tensor(bboxes),
+                                  paddle.to_tensor(scores),
+                                  score_threshold=0.1)
+    assert index is None
+    o = out.numpy()
+    assert int(rois.numpy()[0]) == 3
+    # rows sorted by decayed score: top box undecayed, far box undecayed,
+    # the overlapping box decayed by (1-iou)/(1-0) * 0.85
+    np.testing.assert_allclose(o[0, 1], 0.9, rtol=1e-5)
+    np.testing.assert_allclose(o[1, 1], 0.8, rtol=1e-5)
+    iou = (9 * 9) / (10 * 10 + 10 * 10 - 9 * 9)
+    np.testing.assert_allclose(o[2, 1], 0.85 * (1 - iou), rtol=1e-4)
+
+    H = W = 2
+    anchors = np.zeros((H, W, 1, 4), np.float32)
+    for y in range(H):
+        for x in range(W):
+            anchors[y, x, 0] = [x * 8, y * 8, x * 8 + 12, y * 8 + 12]
+    sc = np.random.default_rng(0).random((1, 1, H, W)).astype(np.float32)
+    rois2, probs, num = generate_proposals(
+        paddle.to_tensor(sc),
+        paddle.to_tensor(np.zeros((1, 4, H, W), np.float32)),
+        paddle.to_tensor(np.asarray([[32, 32]], np.float32)),
+        paddle.to_tensor(anchors), paddle.to_tensor(np.ones_like(anchors)),
+        nms_thresh=0.9)
+    n = int(num.numpy()[0])
+    assert rois2.shape[0] == n > 0 and list(probs.shape) == [n, 1]
+    # zero deltas: proposals are the (clipped) anchors themselves
+    assert rois2.numpy().max() <= 32.0
