@@ -1,11 +1,11 @@
 """paddle.vision.ops — detection operators.
 
 Reference surface: python/paddle/vision/ops.py (nms, roi_align, roi_pool,
-box_coder, deform_conv2d, yolo ops, ...). TPU-native subset: the classic
-trio (nms / roi_align / roi_pool) and box_coder implemented with static
-shapes and lax control flow; the CUDA-heavy detector tails (deform_conv2d,
-yolo_box/loss, generate_proposals) raise with their story rather than
-silently missing.
+box_coder, deform_conv2d, yolo ops, ...). TPU-native surface: nms, matrix_nms,
+roi_align/roi_pool/psroi_pool (+ layer forms), box_coder, prior_box,
+generate_proposals, FPN distribution, and file IO implemented with static
+shapes; only deform_conv2d and the yolo decode/loss pair raise with their
+story (data-dependent sampling / detector-specific CUDA kernels).
 """
 
 from __future__ import annotations
@@ -126,18 +126,21 @@ def _roi_sample(feat, rois, output_size, spatial_scale, mode,
     return vals.max(axis=(3, 5))
 
 
+def _gather_roi_images(feat, bx, bn):
+    """Per-roi image gather: batch index from the boxes_num prefix sums —
+    the one shared roi->image mapping (rois_op modes and psroi_pool)."""
+    csum = jnp.cumsum(bn)
+    roi_batch = jnp.searchsorted(csum, jnp.arange(bx.shape[0]), side="right")
+    return feat[roi_batch]
+
+
 def _rois_op(x, boxes, boxes_num, output_size, spatial_scale, mode,
              sampling_ratio=1, aligned=True):
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
 
     def f(feat, bx, bn):
-        # batch index per roi from boxes_num prefix sums, then gather each
-        # roi's image and vmap the per-roi sampler — fully static shapes
-        csum = jnp.cumsum(bn)
-        roi_batch = jnp.searchsorted(csum, jnp.arange(bx.shape[0]),
-                                     side="right")
-        feats = feat[roi_batch]                     # [K, C, H, W]
+        feats = _gather_roi_images(feat, bx, bn)    # [K, C, H, W]
         return jax.vmap(lambda fm, rb: _roi_sample(
             fm, rb[None], output_size, spatial_scale, mode,
             sampling_ratio, aligned)[0])(feats, bx)
@@ -381,14 +384,6 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
         jnp.asarray(var))
 
 
-def _detector_stub(name, why):
-    def f(*a, **k):
-        raise NotImplementedError(f"{name}: {why}")
-
-    f.__name__ = name
-    return f
-
-
 def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
                nms_top_k=400, keep_top_k=200, use_gaussian=False,
                gaussian_sigma=2.0, background_label=0, normalized=True,
@@ -540,16 +535,82 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
     if return_rois_num:
         return rois, roi_probs, nums_t
     return rois, roi_probs
-psroi_pool = _detector_stub(
-    "psroi_pool", "position-sensitive pooling is R-FCN-specific; roi_align "
-    "covers the modern detector path")
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pooling (reference vision/ops.py psroi_pool,
+    R-FCN): input channels C = output_channels * oh * ow; output channel c
+    of bin (i, j) AVERAGE-pools input channel c*oh*ow + i*ow + j over that
+    bin. Reference window semantics: the roi is rounded to
+    [round(x1)*scale, round(x2 + 1)*scale) and EMPTY bins (integer window
+    collapses) yield exactly 0; within non-empty bins a fixed 4x4 sample
+    grid approximates the integer-window average (static shapes)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    C = int(x.shape[1])
+    if C % (oh * ow):
+        raise ValueError(
+            f"psroi_pool needs channels ({C}) divisible by "
+            f"output_size^2 ({oh}*{ow})")
+    out_c = C // (oh * ow)
+
+    def f(feat, bx, bn):
+        feats = _gather_roi_images(feat, bx, bn)     # [K, C, H, W]
+        K = bx.shape[0]
+        H, W = feat.shape[2], feat.shape[3]
+        S = 4
+        # reference window: rounded starts, end + 1 before scaling
+        x1 = jnp.round(bx[:, 0]) * spatial_scale
+        y1 = jnp.round(bx[:, 1]) * spatial_scale
+        x2 = jnp.round(bx[:, 2] + 1.0) * spatial_scale
+        y2 = jnp.round(bx[:, 3] + 1.0) * spatial_scale
+        bw = (x2 - x1) / ow
+        bh = (y2 - y1) / oh
+        jj = (jnp.arange(ow * S) + 0.5) / S
+        ii = (jnp.arange(oh * S) + 0.5) / S
+        gx = x1[:, None] + jj[None, :] * bw[:, None]         # [K, ow*S]
+        gy = y1[:, None] + ii[None, :] * bh[:, None]
+        xi = jnp.clip(gx.astype(jnp.int32), 0, W - 1)
+        yi = jnp.clip(gy.astype(jnp.int32), 0, H - 1)
+        # ONE gather of every sample, then the position-sensitive diagonal
+        vals = jax.vmap(lambda fm, yy, xx: fm[:, yy[:, None], xx[None, :]])(
+            feats, yi, xi)                                   # [K, C, ohS, owS]
+        vals = vals.reshape(K, out_c, oh, ow, oh, S, ow, S)
+        # put the four bin axes adjacent so the advanced-index diagonal
+        # lands in place (separated advanced indices would jump to axis 0)
+        vals = vals.transpose(0, 1, 5, 7, 2, 3, 4, 6)   # [K,outc,S,S,ohc,owc,ohs,ows]
+        I = jnp.arange(oh)[:, None]
+        J = jnp.arange(ow)[None, :]
+        diag = vals[:, :, :, :, I, J, I, J]             # [K, outc, S, S, oh, ow]
+        out = diag.mean(axis=(2, 3))
+        # empty-bin mask (reference: floor(start) >= ceil(end) after image
+        # clipping -> write 0)
+        ys = jnp.clip(y1[:, None] + jnp.arange(oh)[None, :] * bh[:, None],
+                      0, H)
+        ye = jnp.clip(y1[:, None] + (jnp.arange(oh)[None, :] + 1)
+                      * bh[:, None], 0, H)
+        xs = jnp.clip(x1[:, None] + jnp.arange(ow)[None, :] * bw[:, None],
+                      0, W)
+        xe = jnp.clip(x1[:, None] + (jnp.arange(ow)[None, :] + 1)
+                      * bw[:, None], 0, W)
+        empty = (jnp.floor(ys)[:, :, None] >= jnp.ceil(ye)[:, :, None]
+                 - 0) | (jnp.floor(xs)[:, None, :] >= jnp.ceil(xe)[:, None, :])
+        empty = (jnp.floor(ys[:, :, None]) >= jnp.ceil(ye[:, :, None])) |                 (jnp.floor(xs[:, None, :]) >= jnp.ceil(xe[:, None, :]))
+        return jnp.where(empty[:, None, :, :], 0.0, out)
+
+    return apply_op(f, x, boxes, boxes_num, op_name="psroi_pool")
 
 
 class PSRoIPool:
-    def __init__(self, *a, **k):
-        raise NotImplementedError(
-            "PSRoIPool: position-sensitive pooling is R-FCN-specific; "
-            "RoIAlign covers the modern detector path")
+    """Layer form of psroi_pool (reference vision/ops.py PSRoIPool)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
 
 
 class DeformConv2D:

@@ -360,3 +360,31 @@ def test_matrix_nms_and_generate_proposals():
     assert rois2.shape[0] == n > 0 and list(probs.shape) == [n, 1]
     # zero deltas: proposals are the (clipped) anchors themselves
     assert rois2.numpy().max() <= 32.0
+
+
+def test_psroi_pool_position_sensitive():
+    """psroi_pool (R-FCN): channel block (i, j) pools ONLY spatial bin
+    (i, j) — verified with distinct per-block constants."""
+    import numpy as np
+
+    from paddlepaddle_tpu.vision.ops import PSRoIPool, psroi_pool
+
+    oh = ow = 2
+    out_c = 3
+    C = out_c * oh * ow
+    feat = np.zeros((1, C, 8, 8), np.float32)
+    for c in range(C):
+        feat[0, c] = c + 1
+    rois = paddle.to_tensor(np.asarray([[0, 0, 8, 8]], np.float32))
+    bn = paddle.to_tensor(np.asarray([1], np.int32))
+    out = psroi_pool(paddle.to_tensor(feat), rois, bn, 2).numpy()
+    for k in range(out_c):
+        for i in range(oh):
+            for j in range(ow):
+                assert out[0, k, i, j] == k * oh * ow + i * ow + j + 1
+    np.testing.assert_allclose(
+        PSRoIPool(2)(paddle.to_tensor(feat), rois, bn).numpy(), out)
+    import pytest as _p
+
+    with _p.raises(ValueError, match="divisible"):
+        psroi_pool(paddle.to_tensor(feat[:, :5]), rois, bn, 2)
