@@ -173,6 +173,7 @@ from . import linalg  # noqa: E402,F401
 from . import metric  # noqa: E402,F401
 from . import models  # noqa: E402,F401
 from . import nn  # noqa: E402,F401
+from . import onnx  # noqa: E402,F401
 from . import optimizer  # noqa: E402,F401
 from . import profiler  # noqa: E402,F401
 from . import quantization  # noqa: E402,F401

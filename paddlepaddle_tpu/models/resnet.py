@@ -150,8 +150,24 @@ def resnext50_32x4d(**kwargs):
     return ResNet(BottleneckBlock, 50, width=4, groups=32, **kwargs)
 
 
+def resnext50_64x4d(**kwargs):
+    return ResNet(BottleneckBlock, 50, width=4, groups=64, **kwargs)
+
+
+def resnext101_32x4d(**kwargs):
+    return ResNet(BottleneckBlock, 101, width=4, groups=32, **kwargs)
+
+
 def resnext101_64x4d(**kwargs):
     return ResNet(BottleneckBlock, 101, width=4, groups=64, **kwargs)
+
+
+def resnext152_32x4d(**kwargs):
+    return ResNet(BottleneckBlock, 152, width=4, groups=32, **kwargs)
+
+
+def resnext152_64x4d(**kwargs):
+    return ResNet(BottleneckBlock, 152, width=4, groups=64, **kwargs)
 
 
 def wide_resnet50_2(**kwargs):

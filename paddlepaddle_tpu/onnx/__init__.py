@@ -1,29 +1,110 @@
-"""paddle.onnx namespace parity (reference: python/paddle/onnx/export.py,
-which shells out to the external paddle2onnx package).
+"""paddle.onnx — ONNX model export (reference: python/paddle/onnx/export.py,
+which shells out to the external paddle2onnx package; export.py:110).
 
-TPU-native: the portable export format here is StableHLO
-(paddlepaddle_tpu.jit.save / load — jit/save_load.py), which any XLA-backed
-runtime consumes directly. ``export`` converts to ONNX only when the
-optional ``onnx`` package is installed (it is not vendored); otherwise it
-raises with the StableHLO alternative spelled out, mirroring the reference's
-soft dependency on paddle2onnx.
+TPU-native: the layer's forward is traced to a jaxpr (the same trace
+jit.save uses for StableHLO) and converted primitive-by-primitive to an
+ONNX opset-13 graph (onnx/_converter.py), serialized with an in-tree
+protobuf wire writer (onnx/_proto.py) — no dependency on the ``onnx``
+package. Parameters and closure constants become graph initializers.
+For an XLA-consumable artifact prefer paddlepaddle_tpu.jit.save
+(StableHLO); ONNX export exists for interop with non-XLA runtimes.
 """
 
 from __future__ import annotations
 
+import os
+import warnings
+
+import numpy as np
+
+__all__ = ["export"]
+
 
 def export(layer, path, input_spec=None, opset_version=9, **configs):
-    """Reference signature (python/paddle/onnx/export.py:23)."""
+    """Export ``layer`` to ``path + '.onnx'`` (reference signature,
+    python/paddle/onnx/export.py:35).
+
+    The graph is always emitted at opset 13 (Einsum and axes-as-input
+    Slice/ReduceSum require >= 13); a lower ``opset_version`` (including
+    the reference's default 9) is silently upgraded — opset 13 runtimes
+    are a superset. ``input_spec`` entries may be InputSpec, Tensor,
+    or arrays; a None (batch) dim is traced at 1 and exported as a fixed
+    dim of 1 — XLA traces are shape-specialized, so a symbolic batch
+    would not be sound here.
+    """
+    import jax
+
+    from ..core import autograd as ag
+    from ..core.tensor import Tensor
+    from ..nn.layer import Layer
+    from . import _converter, _proto
+
+    if not isinstance(layer, Layer):
+        inner = getattr(layer, "_layer", None)
+        if isinstance(inner, Layer):
+            layer = inner
+        else:
+            raise TypeError(
+                f"onnx.export expects a Layer, got {type(layer).__name__}")
+    if os.path.basename(path) == "":
+        raise ValueError(
+            "The input path MUST be format of dirname/file_prefix, but the "
+            f"file_prefix is empty in received path: {path}")
+    if input_spec is None:
+        raise ValueError(
+            "onnx.export needs input_spec (the reference likewise requires "
+            "example inputs for dygraph tracing)")
+    # always stamp 13 — that is the dialect the graph actually uses
+    # (e.g. ReduceMax axes-as-attribute would be invalid under >= 18)
+    opset = 13
+    if opset_version > 13:
+        warnings.warn(
+            f"onnx.export emits opset 13 graphs; requested opset_version="
+            f"{opset_version} was lowered to 13", stacklevel=2)
+
+    def to_sds(spec):
+        shape = getattr(spec, "shape", None)
+        if shape is not None and not isinstance(spec, (Tensor, np.ndarray)):
+            dtype = np.dtype(getattr(spec, "dtype", "float32") or "float32")
+            return jax.ShapeDtypeStruct(
+                tuple(1 if d in (None, -1) else int(d) for d in shape), dtype)
+        arr = spec.numpy() if isinstance(spec, Tensor) else np.asarray(spec)
+        return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+
+    params = layer.functional_state()
+    names = sorted(params)
+
+    def fn(plist, *inputs):
+        p = dict(zip(names, plist))
+        with ag.no_grad(), layer.bind_state(p):
+            out = layer(*[Tensor._from_data(i) for i in inputs])
+        flat = jax.tree_util.tree_leaves(
+            out, is_leaf=lambda t: isinstance(t, Tensor))
+        return [t._data if isinstance(t, Tensor) else t for t in flat]
+
+    sds_params = [jax.ShapeDtypeStruct(params[n].shape, params[n].dtype)
+                  for n in names]
+    sds_inputs = [to_sds(s) for s in input_spec]
+    was_training = layer.training
+    layer.eval()
     try:
-        import onnx  # noqa: F401
-    except ImportError:
-        raise NotImplementedError(
-            "ONNX export requires the optional 'onnx' package (the reference "
-            "likewise requires paddle2onnx). For a portable compiled "
-            "artifact use paddlepaddle_tpu.jit.save(layer, path, "
-            "input_spec=...) — it writes StableHLO + params, loadable by "
-            "any XLA runtime via paddlepaddle_tpu.jit.load."
-        ) from None
-    raise NotImplementedError(
-        "onnx is importable but the StableHLO->ONNX converter is not "
-        "implemented; use paddlepaddle_tpu.jit.save (StableHLO) instead")
+        closed = jax.make_jaxpr(fn)(sds_params, *sds_inputs)
+    finally:
+        if was_training:
+            layer.train()
+
+    # fn's first arg is the params list -> the first len(names) flat invars
+    inits = {i: (f"p_{n.replace('.', '_')}", np.asarray(params[n]))
+             for i, n in enumerate(names)}
+    in_names = [f"x{i}" for i in range(len(sds_inputs))]
+    n_out = len(closed.jaxpr.outvars)
+    out_names = [f"y{i}" for i in range(n_out)]
+    gb = _converter.convert(closed, in_names, out_names,
+                            initializers=inits,
+                            graph_name=type(layer).__name__)
+    blob = _proto.model(gb, opset)
+    d = os.path.dirname(os.path.abspath(path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path + ".onnx", "wb") as f:
+        f.write(blob)

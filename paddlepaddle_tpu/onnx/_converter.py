@@ -1,0 +1,427 @@
+"""jaxpr -> ONNX graph converter (opset 13).
+
+The reference's ``paddle.onnx.export`` shells out to paddle2onnx
+(python/paddle/onnx/export.py:110), which walks the static Program op by op.
+The TPU-native equivalent walks the traced jaxpr: every lax primitive the
+framework's layers lower to is mapped onto ONNX ops, with ``pjit`` /
+``custom_jvp_call`` / ``remat`` sub-jaxprs inlined. dot_general maps to
+Einsum (fully general), conv_general_dilated to Conv, reduce_window_{max,sum}
+to MaxPool / AveragePool, the embedding-style gather to Gather.
+
+Primitives outside the mapped set raise NotImplementedError naming the
+primitive, so unsupported models fail loudly at export time, not at load
+time in the consumer runtime.
+"""
+
+from __future__ import annotations
+
+import string
+
+import numpy as np
+
+from . import _proto as P
+
+
+class _Ctx:
+    def __init__(self):
+        self.nodes = []          # serialized NodeProto bytes, in order
+        self.inits = []          # serialized TensorProto bytes
+        self.names = {}          # id(var) -> name
+        self.counter = 0
+
+    def fresh(self, hint="t"):
+        self.counter += 1
+        return f"{hint}_{self.counter}"
+
+    def name_of(self, v):
+        from jax._src.core import Literal
+
+        if isinstance(v, Literal):
+            return self.add_const(np.asarray(v.val, v.aval.dtype))
+        if id(v) not in self.names:
+            self.names[id(v)] = self.fresh()
+        return self.names[id(v)]
+
+    def add_const(self, arr, hint="const"):
+        name = self.fresh(hint)
+        self.inits.append(P.tensor_proto(name, np.asarray(arr)))
+        return name
+
+    def emit(self, op, inputs, outputs, **attrs):
+        self.nodes.append(P.node(op, inputs, outputs,
+                                 name=self.fresh(f"n_{op}"), **attrs))
+
+
+_ELEMENTWISE = {
+    "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div", "pow": "Pow",
+    "max": "Max", "min": "Min", "neg": "Neg", "exp": "Exp", "log": "Log",
+    "sqrt": "Sqrt", "abs": "Abs", "floor": "Floor", "ceil": "Ceil",
+    "sign": "Sign", "tanh": "Tanh", "logistic": "Sigmoid", "erf": "Erf",
+    "sin": "Sin", "cos": "Cos", "round_nearest_even": "Round",
+    "not": "Not", "and": "And", "or": "Or", "xor": "Xor",
+    "stop_gradient": "Identity", "copy": "Identity",
+}
+_COMPARE = {"lt": ("Less", False), "gt": ("Greater", False),
+            "le": ("LessOrEqual", False), "ge": ("GreaterOrEqual", False),
+            "eq": ("Equal", False), "ne": ("Equal", True)}
+_REDUCE = {"reduce_sum": "ReduceSum", "reduce_max": "ReduceMax",
+           "reduce_min": "ReduceMin", "reduce_prod": "ReduceProd",
+           "reduce_and": "ReduceMin", "reduce_or": "ReduceMax"}
+
+
+def _i64(ctx, vals, hint="shape"):
+    return ctx.add_const(np.asarray(list(vals), np.int64), hint)
+
+
+def _einsum_equation(dn, lhs_ndim, rhs_ndim):
+    (lc, rc), (lb, rb) = dn
+    letters = iter(string.ascii_letters)
+    lhs = [next(letters) for _ in range(lhs_ndim)]
+    rhs = [None] * rhs_ndim
+    for l, r in zip(lb, rb):
+        rhs[r] = lhs[l]
+    for l, r in zip(lc, rc):
+        rhs[r] = lhs[l]
+    for i in range(rhs_ndim):
+        if rhs[i] is None:
+            rhs[i] = next(letters)
+    out = [lhs[d] for d in lb]
+    out += [lhs[d] for d in range(lhs_ndim) if d not in lb and d not in lc]
+    out += [rhs[d] for d in range(rhs_ndim) if d not in rb and d not in rc]
+    return f"{''.join(lhs)},{''.join(rhs)}->{''.join(out)}"
+
+
+def _pool_pads(padding):
+    """jax per-dim (lo, hi) pairs (leading N, C must be zero) to ONNX
+    [b1, b2, ..., e1, e2, ...] spatial pads."""
+    if any(p != (0, 0) for p in padding[:2]):
+        raise NotImplementedError(
+            "onnx export: pooling with batch/channel padding")
+    spatial = padding[2:]
+    return [p[0] for p in spatial] + [p[1] for p in spatial]
+
+
+def _convert_eqn(ctx, eqn):
+    prim = eqn.primitive.name
+    ins = [ctx.name_of(v) for v in eqn.invars]
+    outs = [ctx.name_of(v) for v in eqn.outvars]
+    pa = eqn.params
+    aval_in = [getattr(v, "aval", None) for v in eqn.invars]
+    aval_out = eqn.outvars[0].aval if eqn.outvars else None
+
+    if prim in ("pjit", "jit", "closed_call", "core_call", "remat",
+                "checkpoint", "custom_jvp_call", "custom_vjp_call",
+                "custom_vjp_call_jaxpr"):
+        sub = pa.get("jaxpr") or pa.get("call_jaxpr") or pa.get("fun_jaxpr")
+        _inline(ctx, sub, eqn.invars, eqn.outvars)
+        return
+    if prim in _ELEMENTWISE:
+        ctx.emit(_ELEMENTWISE[prim], ins, outs)
+        return
+    if prim in _COMPARE:
+        op, negate = _COMPARE[prim]
+        if negate:
+            t = ctx.fresh("eq")
+            ctx.emit(op, ins, [t])
+            ctx.emit("Not", [t], outs)
+        else:
+            ctx.emit(op, ins, outs)
+        return
+    if prim == "rem":
+        # lax.rem is C-truncated (sign of dividend) = ONNX Mod fmod=1
+        ctx.emit("Mod", ins, outs, fmod=1)
+        return
+    if prim == "integer_pow":
+        y = ctx.add_const(np.asarray(pa["y"], aval_out.dtype))
+        ctx.emit("Pow", [ins[0], y], outs)
+        return
+    if prim == "rsqrt":
+        t = ctx.fresh("sqrt")
+        ctx.emit("Sqrt", ins, [t])
+        ctx.emit("Reciprocal", [t], outs)
+        return
+    if prim == "square":
+        ctx.emit("Mul", [ins[0], ins[0]], outs)
+        return
+    if prim == "log1p":
+        one = ctx.add_const(np.asarray(1, aval_out.dtype))
+        t = ctx.fresh("add1")
+        ctx.emit("Add", [ins[0], one], [t])
+        ctx.emit("Log", [t], outs)
+        return
+    if prim == "expm1":
+        one = ctx.add_const(np.asarray(1, aval_out.dtype))
+        t = ctx.fresh("exp")
+        ctx.emit("Exp", ins, [t])
+        ctx.emit("Sub", [t, one], outs)
+        return
+    if prim == "erfc":
+        one = ctx.add_const(np.asarray(1, aval_out.dtype))
+        t = ctx.fresh("erf")
+        ctx.emit("Erf", ins, [t])
+        ctx.emit("Sub", [one, t], outs)
+        return
+    if prim == "is_finite":
+        # |x| < inf  (NaN compares false, matching lax.is_finite)
+        a = ctx.fresh("abs")
+        inf = ctx.add_const(np.asarray(np.inf, aval_in[0].dtype))
+        ctx.emit("Abs", ins, [a])
+        ctx.emit("Less", [a, inf], outs)
+        return
+    if prim == "clamp":                              # (min, x, max)
+        ctx.emit("Clip", [ins[1], ins[0], ins[2]], outs)
+        return
+    if prim == "select_n":
+        if len(ins) != 3:
+            raise NotImplementedError("onnx export: select_n with >2 cases")
+        ctx.emit("Where", [ins[0], ins[2], ins[1]], outs)
+        return
+    if prim == "convert_element_type":
+        to = P._NP_TO_ONNX[np.dtype(pa["new_dtype"]).name]
+        ctx.emit("Cast", ins, outs, to=to)
+        return
+    if prim == "transpose":
+        ctx.emit("Transpose", ins, outs, perm=list(pa["permutation"]))
+        return
+    if prim in ("reshape", "squeeze", "expand_dims"):
+        if prim == "reshape" and pa.get("dimensions") is not None:
+            t = ctx.fresh("perm")
+            ctx.emit("Transpose", ins, [t], perm=list(pa["dimensions"]))
+            ins = [t]
+        ctx.emit("Reshape", [ins[0], _i64(ctx, aval_out.shape)], outs)
+        return
+    if prim == "broadcast_in_dim":
+        shape, bdims = pa["shape"], pa["broadcast_dimensions"]
+        src = ins[0]
+        if tuple(aval_in[0].shape) != tuple(shape):
+            # step 1: reshape to rank(out) with 1s off the mapped dims
+            mid = [1] * len(shape)
+            for i, d in enumerate(bdims):
+                mid[d] = aval_in[0].shape[i]
+            t = ctx.fresh("bdim")
+            ctx.emit("Reshape", [src, _i64(ctx, mid)], [t])
+            src = t
+            t2 = ctx.fresh("expand")
+            ctx.emit("Expand", [src, _i64(ctx, shape)], [t2])
+            src = t2
+        ctx.emit("Identity", [src], outs)
+        return
+    if prim == "concatenate":
+        ctx.emit("Concat", ins, outs, axis=int(pa["dimension"]))
+        return
+    if prim == "slice":
+        starts, limits = pa["start_indices"], pa["limit_indices"]
+        steps = pa["strides"] or [1] * len(starts)
+        axes = list(range(len(starts)))
+        ctx.emit("Slice", [ins[0], _i64(ctx, starts, "starts"),
+                           _i64(ctx, limits, "ends"), _i64(ctx, axes, "axes"),
+                           _i64(ctx, steps, "steps")], outs)
+        return
+    if prim == "rev":
+        dims = list(pa["dimensions"])
+        sh = aval_in[0].shape
+        ctx.emit("Slice", [
+            ins[0], _i64(ctx, [sh[d] - 1 for d in dims], "starts"),
+            _i64(ctx, [-sh[d] - 1 for d in dims], "ends"),
+            _i64(ctx, dims, "axes"), _i64(ctx, [-1] * len(dims), "steps")],
+            outs)
+        return
+    if prim == "pad":
+        cfg = pa["padding_config"]
+        if any(i != 0 for (_, _, i) in cfg) or any(
+                lo < 0 or hi < 0 for (lo, hi, _) in cfg):
+            raise NotImplementedError(
+                "onnx export: interior/negative padding")
+        pads = [c[0] for c in cfg] + [c[1] for c in cfg]
+        ctx.emit("Pad", [ins[0], _i64(ctx, pads, "pads"), ins[1]], outs)
+        return
+    if prim == "iota":
+        rng = np.arange(pa["shape"][pa["dimension"]], dtype=pa["dtype"])
+        other = tuple(d for d in range(len(pa["shape"]))
+                      if d != pa["dimension"])
+        a = np.broadcast_to(np.expand_dims(rng, other), tuple(pa["shape"]))
+        ctx.emit("Identity",
+                 [ctx.add_const(np.ascontiguousarray(a), "iota")], outs)
+        return
+    if prim in _REDUCE:
+        axes = list(pa["axes"])
+        bool_red = prim in ("reduce_and", "reduce_or")
+        src = ins[0]
+        if bool_red:
+            t = ctx.fresh("b2i")
+            ctx.emit("Cast", [src], [t], to=P.INT32)
+            src = t
+        out = ctx.fresh("red") if bool_red else outs[0]
+        if _REDUCE[prim] == "ReduceSum":             # axes-as-input (op13)
+            ctx.emit("ReduceSum", [src, _i64(ctx, axes, "axes")], [out],
+                     keepdims=0)
+        else:
+            ctx.emit(_REDUCE[prim], [src], [out], axes=axes, keepdims=0)
+        if bool_red:
+            ctx.emit("Cast", [out], outs, to=P.BOOL)
+        return
+    if prim in ("argmax", "argmin"):
+        axes = pa["axes"]
+        if len(axes) != 1:
+            raise NotImplementedError("onnx export: multi-axis argmax")
+        t = ctx.fresh("arg")
+        ctx.emit("ArgMax" if prim == "argmax" else "ArgMin", ins, [t],
+                 axis=int(axes[0]), keepdims=0)
+        ctx.emit("Cast", [t], outs,
+                 to=P._NP_TO_ONNX[np.dtype(pa["index_dtype"]).name])
+        return
+    if prim == "cumsum":
+        ctx.emit("CumSum", [ins[0], ctx.add_const(
+            np.asarray(pa["axis"], np.int64))], outs,
+            reverse=int(bool(pa.get("reverse"))))
+        return
+    if prim == "dot_general":
+        eqs = _einsum_equation(pa["dimension_numbers"],
+                               len(aval_in[0].shape), len(aval_in[1].shape))
+        a, b = ins
+        if aval_in[0].dtype != aval_out.dtype:
+            t = ctx.fresh("cast")
+            ctx.emit("Cast", [a], [t], to=P._NP_TO_ONNX[aval_out.dtype.name])
+            a = t
+        if aval_in[1].dtype != aval_out.dtype:
+            t = ctx.fresh("cast")
+            ctx.emit("Cast", [b], [t], to=P._NP_TO_ONNX[aval_out.dtype.name])
+            b = t
+        ctx.emit("Einsum", [a, b], outs, equation=eqs)
+        return
+    if prim == "conv_general_dilated":
+        dn = pa["dimension_numbers"]
+        nd = len(aval_in[0].shape)
+        if (tuple(dn.lhs_spec) != tuple(range(nd))
+                or tuple(dn.rhs_spec) != tuple(range(nd))
+                or tuple(dn.out_spec) != tuple(range(nd))):
+            raise NotImplementedError(
+                "onnx export: conv layouts other than NCHW/OIHW")
+        if any(d != 1 for d in pa["lhs_dilation"]):
+            raise NotImplementedError(
+                "onnx export: transposed conv (lhs_dilation != 1)")
+        if pa.get("batch_group_count", 1) != 1:
+            raise NotImplementedError("onnx export: batch_group_count != 1")
+        pads = [p[0] for p in pa["padding"]] + [p[1] for p in pa["padding"]]
+        ctx.emit("Conv", ins, outs,
+                 strides=list(pa["window_strides"]), pads=pads,
+                 dilations=list(pa["rhs_dilation"]),
+                 group=int(pa["feature_group_count"]))
+        return
+    if prim in ("reduce_window_max", "reduce_window_sum"):
+        wd = pa["window_dimensions"]
+        if tuple(wd[:2]) != (1, 1) or len(wd) != 4:
+            raise NotImplementedError(
+                "onnx export: reduce_window beyond NCHW spatial pooling")
+        if any(d != 1 for d in pa["base_dilation"]):
+            raise NotImplementedError("onnx export: pooling base dilation")
+        strides = list(pa["window_strides"][2:])
+        pads = _pool_pads(pa["padding"])
+        kernel = list(wd[2:])
+        dil = list(pa["window_dilation"][2:])
+        if prim == "reduce_window_max":
+            ctx.emit("MaxPool", ins, outs, kernel_shape=kernel,
+                     strides=strides, pads=pads, dilations=dil)
+        else:
+            if any(d != 1 for d in dil):
+                raise NotImplementedError("onnx export: avg-pool dilation")
+            t = ctx.fresh("avg")
+            ctx.emit("AveragePool", ins, [t], kernel_shape=kernel,
+                     strides=strides, pads=pads, count_include_pad=1)
+            scale = ctx.add_const(
+                np.asarray(float(np.prod(kernel)), aval_out.dtype))
+            ctx.emit("Mul", [t, scale], outs)
+        return
+    if prim == "gather":
+        dn = pa["dimension_numbers"]
+        op_shape = tuple(aval_in[0].shape)
+        idx_shape = tuple(aval_in[1].shape)
+        take0 = (tuple(dn.collapsed_slice_dims) == (0,)
+                 and tuple(dn.start_index_map) == (0,)
+                 and not dn.operand_batching_dims
+                 and idx_shape and idx_shape[-1] == 1
+                 and tuple(pa["slice_sizes"]) == (1,) + op_shape[1:]
+                 and tuple(dn.offset_dims) == tuple(
+                     range(len(idx_shape) - 1,
+                           len(idx_shape) - 1 + len(op_shape) - 1)))
+        if not take0:
+            raise NotImplementedError(
+                "onnx export: general lax.gather (only axis-0 take / "
+                "embedding lookup is mapped)")
+        idx = ctx.fresh("idx")
+        ctx.emit("Reshape", [ins[1], _i64(ctx, idx_shape[:-1] or (1,))],
+                 [idx])
+        from jax.lax import GatherScatterMode as GSM
+
+        if pa["mode"] in (GSM.CLIP, GSM.FILL_OR_DROP):
+            lo = ctx.add_const(np.asarray(0, np.dtype(aval_in[1].dtype)))
+            hi = ctx.add_const(
+                np.asarray(op_shape[0] - 1, np.dtype(aval_in[1].dtype)))
+            c = ctx.fresh("clip")
+            ctx.emit("Clip", [idx, lo, hi], [c])
+            idx = c
+        g = ctx.fresh("gat") if not idx_shape[:-1] else outs[0]
+        ctx.emit("Gather", [ins[0], idx], [g], axis=0)
+        if not idx_shape[:-1]:                       # scalar take: re-shape
+            ctx.emit("Reshape", [g, _i64(ctx, aval_out.shape or (1,))], outs)
+        return
+    if prim == "sort":
+        raise NotImplementedError("onnx export: lax.sort (use TopK models)")
+    raise NotImplementedError(
+        f"onnx export: unmapped primitive '{prim}'; supported set covers "
+        "dense/conv/attention inference graphs (see onnx/_converter.py)")
+
+
+def _inline(ctx, closed, invars, outvars):
+    jaxpr = getattr(closed, "jaxpr", closed)
+    consts = list(getattr(closed, "consts", []) or [])
+    for cv, cval in zip(jaxpr.constvars, consts):
+        ctx.names[id(cv)] = ctx.add_const(np.asarray(cval), "closure")
+    for sub_v, outer_v in zip(jaxpr.invars, invars):
+        ctx.names[id(sub_v)] = ctx.name_of(outer_v)
+    _convert_body(ctx, jaxpr)
+    for sub_v, outer_v in zip(jaxpr.outvars, outvars):
+        ctx.emit("Identity", [ctx.name_of(sub_v)], [ctx.name_of(outer_v)])
+
+
+def _convert_body(ctx, jaxpr):
+    for eqn in jaxpr.eqns:
+        _convert_eqn(ctx, eqn)
+
+
+def convert(closed_jaxpr, input_names, output_names, *,
+            initializers=None, graph_name="paddlepaddle_tpu"):
+    """Convert a ClosedJaxpr to serialized ONNX GraphProto bytes.
+
+    initializers: {position_in_invars: (name, np_array)} — invars bound to
+    fixed arrays (parameters) become graph initializers, the rest become
+    graph inputs in order, named by ``input_names``.
+    """
+    jaxpr = closed_jaxpr.jaxpr
+    ctx = _Ctx()
+    initializers = initializers or {}
+    for cv, cval in zip(jaxpr.constvars, closed_jaxpr.consts):
+        ctx.names[id(cv)] = ctx.add_const(np.asarray(cval), "closure")
+
+    g_inputs = []
+    it_names = iter(input_names)
+    for pos, v in enumerate(jaxpr.invars):
+        if pos in initializers:
+            name, arr = initializers[pos]
+            ctx.names[id(v)] = name
+            ctx.inits.append(P.tensor_proto(name, np.asarray(arr)))
+        else:
+            name = next(it_names)
+            ctx.names[id(v)] = name
+            g_inputs.append(P.value_info(
+                name, P._NP_TO_ONNX[np.dtype(v.aval.dtype).name],
+                v.aval.shape))
+
+    _convert_body(ctx, jaxpr)
+
+    g_outputs = []
+    for name, v in zip(output_names, jaxpr.outvars):
+        ctx.emit("Identity", [ctx.name_of(v)], [name])
+        g_outputs.append(P.value_info(
+            name, P._NP_TO_ONNX[np.dtype(v.aval.dtype).name], v.aval.shape))
+    return P.graph(ctx.nodes, graph_name, ctx.inits, g_inputs, g_outputs)

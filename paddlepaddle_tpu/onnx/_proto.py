@@ -1,0 +1,150 @@
+"""Minimal protobuf wire-format writer for the ONNX schema subset the
+exporter emits (ModelProto/GraphProto/NodeProto/TensorProto/...).
+
+The image vendors neither ``onnx`` nor ``protoc`` schemas, so the writer
+serializes the wire format directly (varint + length-delimited fields, the
+whole ONNX schema uses nothing else except float fields). Field numbers
+follow the public ``onnx/onnx.proto`` (ONNX IR v8 / opset 13 era); the
+structural and semantic correctness of emitted files is exercised by the
+numpy ONNX interpreter in tests/test_onnx_export.py.
+"""
+
+from __future__ import annotations
+
+import struct
+
+# onnx.proto TensorProto.DataType
+FLOAT, UINT8, INT8, UINT16, INT16, INT32, INT64 = 1, 2, 3, 4, 5, 6, 7
+STRING, BOOL, FLOAT16, DOUBLE, UINT32, UINT64 = 8, 9, 10, 11, 12, 13
+BFLOAT16 = 16
+
+_NP_TO_ONNX = {
+    "float32": FLOAT, "float64": DOUBLE, "float16": FLOAT16,
+    "bfloat16": BFLOAT16, "int8": INT8, "uint8": UINT8, "int16": INT16,
+    "uint16": UINT16, "int32": INT32, "int64": INT64, "uint32": UINT32,
+    "uint64": UINT64, "bool": BOOL,
+}
+
+# AttributeProto.AttributeType
+A_FLOAT, A_INT, A_STRING, A_TENSOR = 1, 2, 3, 4
+A_FLOATS, A_INTS, A_STRINGS = 6, 7, 8
+
+
+def _varint(n: int) -> bytes:
+    if n < 0:
+        n += 1 << 64                       # protobuf encodes int64 two's-c.
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def fv(field: int, n: int) -> bytes:
+    """varint field"""
+    return _varint(field << 3) + _varint(int(n))
+
+
+def fb(field: int, payload: bytes) -> bytes:
+    """length-delimited field (sub-message / string / packed)"""
+    return _varint((field << 3) | 2) + _varint(len(payload)) + payload
+
+
+def fs(field: int, s: str) -> bytes:
+    return fb(field, s.encode("utf-8"))
+
+
+def ff(field: int, x: float) -> bytes:
+    """32-bit float field (wire type 5)"""
+    return _varint((field << 3) | 5) + struct.pack("<f", float(x))
+
+
+def packed_varints(vals) -> bytes:
+    return b"".join(_varint(int(v)) for v in vals)
+
+
+def packed_floats(vals) -> bytes:
+    return struct.pack(f"<{len(vals)}f", *[float(v) for v in vals])
+
+
+def tensor_proto(name: str, np_array) -> bytes:
+    """TensorProto{dims=1, data_type=2, name=8, raw_data=9}"""
+    import numpy as np
+
+    a = np.ascontiguousarray(np_array)
+    dt = _NP_TO_ONNX.get(a.dtype.name)
+    if dt is None:
+        raise ValueError(f"onnx export: unsupported dtype {a.dtype}")
+    if a.dtype.name == "bfloat16":                    # raw little-endian u16
+        raw = a.view(np.uint16).tobytes()
+    else:
+        raw = a.tobytes()
+    return (fb(1, packed_varints(a.shape)) + fv(2, dt)
+            + fs(8, name) + fb(9, raw))
+
+
+def attr(name: str, value) -> bytes:
+    """AttributeProto{name=1, f=2, i=3, s=4, t=5, floats=7, ints=8, type=20}"""
+    body = fs(1, name)
+    if isinstance(value, bool):
+        return body + fv(3, int(value)) + fv(20, A_INT)
+    if isinstance(value, int):
+        return body + fv(3, value) + fv(20, A_INT)
+    if isinstance(value, float):
+        return body + ff(2, value) + fv(20, A_FLOAT)
+    if isinstance(value, str):
+        return body + fs(4, value) + fv(20, A_STRING)
+    if isinstance(value, bytes):                       # pre-built TensorProto
+        return body + fb(5, value) + fv(20, A_TENSOR)
+    if isinstance(value, (list, tuple)):
+        if all(isinstance(v, (int, bool)) for v in value):
+            return body + b"".join(fv(8, int(v)) for v in value) \
+                + fv(20, A_INTS)
+        if all(isinstance(v, float) for v in value):
+            return body + b"".join(ff(7, v) for v in value) + fv(20, A_FLOATS)
+        if all(isinstance(v, str) for v in value):
+            return body + b"".join(fb(9, v.encode()) for v in value) \
+                + fv(20, A_STRINGS)
+    raise TypeError(f"onnx attr {name}: unsupported value {value!r}")
+
+
+def node(op_type: str, inputs, outputs, name: str = "", **attrs) -> bytes:
+    """NodeProto{input=1, output=2, name=3, op_type=4, attribute=5}"""
+    body = b"".join(fs(1, i) for i in inputs)
+    body += b"".join(fs(2, o) for o in outputs)
+    if name:
+        body += fs(3, name)
+    body += fs(4, op_type)
+    body += b"".join(fb(5, attr(k, v)) for k, v in attrs.items())
+    return body
+
+
+def value_info(name: str, elem_type: int, shape) -> bytes:
+    """ValueInfoProto{name=1, type=2}; TypeProto{tensor_type=1};
+    TypeProto.Tensor{elem_type=1, shape=2}; TensorShapeProto{dim=1};
+    Dimension{dim_value=1, dim_param=2}"""
+    dims = b""
+    for d in shape:
+        dims += fb(1, fs(2, d) if isinstance(d, str) else fv(1, int(d)))
+    tt = fv(1, elem_type) + fb(2, dims)
+    return fs(1, name) + fb(2, fb(1, tt))
+
+
+def graph(nodes, name, initializers, inputs, outputs) -> bytes:
+    """GraphProto{node=1, name=2, initializer=5, input=11, output=12}"""
+    body = b"".join(fb(1, n) for n in nodes)
+    body += fs(2, name)
+    body += b"".join(fb(5, t) for t in initializers)
+    body += b"".join(fb(11, v) for v in inputs)
+    body += b"".join(fb(12, v) for v in outputs)
+    return body
+
+
+def model(graph_bytes: bytes, opset: int, producer: str = "paddlepaddle_tpu",
+          ir_version: int = 8) -> bytes:
+    """ModelProto{ir_version=1, producer_name=2, producer_version=3,
+    graph=7, opset_import=8}; OperatorSetIdProto{domain=1, version=2}"""
+    return (fv(1, ir_version) + fs(2, producer) + fs(3, "0.0")
+            + fb(7, graph_bytes) + fb(8, fs(1, "") + fv(2, opset)))

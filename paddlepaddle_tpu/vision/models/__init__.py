@@ -8,7 +8,11 @@ from ...models.resnet import (  # noqa: F401
     resnet101,
     resnet152,
     resnext50_32x4d,
+    resnext50_64x4d,
+    resnext101_32x4d,
     resnext101_64x4d,
+    resnext152_32x4d,
+    resnext152_64x4d,
     wide_resnet50_2,
     wide_resnet101_2,
 )
@@ -21,6 +25,7 @@ from .densenet import (  # noqa: F401
     densenet161,
     densenet169,
     densenet201,
+    densenet264,
 )
 from .lenet import LeNet  # noqa: F401
 from .mobilenet import (  # noqa: F401
@@ -35,6 +40,9 @@ from .mobilenet import (  # noqa: F401
 )
 from .shufflenetv2 import (  # noqa: F401
     ShuffleNetV2,
+    shufflenet_v2_swish,
+    shufflenet_v2_x0_25,
+    shufflenet_v2_x0_33,
     shufflenet_v2_x0_5,
     shufflenet_v2_x1_0,
     shufflenet_v2_x1_5,

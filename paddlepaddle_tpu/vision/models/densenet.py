@@ -61,13 +61,19 @@ class _Transition(Layer):
 
 
 class DenseNet(Layer):
-    _cfgs = {121: (6, 12, 24, 16), 161: (6, 12, 36, 24),
-             169: (6, 12, 32, 32), 201: (6, 12, 48, 32), 264: (6, 12, 64, 48)}
+    # layers -> (num_init_features, growth_rate, block_config); densenet161
+    # is the wide variant (96, 48) — reference vision/models/densenet.py:296
+    _cfgs = {121: (64, 32, (6, 12, 24, 16)), 161: (96, 48, (6, 12, 36, 24)),
+             169: (64, 32, (6, 12, 32, 32)), 201: (64, 32, (6, 12, 48, 32)),
+             264: (64, 32, (6, 12, 64, 48))}
 
-    def __init__(self, layers=121, growth_rate=32, num_init_features=64,
+    def __init__(self, layers=121, growth_rate=None, num_init_features=None,
                  bn_size=4, dropout=0.0, num_classes=1000, with_pool=True):
         super().__init__()
-        block_config = self._cfgs[layers]
+        cfg_init, cfg_growth, block_config = self._cfgs[layers]
+        growth_rate = cfg_growth if growth_rate is None else growth_rate
+        num_init_features = (cfg_init if num_init_features is None
+                             else num_init_features)
         self.features_head = Sequential(
             Conv2D(3, num_init_features, 7, stride=2, padding=3, bias_attr=False),
             BatchNorm2D(num_init_features), ReLU(), MaxPool2D(3, 2, padding=1))
@@ -112,3 +118,7 @@ def densenet169(**kwargs):
 
 def densenet201(**kwargs):
     return DenseNet(201, **kwargs)
+
+
+def densenet264(**kwargs):
+    return DenseNet(264, **kwargs)

@@ -70,10 +70,12 @@ class _InvertedResidual(Layer):
 class ShuffleNetV2(Layer):
     _stage_repeats = [4, 8, 4]
     _out_channels = {
+        0.25: [24, 24, 48, 96, 512],
+        0.33: [24, 32, 64, 128, 512],
         0.5: [24, 48, 96, 192, 1024],
         1.0: [24, 116, 232, 464, 1024],
         1.5: [24, 176, 352, 704, 1024],
-        2.0: [24, 244, 488, 976, 2048],
+        2.0: [24, 224, 488, 976, 2048],
     }
 
     def __init__(self, scale=1.0, act="relu", num_classes=1000, with_pool=True):
@@ -111,6 +113,14 @@ class ShuffleNetV2(Layer):
         return x
 
 
+def shufflenet_v2_x0_25(**kwargs):
+    return ShuffleNetV2(scale=0.25, **kwargs)
+
+
+def shufflenet_v2_x0_33(**kwargs):
+    return ShuffleNetV2(scale=0.33, **kwargs)
+
+
 def shufflenet_v2_x0_5(**kwargs):
     return ShuffleNetV2(scale=0.5, **kwargs)
 
@@ -125,3 +135,7 @@ def shufflenet_v2_x1_5(**kwargs):
 
 def shufflenet_v2_x2_0(**kwargs):
     return ShuffleNetV2(scale=2.0, **kwargs)
+
+
+def shufflenet_v2_swish(**kwargs):
+    return ShuffleNetV2(scale=1.0, act="swish", **kwargs)

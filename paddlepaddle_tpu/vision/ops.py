@@ -3,9 +3,9 @@
 Reference surface: python/paddle/vision/ops.py (nms, roi_align, roi_pool,
 box_coder, deform_conv2d, yolo ops, ...). TPU-native surface: nms, matrix_nms,
 roi_align/roi_pool/psroi_pool (+ layer forms), box_coder, prior_box,
-generate_proposals, FPN distribution, and file IO implemented with static
-shapes; only deform_conv2d and the yolo decode/loss pair raise with their
-story (data-dependent sampling / detector-specific CUDA kernels).
+generate_proposals, FPN distribution, file IO, deform_conv2d (bilinear
+gather + grouped GEMM), and the yolo decode/loss pair — all with static
+shapes.
 """
 
 from __future__ import annotations
@@ -216,21 +216,282 @@ def box_coder(prior_box, prior_box_var, target_box,
                     op_name="box_coder")
 
 
-def deform_conv2d(*a, **k):
-    raise NotImplementedError(
-        "deform_conv2d's data-dependent sampling offsets defeat XLA's "
-        "static-gather lowering; it is CUDA-specific in the reference "
-        "(deformable_conv kernels) and out of the TPU-native surface")
+def _bilinear_gather(x_g, h_im, w_im, H, W):
+    """Bilinear sample with per-corner zero padding (reference
+    funcs::DmcnIm2colBilinear, deformable_conv_functor.h:23): corners
+    outside [0, H-1]x[0, W-1] contribute zero.
+
+    x_g:  [n, dg, cpg, H*W] flattened group-split image.
+    h_im, w_im: [n, dg, T] fractional sample coordinates.
+    Returns [n, dg, cpg, T].
+    """
+    h_low = jnp.floor(h_im)
+    w_low = jnp.floor(w_im)
+    lh = h_im - h_low
+    lw = w_im - w_low
+    hl = h_low.astype(jnp.int32)
+    wl = w_low.astype(jnp.int32)
+
+    out = 0.0
+    for dh, dw, cw in ((0, 0, (1 - lh) * (1 - lw)), (0, 1, (1 - lh) * lw),
+                       (1, 0, lh * (1 - lw)), (1, 1, lh * lw)):
+        hh = hl + dh
+        ww = wl + dw
+        ok = (hh >= 0) & (hh <= H - 1) & (ww >= 0) & (ww <= W - 1)
+        idx = jnp.clip(hh, 0, H - 1) * W + jnp.clip(ww, 0, W - 1)
+        v = jnp.take_along_axis(x_g, idx[:, :, None, :], axis=-1)
+        out = out + jnp.where(ok, cw, 0.0)[:, :, None, :] * v
+    return out
 
 
-def yolo_box(*a, **k):
-    raise NotImplementedError(
-        "yolo_box/yolo_loss are detector-specific CUDA kernels in the "
-        "reference; compose from nms/box_coder or file the decode math "
-        "as a custom op (paddle.utils.register_op)")
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1 (``mask=None``) / v2 (reference
+    vision/ops.py deform_conv2d, kernel semantics from
+    phi/kernels/funcs/deformable_conv_functor.cc:22): each kernel tap
+    samples the input at ``p + p_k + Δp_k`` by bilinear interpolation
+    (zero outside), optionally modulated by ``Δm_k``, then a grouped
+    GEMM applies the filter — im2col-with-offsets as one vectorized
+    XLA gather feeding a dot_general on the MXU.
+
+    offset: [N, 2*dg*kh*kw, Ho, Wo], channel pairs (dy, dx) per tap;
+    mask:   [N, dg*kh*kw, Ho, Wo].
+    """
+    sh, sw = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    ph, pw = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dh_, dw_ = (dilation, dilation) if isinstance(dilation, int) \
+        else tuple(dilation)
+    dg = deformable_groups
+
+    def f(x, offset, mask, weight, bias):
+        n, cin, H, W = x.shape
+        cout, cpg_w, kh, kw = weight.shape
+        if cin % groups or cin % dg or cpg_w != cin // groups:
+            raise ValueError(
+                f"deform_conv2d: in_channels {cin} incompatible with "
+                f"groups={groups}/deformable_groups={dg}/weight {weight.shape}")
+        Ho = (H + 2 * ph - (dh_ * (kh - 1) + 1)) // sh + 1
+        Wo = (W + 2 * pw - (dw_ * (kw - 1) + 1)) // sw + 1
+        dt = jnp.result_type(x.dtype, jnp.float32)
+        xf = x.astype(dt)
+        off = offset.astype(dt).reshape(n, dg, kh * kw, 2, Ho, Wo)
+
+        ti, tj = jnp.meshgrid(jnp.arange(kh), jnp.arange(kw), indexing="ij")
+        base_h = (jnp.arange(Ho) * sh - ph)[:, None] \
+            + (ti.reshape(-1) * dh_)[None, :]            # [Ho, taps]
+        base_w = (jnp.arange(Wo) * sw - pw)[:, None] \
+            + (tj.reshape(-1) * dw_)[None, :]            # [Wo, taps]
+        # sample coords [n, dg, taps, Ho, Wo]
+        h_im = base_h.T[None, None, :, :, None] + off[:, :, :, 0]
+        w_im = base_w.T[None, None, :, None, :] + off[:, :, :, 1]
+        # reference gate: the whole tap is zero unless -1 < p < size
+        ok = (h_im > -1) & (h_im < H) & (w_im > -1) & (w_im < W)
+
+        T = kh * kw * Ho * Wo
+        x_g = xf.reshape(n, dg, cin // dg, H * W)
+        cols = _bilinear_gather(x_g, h_im.reshape(n, dg, T),
+                                w_im.reshape(n, dg, T), H, W)
+        cols = cols * ok.reshape(n, dg, 1, T)
+        if mask is not None:
+            m = mask.astype(dt).reshape(n, dg, 1, T)
+            cols = cols * m
+        # [n, dg, cpg_dg, taps, Ho*Wo] -> [n, cin, taps, Ho*Wo], channel-major
+        cols = cols.reshape(n, dg, cin // dg, kh * kw, Ho * Wo)
+        cols = cols.reshape(n, cin, kh * kw, Ho * Wo)
+        cols = cols.reshape(n, groups, (cin // groups) * kh * kw, Ho * Wo)
+        wg = weight.astype(dt).reshape(
+            groups, cout // groups, (cin // groups) * kh * kw)
+        out = jnp.einsum("gok,ngkp->ngop", wg, cols,
+                         preferred_element_type=dt)
+        out = out.reshape(n, cout, Ho, Wo)
+        if bias is not None:
+            out = out + bias.astype(dt)[None, :, None, None]
+        return out.astype(x.dtype)
+
+    return apply_op(f, x, offset, mask, weight, bias,
+                    op_name="deform_conv2d")
 
 
-yolo_loss = yolo_box
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, name=None, scale_x_y=1.0, iou_aware=False,
+             iou_aware_factor=0.5):
+    """YOLOv3 box decode (reference phi/kernels/cpu/yolo_box_kernel.cc:25,
+    funcs/yolo_box_util.h:26): grid-offset sigmoid xy, anchor-scaled exp
+    wh, boxes rescaled to image size as xyxy; entries whose (iou-aware)
+    confidence is below ``conf_thresh`` output zero boxes and scores.
+
+    Returns (boxes [N, an*H*W, 4], scores [N, an*H*W, class_num]).
+    """
+    an = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)
+    an_num = an.shape[0]
+    scale = float(scale_x_y)
+    bias = -0.5 * (scale - 1.0)
+
+    def f(x, img_size):
+        n, c, h, w = x.shape
+        xf = x.astype(jnp.float32)
+        if iou_aware:
+            iou_t = xf[:, :an_num].reshape(n, an_num, h, w)
+            box_t = xf[:, an_num:].reshape(n, an_num, 5 + class_num, h, w)
+        else:
+            box_t = xf.reshape(n, an_num, 5 + class_num, h, w)
+        img_h = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+        img_w = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+        gx = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+        gy = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+        sig = jax.nn.sigmoid
+        bx = (gx + sig(box_t[:, :, 0]) * scale + bias) * img_w / w
+        by = (gy + sig(box_t[:, :, 1]) * scale + bias) * img_h / h
+        bw = jnp.exp(box_t[:, :, 2]) * an[None, :, 0, None, None] * img_w \
+            / (downsample_ratio * w)
+        bh = jnp.exp(box_t[:, :, 3]) * an[None, :, 1, None, None] * img_h \
+            / (downsample_ratio * h)
+        conf = sig(box_t[:, :, 4])
+        if iou_aware:
+            conf = conf ** (1.0 - iou_aware_factor) \
+                * sig(iou_t) ** iou_aware_factor
+        keep = conf >= conf_thresh
+
+        x1, y1 = bx - bw * 0.5, by - bh * 0.5
+        x2, y2 = bx + bw * 0.5, by + bh * 0.5
+        if clip_bbox:
+            x1, y1 = jnp.maximum(x1, 0.0), jnp.maximum(y1, 0.0)
+            x2 = jnp.minimum(x2, img_w - 1.0)
+            y2 = jnp.minimum(y2, img_h - 1.0)
+        boxes = jnp.stack([x1, y1, x2, y2], -1) * keep[..., None]
+        scores = (conf[..., None] * sig(
+            jnp.moveaxis(box_t[:, :, 5:], 2, -1))) * keep[..., None]
+        return (boxes.reshape(n, an_num * h * w, 4),
+                scores.reshape(n, an_num * h * w, class_num))
+
+    return apply_op(f, x, img_size, op_name="yolo_box")
+
+
+def _cxcywh_iou(b1, b2):
+    """IoU of center-size boxes, broadcasting (reference CalcBoxIoU,
+    cpu/yolo_loss_kernel.cc:83 — no epsilon in the union)."""
+    ov_w = jnp.minimum(b1[..., 0] + b1[..., 2] / 2, b2[..., 0] + b2[..., 2] / 2) \
+        - jnp.maximum(b1[..., 0] - b1[..., 2] / 2, b2[..., 0] - b2[..., 2] / 2)
+    ov_h = jnp.minimum(b1[..., 1] + b1[..., 3] / 2, b2[..., 1] + b2[..., 3] / 2) \
+        - jnp.maximum(b1[..., 1] - b1[..., 3] / 2, b2[..., 1] - b2[..., 3] / 2)
+    inter = jnp.where((ov_w < 0) | (ov_h < 0), 0.0, ov_w * ov_h)
+    union = b1[..., 2] * b1[..., 3] + b2[..., 2] * b2[..., 3] - inter
+    return inter / union
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 loss (reference phi/kernels/cpu/yolo_loss_kernel.cc:181):
+    sigmoid-CE xy + L1 wh box loss scaled by (2 - w*h)*score at each
+    gt's best-anchor cell, label-smoothed class CE, and objectness CE
+    where predictions overlapping any gt above ``ignore_thresh`` are
+    ignored. Fully vectorized except the per-gt objectness scatter,
+    which keeps the kernel's last-writer-wins order via a trace-time
+    loop over the (static) max-box dimension. Returns loss [N]."""
+    an = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)
+    an_num = an.shape[0]
+    mask_list = list(anchor_mask)
+    mask_num = len(mask_list)
+    # an_idx -> first position in anchor_mask, or -1 (GetMaskIndex)
+    lut = [-1] * an_num
+    for pos, v in enumerate(mask_list):
+        if lut[v] == -1:
+            lut[v] = pos
+    lut = jnp.asarray(lut, jnp.int32)
+    scale = float(scale_x_y)
+    bias = -0.5 * (scale - 1.0)
+
+    if use_label_smooth:
+        sw = min(1.0 / class_num, 1.0 / 40)
+        label_pos, label_neg = 1.0 - sw, sw
+    else:
+        label_pos, label_neg = 1.0, 0.0
+
+    def sce(logit, label):
+        return jnp.maximum(logit, 0.0) - logit * label \
+            + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+    def f(x, gt_box, gt_label, gt_score):
+        n, c, h, w = x.shape
+        if h != w:
+            # the reference kernel mixes grid_size=h with gi=gt.x*w and is
+            # only well-defined on square maps (its docstring requires H==W)
+            raise ValueError(f"yolo_loss requires a square feature map, "
+                             f"got H={h}, W={w}")
+        b = gt_box.shape[1]
+        input_size = downsample_ratio * h
+        xr = x.astype(jnp.float32).reshape(n, mask_num, 5 + class_num, h, w)
+        gt = gt_box.astype(jnp.float32)
+        score = (jnp.ones((n, b), jnp.float32) if gt_score is None
+                 else gt_score.astype(jnp.float32))
+        valid = (gt[..., 2] >= 1e-6) & (gt[..., 3] >= 1e-6)
+
+        # --- ignore mask: best pred-vs-gt IoU > ignore_thresh ---
+        gx = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+        gy = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+        sig = jax.nn.sigmoid
+        m_an = an[jnp.asarray(mask_list, jnp.int32)]     # [mask_num, 2]
+        px = (gx + sig(xr[:, :, 0]) * scale + bias) / w
+        py = (gy + sig(xr[:, :, 1]) * scale + bias) / h
+        pw = jnp.exp(xr[:, :, 2]) * m_an[None, :, 0, None, None] / input_size
+        ph = jnp.exp(xr[:, :, 3]) * m_an[None, :, 1, None, None] / input_size
+        pred = jnp.stack([px, py, pw, ph], -1)           # [n,mask,h,w,4]
+        iou = _cxcywh_iou(pred[:, :, :, :, None, :],
+                          gt[:, None, None, None, :, :])  # [n,mask,h,w,b]
+        iou = jnp.where(valid[:, None, None, None, :], iou, 0.0)
+        best_iou = jnp.max(iou, -1) if b else jnp.zeros_like(px)
+        obj_mask = jnp.where(best_iou > ignore_thresh, -1.0, 0.0)
+
+        # --- per-gt best anchor over ALL anchors (shifted-box IoU) ---
+        inter = jnp.minimum(an[None, None, :, 0] / input_size, gt[..., None, 2]) \
+            * jnp.minimum(an[None, None, :, 1] / input_size, gt[..., None, 3])
+        a_area = (an[:, 0] * an[:, 1] / (input_size * input_size))[None, None]
+        union = a_area + gt[..., None, 2] * gt[..., None, 3] - inter
+        best_n = jnp.argmax(inter / union, -1)           # [n, b]
+        mask_idx = lut[best_n]
+        matched = valid & (mask_idx >= 0)
+
+        gi = jnp.clip((gt[..., 0] * w).astype(jnp.int32), 0, w - 1)
+        gj = jnp.clip((gt[..., 1] * h).astype(jnp.int32), 0, h - 1)
+
+        # gather predictions at each gt cell: [n, b, 5+class]
+        ii = jnp.arange(n)[:, None]
+        mi = jnp.maximum(mask_idx, 0)
+        pv = jnp.moveaxis(xr, 2, -1)[ii, mi, gj, gi]
+
+        tx = gt[..., 0] * w - gi
+        ty = gt[..., 1] * h - gj
+        tw = jnp.log(gt[..., 2] * input_size
+                     / jnp.maximum(an[best_n, 0], 1e-10))
+        th = jnp.log(gt[..., 3] * input_size
+                     / jnp.maximum(an[best_n, 1], 1e-10))
+        box_w = (2.0 - gt[..., 2] * gt[..., 3]) * score
+        loc = (sce(pv[..., 0], tx) + sce(pv[..., 1], ty)
+               + jnp.abs(pv[..., 2] - tw) + jnp.abs(pv[..., 3] - th)) * box_w
+
+        cls_t = jnp.where(
+            jnp.arange(class_num)[None, None] == gt_label[..., None],
+            label_pos, label_neg)
+        cls = jnp.sum(sce(pv[..., 5:], cls_t), -1) * score
+        loss = jnp.sum(jnp.where(matched, loc + cls, 0.0), -1)   # [n]
+
+        # --- objectness target: sequential writes keep C-kernel order ---
+        mi_w = jnp.where(matched, mask_idx, mask_num)    # OOB -> dropped
+        ib = jnp.arange(n)
+        for t in range(b):
+            obj_mask = obj_mask.at[ib, mi_w[:, t], gj[:, t], gi[:, t]].set(
+                score[:, t], mode="drop")
+        tobj = xr[:, :, 4]
+        pos = obj_mask > 1e-5
+        neg = (~pos) & (obj_mask > -0.5)
+        obj_loss = jnp.sum(
+            jnp.where(pos, sce(tobj, 1.0) * obj_mask, 0.0)
+            + jnp.where(neg, sce(tobj, 0.0), 0.0), (1, 2, 3))
+        return loss + obj_loss
+
+    return apply_op(f, x, gt_box, gt_label, gt_score, op_name="yolo_loss")
 
 
 
@@ -613,8 +874,23 @@ class PSRoIPool:
                           self.spatial_scale)
 
 
-class DeformConv2D:
-    def __init__(self, *a, **k):
-        raise NotImplementedError(
-            "DeformConv2D's data-dependent sampling offsets defeat XLA's "
-            "static-gather lowering (CUDA-specific in the reference)")
+from ..nn.conv import _ConvNd  # noqa: E402  (after the function surface)
+
+
+class DeformConv2D(_ConvNd):
+    """Layer form of deform_conv2d (reference vision/ops.py DeformConv2D):
+    holds the filter/bias; offset (and optional v2 mask) are forward
+    inputs produced by a sibling conv branch."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__(in_channels, out_channels, kernel_size, 2, stride,
+                         padding, dilation, groups, "zeros", weight_attr,
+                         bias_attr, "NCHW")
+        self._deformable_groups = deformable_groups
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(
+            x, offset, self.weight, self.bias, self._stride, self._padding,
+            self._dilation, self._deformable_groups, self._groups, mask)

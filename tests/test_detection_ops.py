@@ -1,0 +1,328 @@
+"""Detection ops closed this round — yolo_box / yolo_loss / deform_conv2d —
+checked against independent numpy loop oracles implementing the reference
+kernel semantics (phi/kernels/cpu/{yolo_box,yolo_loss}_kernel.cc,
+phi/kernels/funcs/deformable_conv_functor.cc)."""
+
+import numpy as np
+import pytest
+
+import paddlepaddle_tpu as paddle
+import paddlepaddle_tpu.nn.functional as F
+from paddlepaddle_tpu.vision import ops as vops
+
+rng = np.random.default_rng(7)
+
+
+def _sigmoid(v):
+    return 1.0 / (1.0 + np.exp(-v))
+
+
+# ---------------------------------------------------------------- yolo_box
+
+def _yolo_box_np(x, img_size, anchors, class_num, conf_thresh, downsample,
+                 clip_bbox, scale_x_y, iou_aware, iou_aware_factor):
+    n, _, h, w = x.shape
+    an = np.asarray(anchors, np.float32).reshape(-1, 2)
+    an_num = an.shape[0]
+    bias = -0.5 * (scale_x_y - 1.0)
+    boxes = np.zeros((n, an_num * h * w, 4), np.float32)
+    scores = np.zeros((n, an_num * h * w, class_num), np.float32)
+    if iou_aware:
+        iou_t, box_t = x[:, :an_num], x[:, an_num:]
+    else:
+        iou_t, box_t = None, x
+    box_t = box_t.reshape(n, an_num, 5 + class_num, h, w)
+    for i in range(n):
+        img_h, img_w = float(img_size[i, 0]), float(img_size[i, 1])
+        for j in range(an_num):
+            for k in range(h):
+                for l in range(w):
+                    conf = _sigmoid(box_t[i, j, 4, k, l])
+                    if iou_aware:
+                        iou = _sigmoid(iou_t[i, j, k, l])
+                        conf = conf ** (1 - iou_aware_factor) * \
+                            iou ** iou_aware_factor
+                    if conf < conf_thresh:
+                        continue
+                    bx = (l + _sigmoid(box_t[i, j, 0, k, l]) * scale_x_y
+                          + bias) * img_w / w
+                    by = (k + _sigmoid(box_t[i, j, 1, k, l]) * scale_x_y
+                          + bias) * img_h / h
+                    bw = np.exp(box_t[i, j, 2, k, l]) * an[j, 0] * img_w \
+                        / (downsample * w)
+                    bh = np.exp(box_t[i, j, 3, k, l]) * an[j, 1] * img_h \
+                        / (downsample * h)
+                    bi = j * h * w + k * w + l
+                    bb = [bx - bw / 2, by - bh / 2, bx + bw / 2, by + bh / 2]
+                    if clip_bbox:
+                        bb[0] = max(bb[0], 0.0)
+                        bb[1] = max(bb[1], 0.0)
+                        bb[2] = min(bb[2], img_w - 1)
+                        bb[3] = min(bb[3], img_h - 1)
+                    boxes[i, bi] = bb
+                    scores[i, bi] = conf * _sigmoid(box_t[i, j, 5:, k, l])
+    return boxes, scores
+
+
+@pytest.mark.parametrize("iou_aware,scale_x_y,clip",
+                         [(False, 1.0, True), (True, 1.2, False)])
+def test_yolo_box_vs_oracle(iou_aware, scale_x_y, clip):
+    anchors = [10, 13, 16, 30]
+    class_num, h, w = 3, 5, 5
+    cin = len(anchors) // 2 * (5 + class_num + (1 if iou_aware else 0))
+    x = rng.standard_normal((2, cin, h, w)).astype(np.float32)
+    img = np.array([[80, 64], [48, 48]], np.int32)
+    ref_b, ref_s = _yolo_box_np(x, img, anchors, class_num, 0.3, 8, clip,
+                                scale_x_y, iou_aware, 0.5)
+    b, s = vops.yolo_box(paddle.to_tensor(x), paddle.to_tensor(img), anchors,
+                         class_num, 0.3, 8, clip_bbox=clip,
+                         scale_x_y=scale_x_y, iou_aware=iou_aware)
+    np.testing.assert_allclose(b.numpy(), ref_b, rtol=1e-5, atol=1e-4)
+    np.testing.assert_allclose(s.numpy(), ref_s, rtol=1e-5, atol=1e-5)
+
+
+# --------------------------------------------------------------- yolo_loss
+
+def _sce(x, label):
+    return max(x, 0.0) - x * label + np.log1p(np.exp(-abs(x)))
+
+
+def _iou_cxcywh(b1, b2):
+    ow = min(b1[0] + b1[2] / 2, b2[0] + b2[2] / 2) - \
+        max(b1[0] - b1[2] / 2, b2[0] - b2[2] / 2)
+    oh = min(b1[1] + b1[3] / 2, b2[1] + b2[3] / 2) - \
+        max(b1[1] - b1[3] / 2, b2[1] - b2[3] / 2)
+    inter = 0.0 if (ow < 0 or oh < 0) else ow * oh
+    return inter / (b1[2] * b1[3] + b2[2] * b2[3] - inter)
+
+
+def _yolo_loss_np(x, gt_box, gt_label, gt_score, anchors, anchor_mask,
+                  class_num, ignore_thresh, downsample, use_label_smooth,
+                  scale_x_y):
+    n, _, h, w = x.shape
+    b = gt_box.shape[1]
+    an = np.asarray(anchors, np.float32).reshape(-1, 2)
+    mask_num = len(anchor_mask)
+    input_size = downsample * h
+    bias = -0.5 * (scale_x_y - 1.0)
+    xr = x.reshape(n, mask_num, 5 + class_num, h, w)
+    if use_label_smooth:
+        sw = min(1.0 / class_num, 1.0 / 40)
+        pos, neg = 1.0 - sw, sw
+    else:
+        pos, neg = 1.0, 0.0
+    if gt_score is None:
+        gt_score = np.ones((n, b), np.float32)
+    loss = np.zeros((n,), np.float64)
+    obj_mask = np.zeros((n, mask_num, h, w), np.float32)
+    valid = (gt_box[..., 2] >= 1e-6) & (gt_box[..., 3] >= 1e-6)
+
+    for i in range(n):
+        for j in range(mask_num):
+            for k in range(h):
+                for l in range(w):
+                    px = (l + _sigmoid(xr[i, j, 0, k, l]) * scale_x_y
+                          + bias) / w
+                    py = (k + _sigmoid(xr[i, j, 1, k, l]) * scale_x_y
+                          + bias) / h
+                    pw = np.exp(xr[i, j, 2, k, l]) * an[anchor_mask[j], 0] \
+                        / input_size
+                    ph = np.exp(xr[i, j, 3, k, l]) * an[anchor_mask[j], 1] \
+                        / input_size
+                    best = 0.0
+                    for t in range(b):
+                        if not valid[i, t]:
+                            continue
+                        best = max(best, _iou_cxcywh(
+                            (px, py, pw, ph), gt_box[i, t]))
+                    if best > ignore_thresh:
+                        obj_mask[i, j, k, l] = -1
+        for t in range(b):
+            if not valid[i, t]:
+                continue
+            gx, gy, gw, gh = gt_box[i, t]
+            gi, gj = int(gx * w), int(gy * h)
+            best_iou, best_n = 0.0, 0
+            for a_idx in range(an.shape[0]):
+                iou = _iou_cxcywh((0, 0, an[a_idx, 0] / input_size,
+                                   an[a_idx, 1] / input_size), (0, 0, gw, gh))
+                if iou > best_iou:
+                    best_iou, best_n = iou, a_idx
+            mask_idx = anchor_mask.index(best_n) if best_n in anchor_mask \
+                else -1
+            if mask_idx < 0:
+                continue
+            score = gt_score[i, t]
+            sc = (2.0 - gw * gh) * score
+            loss[i] += _sce(xr[i, mask_idx, 0, gj, gi], gx * w - gi) * sc
+            loss[i] += _sce(xr[i, mask_idx, 1, gj, gi], gy * h - gj) * sc
+            loss[i] += abs(xr[i, mask_idx, 2, gj, gi]
+                           - np.log(gw * input_size / an[best_n, 0])) * sc
+            loss[i] += abs(xr[i, mask_idx, 3, gj, gi]
+                           - np.log(gh * input_size / an[best_n, 1])) * sc
+            obj_mask[i, mask_idx, gj, gi] = score
+            for c in range(class_num):
+                loss[i] += _sce(xr[i, mask_idx, 5 + c, gj, gi],
+                                pos if c == gt_label[i, t] else neg) * score
+        for j in range(mask_num):
+            for k in range(h):
+                for l in range(w):
+                    o = obj_mask[i, j, k, l]
+                    if o > 1e-5:
+                        loss[i] += _sce(xr[i, j, 4, k, l], 1.0) * o
+                    elif o > -0.5:
+                        loss[i] += _sce(xr[i, j, 4, k, l], 0.0)
+    return loss.astype(np.float32)
+
+
+@pytest.mark.parametrize("use_smooth,scale_x_y,with_score",
+                         [(True, 1.0, False), (False, 1.1, True)])
+def test_yolo_loss_vs_oracle(use_smooth, scale_x_y, with_score):
+    anchors = [10, 13, 16, 30, 33, 23]
+    anchor_mask = [0, 1]
+    class_num, h, w, b = 4, 6, 6, 5
+    n = 2
+    x = rng.standard_normal(
+        (n, len(anchor_mask) * (5 + class_num), h, w)).astype(np.float32)
+    gt_box = rng.uniform(0.05, 0.9, (n, b, 4)).astype(np.float32)
+    gt_box[:, :, 2:] *= 0.4
+    gt_box[0, 3] = 0.0                      # invalid gt (w,h = 0)
+    gt_label = rng.integers(0, class_num, (n, b)).astype(np.int32)
+    gt_score = rng.uniform(0.3, 1.0, (n, b)).astype(np.float32) \
+        if with_score else None
+    ref = _yolo_loss_np(x, gt_box, gt_label, gt_score, anchors, anchor_mask,
+                        class_num, 0.5, 8, use_smooth, scale_x_y)
+    out = vops.yolo_loss(
+        paddle.to_tensor(x), paddle.to_tensor(gt_box),
+        paddle.to_tensor(gt_label), anchors, anchor_mask, class_num, 0.5, 8,
+        gt_score=None if gt_score is None else paddle.to_tensor(gt_score),
+        use_label_smooth=use_smooth, scale_x_y=scale_x_y)
+    assert out.shape == [n]
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_yolo_loss_duplicate_cell_last_writer_wins():
+    # two gts land in the same cell with the same best anchor: the second
+    # write must own the objectness target (C kernel iterates t in order)
+    anchors = [10, 13]
+    x = np.zeros((1, 1 * 9, 4, 4), np.float32)
+    gt_box = np.array([[[0.3, 0.3, 0.2, 0.2], [0.31, 0.31, 0.2, 0.2]]],
+                      np.float32)
+    gt_label = np.zeros((1, 2), np.int32)
+    gt_score = np.array([[0.4, 0.9]], np.float32)
+    ref = _yolo_loss_np(x, gt_box, gt_label, gt_score, anchors, [0], 4,
+                        0.7, 8, True, 1.0)
+    out = vops.yolo_loss(paddle.to_tensor(x), paddle.to_tensor(gt_box),
+                         paddle.to_tensor(gt_label), anchors, [0], 4, 0.7, 8,
+                         gt_score=paddle.to_tensor(gt_score))
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+
+# ----------------------------------------------------------- deform_conv2d
+
+def _deform_conv_np(x, offset, weight, bias, stride, padding, dilation,
+                    dg, groups, mask):
+    n, cin, H, W = x.shape
+    cout, cpg, kh, kw = weight.shape
+    sh, sw = stride
+    ph, pw = padding
+    dh, dw = dilation
+    Ho = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    Wo = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    off = offset.reshape(n, dg, kh * kw, 2, Ho, Wo)
+    msk = None if mask is None else mask.reshape(n, dg, kh * kw, Ho, Wo)
+    out = np.zeros((n, cout, Ho, Wo), np.float64)
+    cpdg = cin // dg
+
+    def bilinear(img, h, w):
+        hl, wl = int(np.floor(h)), int(np.floor(w))
+        val = 0.0
+        for dhi, dwi in ((0, 0), (0, 1), (1, 0), (1, 1)):
+            hh, ww = hl + dhi, wl + dwi
+            if 0 <= hh < img.shape[0] and 0 <= ww < img.shape[1]:
+                cw = (1 - abs(h - hh)) * (1 - abs(w - ww))
+                val += cw * img[hh, ww]
+        return val
+
+    for b_i in range(n):
+        for ho in range(Ho):
+            for wo in range(Wo):
+                for oc in range(cout):
+                    g = oc // (cout // groups)
+                    acc = 0.0
+                    for icg in range(cpg):
+                        ic = g * cpg + icg
+                        dgi = ic // cpdg
+                        for i in range(kh):
+                            for j in range(kw):
+                                t = i * kw + j
+                                h_im = ho * sh - ph + i * dh \
+                                    + off[b_i, dgi, t, 0, ho, wo]
+                                w_im = wo * sw - pw + j * dw \
+                                    + off[b_i, dgi, t, 1, ho, wo]
+                                v = 0.0
+                                if -1 < h_im < H and -1 < w_im < W:
+                                    v = bilinear(x[b_i, ic], h_im, w_im)
+                                if msk is not None:
+                                    v *= msk[b_i, dgi, t, ho, wo]
+                                acc += v * weight[oc, icg, i, j]
+                    out[b_i, oc, ho, wo] = acc
+                    if bias is not None:
+                        out[b_i, oc, ho, wo] += bias[oc]
+    return out.astype(np.float32)
+
+
+def test_deform_conv2d_zero_offset_matches_conv2d():
+    x = rng.standard_normal((2, 4, 7, 7)).astype(np.float32)
+    w = rng.standard_normal((6, 4, 3, 3)).astype(np.float32)
+    b = rng.standard_normal((6,)).astype(np.float32)
+    off = np.zeros((2, 2 * 9, 4, 4), np.float32)
+    got = vops.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                             paddle.to_tensor(w), paddle.to_tensor(b),
+                             stride=2, padding=1)
+    want = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                    paddle.to_tensor(b), stride=2, padding=1)
+    np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=2e-4,
+                               atol=2e-4)
+
+
+@pytest.mark.parametrize("dg,groups,with_mask", [(1, 1, False), (2, 2, True)])
+def test_deform_conv2d_vs_oracle(dg, groups, with_mask):
+    n, cin, H, W = 2, 4, 6, 5
+    cout, kh, kw = 4, 3, 2
+    stride, padding, dilation = (2, 1), (1, 0), (1, 2)
+    Ho = (H + 2 * padding[0] - (dilation[0] * (kh - 1) + 1)) // stride[0] + 1
+    Wo = (W + 2 * padding[1] - (dilation[1] * (kw - 1) + 1)) // stride[1] + 1
+    x = rng.standard_normal((n, cin, H, W)).astype(np.float32)
+    w = rng.standard_normal((cout, cin // groups, kh, kw)).astype(np.float32)
+    off = (2.5 * rng.standard_normal((n, 2 * dg * kh * kw, Ho, Wo))) \
+        .astype(np.float32)
+    mask = rng.uniform(0, 1, (n, dg * kh * kw, Ho, Wo)).astype(np.float32) \
+        if with_mask else None
+    ref = _deform_conv_np(x, off, w, None, stride, padding, dilation, dg,
+                          groups, mask)
+    got = vops.deform_conv2d(
+        paddle.to_tensor(x), paddle.to_tensor(off), paddle.to_tensor(w),
+        stride=stride, padding=padding, dilation=dilation,
+        deformable_groups=dg, groups=groups,
+        mask=None if mask is None else paddle.to_tensor(mask))
+    assert got.shape == [n, cout, Ho, Wo]
+    np.testing.assert_allclose(got.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_deform_conv2d_layer_and_grads():
+    layer = vops.DeformConv2D(4, 6, 3, padding=1, deformable_groups=2)
+    x = paddle.to_tensor(rng.standard_normal((1, 4, 5, 5)).astype(np.float32),
+                         stop_gradient=False)
+    off = paddle.to_tensor(
+        0.5 * rng.standard_normal((1, 2 * 2 * 9, 5, 5)).astype(np.float32),
+        stop_gradient=False)
+    mask = paddle.to_tensor(
+        rng.uniform(0, 1, (1, 2 * 9, 5, 5)).astype(np.float32))
+    out = layer(x, off, mask)
+    assert out.shape == [1, 6, 5, 5]
+    loss = out.sum()
+    loss.backward()
+    for g in (x.grad, off.grad, layer.weight.grad):
+        assert g is not None and np.isfinite(g.numpy()).all()
+    assert float(np.abs(off.grad.numpy()).sum()) > 0  # sampling grads flow
