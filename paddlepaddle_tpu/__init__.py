@@ -348,16 +348,18 @@ from .nn.functional import _inplace as _make_inplace  # noqa: E402
 # geometric_) are NOT generated from their sampling functions — paddle's
 # in-place fills take distribution PARAMS, not the tensor, as arguments.
 _INPLACE_NAMES = [
-    "acos", "addmm", "atan", "bitwise_and", "bitwise_invert",
+    "acos", "acosh", "addmm", "asin", "asinh", "atan", "atanh",
+    "bitwise_and", "bitwise_invert",
     "bitwise_left_shift", "bitwise_not", "bitwise_or", "bitwise_right_shift",
-    "bitwise_xor", "cast", "copysign", "cumprod", "cumsum",
-    "digamma", "equal", "erf", "expm1", "flatten", "floor_divide",
+    "bitwise_xor", "cast", "copysign", "cosh", "cumprod", "cumsum",
+    "digamma", "equal", "erf", "erfinv", "expm1", "flatten", "floor_divide",
     "floor_mod", "frac", "gammainc", "gammaincc", "gammaln", "gcd",
-    "greater_equal", "greater_than", "hypot", "i0", "lcm",
+    "greater_equal", "greater_than", "hypot", "i0", "index_fill", "lcm",
     "ldexp", "less", "less_equal", "less_than", "lgamma", "log", "log10",
-    "log2", "logical_and", "logical_not", "logical_or",
-    "logit", "masked_fill", "masked_scatter", "mod", "multigammaln",
-    "nan_to_num", "polygamma", "renorm", "sinc", "sinh", "square",
+    "log1p", "log2", "logical_and", "logical_not", "logical_or",
+    "logical_xor", "logit", "masked_fill", "masked_scatter", "mod",
+    "multigammaln", "nan_to_num", "not_equal", "polygamma",
+    "put_along_axis", "renorm", "sigmoid", "sinc", "sinh", "square",
     "squeeze", "t", "tan", "transpose", "tril", "triu", "trunc", "unsqueeze",
 ]
 for _n in _INPLACE_NAMES:
@@ -425,3 +427,68 @@ def geometric_(x, probs, name=None):
     g = jax.random.geometric(_prandom.next_key(), probs, tuple(x.shape))
     x._replace_data(g.astype(x._data.dtype))
     return x
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    """In-place fill with U(min, max) samples (reference tensor/random.py
+    uniform_)."""
+    import jax
+
+    from .core import random as _prandom
+
+    key = jax.random.PRNGKey(seed) if seed else _prandom.next_key()
+    vals = jax.random.uniform(key, tuple(x.shape), minval=min, maxval=max)
+    x._replace_data(vals.astype(x._data.dtype))
+    return x
+
+
+def set_(x, source=None, shape=None, stride=None, offset=0, name=None):
+    """Tensor.set_ (reference tensor/creation.py:3263): rebind ``x`` to a
+    strided view over ``source``'s flat storage. XLA buffers cannot alias,
+    so the view is materialized by gather — value semantics match; buffer
+    sharing (meaningless on TPU) is not reproduced."""
+    import jax.numpy as _jnp
+
+    from .core.tensor import Tensor as _T
+
+    if x.is_leaf and not x.stop_gradient:
+        raise ValueError(
+            "(InvalidArgument) Leaf Tensor that doesn't stop gradient "
+            "can't use inplace strategy.")
+    if source is None:
+        x._replace_data(_jnp.zeros((0,), x._data.dtype))
+        return x
+    src = source._data if isinstance(source, _T) else _jnp.asarray(source)
+    flat = src.reshape(-1)
+    if shape is None:
+        shape = list(src.shape)
+    shape = [int(s) for s in shape]
+    if not stride:
+        acc, stride = 1, [0] * len(shape)
+        for i in range(len(shape) - 1, -1, -1):
+            stride[i] = acc
+            acc *= shape[i]
+    # reference offset is in BYTES into the storage (creation.py set_
+    # example: offset=4 skips one float32 element)
+    idx = _np_mod.zeros(tuple(shape), _np_mod.int64) \
+        + offset // src.dtype.itemsize
+    for d, st in enumerate(stride):
+        ar = _np_mod.arange(shape[d], dtype=_np_mod.int64) * int(st)
+        idx += ar.reshape((-1,) + (1,) * (len(shape) - 1 - d))
+    if idx.size and int(idx.max()) >= flat.size:
+        raise ValueError(
+            f"set_: shape {shape} / stride {stride} / offset {offset} "
+            f"reaches element {int(idx.max())} but source storage has only "
+            f"{flat.size} elements")
+    x._replace_data(flat[_jnp.asarray(idx)])
+    return x
+
+
+# attach the reference's tensor-method tail (plain + in-place + fills) now
+# that the top-level namespace is fully assembled
+import sys as _sys_mod  # noqa: E402
+
+from .ops import _patch_tensor_method_tail as _pmtt  # noqa: E402
+
+_pmtt(_sys_mod.modules[__name__])
+del _pmtt

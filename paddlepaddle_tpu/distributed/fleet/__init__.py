@@ -56,8 +56,9 @@ class DistributedStrategy:
         self.find_unused_parameters = False
         self.localsgd = False                 # wrap with fleet.LocalSGD
         self.localsgd_configs = {"k_steps": 1, "begin_step": 1}
-        self.dgc = False                      # absorbed: see localsgd.py doc
-        self.dgc_configs = {}
+        self.dgc = False                      # wrap Momentum with DGC
+        self.dgc_configs = {"rampup_begin_step": 0, "rampup_step": 1,
+                            "sparsity": [0.999]}
 
     def __repr__(self):
         return f"DistributedStrategy(hybrid_configs={self.hybrid_configs})"
@@ -122,10 +123,40 @@ class _Fleet:
             cfg = getattr(strategy, "localsgd_configs", {}) or {}
             return LocalSGD(optimizer, k_steps=int(cfg.get("k_steps", 1)),
                             begin_step=int(cfg.get("begin_step", 1)))
+        if strategy is not None and getattr(strategy, "dgc", False):
+            # reference DGCOptimizer swaps a Momentum inner optimizer for
+            # DGCMomentumOptimizer (meta_optimizers/dgc_optimizer.py:232);
+            # other optimizers pass through uncompressed, as there.
+            from ...optimizer import DGCMomentumOptimizer, Momentum
+
+            if type(optimizer) is Momentum:
+                cfg = getattr(strategy, "dgc_configs", {}) or {}
+                return DGCMomentumOptimizer(
+                    learning_rate=optimizer._lr,
+                    momentum=optimizer._momentum,
+                    rampup_begin_step=int(cfg.get("rampup_begin_step", 0)),
+                    rampup_step=int(cfg.get("rampup_step", 1)),
+                    sparsity=cfg.get("sparsity", [0.999]),
+                    parameters=optimizer._parameter_list,
+                    use_nesterov=optimizer._nesterov,
+                    regularization=optimizer._weight_decay,
+                    grad_clip=optimizer._grad_clip,
+                    num_trainers=_get_world_size_or_none(
+                        optimizer._grad_clip))
         return optimizer
 
     init_server = None
     run_server = None
+
+
+def _get_world_size_or_none(grad_clip):
+    """DGC needs num_trainers only when grad_clip is set (it rescales the
+    local clip norm); default to the collective world size then."""
+    if grad_clip is None:
+        return None
+    from .. import get_world_size
+
+    return max(int(get_world_size()), 1)
 
 
 fleet = _Fleet()

@@ -5,3 +5,4 @@ dygraph wrappers scripts import from here plus LocalSGD."""
 
 from . import dygraph_optimizer  # noqa: F401
 from ..localsgd import LocalSGD  # noqa: F401
+from ....optimizer import DGCMomentumOptimizer  # noqa: F401

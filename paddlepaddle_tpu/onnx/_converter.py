@@ -353,7 +353,12 @@ def _convert_eqn(ctx, eqn):
                  [idx])
         from jax.lax import GatherScatterMode as GSM
 
-        if pa["mode"] in (GSM.CLIP, GSM.FILL_OR_DROP):
+        if pa["mode"] == GSM.FILL_OR_DROP:
+            raise NotImplementedError(
+                "onnx export: gather in fill mode (jnp.take(mode='fill')) — "
+                "ONNX Gather has no fill-value semantics; trace with "
+                "mode='clip' or guarantee in-bounds indices")
+        if pa["mode"] == GSM.CLIP:
             lo = ctx.add_const(np.asarray(0, np.dtype(aval_in[1].dtype)))
             hi = ctx.add_const(
                 np.asarray(op_shape[0] - 1, np.dtype(aval_in[1].dtype)))
@@ -362,8 +367,8 @@ def _convert_eqn(ctx, eqn):
             idx = c
         g = ctx.fresh("gat") if not idx_shape[:-1] else outs[0]
         ctx.emit("Gather", [ins[0], idx], [g], axis=0)
-        if not idx_shape[:-1]:                       # scalar take: re-shape
-            ctx.emit("Reshape", [g, _i64(ctx, aval_out.shape or (1,))], outs)
+        if not idx_shape[:-1]:          # scalar take: back to the rank-0
+            ctx.emit("Reshape", [g, _i64(ctx, aval_out.shape)], outs)
         return
     if prim == "sort":
         raise NotImplementedError("onnx export: lax.sort (use TopK models)")

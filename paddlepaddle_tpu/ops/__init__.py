@@ -110,3 +110,63 @@ def _isscalarlike(v):
 
 
 _patch_tensor_methods()
+
+
+# ---- tensor-method tail (reference tensor_method_func closure) -------------
+#
+# The reference monkey-patches ~388 functions onto Tensor
+# (python/paddle/tensor/__init__.py tensor_method_func). The module sweep
+# above catches everything living in ops/*; the rest — functions assembled
+# at the package top level, including the generated `*_` in-place variants
+# and the random fills — are attached here from the finished namespace at
+# the end of package __init__.
+
+# plain top-level functions to attach verbatim (self is the first arg, or —
+# faithfully to the reference — the raw function even where a method
+# receiver makes little sense, e.g. create_parameter)
+_METHOD_TAIL = (
+    "add_n", "atleast_1d", "atleast_2d", "atleast_3d", "bitwise_invert",
+    "block_diag", "broadcast_shape", "broadcast_tensors", "cholesky_inverse",
+    "cond", "create_parameter", "create_tensor", "cumulative_trapezoid",
+    "diag", "diagflat", "diagonal_scatter", "frexp", "gammainc", "gammaincc",
+    "histogram_bin_edges", "histogramdd", "index_fill", "is_complex",
+    "is_floating_point", "is_integer", "is_tensor", "isin", "istft", "less",
+    "lu_unpack", "multi_dot", "multigammaln", "multinomial", "ormqr",
+    "pca_lowrank", "polar", "polygamma", "reduce_as", "reverse", "scatter_nd",
+    "select_scatter", "stft", "svd_lowrank", "top_p_sampling", "tril", "triu",
+    "unstack",
+)
+
+# in-place tensor methods taken from the top-level namespace: the generated
+# `<name>_` rebind wrappers plus the hand-written random fills and set_
+_INPLACE_METHOD_TAIL = (
+    "acos_", "acosh_", "addmm_", "asin_", "asinh_", "atan_", "atanh_",
+    "bernoulli_", "bitwise_and_", "bitwise_invert_", "bitwise_left_shift_",
+    "bitwise_not_", "bitwise_or_", "bitwise_right_shift_", "bitwise_xor_",
+    "cast_", "cauchy_", "copysign_", "cosh_", "cumprod_", "cumsum_",
+    "digamma_", "equal_", "erfinv_", "flatten_", "floor_divide_",
+    "floor_mod_", "frac_", "gammainc_", "gammaincc_", "gammaln_", "gcd_",
+    "geometric_", "greater_equal_", "greater_than_", "hypot_", "i0_",
+    "index_fill_", "lcm_", "ldexp_", "less_", "less_equal_", "less_than_",
+    "lgamma_", "log10_", "log1p_", "log2_", "log_", "log_normal_",
+    "logical_and_", "logical_not_", "logical_or_", "logical_xor_",
+    "logit_", "masked_fill_", "masked_scatter_", "mod_", "multigammaln_",
+    "nan_to_num_", "normal_", "not_equal_", "polygamma_",
+    "put_along_axis_", "renorm_", "set_", "sigmoid_", "sinc_", "sinh_",
+    "square_", "squeeze_", "t_", "tan_", "transpose_", "tril_", "triu_",
+    "trunc_", "uniform_", "unsqueeze_",
+)
+
+
+def _patch_tensor_method_tail(ns):
+    """Attach the remaining reference tensor methods from the assembled
+    top-level namespace ``ns`` (called at the end of package __init__)."""
+    for name in _METHOD_TAIL + _INPLACE_METHOD_TAIL:
+        fn = getattr(ns, name, None)
+        if fn is not None and not hasattr(Tensor, name):
+            setattr(Tensor, name, _make_method(fn))
+    missing = [n for n in _METHOD_TAIL + _INPLACE_METHOD_TAIL
+               if not hasattr(Tensor, n)]
+    if missing:
+        raise AssertionError(
+            f"tensor-method tail failed to attach: {missing}")

@@ -10,6 +10,7 @@ from .optimizers import (  # noqa: F401
     Adam,
     Adamax,
     AdamW,
+    DGCMomentumOptimizer,
     Lamb,
     Lars,
     LarsMomentumOptimizer,

@@ -1,0 +1,121 @@
+"""DGCMomentumOptimizer (reference fleet/meta_optimizers/dgc_optimizer.py +
+phi/kernels/gpu/dgc_kernel.cu): momentum-before-rampup, top-k error-feedback
+compression after, small-tensor exemption, and the fleet strategy wiring."""
+
+import numpy as np
+import pytest
+
+import paddlepaddle_tpu as paddle
+from paddlepaddle_tpu.optimizer import Adam, DGCMomentumOptimizer, Momentum
+
+rng = np.random.default_rng(11)
+BIG = 20000    # >= the reference's 16384 compression floor
+
+
+def _pair(shape):
+    w = rng.standard_normal(shape).astype(np.float32)
+    a = paddle.to_tensor(w.copy(), stop_gradient=False)
+    b = paddle.to_tensor(w.copy(), stop_gradient=False)
+    return a, b
+
+
+def _step(opt, p, g):
+    p._grad = paddle.to_tensor(g)
+    opt.step()
+    opt.clear_grad()
+
+
+def test_pre_rampup_matches_momentum():
+    a, b = _pair((BIG,))
+    dgc = DGCMomentumOptimizer(learning_rate=0.1, momentum=0.9,
+                               rampup_begin_step=3, parameters=[a])
+    mom = Momentum(learning_rate=0.1, momentum=0.9, parameters=[b])
+    for _ in range(3):
+        g = rng.standard_normal((BIG,)).astype(np.float32)
+        _step(dgc, a, g)
+        _step(mom, b, g)
+    np.testing.assert_allclose(a.numpy(), b.numpy(), rtol=1e-6, atol=1e-7)
+
+
+def test_small_tensor_never_compressed():
+    a, b = _pair((64,))
+    dgc = DGCMomentumOptimizer(learning_rate=0.1, momentum=0.9,
+                               rampup_begin_step=0, parameters=[a])
+    mom = Momentum(learning_rate=0.1, momentum=0.9, parameters=[b])
+    for _ in range(4):
+        g = rng.standard_normal((64,)).astype(np.float32)
+        _step(dgc, a, g)
+        _step(mom, b, g)
+    np.testing.assert_allclose(a.numpy(), b.numpy(), rtol=1e-6, atol=1e-7)
+
+
+def test_compression_sparsity_and_error_feedback():
+    a, _ = _pair((BIG,))
+    dgc = DGCMomentumOptimizer(learning_rate=0.1, momentum=0.9,
+                               rampup_begin_step=0, sparsity=[0.999],
+                               parameters=[a])
+    p0 = a.numpy().copy()
+    g = rng.standard_normal((BIG,)).astype(np.float32)
+    _step(dgc, a, g)
+    changed = (a.numpy() != p0).sum()
+    # k truncates in float arithmetic exactly as the reference kernel does
+    # (ratio = 1 - 0.999f -> 0.00099998..., k = int(numel * ratio) = 19)
+    k = int(np.float32(BIG) * (np.float32(1.0) - np.float32(0.999)))
+    assert max(k, 1) <= changed <= 3 * (k + 1), changed   # ~0.1% touched
+
+    slots = dgc._accumulators[id(a)]
+    u, v = np.asarray(slots["u"]), np.asarray(slots["v"])
+    np.testing.assert_allclose(u, 0.9 * 0 + g, rtol=1e-6)   # u = m*0 + g
+    # error feedback: v holds exactly the unselected residual of (v0 + u)
+    sel = a.numpy() != p0
+    assert (v[sel] == 0).all()
+    np.testing.assert_allclose(v[~sel], g[~sel], rtol=1e-6)
+    # the update applied -lr * selected v
+    np.testing.assert_allclose(a.numpy()[sel], p0[sel] - 0.1 * g[sel],
+                               rtol=1e-5)
+    # selected entries are the largest magnitudes
+    assert np.abs(g[sel]).min() >= np.abs(g[~sel]).max() - 1e-6
+
+
+def test_convergence_with_compression():
+    target = rng.standard_normal((BIG,)).astype(np.float32)
+    a = paddle.to_tensor(np.zeros((BIG,), np.float32), stop_gradient=False)
+    dgc = DGCMomentumOptimizer(learning_rate=0.01, momentum=0.9,
+                               rampup_begin_step=0, sparsity=[0.9],
+                               parameters=[a])
+    first = None
+    for i in range(60):
+        err = a.numpy() - target
+        loss = float((err ** 2).mean())
+        first = loss if first is None else first
+        _step(dgc, a, 2 * err)
+    assert loss < 0.25 * first, (first, loss)
+
+
+def test_grad_clip_contract_and_fleet_wiring():
+    from paddlepaddle_tpu.nn import ClipGradByGlobalNorm, ClipGradByNorm
+
+    with pytest.raises(TypeError, match="ClipGradByNorm"):
+        DGCMomentumOptimizer(learning_rate=0.1, momentum=0.9,
+                             grad_clip=ClipGradByGlobalNorm(1.0),
+                             parameters=[paddle.to_tensor([1.0])])
+    with pytest.raises(ValueError, match="num_trainers"):
+        DGCMomentumOptimizer(learning_rate=0.1, momentum=0.9,
+                             grad_clip=ClipGradByNorm(1.0),
+                             parameters=[paddle.to_tensor([1.0])])
+
+    from paddlepaddle_tpu.distributed import fleet
+
+    strat = fleet.DistributedStrategy()
+    strat.dgc = True
+    strat.dgc_configs = {"rampup_begin_step": 2, "rampup_step": 4,
+                         "sparsity": [0.99, 0.999]}
+    fleet.init(is_collective=True, strategy=strat)
+    w = paddle.to_tensor(np.ones((4,), np.float32), stop_gradient=False)
+    inner = Momentum(learning_rate=0.1, momentum=0.8, parameters=[w])
+    wrapped = fleet.distributed_optimizer(inner, strat)
+    assert isinstance(wrapped, DGCMomentumOptimizer)
+    assert wrapped._momentum == 0.8 and wrapped._rampup_begin == 2.0
+    # non-Momentum passes through, as in the reference DGCOptimizer
+    adam = Adam(parameters=[w])
+    assert fleet.distributed_optimizer(adam, strat) is adam
