@@ -353,11 +353,11 @@ def _convert_eqn(ctx, eqn):
                  [idx])
         from jax.lax import GatherScatterMode as GSM
 
-        if pa["mode"] == GSM.FILL_OR_DROP:
-            raise NotImplementedError(
-                "onnx export: gather in fill mode (jnp.take(mode='fill')) — "
-                "ONNX Gather has no fill-value semantics; trace with "
-                "mode='clip' or guarantee in-bounds indices")
+        # CLIP keeps its clamp; FILL_OR_DROP (jnp.take's default, what
+        # nn.Embedding traces to) exports as a plain Gather — ONNX has no
+        # fill-value semantics, so out-of-range ids become a consumer-side
+        # error instead of a silent fill, exactly as paddle2onnx's
+        # lookup_table -> Gather mapping behaves.
         if pa["mode"] == GSM.CLIP:
             lo = ctx.add_const(np.asarray(0, np.dtype(aval_in[1].dtype)))
             hi = ctx.add_const(
