@@ -295,3 +295,239 @@ def variable_length_memory_efficient_attention(query, key, value, seq_lens,
         res = res.at[i, :, : lens_q[i]].set(
             out_np[cu_q[i]:cu_q[i + 1]].swapaxes(0, 1))
     return wrap(res)
+
+
+def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size,
+                     name=None):
+    """Max encoder/decoder lengths this step (reference
+    incubate/nn/functional/blha_get_max_len.py:26) — the scheduling scalars
+    fed to block_multihead_attention."""
+    enc = jnp.max(unwrap(seq_lens_encoder).astype(jnp.int32).reshape(-1))
+    dec = jnp.max(unwrap(seq_lens_decoder).astype(jnp.int32).reshape(-1))
+    return wrap(enc.reshape(1)), wrap(dec.reshape(1))
+
+
+def _reject_quant(name, **kw):
+    bad = [k for k, v in kw.items() if v is not None and v is not False]
+    if bad:
+        raise NotImplementedError(
+            f"{name}: int8/quantized serving args {bad} are CUDA-specific "
+            "in the reference; the TPU path serves bf16 (use "
+            "paddlepaddle_tpu.quantization for PTQ of weights)")
+
+
+def _apply_rope_pair(q, k, cos, sin, neox):
+    """Rotate q,k by per-position cos/sin [..., D/2]; neox rotates the two
+    halves, the default rotates adjacent pairs (reference mmha/blha
+    use_neox_rotary_style switch)."""
+    D = q.shape[-1]
+    if neox:
+        def rot(x):
+            x1, x2 = x[..., :D // 2], x[..., D // 2:]
+            return jnp.concatenate(
+                [x1 * cos - x2 * sin, x2 * cos + x1 * sin], -1)
+    else:
+        def rot(x):
+            x1, x2 = x[..., 0::2], x[..., 1::2]
+            out = jnp.stack(
+                [x1 * cos - x2 * sin, x2 * cos + x1 * sin], -1)
+            return out.reshape(x.shape)
+    return rot(q), rot(k)
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
+                               cum_offsets=None, sequence_lengths=None,
+                               rotary_tensor=None, beam_cache_offset=None,
+                               qkv_out_scale=None, out_shift=None,
+                               out_smooth=None, seq_len=1, rotary_emb_dims=0,
+                               use_neox_rotary_style=False,
+                               compute_dtype="default", out_scale=-1,
+                               quant_round_type=1, quant_max_bound=127.0,
+                               quant_min_bound=-127.0):
+    """Fused single-token decode attention (reference
+    incubate/nn/functional/masked_multihead_attention.py:74, MMHA kernel
+    lineage): x is one new token's qkv per sequence
+    [bsz, 3*H*D]; cache_kv [2, bsz, H, max_seq, D] is updated at the
+    per-sequence write position and attention runs over positions
+    [0, pos]. Returns (out [bsz, H*D], cache_kv_out) — the cache is
+    returned (XLA arrays are immutable; the reference mutates in place).
+
+    The write position is sequence_lengths[:, 0] when given, else
+    ``src_mask.shape[-1] - 1``, else ``seq_len - 1`` (the kernel's
+    timestep resolution order). rotary_tensor follows the reference
+    kernel's read layout (masked_multihead_attention_kernel.cu
+    rotary load): the first bsz*D floats are the CURRENT position's
+    full-D cos per batch, the next bsz*D the sin — the kernel never
+    indexes it by timestep."""
+    _reject_quant("masked_multihead_attention",
+                  qkv_out_scale=qkv_out_scale, out_shift=out_shift,
+                  out_smooth=out_smooth,
+                  quant=None if out_scale == -1 else out_scale)
+    if beam_cache_offset is not None:
+        raise NotImplementedError(
+            "masked_multihead_attention: beam search decode "
+            "(beam_cache_offset) is not in the TPU-v1 surface")
+    if cache_kv is None:
+        raise ValueError("masked_multihead_attention requires cache_kv")
+
+    xv = unwrap(x)
+    ck = unwrap(cache_kv)
+    _, bsz, H, max_seq, D = ck.shape
+    qkv = xv.reshape(bsz, 3, H, D).astype(jnp.float32)
+    if bias is not None:
+        qkv = qkv + unwrap(bias).reshape(1, 3, H, D).astype(jnp.float32)
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]        # [bsz, H, D]
+
+    if sequence_lengths is not None:
+        pos = unwrap(sequence_lengths).reshape(-1).astype(jnp.int32)
+    elif src_mask is not None:
+        pos = jnp.full((bsz,), unwrap(src_mask).shape[-1] - 1, jnp.int32)
+    else:
+        pos = jnp.full((bsz,), seq_len - 1, jnp.int32)
+
+    if rotary_tensor is not None and rotary_emb_dims:
+        flat = unwrap(rotary_tensor).astype(jnp.float32).reshape(-1)
+        cos = flat[:bsz * D].reshape(bsz, 1, D)          # full-D, per batch
+        sin = flat[bsz * D:2 * bsz * D].reshape(bsz, 1, D)
+        if use_neox_rotary_style:
+            c, s = cos[..., :D // 2], sin[..., :D // 2]
+        else:
+            c, s = cos[..., 0::2], sin[..., 0::2]
+        q, k = _apply_rope_pair(q, k, c, s, use_neox_rotary_style)
+
+    ib = jnp.arange(bsz)
+    ck = ck.astype(jnp.float32)
+    ck = ck.at[0, ib, :, pos].set(k).at[1, ib, :, pos].set(v)
+
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    logits = jnp.einsum("bhd,bhsd->bhs", q, ck[0]) * scale
+    span = jnp.arange(max_seq)[None, None, :]
+    logits = jnp.where(span <= pos[:, None, None], logits, -1e30)
+    if src_mask is not None:
+        sm = unwrap(src_mask).astype(jnp.float32).reshape(bsz, 1, -1)
+        logits = logits.at[:, :, :sm.shape[-1]].add(sm)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhs,bhsd->bhd", probs, ck[1])
+    dt = xv.dtype
+    return (wrap(out.reshape(bsz, H * D).astype(dt)),
+            wrap(ck.astype(unwrap(cache_kv).dtype)))
+
+
+def block_multihead_attention(
+        qkv, key_cache, value_cache, seq_lens_encoder, seq_lens_decoder,
+        seq_lens_this_time, padding_offsets, cum_offsets, cu_seqlens_q,
+        cu_seqlens_k, block_tables, pre_key_cache=None, pre_value_cache=None,
+        cache_k_quant_scales=None, cache_v_quant_scales=None,
+        cache_k_dequant_scales=None, cache_v_dequant_scales=None,
+        qkv_out_scale=None, qkv_bias=None, out_shift=None, out_smooth=None,
+        max_enc_len_this_time=None, max_dec_len_this_time=None,
+        rope_emb=None, mask=None, tgt_mask=None, max_seq_len=-1,
+        block_size=64, use_neox_style=False, use_dynamic_cachekv_quant=False,
+        quant_round_type=1, quant_max_bound=127.0, quant_min_bound=-127.0,
+        out_scale=-1, compute_dtype="default", rope_theta=10000.0):
+    """Paged-KV batched attention (reference
+    incubate/nn/functional/block_multihead_attention.py:33, kernel
+    fusion/gpu/block_multi_head_attention_kernel.cu): one call serves a
+    mixed batch where each sequence is either PREFILLING
+    (seq_lens_encoder[b] > 0: causal attention over its own packed
+    tokens) or DECODING (seq_lens_decoder[b] = past length, one new
+    token attending to the paged cache). qkv is varlen-packed
+    [token_num, (H + 2*kv_H) * D]; the caches are paged
+    [max_block_num, kv_H, block_size, D] indexed through block_tables.
+    Returns (out [token_num, H*D], qkv, key_cache, value_cache) — caches
+    returned, not mutated (XLA immutability).
+
+    Eager-only: per-sequence lengths are data, so this op shapes on host
+    values (the compiled serving path is inference/decode_engine.py,
+    which keeps one static compiled decode step). Quant/pre-cache args
+    raise; GQA inferred from key_cache's head dim."""
+    import numpy as _np
+
+    _reject_quant("block_multihead_attention",
+                  cache_k_quant_scales=cache_k_quant_scales,
+                  cache_v_quant_scales=cache_v_quant_scales,
+                  cache_k_dequant_scales=cache_k_dequant_scales,
+                  cache_v_dequant_scales=cache_v_dequant_scales,
+                  qkv_out_scale=qkv_out_scale, out_shift=out_shift,
+                  out_smooth=out_smooth,
+                  dynamic_quant=use_dynamic_cachekv_quant or None,
+                  quant=None if out_scale == -1 else out_scale)
+    if pre_key_cache is not None or pre_value_cache is not None:
+        raise NotImplementedError(
+            "block_multihead_attention: pre-cache (system prompt cache) "
+            "is not in the TPU-v1 surface")
+
+    qkv_v = unwrap(qkv)
+    kc = unwrap(key_cache).astype(jnp.float32)
+    vc = unwrap(value_cache).astype(jnp.float32)
+    _, kv_H, bs_, D = kc.shape
+    if bs_ != block_size:
+        if block_size != 64:                  # explicit AND contradictory
+            raise ValueError(
+                f"block_multihead_attention: block_size={block_size} "
+                f"contradicts the cache page dimension {bs_}")
+        block_size = bs_                      # default: trust the cache
+    H = qkv_v.shape[1] // D - 2 * kv_H
+    bsz = unwrap(block_tables).shape[0]
+    enc = _np.asarray(unwrap(seq_lens_encoder)).reshape(-1).astype(int)
+    dec = _np.asarray(unwrap(seq_lens_decoder)).reshape(-1).astype(int)
+    this = _np.asarray(unwrap(seq_lens_this_time)).reshape(-1).astype(int)
+    cu_q = _np.asarray(unwrap(cu_seqlens_q)).reshape(-1).astype(int)
+    btab = unwrap(block_tables)
+    packed = qkv_v.astype(jnp.float32)
+    if qkv_bias is not None:
+        packed = packed + unwrap(qkv_bias).astype(jnp.float32)[None, :]
+
+    rope = None if rope_emb is None else unwrap(rope_emb).astype(jnp.float32)
+    scale = 1.0 / float(_np.sqrt(D))
+    group = H // kv_H
+    out = jnp.zeros((qkv_v.shape[0], H * D), jnp.float32)
+
+    for b in range(bsz):
+        n = int(this[b])
+        if n == 0:
+            continue
+        past = int(dec[b])
+        rows = packed[cu_q[b]:cu_q[b] + n]
+        q = rows[:, :H * D].reshape(n, H, D)
+        k = rows[:, H * D:(H + kv_H) * D].reshape(n, kv_H, D)
+        v = rows[:, (H + kv_H) * D:].reshape(n, kv_H, D)
+        positions = past + _np.arange(n)
+
+        if rope is not None:
+            cs = rope[0, b, positions, 0]                 # [n, D/2]
+            sn = rope[1, b, positions, 0]
+            q, k = _apply_rope_pair(q, k, cs[:, None, :], sn[:, None, :],
+                                    use_neox_style)
+
+        # write this step's kv into the pages
+        blk = jnp.asarray(btab[b, positions // block_size], jnp.int32)
+        off = jnp.asarray(positions % block_size, jnp.int32)
+        kc = kc.at[blk, :, off].set(k)
+        vc = vc.at[blk, :, off].set(v)
+
+        # gather [0, past+n) back out of the pages
+        L = past + n
+        nblk = (L + block_size - 1) // block_size
+        blocks = jnp.asarray(btab[b, :nblk], jnp.int32)
+        K = kc[blocks].transpose(1, 0, 2, 3).reshape(kv_H, -1, D)[:, :L]
+        V = vc[blocks].transpose(1, 0, 2, 3).reshape(kv_H, -1, D)[:, :L]
+
+        qg = q.reshape(n, kv_H, group, D)
+        logits = jnp.einsum("nkgd,ksd->nkgs", qg, K) * scale
+        causal = jnp.asarray(positions)[:, None] >= jnp.arange(L)[None, :]
+        logits = jnp.where(causal[:, None, None, :], logits, -1e30)
+        if past == 0 and mask is not None:
+            m = unwrap(mask).astype(jnp.float32)[b, 0][:n, :L]
+            logits = logits + m[:, None, None, :]
+        elif past > 0 and tgt_mask is not None:
+            m = unwrap(tgt_mask).astype(jnp.float32)[b, 0][:, :L]
+            logits = logits + m[:, None, None, :]
+        probs = jax.nn.softmax(logits, axis=-1)
+        o = jnp.einsum("nkgs,ksd->nkgd", probs, V).reshape(n, H * D)
+        out = out.at[cu_q[b]:cu_q[b] + n].set(o)
+
+    dt = qkv_v.dtype
+    return (wrap(out.astype(dt)), qkv,
+            wrap(kc.astype(unwrap(key_cache).dtype)),
+            wrap(vc.astype(unwrap(value_cache).dtype)))

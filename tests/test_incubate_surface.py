@@ -165,3 +165,188 @@ def test_asp_prune_and_sparsity_guarantee():
     cw = conv.weight.numpy()
     assert abs(asp.calculate_density(cw) - 0.5) < 0.03, asp.calculate_density(cw)
     assert asp.check_mask_1d(cw.reshape(8, -1))
+
+
+# ---- round-4 serving-attention closure (mmha / blha) -----------------------
+
+
+def _np_sdpa(q, K, V, add_mask=None):
+    """[H,D] query vs [H,L,D] keys -> [H,D], fp32 numpy oracle."""
+    import numpy as np
+
+    s = (q[:, None, :] * K).sum(-1) / np.sqrt(q.shape[-1])   # [H, L]
+    if add_mask is not None:
+        s = s + add_mask
+    s = s - s.max(-1, keepdims=True)
+    p = np.exp(s)
+    p /= p.sum(-1, keepdims=True)
+    return (p[:, :, None] * V).sum(1)
+
+
+def test_blha_get_max_len():
+    import numpy as np
+
+    import paddlepaddle_tpu as paddle
+
+    f = paddle.incubate.nn.functional
+    enc = paddle.to_tensor(np.array([3, 0, 7], np.int32))
+    dec = paddle.to_tensor(np.array([5, 2, 0], np.int32))
+    me, md = f.blha_get_max_len(enc, dec, paddle.to_tensor(np.ones(3)))
+    assert int(me.numpy()[0]) == 7 and int(md.numpy()[0]) == 5
+
+
+def test_masked_multihead_attention_oracle():
+    import numpy as np
+
+    import paddlepaddle_tpu as paddle
+
+    f = paddle.incubate.nn.functional
+    rng = np.random.default_rng(3)
+    bsz, H, D, max_seq = 2, 4, 8, 16
+    x = rng.standard_normal((bsz, 3 * H * D)).astype(np.float32)
+    bias = rng.standard_normal((3, H, D)).astype(np.float32)
+    cache = rng.standard_normal((2, bsz, H, max_seq, D)).astype(np.float32)
+    lens = np.array([[5], [9]], np.int32)      # write positions
+    src_mask = rng.standard_normal((bsz, 1, 1, 10)).astype(np.float32)
+
+    out, cache_out = f.masked_multihead_attention(
+        paddle.to_tensor(x), paddle.to_tensor(cache.copy()),
+        bias=paddle.to_tensor(bias), src_mask=paddle.to_tensor(src_mask),
+        sequence_lengths=paddle.to_tensor(lens))
+    out, cache_out = out.numpy(), cache_out.numpy()
+
+    for b in range(bsz):
+        L = int(lens[b, 0])
+        qkv = x[b].reshape(3, H, D) + bias
+        ref_c = cache.copy()
+        ref_c[0, b, :, L] = qkv[1]
+        ref_c[1, b, :, L] = qkv[2]
+        np.testing.assert_allclose(cache_out[:, b], ref_c[:, b], rtol=1e-5)
+        K = ref_c[0, b, :, :L + 1]
+        V = ref_c[1, b, :, :L + 1]
+        ref = _np_sdpa(qkv[0], K, V, add_mask=src_mask[b, 0, 0, :L + 1])
+        np.testing.assert_allclose(out[b].reshape(H, D), ref, rtol=2e-4,
+                                   atol=2e-5)
+
+
+def test_block_multihead_attention_mixed_batch_gqa():
+    """One prefill sequence + one decode sequence through the paged cache,
+    GQA (kv_H=2, H=4), checked against a dense numpy oracle per sequence."""
+    import numpy as np
+
+    import paddlepaddle_tpu as paddle
+
+    f = paddle.incubate.nn.functional
+    rng = np.random.default_rng(5)
+    H, kv_H, D, bs = 4, 2, 8, 4
+    max_blocks, blocks_per_seq = 8, 3
+
+    # seq 0: prefill 5 tokens; seq 1: decode with past=3, 1 new token
+    enc = np.array([[5], [0]], np.int32)
+    dec = np.array([[0], [3]], np.int32)
+    this = np.array([[5], [1]], np.int32)
+    cu_q = np.array([0, 5, 6], np.int32)
+    btab = np.array([[0, 1, -1], [4, 5, -1]], np.int32)
+
+    kc = rng.standard_normal((max_blocks, kv_H, bs, D)).astype(np.float32)
+    vc = rng.standard_normal((max_blocks, kv_H, bs, D)).astype(np.float32)
+    tok = 6
+    qkv = rng.standard_normal((tok, (H + 2 * kv_H) * D)).astype(np.float32)
+
+    out, _, kc_out, vc_out = f.block_multihead_attention(
+        paddle.to_tensor(qkv), paddle.to_tensor(kc.copy()),
+        paddle.to_tensor(vc.copy()), paddle.to_tensor(enc),
+        paddle.to_tensor(dec), paddle.to_tensor(this),
+        paddle.to_tensor(np.zeros(tok, np.int32)),
+        paddle.to_tensor(np.zeros(2, np.int32)),
+        paddle.to_tensor(cu_q), paddle.to_tensor(cu_q),
+        paddle.to_tensor(btab), block_size=bs)
+    out = out.numpy()
+    kc_out, vc_out = kc_out.numpy(), vc_out.numpy()
+
+    group = H // kv_H
+    for b, (n, past) in enumerate([(5, 0), (1, 3)]):
+        rows = qkv[cu_q[b]:cu_q[b] + n]
+        q = rows[:, :H * D].reshape(n, H, D)
+        k = rows[:, H * D:(H + kv_H) * D].reshape(n, kv_H, D)
+        v = rows[:, (H + kv_H) * D:].reshape(n, kv_H, D)
+        ref_kc, ref_vc = kc.copy(), vc.copy()
+        for i, p in enumerate(range(past, past + n)):
+            ref_kc[btab[b, p // bs], :, p % bs] = k[i]
+            ref_vc[btab[b, p // bs], :, p % bs] = v[i]
+        L = past + n
+        K = np.concatenate([ref_kc[btab[b, j]] for j in range((L + bs - 1) // bs)],
+                           axis=1)[:, :L]          # [kv_H, L, D]
+        V = np.concatenate([ref_vc[btab[b, j]] for j in range((L + bs - 1) // bs)],
+                           axis=1)[:, :L]
+        for i in range(n):
+            pos = past + i
+            qi = q[i].reshape(kv_H, group, D)
+            ref = np.zeros((kv_H, group, D), np.float32)
+            for kh in range(kv_H):
+                # causality = truncating keys to [0, pos]
+                ref[kh] = _np_sdpa(qi[kh], np.repeat(K[kh][None, :pos + 1], group, 0),
+                                   np.repeat(V[kh][None, :pos + 1], group, 0))
+            np.testing.assert_allclose(
+                out[cu_q[b] + i].reshape(H, D), ref.reshape(H, D),
+                rtol=2e-4, atol=2e-5, err_msg=f"seq {b} tok {i}")
+        # the written pages match
+        for j in range((L + bs - 1) // bs):
+            np.testing.assert_allclose(kc_out[btab[b, j]], ref_kc[btab[b, j]],
+                                       rtol=1e-6)
+
+
+def test_mmha_rotary_matches_manual_rotation():
+    """rotary_tensor layout per the reference kernel's read pattern:
+    flat [cos(bsz*D) | sin(bsz*D)], current position only, full D."""
+    import numpy as np
+
+    import paddlepaddle_tpu as paddle
+
+    f = paddle.incubate.nn.functional
+    rng = np.random.default_rng(9)
+    bsz, H, D, max_seq = 1, 1, 8, 4
+    x = rng.standard_normal((bsz, 3 * H * D)).astype(np.float32)
+    theta = rng.uniform(0, np.pi, D // 2).astype(np.float32)
+    cos = np.repeat(np.cos(theta), 2)[None, :]           # [bsz, D] paired
+    sin = np.repeat(np.sin(theta), 2)[None, :]
+    rt = np.concatenate([cos.ravel(), sin.ravel()])
+    cache = np.zeros((2, bsz, H, max_seq, D), np.float32)
+
+    _, cache_out = f.masked_multihead_attention(
+        paddle.to_tensor(x), paddle.to_tensor(cache),
+        sequence_lengths=paddle.to_tensor(np.zeros((1, 1), np.int32)),
+        rotary_tensor=paddle.to_tensor(rt), rotary_emb_dims=1)
+    k = x.reshape(3, H, D)[1][0]
+    ref = np.empty(D, np.float32)
+    c, s = cos[0, 0::2], sin[0, 0::2]
+    ref[0::2] = k[0::2] * c - k[1::2] * s
+    ref[1::2] = k[1::2] * c + k[0::2] * s
+    np.testing.assert_allclose(cache_out.numpy()[0, 0, 0, 0], ref,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_serving_attention_quant_rejected():
+    import numpy as np
+
+    import paddlepaddle_tpu as paddle
+
+    f = paddle.incubate.nn.functional
+    with pytest.raises(NotImplementedError, match="quant"):
+        f.masked_multihead_attention(
+            paddle.to_tensor(np.zeros((1, 3 * 2 * 4), np.float32)),
+            paddle.to_tensor(np.zeros((2, 1, 2, 8, 4), np.float32)),
+            out_scale=0.5)
+    zeros = lambda *s: paddle.to_tensor(np.zeros(s, np.float32))
+    i32 = lambda *s: paddle.to_tensor(np.zeros(s, np.int32))
+    with pytest.raises(NotImplementedError, match="quant"):
+        f.block_multihead_attention(
+            zeros(1, 3 * 2 * 4), zeros(2, 2, 4, 4), zeros(2, 2, 4, 4),
+            i32(1, 1), i32(1, 1), i32(1, 1), i32(1), i32(1),
+            i32(2), i32(2), i32(1, 2), block_size=4,
+            cache_k_quant_scales=zeros(2))
+    with pytest.raises(ValueError, match="block_size"):
+        f.block_multihead_attention(
+            zeros(1, 3 * 2 * 4), zeros(2, 2, 4, 4), zeros(2, 2, 4, 4),
+            i32(1, 1), i32(1, 1), i32(1, 1), i32(1), i32(1),
+            i32(2), i32(2), i32(1, 2), block_size=128)
