@@ -165,6 +165,7 @@ from . import fft  # noqa: E402,F401
 from . import framework  # noqa: E402,F401
 from . import geometric  # noqa: E402,F401
 from . import hapi  # noqa: E402,F401
+from . import hub  # noqa: E402,F401
 from . import incubate  # noqa: E402,F401
 from . import inference  # noqa: E402,F401
 from . import io  # noqa: E402,F401
@@ -182,6 +183,7 @@ from . import signal  # noqa: E402,F401
 from . import callbacks  # noqa: E402,F401
 from . import sparse  # noqa: E402,F401
 from . import static  # noqa: E402,F401
+from . import sysconfig  # noqa: E402,F401
 from . import text  # noqa: E402,F401
 from . import utils  # noqa: E402,F401
 from . import vision  # noqa: E402,F401

@@ -1,0 +1,71 @@
+"""paddle.hub — model hub loader (reference: python/paddle/hapi/hub.py).
+
+The reference clones github/gitee repos and imports their ``hubconf.py``.
+This environment has zero egress, so ``source='local'`` is fully
+functional (the reference supports it identically) and the remote
+sources raise with that alternative spelled out.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_HUB_CONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, _HUB_CONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no {_HUB_CONF} in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("paddle_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.remove(repo_dir)
+    return mod
+
+
+def _resolve(repo_dir, source):
+    if source not in ("github", "gitee", "local"):
+        raise ValueError(
+            f"Unknown source: {source}. Valid sources are 'github', "
+            "'gitee' and 'local'.")
+    if source != "local":
+        raise RuntimeError(
+            f"paddle.hub: '{source}' needs network access, which this "
+            "environment does not have (zero egress); clone the repo "
+            "yourself and use source='local' with its path")
+    return _load_hubconf(os.path.expanduser(repo_dir))
+
+
+def list(repo_dir, source="github", force_reload=False, **kw):  # noqa: A001
+    """Entrypoint names exposed by the repo's hubconf (reference
+    hapi/hub.py list)."""
+    conf = _resolve(repo_dir, source)
+    return [k for k, v in vars(conf).items()
+            if callable(v) and not k.startswith("_")]
+
+
+def help(repo_dir, model, source="github", force_reload=False):  # noqa: A001
+    """Docstring of one hub entrypoint (reference hapi/hub.py help)."""
+    conf = _resolve(repo_dir, source)
+    fn = getattr(conf, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"hub entrypoint {model} not found in {repo_dir}")
+    return fn.__doc__
+
+
+def load(repo_dir, model, source="github", force_reload=False, **kwargs):
+    """Call a hub entrypoint and return its model (reference
+    hapi/hub.py load)."""
+    conf = _resolve(repo_dir, source)
+    fn = getattr(conf, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"hub entrypoint {model} not found in {repo_dir}")
+    return fn(**kwargs)

@@ -479,10 +479,25 @@ def block_multihead_attention(
         packed = packed + unwrap(qkv_bias).astype(jnp.float32)[None, :]
 
     rope = None if rope_emb is None else unwrap(rope_emb).astype(jnp.float32)
+    if rope is not None:
+        # reference layout [2, bsz, max_seq, 1, D/2] (the py docstring /
+        # decoder RoPE kernel); the transposed [2, bsz, 1, max_seq, D/2]
+        # is normalized too — both reduce to [2, bsz, S, D/2]
+        if rope.ndim == 5:
+            rope = jnp.squeeze(rope, axis=2 if rope.shape[2] == 1 else 3)
+        if rope.ndim != 4 or rope.shape[0] != 2 or rope.shape[-1] != D // 2:
+            raise ValueError(
+                f"block_multihead_attention: rope_emb shape "
+                f"{unwrap(rope_emb).shape} is not [2, bsz, max_seq, 1, "
+                f"D/2] (D={D})")
     scale = 1.0 / float(_np.sqrt(D))
     group = H // kv_H
     out = jnp.zeros((qkv_v.shape[0], H * D), jnp.float32)
 
+    # pass 1: rope + collect every sequence's page writes for ONE scatter
+    # (a per-sequence .at[].set would copy the whole cache bsz times)
+    qs, ks, vs = {}, [], []
+    w_blk, w_off = [], []
     for b in range(bsz):
         n = int(this[b])
         if n == 0:
@@ -493,27 +508,36 @@ def block_multihead_attention(
         k = rows[:, H * D:(H + kv_H) * D].reshape(n, kv_H, D)
         v = rows[:, (H + kv_H) * D:].reshape(n, kv_H, D)
         positions = past + _np.arange(n)
-
         if rope is not None:
-            cs = rope[0, b, positions, 0]                 # [n, D/2]
-            sn = rope[1, b, positions, 0]
+            cs = rope[0, b, positions]                    # [n, D/2]
+            sn = rope[1, b, positions]
             q, k = _apply_rope_pair(q, k, cs[:, None, :], sn[:, None, :],
                                     use_neox_style)
+        qs[b] = q
+        ks.append(k)
+        vs.append(v)
+        w_blk.append(btab[b, positions // block_size])
+        w_off.append(positions % block_size)
+    if ks:
+        blk = jnp.asarray(_np.concatenate(w_blk), jnp.int32)
+        off = jnp.asarray(_np.concatenate(w_off), jnp.int32)
+        kc = kc.at[blk, :, off].set(jnp.concatenate(ks, 0))
+        vc = vc.at[blk, :, off].set(jnp.concatenate(vs, 0))
 
-        # write this step's kv into the pages
-        blk = jnp.asarray(btab[b, positions // block_size], jnp.int32)
-        off = jnp.asarray(positions % block_size, jnp.int32)
-        kc = kc.at[blk, :, off].set(k)
-        vc = vc.at[blk, :, off].set(v)
-
-        # gather [0, past+n) back out of the pages
+    # pass 2: attention against the updated pages
+    for b in range(bsz):
+        n = int(this[b])
+        if n == 0:
+            continue
+        past = int(dec[b])
+        positions = past + _np.arange(n)
         L = past + n
         nblk = (L + block_size - 1) // block_size
         blocks = jnp.asarray(btab[b, :nblk], jnp.int32)
         K = kc[blocks].transpose(1, 0, 2, 3).reshape(kv_H, -1, D)[:, :L]
         V = vc[blocks].transpose(1, 0, 2, 3).reshape(kv_H, -1, D)[:, :L]
 
-        qg = q.reshape(n, kv_H, group, D)
+        qg = qs[b].reshape(n, kv_H, group, D)
         logits = jnp.einsum("nkgd,ksd->nkgs", qg, K) * scale
         causal = jnp.asarray(positions)[:, None] >= jnp.arange(L)[None, :]
         logits = jnp.where(causal[:, None, None, :], logits, -1e30)

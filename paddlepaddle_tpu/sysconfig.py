@@ -1,0 +1,23 @@
+"""paddle.sysconfig (reference: python/paddle/sysconfig.py): install-tree
+paths for building extensions against the framework. Here the "includes"
+are the package directory itself (the extension story is Python-level —
+paddle.utils.register_op — or the native/ C sources) and the libs are the
+compiled native runtime .so directory."""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include():
+    root = os.path.dirname(os.path.abspath(__file__))
+    return os.path.join(root, "include") if os.path.isdir(
+        os.path.join(root, "include")) else root
+
+
+def get_lib():
+    root = os.path.dirname(os.path.abspath(__file__))
+    native = os.path.abspath(os.path.join(root, os.pardir, "native"))
+    return native if os.path.isdir(native) else root

@@ -75,3 +75,242 @@ class Imdb(Dataset):
 
     def __len__(self):
         return len(self.samples)
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """CRF Viterbi decode (reference python/paddle/text/viterbi_decode.py:31,
+    kernel phi/kernels/cpu/viterbi_decode_kernel.cc): max-sum over the tag
+    lattice with per-sequence lengths. With ``include_bos_eos_tag`` the
+    LAST transition row is the start tag (added at t=0) and the
+    SECOND-TO-LAST column the stop tag (added at each sequence's end).
+    Returns (scores [B], paths [B, max(lengths)] int64, zero-padded past
+    each sequence's length) — the path is truncated to the batch's max
+    length exactly as the kernel sizes its output."""
+    import jax.numpy as jnp
+
+    from ..core.dispatch import unwrap, wrap
+
+    pot = unwrap(potentials).astype(jnp.float32)
+    trans = unwrap(transition_params).astype(jnp.float32)
+    lens = unwrap(lengths).astype(jnp.int32).reshape(-1)
+    B, S, N = pot.shape
+    maxlen = max(int(lens.max()), 1)
+
+    alpha = pot[:, 0]
+    if include_bos_eos_tag:
+        alpha = alpha + trans[-1][None, :]
+    bps = [jnp.zeros((B, N), jnp.int32)]
+    for t in range(1, maxlen):
+        m = alpha[:, :, None] + trans[None]          # [B, from, to]
+        bp = jnp.argmax(m, axis=1).astype(jnp.int32)
+        cand = jnp.max(m, axis=1) + pot[:, t]
+        live = (t < lens)[:, None]
+        alpha = jnp.where(live, cand, alpha)
+        bps.append(bp)
+
+    final = alpha + (trans[:, -2][None, :] if include_bos_eos_tag else 0.0)
+    scores = jnp.max(final, -1)
+    tags = jnp.argmax(final, -1).astype(jnp.int32)
+
+    path = jnp.zeros((B, maxlen), jnp.int64)
+    ib = jnp.arange(B)
+    for t in range(maxlen - 1, -1, -1):
+        started = t <= lens - 1
+        path = path.at[:, t].set(jnp.where(started, tags, 0).astype(jnp.int64))
+        if t > 0:
+            tags = jnp.where(started, bps[t][ib, tags], tags)
+    return wrap(scores), wrap(path)
+
+
+class ViterbiDecoder:
+    """Layer form (reference text/viterbi_decode.py:110): holds the
+    transition matrix and the bos/eos flag."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+    forward = __call__
+
+
+class _LocalOnlyDataset(Dataset):
+    """Base for the reference's downloadable corpora: this environment has
+    zero egress, so each dataset parses a LOCAL copy in its official raw
+    format (pass ``data_file``); without one, a RuntimeError explains."""
+
+    _NAME = ""
+    _FMT = ""
+
+    def _need(self, data_file):
+        if data_file is None:
+            raise RuntimeError(
+                f"{self._NAME}: automatic download is unavailable "
+                f"(zero-egress); pass data_file= pointing at a local copy "
+                f"({self._FMT})")
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class UCIHousing(_LocalOnlyDataset):
+    """Boston housing regression (reference text/datasets/uci_housing.py):
+    13 features + target per whitespace row; features min-max normalized
+    as in the reference loader."""
+
+    _NAME = "UCIHousing"
+    _FMT = "whitespace rows of 14 floats (housing.data)"
+
+    def __init__(self, data_file=None, mode="train", download=False):
+        self._need(data_file)
+        rows = []
+        with open(data_file) as f:
+            for line in f:
+                vals = line.split()
+                if len(vals) == 14:
+                    rows.append([float(v) for v in vals])
+        data = np.asarray(rows, np.float32)
+        feat, target = data[:, :13], data[:, 13:]
+        lo, hi = feat.min(0), feat.max(0)
+        feat = (feat - lo) / np.maximum(hi - lo, 1e-12)
+        split = int(len(data) * 0.8)
+        sel = slice(0, split) if mode == "train" else slice(split, None)
+        self.samples = list(zip(feat[sel], target[sel]))
+
+
+class Imikolov(_LocalOnlyDataset):
+    """PTB n-gram LM dataset (reference text/datasets/imikolov.py): builds
+    a frequency-cutoff vocab and yields n-gram index tuples."""
+
+    _NAME = "Imikolov"
+    _FMT = "one tokenized sentence per line (ptb.train.txt)"
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, download=False):
+        self._need(data_file)
+        sents, freq = [], {}
+        with open(data_file, encoding="utf-8") as f:
+            for line in f:
+                toks = line.split()
+                sents.append(toks)
+                # the reference counts the per-line sentinels too, so
+                # <s>/<e> earn real vocab ids (imikolov.py word_count)
+                for t in ["<s>"] + toks + ["<e>"]:
+                    freq[t] = freq.get(t, 0) + 1
+        vocab = {w: i for i, (w, c) in enumerate(
+            sorted(freq.items(), key=lambda kv: (-kv[1], kv[0])))
+            if c >= min_word_freq}
+        self.word_idx = dict(vocab)
+        self.word_idx["<unk>"] = len(self.word_idx)
+        unk = self.word_idx["<unk>"]
+        self.samples = []
+        for toks in sents:
+            ids = [self.word_idx.get(t, unk)
+                   for t in ["<s>"] + toks + ["<e>"]]
+            if data_type.upper() == "NGRAM":
+                for i in range(len(ids) - window_size + 1):
+                    self.samples.append(tuple(ids[i:i + window_size]))
+            else:
+                self.samples.append(ids)
+
+
+class Movielens(_LocalOnlyDataset):
+    """MovieLens-1M ratings (reference text/datasets/movielens.py):
+    UserID::MovieID::Rating::Timestamp rows."""
+
+    _NAME = "Movielens"
+    _FMT = "ratings.dat with UserID::MovieID::Rating::Timestamp rows"
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=False):
+        self._need(data_file)
+        rng = np.random.default_rng(rand_seed)
+        self.samples = []
+        with open(data_file, encoding="utf-8", errors="ignore") as f:
+            for line in f:
+                parts = line.strip().split("::")
+                if len(parts) != 4:
+                    continue
+                is_test = rng.random() < test_ratio
+                if (mode == "test") == is_test:
+                    self.samples.append(
+                        (int(parts[0]), int(parts[1]), float(parts[2])))
+
+
+class _ParallelCorpus(_LocalOnlyDataset):
+    _FMT = "UTF-8 lines of 'source<TAB>target'"
+
+    def __init__(self, data_file=None, src_dict_size=-1, trg_dict_size=-1,
+                 lang="en", mode="train", download=False):
+        self._need(data_file)
+        pairs = []
+        with open(data_file, encoding="utf-8") as f:
+            for line in f:
+                if "\t" in line:
+                    s, t = line.rstrip("\n").split("\t", 1)
+                    pairs.append((s.split(), t.split()))
+
+        def build(texts, cap):
+            freq = {}
+            for toks in texts:
+                for t in toks:
+                    freq[t] = freq.get(t, 0) + 1
+            words = [w for w, _ in sorted(freq.items(),
+                                          key=lambda kv: (-kv[1], kv[0]))]
+            if cap and cap > 0:
+                # cap is the TOTAL dict size incl. the 3 specials
+                # (reference wmt16 __build_dict keeps words[:size-3])
+                words = words[:max(cap - 3, 0)]
+            d = {"<s>": 0, "<e>": 1, "<unk>": 2}
+            for w in words:
+                d.setdefault(w, len(d))
+            return d
+
+        self.src_dict = build([p[0] for p in pairs], src_dict_size)
+        self.trg_dict = build([p[1] for p in pairs], trg_dict_size)
+        su, tu = self.src_dict["<unk>"], self.trg_dict["<unk>"]
+        self.samples = [
+            ([self.src_dict.get(w, su) for w in s],
+             [self.trg_dict["<s>"]] + [self.trg_dict.get(w, tu) for w in t]
+             + [self.trg_dict["<e>"]])
+            for s, t in pairs]
+
+
+class WMT14(_ParallelCorpus):
+    """WMT'14 en-fr (reference text/datasets/wmt14.py) from a local
+    tab-separated parallel file."""
+
+    _NAME = "WMT14"
+
+
+class WMT16(_ParallelCorpus):
+    """WMT'16 en-de (reference text/datasets/wmt16.py) from a local
+    tab-separated parallel file."""
+
+    _NAME = "WMT16"
+
+
+class Conll05st(_LocalOnlyDataset):
+    """CoNLL-2005 SRL (reference text/datasets/conll05.py): the official
+    distribution is license-gated even upstream; parses a local
+    tab-separated (word, predicate, label-sequence) file."""
+
+    _NAME = "Conll05st"
+    _FMT = "lines of 'words<TAB>predicate<TAB>labels' (space-tokenized)"
+
+    def __init__(self, data_file=None, mode="train", download=False, **kw):
+        self._need(data_file)
+        self.samples = []
+        with open(data_file, encoding="utf-8") as f:
+            for line in f:
+                parts = line.rstrip("\n").split("\t")
+                if len(parts) == 3:
+                    self.samples.append(
+                        (parts[0].split(), parts[1], parts[2].split()))

@@ -19,3 +19,85 @@ class cpp_extension:
             "and register it with paddle.utils.register_op (docs/custom_ops.md)")
 
     setup = load
+
+
+def try_import(module_name, err_msg=None):
+    """Import a soft dependency or raise with guidance (reference
+    utils/lazy_import.py try_import)."""
+    import importlib
+
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        if err_msg is None:
+            err_msg = (f"Failed importing {module_name}. This likely means "
+                       f"that some paddle modules require additional "
+                       f"dependencies that have to be manually installed "
+                       f"(usually with `pip install {module_name}`).")
+        raise ImportError(err_msg) from None
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Deprecation decorator (reference utils/deprecated.py): warns on
+    call (level<=1) or raises (level==2), and prepends a notice to the
+    docstring."""
+    import functools
+    import warnings
+
+    def decorator(func):
+        note = (f"API \"{func.__module__}.{func.__name__}\" is deprecated "
+                f"since {since or 'an earlier release'}"
+                + (f", and will be removed in future versions. Please use "
+                   f"\"{update_to}\" instead." if update_to else ".")
+                + (f" Reason: {reason}" if reason else ""))
+        func.__doc__ = f"Warning: {note}\n\n{func.__doc__ or ''}"
+
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            if level == 2:
+                raise RuntimeError(note)
+            if level < 2:
+                warnings.warn(note, DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+
+        return wrapper
+
+    return decorator
+
+
+def require_version(min_version, max_version=None):
+    """Check the installed framework version against [min, max] (reference
+    utils/install_check role in utils/__init__.py require_version)."""
+    from .. import __version__
+
+    def parts(v):
+        return [int(x) for x in str(v).split(".") if x.isdigit()][:3]
+
+    cur = parts(__version__)
+    if parts(min_version) > cur:
+        raise Exception(
+            f"VersionError: paddlepaddle version {__version__} is below the "
+            f"required minimum {min_version}")
+    if max_version is not None and parts(max_version) < cur:
+        raise Exception(
+            f"VersionError: paddlepaddle version {__version__} exceeds the "
+            f"allowed maximum {max_version}")
+    return True
+
+
+def run_check():
+    """Install smoke check (reference utils/install_check.py run_check):
+    runs a tiny matmul + grad on the default device and reports."""
+    import numpy as np
+
+    import paddlepaddle_tpu as paddle
+
+    x = paddle.to_tensor(np.ones((4, 4), np.float32), stop_gradient=False)
+    w = paddle.to_tensor(np.eye(4, dtype=np.float32), stop_gradient=False)
+    y = (x @ w).sum()
+    y.backward()
+    assert np.allclose(x.grad.numpy(), np.ones((4, 4), np.float32))
+    dev = paddle.get_device()
+    print(f"PaddlePaddle-TPU works well on {dev}.")
+    print("PaddlePaddle-TPU is installed successfully! Let's start deep "
+          "learning with PaddlePaddle-TPU now.")

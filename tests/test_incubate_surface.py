@@ -326,6 +326,53 @@ def test_mmha_rotary_matches_manual_rotation():
                                rtol=1e-5, atol=1e-6)
 
 
+def test_blha_rope_layout_and_rotation():
+    """rope_emb in the reference layout [2, bsz, max_seq, 1, D/2]: cache
+    keys come out rotated per-position; the transposed singleton layout
+    normalizes identically; a wrong trailing dim raises."""
+    import numpy as np
+
+    import paddlepaddle_tpu as paddle
+
+    f = paddle.incubate.nn.functional
+    rng = np.random.default_rng(13)
+    H = kv_H = 1
+    D, bs, S = 8, 4, 4
+    qkv = rng.standard_normal((2, 3 * D)).astype(np.float32)
+    theta = rng.uniform(0, np.pi, (S, D // 2)).astype(np.float32)
+    rope = np.stack([np.cos(theta), np.sin(theta)])[:, None, :, None, :]
+
+    def run(r):
+        return f.block_multihead_attention(
+            paddle.to_tensor(qkv),
+            paddle.to_tensor(np.zeros((2, kv_H, bs, D), np.float32)),
+            paddle.to_tensor(np.zeros((2, kv_H, bs, D), np.float32)),
+            paddle.to_tensor(np.array([[2]], np.int32)),
+            paddle.to_tensor(np.array([[0]], np.int32)),
+            paddle.to_tensor(np.array([[2]], np.int32)),
+            paddle.to_tensor(np.zeros(2, np.int32)),
+            paddle.to_tensor(np.zeros(1, np.int32)),
+            paddle.to_tensor(np.array([0, 2], np.int32)),
+            paddle.to_tensor(np.array([0, 2], np.int32)),
+            paddle.to_tensor(np.array([[0, 1]], np.int32)),
+            rope_emb=paddle.to_tensor(r.astype(np.float32)), block_size=bs)
+
+    _, _, kc_out, _ = run(rope)
+    k = qkv[:, D:2 * D]
+    for t in range(2):
+        c, s = np.cos(theta[t]), np.sin(theta[t])
+        ref = np.empty(D, np.float32)
+        ref[0::2] = k[t, 0::2] * c - k[t, 1::2] * s
+        ref[1::2] = k[t, 1::2] * c + k[t, 0::2] * s
+        np.testing.assert_allclose(kc_out.numpy()[0, 0, t], ref, rtol=1e-5,
+                                   atol=1e-6)
+    # transposed singleton layout gives the same result
+    _, _, kc2, _ = run(np.transpose(rope, (0, 1, 3, 2, 4)))
+    np.testing.assert_allclose(kc2.numpy(), kc_out.numpy(), rtol=1e-6)
+    with pytest.raises(ValueError, match="rope_emb"):
+        run(rope[..., :3])
+
+
 def test_serving_attention_quant_rejected():
     import numpy as np
 

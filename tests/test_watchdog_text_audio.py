@@ -146,3 +146,163 @@ def test_audio_wav_backend_roundtrip(tmp_path):
     # offset + frame window
     part, _ = audio.backends.load(fp, frame_offset=100, num_frames=200)
     np.testing.assert_allclose(part.numpy(), t[:, 100:300], atol=1e-3)
+
+
+# ---- round-4 text tail: viterbi + local datasets + hub/sysconfig/utils ----
+
+
+def test_viterbi_decode_vs_bruteforce():
+    import itertools
+
+    import numpy as np
+
+    import paddlepaddle_tpu as paddle
+
+    rng = np.random.default_rng(2)
+    B, S, N = 3, 5, 4
+    pot = rng.standard_normal((B, S, N)).astype(np.float32)
+    trans = rng.standard_normal((N, N)).astype(np.float32)
+    lens = np.array([5, 3, 1], np.int64)
+
+    for include in (False, True):
+        scores, paths = paddle.text.viterbi_decode(
+            paddle.to_tensor(pot), paddle.to_tensor(trans),
+            paddle.to_tensor(lens), include_bos_eos_tag=include)
+        scores, paths = scores.numpy(), paths.numpy()
+        assert paths.shape == (B, int(lens.max()))
+        for b in range(B):
+            L = int(lens[b])
+            best, best_seq = -np.inf, None
+            for seq in itertools.product(range(N), repeat=L):
+                s = pot[b, 0, seq[0]]
+                if include:
+                    s += trans[-1, seq[0]]
+                for t in range(1, L):
+                    s += trans[seq[t - 1], seq[t]] + pot[b, t, seq[t]]
+                if include:
+                    s += trans[seq[-1], -2]
+                if s > best:
+                    best, best_seq = s, seq
+            np.testing.assert_allclose(scores[b], best, rtol=1e-5,
+                                       err_msg=f"include={include} b={b}")
+            np.testing.assert_array_equal(paths[b, :L], best_seq)
+            assert (paths[b, L:] == 0).all()
+
+
+def test_viterbi_decoder_layer():
+    import numpy as np
+
+    import paddlepaddle_tpu as paddle
+
+    dec = paddle.text.ViterbiDecoder(
+        paddle.to_tensor(np.eye(3, dtype=np.float32)),
+        include_bos_eos_tag=False)
+    pot = np.zeros((1, 2, 3), np.float32)
+    pot[0, :, 2] = 5.0
+    s, p = dec(paddle.to_tensor(pot),
+               paddle.to_tensor(np.array([2], np.int64)))
+    assert p.numpy().tolist() == [[2, 2]]
+
+
+def test_text_local_datasets(tmp_path):
+    import numpy as np
+
+    import paddlepaddle_tpu as paddle
+
+    # UCIHousing: 14-column rows, normalized features
+    rows = np.random.default_rng(0).uniform(1, 9, (10, 14))
+    housing = tmp_path / "housing.data"
+    housing.write_text("\n".join(" ".join(f"{v:.3f}" for v in r)
+                                 for r in rows))
+    ds = paddle.text.UCIHousing(data_file=str(housing), mode="train")
+    x, y = ds[0]
+    assert x.shape == (13,) and 0.0 <= x.min() and x.max() <= 1.0
+
+    # Imikolov n-grams share one vocab with <unk> fallback
+    corpus = tmp_path / "ptb.txt"
+    corpus.write_text("a b a b c\n" "a b a b a\n")
+    ds = paddle.text.Imikolov(data_file=str(corpus), window_size=2,
+                              min_word_freq=2)
+    assert len(ds) > 0 and all(len(s) == 2 for s in ds.samples)
+    # sentinels are counted per line and earn REAL vocab ids
+    assert ds.word_idx["<s>"] != ds.word_idx["<unk>"]
+    assert ds.word_idx["<e>"] != ds.word_idx["<unk>"]
+
+    # Movielens :: rows
+    ml = tmp_path / "ratings.dat"
+    ml.write_text("1::10::5::97\n2::20::3::98\n")
+    ds = paddle.text.Movielens(data_file=str(ml), mode="train",
+                               test_ratio=0.0)
+    assert ds[0] == (1, 10, 5.0)
+
+    # WMT tab-parallel corpus builds dicts with <s>/<e>/<unk>
+    par = tmp_path / "par.tsv"
+    par.write_text("hello world\tbonjour monde\nbye world\tau revoir\n")
+    ds = paddle.text.WMT14(data_file=str(par))
+    src, trg = ds[0]
+    assert trg[0] == 0 and trg[-1] == 1          # <s> ... <e>
+    assert paddle.text.WMT16(data_file=str(par)).src_dict["<unk>"] == 2
+    # dict_size caps the TOTAL size including the 3 specials
+    assert len(paddle.text.WMT16(data_file=str(par),
+                                 src_dict_size=4).src_dict) == 4
+
+    # downloads refused with guidance
+    with pytest.raises(RuntimeError, match="zero-egress"):
+        paddle.text.Conll05st()
+
+
+def test_hub_local_and_remote_refusal(tmp_path):
+    import numpy as np
+
+    import paddlepaddle_tpu as paddle
+
+    (tmp_path / "hubconf.py").write_text(
+        "def tiny_model(scale=1.0):\n"
+        "    'A tiny hub model.'\n"
+        "    import paddlepaddle_tpu as paddle\n"
+        "    lin = paddle.nn.Linear(2, 2)\n"
+        "    lin._hub_scale = scale\n"
+        "    return lin\n")
+    names = paddle.hub.list(str(tmp_path), source="local")
+    assert names == ["tiny_model"]
+    assert "tiny hub model" in paddle.hub.help(str(tmp_path), "tiny_model",
+                                               source="local")
+    m = paddle.hub.load(str(tmp_path), "tiny_model", source="local",
+                        scale=2.0)
+    assert m._hub_scale == 2.0
+    out = m(np.ones((1, 2), np.float32))
+    assert out.shape == [1, 2]
+    with pytest.raises(RuntimeError, match="zero egress"):
+        paddle.hub.load("user/repo", "tiny_model", source="github")
+    with pytest.raises(ValueError, match="Unknown source"):
+        paddle.hub.list(str(tmp_path), source="ftp")
+
+
+def test_sysconfig_and_utils_tail(capsys):
+    import os
+    import warnings
+
+    import paddlepaddle_tpu as paddle
+
+    assert os.path.isdir(paddle.sysconfig.get_include())
+    assert os.path.isdir(paddle.sysconfig.get_lib())
+
+    assert paddle.utils.require_version("0.0.1")
+    with pytest.raises(Exception, match="VersionError"):
+        paddle.utils.require_version("99.0")
+    with pytest.raises(ImportError, match="pip install"):
+        paddle.utils.try_import("not_a_real_module_xyz")
+    assert paddle.utils.try_import("json").dumps({}) == "{}"
+
+    @paddle.utils.deprecated(update_to="paddle.new_api", since="2.0")
+    def old_api():
+        return 7
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert old_api() == 7
+    assert any("deprecated" in str(x.message) for x in w)
+    assert "Warning:" in old_api.__doc__
+
+    paddle.utils.run_check()
+    assert "installed successfully" in capsys.readouterr().out
