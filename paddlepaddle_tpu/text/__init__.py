@@ -245,10 +245,13 @@ class Movielens(_LocalOnlyDataset):
 
 
 class _ParallelCorpus(_LocalOnlyDataset):
+    """Samples follow the reference contract (wmt14.py:203 / wmt16.py:274):
+    (src_ids, trg_ids = <s>+target, trg_ids_next = target+<e>), each a
+    numpy int array."""
+
     _FMT = "UTF-8 lines of 'source<TAB>target'"
 
-    def __init__(self, data_file=None, src_dict_size=-1, trg_dict_size=-1,
-                 lang="en", mode="train", download=False):
+    def _build(self, data_file, src_dict_size, trg_dict_size):
         self._need(data_file)
         pairs = []
         with open(data_file, encoding="utf-8") as f:
@@ -276,25 +279,41 @@ class _ParallelCorpus(_LocalOnlyDataset):
         self.src_dict = build([p[0] for p in pairs], src_dict_size)
         self.trg_dict = build([p[1] for p in pairs], trg_dict_size)
         su, tu = self.src_dict["<unk>"], self.trg_dict["<unk>"]
-        self.samples = [
-            ([self.src_dict.get(w, su) for w in s],
-             [self.trg_dict["<s>"]] + [self.trg_dict.get(w, tu) for w in t]
-             + [self.trg_dict["<e>"]])
-            for s, t in pairs]
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        for s, t in pairs:
+            tids = [self.trg_dict.get(w, tu) for w in t]
+            self.src_ids.append([self.src_dict.get(w, su) for w in s])
+            self.trg_ids.append([self.trg_dict["<s>"]] + tids)
+            self.trg_ids_next.append(tids + [self.trg_dict["<e>"]])
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]), np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)
 
 
 class WMT14(_ParallelCorpus):
-    """WMT'14 en-fr (reference text/datasets/wmt14.py) from a local
-    tab-separated parallel file."""
+    """WMT'14 en-fr (reference text/datasets/wmt14.py:113: one dict_size
+    for both sides) from a local tab-separated parallel file."""
 
     _NAME = "WMT14"
 
+    def __init__(self, data_file=None, mode="train", dict_size=-1,
+                 download=False):
+        self._build(data_file, dict_size, dict_size)
+
 
 class WMT16(_ParallelCorpus):
-    """WMT'16 en-de (reference text/datasets/wmt16.py) from a local
-    tab-separated parallel file."""
+    """WMT'16 en-de (reference text/datasets/wmt16.py: separate
+    src/trg dict sizes) from a local tab-separated parallel file."""
 
     _NAME = "WMT16"
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en", download=False):
+        self._build(data_file, src_dict_size, trg_dict_size)
 
 
 class Conll05st(_LocalOnlyDataset):

@@ -101,3 +101,191 @@ class Cifar10(Dataset):
 
     def __len__(self):
         return len(self.images)
+
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                  ".tiff", ".webp")
+
+
+def _default_loader(path):
+    try:
+        from PIL import Image
+
+        with Image.open(path) as img:
+            return np.asarray(img.convert("RGB"))
+    except ImportError:
+        return np.fromfile(path, np.uint8)
+
+
+class DatasetFolder(Dataset):
+    """class-per-subdirectory image dataset (reference
+    vision/datasets/folder.py DatasetFolder): samples are (path-loaded
+    image, class index); classes are the sorted subdirectory names."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or _default_loader
+        self.transform = transform
+        extensions = tuple(extensions or IMG_EXTENSIONS)
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise RuntimeError(f"Found 0 directories in {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        valid = is_valid_file or (
+            lambda p: p.lower().endswith(extensions))
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for base, _, fnames in sorted(os.walk(cdir)):
+                for fn in sorted(fnames):
+                    p = os.path.join(base, fn)
+                    if valid(p):
+                        self.samples.append((p, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(
+                f"Found 0 files in subfolders of {root} with extensions "
+                f"{','.join(extensions)}")
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """flat (unlabeled) image folder (reference folder.py ImageFolder):
+    yields [image] per sample."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or _default_loader
+        self.transform = transform
+        extensions = tuple(extensions or IMG_EXTENSIONS)
+        valid = is_valid_file or (
+            lambda p: p.lower().endswith(extensions))
+        self.samples = []
+        for base, _, fnames in sorted(os.walk(root)):
+            for fn in sorted(fnames):
+                p = os.path.join(base, fn)
+                if valid(p):
+                    self.samples.append(p)
+        if not self.samples:
+            raise RuntimeError(
+                f"Found 0 files in {root} with extensions "
+                f"{','.join(extensions)}")
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class FashionMNIST(MNIST):
+    """Same idx format as MNIST (reference vision/datasets/mnist.py
+    FashionMNIST subclass) from local files."""
+
+
+class Cifar100(Dataset):
+    """CIFAR-100 from the local python-pickle directory (reference
+    vision/datasets/cifar.py Cifar100: fine labels)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if data_file is None:
+            raise ValueError("downloads are disabled; pass data_file "
+                             "(the cifar-100-python directory)")
+        self.transform = transform
+        with open(os.path.join(data_file,
+                               "train" if mode == "train" else "test"),
+                  "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        self.images = np.asarray(d[b"data"]).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(d[b"fine_labels"], np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Flowers(Dataset):
+    """Oxford 102 Flowers (reference vision/datasets/flowers.py) from
+    local files: an image directory plus the official .mat label/setid
+    files (scipy parses them)."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=False, backend=None):
+        if data_file is None or label_file is None or setid_file is None:
+            raise ValueError(
+                "downloads are disabled; pass data_file (jpg dir), "
+                "label_file (imagelabels.mat), setid_file (setid.mat)")
+        import scipy.io
+
+        self.transform = transform
+        labels = scipy.io.loadmat(label_file)["labels"].ravel()
+        setid = scipy.io.loadmat(setid_file)
+        key = {"train": "trnid", "valid": "valid", "test": "tstid"}[mode]
+        self.indexes = setid[key].ravel()
+        self.data_file = data_file
+        self.labels = labels
+
+    def __getitem__(self, idx):
+        flower_id = int(self.indexes[idx])
+        img = _default_loader(
+            os.path.join(self.data_file, f"image_{flower_id:05d}.jpg"))
+        if self.transform is not None:
+            img = self.transform(img)
+        # labels are 1-based in the official .mat
+        return img, np.int64(self.labels[flower_id - 1] - 1)
+
+    def __len__(self):
+        return len(self.indexes)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation pairs (reference
+    vision/datasets/voc2012.py) from a local VOCdevkit/VOC2012 tree."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if data_file is None:
+            raise ValueError("downloads are disabled; pass data_file "
+                             "(the VOCdevkit/VOC2012 directory)")
+        self.transform = transform
+        name = {"train": "train", "valid": "val", "test": "val",
+                "val": "val"}[mode]
+        lst = os.path.join(data_file, "ImageSets", "Segmentation",
+                           f"{name}.txt")
+        with open(lst) as f:
+            ids = [line.strip() for line in f if line.strip()]
+        self.pairs = [
+            (os.path.join(data_file, "JPEGImages", f"{i}.jpg"),
+             os.path.join(data_file, "SegmentationClass", f"{i}.png"))
+            for i in ids]
+
+    def __getitem__(self, idx):
+        img = _default_loader(self.pairs[idx][0])
+        label = _default_loader(self.pairs[idx][1])
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.pairs)

@@ -166,3 +166,298 @@ class Transpose(BaseTransform):
 
     def _apply_image(self, img):
         return np.transpose(np.asarray(img), self.order)
+
+
+from . import functional  # noqa: E402,F401
+from .functional import (  # noqa: E402,F401
+    adjust_brightness,
+    adjust_contrast,
+    adjust_hue,
+    adjust_saturation,
+    affine,
+    center_crop,
+    crop,
+    erase,
+    hflip,
+    normalize,
+    pad,
+    perspective,
+    resize,
+    rotate,
+    to_grayscale,
+    to_tensor,
+    vflip,
+)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random.random() < self.prob:
+            return functional.vflip(img)
+        return np.asarray(img)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return functional.pad(img, self.padding, self.fill,
+                              self.padding_mode)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1):
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return functional.to_grayscale(img, self.num_output_channels)
+
+
+class RandomResizedCrop(BaseTransform):
+    """Random area/aspect crop then resize (reference transforms.py
+    RandomResizedCrop): 10 sampling attempts, center-crop fallback."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4, 4.0 / 3),
+                 interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _get_param(self, img):
+        h, w = np.asarray(img).shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            log_ratio = (np.log(self.ratio[0]), np.log(self.ratio[1]))
+            ar = np.exp(random.uniform(*log_ratio))
+            tw = int(round(np.sqrt(target * ar)))
+            th = int(round(np.sqrt(target / ar)))
+            if 0 < tw <= w and 0 < th <= h:
+                i = random.randint(0, h - th)
+                j = random.randint(0, w - tw)
+                return i, j, th, tw
+        # fallback: largest center crop at a bound ratio
+        in_ratio = w / h
+        if in_ratio < self.ratio[0]:
+            tw, th = w, int(round(w / self.ratio[0]))
+        elif in_ratio > self.ratio[1]:
+            th, tw = h, int(round(h * self.ratio[1]))
+        else:
+            tw, th = w, h
+        return (h - th) // 2, (w - tw) // 2, th, tw
+
+    def _apply_image(self, img):
+        i, j, th, tw = self._get_param(img)
+        return functional.resize(functional.crop(img, i, j, th, tw),
+                                 self.size, self.interpolation)
+
+
+def _jitter_range(value, name, center=1.0, bound=None, clip_zero=True):
+    """Reference _check_input: a number v means [center-v, center+v]
+    (clipped at 0), a (min, max) pair is taken as-is."""
+    if isinstance(value, numbers.Number):
+        if value < 0:
+            raise ValueError(f"{name} value should be non-negative")
+        lo, hi = center - value, center + value
+        if clip_zero:
+            lo = max(0.0, lo)
+    else:
+        lo, hi = (float(value[0]), float(value[1]))
+    if bound is not None and not (bound[0] <= lo <= hi <= bound[1]):
+        raise ValueError(f"{name} values should be between {bound}")
+    return (lo, hi)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value):
+        self._range = _jitter_range(value, type(self).__name__)
+        self.value = value
+
+    def _is_identity(self):
+        return self._range == (1.0, 1.0)
+
+    def _factor(self):
+        return random.uniform(*self._range)
+
+    def _apply_image(self, img):
+        if self._is_identity():
+            return np.asarray(img)
+        return functional.adjust_brightness(img, self._factor())
+
+
+class ContrastTransform(BrightnessTransform):
+    def _apply_image(self, img):
+        if self._is_identity():
+            return np.asarray(img)
+        return functional.adjust_contrast(img, self._factor())
+
+
+class SaturationTransform(BrightnessTransform):
+    def _apply_image(self, img):
+        if self._is_identity():
+            return np.asarray(img)
+        return functional.adjust_saturation(img, self._factor())
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value):
+        self._range = _jitter_range(value, "hue", center=0.0,
+                                    bound=(-0.5, 0.5), clip_zero=False)
+        if isinstance(value, numbers.Number) and not 0 <= value <= 0.5:
+            raise ValueError("hue value should be in [0, 0.5]")
+        self.value = value
+
+    def _apply_image(self, img):
+        if self._range == (0.0, 0.0):
+            return np.asarray(img)
+        return functional.adjust_hue(img, random.uniform(*self._range))
+
+
+class ColorJitter(BaseTransform):
+    """Random brightness/contrast/saturation/hue in a random order
+    (reference transforms.py ColorJitter)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self.transforms = [BrightnessTransform(brightness),
+                           ContrastTransform(contrast),
+                           SaturationTransform(saturation),
+                           HueTransform(hue)]
+
+    def _apply_image(self, img):
+        order = list(range(4))
+        random.shuffle(order)
+        for idx in order:
+            img = self.transforms[idx]._apply_image(img)
+        return img
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0):
+        if isinstance(degrees, numbers.Number):
+            if degrees < 0:
+                raise ValueError("degrees must be non-negative")
+            degrees = (-degrees, degrees)
+        self.degrees = tuple(degrees)
+        self.interpolation = interpolation
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        angle = random.uniform(*self.degrees)
+        return functional.rotate(img, angle, self.interpolation,
+                                 self.expand, self.center, self.fill)
+
+
+class RandomAffine(BaseTransform):
+    """Random rotation/translation/scale/shear in one warp (reference
+    transforms.py RandomAffine)."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = tuple(degrees)
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        h, w = np.asarray(img).shape[:2]
+        angle = random.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = random.uniform(-self.translate[1], self.translate[1]) * h
+        sc = random.uniform(*self.scale) if self.scale is not None else 1.0
+        sh = (0.0, 0.0)
+        if self.shear is not None:
+            shear = self.shear
+            if isinstance(shear, numbers.Number):
+                sh = (random.uniform(-shear, shear), 0.0)
+            elif len(shear) == 2:
+                sh = (random.uniform(shear[0], shear[1]), 0.0)
+            else:
+                sh = (random.uniform(shear[0], shear[1]),
+                      random.uniform(shear[2], shear[3]))
+        return functional.affine(img, angle, (tx, ty), sc, sh,
+                                 self.interpolation, self.fill, self.center)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0):
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _apply_image(self, img):
+        if random.random() >= self.prob:
+            return np.asarray(img)
+        h, w = np.asarray(img).shape[:2]
+        d = self.distortion_scale
+        dx, dy = int(d * w / 2), int(d * h / 2)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [(random.randint(0, dx), random.randint(0, dy)),
+               (w - 1 - random.randint(0, dx), random.randint(0, dy)),
+               (w - 1 - random.randint(0, dx), h - 1 - random.randint(0, dy)),
+               (random.randint(0, dx), h - 1 - random.randint(0, dy))]
+        return functional.perspective(img, start, end, self.interpolation,
+                                      self.fill)
+
+
+class RandomErasing(BaseTransform):
+    """Random rectangle erase on a CHW tensor/array (reference
+    transforms.py RandomErasing; applied after ToTensor)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+        self.inplace = inplace
+
+    def __call__(self, img):          # operates on tensors, skip asarray
+        return self._apply_image(img)
+
+    def _apply_image(self, img):
+        if random.random() >= self.prob:
+            return img
+        shape = img.shape
+        ch_first = len(shape) == 3 and shape[0] in (1, 3)
+        h, w = (shape[1], shape[2]) if ch_first else (shape[0], shape[1])
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            log_ratio = (np.log(self.ratio[0]), np.log(self.ratio[1]))
+            ar = np.exp(random.uniform(*log_ratio))
+            eh = int(round(np.sqrt(target / ar)))
+            ew = int(round(np.sqrt(target * ar)))
+            if eh < h and ew < w:
+                i = random.randint(0, h - eh)
+                j = random.randint(0, w - ew)
+                if isinstance(self.value, str):         # 'random': noise
+                    # per-pixel normal noise like the reference (scaled
+                    # to the uint8 range for integer images)
+                    shape = ((shape[0], eh, ew) if ch_first
+                             else (eh, ew) + tuple(shape[2:]))
+                    v = np.random.normal(size=shape).astype(np.float32)
+                    if getattr(img, "dtype", None) == np.uint8:
+                        v = np.clip(v * 255, 0, 255).astype(np.uint8)
+                else:
+                    v = self.value
+                return functional.erase(img, i, j, eh, ew, v, self.inplace)
+        return img

@@ -1,0 +1,339 @@
+"""paddle.vision.transforms functional ops (reference:
+python/paddle/vision/transforms/functional{,_cv2,_pil,_tensor}.py).
+
+Host-side numpy implementations over HWC arrays (uint8 or float), the
+backend-neutral subset of the reference's cv2/PIL/tensor triple backends:
+geometry (resize/crop/flip/pad/affine/rotate/perspective) samples through
+one inverse-warp helper; photometry (brightness/contrast/saturation/hue)
+follows the blend formulas the reference's tensor backend uses."""
+
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+__all__ = [
+    "to_tensor", "hflip", "vflip", "resize", "pad", "crop", "center_crop",
+    "affine", "rotate", "perspective", "to_grayscale", "normalize",
+    "adjust_brightness", "adjust_contrast", "adjust_saturation",
+    "adjust_hue", "erase",
+]
+
+
+def _hwc(img):
+    a = np.asarray(img)
+    if a.ndim == 2:
+        a = a[:, :, None]
+    return a
+
+
+def _restore_dtype(out, ref):
+    if np.asarray(ref).dtype == np.uint8:
+        return np.clip(np.round(out), 0, 255).astype(np.uint8)
+    return out.astype(np.asarray(ref).dtype)
+
+
+def to_tensor(pic, data_format="CHW"):
+    """HWC image -> CHW float Tensor in [0,1] (reference functional
+    to_tensor)."""
+    from ...core.tensor import Tensor
+
+    a = _hwc(pic)
+    if a.dtype == np.uint8:
+        a = a.astype(np.float32) / 255.0
+    else:
+        a = a.astype(np.float32)
+    if data_format == "CHW":
+        a = np.transpose(a, (2, 0, 1))
+    import jax.numpy as jnp
+
+    return Tensor._from_data(jnp.asarray(a))
+
+
+def hflip(img):
+    return np.ascontiguousarray(np.asarray(img)[:, ::-1])
+
+
+def vflip(img):
+    return np.ascontiguousarray(np.asarray(img)[::-1])
+
+
+def resize(img, size, interpolation="bilinear"):
+    from . import Resize
+
+    return Resize(size, interpolation)._apply_image(np.asarray(img))
+
+
+def crop(img, top, left, height, width):
+    return np.asarray(img)[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    a = np.asarray(img)
+    h, w = a.shape[:2]
+    th, tw = output_size
+    return crop(a, max(0, (h - th) // 2), max(0, (w - tw) // 2), th, tw)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    """padding: int (all sides) | (lr, tb) | (left, top, right, bottom);
+    modes constant/edge/reflect/symmetric (reference functional pad)."""
+    a = _hwc(img)
+    if isinstance(padding, numbers.Number):
+        l = t = r = b = int(padding)
+    elif len(padding) == 2:
+        l, t = int(padding[0]), int(padding[1])
+        r, b = l, t
+    else:
+        l, t, r, b = (int(p) for p in padding)
+    spec = [(t, b), (l, r), (0, 0)]
+    if padding_mode != "constant":
+        out = np.pad(a, spec, mode=padding_mode)
+    elif isinstance(fill, (list, tuple)):
+        # per-channel fill (reference: a length-3 tuple fills R, G, B)
+        out = np.stack([np.pad(a[..., c], spec[:2], constant_values=fv)
+                        for c, fv in enumerate(fill)], -1)
+    else:
+        out = np.pad(a, spec, constant_values=fill)
+    return out if np.asarray(img).ndim == 3 else out[:, :, 0]
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    a = np.asarray(img, np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        return (a - mean.reshape(-1, 1, 1)) / std.reshape(-1, 1, 1)
+    if to_rgb:
+        a = a[..., ::-1]
+    return (a - mean) / std
+
+
+def to_grayscale(img, num_output_channels=1):
+    """ITU-R 601-2 luma (reference functional to_grayscale)."""
+    a = _hwc(img)
+    if a.shape[2] == 1:
+        gray = a[:, :, 0].astype(np.float32)
+    else:
+        gray = (0.299 * a[..., 0] + 0.587 * a[..., 1]
+                + 0.114 * a[..., 2]).astype(np.float32)
+    out = np.repeat(gray[:, :, None], num_output_channels, axis=2)
+    return _restore_dtype(out, img)
+
+
+# ---- photometric adjustments ----------------------------------------------
+
+
+def _blend(img1, img2, ratio):
+    out = ratio * img1.astype(np.float32) + (1.0 - ratio) * img2
+    return out
+
+
+def adjust_brightness(img, brightness_factor):
+    a = _hwc(img).astype(np.float32)
+    return _restore_dtype(
+        _blend(a, np.zeros_like(a), brightness_factor), img)
+
+
+def adjust_contrast(img, contrast_factor):
+    a = _hwc(img)
+    g = to_grayscale(a.astype(np.float32))
+    mean = float(np.round(g[..., 0].mean())) if np.asarray(img).dtype == \
+        np.uint8 else float(g[..., 0].mean())
+    return _restore_dtype(
+        _blend(a.astype(np.float32), mean, contrast_factor), img)
+
+
+def adjust_saturation(img, saturation_factor):
+    a = _hwc(img).astype(np.float32)
+    g = to_grayscale(a)
+    return _restore_dtype(_blend(a, g.astype(np.float32),
+                                 saturation_factor), img)
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue by ``hue_factor`` in [-0.5, 0.5] of a full cycle
+    (reference functional adjust_hue, HSV round-trip)."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError(f"hue_factor {hue_factor} is not in [-0.5, 0.5]")
+    a = _hwc(img)
+    if a.shape[2] < 3:
+        # grayscale has no hue — the reference returns it unchanged
+        return np.asarray(img)
+    f = a.astype(np.float32) / (255.0 if a.dtype == np.uint8 else 1.0)
+    r, g, b = f[..., 0], f[..., 1], f[..., 2]
+    mx, mn = f.max(-1), f.min(-1)
+    d = mx - mn
+    safe = np.where(d == 0, 1.0, d)
+    h = np.select(
+        [mx == r, mx == g],
+        [((g - b) / safe) % 6.0, (b - r) / safe + 2.0],
+        (r - g) / safe + 4.0) / 6.0
+    h = np.where(d == 0, 0.0, h)
+    s = np.where(mx == 0, 0.0, d / np.where(mx == 0, 1.0, mx))
+    h = (h + hue_factor) % 1.0
+    # HSV -> RGB
+    i = np.floor(h * 6.0)
+    fr = h * 6.0 - i
+    p = mx * (1 - s)
+    q = mx * (1 - s * fr)
+    t = mx * (1 - s * (1 - fr))
+    i = i.astype(np.int32) % 6
+    r2 = np.choose(i, [mx, q, p, p, t, mx])
+    g2 = np.choose(i, [t, mx, mx, q, p, p])
+    b2 = np.choose(i, [p, p, t, mx, mx, q])
+    out = np.stack([r2, g2, b2], -1)
+    if a.dtype == np.uint8:
+        out = out * 255.0
+    return _restore_dtype(out, img)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Set region [i:i+h, j:j+w] to v (reference functional erase).
+    Accepts HWC/CHW ndarrays or Tensors (CHW, the post-ToTensor case)."""
+    from ...core.tensor import Tensor
+
+    if isinstance(img, Tensor):
+        import jax.numpy as jnp
+
+        data = img._data
+        val = jnp.asarray(v, data.dtype)
+        if val.ndim == 1:                               # per-channel (CHW)
+            val = val.reshape(-1, 1, 1)
+        new = data.at[..., i:i + h, j:j + w].set(
+            jnp.broadcast_to(val, data[..., i:i + h, j:j + w].shape))
+        if inplace:
+            img._replace_data(new)
+            return img
+        return Tensor._from_data(new)
+    a = np.asarray(img)
+    out = a if inplace else a.copy()
+    v = np.asarray(v, a.dtype)
+    if a.ndim == 3 and a.shape[0] in (1, 3) and a.shape[0] <= a.shape[2]:
+        if v.ndim == 1:                                 # per-channel value
+            v = v.reshape(-1, 1, 1)
+        out[:, i:i + h, j:j + w] = v                    # CHW
+    else:
+        if v.ndim == 1 and a.ndim == 3:
+            v = v.reshape(1, 1, -1)
+        out[i:i + h, j:j + w] = v                       # HW(C)
+    return out
+
+
+# ---- geometric warps -------------------------------------------------------
+
+
+def _warp(img, inv, out_h, out_w, interpolation="nearest", fill=0):
+    """Sample output pixel centers through the inverse transform ``inv``
+    (3x3), zero-/fill-padded outside, nearest or bilinear."""
+    a = _hwc(img).astype(np.float32)
+    h, w, c = a.shape
+    ys, xs = np.meshgrid(np.arange(out_h, dtype=np.float64),
+                         np.arange(out_w, dtype=np.float64), indexing="ij")
+    ones = np.ones_like(xs)
+    src = inv @ np.stack([xs.ravel(), ys.ravel(), ones.ravel()])
+    sx = (src[0] / src[2]).reshape(out_h, out_w)
+    sy = (src[1] / src[2]).reshape(out_h, out_w)
+
+    fill_v = np.broadcast_to(np.asarray(fill, np.float32), (c,))
+    if interpolation == "nearest":
+        xi = np.round(sx).astype(np.int64)
+        yi = np.round(sy).astype(np.int64)
+        ok = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+        out = a[yi.clip(0, h - 1), xi.clip(0, w - 1)]
+        out = np.where(ok[..., None], out, fill_v)
+    else:
+        x0 = np.floor(sx).astype(np.int64)
+        y0 = np.floor(sy).astype(np.int64)
+        out = np.zeros((out_h, out_w, c), np.float32)
+        wsum = np.zeros((out_h, out_w, 1), np.float32)
+        for dy in (0, 1):
+            for dx in (0, 1):
+                xi, yi = x0 + dx, y0 + dy
+                wgt = ((1 - np.abs(sx - xi)) * (1 - np.abs(sy - yi)))
+                ok = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+                wgt = np.where(ok, wgt, 0.0)[..., None].astype(np.float32)
+                out += wgt * a[yi.clip(0, h - 1), xi.clip(0, w - 1)]
+                wsum += wgt
+        out = out + (1.0 - wsum) * fill_v
+    out = _restore_dtype(out, img)
+    return out if np.asarray(img).ndim == 3 else out[:, :, 0]
+
+
+def _affine_matrix(center, angle, translate, scale, shear):
+    """Forward affine about ``center``: translate . C . R(angle) .
+    Shear . Scale . C^-1 (the reference/torchvision composition; angles
+    in degrees)."""
+    rot = np.deg2rad(angle)
+    sx, sy = (np.deg2rad(s) for s in shear)
+    cx, cy = center
+    tx, ty = translate
+    # R * Shear^-1 convention of the reference: build RSS directly
+    a = np.cos(rot - sy) / np.cos(sy)
+    b = -np.cos(rot - sy) * np.tan(sx) / np.cos(sy) - np.sin(rot)
+    c = np.sin(rot - sy) / np.cos(sy)
+    d = -np.sin(rot - sy) * np.tan(sx) / np.cos(sy) + np.cos(rot)
+    m = np.array([[a * scale, b * scale, 0.0],
+                  [c * scale, d * scale, 0.0],
+                  [0.0, 0.0, 1.0]])
+    pre = np.array([[1, 0, cx + tx], [0, 1, cy + ty], [0, 0, 1.0]])
+    post = np.array([[1, 0, -cx], [0, 1, -cy], [0, 0, 1.0]])
+    return pre @ m @ post
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """Affine warp (reference functional affine): rotation ``angle`` deg,
+    pixel ``translate``, isotropic ``scale``, (sx, sy) ``shear`` deg."""
+    a = _hwc(img)
+    h, w = a.shape[:2]
+    if isinstance(shear, numbers.Number):
+        shear = (shear, 0.0)
+    if center is None:
+        center = ((w - 1) * 0.5, (h - 1) * 0.5)
+    fwd = _affine_matrix(center, angle, translate, scale, tuple(shear))
+    return _warp(img, np.linalg.inv(fwd), h, w, interpolation, fill)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """Rotate ``angle`` degrees counter-clockwise (reference functional
+    rotate); ``expand`` grows the canvas to hold the whole rotation
+    (ignoring any explicit center, as upstream)."""
+    a = _hwc(img)
+    h, w = a.shape[:2]
+    if center is None or expand:
+        center = ((w - 1) * 0.5, (h - 1) * 0.5)
+    fwd = _affine_matrix(center, -angle, (0, 0), 1.0, (0.0, 0.0))
+    out_h, out_w = h, w
+    if expand:
+        corners = np.array([[0, 0, 1], [w - 1, 0, 1],
+                            [0, h - 1, 1], [w - 1, h - 1, 1]]).T
+        mapped = fwd @ corners
+        xs, ys = mapped[0], mapped[1]
+        out_w = int(np.ceil(xs.max() - xs.min())) + 1
+        out_h = int(np.ceil(ys.max() - ys.min())) + 1
+        shift = np.array([[1, 0, -xs.min()], [0, 1, -ys.min()],
+                          [0, 0, 1.0]])
+        fwd = shift @ fwd
+    return _warp(img, np.linalg.inv(fwd), out_h, out_w, interpolation, fill)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """Projective warp mapping 4 ``startpoints`` to ``endpoints``
+    (reference functional perspective); points are (x, y)."""
+    a = _hwc(img)
+    h, w = a.shape[:2]
+    A, rhs = [], []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        A.append([sx, sy, 1, 0, 0, 0, -ex * sx, -ex * sy])
+        A.append([0, 0, 0, sx, sy, 1, -ey * sx, -ey * sy])
+        rhs += [ex, ey]
+    coef = np.linalg.lstsq(np.asarray(A, np.float64),
+                           np.asarray(rhs, np.float64), rcond=None)[0]
+    fwd = np.append(coef, 1.0).reshape(3, 3)
+    return _warp(img, np.linalg.inv(fwd), h, w, interpolation, fill)

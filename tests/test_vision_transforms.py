@@ -1,0 +1,258 @@
+"""Round-4 vision.transforms closure: the full reference __all__ resolves
+and the new functional ops match independent oracles (PIL for geometry —
+the reference's own backend — and formula oracles for photometry)."""
+
+import ast
+import random
+
+import numpy as np
+import pytest
+
+import paddlepaddle_tpu as paddle
+from paddlepaddle_tpu.vision import transforms as T
+from paddlepaddle_tpu.vision.transforms import functional as F
+
+rng = np.random.default_rng(4)
+IMG = rng.integers(0, 255, (12, 10, 3)).astype(np.uint8)
+
+
+def test_transforms_namespace_complete():
+    tree = ast.parse(open(
+        "/root/reference/python/paddle/vision/transforms/__init__.py").read())
+    names = next(
+        [ast.literal_eval(e) for e in n.value.elts]
+        for n in ast.walk(tree)
+        if isinstance(n, ast.Assign)
+        and getattr(n.targets[0], "id", "") == "__all__")
+    missing = [n for n in names if not hasattr(T, n)]
+    assert not missing, missing
+
+
+def test_flips_crops_pad():
+    np.testing.assert_array_equal(F.hflip(IMG), IMG[:, ::-1])
+    np.testing.assert_array_equal(F.vflip(IMG), IMG[::-1])
+    np.testing.assert_array_equal(F.crop(IMG, 2, 3, 4, 5),
+                                  IMG[2:6, 3:8])
+    np.testing.assert_array_equal(F.center_crop(IMG, 6),
+                                  IMG[3:9, 2:8])
+    p = F.pad(IMG, (1, 2, 3, 4), fill=7)
+    assert p.shape == (12 + 2 + 4, 10 + 1 + 3, 3)
+    assert (p[0] == 7).all() and (p[:, 0] == 7).all()
+    np.testing.assert_array_equal(p[2:14, 1:11], IMG)
+    e = F.pad(IMG, 2, padding_mode="reflect")
+    np.testing.assert_array_equal(e[2:14, 2:12], IMG)
+    np.testing.assert_array_equal(e[1], e[3])        # reflect symmetry
+    # per-channel tuple fill (reference: R, G, B)
+    rgb = F.pad(IMG, 1, fill=(9, 8, 7))
+    assert rgb[0, 0].tolist() == [9, 8, 7]
+
+
+def test_photometric_oracles():
+    f = IMG.astype(np.float32)
+    np.testing.assert_array_equal(
+        F.adjust_brightness(IMG, 0.5),
+        np.clip(np.round(f * 0.5), 0, 255).astype(np.uint8))
+    gray = 0.299 * f[..., 0] + 0.587 * f[..., 1] + 0.114 * f[..., 2]
+    np.testing.assert_array_equal(
+        F.to_grayscale(IMG)[:, :, 0],
+        np.clip(np.round(gray), 0, 255).astype(np.uint8))
+    mean = round(float(np.round(gray).mean()))
+    want = np.clip(np.round(0.3 * f + 0.7 * mean), 0, 255).astype(np.uint8)
+    np.testing.assert_allclose(F.adjust_contrast(IMG, 0.3).astype(int),
+                               want.astype(int), atol=1)
+    sat = np.clip(np.round(0.4 * f + 0.6 * np.round(gray)[..., None]),
+                  0, 255).astype(np.uint8)
+    np.testing.assert_allclose(F.adjust_saturation(IMG, 0.4).astype(int),
+                               sat.astype(int), atol=1)
+    # hue: 0 is identity; +1/3 turns pure red into pure green
+    np.testing.assert_allclose(F.adjust_hue(IMG, 0.0).astype(int),
+                               IMG.astype(int), atol=1)
+    red = np.zeros((2, 2, 3), np.uint8)
+    red[..., 0] = 255
+    g = F.adjust_hue(red, 1.0 / 3)
+    assert (g[..., 1] == 255).all() and (g[..., 0] == 0).all()
+    with pytest.raises(ValueError):
+        F.adjust_hue(IMG, 0.7)
+    # grayscale images pass through hue unchanged (reference PIL backend)
+    gray2d = IMG[..., 0]
+    np.testing.assert_array_equal(F.adjust_hue(gray2d, 0.2), gray2d)
+
+
+def test_geometry_matches_pil():
+    from PIL import Image
+
+    img = rng.integers(0, 255, (16, 16)).astype(np.uint8)
+    for angle in (33, -57, 90):
+        ours = F.rotate(img, angle, fill=0)
+        ref = np.asarray(Image.fromarray(img).rotate(
+            angle, resample=Image.NEAREST, fillcolor=0))
+        assert (ours != ref).mean() < 0.02, angle
+    # expand grows the canvas to hold the rotation
+    ex = F.rotate(img, 45, expand=True)
+    assert ex.shape[0] > 16 and ex.shape[1] > 16
+    ref = np.asarray(Image.fromarray(img).rotate(
+        45, resample=Image.NEAREST, expand=True))
+    assert abs(ex.shape[0] - ref.shape[0]) <= 1
+
+    # affine identity and integer translation
+    np.testing.assert_array_equal(
+        F.affine(img, 0, (0, 0), 1.0, (0, 0)), img)
+    t = F.affine(img, 0, (2, 3), 1.0, (0, 0), fill=0)
+    np.testing.assert_array_equal(t[3:, 2:], img[:-3, :-2])
+    assert (t[:3] == 0).all() and (t[:, :2] == 0).all()
+
+    # perspective: identity points -> identity; PIL cross-check
+    pts = [(0, 0), (15, 0), (15, 15), (0, 15)]
+    np.testing.assert_array_equal(F.perspective(img, pts, pts), img)
+
+
+def test_erase_and_tensor_paths():
+    chw = rng.standard_normal((3, 8, 8)).astype(np.float32)
+    out = F.erase(chw.copy(), 2, 3, 4, 2, 9.0)
+    assert (out[:, 2:6, 3:5] == 9.0).all()
+    assert (out[:, :2] == chw[:, :2]).all()
+    t = paddle.to_tensor(chw)
+    to = F.erase(t, 1, 1, 2, 2, 0.0)
+    assert (to.numpy()[:, 1:3, 1:3] == 0).all()
+    tt = F.to_tensor(IMG)
+    assert tt.shape == [3, 12, 10]
+    np.testing.assert_allclose(tt.numpy(),
+                               IMG.transpose(2, 0, 1) / 255.0, rtol=1e-6)
+
+
+def test_random_transform_classes():
+    random.seed(0)
+    rrc = T.RandomResizedCrop(8)(IMG)
+    assert rrc.shape == (8, 8, 3)
+    assert T.RandomVerticalFlip(prob=1.0)(IMG).tolist() == \
+        IMG[::-1].tolist()
+    assert T.Grayscale(3)(IMG).shape == (12, 10, 3)
+    assert T.Pad(2)(IMG).shape == (16, 14, 3)
+    jit = T.ColorJitter(0.4, 0.4, 0.4, 0.1)(IMG)
+    assert jit.shape == IMG.shape
+    rot = T.RandomRotation(30)(IMG)
+    assert rot.shape == IMG.shape
+    aff = T.RandomAffine(10, translate=(0.1, 0.1), scale=(0.8, 1.2),
+                         shear=5)(IMG)
+    assert aff.shape == IMG.shape
+    per = T.RandomPerspective(prob=1.0)(IMG)
+    assert per.shape == IMG.shape
+    random.seed(1)
+    chw = np.ones((3, 16, 16), np.float32)
+    er = T.RandomErasing(prob=1.0)(chw)
+    assert (er == 0).any() and er.shape == chw.shape
+    # per-channel value and 'random' per-pixel noise (reference contract)
+    random.seed(2)
+    erc = T.RandomErasing(prob=1.0, value=[5.0, 6.0, 7.0])(chw)
+    region = erc != chw
+    assert region.any() and (erc[0][region[0]] == 5.0).all()
+    random.seed(3)
+    ern = T.RandomErasing(prob=1.0, value="random")(chw)
+    patch = ern[ern != chw]
+    assert patch.size > 1 and np.unique(patch).size > 1   # noise, not const
+    # tuple-range jitter parameters accepted (reference _check_input)
+    assert T.ColorJitter(brightness=(0.9, 1.1),
+                         hue=(-0.1, 0.1))(IMG).shape == IMG.shape
+    with pytest.raises(ValueError):
+        T.HueTransform(0.7)
+    # Compose chains the new classes end to end
+    pipe = T.Compose([T.RandomResizedCrop(8), T.ColorJitter(0.2, 0.2),
+                      T.ToTensor()])
+    assert tuple(pipe(IMG).shape) == (3, 8, 8)
+
+
+# ---- round-4 vision.datasets closure ---------------------------------------
+
+
+def _write_png(path, arr):
+    from PIL import Image
+
+    Image.fromarray(arr).save(path)
+
+
+def test_dataset_and_image_folder(tmp_path):
+    from paddlepaddle_tpu.vision.datasets import DatasetFolder, ImageFolder
+
+    for ci, cls in enumerate(["ants", "bees"]):
+        d = tmp_path / "root" / cls
+        d.mkdir(parents=True)
+        for k in range(2):
+            _write_png(str(d / f"{k}.png"),
+                       np.full((4, 4, 3), 40 * ci + k, np.uint8))
+    ds = DatasetFolder(str(tmp_path / "root"))
+    assert ds.classes == ["ants", "bees"]
+    assert len(ds) == 4
+    img, label = ds[3]
+    assert label == 1 and img[0, 0, 0] == 41
+    tds = DatasetFolder(str(tmp_path / "root"),
+                        transform=lambda x: x.astype(np.float32) / 255)
+    assert tds[0][0].dtype == np.float32
+
+    flat = ImageFolder(str(tmp_path / "root"))
+    assert len(flat) == 4 and flat[0][0].shape == (4, 4, 3)
+    with pytest.raises(RuntimeError, match="Found 0"):
+        ImageFolder(str(tmp_path), extensions=(".xyz",))
+
+
+def test_fashion_mnist_and_cifar100(tmp_path):
+    import pickle
+    import struct
+
+    from paddlepaddle_tpu.vision.datasets import Cifar100, FashionMNIST
+
+    imgs = np.arange(2 * 28 * 28, dtype=np.uint8).reshape(2, 28, 28)
+    with open(tmp_path / "imgs", "wb") as f:
+        f.write(struct.pack(">IIII", 2051, 2, 28, 28) + imgs.tobytes())
+    with open(tmp_path / "lbls", "wb") as f:
+        f.write(struct.pack(">II", 2049, 2) + bytes([3, 7]))
+    ds = FashionMNIST(image_path=str(tmp_path / "imgs"),
+                      label_path=str(tmp_path / "lbls"))
+    assert len(ds) == 2 and ds[1][1] == 7
+    np.testing.assert_array_equal(ds[0][0], imgs[0])
+
+    data = np.arange(3 * 3072, dtype=np.uint8).reshape(3, 3072)
+    with open(tmp_path / "train", "wb") as f:
+        pickle.dump({b"data": data, b"fine_labels": [5, 9, 11]}, f)
+    c100 = Cifar100(data_file=str(tmp_path), mode="train")
+    assert len(c100) == 3
+    img, lbl = c100[2]
+    assert img.shape == (3, 32, 32) and lbl == 11
+
+
+def test_flowers_and_voc2012(tmp_path):
+    import scipy.io
+
+    from paddlepaddle_tpu.vision.datasets import VOC2012, Flowers
+
+    jpg_dir = tmp_path / "jpg"
+    jpg_dir.mkdir()
+    for i in (1, 2, 3):
+        _write_png(str(jpg_dir / f"image_{i:05d}.jpg"),
+                   np.full((6, 6, 3), i, np.uint8))
+    scipy.io.savemat(tmp_path / "imagelabels.mat",
+                     {"labels": np.array([[4, 5, 6]])})
+    scipy.io.savemat(tmp_path / "setid.mat",
+                     {"trnid": np.array([[2, 3]]), "valid": np.array([[1]]),
+                      "tstid": np.array([[1]])})
+    ds = Flowers(data_file=str(jpg_dir),
+                 label_file=str(tmp_path / "imagelabels.mat"),
+                 setid_file=str(tmp_path / "setid.mat"), mode="train")
+    assert len(ds) == 2
+    img, lbl = ds[0]
+    assert img[0, 0, 0] == 2 and lbl == 4  # image 2, label 5 -> 0-based 4
+
+    voc = tmp_path / "VOC2012"
+    (voc / "ImageSets" / "Segmentation").mkdir(parents=True)
+    (voc / "JPEGImages").mkdir()
+    (voc / "SegmentationClass").mkdir()
+    (voc / "ImageSets" / "Segmentation" / "train.txt").write_text(
+        "a\nb\n")
+    for n in ("a", "b"):
+        _write_png(str(voc / "JPEGImages" / f"{n}.jpg"),
+                   np.zeros((5, 5, 3), np.uint8))
+        _write_png(str(voc / "SegmentationClass" / f"{n}.png"),
+                   np.ones((5, 5, 3), np.uint8))
+    vds = VOC2012(data_file=str(voc), mode="train")
+    assert len(vds) == 2
+    img, seg = vds[0]
+    assert img.shape == (5, 5, 3) and (seg == 1).all()

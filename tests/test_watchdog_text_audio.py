@@ -235,12 +235,14 @@ def test_text_local_datasets(tmp_path):
                                test_ratio=0.0)
     assert ds[0] == (1, 10, 5.0)
 
-    # WMT tab-parallel corpus builds dicts with <s>/<e>/<unk>
+    # WMT tab-parallel corpus: reference 3-tuple samples
+    # (src, <s>+trg, trg+<e>) and dict with <s>/<e>/<unk> specials
     par = tmp_path / "par.tsv"
     par.write_text("hello world\tbonjour monde\nbye world\tau revoir\n")
-    ds = paddle.text.WMT14(data_file=str(par))
-    src, trg = ds[0]
-    assert trg[0] == 0 and trg[-1] == 1          # <s> ... <e>
+    ds = paddle.text.WMT14(data_file=str(par), dict_size=50)
+    src, trg, trg_next = ds[0]
+    assert trg[0] == 0 and trg_next[-1] == 1     # <s> prefix / <e> suffix
+    np.testing.assert_array_equal(trg[1:], trg_next[:-1])
     assert paddle.text.WMT16(data_file=str(par)).src_dict["<unk>"] == 2
     # dict_size caps the TOTAL size including the 3 specials
     assert len(paddle.text.WMT16(data_file=str(par),
