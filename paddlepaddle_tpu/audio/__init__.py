@@ -3,4 +3,5 @@
 Spectrogram/MelSpectrogram/MFCC over the framework's fft ops (XLA-lowered).
 """
 
-from . import backends, features, functional  # noqa: F401
+from . import backends, datasets, features, functional  # noqa: F401
+from .backends import info, load, save  # noqa: F401

@@ -1,0 +1,121 @@
+"""paddle.audio.datasets (reference: python/paddle/audio/datasets/ — the
+AudioClassificationDataset base with ESC50 and TESS). Zero-egress
+environment: both parse a LOCAL copy of the official layout (pass
+``data_dir``); features follow the same raw/mfcc/logmelspectrogram/
+melspectrogram/spectrogram switch the reference base implements."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["AudioClassificationDataset", "ESC50", "TESS"]
+
+
+class AudioClassificationDataset(Dataset):
+    """(file, label) pairs with on-access feature extraction (reference
+    audio/datasets/dataset.py: feat_type in raw / mfcc / spectrogram /
+    melspectrogram / logmelspectrogram)."""
+
+    def __init__(self, files, labels, feat_type="raw", sample_rate=None,
+                 **feat_config):
+        if feat_type not in ("raw", "mfcc", "spectrogram", "melspectrogram",
+                             "logmelspectrogram"):
+            raise ValueError(f"Unknown feat_type: {feat_type}")
+        self.files = list(files)
+        self.labels = list(labels)
+        self.feat_type = feat_type
+        self.feat_config = feat_config
+        self.sample_rate = sample_rate
+
+    def _feature(self, wav, sr):
+        if self.feat_type == "raw":
+            return wav.astype(np.float32)
+        import paddlepaddle_tpu as paddle
+
+        x = paddle.to_tensor(wav[None, :].astype(np.float32))
+        feats = paddle.audio.features
+        if self.feat_type == "mfcc":
+            layer = feats.MFCC(sr=sr, **self.feat_config)
+        elif self.feat_type == "spectrogram":
+            layer = feats.Spectrogram(**self.feat_config)
+        elif self.feat_type == "melspectrogram":
+            layer = feats.MelSpectrogram(sr=sr, **self.feat_config)
+        else:
+            layer = feats.LogMelSpectrogram(sr=sr, **self.feat_config)
+        return layer(x).numpy()[0]
+
+    def __getitem__(self, idx):
+        from . import backends
+
+        wav, sr = backends.load(self.files[idx])
+        wav = np.asarray(wav)
+        if wav.ndim > 1:
+            wav = wav[0]
+        return self._feature(wav, self.sample_rate or sr), \
+            np.int64(self.labels[idx])
+
+    def __len__(self):
+        return len(self.files)
+
+
+class ESC50(AudioClassificationDataset):
+    """ESC-50 (reference audio/datasets/esc50.py:43): filenames are
+    ``{fold}-{src}-{take}-{target}.wav``; ``mode='dev'`` keeps fold ==
+    ``split``, train keeps the rest."""
+
+    def __init__(self, mode="train", split=1, feat_type="raw",
+                 data_dir=None, archive=None, **kw):
+        if data_dir is None:
+            raise RuntimeError(
+                "ESC50: downloads are unavailable (zero-egress); pass "
+                "data_dir pointing at the audio/ directory of a local copy")
+        files, labels = [], []
+        for fn in sorted(os.listdir(data_dir)):
+            if not fn.endswith(".wav"):
+                continue
+            parts = os.path.splitext(fn)[0].split("-")
+            fold, target = int(parts[0]), int(parts[-1])
+            keep = (fold == split) if mode != "train" else (fold != split)
+            if keep:
+                files.append(os.path.join(data_dir, fn))
+                labels.append(target)
+        super().__init__(files, labels, feat_type, **kw)
+
+
+class TESS(AudioClassificationDataset):
+    """TESS (reference audio/datasets/tess.py:30): emotion is the last
+    ``_``-separated token of the filename; round-robin n-fold split."""
+
+    archive = None
+    speakers = ["OAF", "YAF"]
+    emotions = ["angry", "disgust", "fear", "happy", "neutral", "ps", "sad"]
+
+    def __init__(self, mode="train", n_folds=5, split=1, feat_type="raw",
+                 data_dir=None, archive=None, **kw):
+        if not 1 <= split <= n_folds:
+            raise ValueError(f"split {split} not in [1, {n_folds}]")
+        if data_dir is None:
+            raise RuntimeError(
+                "TESS: downloads are unavailable (zero-egress); pass "
+                "data_dir pointing at a local copy of the wav tree")
+        wavs = []
+        for base, _, fnames in sorted(os.walk(data_dir)):
+            for fn in sorted(fnames):
+                if fn.lower().endswith(".wav"):
+                    wavs.append(os.path.join(base, fn))
+        files, labels = [], []
+        for i, path in enumerate(wavs):
+            emo = os.path.splitext(os.path.basename(path))[0] \
+                .split("_")[-1].lower()
+            if emo not in self.emotions:
+                continue
+            fold = i % n_folds + 1
+            keep = (fold == split) if mode != "train" else (fold != split)
+            if keep:
+                files.append(path)
+                labels.append(self.emotions.index(emo))
+        super().__init__(files, labels, feat_type, **kw)

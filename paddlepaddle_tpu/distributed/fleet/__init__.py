@@ -168,3 +168,80 @@ distributed_optimizer = fleet.distributed_optimizer
 worker_index = fleet.worker_index
 is_first_worker = fleet.is_first_worker
 barrier_worker = fleet.barrier_worker
+
+# reference fleet/__init__.py re-exports: role makers, util, generators
+Fleet = _Fleet
+from .base.role_maker import (  # noqa: E402,F401
+    PaddleCloudRoleMaker,
+    Role,
+    UserDefinedRoleMaker,
+)
+from .data_generator import (  # noqa: E402,F401
+    MultiSlotDataGenerator,
+    MultiSlotStringDataGenerator,
+)
+
+
+class UtilBase:
+    """fleet.UtilBase (reference fleet/base/util_factory.py:64): rank
+    utilities over the collective world — here the host-collective group
+    plays the comm_world role for 'worker'/'all'."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import numpy as np
+
+        from .. import ReduceOp, get_world_size
+        from .. import all_reduce as _ar
+
+        if mode not in ("sum", "min", "max"):
+            raise ValueError(f"unknown all_reduce mode {mode}")
+        if get_world_size() <= 1:
+            return np.asarray(input)
+        import paddlepaddle_tpu as paddle
+
+        t = paddle.to_tensor(np.asarray(input))
+        _ar(t, op={"sum": ReduceOp.SUM, "min": ReduceOp.MIN,
+                   "max": ReduceOp.MAX}[mode])
+        return t.numpy()
+
+    def barrier(self, comm_world="worker"):
+        from .. import barrier as _barrier
+        from .. import get_world_size
+
+        if get_world_size() > 1:
+            _barrier()
+
+    def all_gather(self, input, comm_world="worker"):
+        from .. import all_gather_object, get_world_size
+
+        if get_world_size() <= 1:
+            return [input]
+        out = []
+        all_gather_object(out, input)
+        return out
+
+    def get_file_shard(self, files):
+        """Contiguous per-rank file split (reference get_file_shard:
+        earlier ranks take the remainder)."""
+        if not isinstance(files, list):
+            raise TypeError("files should be a list of file need to be read.")
+        from .. import get_rank, get_world_size
+
+        trainer_id, trainers = get_rank(), max(get_world_size(), 1)
+        blocks = len(files) // trainers
+        remainder = len(files) % trainers
+        begin = 0
+        for i in range(trainer_id):
+            begin += blocks + (1 if i < remainder else 0)
+        length = blocks + (1 if trainer_id < remainder else 0)
+        return files[begin:begin + length]
+
+    def print_on_rank(self, message, rank_id):
+        from .. import get_rank
+
+        if get_rank() == rank_id:
+            print(message)
+
+
+util = UtilBase()
+_Fleet.util = util

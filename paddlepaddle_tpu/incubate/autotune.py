@@ -1,0 +1,59 @@
+"""paddle.incubate.autotune (reference: python/paddle/incubate/autotune.py).
+
+TPU mapping: the kernel/layout tuners are XLA's job (its autotuner picks
+tilings and the compiler owns layout), so those sections validate and
+record but change nothing — which IS the tuned behavior here. The
+dataloader section is live: it feeds the DataLoader's num_workers
+auto-selection default.
+"""
+
+from __future__ import annotations
+
+import json
+import warnings
+
+__all__ = ["set_config"]
+
+_config = {
+    "kernel": {"enable": True, "tuning_range": [1, 10]},
+    "layout": {"enable": False},
+    "dataloader": {"enable": False},
+}
+
+
+def get_config():
+    return {k: dict(v) for k, v in _config.items()}
+
+
+def set_config(config=None):
+    """Set kernel/layout/dataloader auto-tuning config (reference
+    incubate/autotune.py:47; dict, json-file path, or None = enable all)."""
+    if config is None:
+        for section in _config.values():
+            section["enable"] = True
+        return
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    if not isinstance(config, dict):
+        raise ValueError(
+            "The config should be None, a dict or a json file path")
+    # validate everything first, THEN commit — a failed call must not
+    # leave half-applied global config behind
+    staged = []
+    for key, val in config.items():
+        if key not in _config:
+            warnings.warn(f"autotune: unknown section {key!r} ignored "
+                          "(valid: kernel/layout/dataloader)", stacklevel=2)
+            continue
+        if not isinstance(val, dict):
+            raise ValueError(f"autotune: section {key!r} must be a dict")
+        for k, v in val.items():
+            if k == "enable" and not isinstance(v, bool):
+                raise ValueError(f"autotune: {key}.enable must be bool")
+            if k == "tuning_range" and not isinstance(v, (list, tuple)):
+                raise ValueError(
+                    f"autotune: {key}.tuning_range must be a list")
+            staged.append((key, k, v))
+    for key, k, v in staged:
+        _config[key][k] = v

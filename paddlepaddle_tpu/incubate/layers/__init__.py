@@ -1,0 +1,122 @@
+"""paddle.incubate.layers (reference: python/paddle/incubate/layers/nn.py —
+legacy static-graph helper ops; its public ``__all__`` is empty). The
+generic tensor helpers are implemented; the PS-stack ops (sparse pulls,
+TDM tree sampling, pyramid hash) stay out of TPU-v1 scope with the rest
+of the parameter-server runtime (SURVEY §2.10) and raise by name."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op
+from ...core import random as prandom
+
+__all__ = []
+
+
+def partial_concat(input, start_index=0, length=-1):
+    """Concat the [start:start+length] column slice of every input
+    (reference incubate/layers/nn.py partial_concat)."""
+
+    def f(*xs):
+        outs = []
+        for x in xs:
+            end = x.shape[1] if length < 0 else start_index + length
+            outs.append(x[:, start_index:end])
+        return jnp.concatenate(outs, axis=1)
+
+    return apply_op(f, *input, op_name="partial_concat")
+
+
+def partial_sum(input, start_index=0, length=-1):
+    """Sum the same column slice across inputs (reference partial_sum)."""
+
+    def f(*xs):
+        end = xs[0].shape[1] if length < 0 else start_index + length
+        acc = xs[0][:, start_index:end]
+        for x in xs[1:]:
+            acc = acc + x[:, start_index:end]
+        return acc
+
+    return apply_op(f, *input, op_name="partial_sum")
+
+
+def shuffle_batch(x, seed=None):
+    """Random row permutation (reference shuffle_batch)."""
+
+    def f(v):
+        key = jax.random.PRNGKey(seed) if seed is not None \
+            else prandom.next_key()
+        return v[jax.random.permutation(key, v.shape[0])]
+
+    return apply_op(f, x, op_name="shuffle_batch")
+
+
+def batch_fc(input, param_size, param_attr, bias_size, bias_attr, act=None):
+    """Per-slot batched FC (reference batch_fc): input [slot, B, in],
+    weight [slot, in, out], bias [slot, 1, out]."""
+    import paddlepaddle_tpu as paddle
+
+    w = paddle.create_parameter(shape=param_size, dtype="float32",
+                                attr=param_attr)
+    b = paddle.create_parameter(shape=bias_size, dtype="float32",
+                                attr=bias_attr)
+
+    def f(x, w, b):
+        out = jnp.einsum("sbi,sio->sbo", x, w) + b
+        return jax.nn.relu(out) if act == "relu" else out
+
+    return apply_op(f, input, w, b, op_name="batch_fc")
+
+
+def fused_bn_add_act(x, y, momentum=0.9, epsilon=1e-5, **kw):
+    """batch_norm(x) + y |> relu (reference fused_bn_add_act; XLA fuses
+    the chain on TPU, so this is the composition, not a kernel)."""
+
+    def f(xb, yb):
+        mean = xb.mean((0, 2, 3), keepdims=True)
+        var = xb.var((0, 2, 3), keepdims=True)
+        norm = (xb - mean) * jax.lax.rsqrt(var + epsilon)
+        return jax.nn.relu(norm + yb)
+
+    return apply_op(f, x, y, op_name="fused_bn_add_act")
+
+
+def pow2_decay_with_linear_warmup(warmup_steps, total_steps, base_lr, end_lr,
+                                  dtype="float32", name=None):
+    """LR schedule value factory (reference pow2_decay_with_linear_warmup):
+    linear warmup then (1 - t)^2 decay to end_lr. Returns a step->lr
+    callable (the reference builds a global-step op graph)."""
+    if total_steps <= warmup_steps:
+        raise ValueError("total_steps must exceed warmup_steps")
+
+    def lr_at(step):
+        if step < warmup_steps:
+            return base_lr * (step / max(warmup_steps, 1))
+        t = min(step - warmup_steps, total_steps - warmup_steps)
+        frac = 1.0 - t / (total_steps - warmup_steps)
+        return (base_lr - end_lr) * frac * frac + end_lr
+
+    return lr_at
+
+
+def _ps_only(name):
+    def fn(*a, **k):
+        raise NotImplementedError(
+            f"{name} belongs to the parameter-server stack "
+            "(paddle/fluid/distributed/ps/), which is documented out of "
+            "TPU-v1 scope (SURVEY §2.10)")
+
+    fn.__name__ = name
+    return fn
+
+
+_pull_box_sparse = _ps_only("_pull_box_sparse")
+_pull_gpups_sparse = _ps_only("_pull_gpups_sparse")
+fused_seqpool_cvm = _ps_only("fused_seqpool_cvm")
+search_pyramid_hash = _ps_only("search_pyramid_hash")
+tdm_child = _ps_only("tdm_child")
+tdm_sampler = _ps_only("tdm_sampler")
+rank_attention = _ps_only("rank_attention")
+correlation = _ps_only("correlation")

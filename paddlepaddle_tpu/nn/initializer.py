@@ -207,3 +207,41 @@ def calculate_gain(nonlinearity, param=None):
         "selu": 3.0 / 4,
     }
     return gains[nonlinearity]
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel init for transposed convs (reference
+    nn/initializer/bilinear.py:33): every KxK channel slice gets the same
+    interpolation kernel (1-|x/f - c|)(1-|y/f - c|), f = ceil(K/2),
+    c = (2f - 1 - f%2)/(2f)."""
+
+    def __call__(self, shape, dtype):
+        import numpy as np
+
+        if len(shape) < 2:
+            raise ValueError("Bilinear init needs a rank >= 2 filter shape")
+        kh, kw = shape[-2], shape[-1]
+        f = int(np.ceil(kw / 2.0))
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        x = np.arange(kw)
+        y = np.arange(kh)
+        k2d = ((1 - np.abs(x / f - c))[None, :]
+               * (1 - np.abs(y / f - c))[:, None]).astype(np.float32)
+        return jnp.broadcast_to(jnp.asarray(k2d), tuple(shape)).astype(dtype)
+
+
+_global_weight_init = None
+_global_bias_init = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Reference nn/initializer/__init__.py set_global_initializer: a
+    process-wide default pair consulted by create_parameter when neither
+    attr nor default_initializer pins one; call with None to reset."""
+    global _global_weight_init, _global_bias_init
+    if weight_init is not None and not isinstance(weight_init, Initializer):
+        raise TypeError("weight_init must be an Initializer or None")
+    if bias_init is not None and not isinstance(bias_init, Initializer):
+        raise TypeError("bias_init must be an Initializer or None")
+    _global_weight_init = weight_init
+    _global_bias_init = bias_init

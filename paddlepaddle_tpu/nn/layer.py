@@ -125,6 +125,13 @@ class Layer:
         elif attr is not None and attr is not True:
             init = _resolve_initializer(attr)
         if init is None:
+            # priority (reference base/initializer.py set_global_initializer):
+            # ParamAttr init > global init > the layer's default init
+            from . import initializer as _ini
+
+            init = (_ini._global_bias_init if is_bias
+                    else _ini._global_weight_init)
+        if init is None:
             init = default_initializer or (Constant(0.0) if is_bias else XavierNormal())
         data = init(tuple(shape), dtype)
         p = Parameter(data, trainable=trainable, name=name)

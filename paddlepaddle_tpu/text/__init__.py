@@ -251,14 +251,15 @@ class _ParallelCorpus(_LocalOnlyDataset):
 
     _FMT = "UTF-8 lines of 'source<TAB>target'"
 
-    def _build(self, data_file, src_dict_size, trg_dict_size):
+    def _build(self, data_file, src_dict_size, trg_dict_size, swap=False):
         self._need(data_file)
         pairs = []
         with open(data_file, encoding="utf-8") as f:
             for line in f:
                 if "\t" in line:
                     s, t = line.rstrip("\n").split("\t", 1)
-                    pairs.append((s.split(), t.split()))
+                    pairs.append((t.split(), s.split()) if swap
+                                 else (s.split(), t.split()))
 
         def build(texts, cap):
             freq = {}
@@ -313,7 +314,10 @@ class WMT16(_ParallelCorpus):
 
     def __init__(self, data_file=None, mode="train", src_dict_size=-1,
                  trg_dict_size=-1, lang="en", download=False):
-        self._build(data_file, src_dict_size, trg_dict_size)
+        # lang picks the SOURCE side (reference wmt16.py): the local file
+        # is en<TAB>de, so lang="de" swaps the columns (and dict sizes)
+        self._build(data_file, src_dict_size, trg_dict_size,
+                    swap=(lang != "en"))
 
 
 class Conll05st(_LocalOnlyDataset):

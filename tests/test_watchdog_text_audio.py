@@ -308,3 +308,168 @@ def test_sysconfig_and_utils_tail(capsys):
 
     paddle.utils.run_check()
     assert "installed successfully" in capsys.readouterr().out
+
+
+# ---- round-4 sweep tail: fleet utils, initializer, audio datasets, ---------
+# ---- incubate autotune/layers ----------------------------------------------
+
+
+def test_fleet_data_generators_and_util():
+    import numpy as np
+
+    import paddlepaddle_tpu as paddle
+    from paddlepaddle_tpu.distributed import fleet
+
+    class G(fleet.MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def gen():
+                toks = line.split()
+                yield [("words", toks[:-1]), ("label", [toks[-1]])]
+
+            return gen
+
+    g = G()
+    lines = g.run_from_memory(["1926 08 17 1", "3 4 0"])
+    assert lines[0] == "3 1926 08 17 1 1\n"
+    assert lines[1] == "2 3 4 1 0\n"
+    with pytest.raises(ValueError, match="consistent"):
+        g._gen_str([("other", ["1"])])
+
+    util = fleet.UtilBase()
+    files = [f"f{i}" for i in range(7)]
+    assert util.get_file_shard(files) == files  # world size 1: all files
+    assert util.all_gather(5) == [5]
+    np.testing.assert_array_equal(util.all_reduce(np.ones(3)), np.ones(3))
+    assert isinstance(fleet.fleet, fleet.Fleet)
+    rm = fleet.UserDefinedRoleMaker(current_id=0, worker_num=1)
+    assert rm._is_worker() and fleet.Role.WORKER
+
+
+def test_bilinear_and_global_initializer():
+    import numpy as np
+
+    import paddlepaddle_tpu as paddle
+    from paddlepaddle_tpu.nn import initializer as I
+
+    k = I.Bilinear()((2, 1, 4, 4), "float32")
+    k = np.asarray(k)
+    # every channel identical, symmetric interpolation kernel, corner 1/16
+    np.testing.assert_allclose(k[0, 0], k[1, 0])
+    np.testing.assert_allclose(k[0, 0], k[0, 0][::-1, ::-1])
+    np.testing.assert_allclose(k[0, 0, 0, 0], 1.0 / 16)
+    np.testing.assert_allclose(k[0, 0, 1, 1], 9.0 / 16)
+
+    try:
+        I.set_global_initializer(I.Constant(3.0), I.Constant(-1.0))
+        lin = paddle.nn.Linear(2, 2)
+        assert (lin.weight.numpy() == 3.0).all()
+        assert (lin.bias.numpy() == -1.0).all()
+        # ParamAttr still wins over the global
+        lin2 = paddle.nn.Linear(
+            2, 2, weight_attr=I.ParamAttr(initializer=I.Constant(7.0)))
+        assert (lin2.weight.numpy() == 7.0).all()
+    finally:
+        I.set_global_initializer(None)
+    lin3 = paddle.nn.Linear(2, 2)
+    assert not (lin3.weight.numpy() == 3.0).all()
+    with pytest.raises(TypeError):
+        I.set_global_initializer(lambda s, d: None)
+
+
+def test_audio_datasets_local(tmp_path):
+    import numpy as np
+
+    import paddlepaddle_tpu as paddle
+
+    sr = 16000
+    wav = (0.1 * np.sin(2 * np.pi * 440 *
+                        np.arange(sr // 10) / sr)).astype(np.float32)
+    esc = tmp_path / "esc"
+    esc.mkdir()
+    for fold, target in ((1, 3), (2, 5), (3, 7)):
+        paddle.audio.save(str(esc / f"{fold}-1000-A-{target}.wav"),
+                          paddle.to_tensor(wav[None, :]), sr)
+    train = paddle.audio.datasets.ESC50(mode="train", split=1,
+                                        data_dir=str(esc))
+    dev = paddle.audio.datasets.ESC50(mode="dev", split=1,
+                                      data_dir=str(esc))
+    assert len(train) == 2 and len(dev) == 1
+    x, y = dev[0]
+    assert y == 3 and x.shape[0] == wav.shape[0]
+
+    tess = tmp_path / "tess"
+    tess.mkdir()
+    for i, emo in enumerate(["angry", "happy", "sad", "neutral", "fear"]):
+        paddle.audio.save(str(tess / f"OAF_word_{emo}.wav"),
+                          paddle.to_tensor(wav[None, :]), sr)
+    ds = paddle.audio.datasets.TESS(mode="train", n_folds=5, split=1,
+                                    data_dir=str(tess))
+    assert len(ds) == 4
+    feats = paddle.audio.datasets.TESS(
+        mode="dev", n_folds=5, split=1, data_dir=str(tess),
+        feat_type="melspectrogram", n_fft=256, n_mels=16)
+    x, _ = feats[0]
+    assert x.shape[0] == 16                       # mel bins
+    with pytest.raises(RuntimeError, match="zero-egress"):
+        paddle.audio.datasets.ESC50()
+
+
+def test_incubate_autotune_and_layers(tmp_path):
+    import json
+
+    import numpy as np
+
+    import paddlepaddle_tpu as paddle
+
+    at = paddle.incubate.autotune
+    at.set_config({"kernel": {"enable": True, "tuning_range": [1, 5]},
+                   "dataloader": {"enable": True}})
+    assert at.get_config()["kernel"]["tuning_range"] == [1, 5]
+    cfg = tmp_path / "tune.json"
+    cfg.write_text(json.dumps({"layout": {"enable": True}}))
+    at.set_config(str(cfg))
+    assert at.get_config()["layout"]["enable"]
+    with pytest.raises(ValueError):
+        at.set_config({"kernel": {"enable": "yes"}})
+
+    L = paddle.incubate.layers
+    a = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    b = paddle.to_tensor(10 + np.arange(6, dtype=np.float32).reshape(2, 3))
+    pc = L.partial_concat([a, b], start_index=1, length=2)
+    np.testing.assert_array_equal(pc.numpy(),
+                                  np.concatenate([a.numpy()[:, 1:3],
+                                                  b.numpy()[:, 1:3]], 1))
+    ps = L.partial_sum([a, b], start_index=0, length=2)
+    np.testing.assert_array_equal(ps.numpy(),
+                                  a.numpy()[:, :2] + b.numpy()[:, :2])
+    sh = L.shuffle_batch(a, seed=3)
+    assert sorted(sh.numpy()[:, 0].tolist()) == sorted(
+        a.numpy()[:, 0].tolist())
+    lr = L.pow2_decay_with_linear_warmup(10, 100, 0.1, 0.001)
+    assert lr(0) == 0.0 and abs(lr(10) - 0.1) < 1e-9 and \
+        abs(lr(100) - 0.001) < 1e-9
+    with pytest.raises(NotImplementedError, match="parameter-server"):
+        L.tdm_sampler()
+
+
+def test_wmt16_lang_swaps_direction(tmp_path):
+    import numpy as np
+
+    import paddlepaddle_tpu as paddle
+
+    par = tmp_path / "ende.tsv"
+    par.write_text("hello\thallo\nworld\twelt\n")
+    en = paddle.text.WMT16(data_file=str(par), lang="en")
+    de = paddle.text.WMT16(data_file=str(par), lang="de")
+    assert "hello" in en.src_dict and "hallo" in en.trg_dict
+    assert "hallo" in de.src_dict and "hello" in de.trg_dict
+
+
+def test_autotune_failed_call_leaves_config_untouched():
+    import paddlepaddle_tpu as paddle
+
+    at = paddle.incubate.autotune
+    before = at.get_config()
+    with pytest.raises(ValueError):
+        at.set_config({"kernel": {"tuning_range": [2, 9], "enable": "bad"}})
+    assert at.get_config() == before
