@@ -181,6 +181,7 @@ from . import quantization  # noqa: E402,F401
 from . import regularizer  # noqa: E402,F401
 from . import signal  # noqa: E402,F401
 from . import callbacks  # noqa: E402,F401
+from . import cost_model  # noqa: E402,F401
 from . import sparse  # noqa: E402,F401
 from . import static  # noqa: E402,F401
 from . import sysconfig  # noqa: E402,F401
@@ -494,3 +495,11 @@ from .ops import _patch_tensor_method_tail as _pmtt  # noqa: E402
 
 _pmtt(_sys_mod.modules[__name__])
 del _pmtt
+
+# reference nn.initializer package exposes LazyGuard via its lazy_init
+# submodule (nn/initializer/lazy_init.py); initializer here is a single
+# module, so mirror that path as attributes
+import types as _types_mod  # noqa: E402
+
+nn.initializer.LazyGuard = LazyGuard
+nn.initializer.lazy_init = _types_mod.SimpleNamespace(LazyGuard=LazyGuard)

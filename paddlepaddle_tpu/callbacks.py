@@ -7,4 +7,7 @@ from .hapi.callbacks import (  # noqa: F401
     LRScheduler,
     ModelCheckpoint,
     ProgBarLogger,
+    ReduceLROnPlateau,
+    VisualDL,
+    WandbCallback,
 )

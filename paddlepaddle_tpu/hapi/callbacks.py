@@ -138,3 +138,193 @@ class EarlyStopping(Callback):
             self.wait += 1
             if self.wait >= self.patience:
                 self.model.stop_training = True
+
+
+class ReduceLROnPlateau(Callback):
+    """Shrink the optimizer lr when a monitored metric plateaus
+    (reference hapi/callbacks.py:1274): after ``patience`` epochs without
+    ``min_delta`` improvement, lr <- max(lr * factor, min_lr), then hold
+    for ``cooldown`` epochs."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=0, cooldown=0, min_lr=0):
+        if factor >= 1.0:
+            raise ValueError("ReduceLROnPlateau does not support a "
+                             "factor >= 1.0")
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+        self._reset()
+
+    def _reset(self):
+        import numpy as np
+
+        self.best = -np.inf if self.mode == "max" else np.inf
+        self.wait = 0
+        self.cooldown_counter = 0
+
+    on_train_begin = lambda self, logs=None: self._reset()  # noqa: E731
+
+    def _better(self, cur):
+        if self.mode == "max":
+            return cur > self.best + self.min_delta
+        return cur < self.best - self.min_delta
+
+    def on_eval_end(self, logs=None):
+        # the reference hooks ONLY eval end (hapi/callbacks.py:1378);
+        # hooking epoch end too would double-count monitors that
+        # Model.fit merges into the epoch logs
+        self._check(logs)
+
+    def _check(self, logs):
+        logs = logs or {}
+        if self.monitor not in logs:
+            return
+        cur = logs[self.monitor]
+        if isinstance(cur, (list, tuple)):
+            cur = cur[0]
+        cur = float(cur)
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if self._better(cur):
+            self.best = cur
+            self.wait = 0
+            return
+        if self.cooldown_counter > 0:
+            return
+        self.wait += 1
+        if self.wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is None:
+                return
+            from ..optimizer.lr import LRScheduler as Sched
+
+            if isinstance(opt._lr, Sched):
+                # scale the WHOLE schedule (base and current) by factor —
+                # writing the decayed value into base_lr would compound
+                # the schedule's own decay
+                sched = opt._lr
+                old = float(sched.last_lr)
+                new = max(old * self.factor, self.min_lr)
+                sched.base_lr = sched.base_lr * self.factor
+                sched.last_lr = new
+            else:
+                old = float(opt._lr)
+                new = max(old * self.factor, self.min_lr)
+                opt.set_lr(new)
+            if self.verbose:
+                print(f"Epoch: lr reduced {old:.6g} -> {new:.6g} "
+                      f"(monitor={self.monitor})")
+            self.cooldown_counter = self.cooldown
+            self.wait = 0
+
+
+class VisualDL(Callback):
+    """VisualDL scalar logging (reference hapi/callbacks.py:977): needs
+    the external ``visualdl`` package, imported lazily exactly as
+    upstream — construction works everywhere, writing requires the
+    dependency."""
+
+    def __init__(self, log_dir):
+        self.log_dir = log_dir
+        self.epochs = None
+        self.steps = None
+        self._writer = None
+        self._step = {}                # standalone evaluate() never runs
+        #                                on_train_begin
+
+    def _get_writer(self):
+        if self._writer is None:
+            from ..utils import try_import
+
+            visualdl = try_import("visualdl")
+            self._writer = visualdl.LogWriter(self.log_dir)
+        return self._writer
+
+    def _updates(self, logs, mode):
+        logs = logs or {}
+        writer = self._get_writer()
+        metrics = getattr(self, f"{mode}_metrics", list(logs.keys()))
+        for k in metrics:
+            if k in logs:
+                v = logs[k]
+                if isinstance(v, (list, tuple)):
+                    v = v[0]
+                writer.add_scalar(f"{mode}/{k}", float(v),
+                                  self._step.get(mode, 0))
+        self._step[mode] = self._step.get(mode, 0) + 1
+
+    def on_train_begin(self, logs=None):
+        self._step = {}
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._updates(logs, "train")
+
+    def on_eval_end(self, logs=None):
+        self._updates(logs, "eval")
+
+    def on_train_end(self, logs=None):
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+
+
+class WandbCallback(Callback):
+    """Weights & Biases logging (reference hapi/callbacks.py:1097): needs
+    the external ``wandb`` package, imported lazily as upstream."""
+
+    def __init__(self, project=None, entity=None, name=None, dir=None,
+                 mode=None, job_type=None, **kwargs):
+        self._wandb_args = dict(project=project, entity=entity, name=name,
+                                dir=dir, mode=mode, job_type=job_type,
+                                **kwargs)
+        self.run = None
+
+    def _wandb(self):
+        from ..utils import try_import
+
+        return try_import(
+            "wandb",
+            "You want to use `wandb` which is not installed yet install "
+            "it with `pip install wandb`")
+
+    def on_train_begin(self, logs=None):
+        wandb = self._wandb()
+        if self.run is None:
+            self.run = wandb.init(**{k: v for k, v in
+                                     self._wandb_args.items()
+                                     if v is not None})
+
+    def _log(self, logs, prefix):
+        if self.run is None:
+            return
+        logs = logs or {}
+        payload = {}
+        for k, v in logs.items():
+            if isinstance(v, (list, tuple)):
+                v = v[0]
+            try:
+                payload[f"{prefix}/{k}"] = float(v)
+            except (TypeError, ValueError):
+                continue
+        if payload:
+            self.run.log(payload)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._log(logs, "train")
+
+    def on_eval_end(self, logs=None):
+        self._log(logs, "eval")
+
+    def on_train_end(self, logs=None):
+        if self.run is not None:
+            self.run.finish()
+            self.run = None

@@ -2,7 +2,7 @@
 
 from . import asp  # noqa: F401
 from . import autograd, nn  # noqa: F401
-from . import autotune, layers  # noqa: F401
+from . import autotune, layers, xpu  # noqa: F401
 
 # top-level incubate surface (reference python/paddle/incubate/__init__.py)
 from ..geometric import (  # noqa: F401,E402  — graph ops live in geometric

@@ -83,7 +83,11 @@ def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
             "downgrading would be wrong)")
     pruned = []
     for p in model.parameters():
-        if not _supported(p, m):
+        pname = getattr(p, "name", "") or ""
+        if pname and any(t in pname for t in _excluded_names):
+            continue
+        if not _supported(p, m) and not (
+                pname and any(t in pname for t in _extra_supported)):
             continue
         w = np.asarray(p.numpy())
         # conv (out, in, kh, kw) and any >=2-D weight: n:m over the
@@ -138,4 +142,26 @@ def decorate(optimizer) -> OptimizerWithSparsityGuarantee:
 
 
 def reset_excluded_layers(*a, **k):
-    """Compatibility no-op: exclusion is by shape here (see _supported)."""
+    """Clear the name-based exclusion set (reference asp.py
+    reset_excluded_layers)."""
+    _excluded_names.clear()
+
+
+_excluded_names: set = set()
+_extra_supported: set = set()
+
+
+def set_excluded_layers(param_names, main_program=None):
+    """Names (or name substrings) of parameters that prune_model must skip
+    (reference incubate/asp/asp.py:55)."""
+    _excluded_names.update(param_names)
+
+
+def add_supported_layer(layer, pruning_func=None):
+    """Mark a layer type or parameter-name pattern as prunable even when
+    the shape heuristic would skip it (reference asp/supported_layer_list
+    add_supported_layer). ``pruning_func`` is accepted for parity; the n:m
+    mask algorithm here is fixed (mask_1d)."""
+    name = layer if isinstance(layer, str) else getattr(
+        layer, "__name__", str(layer))
+    _extra_supported.add(name)

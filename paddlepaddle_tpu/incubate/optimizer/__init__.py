@@ -7,6 +7,8 @@ import jax.numpy as jnp
 
 from ...optimizer import LBFGS  # noqa: F401  (reference re-exports it here)
 from ...optimizer.optimizer import Optimizer
+from . import functional  # noqa: F401
+from .functional import minimize_bfgs, minimize_lbfgs  # noqa: F401
 
 
 class LookAhead(Optimizer):

@@ -1,0 +1,181 @@
+"""paddle.incubate.optimizer.functional (reference:
+python/paddle/incubate/optimizer/functional/{bfgs,lbfgs}.py): functional
+quasi-Newton minimizers over a differentiable ``objective_func(x) ->
+scalar``. Gradients come from the framework's autograd; the strong-Wolfe
+line search follows Nocedal & Wright's bracket+zoom, as upstream."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["minimize_bfgs", "minimize_lbfgs"]
+
+
+def _value_and_grad(objective_func, x_np, dtype, counter):
+    import paddlepaddle_tpu as paddle
+
+    t = paddle.to_tensor(x_np.astype(dtype), stop_gradient=False)
+    y = objective_func(t)
+    counter[0] += 1
+    (g,) = paddle.grad(y, [t])
+    return float(y.numpy()), np.asarray(g.numpy(), np.float64)
+
+
+def _strong_wolfe(fg, x, d, f0, g0, a1, max_iters, c1=1e-4, c2=0.9):
+    """Bracket + zoom line search returning a step satisfying the strong
+    Wolfe conditions (or the best point found)."""
+    d0 = float(g0 @ d)
+    if d0 >= 0:                                 # not a descent direction
+        return 0.0, f0, g0
+
+    def phi(a):
+        f, g = fg(x + a * d)
+        return f, g, float(g @ d)
+
+    def zoom(lo, f_lo, hi):
+        best = (lo, f_lo)
+        for _ in range(max_iters):
+            a = 0.5 * (lo + hi)
+            f, g, dd = phi(a)
+            if f > f0 + c1 * a * d0 or f >= f_lo:
+                hi = a
+            else:
+                if abs(dd) <= -c2 * d0:
+                    return a, f, g
+                if dd * (hi - lo) >= 0:
+                    hi = lo
+                lo, f_lo = a, f
+                best = (a, f)
+            if abs(hi - lo) < 1e-16:
+                break
+        a = best[0]
+        f, g, _ = phi(a)
+        return a, f, g
+
+    a_prev, f_prev, g_prev = 0.0, f0, g0
+    a = a1
+    for it in range(max_iters):
+        f, g, dd = phi(a)
+        if f > f0 + c1 * a * d0 or (it > 0 and f >= f_prev):
+            return zoom(a_prev, f_prev, a)
+        if abs(dd) <= -c2 * d0:
+            return a, f, g
+        if dd >= 0:
+            return zoom(a, f, a_prev)
+        a_prev, f_prev, g_prev = a, f, g
+        a = min(2 * a, 1e10)
+    return a_prev, f_prev, g_prev
+
+
+def _minimize(objective_func, initial_position, max_iters, tolerance_grad,
+              tolerance_change, H0, line_search_fn, max_line_search_iters,
+              initial_step_length, dtype, history_size=None):
+    if line_search_fn != "strong_wolfe":
+        raise NotImplementedError(
+            f"line_search_fn {line_search_fn!r}: only 'strong_wolfe' is "
+            "supported (as in the reference)")
+    import paddlepaddle_tpu as paddle
+
+    counter = [0]
+
+    def fg(x_np):
+        return _value_and_grad(objective_func, x_np, dtype, counter)
+
+    x = np.asarray(
+        initial_position.numpy()
+        if hasattr(initial_position, "numpy") else initial_position,
+        np.float64).reshape(-1)
+    n = x.size
+    f, g = fg(x)
+    if H0 is not None:
+        H = np.asarray(H0.numpy() if hasattr(H0, "numpy") else H0,
+                       np.float64)
+    else:
+        # bfgs needs a live estimate; lbfgs centers its two-loop on the
+        # gamma scaling unless an explicit H0 is given
+        H = np.eye(n) if history_size is None else None
+    sk_yk = []                                   # lbfgs history
+    converged = False
+
+    for _ in range(max_iters):
+        if np.max(np.abs(g)) <= tolerance_grad:
+            converged = True
+            break
+        if history_size is None:
+            d = -(H @ g)
+        else:
+            # two-loop recursion; an explicit H0 replaces the standard
+            # gamma * I center scaling
+            q = g.copy()
+            alphas = []
+            for s, y, rho in reversed(sk_yk):
+                a = rho * (s @ q)
+                alphas.append(a)
+                q -= a * y
+            if H is not None:
+                q = H @ q
+            elif sk_yk:
+                s, y, _ = sk_yk[-1]
+                q *= (s @ y) / max(y @ y, 1e-30)
+            for (s, y, rho), a in zip(sk_yk, reversed(alphas)):
+                q += (a - rho * (y @ q)) * s
+            d = -q
+        a, f_new, g_new = _strong_wolfe(fg, x, d, f, g,
+                                        initial_step_length,
+                                        max_line_search_iters)
+        s = a * d
+        if np.max(np.abs(s)) <= tolerance_change or a == 0.0:
+            converged = np.max(np.abs(g_new)) <= tolerance_grad
+            x, f, g = x + s, f_new, g_new
+            break
+        y = g_new - g
+        sy = s @ y
+        if sy > 1e-10:
+            if history_size is None:
+                rho = 1.0 / sy
+                V = np.eye(n) - rho * np.outer(s, y)
+                H = V @ H @ V.T + rho * np.outer(s, s)
+            else:
+                sk_yk.append((s, y, 1.0 / sy))
+                if len(sk_yk) > history_size:
+                    sk_yk.pop(0)
+        x, f, g = x + s, f_new, g_new
+
+    shape = tuple(np.asarray(
+        initial_position.numpy() if hasattr(initial_position, "numpy")
+        else initial_position).shape)
+    to_t = lambda v: paddle.to_tensor(np.asarray(v, dtype))  # noqa: E731
+    results = (bool(converged), to_t(counter[0]).astype("int64"),
+               to_t(x.reshape(shape)), to_t(f), to_t(g.reshape(shape)))
+    if history_size is None:
+        results = results + (to_t(H),)
+    return results
+
+
+def minimize_bfgs(objective_func, initial_position, max_iters=50,
+                  tolerance_grad=1e-7, tolerance_change=1e-9,
+                  initial_inverse_hessian_estimate=None,
+                  line_search_fn="strong_wolfe", max_line_search_iters=50,
+                  initial_step_length=1.0, dtype="float32", name=None):
+    """Reference incubate/optimizer/functional/bfgs.py:36. Returns
+    (is_converge, num_func_calls, position, objective_value,
+    objective_gradient, inverse_hessian_estimate)."""
+    return _minimize(objective_func, initial_position, max_iters,
+                     tolerance_grad, tolerance_change,
+                     initial_inverse_hessian_estimate, line_search_fn,
+                     max_line_search_iters, initial_step_length, dtype)
+
+
+def minimize_lbfgs(objective_func, initial_position, history_size=100,
+                   max_iters=50, tolerance_grad=1e-7, tolerance_change=1e-9,
+                   initial_inverse_hessian_estimate=None,
+                   line_search_fn="strong_wolfe", max_line_search_iters=50,
+                   initial_step_length=1.0, dtype="float32", name=None):
+    """Reference incubate/optimizer/functional/lbfgs.py. Returns
+    (is_converge, num_func_calls, position, objective_value,
+    objective_gradient)."""
+    return _minimize(objective_func, initial_position, max_iters,
+                     tolerance_grad, tolerance_change,
+                     initial_inverse_hessian_estimate, line_search_fn,
+                     max_line_search_iters, initial_step_length, dtype,
+                     history_size=history_size)

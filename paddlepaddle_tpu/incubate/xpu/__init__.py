@@ -1,0 +1,8 @@
+"""paddle.incubate.xpu (reference: python/paddle/incubate/xpu/) — the XPU
+(Kunlun) fused-kernel surface. Not applicable on this backend: the TPU
+equivalents of these fusions are XLA's own (conv+bn+relu fuse in the
+compiler); the names raise with that story."""
+
+from . import resnet_block  # noqa: F401
+
+__all__ = []

@@ -1,0 +1,17 @@
+"""reference: python/paddle/incubate/xpu/resnet_block.py — a Kunlun-XPU
+fused resnet basic block. XLA performs this fusion from the plain layer
+composition on TPU, so the fused op has no role here."""
+
+__all__ = ["resnet_basic_block", "ResNetBasicBlock"]
+
+
+def resnet_basic_block(*args, **kwargs):
+    raise NotImplementedError(
+        "resnet_basic_block is a Kunlun-XPU fused kernel; on TPU compose "
+        "nn.Conv2D/BatchNorm2D/ReLU directly — XLA fuses the block "
+        "(see vision/models/resnet.py BasicBlock)")
+
+
+class ResNetBasicBlock:
+    def __init__(self, *args, **kwargs):
+        resnet_basic_block()

@@ -29,3 +29,21 @@ from .ops.linalg import (  # noqa: F401
     triangular_solve,
     vector_norm,
 )
+
+from .ops.linalg import (  # noqa: F401,E402
+    fp8_fp8_half_gemm_fused,
+    matrix_exp,
+)
+from .ops.longtail import cholesky_inverse, cond  # noqa: F401,E402
+
+# names the reference linalg namespace shares with the top level
+import paddlepaddle_tpu as _p  # noqa: E402
+
+cross = _p.cross
+vecdot = _p.vecdot
+matrix_transpose = _p.matrix_transpose
+pca_lowrank = _p.pca_lowrank
+svd_lowrank = _p.svd_lowrank
+lu_unpack = _p.lu_unpack
+ormqr = _p.ormqr
+del _p

@@ -265,3 +265,63 @@ def einsum(equation, *operands, name=None):
     return apply_op(
         lambda *ops: jnp.einsum(equation, *ops), *operands, op_name="einsum"
     )
+
+
+def matrix_exp(x, name=None):
+    """Matrix exponential (reference tensor/linalg.py matrix_exp):
+    Padé-approximant expm over the trailing two dims (jax.scipy lowering;
+    batched via vmap)."""
+    import jax
+
+    def f(a):
+        a32 = a.astype(jnp.float64 if a.dtype == jnp.float64
+                       else jnp.float32)
+        fn = jax.scipy.linalg.expm
+        if a32.ndim > 2:
+            flat = a32.reshape((-1,) + a32.shape[-2:])
+            out = jax.vmap(fn)(flat).reshape(a32.shape)
+        else:
+            out = fn(a32)
+        return out.astype(a.dtype)
+
+    return apply_op(f, x, op_name="matrix_exp")
+
+
+def fp8_fp8_half_gemm_fused(x, y, transpose_x=False, transpose_y=False,
+                            bias=None, scale=1.0, output_dtype="float16",
+                            act="identity", name=None):
+    """FP8 x FP8 -> half GEMM (reference tensor/linalg.py:358, a cuBLASLt
+    fused kernel there): inputs are float8_e4m3fn/e5m2; the MXU path
+    computes in bf16 (numerically the dequantized product) and returns
+    float16/bfloat16 with scale/bias/act fused by XLA."""
+
+    def f(xv, yv, bv):
+        if "float8" not in str(xv.dtype) or "float8" not in str(yv.dtype):
+            raise ValueError(
+                f"fp8_fp8_half_gemm_fused expects float8 inputs, got "
+                f"{xv.dtype} x {yv.dtype}")
+        a = xv.astype(jnp.bfloat16)
+        b = yv.astype(jnp.bfloat16)
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        if output_dtype not in ("float16", "bfloat16"):
+            raise ValueError(
+                f"fp8_fp8_half_gemm_fused: output_dtype must be float16 "
+                f"or bfloat16, got {output_dtype!r}")
+        out_dt = jnp.float16 if output_dtype == "float16" else jnp.bfloat16
+        out = jnp.matmul(a, b, preferred_element_type=jnp.float32) * scale
+        if bv is not None:
+            out = out + bv.astype(jnp.float32)
+        if act == "relu":
+            out = jnp.maximum(out, 0)
+        elif act == "gelu":
+            import jax
+
+            out = jax.nn.gelu(out)
+        elif act != "identity":
+            raise ValueError(f"unknown act {act!r}")
+        return out.astype(out_dt)
+
+    return apply_op(f, x, y, bias, op_name="fp8_fp8_half_gemm_fused")

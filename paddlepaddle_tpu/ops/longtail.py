@@ -528,17 +528,15 @@ def log_normal(mean=1.0, std=2.0, shape=None, dtype=None, name=None):
 
 
 def to_dlpack(x):
-    import jax
+    # one implementation: utils/dlpack.py (jax arrays export __dlpack__;
+    # the old jax.dlpack.to_dlpack API no longer exists)
+    from ..utils.dlpack import to_dlpack as _impl
 
-    return jax.dlpack.to_dlpack(unwrap(x))
+    return _impl(x)
 
 
 def from_dlpack(capsule):
-    import jax
+    from ..utils.dlpack import from_dlpack as _impl
 
-    try:
-        arr = jax.dlpack.from_dlpack(capsule)
-    except Exception:
-        arr = jnp.asarray(np.from_dlpack(capsule))
-    return wrap(arr)
+    return _impl(capsule)
 

@@ -610,3 +610,5 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
 
     return (_T._from_data(u[..., :q]), _T._from_data(s_[..., :q]),
             _T._from_data(jnp.swapaxes(vt, -1, -2)[..., :q]))
+
+from . import creation  # noqa: E402,F401  (reference submodule path)

@@ -1,0 +1,6 @@
+"""paddle.sparse.creation (reference: python/paddle/sparse/creation.py) —
+submodule alias; the constructors live in the package root."""
+
+from . import sparse_coo_tensor, sparse_csr_tensor  # noqa: F401
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor"]

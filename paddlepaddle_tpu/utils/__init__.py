@@ -3,6 +3,7 @@ cpp_extension role — user-registered ops with autograd and SPMD — is the
 pure-function registry in ``custom_op`` (see docs/custom_ops.md)."""
 
 from . import custom_op  # noqa: F401
+from . import dlpack, download, unique_name  # noqa: F401
 from .custom_op import CustomOp, get_op, register_op, registered_ops  # noqa: F401
 
 

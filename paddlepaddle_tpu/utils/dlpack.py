@@ -1,0 +1,44 @@
+"""paddle.utils.dlpack (reference: python/paddle/utils/dlpack.py):
+zero-copy tensor exchange via the DLPack protocol, mapped onto
+jax.dlpack (device buffers cross directly; torch/cupy/numpy consumers
+work unchanged)."""
+
+from __future__ import annotations
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """Tensor -> DLPack capsule (jax arrays export __dlpack__)."""
+    from ..core.tensor import Tensor
+
+    data = x._data if isinstance(x, Tensor) else x
+    return data.__dlpack__()
+
+
+def from_dlpack(dlpack):
+    """DLPack capsule (or any __dlpack__ exporter, e.g. a torch/numpy
+    array) -> Tensor. jax's importer only takes protocol objects, so raw
+    capsules are adopted through torch's capsule consumer first."""
+    import jax.dlpack
+
+    from ..core.tensor import Tensor
+
+    if not hasattr(dlpack, "__dlpack__"):
+        class _Capsule:
+            """Adapter: jax's importer wants the protocol, not a raw
+            capsule. Capsules don't carry a device; kDLCPU covers every
+            producer in this single-process environment (cross-device
+            exchange goes through protocol objects, which keep theirs)."""
+
+            def __init__(self, c):
+                self._c = c
+
+            def __dlpack__(self, stream=None):
+                return self._c
+
+            def __dlpack_device__(self):
+                return (1, 0)          # (kDLCPU, 0)
+
+        dlpack = _Capsule(dlpack)
+    return Tensor._from_data(jax.dlpack.from_dlpack(dlpack))

@@ -1,0 +1,26 @@
+"""paddle.utils.download (reference: python/paddle/utils/download.py):
+pretrained-weight fetcher. Zero-egress environment: the cache lookup is
+live (a pre-populated ~/.cache/paddle/hapi/weights works exactly as
+upstream), the network fetch raises with that escape hatch."""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_weights_path_from_url"]
+
+WEIGHTS_HOME = os.path.expanduser("~/.cache/paddle/hapi/weights")
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    """Return the local cache path for ``url``, downloading if absent —
+    here the download step raises (no network), naming the exact path to
+    pre-populate."""
+    fname = os.path.basename(url.split("?")[0])
+    path = os.path.join(WEIGHTS_HOME, fname)
+    if os.path.isfile(path):
+        return path
+    raise RuntimeError(
+        f"get_weights_path_from_url: downloading {url} needs network "
+        f"access, which this environment does not have (zero egress); "
+        f"place the file at {path} to use the cache path")

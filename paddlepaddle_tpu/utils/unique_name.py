@@ -1,0 +1,53 @@
+"""paddle.utils.unique_name (reference: python/paddle/utils/unique_name.py,
+backing base/unique_name.py): process-wide name generator with guard
+scopes so layer/param auto-names are reproducible per scope."""
+
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["generate", "switch", "guard"]
+
+
+class _Generator:
+    def __init__(self, prefix=""):
+        self.prefix = prefix
+        self.ids = {}
+
+    def __call__(self, key):
+        self.ids[key] = self.ids.get(key, -1) + 1
+        return f"{self.prefix}{key}_{self.ids[key]}"
+
+
+_generator = _Generator()
+
+
+def generate(key):
+    """Unique name 'key_N' within the current scope."""
+    return _generator(key)
+
+
+def switch(new_generator=None):
+    """Swap the active scope, returning the previous one; None starts a
+    fresh scope."""
+    global _generator
+    old = _generator
+    _generator = new_generator if isinstance(new_generator, _Generator) \
+        else _Generator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    """Scoped switch(): names inside restart from 0 (or continue a scope
+    object obtained from a previous switch()); a str/bytes argument
+    becomes a name prefix, as in the reference."""
+    if isinstance(new_generator, str):
+        new_generator = _Generator(new_generator)
+    elif isinstance(new_generator, bytes):
+        new_generator = _Generator(new_generator.decode())
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

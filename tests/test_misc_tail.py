@@ -1,0 +1,198 @@
+"""Round-4 audit-tail closure: linalg namespace + matrix_exp/fp8 gemm,
+unique_name/dlpack/download, BFGS/LBFGS functional minimizers, asp
+exclusions, ReduceLROnPlateau, cost_model, and the submodule aliases."""
+
+import numpy as np
+import pytest
+import scipy.linalg
+
+import paddlepaddle_tpu as paddle
+
+rng = np.random.default_rng(21)
+
+
+def test_linalg_namespace_and_matrix_exp():
+    import ast
+
+    tree = ast.parse(open("/root/reference/python/paddle/linalg.py").read())
+    names = next([ast.literal_eval(e) for e in n.value.elts]
+                 for n in ast.walk(tree)
+                 if isinstance(n, ast.Assign)
+                 and getattr(n.targets[0], "id", "") == "__all__")
+    assert not [n for n in names if not hasattr(paddle.linalg, n)]
+
+    A = (rng.standard_normal((4, 4)) * 0.3).astype(np.float32)
+    np.testing.assert_allclose(
+        paddle.linalg.matrix_exp(paddle.to_tensor(A)).numpy(),
+        scipy.linalg.expm(A), rtol=1e-4, atol=1e-5)
+    B = np.stack([A, 2 * A])                      # batched
+    out = paddle.linalg.matrix_exp(paddle.to_tensor(B)).numpy()
+    np.testing.assert_allclose(out[1], scipy.linalg.expm(2 * A),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_fp8_gemm():
+    import ml_dtypes
+
+    x = (rng.standard_normal((4, 8)) * 0.5).astype(ml_dtypes.float8_e4m3fn)
+    y = (rng.standard_normal((8, 3)) * 0.5).astype(ml_dtypes.float8_e4m3fn)
+    out = paddle.linalg.fp8_fp8_half_gemm_fused(
+        paddle.to_tensor(x), paddle.to_tensor(y), scale=2.0)
+    assert str(out.numpy().dtype) == "float16"
+    ref = x.astype(np.float32) @ y.astype(np.float32) * 2.0
+    np.testing.assert_allclose(out.numpy().astype(np.float32), ref,
+                               rtol=2e-2, atol=2e-2)
+    with pytest.raises(ValueError, match="float8"):
+        paddle.linalg.fp8_fp8_half_gemm_fused(
+            paddle.to_tensor(np.zeros((2, 2), np.float32)),
+            paddle.to_tensor(np.zeros((2, 2), np.float32)))
+
+
+def test_unique_name_and_download():
+    un = paddle.utils.unique_name
+    with un.guard():
+        a = un.generate("fc")
+        b = un.generate("fc")
+        c = un.generate("conv")
+    assert (a, b, c) == ("fc_0", "fc_1", "conv_0")
+    with un.guard("p_"):
+        assert un.generate("fc") == "p_fc_0"
+    # outer scope unaffected by the guards
+    with un.guard():
+        assert un.generate("fc") == "fc_0"
+
+    with pytest.raises(RuntimeError, match="zero egress"):
+        paddle.utils.download.get_weights_path_from_url(
+            "https://example.com/w.pdparams")
+
+
+def test_dlpack_roundtrip_and_torch_interop():
+    import torch
+
+    t = paddle.to_tensor(rng.standard_normal((3, 4)).astype(np.float32))
+    rt = paddle.utils.dlpack.from_dlpack(paddle.utils.dlpack.to_dlpack(t))
+    np.testing.assert_array_equal(rt.numpy(), t.numpy())
+    tt = torch.utils.dlpack.from_dlpack(paddle.utils.dlpack.to_dlpack(t))
+    np.testing.assert_array_equal(tt.numpy(), t.numpy())
+    back = paddle.utils.dlpack.from_dlpack(torch.ones(5))
+    assert back.numpy().tolist() == [1.0] * 5
+    # top-level aliases round-trip through the same implementation
+    # (the old paddle.to_dlpack used a removed jax API — caught here)
+    rt2 = paddle.from_dlpack(paddle.to_dlpack(t))
+    np.testing.assert_array_equal(rt2.numpy(), t.numpy())
+
+
+def test_minimize_bfgs_and_lbfgs():
+    F = paddle.incubate.optimizer.functional
+    target = np.array([1.0, -2.0, 3.0], np.float32)
+
+    def quad(x):
+        return ((x - paddle.to_tensor(target)) ** 2).sum()
+
+    ok, calls, pos, val, grad, H = F.minimize_bfgs(
+        quad, paddle.to_tensor(np.zeros(3, np.float32)))
+    assert ok and int(calls.numpy()) > 0
+    np.testing.assert_allclose(pos.numpy(), target, atol=1e-4)
+    assert float(val.numpy()) < 1e-8
+    assert H.shape == [3, 3]
+
+    def rosen(x):
+        return (1 - x[0]) ** 2 + 100 * (x[1] - x[0] ** 2) ** 2
+
+    ok2, _, pos2, val2, g2 = F.minimize_lbfgs(
+        rosen, paddle.to_tensor(np.array([-1.0, 1.0], np.float32)),
+        max_iters=200)
+    np.testing.assert_allclose(pos2.numpy(), [1.0, 1.0], atol=1e-2)
+    with pytest.raises(NotImplementedError, match="strong_wolfe"):
+        F.minimize_bfgs(quad, paddle.to_tensor(np.zeros(2, np.float32)),
+                        line_search_fn="armijo")
+
+
+def test_asp_excluded_and_supported_layers():
+    from paddlepaddle_tpu.incubate import asp
+
+    net = paddle.nn.Sequential(paddle.nn.Linear(16, 16),
+                               paddle.nn.Linear(16, 16))
+    names = [p.name for p in net.parameters() if p.ndim == 2]
+    asp.set_excluded_layers([names[0]])
+    try:
+        pruned = asp.prune_model(net)
+        pruned_names = {p.name for p in pruned}
+        assert names[0] not in pruned_names and names[1] in pruned_names
+    finally:
+        asp.reset_excluded_layers()
+    # after reset both prune
+    pruned = asp.prune_model(net)
+    assert {p.name for p in pruned} >= set(names)
+    asp.add_supported_layer("whatever")           # parity surface
+
+
+def test_reduce_lr_on_plateau():
+    cb = paddle.callbacks.ReduceLROnPlateau(monitor="loss", factor=0.5,
+                                            patience=2, verbose=0,
+                                            cooldown=1, min_lr=0.01)
+
+    class FakeModel:
+        pass
+
+    m = FakeModel()
+    opt = paddle.optimizer.SGD(learning_rate=0.08,
+                               parameters=[paddle.to_tensor([1.0])])
+    m._optimizer = opt
+    cb.set_model(m)
+    cb.on_train_begin()
+    # the reference triggers on EVAL end only (epoch-end would double
+    # count monitors merged into the epoch logs)
+    # e0 sets best; e1/e2 stale -> reduce to 0.04 (cooldown 1);
+    # e3 cooldown tick then stale; e4 stale -> reduce to 0.02
+    for _ in range(5):
+        cb.on_eval_end({"loss": 1.0})
+    assert abs(opt.get_lr() - 0.02) < 1e-9
+    cb.on_epoch_end(7, {"loss": 1.0})     # epoch end must NOT count
+    assert abs(opt.get_lr() - 0.02) < 1e-9
+    # improvement resets the counter
+    cb.on_eval_end({"loss": 0.1})
+    cb.on_eval_end({"loss": 0.09})
+    assert abs(opt.get_lr() - 0.02) < 1e-9
+    # scheduler-composed lr scales the whole schedule, not compounding
+    from paddlepaddle_tpu.optimizer.lr import StepDecay
+
+    sched = StepDecay(learning_rate=0.08, step_size=100, gamma=0.1)
+    opt2 = paddle.optimizer.SGD(learning_rate=sched,
+                                parameters=[paddle.to_tensor([1.0])])
+    m2 = FakeModel()
+    m2._optimizer = opt2
+    cb2 = paddle.callbacks.ReduceLROnPlateau(monitor="loss", factor=0.5,
+                                             patience=1, verbose=0)
+    cb2.set_model(m2)
+    cb2.on_train_begin()
+    for _ in range(3):
+        cb2.on_eval_end({"loss": 1.0})
+    # e0 best, e1 reduce (0.04), e2 reduce (0.02) at patience=1
+    assert abs(opt2.get_lr() - 0.02) < 1e-9
+    assert abs(sched.base_lr - 0.02) < 1e-9
+    with pytest.raises(ValueError):
+        paddle.callbacks.ReduceLROnPlateau(factor=1.5)
+    # VisualDL/Wandb construct without their soft deps installed
+    paddle.callbacks.VisualDL(log_dir="/tmp/vdl")
+    paddle.callbacks.WandbCallback(project="x")
+
+
+def test_cost_model_profiles_ops():
+    cm = paddle.cost_model.CostModel()
+    startup, main = cm.build_program()
+    costs = cm.profile_measure(startup, main, device="cpu")
+    paddle.disable_static()
+    assert "total" in costs and costs["total"]["time"] > 0
+    op_rows = {k: v for k, v in costs.items() if k != "total"}
+    assert op_rows and all(v["count"] >= 1 for v in op_rows.values())
+    assert sum(v["time"] for v in op_rows.values()) <= \
+        costs["total"]["time"] * 1.01
+
+
+def test_submodule_aliases():
+    assert paddle.sparse.creation.sparse_coo_tensor is \
+        paddle.sparse.sparse_coo_tensor
+    assert paddle.nn.initializer.lazy_init.LazyGuard is paddle.LazyGuard
+    with pytest.raises(NotImplementedError, match="XPU"):
+        paddle.incubate.xpu.resnet_block.resnet_basic_block()
