@@ -15,8 +15,6 @@ class CostModel:
     def build_program(self):
         """The reference's demo program: data -> fc -> mean, minimized by
         SGD (cost_model.py:37)."""
-        import numpy as np
-
         import paddlepaddle_tpu as paddle
         from paddlepaddle_tpu import static
 
@@ -29,7 +27,6 @@ class CostModel:
             hidden = static.nn.fc(data, 10)
             loss = paddle.mean(hidden)
             paddle.optimizer.SGD(learning_rate=0.01).minimize(loss)
-        del np
         return startup_program, main_program
 
     def profile_measure(self, startup_program, main_program, device="gpu",

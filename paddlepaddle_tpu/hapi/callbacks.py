@@ -208,13 +208,16 @@ class ReduceLROnPlateau(Callback):
             from ..optimizer.lr import LRScheduler as Sched
 
             if isinstance(opt._lr, Sched):
-                # scale the WHOLE schedule (base and current) by factor —
-                # writing the decayed value into base_lr would compound
-                # the schedule's own decay
+                # scale the WHOLE schedule (base and current) by the same
+                # EFFECTIVE ratio — writing the decayed value into base_lr
+                # would compound the schedule's own decay, and scaling base
+                # by the unclamped factor would let the next step() dive
+                # under min_lr
                 sched = opt._lr
                 old = float(sched.last_lr)
                 new = max(old * self.factor, self.min_lr)
-                sched.base_lr = sched.base_lr * self.factor
+                ratio = new / max(old, 1e-30)
+                sched.base_lr = sched.base_lr * ratio
                 sched.last_lr = new
             else:
                 old = float(opt._lr)

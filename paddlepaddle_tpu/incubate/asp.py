@@ -86,8 +86,7 @@ def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
         pname = getattr(p, "name", "") or ""
         if pname and any(t in pname for t in _excluded_names):
             continue
-        if not _supported(p, m) and not (
-                pname and any(t in pname for t in _extra_supported)):
+        if not _supported(p, m):
             continue
         w = np.asarray(p.numpy())
         # conv (out, in, kh, kw) and any >=2-D weight: n:m over the
@@ -158,10 +157,20 @@ def set_excluded_layers(param_names, main_program=None):
 
 
 def add_supported_layer(layer, pruning_func=None):
-    """Mark a layer type or parameter-name pattern as prunable even when
-    the shape heuristic would skip it (reference asp/supported_layer_list
-    add_supported_layer). ``pruning_func`` is accepted for parity; the n:m
-    mask algorithm here is fixed (mask_1d)."""
+    """Register a layer type as prunable (reference
+    asp/supported_layer_list add_supported_layer). The reference needs
+    this because it prunes a fixed TYPE list (Linear/Conv); here
+    ``_supported`` gates by SHAPE (any >=2-D weight whose flattened
+    trailing width fits the n:m pattern), which is a superset of every
+    registrable type — so registration is recorded for introspection but
+    cannot widen the prune set. A custom ``pruning_func`` is not
+    supported (the mask algorithm is fixed to mask_1d) and raises rather
+    than being silently ignored."""
+    if pruning_func is not None:
+        raise NotImplementedError(
+            "add_supported_layer(pruning_func=...): custom mask functions "
+            "are not supported — the n:m mask algorithm is fixed "
+            "(mask_1d); shapes it can mask are already auto-included")
     name = layer if isinstance(layer, str) else getattr(
         layer, "__name__", str(layer))
     _extra_supported.add(name)

@@ -28,6 +28,35 @@ import jax.numpy as jnp
 
 from ..core.dispatch import apply_op
 from ..core.tensor import Tensor
+
+
+@jax.custom_vjp
+def _ce_rows(lg, labels):
+    """Per-position NLL = lse(logits) - logits[label], fp32 math over bf16
+    logits. The custom vjp keeps the fp32 [B,S,V] intermediates OUT of the
+    saved residuals: backward rebuilds softmax rows from the bf16 logits
+    and the saved [B,S] lse (tools/ce_head_ab.py A/B)."""
+    lgf = lg.astype(jnp.float32)
+    lse = jax.nn.logsumexp(lgf, axis=-1)
+    picked = jnp.take_along_axis(lgf, labels[..., None], axis=-1)[..., 0]
+    return lse - picked
+
+
+def _ce_rows_fwd(lg, labels):
+    lgf = lg.astype(jnp.float32)
+    lse = jax.nn.logsumexp(lgf, axis=-1)
+    picked = jnp.take_along_axis(lgf, labels[..., None], axis=-1)[..., 0]
+    return lse - picked, (lg, labels, lse)
+
+
+def _ce_rows_bwd(res, g):
+    lg, labels, lse = res
+    p = jnp.exp(lg.astype(jnp.float32) - lse[..., None])
+    onehot = jax.nn.one_hot(labels, lg.shape[-1], dtype=jnp.float32)
+    return ((p - onehot) * g[..., None]).astype(lg.dtype), None
+
+
+_ce_rows.defvjp(_ce_rows_fwd, _ce_rows_bwd)
 from ..nn import functional as F
 from ..nn.common import Embedding, Linear
 from ..nn.container import LayerList
@@ -508,14 +537,18 @@ class LlamaForCausalLM(Layer):
         usual [:-1]/[1:] slices): slicing one element off a sharded sequence
         dim makes it unevenly sharded, which both costs a reshard and crashes
         XLA's SPMD partitioner under context parallelism; roll lowers to a
-        collective-permute and keeps every tensor evenly sharded."""
+        collective-permute and keeps every tensor evenly sharded.
+
+        The per-row NLL is a custom-vjp lse formulation: forward saves only
+        the [B,S] logsumexp (softmax rows are recomputed from the bf16
+        logits in backward), so no fp32 [B,S,V] residual crosses the
+        fwd/bwd boundary — measured 14.1 -> 9.9 ms on the 254M head
+        segment (tools/ce_head_ab.py), exact loss parity, grad diff 5e-7."""
 
         def f(lg, lb):
             seq = lg.shape[1]
-            lg = lg.astype(jnp.float32)
             lb_next = jnp.roll(lb, -1, axis=1)           # label for pos t is token t+1
-            logp = jax.nn.log_softmax(lg, axis=-1)
-            nll = -jnp.take_along_axis(logp, jnp.maximum(lb_next, 0)[..., None], axis=-1)[..., 0]
+            nll = _ce_rows(lg, jnp.maximum(lb_next, 0))
             pos = jax.lax.broadcasted_iota(jnp.int32, nll.shape, 1)
             valid = ((lb_next >= 0) & (pos < seq - 1)).astype(jnp.float32)
             return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1.0)

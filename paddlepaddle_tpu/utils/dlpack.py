@@ -25,20 +25,28 @@ def from_dlpack(dlpack):
     from ..core.tensor import Tensor
 
     if not hasattr(dlpack, "__dlpack__"):
-        class _Capsule:
-            """Adapter: jax's importer wants the protocol, not a raw
-            capsule. Capsules don't carry a device; kDLCPU covers every
-            producer in this single-process environment (cross-device
-            exchange goes through protocol objects, which keep theirs)."""
+        # raw capsule: prefer torch's consumer, which reads the REAL
+        # device out of the DLManagedTensor (a GPU capsule mislabeled as
+        # CPU would be dereferenced as host memory)
+        try:
+            import torch.utils.dlpack as _tdl
 
-            def __init__(self, c):
-                self._c = c
+            dlpack = _tdl.from_dlpack(dlpack)
+        except ImportError:
+            class _CpuCapsule:
+                """jax's importer wants the protocol, not a capsule. A
+                capsule's device header is unreadable without a native
+                consumer, so without torch only host capsules are
+                accepted (kDLCPU)."""
 
-            def __dlpack__(self, stream=None):
-                return self._c
+                def __init__(self, c):
+                    self._c = c
 
-            def __dlpack_device__(self):
-                return (1, 0)          # (kDLCPU, 0)
+                def __dlpack__(self, stream=None):
+                    return self._c
 
-        dlpack = _Capsule(dlpack)
+                def __dlpack_device__(self):
+                    return (1, 0)      # (kDLCPU, 0)
+
+            dlpack = _CpuCapsule(dlpack)
     return Tensor._from_data(jax.dlpack.from_dlpack(dlpack))

@@ -196,3 +196,44 @@ def test_submodule_aliases():
     assert paddle.nn.initializer.lazy_init.LazyGuard is paddle.LazyGuard
     with pytest.raises(NotImplementedError, match="XPU"):
         paddle.incubate.xpu.resnet_block.resnet_basic_block()
+
+
+def test_asp_add_supported_layer_contract():
+    """The shape gate already covers every registrable type (documented
+    superset of the reference's type list); custom pruning funcs raise
+    instead of being silently dropped."""
+    from paddlepaddle_tpu.incubate import asp
+
+    net = paddle.nn.Sequential(paddle.nn.Linear(6, 8))
+    assert len(asp.prune_model(net)) == 1      # shape-gated: included
+    asp.add_supported_layer(paddle.nn.Linear)  # recorded, no error
+    assert "Linear" in asp._extra_supported
+    asp._extra_supported.clear()
+    with pytest.raises(NotImplementedError, match="mask_1d"):
+        asp.add_supported_layer(paddle.nn.Linear, pruning_func=lambda w: w)
+
+
+def test_reduce_lr_plateau_min_lr_with_scheduler():
+    """min_lr holds through subsequent scheduler steps (base scales by
+    the clamped effective ratio, not the raw factor)."""
+    from paddlepaddle_tpu.optimizer.lr import StepDecay
+
+    sched = StepDecay(learning_rate=0.08, step_size=1000, gamma=0.5)
+    opt = paddle.optimizer.SGD(learning_rate=sched,
+                               parameters=[paddle.to_tensor([1.0])])
+
+    class M:
+        pass
+
+    m = M()
+    m._optimizer = opt
+    cb = paddle.callbacks.ReduceLROnPlateau(monitor="loss", factor=0.5,
+                                            patience=1, verbose=0,
+                                            min_lr=0.05)
+    cb.set_model(m)
+    cb.on_train_begin()
+    for _ in range(6):
+        cb.on_eval_end({"loss": 1.0})
+    assert abs(sched.last_lr - 0.05) < 1e-9
+    sched.step()                                 # within step_size window
+    assert sched.last_lr >= 0.05 - 1e-9

@@ -1,0 +1,134 @@
+"""A/B the flagship CE head on the real chip: the current formulation
+(fp32 log_softmax over the full [B,S,V] logits, models/llama.py
+loss_from_logits) against a custom-vjp variant that saves only the LSE +
+label logit for backward (recomputing softmax rows from the bf16 logits),
+trading HBM traffic in the backward for a recompute.
+
+Run ambient (TPU): python tools/ce_head_ab.py
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+B, S, V, H = 8, 1024, 32000, 1024
+ITERS = 6
+
+
+def _sync(x):
+    return float(jnp.sum(x).block_until_ready())
+
+
+def current_ce(lg, lb):
+    seq = lg.shape[1]
+    lg = lg.astype(jnp.float32)
+    lb_next = jnp.roll(lb, -1, axis=1)
+    logp = jax.nn.log_softmax(lg, axis=-1)
+    nll = -jnp.take_along_axis(
+        logp, jnp.maximum(lb_next, 0)[..., None], axis=-1)[..., 0]
+    pos = jax.lax.broadcasted_iota(jnp.int32, nll.shape, 1)
+    valid = ((lb_next >= 0) & (pos < seq - 1)).astype(jnp.float32)
+    return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+
+
+@jax.custom_vjp
+def _ce_rows(lg, labels):
+    lgf = lg.astype(jnp.float32)
+    lse = jax.nn.logsumexp(lgf, axis=-1)
+    picked = jnp.take_along_axis(lgf, labels[..., None], axis=-1)[..., 0]
+    return lse - picked
+
+
+def _ce_rows_fwd(lg, labels):
+    lgf = lg.astype(jnp.float32)
+    lse = jax.nn.logsumexp(lgf, axis=-1)
+    picked = jnp.take_along_axis(lgf, labels[..., None], axis=-1)[..., 0]
+    return lse - picked, (lg, labels, lse)
+
+
+def _ce_rows_bwd(res, g):
+    lg, labels, lse = res
+    # softmax recomputed from bf16 logits + saved lse: no fp32 [B,S,V]
+    # residual crosses the fwd/bwd boundary
+    p = jnp.exp(lg.astype(jnp.float32) - lse[..., None])
+    onehot = jax.nn.one_hot(labels, lg.shape[-1], dtype=jnp.float32)
+    return ((p - onehot) * g[..., None]).astype(lg.dtype), None
+
+
+_ce_rows.defvjp(_ce_rows_fwd, _ce_rows_bwd)
+
+
+def fused_ce(lg, lb):
+    seq = lg.shape[1]
+    lb_next = jnp.roll(lb, -1, axis=1)
+    nll = _ce_rows(lg, jnp.maximum(lb_next, 0))
+    pos = jax.lax.broadcasted_iota(jnp.int32, nll.shape, 1)
+    valid = ((lb_next >= 0) & (pos < seq - 1)).astype(jnp.float32)
+    return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+
+
+def bench(name, ce):
+    """Time fwd+bwd of hidden @ W_head -> ce, grads to hidden and W."""
+    key = jax.random.PRNGKey(0)
+    hidden = jax.random.normal(key, (B, S, H), jnp.bfloat16)
+    w = jax.random.normal(key, (V, H), jnp.bfloat16) * 0.02
+    labels = jax.random.randint(key, (B, S), 0, V)
+
+    def loss_fn(hidden, w):
+        logits = jnp.einsum("bsh,vh->bsv", hidden, w,
+                            preferred_element_type=jnp.bfloat16)
+        return ce(logits, labels)
+
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn, argnums=(0, 1)))
+
+    def chain(k):
+        def f(h, w):
+            def body(carry, _):
+                h_, w_ = carry
+                val, (gh, gw) = grad_fn(h_, w_)
+                return (h_ - 1e-6 * gh.astype(h_.dtype),
+                        w_ - 1e-6 * gw.astype(w_.dtype)), val
+
+            (hf, wf), vals = jax.lax.scan(body, (h, w), None, length=k)
+            return vals[-1]
+
+        return jax.jit(f)
+
+    lo, hi = chain(2), chain(ITERS + 2)
+    _sync(lo(hidden, w))
+    _sync(hi(hidden, w))
+    best_lo = best_hi = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        _sync(lo(hidden, w))
+        best_lo = min(best_lo, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        _sync(hi(hidden, w))
+        best_hi = min(best_hi, time.perf_counter() - t0)
+    per = (best_hi - best_lo) / ITERS
+    print(f"{name}: {per*1e3:.2f} ms/step (lo {best_lo*1e3:.1f} "
+          f"hi {best_hi*1e3:.1f})")
+    return per
+
+
+def check_parity():
+    key = jax.random.PRNGKey(1)
+    lg = jax.random.normal(key, (2, 16, 512), jnp.bfloat16)
+    lb = jax.random.randint(key, (2, 16), 0, 512)
+    a = current_ce(lg, lb)
+    b = fused_ce(lg, lb)
+    ga = jax.grad(lambda x: current_ce(x, lb))(lg)
+    gb = jax.grad(lambda x: fused_ce(x, lb))(lg)
+    print("loss parity:", float(a), float(b))
+    print("grad max diff:", float(jnp.max(jnp.abs(
+        ga.astype(jnp.float32) - gb.astype(jnp.float32)))))
+    assert abs(float(a) - float(b)) < 1e-3
+
+
+if __name__ == "__main__":
+    check_parity()
+    t_cur = bench("current (fp32 log_softmax)", current_ce)
+    t_fus = bench("fused   (lse custom vjp)  ", fused_ce)
+    print(f"speedup: {t_cur / t_fus:.3f}x")
