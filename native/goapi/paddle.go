@@ -1,0 +1,215 @@
+// Package paddle — Go inference API over the native C inference library
+// (reference surface: paddle/fluid/inference/goapi/{config,predictor,
+// tensor}.go). The binding wraps libpaddle_inference_c.so, whose
+// predictor speaks the Unix-socket protocol to inference/c_api_server.py
+// executing a jit.save'd StableHLO program on the chip.
+//
+// Build (needs a Go toolchain; this repo's CI image has none, so the
+// binding ships as source — the C library underneath is the same one the
+// ctypes client test exercises end to end):
+//
+//	cd native && make   # builds libpaddle_inference_c.so
+//	cd goapi && CGO_LDFLAGS="-L.. -lpaddle_inference_c" go build
+package paddle
+
+/*
+#cgo LDFLAGS: -L${SRCDIR}/.. -lpaddle_inference_c
+#include <stdlib.h>
+#include "paddle_inference_c.h"
+*/
+import "C"
+
+import (
+	"errors"
+	"runtime"
+	"unsafe"
+)
+
+// DataType mirrors the C library's dtype tags.
+type DataType int32
+
+const (
+	Float32 DataType = 0
+	Int64   DataType = 1
+	Int32   DataType = 2
+	Uint8   DataType = 3
+)
+
+// Config carries the predictor endpoint (the c_api_server socket path
+// plays the model-path role; params kept for reference call-shape parity).
+type Config struct {
+	c *C.PD_Config
+}
+
+func NewConfig() *Config {
+	return &Config{c: C.PD_ConfigCreate()}
+}
+
+// SetModel points the predictor at the serving socket (prog, params).
+func (cfg *Config) SetModel(prog, params string) {
+	cProg := C.CString(prog)
+	cParams := C.CString(params)
+	defer C.free(unsafe.Pointer(cProg))
+	defer C.free(unsafe.Pointer(cParams))
+	C.PD_ConfigSetModel(cfg.c, cProg, cParams)
+}
+
+func (cfg *Config) SetModelDir(dir string) {
+	cDir := C.CString(dir)
+	defer C.free(unsafe.Pointer(cDir))
+	C.PD_ConfigSetModelDir(cfg.c, cDir)
+}
+
+func (cfg *Config) ModelDir() string {
+	return C.GoString(C.PD_ConfigGetModelDir(cfg.c))
+}
+
+// Predictor executes the served program. NewPredictor consumes the
+// Config (the C Create takes ownership), as in the reference API.
+type Predictor struct {
+	p *C.PD_Predictor
+}
+
+func NewPredictor(cfg *Config) (*Predictor, error) {
+	p := C.PD_PredictorCreate(cfg.c)
+	cfg.c = nil // consumed either way
+	if p == nil {
+		return nil, errors.New("paddle: predictor create failed (is the c_api_server socket up?)")
+	}
+	pred := &Predictor{p: p}
+	runtime.SetFinalizer(pred, func(pr *Predictor) { pr.Destroy() })
+	return pred, nil
+}
+
+func (pr *Predictor) Destroy() {
+	if pr.p != nil {
+		C.PD_PredictorDestroy(pr.p)
+		pr.p = nil
+	}
+}
+
+func (pr *Predictor) GetInputNum() uint  { return uint(C.PD_PredictorGetInputNum(pr.p)) }
+func (pr *Predictor) GetOutputNum() uint { return uint(C.PD_PredictorGetOutputNum(pr.p)) }
+
+func goNames(a *C.PD_OneDimArrayCstr) []string {
+	defer C.PD_OneDimArrayCstrDestroy(a)
+	n := int(a.size)
+	out := make([]string, n)
+	data := unsafe.Slice(a.data, n)
+	for i := 0; i < n; i++ {
+		out[i] = C.GoString(data[i])
+	}
+	return out
+}
+
+func (pr *Predictor) GetInputNames() []string {
+	return goNames(C.PD_PredictorGetInputNames(pr.p))
+}
+
+func (pr *Predictor) GetOutputNames() []string {
+	return goNames(C.PD_PredictorGetOutputNames(pr.p))
+}
+
+func (pr *Predictor) GetInputHandle(name string) *Tensor {
+	cName := C.CString(name)
+	defer C.free(unsafe.Pointer(cName))
+	t := C.PD_PredictorGetInputHandle(pr.p, cName)
+	if t == nil {
+		return nil
+	}
+	return &Tensor{t: t, pred: pr}
+}
+
+// GetOutputHandle borrows the CURRENT output buffer. PD_PredictorRun
+// rebuilds the output set, so a handle is valid only until the next
+// Run() — re-fetch after every Run, as the reference examples do.
+func (pr *Predictor) GetOutputHandle(name string) *Tensor {
+	cName := C.CString(name)
+	defer C.free(unsafe.Pointer(cName))
+	t := C.PD_PredictorGetOutputHandle(pr.p, cName)
+	if t == nil {
+		return nil
+	}
+	return &Tensor{t: t, pred: pr}
+}
+
+// Run executes one inference; on failure the server/transport error is
+// surfaced from PD_PredictorGetLastError.
+func (pr *Predictor) Run() error {
+	if C.PD_PredictorRun(pr.p) == 0 {
+		return errors.New("paddle: " + C.GoString(C.PD_PredictorGetLastError(pr.p)))
+	}
+	return nil
+}
+
+// Tensor is a borrowed handle owned by its predictor (as in the C API).
+// The pred back-reference keeps the Predictor reachable — and its
+// finalizer unfired — for as long as any handle is alive; output handles
+// additionally die at the next Run() (see GetOutputHandle).
+type Tensor struct {
+	t    *C.PD_Tensor
+	pred *Predictor
+}
+
+func (t *Tensor) Reshape(shape []int32) {
+	C.PD_TensorReshape(t.t, C.size_t(len(shape)), (*C.int32_t)(unsafe.Pointer(&shape[0])))
+}
+
+func (t *Tensor) Shape() []int32 {
+	n := int(C.PD_TensorGetNumDims(t.t))
+	if n == 0 {
+		return nil
+	}
+	out := make([]int32, n)
+	C.PD_TensorGetShape(t.t, (*C.int32_t)(unsafe.Pointer(&out[0])))
+	return out
+}
+
+func (t *Tensor) DataType() DataType { return DataType(C.PD_TensorGetDataType(t.t)) }
+func (t *Tensor) Name() string       { return C.GoString(C.PD_TensorGetName(t.t)) }
+
+func (t *Tensor) numel() int {
+	n := 1
+	for _, d := range t.Shape() {
+		n *= int(d)
+	}
+	return n
+}
+
+// CopyFromCpu uploads host data ([]float32, []int64, []int32 or []uint8)
+// into the input tensor; call Reshape first.
+func (t *Tensor) CopyFromCpu(data interface{}) error {
+	switch v := data.(type) {
+	case []float32:
+		C.PD_TensorCopyFromCpuFloat(t.t, (*C.float)(unsafe.Pointer(&v[0])))
+	case []int64:
+		C.PD_TensorCopyFromCpuInt64(t.t, (*C.int64_t)(unsafe.Pointer(&v[0])))
+	case []int32:
+		C.PD_TensorCopyFromCpuInt32(t.t, (*C.int32_t)(unsafe.Pointer(&v[0])))
+	case []uint8:
+		C.PD_TensorCopyFromCpuUint8(t.t, (*C.uint8_t)(unsafe.Pointer(&v[0])))
+	default:
+		return errors.New("paddle: CopyFromCpu supports []float32/[]int64/[]int32/[]uint8")
+	}
+	runtime.KeepAlive(t.pred)
+	return nil
+}
+
+// CopyToCpu downloads the output tensor into a pre-sized slice of the
+// matching element type.
+func (t *Tensor) CopyToCpu(data interface{}) error {
+	switch v := data.(type) {
+	case []float32:
+		C.PD_TensorCopyToCpuFloat(t.t, (*C.float)(unsafe.Pointer(&v[0])))
+	case []int64:
+		C.PD_TensorCopyToCpuInt64(t.t, (*C.int64_t)(unsafe.Pointer(&v[0])))
+	case []int32:
+		C.PD_TensorCopyToCpuInt32(t.t, (*C.int32_t)(unsafe.Pointer(&v[0])))
+	case []uint8:
+		C.PD_TensorCopyToCpuUint8(t.t, (*C.uint8_t)(unsafe.Pointer(&v[0])))
+	default:
+		return errors.New("paddle: CopyToCpu supports []float32/[]int64/[]int32/[]uint8")
+	}
+	runtime.KeepAlive(t.pred)
+	return nil
+}

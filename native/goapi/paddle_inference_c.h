@@ -1,0 +1,58 @@
+/* C declarations for libpaddle_inference_c.so (native/paddle_inference_c.cpp)
+ * — the capi_exp-shaped surface the Go binding consumes. */
+#ifndef PADDLE_INFERENCE_C_H
+#define PADDLE_INFERENCE_C_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct PD_Config PD_Config;
+typedef struct PD_Predictor PD_Predictor;
+typedef struct PD_Tensor PD_Tensor;
+
+typedef struct PD_OneDimArrayCstr {
+  size_t size;
+  char** data;
+} PD_OneDimArrayCstr;
+
+PD_Config* PD_ConfigCreate(void);
+void PD_ConfigDestroy(PD_Config* c);
+void PD_ConfigSetModel(PD_Config* c, const char* socket_path, const char* params);
+void PD_ConfigSetModelDir(PD_Config* c, const char* socket_path);
+const char* PD_ConfigGetModelDir(PD_Config* c);
+
+PD_Predictor* PD_PredictorCreate(PD_Config* config); /* consumes config */
+void PD_PredictorDestroy(PD_Predictor* p);
+size_t PD_PredictorGetInputNum(PD_Predictor* p);
+size_t PD_PredictorGetOutputNum(PD_Predictor* p);
+PD_OneDimArrayCstr* PD_PredictorGetInputNames(PD_Predictor* p);
+PD_OneDimArrayCstr* PD_PredictorGetOutputNames(PD_Predictor* p);
+void PD_OneDimArrayCstrDestroy(PD_OneDimArrayCstr* a);
+PD_Tensor* PD_PredictorGetInputHandle(PD_Predictor* p, const char* name);
+PD_Tensor* PD_PredictorGetOutputHandle(PD_Predictor* p, const char* name);
+const char* PD_PredictorGetLastError(PD_Predictor* p);
+int PD_PredictorRun(PD_Predictor* p);
+
+void PD_TensorReshape(PD_Tensor* t, size_t ndim, int32_t* shape);
+void PD_TensorCopyFromCpuFloat(PD_Tensor* t, const float* v);
+void PD_TensorCopyFromCpuInt64(PD_Tensor* t, const int64_t* v);
+void PD_TensorCopyFromCpuInt32(PD_Tensor* t, const int32_t* v);
+void PD_TensorCopyFromCpuUint8(PD_Tensor* t, const uint8_t* v);
+void PD_TensorCopyToCpuFloat(PD_Tensor* t, float* out);
+void PD_TensorCopyToCpuInt64(PD_Tensor* t, int64_t* out);
+void PD_TensorCopyToCpuInt32(PD_Tensor* t, int32_t* out);
+void PD_TensorCopyToCpuUint8(PD_Tensor* t, uint8_t* out);
+size_t PD_TensorGetNumDims(PD_Tensor* t);
+void PD_TensorGetShape(PD_Tensor* t, int32_t* out);
+int32_t PD_TensorGetDataType(PD_Tensor* t);
+const char* PD_TensorGetName(PD_Tensor* t);
+void PD_TensorDestroy(PD_Tensor* t);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* PADDLE_INFERENCE_C_H */

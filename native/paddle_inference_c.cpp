@@ -31,6 +31,11 @@
 #include <sys/un.h>
 #include <unistd.h>
 
+// the public C declarations the Go binding consumes — included here so the
+// compiler enforces that every extern "C" definition below matches the
+// header's ABI (signature drift becomes a build error, not a crash in cgo)
+#include "goapi/paddle_inference_c.h"
+
 namespace {
 
 constexpr uint32_t kMagic = 0x50444331u;  // 'PDC1'
@@ -101,10 +106,7 @@ typedef struct PD_Predictor {
   std::string last_error;
 } PD_Predictor;
 
-typedef struct PD_OneDimArrayCstr {
-  size_t size;
-  char** data;
-} PD_OneDimArrayCstr;
+// PD_OneDimArrayCstr comes fully defined from goapi/paddle_inference_c.h
 
 extern "C" void PD_PredictorDestroy(PD_Predictor* p);
 

@@ -12,6 +12,7 @@ A completeness test pins the sweep against the module surface so newly added
 ops must register here.
 """
 
+import ml_dtypes
 import numpy as np
 import pytest
 
@@ -224,6 +225,10 @@ SPECS = [
     ("triangular_solve", "smoke",
      [np.triu(PD).astype(np.float32), _f32(3, 1)], {}),
     ("matrix_power", "smoke", [SQ], {"n": 2}),
+    ("matrix_exp", "grad", [(SQ * 0.3).astype(np.float32)], {}),
+    ("fp8_fp8_half_gemm_fused", "smoke",
+     [A23.astype(ml_dtypes.float8_e4m3fn),
+      B23.T.astype(ml_dtypes.float8_e4m3fn)], {}),
     ("multi_dot", "smoke", [[A23, _f32(3, 2)]], {}),
     ("qr", "smoke", [A23], {}),
     ("svd", "smoke", [A23], {}),

@@ -295,3 +295,88 @@ def test_tensor_method_tail_semantics():
     g = paddle.to_tensor(x, stop_gradient=False)
     with pytest.raises((RuntimeError, ValueError)):
         g.set_(src2)
+
+
+def test_inplace_variant_sweep():
+    """Every generated in-place method: result equals the out-of-place op,
+    the SAME Tensor object is returned, and the version counter bumps
+    (reference inplace contract, eager_method.cc TensorWrapper rules)."""
+    f1 = np.random.default_rng(3).uniform(0.2, 0.8, (2, 4)).astype(np.float32)
+    other = np.random.default_rng(4).uniform(0.2, 0.8, (2, 4)).astype(np.float32)
+    i1 = np.random.default_rng(5).integers(1, 8, (2, 4)).astype(np.int32)
+    b1 = np.array([[True, False], [False, True]])
+
+    # erf_/expm1_ are top-level-only in the reference's method list
+    unary_f = ["abs", "acos", "asin", "atan", "ceil", "cos", "cosh",
+               "erfinv", "exp", "floor", "frac", "lgamma", "log",
+               "log10", "log1p", "log2", "neg", "reciprocal", "round",
+               "rsqrt", "sigmoid", "sin", "sinh",
+               "sqrt", "square", "tan", "tanh", "trunc", "digamma", "i0",
+               "logit", "nan_to_num", "sinc", "gammaln"]
+    for name in unary_f:
+        t = paddle.to_tensor(f1)
+        v0 = t._version
+        out = getattr(t, name + "_")()
+        assert out is t and t._version > v0, name
+        want = getattr(paddle, name)(paddle.to_tensor(f1)).numpy()
+        np.testing.assert_allclose(t.numpy(), want, rtol=1e-5, atol=1e-6,
+                                   err_msg=name)
+
+    binary_f = ["add", "subtract", "multiply", "divide", "pow", "copysign",
+                "hypot", "floor_divide", "floor_mod", "mod", "ldexp"]
+    for name in binary_f:
+        t = paddle.to_tensor(f1)
+        o = paddle.to_tensor(other)
+        out = getattr(t, name + "_")(o)
+        assert out is t, name
+        want = getattr(paddle, name)(paddle.to_tensor(f1), o).numpy()
+        np.testing.assert_allclose(t.numpy(), want, rtol=1e-5, atol=1e-6,
+                                   err_msg=name)
+
+    int_binary = ["gcd", "lcm", "bitwise_and", "bitwise_or", "bitwise_xor",
+                  "bitwise_left_shift", "bitwise_right_shift"]
+    for name in int_binary:
+        t = paddle.to_tensor(i1)
+        out = getattr(t, name + "_")(paddle.to_tensor(i1))
+        assert out is t, name
+        want = getattr(paddle, name)(paddle.to_tensor(i1),
+                                     paddle.to_tensor(i1)).numpy()
+        np.testing.assert_array_equal(t.numpy(), want, err_msg=name)
+
+    # comparison / logical in-place rebind to bool results
+    t = paddle.to_tensor(f1)
+    t.greater_than_(paddle.to_tensor(other))
+    np.testing.assert_array_equal(t.numpy(), f1 > other)
+    t = paddle.to_tensor(b1)
+    t.logical_xor_(paddle.to_tensor(b1))
+    assert not t.numpy().any()
+
+    # shape-rewriting in-place
+    t = paddle.to_tensor(f1)
+    t.unsqueeze_(0)
+    assert t.shape == [1, 2, 4]
+    t.squeeze_(0)
+    assert t.shape == [2, 4]
+    t.flatten_()
+    assert t.shape == [8]
+    t = paddle.to_tensor(f1)
+    t.t_()
+    assert t.shape == [4, 2]
+    np.testing.assert_allclose(t.numpy(), f1.T)
+    sq = paddle.to_tensor(f1 @ other.T)
+    sq.tril_()
+    assert np.allclose(sq.numpy(), np.tril(f1 @ other.T))
+    sq.triu_()
+    assert np.allclose(sq.numpy(), np.triu(np.tril(f1 @ other.T)))
+
+    # tape interaction: in-place on an intermediate keeps upstream grads
+    x = paddle.to_tensor(f1, stop_gradient=False)
+    y = (x * 2.0)
+    y.exp_()
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), 2.0 * np.exp(2.0 * f1),
+                               rtol=1e-5, err_msg="inplace tape grad")
+    # leaf guard still applies
+    leaf = paddle.to_tensor(f1, stop_gradient=False)
+    with pytest.raises(RuntimeError, match="leaf"):
+        leaf.exp_()
