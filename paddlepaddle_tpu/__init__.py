@@ -9,7 +9,9 @@ for the fused hot ops; distribution is GSPMD mesh sharding over ICI/DCN.
 
 from __future__ import annotations
 
-__version__ = "0.1.0"
+from . import version  # noqa: F401  (reference: paddle.version module)
+
+__version__ = version.full_version
 
 import jax as _jax
 

@@ -1,0 +1,115 @@
+"""paddle.version (reference: the module setup.py write_version_py
+generates at build time, python/paddle/version/__init__.py). Here the
+fields are authored directly — there is no codegen step — and the
+CUDA/XPU backend queries answer honestly for the TPU build (False, as
+the reference's CPU build does for cuda())."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+try:
+    from importlib.metadata import version as _pkg_version
+
+    full_version = _pkg_version("paddlepaddle-tpu")
+except Exception:
+    full_version = "0.4.0"       # source of truth: pyproject.toml
+major, minor, patch = (full_version.split(".") + ["0", "0"])[:3]
+rc = "0"
+nccl_version = "0"
+cuda_version = "False"
+cudnn_version = "False"
+xpu_xre_version = "False"
+xpu_xccl_version = "False"
+xpu_xhpc_version = "False"
+is_tagged = False
+with_mkl = "OFF"
+cinn_version = "False"
+tensorrt_version = "False"
+tpu_backend = "jax/XLA/Pallas"
+
+__all__ = ["cuda", "cudnn", "nccl", "show", "xpu", "xpu_xre", "xpu_xccl",
+           "xpu_xhpc", "tensorrt", "cuda_archs"]
+
+
+_commit_cache = None
+
+
+def _commit():
+    """Lazy + cached: resolved from THIS package's checkout (not the
+    importer's cwd), only when `commit` is first read."""
+    global _commit_cache
+    if _commit_cache is None:
+        try:
+            _commit_cache = subprocess.run(
+                ["git", "rev-parse", "HEAD"], capture_output=True,
+                text=True, timeout=5,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            ).stdout.strip() or "unknown"
+        except Exception:
+            _commit_cache = "unknown"
+    return _commit_cache
+
+
+def __getattr__(name):
+    if name == "commit":
+        return _commit()
+    raise AttributeError(name)
+
+
+def show():
+    """Print the version record (reference version.show contract)."""
+    if is_tagged:
+        print("full_version:", full_version)
+        print("major:", major)
+        print("minor:", minor)
+        print("patch:", patch)
+        print("rc:", rc)
+    else:
+        print("commit:", _commit())
+    print("cuda:", cuda_version)
+    print("cudnn:", cudnn_version)
+    print("nccl:", nccl_version)
+    print("xpu_xre:", xpu_xre_version)
+    print("xpu_xccl:", xpu_xccl_version)
+    print("xpu_xhpc:", xpu_xhpc_version)
+    print("cinn:", cinn_version)
+    print("tensorrt:", tensorrt_version)
+    print("tpu_backend:", tpu_backend)
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version
+
+
+def nccl():
+    return nccl_version
+
+
+def xpu():
+    return xpu_xhpc_version
+
+
+def xpu_xre():
+    return xpu_xre_version
+
+
+def xpu_xccl():
+    return xpu_xccl_version
+
+
+def xpu_xhpc():
+    return xpu_xhpc_version
+
+
+def tensorrt():
+    return tensorrt_version
+
+
+def cuda_archs():
+    return []
