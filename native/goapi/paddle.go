@@ -152,6 +152,13 @@ type Tensor struct {
 }
 
 func (t *Tensor) Reshape(shape []int32) {
+	if len(shape) == 0 {
+		// rank-0: pass a valid (ignored) pointer rather than &shape[0],
+		// which panics on an empty slice
+		var dummy C.int32_t
+		C.PD_TensorReshape(t.t, 0, &dummy)
+		return
+	}
 	C.PD_TensorReshape(t.t, C.size_t(len(shape)), (*C.int32_t)(unsafe.Pointer(&shape[0])))
 }
 
@@ -168,6 +175,20 @@ func (t *Tensor) Shape() []int32 {
 func (t *Tensor) DataType() DataType { return DataType(C.PD_TensorGetDataType(t.t)) }
 func (t *Tensor) Name() string       { return C.GoString(C.PD_TensorGetName(t.t)) }
 
+func sliceLen(data interface{}) int {
+	switch v := data.(type) {
+	case []float32:
+		return len(v)
+	case []int64:
+		return len(v)
+	case []int32:
+		return len(v)
+	case []uint8:
+		return len(v)
+	}
+	return -1 // unknown type: let the switch in the caller report it
+}
+
 func (t *Tensor) numel() int {
 	n := 1
 	for _, d := range t.Shape() {
@@ -177,8 +198,13 @@ func (t *Tensor) numel() int {
 }
 
 // CopyFromCpu uploads host data ([]float32, []int64, []int32 or []uint8)
-// into the input tensor; call Reshape first.
+// into the input tensor; call Reshape first. A zero-length slice is a
+// successful no-op (a zero-numel tensor's buffer IS empty; taking &v[0]
+// of an empty slice would panic).
 func (t *Tensor) CopyFromCpu(data interface{}) error {
+	if n := sliceLen(data); n == 0 {
+		return nil
+	}
 	switch v := data.(type) {
 	case []float32:
 		C.PD_TensorCopyFromCpuFloat(t.t, (*C.float)(unsafe.Pointer(&v[0])))
@@ -196,8 +222,13 @@ func (t *Tensor) CopyFromCpu(data interface{}) error {
 }
 
 // CopyToCpu downloads the output tensor into a pre-sized slice of the
-// matching element type.
+// matching element type. A zero-length slice is a successful no-op (a
+// zero-numel tensor has nothing to copy; taking &v[0] of an empty slice
+// would panic).
 func (t *Tensor) CopyToCpu(data interface{}) error {
+	if n := sliceLen(data); n == 0 {
+		return nil
+	}
 	switch v := data.(type) {
 	case []float32:
 		C.PD_TensorCopyToCpuFloat(t.t, (*C.float)(unsafe.Pointer(&v[0])))

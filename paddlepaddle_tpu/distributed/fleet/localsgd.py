@@ -12,11 +12,12 @@ parameters every k steps instead of gradients every step cuts communication
 k-fold. This wrapper runs the inner optimizer locally and averages
 parameters over the host process group every ``k_steps``.
 
-DGC (deep gradient compression) from the same meta-optimizer family is
-documented ABSORBED: its purpose is taming slow-ethernet gradient traffic,
-while the data plane here is XLA collectives over ICI where compression
-would cost more than it saves; the DCN control plane ships small tensors
-only. (PARITY.md §2.7 records the decision.)
+DGC (deep gradient compression) from the same meta-optimizer family IS
+implemented: ``optimizer.DGCMomentumOptimizer`` keeps the reference
+kernel's momentum-correction + error-feedback top-k semantics
+(dgc_kernel.cu), while its allreduce stays dense — on ICI the bandwidth
+trick would cost more than it saves; see that class's docstring and
+tests/test_dgc.py.
 """
 
 from __future__ import annotations

@@ -5,7 +5,10 @@ worker.py + dataloader_iter.py).
 owns an index queue, runs ``dataset[i]`` + collate outside the parent's GIL
 (python-heavy transforms scale), and ships numpy batches back over a bounded
 data queue (pickle+pipe transport; the parent wraps leaves into Tensors and
-uploads to device, so forked children never touch the accelerator runtime).
+uploads to device, so worker children never touch the accelerator runtime).
+Workers start via ``forkserver`` by default (fork-safe under the parent's
+multithreaded JAX runtime — see ``_worker_context``); the
+``PADDLE_TPU_MP_START_METHOD`` env var selects fork/forkserver/spawn.
 ``worker_init_fn``/``persistent_workers`` are honored; iterable datasets see
 ``get_worker_info()`` for self-sharding (reference worker.py WorkerInfo).
 ``num_workers=0`` is fully synchronous; ``use_multiprocess=False`` keeps the
@@ -87,6 +90,88 @@ class _RemoteTraceback(RuntimeError):
     """Worker-side exception re-raised in the parent with the remote trace."""
 
 
+def _main_reimportable():
+    """True when spawn/forkserver worker prep can reconstruct __main__.
+
+    multiprocessing's spawn prep re-runs the parent's main module from its
+    file path. A parent fed from stdin (``python - <<EOF``) has
+    ``__main__.__file__ == '<stdin>'`` — a path that does not exist — so
+    every worker dies in ``_fixup_main_from_path``. Interactive REPLs
+    (no __file__ at all) are fine: prep skips the re-run.
+    """
+    import sys
+
+    main = sys.modules.get("__main__")
+    if main is None:
+        return True
+    path = getattr(main, "__file__", None)
+    if path is None:
+        return True  # REPL/embedded: spawn prep has nothing to re-run
+    return os.path.exists(path)
+
+
+def _worker_context(dataset, collate_fn, worker_init_fn):
+    """Pick the multiprocessing start method for worker processes.
+
+    Default is ``forkserver``: the parent embeds a multithreaded JAX
+    runtime, and ``os.fork`` of a multithreaded process can deadlock in a
+    child that inherits locks mid-acquire (the reference's workers are
+    spawn-capable for the same reason, python/paddle/io/dataloader/
+    worker.py). With forkserver, children fork from a clean single-threaded
+    server process, so the hazard disappears while startup stays cheaper
+    than full spawn. ``PADDLE_TPU_MP_START_METHOD`` overrides
+    (fork|forkserver|spawn); fork remains the opt-in for unpicklable
+    datasets. When the default is in effect and the worker payload cannot
+    pickle (e.g. a dataset class defined inside a function), we fall back
+    to fork with a warning instead of failing in ``Process.start()``.
+    """
+    method = os.environ.get("PADDLE_TPU_MP_START_METHOD", "").strip()
+    explicit = bool(method)
+    method = method or "forkserver"
+    if method != "fork" and not explicit and not _main_reimportable():
+        import warnings
+
+        warnings.warn(
+            "DataLoader workers: __main__ was not started from an "
+            "importable file (stdin/heredoc/embedded interpreter), which "
+            "the 'forkserver' start method cannot re-import in workers; "
+            "falling back to 'fork'. Run from a real script file (with "
+            "dataset definitions importable) to use forkserver.",
+            stacklevel=3)
+        method = "fork"
+    if method != "fork":
+        try:
+            # probe with the SAME pickler Process.start() uses, into a null
+            # sink — no multi-GB serialized copy is retained for large
+            # in-memory datasets
+            from multiprocessing.reduction import ForkingPickler
+
+            class _Null:
+                def write(self, b):
+                    return len(b)
+
+            ForkingPickler(_Null()).dump(
+                (dataset, collate_fn, worker_init_fn))
+        except Exception as e:
+            if explicit:
+                raise RuntimeError(
+                    f"DataLoader workers with start method '{method}' need "
+                    f"a picklable dataset/collate_fn/worker_init_fn: {e}. "
+                    "Define them at module level, or set "
+                    "PADDLE_TPU_MP_START_METHOD=fork.") from e
+            import warnings
+
+            warnings.warn(
+                "DataLoader worker payload is not picklable "
+                f"({type(e).__name__}: {e}); falling back to the 'fork' "
+                "start method. fork of a multithreaded JAX parent risks "
+                "child deadlock — prefer module-level dataset/collate/"
+                "init_fn definitions (or opt in explicitly via "
+                "PADDLE_TPU_MP_START_METHOD=fork).", stacklevel=3)
+            method = "fork"
+    return multiprocessing.get_context(method)
+
+
 def _to_np_leaves(obj):
     """Tensor/jax leaves -> numpy so batches pickle cleanly through the mp
     queue even when a user collate_fn builds device arrays in the worker."""
@@ -158,12 +243,14 @@ class _WorkerPool:
     reused pool discard leftovers from an abandoned epoch."""
 
     def __init__(self, dataset, collate_fn, worker_init_fn, num_workers,
-                 prefetch_factor, iterable, batch_size, drop_last):
+                 prefetch_factor, iterable, batch_size, drop_last,
+                 ctx=None):
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
         self.epoch = 0
-        ctx = multiprocessing.get_context(
-            os.environ.get("PADDLE_TPU_MP_START_METHOD", "fork"))
+        if ctx is None:
+            ctx = _worker_context(dataset, collate_fn, worker_init_fn)
+        self.start_method = ctx.get_start_method()
         self.index_queues = [ctx.Queue() for _ in range(num_workers)]
         self.data_queue = ctx.Queue(maxsize=num_workers * prefetch_factor)
         seed = int(np.random.randint(0, 2**31 - 1))
@@ -183,14 +270,46 @@ class _WorkerPool:
         return self.alive and all(p.is_alive() for p in self.procs)
 
     def get(self, timeout):
-        """One message for the CURRENT epoch (stale-epoch messages dropped)."""
+        """One message for the CURRENT epoch (stale-epoch messages dropped).
+
+        Polls in short slices so a worker that died WITHOUT posting an
+        error message (killed, or crashed in interpreter startup before
+        the loop) surfaces as an exception instead of a parent hang."""
+        waited = 0.0
         while True:
+            slice_t = min(timeout - waited, 1.0) if timeout else 1.0
             try:
-                msg = self.data_queue.get(timeout=timeout or None)
+                msg = self.data_queue.get(timeout=max(slice_t, 0.001))
             except queue.Empty:
-                raise _RemoteTraceback(
-                    f"DataLoader timed out after {timeout}s waiting for "
-                    "worker data") from None
+                if not self.alive:
+                    raise _RemoteTraceback(
+                        "DataLoader worker pool was shut down while an "
+                        "iterator was still reading from it")
+                if not self.healthy():
+                    dead = [w for w, p in enumerate(self.procs)
+                            if not p.is_alive()]
+                    codes = [self.procs[w].exitcode for w in dead]
+                    hint = ""
+                    if self.start_method != "fork" and codes and all(
+                            c == 1 for c in codes):
+                        hint = (
+                            " With the '%s' start method, a script that "
+                            "builds its DataLoader at module top level "
+                            "must guard it with `if __name__ == "
+                            "'__main__':` (workers re-import the main "
+                            "module); alternatively set "
+                            "PADDLE_TPU_MP_START_METHOD=fork."
+                            % self.start_method)
+                    raise _RemoteTraceback(
+                        f"DataLoader worker(s) {dead} died unexpectedly "
+                        f"(exitcode {codes}) without reporting an error — "
+                        f"e.g. killed, or crashed during startup.{hint}")
+                waited += slice_t
+                if timeout and waited >= timeout:
+                    raise _RemoteTraceback(
+                        f"DataLoader timed out after {timeout}s waiting "
+                        "for worker data") from None
+                continue
             kind, epoch, key, payload = msg
             if kind == "error" or epoch == self.epoch:
                 return kind, key, payload
@@ -264,6 +383,7 @@ class DataLoader:
         self.batch_size = batch_size
         self.drop_last = drop_last
         self._pool = None
+        self._mp_ctx = None  # resolved start-method context, cached per loader
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
             self.batch_sampler = None
@@ -342,11 +462,19 @@ class DataLoader:
                 return self._pool
             self._pool.shutdown()  # a worker died: never reuse a broken pool
             self._pool = None
+        if self._mp_ctx is None:
+            # resolve the start method (incl. the picklability probe, which
+            # serializes the whole payload) ONCE per DataLoader — the
+            # payload doesn't change between epochs, and a non-persistent
+            # loader rebuilds its pool every epoch
+            self._mp_ctx = _worker_context(
+                self.dataset, self._worker_collate, self.worker_init_fn)
         pool = _WorkerPool(self.dataset, self._worker_collate,
                            self.worker_init_fn, self.num_workers,
                            self.prefetch_factor, self._iterable,
                            self.batch_size if self._iterable else 0,
-                           self.drop_last if self._iterable else False)
+                           self.drop_last if self._iterable else False,
+                           ctx=self._mp_ctx)
         if self.persistent_workers:
             self._pool = pool
         return pool

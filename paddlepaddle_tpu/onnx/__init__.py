@@ -28,9 +28,10 @@ def export(layer, path, input_spec=None, opset_version=9, **configs):
     Slice/ReduceSum require >= 13); a lower ``opset_version`` (including
     the reference's default 9) is silently upgraded — opset 13 runtimes
     are a superset. ``input_spec`` entries may be InputSpec, Tensor,
-    or arrays; a None (batch) dim is traced at 1 and exported as a fixed
-    dim of 1 — XLA traces are shape-specialized, so a symbolic batch
-    would not be sound here.
+    or arrays; a None/-1 (batch) dim is traced at 1 — the trace itself is
+    shape-specialized — but the exported input ValueInfo carries a symbolic
+    ``dim_param`` for those axes, so consumer runtimes accept other sizes
+    when the traced ops are batch-agnostic (a warning notes the caveat).
     """
     import jax
 
@@ -63,13 +64,16 @@ def export(layer, path, input_spec=None, opset_version=9, **configs):
             f"{opset_version} was lowered to 13", stacklevel=2)
 
     def to_sds(spec):
+        """-> (ShapeDtypeStruct traced at 1 for dynamic dims, dynamic axes)."""
         shape = getattr(spec, "shape", None)
         if shape is not None and not isinstance(spec, (Tensor, np.ndarray)):
             dtype = np.dtype(getattr(spec, "dtype", "float32") or "float32")
+            dyn = tuple(ax for ax, d in enumerate(shape) if d in (None, -1))
             return jax.ShapeDtypeStruct(
-                tuple(1 if d in (None, -1) else int(d) for d in shape), dtype)
+                tuple(1 if d in (None, -1) else int(d) for d in shape),
+                dtype), dyn
         arr = spec.numpy() if isinstance(spec, Tensor) else np.asarray(spec)
-        return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+        return jax.ShapeDtypeStruct(arr.shape, arr.dtype), ()
 
     params = layer.functional_state()
     names = sorted(params)
@@ -84,11 +88,46 @@ def export(layer, path, input_spec=None, opset_version=9, **configs):
 
     sds_params = [jax.ShapeDtypeStruct(params[n].shape, params[n].dtype)
                   for n in names]
-    sds_inputs = [to_sds(s) for s in input_spec]
+    sds_and_dyn = [to_sds(s) for s in input_spec]
+    sds_inputs = [sd for sd, _ in sds_and_dyn]
+    dynamic_dims = {i: dyn for i, (_, dyn) in enumerate(sds_and_dyn) if dyn}
+    if dynamic_dims:
+        warnings.warn(
+            "onnx.export: input dims declared None/-1 were traced at size 1 "
+            "and exported as symbolic dim_param axes "
+            f"{ {in_i: list(axs) for in_i, axs in dynamic_dims.items()} }; "
+            "the graph runs at other sizes only where the traced ops are "
+            "shape-agnostic on those axes", stacklevel=2)
     was_training = layer.training
     layer.eval()
     try:
         closed = jax.make_jaxpr(fn)(sds_params, *sds_inputs)
+        out_dynamic = {}
+        if dynamic_dims:
+            # which OUTPUT axes track the dynamic inputs? retrace abstractly
+            # at size 2 and diff the out shapes (keeps the exported model
+            # internally consistent: inputs and outputs agree on what is
+            # symbolic). If the model can't trace at another size, the
+            # symbolic export is unsound — pin everything and say so.
+            try:
+                sds2 = [jax.ShapeDtypeStruct(
+                    tuple(2 if ax in dynamic_dims.get(i, ()) else d
+                          for ax, d in enumerate(sd.shape)), sd.dtype)
+                    for i, sd in enumerate(sds_inputs)]
+                closed2 = jax.make_jaxpr(fn)(sds_params, *sds2)
+                for oi, (v1, v2) in enumerate(zip(closed.jaxpr.outvars,
+                                                  closed2.jaxpr.outvars)):
+                    dyn = tuple(ax for ax, (a, b) in enumerate(
+                        zip(v1.aval.shape, v2.aval.shape)) if a != b)
+                    if dyn:
+                        out_dynamic[oi] = dyn
+            except Exception as e:
+                warnings.warn(
+                    "onnx.export: model does not trace at other sizes on "
+                    f"the declared dynamic axes ({type(e).__name__}: {e}); "
+                    "exporting FIXED dims instead of dim_param",
+                    stacklevel=2)
+                dynamic_dims = {}
     finally:
         if was_training:
             layer.train()
@@ -101,7 +140,9 @@ def export(layer, path, input_spec=None, opset_version=9, **configs):
     out_names = [f"y{i}" for i in range(n_out)]
     gb = _converter.convert(closed, in_names, out_names,
                             initializers=inits,
-                            graph_name=type(layer).__name__)
+                            graph_name=type(layer).__name__,
+                            dynamic_dims=dynamic_dims,
+                            output_dynamic_dims=out_dynamic)
     blob = _proto.model(gb, opset)
     d = os.path.dirname(os.path.abspath(path))
     if d:

@@ -34,7 +34,7 @@ class _Ctx:
         return f"{hint}_{self.counter}"
 
     def name_of(self, v):
-        from jax._src.core import Literal
+        from jax.extend.core import Literal
 
         if isinstance(v, Literal):
             return self.add_const(np.asarray(v.val, v.aval.dtype))
@@ -395,16 +395,25 @@ def _convert_body(ctx, jaxpr):
 
 
 def convert(closed_jaxpr, input_names, output_names, *,
-            initializers=None, graph_name="paddlepaddle_tpu"):
+            initializers=None, graph_name="paddlepaddle_tpu",
+            dynamic_dims=None, output_dynamic_dims=None):
     """Convert a ClosedJaxpr to serialized ONNX GraphProto bytes.
 
     initializers: {position_in_invars: (name, np_array)} — invars bound to
     fixed arrays (parameters) become graph initializers, the rest become
     graph inputs in order, named by ``input_names``.
+    dynamic_dims / output_dynamic_dims: {graph_input_index: axes} /
+    {output_index: axes} — axes exported as symbolic ``dim_param`` (e.g. a
+    batch dim the user declared None/-1) instead of the traced
+    ``dim_value``. Only the ValueInfo shapes are affected; the node graph
+    itself must be shape-agnostic on those axes for the artifact to
+    actually run at other sizes.
     """
     jaxpr = closed_jaxpr.jaxpr
     ctx = _Ctx()
     initializers = initializers or {}
+    dynamic_dims = dynamic_dims or {}
+    output_dynamic_dims = output_dynamic_dims or {}
     for cv, cval in zip(jaxpr.constvars, closed_jaxpr.consts):
         ctx.names[id(cv)] = ctx.add_const(np.asarray(cval), "closure")
 
@@ -417,16 +426,23 @@ def convert(closed_jaxpr, input_names, output_names, *,
             ctx.inits.append(P.tensor_proto(name, np.asarray(arr)))
         else:
             name = next(it_names)
+            idx = len(g_inputs)
+            dyn = set(dynamic_dims.get(idx, ()))
+            shape = [f"{name}_dim{ax}" if ax in dyn else d
+                     for ax, d in enumerate(v.aval.shape)]
             ctx.names[id(v)] = name
             g_inputs.append(P.value_info(
                 name, P._NP_TO_ONNX[np.dtype(v.aval.dtype).name],
-                v.aval.shape))
+                shape))
 
     _convert_body(ctx, jaxpr)
 
     g_outputs = []
-    for name, v in zip(output_names, jaxpr.outvars):
+    for oi, (name, v) in enumerate(zip(output_names, jaxpr.outvars)):
         ctx.emit("Identity", [ctx.name_of(v)], [name])
+        dyn = set(output_dynamic_dims.get(oi, ()))
+        shape = [f"{name}_dim{ax}" if ax in dyn else d
+                 for ax, d in enumerate(v.aval.shape)]
         g_outputs.append(P.value_info(
-            name, P._NP_TO_ONNX[np.dtype(v.aval.dtype).name], v.aval.shape))
+            name, P._NP_TO_ONNX[np.dtype(v.aval.dtype).name], shape))
     return P.graph(ctx.nodes, graph_name, ctx.inits, g_inputs, g_outputs)

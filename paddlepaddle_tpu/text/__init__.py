@@ -83,7 +83,9 @@ def viterbi_decode(potentials, transition_params, lengths,
     kernel phi/kernels/cpu/viterbi_decode_kernel.cc): max-sum over the tag
     lattice with per-sequence lengths. With ``include_bos_eos_tag`` the
     LAST transition row is the start tag (added at t=0) and the
-    SECOND-TO-LAST column the stop tag (added at each sequence's end).
+    SECOND-TO-LAST row the stop tag (added at each sequence's end; the
+    reference oracle adds ``trans_exp[:, stop_idx]`` on a ``[1, N, N]``
+    expansion, i.e. row ``trans[-2, :]``).
     Returns (scores [B], paths [B, max(lengths)] int64, zero-padded past
     each sequence's length) — the path is truncated to the batch's max
     length exactly as the kernel sizes its output."""
@@ -109,7 +111,7 @@ def viterbi_decode(potentials, transition_params, lengths,
         alpha = jnp.where(live, cand, alpha)
         bps.append(bp)
 
-    final = alpha + (trans[:, -2][None, :] if include_bos_eos_tag else 0.0)
+    final = alpha + (trans[-2][None, :] if include_bos_eos_tag else 0.0)
     scores = jnp.max(final, -1)
     tags = jnp.argmax(final, -1).astype(jnp.int32)
 

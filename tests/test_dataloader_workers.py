@@ -1,9 +1,15 @@
 """Subprocess DataLoader workers (reference: python/paddle/io/dataloader/
 worker.py, reader.py:262): GIL-escaping throughput, worker_init_fn,
-persistent workers, and IterableDataset self-sharding via get_worker_info."""
+persistent workers, and IterableDataset self-sharding via get_worker_info.
+
+Datasets are defined at module level so the default ``forkserver`` start
+method (fork-safe under the multithreaded JAX parent) can pickle them; one
+test covers the documented fallback-to-fork path for local classes.
+"""
 
 import os
 import time
+from pathlib import Path
 
 import numpy as np
 import pytest
@@ -30,6 +36,67 @@ class _PyHeavy(Dataset):
         return self.n
 
 
+class _ArangeDs(Dataset):
+    def __init__(self, n=10):
+        self.n = n
+
+    def __getitem__(self, i):
+        info = get_worker_info()
+        assert info is not None and 0 <= info.id < 2
+        return np.array([i], np.int64)
+
+    def __len__(self):
+        return self.n
+
+
+class _PidDs(Dataset):
+    def __getitem__(self, i):
+        return np.array([os.getpid(), i], np.int64)
+
+    def __len__(self):
+        return 8
+
+
+class _PlainDs(Dataset):
+    def __init__(self, n=16):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.array([i], np.int64)
+
+    def __len__(self):
+        return self.n
+
+
+class _BadDs(Dataset):
+    def __getitem__(self, i):
+        if i == 3:
+            raise RuntimeError("boom")
+        return np.array([i], np.int64)
+
+    def __len__(self):
+        return 8
+
+
+class _Stream(IterableDataset):
+    def __iter__(self):
+        info = get_worker_info()
+        lo, hi = 0, 16
+        if info is not None:  # reference pattern: shard by worker id
+            per = (hi - lo) // info.num_workers
+            lo = info.id * per
+            hi = lo + per
+        for i in range(lo, hi):
+            yield np.array([i], np.int64)
+
+
+_init_calls = []
+
+
+def _init_fn(worker_id):
+    _init_calls.append(worker_id)  # runs in the child (parent list stays empty)
+
+
 def _time(loader):
     t0 = time.perf_counter()
     out = [b.numpy() for b in loader]
@@ -49,37 +116,16 @@ def test_subprocess_beats_threads_on_python_heavy():
 
 
 def test_worker_init_fn_and_order():
-    calls = []
-
-    class Ds(Dataset):
-        def __getitem__(self, i):
-            info = get_worker_info()
-            assert info is not None and 0 <= info.id < 2
-            return np.array([i], np.int64)
-
-        def __len__(self):
-            return 10
-
-    def init_fn(worker_id):
-        calls.append(worker_id)  # runs in the child (parent list stays empty)
-
-    loader = DataLoader(Ds(), batch_size=2, num_workers=2,
-                        worker_init_fn=init_fn)
+    loader = DataLoader(_ArangeDs(), batch_size=2, num_workers=2,
+                        worker_init_fn=_init_fn)
     flat = np.concatenate([b.numpy().ravel() for b in loader])
     np.testing.assert_array_equal(flat, np.arange(10))
-    assert calls == []  # init ran in workers, not the parent
+    assert _init_calls == []  # init ran in workers, not the parent
     assert get_worker_info() is None  # main process sees None
 
 
 def test_persistent_workers_reuse_pool():
-    class Ds(Dataset):
-        def __getitem__(self, i):
-            return np.array([os.getpid(), i], np.int64)
-
-        def __len__(self):
-            return 8
-
-    loader = DataLoader(Ds(), batch_size=2, num_workers=2,
+    loader = DataLoader(_PidDs(), batch_size=2, num_workers=2,
                         persistent_workers=True)
     pids1 = {int(b.numpy()[0, 0]) for b in loader}
     pool1 = loader._pool
@@ -93,14 +139,8 @@ def test_persistent_workers_reuse_pool():
 def test_abandoned_epoch_does_not_leak_stale_batches():
     """Early break with persistent workers: the next epoch must start from
     batch 0, discarding leftovers of the abandoned epoch (epoch-tag filter)."""
-    class Ds(Dataset):
-        def __getitem__(self, i):
-            return np.array([i], np.int64)
-
-        def __len__(self):
-            return 16
-
-    dl = DataLoader(Ds(), batch_size=2, num_workers=2, persistent_workers=True)
+    dl = DataLoader(_PlainDs(), batch_size=2, num_workers=2,
+                    persistent_workers=True)
     it = iter(dl)
     np.testing.assert_array_equal(next(it).numpy().ravel(), [0, 1])
     del it  # abandon mid-epoch
@@ -112,16 +152,7 @@ def test_abandoned_epoch_does_not_leak_stale_batches():
 def test_dead_worker_pool_is_replaced_not_hung():
     """A worker exception kills its process; a persistent pool must be torn
     down (retry gets fresh workers) instead of hanging on a dead queue."""
-    class Bad(Dataset):
-        def __getitem__(self, i):
-            if i == 3:
-                raise RuntimeError("boom")
-            return np.array([i], np.int64)
-
-        def __len__(self):
-            return 8
-
-    dl = DataLoader(Bad(), batch_size=2, num_workers=2,
+    dl = DataLoader(_BadDs(), batch_size=2, num_workers=2,
                     persistent_workers=True)
     with pytest.raises(RuntimeError, match="boom"):
         list(dl)
@@ -129,17 +160,85 @@ def test_dead_worker_pool_is_replaced_not_hung():
 
 
 def test_iterable_dataset_self_sharding():
-    class Stream(IterableDataset):
-        def __iter__(self):
-            info = get_worker_info()
-            lo, hi = 0, 16
-            if info is not None:  # reference pattern: shard by worker id
-                per = (hi - lo) // info.num_workers
-                lo = info.id * per
-                hi = lo + per
-            for i in range(lo, hi):
-                yield np.array([i], np.int64)
-
-    loader = DataLoader(Stream(), batch_size=2, num_workers=2)
+    loader = DataLoader(_Stream(), batch_size=2, num_workers=2)
     got = sorted(int(x) for b in loader for x in b.numpy().ravel())
     assert got == list(range(16))  # every element exactly once
+
+
+def test_unpicklable_dataset_falls_back_to_fork_with_warning():
+    """A dataset class defined inside a function cannot pickle for the
+    default forkserver start method; the loader must warn and fall back to
+    fork rather than dying in Process.start()."""
+    class Local(Dataset):
+        def __getitem__(self, i):
+            return np.array([i], np.int64)
+
+        def __len__(self):
+            return 6
+
+    with pytest.warns(UserWarning, match="falling back to the 'fork'"):
+        loader = DataLoader(Local(), batch_size=2, num_workers=2)
+        flat = np.concatenate([b.numpy().ravel() for b in loader])
+    np.testing.assert_array_equal(flat, np.arange(6))
+
+
+def test_explicit_spawn_with_unpicklable_dataset_raises(monkeypatch):
+    class Local(Dataset):
+        def __getitem__(self, i):
+            return np.array([i], np.int64)
+
+        def __len__(self):
+            return 4
+
+    monkeypatch.setenv("PADDLE_TPU_MP_START_METHOD", "spawn")
+    with pytest.raises(RuntimeError, match="picklable"):
+        list(DataLoader(Local(), batch_size=2, num_workers=2))
+
+
+def test_explicit_fork_still_works(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_MP_START_METHOD", "fork")
+    loader = DataLoader(_PlainDs(8), batch_size=2, num_workers=2)
+    flat = np.concatenate([b.numpy().ravel() for b in loader])
+    np.testing.assert_array_equal(flat, np.arange(8))
+
+
+def test_killed_worker_raises_instead_of_hanging():
+    """A worker that dies WITHOUT posting an error (SIGKILL, startup crash)
+    must surface as an exception from the health poll, not a parent hang."""
+    import signal
+
+    dl = DataLoader(_PyHeavy(n=64, work=2_000_000), batch_size=2,
+                    num_workers=2, persistent_workers=True)
+    it = iter(dl)
+    next(it)
+    os.kill(dl._pool.procs[0].pid, signal.SIGKILL)
+    with pytest.raises(RuntimeError, match="died unexpectedly"):
+        for _ in it:
+            pass
+    dl._pool.shutdown()
+
+
+def test_stdin_main_falls_back_to_fork():
+    """A parent whose __main__ came from stdin (heredoc) cannot re-import
+    it in forkserver workers; the loader must fall back to fork, warn, and
+    still deliver batches (r5 verify finding)."""
+    import subprocess
+    import sys
+
+    script = (
+        "import sys, warnings, numpy as np\n"
+        f"sys.path.insert(0, {repr(str(Path(__file__).parent))})\n"
+        "from paddlepaddle_tpu.io import DataLoader\n"
+        "import test_dataloader_workers as tw\n"
+        "with warnings.catch_warnings(record=True) as w:\n"
+        "    warnings.simplefilter('always')\n"
+        "    dl = DataLoader(tw._PlainDs(6), batch_size=2, num_workers=2)\n"
+        "    got = np.concatenate([b.numpy().ravel() for b in dl])\n"
+        "assert got.tolist() == [0, 1, 2, 3, 4, 5], got\n"
+        "assert any('falling back' in str(x.message) for x in w)\n"
+        "print('OK')\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+               PYTHONPATH=str(Path(__file__).parent.parent))
+    r = subprocess.run([sys.executable, "-"], input=script, text=True,
+                       capture_output=True, env=env, timeout=240)
+    assert r.returncode == 0 and "OK" in r.stdout, (r.stdout, r.stderr)

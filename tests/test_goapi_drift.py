@@ -1,0 +1,119 @@
+"""Go-binding drift guard (r4 verdict item 7).
+
+The image has no Go toolchain, so ``native/goapi/paddle.go`` can never be
+compiled in CI — this test makes API drift a test failure instead of a
+user-side build break. Three surfaces must agree on every ``PD_*`` symbol:
+
+  header  ``native/goapi/paddle_inference_c.h``   (declarations)
+  cpp     ``native/paddle_inference_c.cpp``       (extern "C" definitions;
+          the cpp #includes the header, so *mismatched* signatures are a
+          compile error — but a *missing* definition would only surface as
+          a link error on a user's machine)
+  go      ``native/goapi/paddle.go``              (cgo call sites)
+
+The checks are symbol-set and call-arity agreement, which is exactly the
+class of drift cgo cannot catch before link time.
+"""
+
+import re
+from pathlib import Path
+
+NATIVE = Path(__file__).resolve().parent.parent / "native"
+HEADER = NATIVE / "goapi" / "paddle_inference_c.h"
+CPP = NATIVE / "paddle_inference_c.cpp"
+GO = NATIVE / "goapi" / "paddle.go"
+
+
+def _strip_comments(text):
+    text = re.sub(r"/\*.*?\*/", " ", text, flags=re.S)
+    return re.sub(r"//[^\n]*", " ", text)
+
+
+def _arity(argstr):
+    argstr = argstr.strip()
+    if argstr in ("", "void"):
+        return 0
+    return argstr.count(",") + 1
+
+
+def header_decls():
+    """{name: arity} for every PD_* function declared in the header."""
+    text = _strip_comments(HEADER.read_text())
+    out = {}
+    for m in re.finditer(r"\b(PD_\w+)\s*\(([^)]*)\)\s*;", text):
+        out[m.group(1)] = _arity(m.group(2))
+    # typedef struct names (PD_Config etc.) don't match: they have no '('
+    return out
+
+
+def cpp_defs():
+    """{name: arity} for every PD_* function DEFINED (body, not ';')."""
+    text = _strip_comments(CPP.read_text())
+    out = {}
+    for m in re.finditer(r"\b(PD_\w+)\s*\(([^)]*)\)\s*\{", text):
+        out[m.group(1)] = _arity(m.group(2))
+    return out
+
+
+def go_calls():
+    """[(name, arity)] for every cgo C.PD_*(...) call site in paddle.go
+    (balanced-paren scan: casts like (*C.int32_t)(...) nest)."""
+    text = GO.read_text()
+    calls = []
+    for m in re.finditer(r"\bC\.(PD_\w+)\(", text):
+        name = m.group(1)
+        i, depth, args, top_commas = m.end(), 1, text[m.end():], 0
+        n = 0
+        for j, ch in enumerate(args):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    body = args[:j]
+                    n = 0 if not body.strip() else top_commas + 1
+                    break
+            elif ch == "," and depth == 1:
+                top_commas += 1
+        else:
+            raise AssertionError(f"unbalanced parens after C.{name}(")
+        calls.append((name, n))
+    return calls
+
+
+def test_header_parses_expected_surface():
+    decls = header_decls()
+    assert len(decls) >= 25, sorted(decls)  # sanity: parser found the API
+    assert decls["PD_ConfigCreate"] == 0
+    assert decls["PD_TensorReshape"] == 3
+
+
+def test_every_header_symbol_is_defined_in_cpp():
+    decls, defs = header_decls(), cpp_defs()
+    missing = sorted(set(decls) - set(defs))
+    assert not missing, f"declared but never defined (link break): {missing}"
+    drift = {n: (decls[n], defs[n]) for n in decls if decls[n] != defs[n]}
+    assert not drift, f"header/cpp arity drift: {drift}"
+
+
+def test_every_go_call_matches_header():
+    decls = header_decls()
+    calls = go_calls()
+    assert calls, "no cgo calls parsed from paddle.go"
+    unknown = sorted({n for n, _ in calls} - set(decls))
+    assert not unknown, f"paddle.go calls undeclared symbols: {unknown}"
+    drift = [(n, a, decls[n]) for n, a in calls if a != decls[n]]
+    assert not drift, (
+        "cgo call arity != header arity (call, got, want): " + repr(drift))
+
+
+def test_go_covers_the_predictor_surface():
+    """The binding must keep wrapping the core lifecycle; dropping a call
+    silently (e.g. the Destroy or LastError path) is also drift."""
+    used = {n for n, _ in go_calls()}
+    for required in ["PD_ConfigCreate", "PD_PredictorCreate",
+                     "PD_PredictorDestroy", "PD_PredictorRun",
+                     "PD_PredictorGetLastError", "PD_TensorReshape",
+                     "PD_TensorCopyFromCpuFloat", "PD_TensorCopyToCpuFloat",
+                     "PD_OneDimArrayCstrDestroy"]:
+        assert required in used, f"paddle.go no longer calls {required}"

@@ -413,3 +413,26 @@ def test_export_unsupported_primitive_raises(tmp_path):
 def test_export_path_validation(tmp_path):
     with pytest.raises(ValueError, match="file_prefix"):
         paddle.onnx.export(nn.Linear(2, 2), str(tmp_path) + "/")
+
+
+def test_export_dynamic_batch_dim_param(tmp_path):
+    """A None/-1 input-spec dim exports as a symbolic dim_param (not a
+    fixed 1) and the pinning is warned about (r4 advisor finding)."""
+    from paddlepaddle_tpu.static import InputSpec
+
+    mlp = nn.Sequential(nn.Linear(8, 4), nn.ReLU())
+    with pytest.warns(UserWarning, match="dim_param"):
+        paddle.onnx.export(
+            mlp, str(tmp_path / "dyn"),
+            input_spec=[InputSpec([None, 8], "float32", "x")])
+    m = load_model(str(tmp_path / "dyn") + ".onnx")
+    (name, _elem, dims), = m["inputs"]
+    assert name == "x0"
+    assert isinstance(dims[0], str) and dims[1] == 8
+    # outputs must agree on what is symbolic (consistent shape inference)
+    (oname, _oelem, odims), = m["outputs"]
+    assert isinstance(odims[0], str) and odims[1] == 4
+    # the traced graph is batch-agnostic for an MLP: runs at batch 3
+    x = np.random.default_rng(3).standard_normal((3, 8)).astype(np.float32)
+    got = run_model(m, {"x0": x})
+    assert got[0].shape == (3, 4)

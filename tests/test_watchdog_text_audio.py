@@ -180,7 +180,9 @@ def test_viterbi_decode_vs_bruteforce():
                 for t in range(1, L):
                     s += trans[seq[t - 1], seq[t]] + pot[b, t, seq[t]]
                 if include:
-                    s += trans[seq[-1], -2]
+                    # reference oracle: trans_exp[:, stop_idx] on a [1,N,N]
+                    # expansion = ROW trans[-2, :] indexed by the final tag
+                    s += trans[-2, seq[-1]]
                 if s > best:
                     best, best_seq = s, seq
             np.testing.assert_allclose(scores[b], best, rtol=1e-5,
