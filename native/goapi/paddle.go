@@ -198,12 +198,16 @@ func (t *Tensor) numel() int {
 }
 
 // CopyFromCpu uploads host data ([]float32, []int64, []int32 or []uint8)
-// into the input tensor; call Reshape first. A zero-length slice is a
-// successful no-op (a zero-numel tensor's buffer IS empty; taking &v[0]
-// of an empty slice would panic).
+// into the input tensor; call Reshape first. For a zero-numel tensor the
+// empty slice is the correct buffer and the copy is a successful no-op;
+// an empty slice for a non-empty tensor is an error (taking &v[0] of an
+// empty slice would panic).
 func (t *Tensor) CopyFromCpu(data interface{}) error {
 	if n := sliceLen(data); n == 0 {
-		return nil
+		if t.numel() == 0 {
+			return nil
+		}
+		return errors.New("paddle: CopyFromCpu got an empty slice for a non-empty tensor")
 	}
 	switch v := data.(type) {
 	case []float32:
@@ -222,12 +226,16 @@ func (t *Tensor) CopyFromCpu(data interface{}) error {
 }
 
 // CopyToCpu downloads the output tensor into a pre-sized slice of the
-// matching element type. A zero-length slice is a successful no-op (a
-// zero-numel tensor has nothing to copy; taking &v[0] of an empty slice
-// would panic).
+// matching element type. For a zero-numel tensor the empty slice is the
+// correct buffer and the copy is a successful no-op; an empty slice for
+// a non-empty tensor is an error (taking &v[0] of an empty slice would
+// panic).
 func (t *Tensor) CopyToCpu(data interface{}) error {
 	if n := sliceLen(data); n == 0 {
-		return nil
+		if t.numel() == 0 {
+			return nil
+		}
+		return errors.New("paddle: CopyToCpu got an empty slice for a non-empty tensor")
 	}
 	switch v := data.(type) {
 	case []float32:

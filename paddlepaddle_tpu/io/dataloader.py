@@ -90,6 +90,19 @@ class _RemoteTraceback(RuntimeError):
     """Worker-side exception re-raised in the parent with the remote trace."""
 
 
+def _is_pickle_error(e):
+    """True for the exception shapes CPython's picklers raise on an
+    unpicklable object (PicklingError, or the TypeError/AttributeError
+    'cannot pickle X' / "Can't pickle local object" family)."""
+    import pickle
+
+    if isinstance(e, pickle.PicklingError):
+        return True
+    return (isinstance(e, (TypeError, AttributeError))
+            and ("pickle" in str(e) or "local object" in str(e)
+                 or "local class" in str(e)))
+
+
 def _main_reimportable():
     """True when spawn/forkserver worker prep can reconstruct __main__.
 
@@ -110,7 +123,7 @@ def _main_reimportable():
     return os.path.exists(path)
 
 
-def _worker_context(dataset, collate_fn, worker_init_fn):
+def _worker_context():
     """Pick the multiprocessing start method for worker processes.
 
     Default is ``forkserver``: the parent embeds a multithreaded JAX
@@ -121,9 +134,12 @@ def _worker_context(dataset, collate_fn, worker_init_fn):
     server process, so the hazard disappears while startup stays cheaper
     than full spawn. ``PADDLE_TPU_MP_START_METHOD`` overrides
     (fork|forkserver|spawn); fork remains the opt-in for unpicklable
-    datasets. When the default is in effect and the worker payload cannot
-    pickle (e.g. a dataset class defined inside a function), we fall back
-    to fork with a warning instead of failing in ``Process.start()``.
+    datasets. Returns (ctx, explicit).
+
+    Unpicklable payloads are NOT probed here: spawn/forkserver contexts
+    pickle worker args synchronously in the parent's ``Process.start()``,
+    so _WorkerPool catches the failure there and falls back to fork —
+    no extra full-payload serialization pass for multi-GB datasets.
     """
     method = os.environ.get("PADDLE_TPU_MP_START_METHOD", "").strip()
     explicit = bool(method)
@@ -139,37 +155,7 @@ def _worker_context(dataset, collate_fn, worker_init_fn):
             "dataset definitions importable) to use forkserver.",
             stacklevel=3)
         method = "fork"
-    if method != "fork":
-        try:
-            # probe with the SAME pickler Process.start() uses, into a null
-            # sink — no multi-GB serialized copy is retained for large
-            # in-memory datasets
-            from multiprocessing.reduction import ForkingPickler
-
-            class _Null:
-                def write(self, b):
-                    return len(b)
-
-            ForkingPickler(_Null()).dump(
-                (dataset, collate_fn, worker_init_fn))
-        except Exception as e:
-            if explicit:
-                raise RuntimeError(
-                    f"DataLoader workers with start method '{method}' need "
-                    f"a picklable dataset/collate_fn/worker_init_fn: {e}. "
-                    "Define them at module level, or set "
-                    "PADDLE_TPU_MP_START_METHOD=fork.") from e
-            import warnings
-
-            warnings.warn(
-                "DataLoader worker payload is not picklable "
-                f"({type(e).__name__}: {e}); falling back to the 'fork' "
-                "start method. fork of a multithreaded JAX parent risks "
-                "child deadlock — prefer module-level dataset/collate/"
-                "init_fn definitions (or opt in explicitly via "
-                "PADDLE_TPU_MP_START_METHOD=fork).", stacklevel=3)
-            method = "fork"
-    return multiprocessing.get_context(method)
+    return multiprocessing.get_context(method), explicit
 
 
 def _to_np_leaves(obj):
@@ -244,27 +230,70 @@ class _WorkerPool:
 
     def __init__(self, dataset, collate_fn, worker_init_fn, num_workers,
                  prefetch_factor, iterable, batch_size, drop_last,
-                 ctx=None):
+                 ctx=None, explicit_method=False):
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
         self.epoch = 0
         if ctx is None:
-            ctx = _worker_context(dataset, collate_fn, worker_init_fn)
+            ctx, explicit_method = _worker_context()
+        self.alive = False
+        try:
+            self._build(ctx, dataset, collate_fn, worker_init_fn,
+                        iterable, batch_size, drop_last)
+        except Exception as e:
+            # spawn/forkserver contexts pickle the worker args synchronously
+            # in the parent's Process.start() — an unpicklable payload
+            # (e.g. a dataset class defined inside a function) lands here,
+            # with zero extra serialization cost in the happy path
+            self._teardown_partial()
+            if ctx.get_start_method() == "fork" or not _is_pickle_error(e):
+                raise
+            if explicit_method:
+                raise RuntimeError(
+                    f"DataLoader workers with start method "
+                    f"'{ctx.get_start_method()}' need a picklable "
+                    f"dataset/collate_fn/worker_init_fn: {e}. Define them "
+                    "at module level, or set "
+                    "PADDLE_TPU_MP_START_METHOD=fork.") from e
+            import warnings
+
+            warnings.warn(
+                "DataLoader worker payload is not picklable "
+                f"({type(e).__name__}: {e}); falling back to the 'fork' "
+                "start method. fork of a multithreaded JAX parent risks "
+                "child deadlock — prefer module-level dataset/collate/"
+                "init_fn definitions (or opt in explicitly via "
+                "PADDLE_TPU_MP_START_METHOD=fork).", stacklevel=2)
+            self._build(multiprocessing.get_context("fork"), dataset,
+                        collate_fn, worker_init_fn, iterable, batch_size,
+                        drop_last)
+        self.alive = True
+
+    def _build(self, ctx, dataset, collate_fn, worker_init_fn, iterable,
+               batch_size, drop_last):
+        self.ctx = ctx
         self.start_method = ctx.get_start_method()
-        self.index_queues = [ctx.Queue() for _ in range(num_workers)]
-        self.data_queue = ctx.Queue(maxsize=num_workers * prefetch_factor)
+        self.index_queues = [ctx.Queue() for _ in range(self.num_workers)]
+        self.data_queue = ctx.Queue(
+            maxsize=self.num_workers * self.prefetch_factor)
         seed = int(np.random.randint(0, 2**31 - 1))
         self.procs = []
-        for w in range(num_workers):
+        for w in range(self.num_workers):
             p = ctx.Process(
                 target=_worker_loop,
                 args=(dataset, self.index_queues[w], self.data_queue,
-                      collate_fn, worker_init_fn, w, num_workers, seed,
+                      collate_fn, worker_init_fn, w, self.num_workers, seed,
                       iterable, batch_size, drop_last),
                 daemon=True)
             p.start()
             self.procs.append(p)
-        self.alive = True
+
+    def _teardown_partial(self):
+        for p in getattr(self, "procs", []):
+            try:
+                p.terminate()
+            except Exception:
+                pass
 
     def healthy(self) -> bool:
         return self.alive and all(p.is_alive() for p in self.procs)
@@ -463,18 +492,19 @@ class DataLoader:
             self._pool.shutdown()  # a worker died: never reuse a broken pool
             self._pool = None
         if self._mp_ctx is None:
-            # resolve the start method (incl. the picklability probe, which
-            # serializes the whole payload) ONCE per DataLoader — the
-            # payload doesn't change between epochs, and a non-persistent
-            # loader rebuilds its pool every epoch
-            self._mp_ctx = _worker_context(
-                self.dataset, self._worker_collate, self.worker_init_fn)
+            self._mp_ctx = _worker_context()
         pool = _WorkerPool(self.dataset, self._worker_collate,
                            self.worker_init_fn, self.num_workers,
                            self.prefetch_factor, self._iterable,
                            self.batch_size if self._iterable else 0,
                            self.drop_last if self._iterable else False,
-                           ctx=self._mp_ctx)
+                           ctx=self._mp_ctx[0],
+                           explicit_method=self._mp_ctx[1])
+        # remember the method the pool actually ended on (a picklability
+        # fallback to fork happens inside Process.start once; don't repeat
+        # the failed attempt — or its warning — every epoch)
+        if pool.start_method != self._mp_ctx[0].get_start_method():
+            self._mp_ctx = (pool.ctx, self._mp_ctx[1])
         if self.persistent_workers:
             self._pool = pool
         return pool
