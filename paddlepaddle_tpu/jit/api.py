@@ -67,11 +67,12 @@ class StaticFunction:
                 "to_static hit tensor-dependent python control flow the "
                 "dy2static subset could not convert (supported: tensor "
                 "`if` with branch assignments or both-branch returns, "
-                "tensor `while`, and `for i in range(<tensor>)` with a "
-                "static-shape carry; closures, break/continue/early-return "
-                "and attribute/subscript stores inside such blocks are not "
-                "converted — see jit/dy2static.py). Original: "
-                f"{e}") from None
+                "tensor `while`/`for i in range(<tensor>)`/`for x in "
+                "<tensor>` with a static-shape carry, break/continue under "
+                "tensor conditions, and single early-return-in-loop; "
+                "closures and attribute/subscript stores inside such "
+                "blocks are not converted — see jit/dy2static.py). "
+                f"Original: {e}") from None
 
     @property
     def code(self):

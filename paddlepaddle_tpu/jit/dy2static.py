@@ -12,6 +12,16 @@ This module implements that subset with one small AST pass:
 * ``if <tensor>:`` where BOTH branches end in ``return`` -> ``lax.cond``
   whose value is returned
 * ``while <tensor>:`` with assignments in the body    -> ``lax.while_loop``
+* ``break`` / ``continue`` under tensor conditions inside converted loops
+  -> the reference's bool-guard rewrite (break_continue_transformer.py:87):
+  a break/continue flag variable + guarded trailing statements, the flag
+  joined into the loop predicate
+* ``return e`` inside a loop whose enclosing block ends ``return f``
+  -> break-flag rewrite + a post-loop ``select(flag, e, f)``
+  (return_transformer.py role, single-return subset)
+* ``for x in <tensor>:`` -> runtime dispatch: tensor iterables lower to an
+  index loop over ``lax.while_loop`` (loop_transformer.py:473 role);
+  python iterables keep the original loop untouched
 * everything on python values stays untouched (trace-time control flow)
 
 Unsupported remainders raise ``Dy2StaticUnsupportedError`` with the pattern
@@ -31,6 +41,7 @@ from typing import Callable, List, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.tensor import Tensor
 
@@ -120,12 +131,18 @@ def while_(cond_fn: Callable, body_fn: Callable, carry):
     yields the same named error — python's unbound-local semantics,
     enforced."""
     carry = tuple(carry)
-    first = cond_fn(*carry)
-    p = _unwrap(first)
-    if not _is_traced(p):
-        while cond_fn(*carry):
-            carry = body_fn(*carry)
-        return carry
+    # python path: run eagerly while the predicate stays python-valued. A
+    # predicate that BECOMES traced mid-loop — e.g. a break flag first set
+    # under a tensor-`if`, so iteration 0 ran on python bools — hands the
+    # CURRENT carry to lax.while_loop: the finished iterations were traced
+    # inline (loop peeling), the rest run inside the lax op.
+    while True:
+        p = _unwrap(cond_fn(*carry))
+        if _is_traced(p):
+            break
+        if not p:
+            return carry
+        carry = tuple(body_fn(*carry))
     defined = [k for k, c in enumerate(carry)
                if not isinstance(c, _Undefined)]
 
@@ -150,6 +167,89 @@ def while_(cond_fn: Callable, body_fn: Callable, carry):
     for slot, v in zip(defined, _tree_wrap(out)):
         result[slot] = v
     return tuple(result)
+
+
+def true_():
+    """Break/continue flag constant. np.bool_ (not python bool) so the flag
+    has a stable strong dtype whether it stays python or joins a lax carry."""
+    return np.bool_(True)
+
+
+def false_():
+    return np.bool_(False)
+
+
+def not_(x):
+    p = _unwrap(x)
+    if _is_traced(p):
+        return jnp.logical_not(jnp.asarray(p).reshape(()))
+    return np.bool_(not p)
+
+
+def or_(a, b):
+    pa, pb = _unwrap(a), _unwrap(b)
+    if _is_traced(pa) or _is_traced(pb):
+        return jnp.logical_or(jnp.asarray(pa).reshape(()),
+                              jnp.asarray(pb).reshape(()))
+    return np.bool_(bool(pa) or bool(pb))
+
+
+def guard_and(brk, test_thunk):
+    """Loop predicate with the break flag joined in, SHORT-CIRCUITING like
+    python's `and`: once a python-valued break flag is set, the user's test
+    is NOT re-evaluated (it may index past the break point, as a real
+    `break` would have prevented). A traced flag evaluates both — inside a
+    lax trace everything is abstract and side-effect-free."""
+    nb = not_(brk)
+    if not _is_traced(nb):
+        if not nb:
+            return np.bool_(False)
+        return test_thunk()
+    return jnp.logical_and(
+        nb, jnp.asarray(_unwrap(test_thunk())).reshape(()))
+
+
+def select(flag, a_thunk, b_thunk):
+    """Post-loop early-return merge: a when the in-loop return fired, else
+    b — LAZY on the python path (a zero-trip loop must not evaluate the
+    in-loop return expression, whose loop variables were never bound).
+    A traced flag evaluates both sides: the loop's return expression
+    re-evaluates on the carried-out locals of the exiting iteration."""
+    p = _unwrap(flag)
+    if not _is_traced(p):
+        return a_thunk() if p else b_thunk()
+    p = jnp.asarray(p).reshape(())
+    try:
+        return _tree_wrap(jax.tree_util.tree_map(
+            lambda x, y: jnp.where(p, x, y),
+            _tree_unwrap(a_thunk()), _tree_unwrap(b_thunk())))
+    except (TypeError, ValueError) as e:
+        raise Dy2StaticUnsupportedError(
+            "an early `return` inside a tensor loop must produce the same "
+            "shape/dtype/structure as the function's final return "
+            f"(lax select contract): {e}") from None
+
+
+def is_tensor_seq(x):
+    """Dispatch test for `for x in <seq>`: tensor-valued iterables take the
+    index-loop lowering, python iterables keep the original python loop."""
+    return isinstance(x, Tensor) or _is_traced(x)
+
+
+def seq_len(x):
+    """Leading-dim length of a tensor iterable, as a TRACED scalar so the
+    synthesized range loop lowers to lax.while_loop instead of unrolling
+    shape[0] python iterations into the graph."""
+    d = _unwrap(x)
+    if getattr(d, "ndim", 0) == 0:
+        raise Dy2StaticUnsupportedError(
+            "`for` over a 0-d tensor: iteration needs a leading dimension")
+    return jnp.asarray(d.shape[0], jnp.int32)
+
+
+def seq_item(seq, k):
+    out = _unwrap(seq)[_unwrap(k)]
+    return Tensor._from_data(out) if isinstance(seq, Tensor) else out
 
 
 # ---------------------------------------------------------------------------
@@ -216,6 +316,90 @@ def _walk_scope(node):
 
 def _has(stmts, kinds) -> bool:
     return any(isinstance(n, kinds) for s in stmts for n in _walk_scope(s))
+
+
+def _own_has(stmts, kinds) -> bool:
+    """break/continue/return at THIS loop level — does not descend into
+    nested loops or function definitions (their break/continue/return
+    belongs to them)."""
+    for s in stmts:
+        stack = [s]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, kinds):
+                return True
+            if isinstance(n, (ast.For, ast.While, ast.FunctionDef,
+                              ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(n))
+    return False
+
+
+def _jst_attr_call(attr, args):
+    return ast.Call(
+        func=ast.Attribute(value=ast.Name(id=_HELPERS, ctx=ast.Load()),
+                           attr=attr, ctx=ast.Load()),
+        args=args, keywords=[])
+
+
+def _thunk(expr):
+    """``lambda: <expr>`` — lazy argument for guard_and/select."""
+    return ast.Lambda(
+        args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                           kw_defaults=[], defaults=[]),
+        body=expr)
+
+
+def _assign_name(name, value):
+    return ast.Assign(targets=[ast.Name(id=name, ctx=ast.Store())],
+                      value=value)
+
+
+class _BCRewriter:
+    """The reference's bool-guard rewrite (break_continue_transformer.py:87)
+    on ONE loop level: every `break`/`continue` becomes a flag assignment,
+    statements that would be skipped get wrapped in `if not <flags>:`, and
+    statements after a bare break/continue in the same block are dropped
+    (dead code). The caller joins the break flag into the loop predicate."""
+
+    def __init__(self, brk: str, cnt: str):
+        self.brk, self.cnt = brk, cnt
+        self.used_b = self.used_c = False
+
+    def _guard_test(self, has_b, has_c):
+        flags = ([ast.Name(id=self.brk, ctx=ast.Load())] if has_b else []) \
+            + ([ast.Name(id=self.cnt, ctx=ast.Load())] if has_c else [])
+        test = flags[0] if len(flags) == 1 else _jst_attr_call("or_", flags)
+        return _jst_attr_call("not_", [test])
+
+    def rewrite(self, stmts):
+        out = []
+        for idx, s in enumerate(stmts):
+            if isinstance(s, ast.Break):
+                self.used_b = True
+                out.append(_assign_name(self.brk, _jst_attr_call("true_", [])))
+                return out  # rest of this block is dead code
+            if isinstance(s, ast.Continue):
+                self.used_c = True
+                out.append(_assign_name(self.cnt, _jst_attr_call("true_", [])))
+                return out
+            if isinstance(s, ast.If) and _own_has(
+                    [s], (ast.Break, ast.Continue)):
+                has_b = _own_has([s], ast.Break)
+                has_c = _own_has([s], ast.Continue)
+                self.used_b |= has_b
+                self.used_c |= has_c
+                nb = self.rewrite(list(s.body))
+                ne = self.rewrite(list(s.orelse))
+                out.append(ast.If(test=s.test, body=nb or [ast.Pass()],
+                                  orelse=ne))
+                rest = self.rewrite(list(stmts[idx + 1:]))
+                if rest:
+                    out.append(ast.If(test=self._guard_test(has_b, has_c),
+                                      body=rest, orelse=[]))
+                return out
+            out.append(s)
+        return out
 
 
 def _ends_in_return(stmts) -> bool:
@@ -296,7 +480,32 @@ class _CtlFlow(ast.NodeTransformer):
         return guards + [tdef, fdef,
                          ast.Assign(targets=[target], value=call)]
 
-    # -- For over range(...) -------------------------------------------------
+    # -- break/continue lowering (reference break_continue_transformer) ------
+    def _lower_bc_parts(self, body):
+        """Eliminate this loop level's break/continue via flag variables.
+
+        -> (prelude, body_prefix, new_body, brk_name or None);
+        new_body is None when the rewrite does not apply (break under
+        try/with — keep the python loop). No-op (empty extras) when the
+        body has no own-level break/continue."""
+        if not _own_has(body, (ast.Break, ast.Continue)):
+            return [], [], list(body), None
+        brk, cnt = self._name("brk"), self._name("cnt")
+        rw = _BCRewriter(brk, cnt)
+        nb = rw.rewrite(list(body))
+        if _own_has(nb, (ast.Break, ast.Continue)):
+            return [], [], None, None
+        prelude, prefix = [], []
+        if rw.used_b:
+            prelude.append(_assign_name(brk, _jst_attr_call("false_", [])))
+            self.fn_locals.add(brk)
+        if rw.used_c:
+            # per-iteration flag: reset at the top of every iteration
+            prefix.append(_assign_name(cnt, _jst_attr_call("false_", [])))
+            self.fn_locals.add(cnt)
+        return prelude, prefix, nb, (brk if rw.used_b else None)
+
+    # -- For -----------------------------------------------------------------
     def visit_For(self, node: ast.For):
         """``for i in range(n)`` (1–3 args, positive constant step) lowers to
         a While over an INTERNAL counter so a TENSOR bound converts to
@@ -311,18 +520,32 @@ class _CtlFlow(ast.NodeTransformer):
         deviation is an EMPTY range, which leaves ``i`` unset here where
         python leaves it unbound (reading it raises either way). Bounds are
         hoisted in source order and evaluated once, like range() itself.
-        Anything else — non-name targets, starred/keyword args, break/
-        continue/return, attribute stores — is left as a python loop."""
-        self.generic_visit(node)
+        ``break``/``continue`` lower via the flag rewrite (the counter bump
+        stays outside the guards, so ``continue`` still advances the loop
+        like python's for). ``for x in <anything else>`` with a Name target
+        becomes a RUNTIME dispatch: tensor iterables take an index loop
+        (lax.while_loop), python iterables keep the original loop.
+        Remaining non-subset shapes — non-name targets, starred/keyword
+        args, own-level return, attribute stores — stay python loops."""
+        if getattr(node, "_jst_keep", False):
+            self.generic_visit(node)
+            return node
         it = node.iter
-        if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
-                and it.func.id == "range" and 1 <= len(it.args) <= 3
-                and not it.keywords
-                and not any(isinstance(a, ast.Starred) for a in it.args)
-                and isinstance(node.target, ast.Name)
+        is_range = (isinstance(it, ast.Call)
+                    and isinstance(it.func, ast.Name)
+                    and it.func.id == "range" and 1 <= len(it.args) <= 3
+                    and not it.keywords
+                    and not any(isinstance(a, ast.Starred) for a in it.args))
+        if not is_range:
+            if isinstance(node.target, ast.Name) and not node.orelse:
+                return self._dispatch_for(node)
+            self.generic_visit(node)
+            return node
+        if not (isinstance(node.target, ast.Name)
                 and not node.orelse
-                and not _has(node.body, (ast.Break, ast.Continue, ast.Return))
+                and not _has(node.body, ast.Return)
                 and not _has_nonname_store(node.body)):
+            self.generic_visit(node)
             return node
         i = node.target.id
         if len(it.args) == 1:
@@ -333,7 +556,12 @@ class _CtlFlow(ast.NodeTransformer):
             start, stop, step = it.args
             if not (isinstance(step, ast.Constant) and isinstance(
                     step.value, int) and step.value > 0):
+                self.generic_visit(node)
                 return node  # negative/dynamic step: keep the python loop
+        bc_prelude, bc_prefix, user, brk = self._lower_bc_parts(node.body)
+        if user is None:
+            self.generic_visit(node)
+            return node
         step = step or ast.Constant(value=1)
         k_name = self._name("k")
         start_name = self._name("start")
@@ -352,28 +580,83 @@ class _CtlFlow(ast.NodeTransformer):
                   _asn(k_name, _n(start_name))]
         test = ast.Compare(left=_n(k_name), ops=[ast.Lt()],
                            comparators=[_n(stop_name)])
+        if brk is not None:
+            test = _jst_attr_call("guard_and", [_n(brk), _thunk(test)])
         set_i = _asn(i, _n(k_name))
         bump = ast.AugAssign(target=_n(k_name, ast.Store), op=ast.Add(),
                              value=step)
-        wh = ast.While(test=test, body=[set_i] + list(node.body) + [bump],
+        wh = ast.While(test=test,
+                       body=[set_i] + bc_prefix + user + [bump],
                        orelse=[])
         out = self.visit_While(wh)
         # python leaves the loop var at its LAST value: recover it from the
         # carried counter (the in-body `i` itself is an undefined-entry
-        # carry slot that lax cannot thread past the loop)
+        # carry slot that lax cannot thread past the loop). After a break
+        # the bump has still run exactly once past the exit iteration, so
+        # k - step is the break-iteration value — python semantics either
+        # way.
         fin = _asn(i, ast.Call(
             func=ast.Attribute(value=_n(_HELPERS), attr="final_loopvar",
                                ctx=ast.Load()),
             args=[_n(k_name), _n(start_name), step, _n(i)], keywords=[]))
-        return hoists + (out if isinstance(out, list) else [out]) + [fin]
+        return hoists + bc_prelude + \
+            (out if isinstance(out, list) else [out]) + [fin]
+
+    def _dispatch_for(self, node: ast.For):
+        """``for x in <expr>`` -> runtime dispatch (loop_transformer.py:473
+        role): evaluate the iterable once; a tensor takes the index-loop
+        lowering (lax.while_loop over a traced length — no shape[0]-fold
+        unrolling), anything else keeps the ORIGINAL python loop with
+        untouched semantics. The dispatch predicate is a python bool, so
+        only the taken branch ever executes."""
+        import copy
+
+        seq = self._name("seq")
+        kvar = self._name("idx")
+        self.fn_locals.update((seq, kvar))
+
+        def _n(name, ctx=ast.Load):
+            return ast.Name(id=name, ctx=ctx())
+
+        t_body = [ast.Assign(
+            targets=[ast.Name(id=node.target.id, ctx=ast.Store())],
+            value=_jst_attr_call("seq_item", [_n(seq), _n(kvar)]))] \
+            + copy.deepcopy(node.body)
+        t_for = ast.For(
+            target=ast.Name(id=kvar, ctx=ast.Store()),
+            iter=ast.Call(func=ast.Name(id="range", ctx=ast.Load()),
+                          args=[_jst_attr_call("seq_len", [_n(seq)])],
+                          keywords=[]),
+            body=t_body, orelse=[])
+        p_for = ast.For(target=node.target, iter=_n(seq),
+                        body=node.body, orelse=[])
+        p_for._jst_keep = True
+        disp = ast.If(test=_jst_attr_call("is_tensor_seq", [_n(seq)]),
+                      body=[t_for], orelse=[p_for])
+        out = self.visit_If(disp)
+        return [_assign_name(seq, node.iter)] \
+            + (out if isinstance(out, list) else [out])
 
     # -- While ---------------------------------------------------------------
     def visit_While(self, node: ast.While):
+        bc_prelude = []
+        if not node.orelse:  # while-else: a break must SKIP the else — the
+            # flag rewrite exits via the predicate and would run it; keep
+            # the python loop (same for the For path, gated on orelse too)
+            bc_prelude, bc_prefix, nb, brk = self._lower_bc_parts(node.body)
+            if nb is not None and (bc_prefix or brk is not None):
+                test = node.test if brk is None else _jst_attr_call(
+                    "guard_and",
+                    [ast.Name(id=brk, ctx=ast.Load()), _thunk(node.test)])
+                node = ast.While(test=test, body=bc_prefix + nb,
+                                 orelse=node.orelse)
         self.generic_visit(node)
-        if _has(node.body, (ast.Break, ast.Continue, ast.Return)) \
+        if _own_has(node.body, (ast.Break, ast.Continue)) \
+                or _has(node.body, ast.Return) \
                 or _has_nonname_store(node.body) or node.orelse:
-            return node  # not convertible: keep python control flow (see
+            out = node  # not convertible: keep python control flow (see
             # visit_If) — tensor predicates get the runtime subset error
+            return bc_prelude + [out] if bc_prelude else out
         carried = _assigned_names(node.body)
         for v in _loaded_names(node.test):
             # only FUNCTION LOCALS join the carry — a test like
@@ -412,8 +695,8 @@ class _CtlFlow(ast.NodeTransformer):
         # assigns before reading; the tensor-pred path raises the subset
         # error from the while_ helper instead of UnboundLocalError)
         guards = [_undef_guard(v) for v in carried]
-        return guards + [cdef, bdef,
-                         ast.Assign(targets=[target], value=call)]
+        return bc_prelude + guards + [cdef, bdef,
+                                      ast.Assign(targets=[target], value=call)]
 
 
 def _fn_def(name, body, args=None):
@@ -471,6 +754,94 @@ class _Helpers:
     UNDEF = UNDEF
     undef = staticmethod(_Undefined)
     final_loopvar = staticmethod(final_loopvar)
+    true_ = staticmethod(true_)
+    false_ = staticmethod(false_)
+    not_ = staticmethod(not_)
+    guard_and = staticmethod(guard_and)
+    or_ = staticmethod(or_)
+    select = staticmethod(select)
+    is_tensor_seq = staticmethod(is_tensor_seq)
+    seq_len = staticmethod(seq_len)
+    seq_item = staticmethod(seq_item)
+
+
+class _ReturnInLoop:
+    """Early-return-in-loop rewrite (the reference ReturnTransformer's role,
+    single-return subset): in any block shaped
+
+        <loop with exactly ONE own-level `return e`> ; return f
+
+    the in-loop return becomes `flag = True; break` (the break then lowers
+    through the flag rewrite) and the block's trailing return becomes
+    ``return select(flag, e, f)`` — e re-evaluates on the carried-out
+    locals of the exiting iteration, so it must be a pure expression over
+    variables defined before the loop (others raise the named UNDEF
+    error)."""
+
+    def __init__(self):
+        self.n = 0
+        self.new_locals = set()
+
+    def _name(self):
+        self.n += 1
+        return f"__jst_retf_{self.n}"
+
+    def process(self, stmts):
+        for s in stmts:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # their returns are theirs
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(s, field, None)
+                if isinstance(sub, list) and sub:
+                    setattr(s, field, self.process(sub))
+        if len(stmts) >= 2 and isinstance(stmts[-1], ast.Return) \
+                and stmts[-1].value is not None \
+                and isinstance(stmts[-2], (ast.While, ast.For)):
+            loop = stmts[-2]
+            rets = [n for n in self._own_returns(loop.body)]
+            if len(rets) == 1 and rets[0].value is not None:
+                retf = self._name()
+                self.new_locals.add(retf)
+                repl = [_assign_name(retf, _jst_attr_call("true_", [])),
+                        ast.Break()]
+                loop.body = self._replace(loop.body, rets[0], repl)
+                final = ast.Return(value=_jst_attr_call(
+                    "select", [ast.Name(id=retf, ctx=ast.Load()),
+                               _thunk(rets[0].value),
+                               _thunk(stmts[-1].value)]))
+                return stmts[:-2] + [
+                    _assign_name(retf, _jst_attr_call("false_", [])),
+                    loop, final]
+        return stmts
+
+    @staticmethod
+    def _own_returns(stmts):
+        for s in stmts:
+            stack = [s]
+            while stack:
+                n = stack.pop()
+                if isinstance(n, ast.Return):
+                    yield n
+                    continue
+                if isinstance(n, (ast.For, ast.While, ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                stack.extend(ast.iter_child_nodes(n))
+
+    def _replace(self, stmts, target, repl):
+        out = []
+        for s in stmts:
+            if s is target:
+                out.extend(repl)
+                continue
+            if not isinstance(s, (ast.For, ast.While, ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                for field in ("body", "orelse", "finalbody"):
+                    sub = getattr(s, field, None)
+                    if isinstance(sub, list):
+                        setattr(s, field, self._replace(sub, target, repl))
+            out.append(s)
+        return out
 
 
 def convert_function(fn) -> Optional[Callable]:
@@ -493,12 +864,16 @@ def convert_function(fn) -> Optional[Callable]:
     def _convertible(n):
         if isinstance(n, (ast.If, ast.While)):
             return True
-        # a For matters only when it iterates a bare range() call — loops
-        # over lists/zip/enumerate are never converted, so a function whose
-        # only control flow is those keeps the cheap untransformed path
-        return (isinstance(n, ast.For) and isinstance(n.iter, ast.Call)
+        # a For matters when it iterates a bare range() call OR has a
+        # simple Name target (the runtime tensor-iterable dispatch may
+        # apply); loops over tuple targets (zip/enumerate/items) are never
+        # converted, so a function whose only control flow is those keeps
+        # the cheap untransformed path
+        return isinstance(n, ast.For) and (
+            isinstance(n.target, ast.Name)
+            or (isinstance(n.iter, ast.Call)
                 and isinstance(n.iter.func, ast.Name)
-                and n.iter.func.id == "range")
+                and n.iter.func.id == "range"))
 
     if not any(_convertible(n) for n in ast.walk(fdef)):
         return None
@@ -515,6 +890,9 @@ def convert_function(fn) -> Optional[Callable]:
     if fdef.args.kwarg:
         fn_locals.add(fdef.args.kwarg.arg)
     fn_locals |= set(_assigned_names(fdef.body))
+    rp = _ReturnInLoop()
+    fdef.body = rp.process(fdef.body)
+    fn_locals |= rp.new_locals
     new_tree = _CtlFlow(fn_locals).visit(tree)
     ast.fix_missing_locations(new_tree)
     code = compile(new_tree, filename=f"<dy2static {f0.__qualname__}>",

@@ -82,6 +82,19 @@ def dyfunc_break(x):
     return x
 
 
+class _Box:
+    pass
+
+
+def dyfunc_attr_store_loop(x):
+    box = _Box()
+    i = paddle.to_tensor(np.asarray(0, np.int32))
+    while i < 5:
+        box.v = x      # attribute store: outside the convertible subset
+        i = i + 1
+    return x
+
+
 def _run_both(fn, x):
     eager = fn(paddle.to_tensor(x)).numpy()
     static = paddle.jit.to_static(fn)(paddle.to_tensor(x)).numpy()
@@ -152,7 +165,15 @@ def test_unsupported_patterns_raise_clearly():
     with pytest.raises(TypeError, match="dy2static"):
         paddle.jit.to_static(dyfunc_early_return_mixed)(x)
     with pytest.raises(TypeError, match="dy2static"):
-        paddle.jit.to_static(dyfunc_break)(x)
+        paddle.jit.to_static(dyfunc_attr_store_loop)(x)
+
+
+def test_break_in_tensor_while_now_converts():
+    # r5: `break` inside a tensor while is IN the subset (bool-guard
+    # rewrite) — the loop body runs once then exits
+    x = np.ones((2,), np.float32)
+    out = paddle.jit.to_static(dyfunc_break)(paddle.to_tensor(x))
+    np.testing.assert_allclose(out.numpy(), x)
 
 
 def dyfunc_python_break(x):
@@ -338,3 +359,374 @@ def test_for_starred_args_stay_python():
     x = np.ones((2,), np.float32)
     out = paddle.jit.to_static(dyfunc_starred)(paddle.to_tensor(x))
     np.testing.assert_allclose(out.numpy(), x + 2)
+
+
+# -- r5: break/continue/early-return + tensor-iterator loops ----------------
+# (reference patterns: test/dygraph_to_static/test_break_continue.py,
+#  break_continue_transformer.py:87 bool-guard rewrite,
+#  loop_transformer.py:473 for-over-tensor)
+
+
+def dyfunc_continue_in_for(x):
+    x = x + 0
+    for i in range(10):
+        x += 1
+        if i > 5:
+            continue
+            x += 10086    # dead code after continue (reference keeps it)
+        x += i
+    return x
+
+
+def dyfunc_continue_in_while(x):
+    i = paddle.to_tensor(np.asarray(0, np.int64))
+    while i < 10:
+        i += 1
+        if i > 5:
+            continue
+            x += 10086
+        x += i.astype("float32")
+    return x
+
+
+def dyfunc_break_in_for(x):
+    for i in range(10):
+        x += 1
+        if i > 5:
+            break
+            x += 10086
+        x += i
+    return x
+
+
+def dyfunc_break_in_while(x):
+    i = paddle.to_tensor(np.asarray(0, np.int64))
+    while i < 10:
+        i += 1
+        if i > 5:
+            break
+            x += 10086
+        x += i.astype("float32")
+    return x
+
+
+def dyfunc_break_continue_mixed(x):
+    # both flags in one loop, with an unreachable trailing statement
+    for i in range(1, 10, 1):
+        if i <= 4:
+            x += 1
+            continue
+        else:
+            x += 10010
+            break
+        x += 10086
+    return x
+
+
+def dyfunc_break_tensor_bound(x):
+    # tensor bound AND tensor break/continue predicates, reference's
+    # second test_break_continue_in_for block
+    a = paddle.to_tensor(np.asarray([0], np.int64))
+    b = paddle.to_tensor(np.asarray(3, np.int64))
+    for i in range(b):
+        if a <= 4:
+            x += 1
+            a += 1
+            continue
+        else:
+            x += 10010
+            break
+        x += 10086
+    return x
+
+
+def dyfunc_optim_break_in_for(x):
+    # tensor break pred with PYTHON bounds: loop peels eagerly until the
+    # flag becomes traced, then hands off to lax.while_loop mid-loop
+    for i in range(10):
+        if x.sum() > 5:
+            break
+            x += 10086
+        x += i
+        if i < 3:
+            x = x * 2
+    return x
+
+
+def dyfunc_for_in_else(x):
+    # reference test_for_in_else: loop-with-break nested in a python else
+    if False:
+        pass
+    else:
+        for i in range(0, 10):
+            if i > 5:
+                x += 1
+                break
+            x += i
+    return x
+
+
+def dyfunc_return_in_loop(x):
+    # early return in a tensor loop + trailing return -> select rewrite
+    i = paddle.to_tensor(np.asarray(0, np.int64))
+    while i < 10:
+        if x.sum() > 5:
+            return x * 100
+        x = x + 1
+        i = i + 1
+    return x - 7
+
+
+def dyfunc_for_in_tensor(t):
+    # for-over-tensor: rows of a [N, D] tensor (loop_transformer role)
+    s = paddle.zeros([2])
+    for row in t:
+        s = s + row
+    return s
+
+
+def dyfunc_for_in_tensor_break(t):
+    s = paddle.zeros([2])
+    for row in t:
+        if row.sum() > 100:
+            break
+        s = s + row
+    return s
+
+
+def dyfunc_for_in_pylist(x):
+    # python branch of the dispatch: original loop, untouched semantics
+    acc = x
+    for m in [1.0, 2.0, 3.0]:
+        acc = acc + m
+    return acc
+
+
+def _bc_both(fn, *xs):
+    """eager result == to_static(jit) result, and return the value."""
+    eager = fn(*[paddle.to_tensor(np.asarray(v)) for v in xs]).numpy()
+    static = paddle.jit.to_static(fn)(
+        *[paddle.to_tensor(np.asarray(v)) for v in xs]).numpy()
+    np.testing.assert_allclose(eager, static, rtol=1e-6)
+    return static
+
+
+def _py_oracle(fn, *xs):
+    """the same source run as PLAIN python on numpy (no paddle)"""
+    return fn(*xs)
+
+
+def test_continue_in_for():
+    x = np.ones((1,), np.float32)
+    got = _bc_both(dyfunc_continue_in_for, x)
+    want = x + 0.0
+    for i in range(10):
+        want = want + 1
+        if i > 5:
+            continue
+        want = want + i
+    np.testing.assert_allclose(got, want)
+
+
+def test_break_in_for():
+    x = np.ones((1,), np.float32)
+    got = _bc_both(dyfunc_break_in_for, x)
+    want = x.copy()
+    for i in range(10):
+        want = want + 1
+        if i > 5:
+            break
+        want = want + i
+    np.testing.assert_allclose(got, want)
+
+
+def test_continue_in_while_tensor():
+    x = np.ones((1,), np.float32)
+    got = _bc_both(dyfunc_continue_in_while, x)
+    want, i = x.copy(), 0
+    while i < 10:
+        i += 1
+        if i > 5:
+            continue
+        want = want + i
+    np.testing.assert_allclose(got, want)
+
+
+def test_break_in_while_tensor():
+    x = np.ones((1,), np.float32)
+    got = _bc_both(dyfunc_break_in_while, x)
+    want, i = x.copy(), 0
+    while i < 10:
+        i += 1
+        if i > 5:
+            break
+        want = want + i
+    np.testing.assert_allclose(got, want)
+
+
+def test_break_continue_mixed_and_dead_code():
+    x = np.ones((1,), np.float32)
+    got = _bc_both(dyfunc_break_continue_mixed, x)
+    want = x.copy()
+    for i in range(1, 10, 1):
+        if i <= 4:
+            want = want + 1
+            continue
+        else:
+            want = want + 10010
+            break
+    np.testing.assert_allclose(got, want)
+
+
+def test_break_continue_tensor_bound_and_preds():
+    x = np.ones((1,), np.float32)
+    got = _bc_both(dyfunc_break_tensor_bound, x)
+    want, a = x.copy(), 0
+    for i in range(3):
+        if a <= 4:
+            want = want + 1
+            a += 1
+            continue
+        else:
+            want = want + 10010
+            break
+    np.testing.assert_allclose(got, want)
+
+
+def test_optim_break_mid_loop_handoff():
+    x = np.full((1,), 0.1, np.float32)
+    got = _bc_both(dyfunc_optim_break_in_for, x)
+    want = x.copy()
+    for i in range(10):
+        if want.sum() > 5:
+            break
+        want = want + i
+        if i < 3:
+            want = want * 2
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_for_in_else_with_break():
+    x = np.ones((1,), np.float32)
+    got = _bc_both(dyfunc_for_in_else, x)
+    want = x.copy()
+    for i in range(0, 10):
+        if i > 5:
+            want = want + 1
+            break
+        want = want + i
+    np.testing.assert_allclose(got, want)
+
+
+def test_early_return_in_loop_both_paths():
+    # path A: the in-loop return fires (x grows past the threshold)
+    x = np.full((2,), 2.0, np.float32)
+    got = _bc_both(dyfunc_return_in_loop, x)
+    want, i = x.copy(), 0
+    while i < 10:
+        if want.sum() > 5:
+            want = want * 100
+            break
+        want = want + 1
+        i += 1
+    np.testing.assert_allclose(got, want)
+    # path B: the loop exhausts, the trailing return fires
+    x = np.full((2,), -100.0, np.float32)
+    got = _bc_both(dyfunc_return_in_loop, x)
+    np.testing.assert_allclose(got, x + 10 - 7)
+
+
+def test_for_over_tensor_rows():
+    t = np.arange(8, dtype=np.float32).reshape(4, 2)
+    got = _bc_both(dyfunc_for_in_tensor, t)
+    np.testing.assert_allclose(got, t.sum(0))
+
+
+def test_for_over_tensor_rows_with_break():
+    t = np.arange(8, dtype=np.float32).reshape(4, 2)
+    t[2] = 1000.0     # row 2 trips the break before being added
+    got = _bc_both(dyfunc_for_in_tensor_break, t)
+    np.testing.assert_allclose(got, t[:2].sum(0))
+
+
+def test_for_over_python_list_untouched():
+    x = np.ones((2,), np.float32)
+    got = _bc_both(dyfunc_for_in_pylist, x)
+    np.testing.assert_allclose(got, x + 6.0)
+
+
+# -- r5 review regressions ---------------------------------------------------
+
+
+def dyfunc_nested_loop_return(x):
+    # a return inside a NESTED loop must keep the OUTER loop python
+    # (converting it would corrupt the synthesized carry)
+    n = 0
+    while n < 10:
+        for j in range(3):
+            if j == 2:
+                return x
+        n += 1
+    return x * 2
+
+
+def test_nested_loop_return_stays_python():
+    x = np.ones((2,), np.float32)
+    out = paddle.jit.to_static(dyfunc_nested_loop_return)(
+        paddle.to_tensor(x))
+    np.testing.assert_allclose(out.numpy(), x)
+
+
+def dyfunc_while_else_break(x):
+    i = 0
+    while i < 3:
+        if i == 1:
+            break
+        i += 1
+    else:
+        x = x + 100
+    return x
+
+
+def test_while_else_break_skips_else():
+    x = np.zeros((1,), np.float32)
+    out = paddle.jit.to_static(dyfunc_while_else_break)(paddle.to_tensor(x))
+    np.testing.assert_allclose(out.numpy(), x)   # break skips the else
+
+
+_SENTINEL_LIST = [1.0, 2.0, 3.0, 10.0]
+
+
+def dyfunc_break_guards_test(x):
+    # after a python break the predicate must NOT re-evaluate (it would
+    # index past the end) — guard_and short-circuits
+    i = 0
+    while _SENTINEL_LIST[i] < 5:
+        i += 1
+        if i >= len(_SENTINEL_LIST):
+            break
+    return x + i
+
+
+def test_break_short_circuits_predicate():
+    x = np.zeros((1,), np.float32)
+    out = paddle.jit.to_static(dyfunc_break_guards_test)(paddle.to_tensor(x))
+    np.testing.assert_allclose(out.numpy(), x + 3)
+
+
+_ZT_LIST = [1.0, 2.0]
+
+
+def dyfunc_zero_trip_return(x):
+    n = 0
+    for k in range(n):
+        if _ZT_LIST[k] > 10:
+            return x + _ZT_LIST[k]
+    return x
+
+
+def test_zero_trip_loop_skips_return_expr():
+    # select must be lazy: range(0) never binds k, yet the function works
+    x = np.zeros((1,), np.float32)
+    out = paddle.jit.to_static(dyfunc_zero_trip_return)(paddle.to_tensor(x))
+    np.testing.assert_allclose(out.numpy(), x)
