@@ -213,15 +213,15 @@ def disable_static(place=None):
 
 
 def enable_static():
-    """Static-graph compatibility mode (reference: paddle.enable_static).
+    """Static-graph mode (reference: paddle.enable_static).
 
-    TPU-native design: there is no separate graph IR — ops still execute
-    eagerly at build time, but the autograd tape they record doubles as the
-    Program's op graph. ``static.Executor.run(prog, feed, fetch_list)``
-    REPLAYS that tape with the feed substituted for the
-    ``static.data`` placeholders (and applies any ``minimize`` update), so
-    the reference's basic static examples run unchanged while XLA remains
-    the compiler underneath.
+    TPU-native design (static/program.py): ops touching a static Variable
+    are captured ABSTRACTLY into a real Program op graph at the dispatcher
+    (shape inference via jax.eval_shape — the InferMeta role); transforms
+    (append_backward, clone(for_test=True)) rewrite the op list, and
+    ``static.Executor.run(prog, feed, fetch_list)`` lowers the graph into
+    one pure function compiled by jax.jit per feed/fetch signature — the
+    PirInterpreter's scheduling role is taken by XLA.
     """
     global _static_mode
     _static_mode = True

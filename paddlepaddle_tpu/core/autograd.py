@@ -98,8 +98,6 @@ class GradNode:
         "pure_fn",
         "out_treedef",
         "primal_data",
-        "replay_fn",      # static-mode replay over ALL tensor inputs
-        "replay_inputs",  # (see core.dispatch.set_static_capture)
     )
 
     def __init__(self, name, vjp_fn, inputs, out_avals, pure_fn=None,
@@ -115,8 +113,6 @@ class GradNode:
         # the forward-time input ARRAYS (immutable), so lazy vjp recompute is
         # immune to later in-place updates of the input tensors
         self.primal_data = primal_data
-        self.replay_fn = None
-        self.replay_inputs = ()
 
     def accumulate(self, index: int, grad):
         cur = self.out_grads[index]

@@ -33,10 +33,10 @@ _amp_cast_hook = None
 # the per-op NaN/Inf scan (FLAGS_check_nan_inf analogue) and op-stats.
 _op_observer = None
 
-# static-graph capture (paddle.enable_static): when on, every op records a
-# replay closure over ALL tensor inputs — including non-differentiable ints
-# (labels, indices) the autograd tape would not track — so
-# static.Executor.run can re-execute the graph with feeds substituted.
+# static-graph capture (paddle.enable_static): when on, any op touching a
+# static Variable is RECORDED into the current Program's op graph
+# (static/program.py capture — abstract shape inference via eval_shape)
+# instead of executing; static.Executor lowers + jits the graph.
 _static_capture = False
 
 
@@ -59,11 +59,15 @@ def _requires_grad(t: Tensor) -> bool:
     return (not t.stop_gradient) and is_differentiable(t._data.dtype)
 
 
-def apply_op(fn: Callable, *args, op_name: str = None, **kwargs) -> Any:
+def apply_op(fn: Callable, *args, op_name: str = None,
+             static_eval_fn: Callable = None, **kwargs) -> Any:
     """Run ``fn`` (a pure function of jax arrays) on Tensor/array arguments.
 
     Tensors may appear anywhere in args/kwargs (including in lists/tuples).
     Returns Tensors mirroring fn's output structure.
+
+    ``static_eval_fn``: optional test-mode variant recorded on the captured
+    static op (dropout/batch_norm), used by Program.clone(for_test=True).
     """
     name = op_name or getattr(fn, "__name__", "op")
     leaves, treedef = jax.tree_util.tree_flatten(
@@ -72,49 +76,32 @@ def apply_op(fn: Callable, *args, op_name: str = None, **kwargs) -> Any:
     tensor_pos = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
     datas = [l._data if isinstance(l, Tensor) else l for l in leaves]
 
+    def run(vals):
+        a, k = jax.tree_util.tree_unflatten(treedef, vals)
+        return fn(*a, **k)
+
+    if _static_capture and tensor_pos:
+        # static-graph build: ops touching a static Variable are RECORDED
+        # into the current Program (abstract shape inference), not executed
+        from ..static.program import capture, is_static_var
+
+        if any(is_static_var(leaves[p]) for p in tensor_pos):
+            return capture(name, run, leaves, tensor_pos, datas,
+                           eval_fn=static_eval_fn)
+
     if _amp_cast_hook is not None and tensor_pos:
         datas = _amp_cast_hook(name, datas, tensor_pos)
 
     grad_on = ag.is_grad_enabled()
     diff_pos = [i for i in tensor_pos if grad_on and _requires_grad(leaves[i])]
 
-    def run(vals):
-        a, k = jax.tree_util.tree_unflatten(treedef, vals)
-        return fn(*a, **k)
-
-    def make_replay(node):
-        """Attach the all-tensor-inputs replay closure (static mode only)."""
-        if not (_static_capture and tensor_pos):
-            return
-
-        def replay(*tvals):
-            vals = list(datas)
-            for p, v in zip(tensor_pos, tvals):
-                vals[p] = v
-            return run(vals)
-
-        node.replay_fn = replay
-        node.replay_inputs = tuple(leaves[p] for p in tensor_pos)
-
     if not diff_pos:
         out = run(datas)
         if _op_observer is not None:
             _op_observer(name, jax.tree_util.tree_leaves(out))
-        wrapped = jax.tree_util.tree_map(
+        return jax.tree_util.tree_map(
             lambda x: Tensor._from_data(x, stop_gradient=True), out
         )
-        if _static_capture and tensor_pos:
-            # no autograd node, but the static replay still needs the edge
-            # (e.g. one_hot(labels) — int-only inputs)
-            out_leaves_, out_treedef_ = jax.tree_util.tree_flatten(
-                wrapped, is_leaf=lambda o: isinstance(o, Tensor))
-            node = ag.GradNode(name, None, (), [], out_treedef=out_treedef_)
-            make_replay(node)
-            for i, t in enumerate(out_leaves_):
-                if isinstance(t, Tensor):
-                    t._grad_node = node
-                    t._out_index = i
-        return wrapped
 
     def pure(*diff_vals):
         vals = list(datas)
@@ -141,7 +128,6 @@ def apply_op(fn: Callable, *args, op_name: str = None, **kwargs) -> Any:
         out_treedef=out_treedef,
         primal_data=primal_data,
     )
-    make_replay(node)
     wrapped = []
     for i, o in enumerate(out_leaves):
         t = Tensor._from_data(o, stop_gradient=False)

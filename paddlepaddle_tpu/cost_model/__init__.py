@@ -1,8 +1,10 @@
 """paddle.cost_model (reference: python/paddle/cost_model/cost_model.py):
-profile a static Program and report per-op costs. TPU-native: the replay
-executor runs the recorded graph node by node, so the measurement wraps
-each replay closure with a wall-clock timer — the role the reference's
-C++ CostModel.ProfileMeasure plays over the event profiler."""
+profile a static Program and report per-op costs. TPU-native: the op-graph
+Program (static/program.py) is INTERPRETED node by node here — each
+Operation.call timed with a device sync — the role the reference's C++
+CostModel.ProfileMeasure plays over the event profiler. (The production
+Executor path compiles the whole graph into one jitted module instead;
+per-op wall times only exist in this interpreted profiling mode.)"""
 
 from __future__ import annotations
 
@@ -31,44 +33,54 @@ class CostModel:
 
     def profile_measure(self, startup_program, main_program, device="gpu",
                         fetch_cost_list=("time",)):
-        """Run the program once with a per-op timing observer on the
-        dispatcher (the post-op hook amp.debugging also uses) and return
-        {op_name: {"time": seconds, "count": n}} plus a "total" entry.
-        Each op is synced before the clock reads, so times are real
-        wall-clock per op, not dispatch latencies."""
+        """Interpret the program's op graph node by node, timing each
+        Operation.call with a device sync; returns
+        {op_type: {"time": seconds, "count": n}} plus a "total" entry.
+        Backward/optimize ops recorded by minimize are profiled too."""
         import jax
+        import jax.numpy as jnp
         import numpy as np
 
-        import paddlepaddle_tpu as paddle
         from paddlepaddle_tpu import static
-        from paddlepaddle_tpu.core import dispatch as _dispatch
+        from paddlepaddle_tpu.static.program import StaticVariable
 
-        exe = static.Executor(paddle.CPUPlace())
+        exe = static.Executor()
         exe.run(startup_program)
         x = np.random.random(size=(10, 1)).astype("float32")
+        feed = {"X": x}
 
+        env = {}
+        for name, var in main_program._feed_targets.items():
+            if name in feed:
+                env[id(var)] = jnp.asarray(feed[name])
         costs = {}
-        state = {"last": None}
-
-        def observer(name, out_leaves):
-            for leaf in out_leaves:
+        t0 = time.perf_counter()
+        for op in main_program.global_block().ops:
+            ins = []
+            skip = False
+            for t in op.inputs:
+                if id(t) in env:
+                    ins.append(env[id(t)])
+                elif isinstance(t, StaticVariable):
+                    skip = True  # depends on an un-fed placeholder
+                    break
+                else:
+                    ins.append(t._data)
+            if skip:
+                continue
+            t1 = time.perf_counter()
+            out = op.call(*ins)
+            leaves = jax.tree_util.tree_leaves(out)
+            for leaf in leaves:
                 try:
                     jax.block_until_ready(leaf)
                 except Exception:
                     pass
-            now = time.perf_counter()
-            entry = costs.setdefault(name, {"time": 0.0, "count": 0})
-            entry["time"] += now - state["last"]
+            dt = time.perf_counter() - t1
+            entry = costs.setdefault(op.type, {"time": 0.0, "count": 0})
+            entry["time"] += dt
             entry["count"] += 1
-            state["last"] = now
-
-        prev = _dispatch._op_observer
-        t0 = time.perf_counter()
-        state["last"] = t0
-        _dispatch.set_op_observer(observer)
-        try:
-            exe.run(main_program, feed={"X": x}, fetch_list=[])
-        finally:
-            _dispatch.set_op_observer(prev)
+            for var, o in zip(op.outputs, leaves):
+                env[id(var)] = o
         costs["total"] = {"time": time.perf_counter() - t0}
         return costs

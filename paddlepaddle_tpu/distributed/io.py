@@ -13,7 +13,7 @@ import os
 
 def save_persistables(executor=None, dirname=None, main_program=None,
                       filename=None, **kw):
-    """Save every trainable parameter recorded on the (replay) program,
+    """Save every trainable parameter recorded on the program,
     with a manifest of shapes/dtypes for load-time validation."""
     import numpy as np
 

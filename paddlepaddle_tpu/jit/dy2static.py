@@ -219,10 +219,11 @@ def select(flag, a_thunk, b_thunk):
     if not _is_traced(p):
         return a_thunk() if p else b_thunk()
     p = jnp.asarray(p).reshape(())
+    a, b = a_thunk(), b_thunk()   # user errors propagate with THEIR trace
     try:
         return _tree_wrap(jax.tree_util.tree_map(
             lambda x, y: jnp.where(p, x, y),
-            _tree_unwrap(a_thunk()), _tree_unwrap(b_thunk())))
+            _tree_unwrap(a), _tree_unwrap(b)))
     except (TypeError, ValueError) as e:
         raise Dy2StaticUnsupportedError(
             "an early `return` inside a tensor loop must produce the same "

@@ -240,7 +240,10 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=No
             return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
         return jnp.where(keep, a, 0.0).astype(a.dtype)
 
-    return apply_op(f, x, op_name="dropout")
+    # static capture records the eval form for Program.clone(for_test=True)
+    eval_f = (lambda a: a) if mode == "upscale_in_train" \
+        else (lambda a: (a * (1.0 - p)).astype(a.dtype))
+    return apply_op(f, x, op_name="dropout", static_eval_fn=eval_f)
 
 
 def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
@@ -412,10 +415,59 @@ def batch_norm(
         return (a.astype(jnp.float32) * scale.reshape(sh)
                 + shift.reshape(sh)).astype(a.dtype)
 
-    out = apply_op(f, x, weight, bias, running_mean, running_var, op_name="batch_norm")
+    def f_eval(*tvals):
+        # test-mode form for Program.clone(for_test=True): always the
+        # folded running-stats pass. Signature = the op's TENSOR leaves in
+        # dispatch order (weight/bias may be absent — they are None, not
+        # tensor leaves).
+        it = iter(tvals)
+        a = next(it)
+        w = next(it) if weight is not None else None
+        b = next(it) if bias is not None else None
+        rm, rv = next(it), next(it)
+        sh = [1] * a.ndim
+        sh[ch_axis] = a.shape[ch_axis]
+        scale = jax.lax.rsqrt(jnp.asarray(rv).astype(jnp.float32) + epsilon)
+        if w is not None:
+            scale = scale * jnp.asarray(w).astype(jnp.float32)
+        shift = -jnp.asarray(rm).astype(jnp.float32) * scale
+        if b is not None:
+            shift = shift + jnp.asarray(b).astype(jnp.float32)
+        return (a.astype(jnp.float32) * scale.reshape(sh)
+                + shift.reshape(sh)).astype(a.dtype)
+
+    out = apply_op(f, x, weight, bias, running_mean, running_var,
+                   op_name="batch_norm",
+                   static_eval_fn=f_eval if use_batch_stats else None)
 
     if use_batch_stats and isinstance(running_mean, Tensor):
-        # update running stats in place (reference batch_norm_kernel
+        from ..static.program import is_static_var, record_state_write
+
+        if is_static_var(out):
+            # static build: record the running-stat updates as train-only
+            # ops + state writes (reference records them as in-program ops;
+            # the executor applies the writes after each train-mode run).
+            # XLA CSEs the recomputed batch stats with the forward's inside
+            # the single jitted program.
+            def upd(a, rm_, rv_):
+                axes_ = tuple(i for i in range(a.ndim) if i != ch_axis)
+                n_ = 1
+                for i in axes_:
+                    n_ *= a.shape[i]
+                m_ = jnp.mean(a.astype(jnp.float32), axes_)
+                v_ = jnp.var(a.astype(jnp.float32), axes_) \
+                    * (n_ / max(n_ - 1, 1))
+                return (momentum * rm_ + (1 - momentum) * m_).astype(rm_.dtype), \
+                       (momentum * rv_ + (1 - momentum) * v_).astype(rv_.dtype)
+
+            new_rm, new_rv = apply_op(upd, x, running_mean, running_var,
+                                      op_name="bn_stat_update")
+            prog_op = new_rm.block.program.global_block().ops[-1]
+            prog_op.train_only = True   # dropped by clone(for_test=True)
+            record_state_write(running_mean, new_rm)
+            record_state_write(running_var, new_rv)
+            return out
+        # eager: update running stats in place (reference batch_norm_kernel
         # semantics), REUSING the stats already computed in the forward pass
         axes = tuple(i for i in range(unwrap(x).ndim) if i != ch_axis)
         n = np.prod([unwrap(x).shape[i] for i in axes])

@@ -271,20 +271,15 @@ def _inplace(fn, op_name=None):
         if node is not None:
             # the node recorded X ITSELF as a producer input; after the
             # rebind x's _grad_node would point at this very node, making
-            # the edge a self-loop that silently drops upstream grads (and
-            # infinitely recurses the static replay). Swap the edges —
-            # autograd inputs AND static replay_inputs — to a shadow tensor
-            # carrying x's PRE-mutation tape position (the reference's
-            # TensorWrapper role).
+            # the edge a self-loop that silently drops upstream grads. Swap
+            # the edge to a shadow tensor carrying x's PRE-mutation tape
+            # position (the reference's TensorWrapper role).
             from ..core.tensor import Tensor as _T
 
             old = _T._from_data(x._data, stop_gradient=x.stop_gradient)
             old._grad_node = x._grad_node
             old._out_index = x._out_index
             node.inputs = tuple(old if t is x else t for t in node.inputs)
-            if node.replay_inputs:
-                node.replay_inputs = tuple(
-                    old if t is x else t for t in node.replay_inputs)
         x._data = out._data
         x._grad_node = node
         x._out_index = out._out_index

@@ -133,18 +133,23 @@ class Optimizer:
         import paddlepaddle_tpu as _paddle
 
         if not _paddle.in_dynamic_mode():
-            # static-graph build phase (executor.py:1247 semantics): record
-            # the (optimizer, loss) pair on the program; Executor.run
-            # replays the graph and applies the update per run. Reference
-            # static optimizers are built WITHOUT parameters= — collect the
-            # trainable leaves from the loss's recorded graph instead.
-            from ..static import _collect_parameters, default_main_program
+            # static-graph build phase (executor.py:1247 semantics):
+            # append_backward records REAL grad ops into the program (the
+            # reference's minimize = append_backward + optimize ops), and
+            # the (optimizer, loss, pairs) record tells Executor.run to
+            # fetch those grads and apply this optimizer's update per run.
+            # Reference static optimizers are built WITHOUT parameters= —
+            # collect the trainable leaves from the loss's graph slice.
+            from ..static import (_collect_parameters, append_backward_ir,
+                                  default_main_program)
 
             prog = default_main_program()
             if self._parameter_list is None:
                 self._parameter_list = _collect_parameters(loss, prog)
-            prog._minimize_ops.append((self, loss))
-            return None, None
+            pairs = append_backward_ir(prog, loss,
+                                       parameter_list=self._parameter_list)
+            prog._minimize_ops.append((self, loss, pairs))
+            return None, pairs
         loss.backward()
         self.step()
         return None, None

@@ -1,11 +1,15 @@
-"""paddle.static — compatibility shim over jit compilation.
+"""paddle.static — the op-graph static mode.
 
 Reference surface: python/paddle/static/ (Program/program_guard, Executor,
-data, nn re-exports). The PIR program + PirInterpreter stack (SURVEY.md
-§2.5) is absorbed by jax tracing + XLA: a "Program" here records the traced
-callables registered under its guard, and ``Executor.run`` executes the
-compiled function. Kept so reference code paths importing paddle.static
-don't break; new code should use jit.to_static directly.
+data, nn re-exports) over the PIR program + PirInterpreter stack
+(executor.py:1247, new_executor/pir_interpreter.h:32). TPU-native design
+(static/program.py): ops are captured ABSTRACTLY into a real Program IR at
+the dispatcher (shape inference via jax.eval_shape — the InferMeta role),
+transforms (append_backward, clone(for_test)) rewrite the op list, and the
+Executor lowers the graph to ONE pure function handed to jax.jit — XLA's
+scheduler takes the interpreter's dependency-analysis role, so a whole
+train step (forward + backward + updates' grads) compiles to a single
+fused module per feed signature.
 """
 
 from __future__ import annotations
@@ -17,6 +21,20 @@ import numpy as np
 
 from ..core.tensor import Tensor
 from ..nn import functional as F  # noqa: F401
+from .program import (  # noqa: F401
+    Block,
+    Operation,
+    StaticVariable,
+    _ProgramIR,
+    append_backward_ir,
+    export_inference,
+    gradients_ir,
+    load_inference,
+    lower,
+    run_program,
+)
+
+import jax  # noqa: E402
 
 
 class InputSpec:
@@ -32,24 +50,20 @@ class InputSpec:
         return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
 
 
-class Program:
+class Program(_ProgramIR):
+    """A real op-graph program (see static/program.py)."""
+
     def __init__(self):
-        self._feed_targets: Dict[str, "Variable"] = {}
+        self._feed_targets: Dict[str, StaticVariable] = {}
         self._fetch_list: List = []
-        self._fn = None
-        self._minimize_ops: List = []   # (optimizer, loss_var) from minimize
+        self._fn = None                 # legacy jit-traced path (to_static)
+        self._minimize_ops: List = []   # (optimizer, loss_var, grad pairs)
+        self._static_params: List = []
         self.random_seed = 0
-
-    def global_block(self):
-        return self
-
-    def clone(self, for_test=False):
-        return self
+        self._init_ir()
 
 
-class Variable(Tensor):
-    pass
-
+Variable = StaticVariable
 
 _default_main = Program()
 _default_startup = Program()
@@ -74,128 +88,92 @@ def program_guard(main_program: Program, startup_program: Optional[Program] = No
 
 
 def data(name, shape, dtype="float32", lod_level=0):
-    """Declare a feed placeholder. The returned tensor participates in the
-    autograd tape (stop_gradient=False) so every op downstream records it as
-    a producer edge — that tape IS the Program graph Executor.run replays
-    with the feed substituted (executor.py:1247 feed/fetch contract)."""
-    shape = [1 if (s is None or s < 0) else s for s in shape]
-    t = Tensor(np.zeros(shape, dtype="float32" if dtype is None else dtype),
-               stop_gradient=False)
-    t.name = name
+    """Declare a feed placeholder: an abstract Variable in the program
+    (None/-1 dims traced at 1 — ops are captured shape-polymorphically, so
+    Executor.run accepts any fed batch size)."""
+    from ..core.dtype import convert_dtype
+
+    none_dims = tuple(i for i, s in enumerate(shape)
+                      if s is None or (isinstance(s, int) and s < 0))
+    shape = [1 if (s is None or s < 0) else int(s) for s in shape]
     prog = default_main_program()
-    prog._feed_targets[name] = t
-    return t
-
-
-def _replay(var, env):
-    """Re-execute the tape that produced ``var`` with placeholder tensors
-    substituted from ``env`` (id(placeholder) -> feed Tensor). Leaf tensors
-    (parameters) evaluate to THEMSELVES, so gradients from a replayed loss
-    flow to the live parameters; every replayed op goes back through
-    apply_op, re-taping it for backward/minimize."""
-    from ..core.dispatch import apply_op
-
-    key = id(var)
-    if key in env:
-        return env[key]
-    node = getattr(var, "_grad_node", None)
-    fn = getattr(node, "replay_fn", None) if node is not None else None
-    fin = getattr(node, "replay_inputs", ()) if node is not None else ()
-    if fn is None and node is not None:  # pre-capture tape (grad-only edges)
-        fn, fin = node.pure_fn, node.inputs
-    if node is None or fn is None:
-        if getattr(var, "name", None) in env.get("_placeholders", ()):
-            raise KeyError(
-                f"static.data placeholder '{var.name}' was not fed "
-                f"(executor.py feed contract): pass it in `feed=`")
-        return var  # parameter / constant leaf
-    cache_key = ("node", id(node))
-    if cache_key in env:
-        outs = env[cache_key]
-    else:
-        ins = [_replay(t, env) for t in fin]
-        out_tree = apply_op(fn, *ins, op_name=f"replay_{node.name}")
-        import jax
-
-        # Tensor is itself a registered pytree: stop flattening AT tensors
-        outs = jax.tree_util.tree_leaves(
-            out_tree, is_leaf=lambda o: isinstance(o, Tensor))
-        env[cache_key] = outs
-    out = outs[getattr(var, "_out_index", 0)]
-    env[key] = out
-    return out
+    v = StaticVariable._make(
+        jax.ShapeDtypeStruct(tuple(shape),
+                             convert_dtype(dtype or "float32")),
+        name, prog.global_block())
+    v._none_dims = none_dims   # symbolic axes for inference export
+    prog._feed_targets[name] = v
+    prog.global_block().vars[name] = v
+    return v
 
 
 def _collect_parameters(loss, program) -> List[Tensor]:
-    """Trainable leaf tensors of the recorded graph (the static analogue of
-    a Program's parameter list): DFS the tape; a leaf with
-    stop_gradient=False that is not a feed placeholder is a parameter."""
-    placeholder_ids = {id(t) for t in program._feed_targets.values()}
-    seen, out, stack = set(), [], [loss]
-    while stack:
-        t = stack.pop()
-        if id(t) in seen:
-            continue
-        seen.add(id(t))
-        node = getattr(t, "_grad_node", None)
-        if node is None:
-            if not t.stop_gradient and id(t) not in placeholder_ids:
+    """Trainable concrete Tensors in the loss's backward slice (the static
+    analogue of a Program's parameter list)."""
+    from .program import _slice_ops
+
+    ops = _slice_ops(program.global_block().ops, [loss])
+    seen, out = set(), []
+    for op in ops:
+        for t in op.inputs:
+            if (not isinstance(t, StaticVariable) and isinstance(t, Tensor)
+                    and not t.stop_gradient and id(t) not in seen):
+                seen.add(id(t))
                 out.append(t)
-        else:
-            stack.extend(node.inputs)
     return out
 
 
 class Executor:
     """Reference: python/paddle/base/executor.py:1247,1935.
 
-    ``run(program, feed, fetch_list)`` replays the program's recorded op
-    tape with the feed dict bound to the ``static.data`` placeholders,
-    applies any ``optimizer.minimize`` registered at build time (backward +
-    step on the replayed loss, updating the live parameters), and returns
-    the fetched values. Unknown feed names and un-fed placeholders raise
-    (the reference feed contract). The ``_ExecutorCache`` role
-    (executor.py:1935) is filled by the taped-op graph itself — replay
-    memoizes per-node within a run, and XLA caches each op's compilation
-    across runs."""
+    ``run(program, feed, fetch_list)`` lowers the program's op graph for
+    the requested fetches (cached per feed/fetch signature — the
+    _ExecutorCache role, executor.py:1935), executes the jitted module,
+    applies recorded minimize updates (grads come out of the same compiled
+    run; the optimizer's eager step applies them to the live parameters),
+    and returns the fetched values. Unknown feed names and un-fed
+    placeholders raise (the reference feed contract)."""
 
     def __init__(self, place=None):
         self.place = place
-
-    def _feed_env(self, program, feed):
-        unknown = [k for k in feed if k not in program._feed_targets]
-        if unknown:
-            raise KeyError(
-                f"feed names {unknown} match no static.data placeholder "
-                f"(have: {sorted(program._feed_targets)})")
-        env = {"_placeholders": frozenset(
-            n for n in program._feed_targets if n not in feed)}
-        for name, value in feed.items():
-            ph = program._feed_targets[name]
-            t = value if isinstance(value, Tensor) else Tensor(
-                np.asarray(value))
-            t.stop_gradient = True
-            env[id(ph)] = t
-        return env
 
     def run(self, program: Optional[Program] = None, feed=None, fetch_list=None,
             return_numpy=True, **kwargs):
         program = program or default_main_program()
         feed = feed or {}
-        if program._fn is not None:  # jit-traced program (to_static path)
+        if getattr(program, "_fn", None) is not None:
             out = program._fn(**feed)
-            outs = out if isinstance(out, (list, tuple)) else [out]
-        elif fetch_list or program._minimize_ops:
-            env = self._feed_env(program, feed)
-            outs = [_replay(v, env) if isinstance(v, Tensor) else v
-                    for v in (fetch_list or [])]
-            for opt, loss_var in program._minimize_ops:
-                loss_t = _replay(loss_var, env)
-                loss_t.backward()
-                opt.step()
-                opt.clear_grad()
+            outs = list(out) if isinstance(out, (list, tuple)) else [out]
         else:
-            return [None for _ in (fetch_list or [])]
+            fetch_list = list(fetch_list or [])
+            n_user = len(fetch_list)
+            grad_slots = []
+            for entry in program._minimize_ops:
+                opt, loss_var, pairs = entry
+                for p, gv in pairs:
+                    grad_slots.append((opt, p, gv, len(fetch_list)))
+                    fetch_list.append(gv)
+            if not fetch_list:
+                # startup / side-effect-free run (e.g. exe.run(startup))
+                return []
+            outs = run_program(program, feed, fetch_list, train=True)
+            if grad_slots:
+                by_opt = {}
+                for opt, p, gv, idx in grad_slots:
+                    p.grad = outs[idx]
+                    by_opt.setdefault(id(opt), opt)
+                for opt in by_opt.values():
+                    opt.step()
+                    opt.clear_grad()
+                # reference semantics: fetch ops sit at the END of the
+                # program, AFTER the optimize ops — a fetched parameter
+                # reflects this run's update
+                for i, v in enumerate(fetch_list[:n_user]):
+                    if isinstance(v, Tensor) \
+                            and not isinstance(v, StaticVariable):
+                        outs[i] = Tensor._from_data(v._data,
+                                                    stop_gradient=True)
+            outs = outs[:n_user]
         if return_numpy:
             return [o.numpy() if isinstance(o, Tensor) else np.asarray(o)
                     for o in outs]

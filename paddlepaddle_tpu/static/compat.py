@@ -1,8 +1,8 @@
 """Static-graph namespace tail (reference: python/paddle/static/__init__.py).
 
-The replay-graph executor (static/__init__.py) carries the training
+The op-graph Program/Executor (static/program.py) carries the training
 semantics; this module fills the rest of the reference surface — program
-serialization over the replay-param manifest, scopes/places/guards that
+serialization (StableHLO via jax.export), scopes/places/guards that
 map onto the single-runtime model, metrics, EMA — and raises with the
 story for the IPU- and PS-specific leftovers."""
 
@@ -94,8 +94,8 @@ class BuildStrategy:
 
 
 class CompiledProgram:
-    """Reference CompiledProgram(program): under the replay executor a
-    program is already executable; the wrapper keeps the call shape."""
+    """Reference CompiledProgram(program): under the jit-lowering executor
+    a program is already executable; the wrapper keeps the call shape."""
 
     def __init__(self, program, build_strategy: Optional[BuildStrategy] = None):
         self._program = program
@@ -110,7 +110,7 @@ class CompiledProgram:
 # -- ops ----------------------------------------------------------------------
 
 def Print(input, first_n=-1, message=None, summarize=20, **kw):
-    """Reference static Print op: eager print at build/replay time."""
+    """Reference static Print op: eager print at build time."""
     val = np.asarray(input.numpy() if isinstance(input, Tensor) else input)
     flat = val.ravel() if summarize < 0 else val.ravel()[:summarize]
     msg = f"{message or 'Variable'}: {np.array2string(flat)}"
@@ -120,7 +120,7 @@ def Print(input, first_n=-1, message=None, summarize=20, **kw):
 
 def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
     """Reference py_func: run a python function as an op. Routed through the
-    dispatcher so the replay graph records it; the optional backward_func
+    dispatcher so the program graph records it; the optional backward_func
     becomes a custom vjp."""
     from ..utils.custom_op import CustomOp
 
@@ -185,34 +185,137 @@ def load(program, model_path, executor=None, var_list=None):
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
                          program=None, **kwargs):
-    raise NotImplementedError(
-        "static save_inference_model serializes a ProgramDesc; the portable "
-        "artifact here is StableHLO — use paddle.jit.save(layer, path, "
-        "input_spec=...) (jit/save_load.py), which inference.Config/"
-        "create_predictor and the C API consume")
+    """Export feeds→fetches of the (test-cloned) program as StableHLO with
+    parameters baked in (static/program.py export_inference — the
+    TPU-native ProgramDesc). Reference: static/io.py save_inference_model."""
+    from . import default_main_program, export_inference
+
+    program = program or default_main_program()
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) \
+        else [feed_vars]
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) \
+        else [fetch_vars]
+    return export_inference(program, feed_vars, fetch_vars, path_prefix)
 
 
 def load_inference_model(path_prefix, executor, **kwargs):
-    raise NotImplementedError(
-        "use paddle.jit.load(path) / inference.create_predictor for "
-        "StableHLO artifacts (see save_inference_model)")
+    """-> [program-like runner, feed_target_names, fetch_targets]; the
+    runner executes the deserialized StableHLO via executor.run-compatible
+    shape: exe.run(prog, feed=..., fetch_list=fetch_targets)."""
+    from . import Program, load_inference
+
+    run, feed_names, n_fetch = load_inference(path_prefix)
+    prog = Program()
+
+    def _fn(**feed):
+        missing = [n for n in feed_names if n not in feed]
+        if missing:
+            raise KeyError(f"feed(s) {missing} required by the loaded "
+                           f"inference model (have {sorted(feed)})")
+        outs = run(*[feed[n] for n in feed_names])
+        from ..core.tensor import Tensor
+
+        return [Tensor(np.asarray(o)) for o in outs]
+
+    prog._fn = _fn
+    return [prog, feed_names, list(range(n_fetch))]
 
 
-def _stablehlo_story(name):
-    def f(*a, **k):
-        raise NotImplementedError(
-            f"static.{name} serializes PIR ProgramDescs; programs here are "
-            "replay graphs + StableHLO exports (paddle.jit.save/load)")
+def serialize_program(program, fetch_vars=None):
+    """Program bytes: a feed-name manifest + the StableHLO of
+    feeds→fetch_vars (defaults to the program's recorded fetch list).
+    Reference serialize_program pickles the ProgramDesc; the portable IR
+    here is StableHLO."""
+    import json
+    import struct
+    import tempfile
 
-    f.__name__ = name
-    return f
+    from . import export_inference
+
+    fetch_vars = fetch_vars or program._fetch_list
+    if not fetch_vars:
+        raise ValueError(
+            "serialize_program needs fetch_vars (or program._fetch_list): "
+            "the serialized artifact is the feeds→fetches StableHLO")
+    feeds = list(program._feed_targets.values())
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "prog")
+        export_inference(program, feeds, fetch_vars, p)
+        with open(p + ".pdmodel", "rb") as f:
+            hlo = f.read()
+    header = json.dumps({"feeds": [v.name for v in feeds]}).encode()
+    return b"PDIR" + struct.pack("<I", len(header)) + header + hlo
 
 
-serialize_program = _stablehlo_story("serialize_program")
-serialize_persistables = _stablehlo_story("serialize_persistables")
-deserialize_program = _stablehlo_story("deserialize_program")
-deserialize_persistables = _stablehlo_story("deserialize_persistables")
-normalize_program = _stablehlo_story("normalize_program")
+def deserialize_program(blob):
+    """Rebuild a runnable program wrapper from serialize_program bytes —
+    feeds bind BY NAME via the embedded manifest, not dict order. The
+    result executes but is opaque to further graph transforms (the
+    StableHLO boundary), which matches the reference's deserialized-desc
+    usage pattern (load → run)."""
+    import json
+    import struct
+
+    from jax import export as jexport
+
+    from . import Program
+
+    if blob[:4] != b"PDIR":
+        raise ValueError("not a serialize_program artifact (bad magic)")
+    hlen, = struct.unpack("<I", blob[4:8])
+    header = json.loads(blob[8:8 + hlen].decode())
+    feed_names = header["feeds"]
+    exported = jexport.deserialize(bytearray(blob[8 + hlen:]))
+    prog = Program()
+
+    def _fn(**feed):
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+
+        missing = [n for n in feed_names if n not in feed]
+        if missing:
+            raise KeyError(f"feed(s) {missing} required by the deserialized "
+                           f"program (have {sorted(feed)})")
+        vals = [jnp.asarray(feed[n]._data if isinstance(feed[n], Tensor)
+                            else feed[n]) for n in feed_names]
+        out = exported.call(*vals)
+        outs = list(out) if isinstance(out, (list, tuple)) else [out]
+        return [Tensor(np.asarray(o)) for o in outs]
+
+    prog._fn = _fn
+    return prog
+
+
+def serialize_persistables(program, executor=None):
+    """Parameter blob (name→array npz bytes)."""
+    import io as _io
+
+    params = program.all_parameters() or getattr(
+        program, "_static_params", [])
+    buf = _io.BytesIO()
+    np.savez(buf, **{getattr(p, "name", None) or f"param_{i}":
+                     np.asarray(p._data) for i, p in enumerate(params)})
+    return buf.getvalue()
+
+
+def deserialize_persistables(program, blob, executor=None):
+    import io as _io
+
+    data = np.load(_io.BytesIO(blob))
+    params = program.all_parameters() or getattr(
+        program, "_static_params", [])
+    for i, p in enumerate(params):
+        key = getattr(p, "name", None) or f"param_{i}"
+        if key in data:
+            p.set_value(data[key])
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """Reference normalize_program: prune to the feeds→fetches slice in
+    test form — here that IS clone(for_test=True) (lowering slices per
+    fetch already)."""
+    return program.clone(for_test=True)
 
 
 def save_to_file(path, content):
@@ -247,21 +350,30 @@ def set_program_state(program, state_dict):
 
 def append_backward(loss, parameter_list=None, no_grad_set=None,
                     callbacks=None, checkpoints=None):
-    """Reference append_backward: under the replay model the executor
-    differentiates at run time (minimize records the pair); building an
-    explicit grad-op list has no replay-graph meaning."""
-    raise NotImplementedError(
-        "append_backward builds explicit grad ops into a ProgramDesc; the "
-        "replay executor differentiates at run time — use "
-        "optimizer.minimize(loss) (static/__init__.py) or eager "
-        "loss.backward()")
+    """REAL program transform (reference python/paddle/base/backward.py):
+    appends grad ops to the loss's program and registers `<param>@GRAD`
+    variables. Returns [(param, grad_var)]; the grad vars are fetchable
+    through Executor.run like any variable."""
+    from . import append_backward_ir, default_main_program
+
+    prog = getattr(getattr(loss, "block", None), "program", None) \
+        or default_main_program()
+    params = parameter_list
+    if params and no_grad_set:
+        ng = {id(p) for p in no_grad_set}
+        params = [p for p in params if id(p) not in ng]
+    return append_backward_ir(prog, loss, parameter_list=params)
 
 
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
-    raise NotImplementedError(
-        "static.gradients queries a ProgramDesc grad graph; use "
-        "paddle.grad(outputs, inputs) on the eager tape (same math, "
-        "run-time differentiation)")
+    """Static grad-of-variables (reference base/backward.py gradients):
+    appends a backward op; returns the `@GRAD` Variables for ``inputs``."""
+    from . import default_main_program, gradients_ir
+
+    t0 = targets[0] if isinstance(targets, (list, tuple)) else targets
+    prog = getattr(getattr(t0, "block", None), "program", None) \
+        or default_main_program()
+    return gradients_ir(prog, targets, inputs)
 
 
 class WeightNormParamAttr:

@@ -1,8 +1,8 @@
 """paddle.static.nn (reference: python/paddle/static/nn/): the static-graph
-layer builders. Under the replay-graph static mode, ops execute eagerly at
-build time and the tape doubles as the Program, so a builder is: create
-Parameters, apply the functional op — the recorded node replays with feeds
-substituted exactly like any other op."""
+layer builders. A builder creates concrete Parameters eagerly and applies
+the functional op on the static Variables — the dispatcher captures the op
+into the current Program's graph (static/program.py), shape-inferred
+abstractly; the Executor later lowers + jits the whole graph."""
 
 from __future__ import annotations
 
@@ -40,9 +40,9 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
        activation=None, name=None):
     """Reference: static/nn/common.py fc — y = act(x @ W + b), creating the
     parameters in the program. The trailing dims are contracted with
-    tensordot instead of reshape so NO batch dim is baked into the replay
-    tape — Executor.run replays with any fed batch size (static.data None
-    dims are placeholder-1)."""
+    tensordot instead of reshape so NO batch dim is baked into the captured
+    op — Executor.run accepts any fed batch size (static.data None dims
+    are placeholder-1)."""
     from ...core.dispatch import apply_op
 
     k = len(x.shape) - num_flatten_dims
