@@ -86,6 +86,17 @@ def apply_op(fn: Callable, *args, op_name: str = None,
         from ..static.program import capture, is_static_var
 
         if any(is_static_var(leaves[p]) for p in tensor_pos):
+            if _amp_cast_hook is not None:
+                # bake the ACTIVE amp policy into the recorded op (the
+                # reference inserts cast ops into the program at build):
+                # the hook runs on tracers/arrays at execution-trace time
+                hook = _amp_cast_hook
+
+                def run_amp(vals, _run=run):
+                    return _run(hook(name, list(vals), tensor_pos))
+
+                return capture(name, run_amp, leaves, tensor_pos, datas,
+                               eval_fn=static_eval_fn)
             return capture(name, run, leaves, tensor_pos, datas,
                            eval_fn=static_eval_fn)
 

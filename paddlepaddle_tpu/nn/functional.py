@@ -228,17 +228,42 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=No
         if mode == "downscale_in_infer" and not training:
             return apply_op(lambda a: a * (1.0 - p), x)
         return x
-    key = prandom.next_key()
 
-    def f(a):
+    def _mask_shape(a):
         shape = list(a.shape)
         if axis is not None:
             axes = axis if isinstance(axis, (list, tuple)) else [axis]
-            shape = [s if i in [ax % a.ndim for ax in axes] else 1 for i, s in enumerate(a.shape)]
-        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+            shape = [s if i in [ax % a.ndim for ax in axes] else 1
+                     for i, s in enumerate(a.shape)]
+        return tuple(shape)
+
+    def _apply(a, key):
+        keep = jax.random.bernoulli(key, 1.0 - p, _mask_shape(a))
         if mode == "upscale_in_train":
             return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
         return jnp.where(keep, a, 0.0).astype(a.dtype)
+
+    from ..core.dispatch import _static_capture
+    from ..static.program import is_static_var, static_rng_key
+
+    if _static_capture and (is_static_var(x)):
+        # static build: the key is a per-RUN feed (run_program refreshes
+        # it), folded with a per-op salt — a build-time key closure would
+        # bake ONE mask into the compiled program for every step
+        kv = static_rng_key()
+        salt = id(x) & 0x7FFFFFFF
+
+        def f2(a, k):
+            return _apply(a, jax.random.fold_in(k, salt))
+
+        eval_f = (lambda a, k: a) if mode == "upscale_in_train" \
+            else (lambda a, k: (a * (1.0 - p)).astype(a.dtype))
+        return apply_op(f2, x, kv, op_name="dropout", static_eval_fn=eval_f)
+
+    key = prandom.next_key()
+
+    def f(a):
+        return _apply(a, key)
 
     # static capture records the eval form for Program.clone(for_test=True)
     eval_f = (lambda a: a) if mode == "upscale_in_train" \

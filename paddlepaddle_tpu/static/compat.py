@@ -358,11 +358,8 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
 
     prog = getattr(getattr(loss, "block", None), "program", None) \
         or default_main_program()
-    params = parameter_list
-    if params and no_grad_set:
-        ng = {id(p) for p in no_grad_set}
-        params = [p for p in params if id(p) not in ng]
-    return append_backward_ir(prog, loss, parameter_list=params)
+    return append_backward_ir(prog, loss, parameter_list=parameter_list,
+                              no_grad_set=no_grad_set)
 
 
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
@@ -370,6 +367,16 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
     appends a backward op; returns the `@GRAD` Variables for ``inputs``."""
     from . import default_main_program, gradients_ir
 
+    if target_gradients is not None:
+        raise NotImplementedError(
+            "static.gradients(target_gradients=...) — weighted vjp seeds — "
+            "is not implemented; the unweighted d(sum(targets))/d(inputs) "
+            "form is (silently dropping the weights would be wrong)")
+    if no_grad_set:
+        raise NotImplementedError(
+            "static.gradients(no_grad_set=...) is not implemented for the "
+            "variable-gradients form; use append_backward(no_grad_set=...) "
+            "for parameter gradients")
     t0 = targets[0] if isinstance(targets, (list, tuple)) else targets
     prog = getattr(getattr(t0, "block", None), "program", None) \
         or default_main_program()

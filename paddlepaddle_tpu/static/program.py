@@ -193,6 +193,7 @@ class _ProgramIR:
         new._static_params = list(getattr(self, "_static_params", []))
         new.random_seed = self.random_seed
         nb = new.global_block()
+        nb.vars.update(new._feed_targets)   # feeds stay name-resolvable
         kept = set()
         for op in self.global_block().ops:
             if for_test:
@@ -269,6 +270,27 @@ def capture(name, run, leaves, tensor_pos, datas, eval_fn=None):
         eval_call=eval_call)
     block.append_op(op)
     return jax.tree_util.tree_unflatten(out_treedef, out_vars)
+
+
+RNG_FEED = "__rng__"
+
+
+def static_rng_key():
+    """Per-RUN randomness for captured ops (dropout): a reserved feed
+    variable holding a PRNG key that run_program refreshes on every train
+    run — a build-time key baked into an op closure would reuse one mask
+    forever. Ops fold_in a unique salt so two dropouts differ."""
+    from . import default_main_program
+
+    prog = default_main_program()
+    v = prog._feed_targets.get(RNG_FEED)
+    if v is None:
+        v = StaticVariable._make(
+            jax.ShapeDtypeStruct((2,), np.uint32), RNG_FEED,
+            prog.global_block())
+        prog._feed_targets[RNG_FEED] = v
+        prog.global_block().vars[RNG_FEED] = v
+    return v
 
 
 def record_state_write(target: Tensor, source: StaticVariable):
@@ -401,6 +423,10 @@ def run_program(prog, feed, fetch_vars, train=True):
             f"(have: {sorted(prog._feed_targets)})")
     feed_arrays = {k: jnp.asarray(v._data if isinstance(v, Tensor) else v)
                    for k, v in feed.items()}
+    if RNG_FEED in prog._feed_targets and RNG_FEED not in feed_arrays:
+        # fresh key per run: captured dropout masks vary across steps
+        prog._rng_counter = getattr(prog, "_rng_counter", 0) + 1
+        feed_arrays[RNG_FEED] = jax.random.PRNGKey(prog._rng_counter)
     key = (prog._version, tuple(sorted(feed_arrays)),
            tuple(id(v) for v in fetch_vars), bool(train))
     cached = prog._exec_cache.get(key)
@@ -425,7 +451,7 @@ def run_program(prog, feed, fetch_vars, train=True):
 # ---------------------------------------------------------------------------
 
 
-def append_backward_ir(prog, loss, parameter_list=None):
+def append_backward_ir(prog, loss, parameter_list=None, no_grad_set=None):
     """Append a backward op computing d(loss)/d(param) for every trainable
     parameter in loss's slice; register `<param>@GRAD` variables. Returns
     [(param, grad_var)] like the reference."""
@@ -446,16 +472,32 @@ def append_backward_ir(prog, loss, parameter_list=None):
                         and id(t) not in seen):
                     seen.add(id(t))
                     params.append(t)
+    if no_grad_set:
+        ng = {id(p) for p in no_grad_set}
+        params = [p for p in params if id(p) not in ng]
     if not params:
         raise ValueError("append_backward: loss depends on no trainable "
                          "parameter")
     feed_names = _required_feeds(prog, ops)
     feed_vars = [prog._feed_targets[n] for n in feed_names]
     n_feeds = len(feed_vars)
+    # NON-differentiated concrete tensors (frozen weights, running stats)
+    # are runtime inputs too — baking ._data at trace time would compute
+    # grads against stale values after a set_value / state write
+    pset = {id(p) for p in params}
+    consts, cseen = [], set()
+    for op in ops:
+        for t in op.inputs:
+            if (not isinstance(t, StaticVariable) and isinstance(t, Tensor)
+                    and id(t) not in pset and id(t) not in cseen):
+                cseen.add(id(t))
+                consts.append(t)
+    n_params = len(params)
 
     def grad_call(*tvals):
         fvals = tvals[:n_feeds]
-        pvals = tvals[n_feeds:]
+        pvals = tvals[n_feeds:n_feeds + n_params]
+        cvals = tvals[n_feeds + n_params:]
 
         def loss_of(pv):
             env = {}
@@ -463,6 +505,8 @@ def append_backward_ir(prog, loss, parameter_list=None):
                 env[id(v)] = a
             for p, a in zip(params, pv):
                 env[id(p)] = a
+            for c, a in zip(consts, cvals):
+                env[id(c)] = a
             run_ops(ops, env)
             return jnp.asarray(env[id(loss)]).reshape(()).astype(jnp.float32)
 
@@ -476,8 +520,8 @@ def append_backward_ir(prog, loss, parameter_list=None):
             jax.ShapeDtypeStruct(p._data.shape, p._data.dtype), gname, block))
     out_treedef = jax.tree_util.tree_structure(tuple(grad_vars))
     op = Operation(f"grad_of_{loss.name}", grad_call,
-                   list(feed_vars) + list(params), grad_vars, out_treedef,
-                   role="backward")
+                   list(feed_vars) + list(params) + consts, grad_vars,
+                   out_treedef, role="backward")
     block.append_op(op)
     pairs = list(zip(params, grad_vars))
     prog._param_grads.extend(pairs)

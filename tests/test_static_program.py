@@ -277,3 +277,41 @@ def test_deserialized_program_binds_feeds_by_name(static_mode):
     # reversed dict order must still bind by NAME
     got, = exe.run(prog2, feed={"b": bv, "a": av}, fetch_list=[0])
     np.testing.assert_allclose(got, av * 2 + bv)
+
+
+def test_static_dropout_masks_vary_across_runs(static_mode):
+    """A captured dropout must draw a fresh mask each Executor.run (the
+    key is a per-run feed, not a build-time closure constant)."""
+    prog = paddle.static.Program()
+    with paddle.static.program_guard(prog):
+        x = paddle.static.data(name="x", shape=[None, 64], dtype="float32")
+        out = paddle.nn.functional.dropout(x, p=0.5, training=True)
+    exe = paddle.static.Executor()
+    xb = np.ones((8, 64), np.float32)
+    m1, = exe.run(prog, feed={"x": xb}, fetch_list=[out])
+    m2, = exe.run(prog, feed={"x": xb}, fetch_list=[out])
+    assert (m1 != m2).any(), "same dropout mask on consecutive runs"
+
+
+def test_append_backward_no_grad_set_without_parameter_list(static_mode):
+    prog, x, y, pred, loss = _build_linreg()
+    W, b = prog.all_parameters()
+    with paddle.static.program_guard(prog):
+        pairs = paddle.static.append_backward(loss, no_grad_set={W})
+    assert [p for p, _ in pairs] == [b]
+
+
+def test_gradients_rejects_unimplemented_args(static_mode):
+    prog = paddle.static.Program()
+    with paddle.static.program_guard(prog):
+        x = paddle.static.data(name="x", shape=[None, 2], dtype="float32")
+        y = (x * x).sum()
+        with pytest.raises(NotImplementedError, match="target_gradients"):
+            paddle.static.gradients([y], [x], target_gradients=[y])
+
+
+def test_clone_keeps_feed_vars_resolvable(static_mode):
+    prog, x, y, pred, loss = _build_linreg()
+    test_prog = prog.clone(for_test=True)
+    assert test_prog.global_block().var("x") is x
+    assert any(v.name == "x" for v in test_prog.list_vars())
