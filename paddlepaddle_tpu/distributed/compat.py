@@ -214,6 +214,10 @@ def spawn(func, args=(), nprocs=1, join=True, daemon=False, **options):
             "MASTER_ADDR": "127.0.0.1",
             "MASTER_PORT": str(port),
             "PADDLE_LOCAL_RANK": str(rank),
+            # spawn picks a FRESH port its own rank 0 must host: a
+            # launcher-hosted-store flag inherited from a parent worker
+            # would leave nobody serving it
+            "PADDLE_LAUNCH_STORE": "0",
         }
         p = ctx.Process(target=_spawn_entry, args=(func, args, env),
                         daemon=daemon)

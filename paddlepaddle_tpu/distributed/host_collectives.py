@@ -85,6 +85,22 @@ class HostProcessGroup:
     def _key(self, seq: int, tag: str) -> str:
         return f"hcoll/{self.gid}/{seq}/{tag}"
 
+    def rejoin(self, op_index: int) -> None:
+        """Elastic re-admission: a restarted worker resuming from a
+        checkpoint fast-forwards its collective stream to ``op_index``
+        (the number of group ops its peers have already completed for the
+        checkpointed state — ops-per-step x steps under a deterministic
+        schedule). Without this, a fresh incarnation's sequence restarts
+        at 0 and its collectives ALIAS live ranks' older slots, silently
+        reading stale payloads (reference elastic contract:
+        fleet/elastic/manager.py re-admission)."""
+        if op_index < self._seq:
+            raise ValueError(
+                f"rejoin(op_index={op_index}) would move the sequence "
+                f"backwards (already at {self._seq})")
+        self._seq = int(op_index)
+        self._posted.clear()
+
     def _next(self) -> int:
         """Advance the group sequence, gating on the retirement of the op one
         window back so outstanding state on the master stays O(window).

@@ -62,6 +62,10 @@ def _worker_env(args, local_rank: int, world_size: int, master_addr,
         "LOCAL_RANK": str(local_rank),
         "MASTER_ADDR": master_addr,
         "MASTER_PORT": str(master_port),
+        # the LAUNCHER hosts the rendezvous store (it must outlive worker
+        # restarts — elastic re-admission depends on surviving store
+        # state); workers always connect as clients, rank 0 included
+        "PADDLE_LAUNCH_STORE": "1",
     })
     if args.devices:
         env["CUDA_VISIBLE_DEVICES"] = args.devices  # env parity; unused on TPU

@@ -72,13 +72,17 @@ def init_rpc(name: str, rank: Optional[int] = None, world_size: Optional[int] = 
     """Start this worker's RPC agent and register it in the store."""
     rank = rank if rank is not None else int(os.environ.get("PADDLE_TRAINER_ID", "0"))
     world_size = world_size or int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    from .store import launcher_hosts_store
+
+    host_it = rank == 0 and not launcher_hosts_store()
     if master_endpoint:
         host, port = master_endpoint.rsplit(":", 1)
-        store = TCPStore(host, int(port), is_master=(rank == 0), world_size=world_size)
+        store = TCPStore(host, int(port), is_master=host_it,
+                         world_size=world_size)
     else:
         store = TCPStore(os.environ.get("MASTER_ADDR", "127.0.0.1"),
                          int(os.environ.get("MASTER_PORT", "0") or 0),
-                         is_master=(rank == 0), world_size=world_size)
+                         is_master=host_it, world_size=world_size)
 
     srv = socketserver.ThreadingTCPServer(("0.0.0.0", 0), _Handler)
     srv.daemon_threads = True

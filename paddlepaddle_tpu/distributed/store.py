@@ -294,5 +294,18 @@ def create_or_get_global_tcp_store() -> TCPStore:
         port = int(os.environ.get("MASTER_PORT", "0") or 0)
         rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
         world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
-        _global_store = TCPStore(host, port, is_master=(rank == 0), world_size=world)
+        # under the launcher the STORE IS HOSTED BY THE LAUNCHER (it must
+        # outlive worker restarts for elastic re-admission) — every worker,
+        # rank 0 included, connects as a client
+        _global_store = TCPStore(
+            host, port,
+            is_master=(rank == 0 and not launcher_hosts_store()),
+            world_size=world)
     return _global_store
+
+
+def launcher_hosts_store() -> bool:
+    """True when an external launcher hosts the MASTER_PORT store (so
+    rank 0 must connect as a client, not bind). "0"/"false" opt out."""
+    return os.environ.get(
+        "PADDLE_LAUNCH_STORE", "").strip().lower() in ("1", "true", "yes")
