@@ -9,7 +9,9 @@ TPU-native: placements are GSPMD PartitionSpecs; ``to_static`` compiles ONE
 pjit train step (parallel.ShardedTrainStep) — completion/partitioner/reshard
 passes are the XLA SPMD partitioner's job. ``parallelize`` applies per-layer
 plans (ColWiseParallel/RowWiseParallel/...) by attaching ``dist_spec`` to
-parameters, exactly what the mpu layers do internally.
+parameters, exactly what the mpu layers do internally. The INSPECTION half
+of the reference's completion pass (read back what placement every tensor
+was inferred to have) lives in ``completion.complete_program``.
 """
 
 from __future__ import annotations
@@ -251,3 +253,6 @@ class _ShardedLoader:
 def shard_dataloader(dataloader, meshes, shard_dims="dp", is_dataset_splitted=False):
     mesh = meshes[0] if isinstance(meshes, (list, tuple)) else meshes
     return _ShardedLoader(dataloader, mesh, shard_dims)
+
+
+from .completion import complete_program, format_completion  # noqa: E402,F401

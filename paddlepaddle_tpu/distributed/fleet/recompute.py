@@ -35,6 +35,7 @@ def recompute(function, *args, **kwargs) -> Any:
     """
     use_reentrant = kwargs.pop("use_reentrant", True)  # API parity; one path
     preserve_rng_state = kwargs.pop("preserve_rng_state", True)  # noqa: F841
+    policy = kwargs.pop("policy", None)  # jax.checkpoint_policies entry
 
     if isinstance(function, Layer):
         params = dict(function.raw_state())
@@ -46,7 +47,8 @@ def recompute(function, *args, **kwargs) -> Any:
                 lambda t: t._data if hasattr(t, "_data") else t, out,
                 is_leaf=lambda t: hasattr(t, "_data"))
 
-        return apply_op(jax.checkpoint(pure), params, *args, op_name="recompute")
+        return apply_op(jax.checkpoint(pure, policy=policy), params, *args,
+                        op_name="recompute")
 
     if ag.is_grad_enabled():
         # plain callable on the eager tape: run as-is (correct grads, no
@@ -60,7 +62,8 @@ def recompute(function, *args, **kwargs) -> Any:
             lambda t: t._data if hasattr(t, "_data") else t, out,
             is_leaf=lambda t: hasattr(t, "_data"))
 
-    return apply_op(jax.checkpoint(pure_fn), *args, op_name="recompute")
+    return apply_op(jax.checkpoint(pure_fn, policy=policy), *args,
+                    op_name="recompute")
 
 
 def recompute_sequential(ctx, functions, *args, **kwargs):

@@ -85,6 +85,11 @@ class LlamaConfig:
     # -> jax.checkpoint): trades one extra forward for O(layers) activation
     # memory, what lets billion-param configs train on one chip
     recompute: bool = False
+    # remat policy (reference recompute's selective-checkpoint knob ->
+    # jax.checkpoint policy): None = full remat; "dots" saves matmul
+    # outputs so backward skips recomputing the MXU work (more memory,
+    # less recompute time)
+    remat_policy: Optional[str] = None
 
     @property
     def head_dim(self) -> int:
@@ -322,8 +327,19 @@ class LlamaModel(Layer):
         if self.config.recompute:
             from ..distributed.fleet.recompute import recompute
 
+            policies = {
+                None: None,
+                "dots": jax.checkpoint_policies.checkpoint_dots,
+                "dots_no_batch":
+                    jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            }
+            if self.config.remat_policy not in policies:
+                raise ValueError(
+                    f"remat_policy={self.config.remat_policy!r} — valid: "
+                    f"{sorted(k for k in policies if k)} or None")
+            policy = policies[self.config.remat_policy]
             for layer in self.layers:
-                x = recompute(layer, x, cos, sin, attn_mask)
+                x = recompute(layer, x, cos, sin, attn_mask, policy=policy)
         else:
             for layer in self.layers:
                 x = layer(x, cos, sin, attn_mask)

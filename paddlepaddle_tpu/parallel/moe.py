@@ -469,13 +469,16 @@ class MoELayer(Layer):
         85.2 ms/step): the single-chip perf path. Tokens beyond
         ``capacity_factor`` per expert are dropped.
       * "dropless" — same routing, ``lax.ragged_dot`` grouped matmuls, no
-        capacity bound / no drops (91-98 ms/step) — trade ~10% step time
-        for exact routing. Attacked in round 4 and kept non-default on
-        the numbers: 128-aligned group boundaries measured neutral,
-        a fused gate|up concat measured SLOWER (97.8 vs 90.9), and a
-        fixed-assignment ablation shows routing+dispatch costs 11.5
-        ms/step for EITHER path — ragged_dot's remaining deficit vs the
-        static batched einsum is intrinsic on this platform.
+        capacity bound / no drops (~6% slower full-model, r5) — trade
+        step time for exact routing. Attacked in rounds 4-5 and kept
+        non-default on the numbers: 128-aligned group boundaries measured
+        neutral, a fused gate|up parameter measured SLOWER (XLA already
+        folds the in-graph concat), and an r5 fixed-assignment A/B shows
+        routing+dispatch INDEX MATH costs ~0 ms (r4's "11.5 ms" was
+        cross-session variance) — the real MoE premium over a
+        dense-equivalent model is capacity padding + dispatch data
+        movement + expert-granularity (decomposition in BASELINE.md and
+        tools/moe_ab.py).
       * "einsum" — GShard one-hot dispatch/combine einsums (~2x sorted);
         XLA's SPMD partitioner turns the token-expert contraction into the
         ICI all_to_all, the cleanest multi-chip ep-sharded lowering — use

@@ -74,3 +74,49 @@ def test_gqa_matches_mha_repeat():
     ids = np.random.default_rng(1).integers(0, 32, (1, 8)).astype(np.int32)
     out = m(ids)
     assert np.isfinite(out.numpy()).all()
+
+
+def test_remat_policy_dots_grad_parity():
+    """recompute with the "dots" checkpoint policy (save matmul outputs,
+    r5) must produce the same loss AND grads as no recompute at all."""
+    import jax
+    import numpy as np
+
+    from paddlepaddle_tpu.core import autograd as ag
+    from paddlepaddle_tpu.core.dispatch import unwrap
+    from paddlepaddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    ids = np.random.default_rng(0).integers(0, 128, (2, 16)).astype(np.int32)
+    results = {}
+    state0 = None
+    for tag, kw in (("plain", {}),
+                    ("dots", dict(recompute=True, remat_policy="dots"))):
+        paddle.seed(0)
+        cfg = LlamaConfig.tiny(vocab_size=128, hidden_size=32, layers=2,
+                               heads=4, kv_heads=2, max_len=32)
+        for k, v in kw.items():
+            setattr(cfg, k, v)
+        model = LlamaForCausalLM(cfg)
+        if state0 is None:
+            state0 = {k: np.asarray(v) for k, v in
+                      model.functional_state(trainable_only=True).items()}
+        buffers = {k: v for k, v in model.functional_state().items()
+                   if k not in state0}
+
+        def loss_of(p):
+            with ag.no_grad():
+                full = dict(p)
+                full.update(buffers)
+                with model.bind_state(full):
+                    return unwrap(model(paddle.to_tensor(ids),
+                                        labels=paddle.to_tensor(ids)))
+
+        loss, grads = jax.jit(jax.value_and_grad(loss_of))(state0)
+        results[tag] = (float(np.asarray(loss)),
+                        {k: np.asarray(v) for k, v in grads.items()})
+    l_plain, g_plain = results["plain"]
+    l_dots, g_dots = results["dots"]
+    assert abs(l_plain - l_dots) < 1e-4, (l_plain, l_dots)
+    for k in g_plain:
+        np.testing.assert_allclose(g_dots[k], g_plain[k], rtol=2e-3,
+                                   atol=1e-5, err_msg=k)

@@ -315,3 +315,32 @@ def test_clone_keeps_feed_vars_resolvable(static_mode):
     test_prog = prog.clone(for_test=True)
     assert test_prog.global_block().var("x") is x
     assert any(v.name == "x" for v in test_prog.list_vars())
+
+
+def test_completion_inspects_propagated_shardings(static_mode):
+    """The completion pass (reference auto_parallel/static/completion.py
+    role): annotate ONE feed, read back the GSPMD-inferred placement of
+    every program variable on a CPU mesh."""
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from paddlepaddle_tpu.distributed.auto_parallel import (
+        complete_program, format_completion)
+
+    prog = paddle.static.Program()
+    with paddle.static.program_guard(prog):
+        x = paddle.static.data(name="x", shape=[32, 16], dtype="float32")
+        h = paddle.static.nn.fc(x, size=8, activation="relu")
+        out = h.sum()
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("dp",))
+    specs = complete_program(prog, mesh,
+                             feed_shardings={"x": P("dp", None)})
+    # the batch sharding propagates through fc+relu to h
+    h_spec = specs[h.name]
+    assert tuple(h_spec)[0] == "dp", specs
+    # ...but collapses at the scalar reduction
+    assert specs[out.name] == P()
+    text = format_completion(prog, specs)
+    assert "fc_tensordot" in text and "dp" in text
