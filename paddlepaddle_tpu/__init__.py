@@ -158,8 +158,44 @@ for _mod in _OP_MODULES:
             _globals.setdefault(_name, _obj)
 
 # submodules (populated as the build progresses)
+
+
+class _MissingModule:
+    """Placeholder bound when an OPTIONAL submodule fails to import (its
+    heavy dependency is absent from the environment): ``import
+    paddlepaddle_tpu`` must never break on an extra the user isn't using.
+    Any attribute access raises the original error with guidance."""
+
+    def __init__(self, name, err):
+        self.__name__ = "paddlepaddle_tpu." + name
+        object.__setattr__(self, "_mm_name", name)
+        object.__setattr__(self, "_mm_err", err)
+
+    def __getattr__(self, attr):
+        name, err = self._mm_name, self._mm_err
+        if attr.startswith("__") and attr.endswith("__"):
+            # dunder probes (hasattr/inspect/pickle) must see a normal
+            # AttributeError, not an ImportError they won't catch
+            raise AttributeError(attr)
+        raise ImportError(
+            f"paddlepaddle_tpu.{name} is unavailable: importing it failed "
+            f"with {err!r}. Install the missing optional dependency to use "
+            f"paddlepaddle_tpu.{name}.{attr}.") from err
+
+    def __repr__(self):
+        return f"<unavailable module {self.__name__} ({self._mm_err!r})>"
+
+
+def _optional_import(name):
+    import importlib
+
+    try:
+        return importlib.import_module("." + name, __name__)
+    except (ImportError, OSError) as e:  # missing package / shared lib
+        return _MissingModule(name, e)
+
+
 from . import amp  # noqa: E402,F401
-from . import audio  # noqa: E402,F401
 from . import autograd  # noqa: E402,F401
 from . import distributed  # noqa: E402,F401
 from . import distribution  # noqa: E402,F401
@@ -169,16 +205,22 @@ from . import geometric  # noqa: E402,F401
 from . import hapi  # noqa: E402,F401
 from . import hub  # noqa: E402,F401
 from . import incubate  # noqa: E402,F401
-from . import inference  # noqa: E402,F401
 from . import io  # noqa: E402,F401
 from . import jit  # noqa: E402,F401
 from . import linalg  # noqa: E402,F401
 from . import metric  # noqa: E402,F401
 from . import models  # noqa: E402,F401
 from . import nn  # noqa: E402,F401
-from . import onnx  # noqa: E402,F401
+from . import observability  # noqa: E402,F401
 from . import optimizer  # noqa: E402,F401
 from . import profiler  # noqa: E402,F401
+
+# optional extras: serving/deployment (inference), audio features, ONNX
+# export — guarded so a missing heavy dep degrades to a clear error on
+# first USE instead of breaking `import paddlepaddle_tpu`
+audio = _optional_import("audio")
+inference = _optional_import("inference")
+onnx = _optional_import("onnx")
 from . import quantization  # noqa: E402,F401
 from . import regularizer  # noqa: E402,F401
 from . import signal  # noqa: E402,F401

@@ -17,10 +17,16 @@ XLA path (whole-graph AD, fusable by the compiler).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+
+# observability hook (observability.enable installs, disable clears):
+# _obs_node("capture", op_name) when a GradNode is taped,
+# _obs_node("exec", op_name, dur_s) when its backward runs. None when off.
+_obs_node = None
 
 
 class _GradState(threading.local):
@@ -113,6 +119,8 @@ class GradNode:
         # the forward-time input ARRAYS (immutable), so lazy vjp recompute is
         # immune to later in-place updates of the input tensors
         self.primal_data = primal_data
+        if _obs_node is not None:
+            _obs_node("capture", name)
 
     def accumulate(self, index: int, grad):
         cur = self.out_grads[index]
@@ -383,7 +391,12 @@ def run_backward(
     seen_ready = set(id(n) for n in ready)
     while ready:
         node = ready.pop()
-        in_grads = _exec_node(node)
+        if _obs_node is None:
+            in_grads = _exec_node(node)
+        else:
+            t0 = time.perf_counter()
+            in_grads = _exec_node(node)
+            _obs_node("exec", node.name, time.perf_counter() - t0)
         for t, g in zip(node.inputs, in_grads):
             if id(t) in no_grad_ids:
                 continue

@@ -17,6 +17,7 @@ from jnp, VJPs from jax, SPMD rules from GSPMD sharding propagation.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable
 
 import jax
@@ -28,6 +29,13 @@ from .tensor import Tensor
 
 # AMP hook: paddlepaddle_tpu.amp installs a callable (op_name, datas) -> datas.
 _amp_cast_hook = None
+
+# observability hooks (observability.enable installs, disable clears):
+# _obs_op(name, dur_s) per dispatched op, _obs_amp(name, n_casts) per op
+# whose inputs the AMP policy re-typed. None when off — the hot path pays
+# one global read + branch.
+_obs_op = None
+_obs_amp = None
 
 # post-op observer: amp.debugging installs (op_name, out_datas) -> None for
 # the per-op NaN/Inf scan (FLAGS_check_nan_inf analogue) and op-stats.
@@ -69,6 +77,22 @@ def apply_op(fn: Callable, *args, op_name: str = None,
     ``static_eval_fn``: optional test-mode variant recorded on the captured
     static op (dropout/batch_norm), used by Program.clone(for_test=True).
     """
+    obs = _obs_op
+    if obs is None:
+        # disabled path: one global read + branch + a plain positional call
+        # (no *args/**kwargs repack) into the inner — the cost contract
+        # tools/check_obs_overhead.py enforces
+        return _apply_op(fn, args, kwargs, op_name, static_eval_fn)
+    name = op_name or getattr(fn, "__name__", "op")
+    t0 = time.perf_counter()
+    try:
+        return _apply_op(fn, args, kwargs, name, static_eval_fn)
+    finally:
+        obs(name, time.perf_counter() - t0)
+
+
+def _apply_op(fn: Callable, args: tuple, kwargs: dict, op_name: str,
+              static_eval_fn: Callable) -> Any:
     name = op_name or getattr(fn, "__name__", "op")
     leaves, treedef = jax.tree_util.tree_flatten(
         (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor)
@@ -101,7 +125,15 @@ def apply_op(fn: Callable, *args, op_name: str = None,
                            eval_fn=static_eval_fn)
 
     if _amp_cast_hook is not None and tensor_pos:
-        datas = _amp_cast_hook(name, datas, tensor_pos)
+        if _obs_amp is None:
+            datas = _amp_cast_hook(name, datas, tensor_pos)
+        else:
+            before = [getattr(datas[p], "dtype", None) for p in tensor_pos]
+            datas = _amp_cast_hook(name, datas, tensor_pos)
+            n = sum(1 for p, d in zip(tensor_pos, before)
+                    if getattr(datas[p], "dtype", None) != d)
+            if n:
+                _obs_amp(name, n)
 
     grad_on = ag.is_grad_enabled()
     diff_pos = [i for i in tensor_pos if grad_on and _requires_grad(leaves[i])]

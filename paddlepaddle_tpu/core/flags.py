@@ -27,15 +27,20 @@ class _Flag:
 _registry: Dict[str, _Flag] = {}
 
 
-def define_flag(name: str, default: Any, help_: str = ""):
+def define_flag(name: str, default: Any, help_: str = "", env: str = None):
+    """Register a typed flag. ``env`` names an alternate environment variable
+    consulted (after the canonical FLAGS_xxx) for the initial value — used by
+    flag families with an established env spelling (PADDLE_OBS_*)."""
     if not name.startswith("FLAGS_"):
         name = "FLAGS_" + name
     if name in _registry:
         return _registry[name]
     f = _Flag(name, default, help_)
-    env = os.environ.get(name)
-    if env is not None:
-        f.value = _parse(env, f.type)
+    raw = os.environ.get(name)
+    if raw is None and env is not None:
+        raw = os.environ.get(env)
+    if raw is not None:
+        f.value = _parse(raw, f.type)
         f.env_read = True
     _registry[name] = f
     return f
@@ -83,3 +88,24 @@ define_flag("flash_attn_block_q", 512, "pallas flash-attn q block")
 define_flag("flash_attn_block_kv", 512, "pallas flash-attn kv block")
 define_flag("eager_delete_tensor_gb", 0.0, "compat no-op (XLA owns memory)")
 define_flag("allocator_strategy", "xla", "compat: allocation handled by XLA runtime")
+
+# Observability family (observability/): each flag also reads its PADDLE_OBS_*
+# env spelling; all default off so the hot paths carry no instrumentation.
+define_flag("obs_trace", False,
+            "record host spans (ops, regions, collectives) into the "
+            "observability ring buffer for chrome-trace export",
+            env="PADDLE_OBS_TRACE")
+define_flag("obs_metrics", False,
+            "aggregate per-op/per-collective counters, gauges and latency "
+            "histograms in the observability metrics registry",
+            env="PADDLE_OBS_METRICS")
+define_flag("obs_recompile_watch", False,
+            "watch jax.jit compilations and warn on recompilation storms "
+            "(same callsite compiling repeatedly)",
+            env="PADDLE_OBS_RECOMPILE_WATCH")
+define_flag("obs_buffer_size", 100000,
+            "observability ring buffer capacity (events)",
+            env="PADDLE_OBS_BUFFER_SIZE")
+define_flag("obs_recompile_threshold", 3,
+            "compiles from one callsite before the recompilation watchdog "
+            "flags a storm", env="PADDLE_OBS_RECOMPILE_THRESHOLD")

@@ -1,0 +1,44 @@
+"""Version-compat shims over moved/renamed JAX APIs.
+
+The repo pins no JAX version: installed builds range from 0.4.x (where
+``shard_map`` still lives in ``jax.experimental`` and the replication-check
+kwarg is spelled ``check_rep``) to >= 0.6 (promoted to the top-level ``jax``
+namespace, kwarg renamed ``check_vma``). Import the symbol from here — one
+probe site instead of a per-module try/except — and write call sites in the
+NEW spelling (``check_vma``); the shim rewrites kwargs for old builds.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import jax
+
+try:
+    _raw_shard_map = jax.shard_map  # jax >= 0.6
+    if not callable(_raw_shard_map):
+        # some versions expose jax.shard_map as a MODULE holding the fn
+        _raw_shard_map = _raw_shard_map.shard_map
+except AttributeError:
+    from jax.experimental.shard_map import shard_map as _raw_shard_map
+
+try:
+    _SHARD_MAP_KWARGS = frozenset(
+        inspect.signature(_raw_shard_map).parameters)
+except (TypeError, ValueError):  # C-implemented / wrapped: assume modern
+    _SHARD_MAP_KWARGS = frozenset(("mesh", "in_specs", "out_specs",
+                                   "check_vma"))
+
+
+def shard_map(f, **kwargs):
+    """``jax.shard_map`` with the replication-check kwarg normalized:
+    accepts either ``check_vma`` (>= 0.6) or ``check_rep`` (<= 0.5) and
+    forwards whichever the installed build understands."""
+    for new, old in (("check_vma", "check_rep"), ("check_rep", "check_vma")):
+        if new in kwargs and new not in _SHARD_MAP_KWARGS \
+                and old in _SHARD_MAP_KWARGS:
+            kwargs[old] = kwargs.pop(new)
+    return _raw_shard_map(f, **kwargs)
+
+
+__all__ = ["shard_map"]

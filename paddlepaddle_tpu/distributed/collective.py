@@ -115,6 +115,56 @@ def f_axis_index(axis: str):
 # eager paddle-signature wrappers
 # ---------------------------------------------------------------------------
 
+# observability hook: _obs_coll(op_name, nbytes, dur_s) per eager collective
+# call — bytes-moved counters + latency histograms (the per-collective comm
+# logging of the reference's comm_task layer). None when observability is off.
+_obs_coll = None
+
+
+def _nbytes(obj) -> int:
+    """Payload size of a Tensor / array / (nested) list of them."""
+    if isinstance(obj, (list, tuple)):
+        return sum(_nbytes(o) for o in obj)
+    data = getattr(obj, "_data", obj)
+    nb = getattr(data, "nbytes", None)
+    return int(nb) if nb is not None else 0
+
+
+def _collective_op(bytes_arg=None):
+    """Wrap an eager collective: when observability is on, record the call,
+    the payload bytes (positional arg ``bytes_arg``, or the keyword of the
+    same name when called keyword-style), and the wall time. Off: one
+    global read + branch."""
+    import functools
+    import inspect
+    import time
+
+    def deco(fn):
+        name = fn.__name__
+        payload_kw = (list(inspect.signature(fn).parameters)[bytes_arg]
+                      if bytes_arg is not None else None)
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            obs = _obs_coll
+            if obs is None:
+                return fn(*args, **kwargs)
+            t0 = time.perf_counter()
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                if bytes_arg is None:
+                    nb = 0
+                elif len(args) > bytes_arg:
+                    nb = _nbytes(args[bytes_arg])
+                else:
+                    nb = _nbytes(kwargs.get(payload_kw))
+                obs(name, nb, time.perf_counter() - t0)
+
+        return wrapper
+
+    return deco
+
 
 def _single_controller_identity(tensor):
     # In the single-controller GSPMD model, replicated values are already
@@ -156,6 +206,7 @@ def _set_inplace(tensor, value):
     return wrap(jnp.asarray(value))
 
 
+@_collective_op(bytes_arg=0)
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     g = _hg(group)
     if g is None:
@@ -163,6 +214,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     return _set_inplace(tensor, g.all_reduce(_np(tensor), op))
 
 
+@_collective_op(bytes_arg=1)
 def all_gather(tensor_list, tensor, group=None, sync_op=True):
     g = _hg(group)
     if g is None:
@@ -172,6 +224,7 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
     return tensor_list
 
 
+@_collective_op()
 def all_gather_object(object_list, obj, group=None):
     g = _hg(group)
     if g is None:
@@ -181,6 +234,7 @@ def all_gather_object(object_list, obj, group=None):
     return object_list
 
 
+@_collective_op(bytes_arg=0)
 def broadcast(tensor, src=0, group=None, sync_op=True):
     g = _hg(group)
     if g is None:
@@ -188,6 +242,7 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     return _set_inplace(tensor, g.broadcast(_np(tensor), src=src))
 
 
+@_collective_op(bytes_arg=0)
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     g = _hg(group)
     if g is None:
@@ -196,6 +251,7 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     return _set_inplace(tensor, out)
 
 
+@_collective_op(bytes_arg=1)
 def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=True):
     g = _hg(group)
     if g is None:
@@ -212,6 +268,7 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=Tru
     return _set_inplace(tensor, _REDUCERS[op](np.stack(mine)))
 
 
+@_collective_op(bytes_arg=1)
 def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     g = _hg(group)
     if g is None:
@@ -222,6 +279,7 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     return out_tensor_list
 
 
+@_collective_op(bytes_arg=0)
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     g = _hg(group)
     if g is None:
@@ -232,6 +290,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     return _set_inplace(tensor, g.scatter(parts, src=src))
 
 
+@_collective_op(bytes_arg=0)
 def send(tensor, dst=0, group=None, sync_op=True):
     g = _hg(group)
     if g is None:
@@ -243,6 +302,7 @@ def send(tensor, dst=0, group=None, sync_op=True):
     return tensor
 
 
+@_collective_op(bytes_arg=0)
 def recv(tensor, src=0, group=None, sync_op=True):
     g = _hg(group)
     if g is None:
@@ -288,6 +348,7 @@ def irecv(tensor, src=0, group=None):
                                  sync_op=False))
 
 
+@_collective_op()
 def barrier(group=None):
     from .comm_task import comm_task
 

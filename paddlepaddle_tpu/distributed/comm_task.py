@@ -24,6 +24,11 @@ _lock = threading.Lock()
 _tasks = {}  # task_id -> (name, group, start_monotonic, thread_id)
 _ids = itertools.count()
 
+# observability hook: _obs_task(name, group, elapsed_s) on every completed
+# task — per-collective/region latency histograms + trace spans. None when
+# observability is off.
+_obs_task = None
+
 
 def begin_task(name: str, group: Optional[str] = None) -> int:
     tid = next(_ids)
@@ -35,7 +40,10 @@ def begin_task(name: str, group: Optional[str] = None) -> int:
 
 def end_task(tid: int) -> None:
     with _lock:
-        _tasks.pop(tid, None)
+        task = _tasks.pop(tid, None)
+    if _obs_task is not None and task is not None:
+        name, group, start, _thread = task
+        _obs_task(name, group, time.monotonic() - start)
 
 
 class comm_task:

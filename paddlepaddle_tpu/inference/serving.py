@@ -31,6 +31,12 @@ from typing import List, Optional
 
 import numpy as np
 
+# observability hook: _obs_srv(event, value) with events "latency" (seconds
+# submit-to-result for one completed request), "error" (a request failed),
+# "batch_size" (decode slots / requests active in the current batch).
+# None when observability is off.
+_obs_srv = None
+
 
 class GenerationResult:
     """Future for one request."""
@@ -39,6 +45,7 @@ class GenerationResult:
         self._event = threading.Event()
         self._output = None
         self._error: Optional[BaseException] = None
+        self._t_submit = time.perf_counter()
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -56,6 +63,12 @@ class GenerationResult:
         self._output = output   # slot racing stop()) must not flip a result
         self._error = error
         self._event.set()
+        obs = _obs_srv
+        if obs is not None:
+            if error is None:
+                obs("latency", time.perf_counter() - self._t_submit)
+            else:
+                obs("error", 1)
 
 
 class GenerationRequest:
@@ -216,6 +229,8 @@ class ServingEngine:
                 continue
             self._bump("batches")
             self._bump("batched_requests", len(batch))
+            if _obs_srv is not None:
+                _obs_srv("batch_size", len(batch))
             try:
                 ids = np.concatenate([r.prompt_ids for r in batch], axis=0)
                 leader = batch[0]
@@ -263,6 +278,10 @@ class ServingEngine:
                 except BaseException as e:  # noqa: BLE001
                     req.result._set(error=e)
             if busy:
+                if _obs_srv is not None:
+                    _obs_srv("batch_size",
+                             sum(1 for s in eng._host_slots
+                                 if s.req is not None))
                 before = eng.stats["tokens_out"]
                 try:
                     eng._decode_chunk()

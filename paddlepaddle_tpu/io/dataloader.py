@@ -31,6 +31,12 @@ from ..core.tensor import Tensor
 from .dataset import IterableDataset
 from .sampler import BatchSampler
 
+# observability hook: _obs_io(event, value) with events "wait" (seconds the
+# parent blocked on worker data), "qdepth" (batches sitting prefetched in
+# the data queue), "batch" (one batch delivered to the training loop).
+# None when observability is off.
+_obs_io = None
+
 
 class WorkerInfo:
     """Visible to dataset code inside a worker (reference: paddle.io
@@ -304,6 +310,10 @@ class _WorkerPool:
         Polls in short slices so a worker that died WITHOUT posting an
         error message (killed, or crashed in interpreter startup before
         the loop) surfaces as an exception instead of a parent hang."""
+        import time as _time
+
+        obs = _obs_io
+        t_enter = _time.perf_counter() if obs is not None else 0.0
         waited = 0.0
         while True:
             slice_t = min(timeout - waited, 1.0) if timeout else 1.0
@@ -341,6 +351,12 @@ class _WorkerPool:
                 continue
             kind, epoch, key, payload = msg
             if kind == "error" or epoch == self.epoch:
+                if obs is not None:
+                    obs("wait", _time.perf_counter() - t_enter)
+                    try:
+                        obs("qdepth", self.data_queue.qsize())
+                    except NotImplementedError:  # macOS mp queues
+                        pass
                 return kind, key, payload
             # else: leftover from an abandoned epoch — discard
 
@@ -446,6 +462,15 @@ class DataLoader:
                 yield self.collate_fn([self.dataset[i] for i in indices])
 
     def __iter__(self):
+        obs = _obs_io
+        if obs is None:
+            yield from self._iter_impl()
+            return
+        for b in self._iter_impl():
+            obs("batch", 1)
+            yield b
+
+    def _iter_impl(self):
         if self.num_workers == 0:
             yield from self._batches()
             return

@@ -1,0 +1,437 @@
+"""Unified runtime observability — op-level tracing, metrics registry, and a
+recompilation watchdog, threaded through every hot path of the framework.
+
+Reference surface: the full ``paddle.profiler`` stack (host tracer + device
+tracer + chrome-trace export), ``paddle.monitor``-style stat registries, and
+per-collective comm logging. One subsystem here provides all three:
+
+* :class:`~.recorder.Recorder` — zero-dep host span recorder (thread-local
+  nesting, ring buffer, chrome-trace JSON export) interleaved with
+  ``jax.profiler.TraceAnnotation`` so host spans land in the same
+  TensorBoard/Perfetto timeline as XLA device activity;
+* :class:`~.metrics.Registry` — counters / gauges / histograms (exponential
+  buckets) with ``snapshot()`` and ``to_prometheus_text()``;
+* :mod:`~.watchdog` — detects ``jax.jit`` cache misses via
+  ``jax.monitoring`` and names the callsite of a recompilation storm;
+* instrumentation hooks in dispatch (per-op wall time, AMP casts), autograd
+  (node capture/exec), collectives + comm tasks (bytes, latency),
+  DataLoader workers (queue depth, wait time) and the serving engine
+  (request latency, batch size).
+
+Everything is gated by ``PADDLE_OBS_*`` env vars / ``FLAGS_obs_*`` flags and
+defaults OFF: the only cost on a hot path when disabled is one module-global
+``is None`` check. Turn it on::
+
+    import paddlepaddle_tpu.observability as obs
+    obs.enable()                       # trace + metrics + watchdog
+    ... run steps ...
+    print(obs.summary())               # per-op/per-collective table
+    obs.export_chrome_trace("/tmp/host_trace.json")   # open in Perfetto
+    text = obs.to_prometheus_text()    # mount on /metrics
+
+or set ``PADDLE_OBS_TRACE=1 PADDLE_OBS_METRICS=1 PADDLE_OBS_RECOMPILE_WATCH=1``
+before import.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..core import flags as _flags
+from . import watchdog
+from .metrics import (  # noqa: F401
+    BYTES_BUCKETS,
+    LATENCY_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    exponential_buckets,
+)
+from .recorder import Event, Recorder, trace_region  # noqa: F401
+
+_recorder = Recorder(capacity=_flags.flag_value("obs_buffer_size"))
+_registry = Registry()
+_trace_on = False
+_metrics_on = False
+_watchdog_on = False
+
+
+# -- state accessors (hot-path helpers, also used by recorder/watchdog) ------
+
+def get_recorder() -> Recorder:
+    return _recorder
+
+
+def get_registry() -> Registry:
+    return _registry
+
+
+def _recorder_if_tracing() -> Optional[Recorder]:
+    return _recorder if _trace_on else None
+
+
+def _metrics_if_enabled() -> Optional[Registry]:
+    return _registry if _metrics_on else None
+
+
+def is_enabled() -> bool:
+    return _trace_on or _metrics_on or _watchdog_on
+
+
+class RecordEvent(trace_region):
+    """Explicit host annotation: always records (no flags needed) and opens
+    a ``jax.profiler.TraceAnnotation``. ``paddle.profiler.RecordEvent`` is a
+    thin wrapper over this, so both APIs feed ONE event pipeline."""
+
+    def __init__(self, name: str, cat: str = "region"):
+        super().__init__(name, cat, force=True)
+
+
+# ---------------------------------------------------------------------------
+# hot-path hook bodies. Installed into the instrumented modules' nullable
+# module globals by enable(); metric objects are resolved once here so the
+# per-event work is dict-free.
+# ---------------------------------------------------------------------------
+
+def _make_hooks():
+    reg = _registry
+    rec = _recorder
+
+    op_calls = reg.counter("paddle_op_calls_total",
+                           "eager ops dispatched, by op name")
+    op_latency = reg.histogram("paddle_op_seconds",
+                               "eager op dispatch wall time, by op name")
+    amp_casts = reg.counter("paddle_amp_casts_total",
+                            "AMP dtype casts inserted at dispatch, by op")
+    node_cap = reg.counter("paddle_autograd_nodes_captured_total",
+                           "GradNodes recorded on the tape, by op")
+    node_exec = reg.counter("paddle_autograd_nodes_executed_total",
+                            "GradNode backwards executed, by op")
+    node_exec_lat = reg.histogram("paddle_autograd_node_seconds",
+                                  "GradNode backward wall time, by op")
+    comm_lat = reg.histogram("paddle_comm_task_seconds",
+                             "host-blocking comm/region task latency")
+    coll_calls = reg.counter("paddle_collective_calls_total",
+                             "eager collective calls, by collective")
+    coll_bytes = reg.counter("paddle_collective_bytes_total",
+                             "tensor bytes moved by eager collectives")
+    coll_lat = reg.histogram("paddle_collective_seconds",
+                             "eager collective wall time, by collective")
+    io_wait = reg.histogram("paddle_dataloader_wait_seconds",
+                            "parent time blocked waiting on worker data")
+    io_depth = reg.gauge("paddle_dataloader_queue_depth",
+                         "prefetched batches sitting in the data queue")
+    io_batches = reg.counter("paddle_dataloader_batches_total",
+                             "batches delivered to the training loop")
+    srv_requests = reg.counter("paddle_serving_requests_total",
+                               "generation requests completed, by outcome")
+    srv_lat = reg.histogram("paddle_serving_request_seconds",
+                            "submit-to-result generation latency")
+    srv_batch = reg.gauge("paddle_serving_batch_size",
+                          "active decode slots / batched requests")
+
+    def obs_op(name, dur):
+        if _metrics_on:
+            op_calls.inc(op=name)
+            op_latency.observe(dur, op=name)
+        if _trace_on:
+            rec.record_complete(name, "op", dur)
+
+    def obs_amp(name, n):
+        if _metrics_on:
+            amp_casts.inc(n, op=name)
+
+    def obs_node(kind, name, dur=None):
+        if kind == "capture":
+            if _metrics_on:
+                node_cap.inc(op=name)
+            return
+        if _metrics_on:
+            node_exec.inc(op=name)
+            if dur is not None:
+                node_exec_lat.observe(dur, op=name)
+        if _trace_on and dur is not None:
+            rec.record_complete(name + "_bwd", "autograd", dur)
+
+    def obs_task(name, group, elapsed):
+        if _metrics_on:
+            comm_lat.observe(elapsed, task=name, group=group or "")
+        # "region" tasks are profiler RecordEvents — already recorder spans
+        # on the explicit path; re-recording them would double every region
+        # in the exported trace
+        if _trace_on and group != "region":
+            rec.record_complete(name, "comm", elapsed,
+                                {"group": group} if group else None)
+
+    def obs_coll(op, nbytes, dur):
+        if _metrics_on:
+            coll_calls.inc(coll=op)
+            if nbytes:
+                coll_bytes.inc(nbytes, coll=op)
+            coll_lat.observe(dur, coll=op)
+        if _trace_on:
+            rec.record_complete(op, "collective", dur,
+                                {"bytes": nbytes} if nbytes else None)
+
+    def obs_io(event, value):
+        if not _metrics_on:
+            return
+        if event == "wait":
+            io_wait.observe(value)
+        elif event == "qdepth":
+            io_depth.set(value)
+        elif event == "batch":
+            io_batches.inc(value)
+
+    def obs_srv(event, value):
+        if not _metrics_on:
+            return
+        if event == "latency":
+            srv_lat.observe(value)
+            srv_requests.inc(outcome="ok")
+        elif event == "error":
+            srv_requests.inc(outcome="error")
+        elif event == "batch_size":
+            srv_batch.set(value)
+
+    return {
+        "op": obs_op, "amp": obs_amp, "node": obs_node, "task": obs_task,
+        "coll": obs_coll, "io": obs_io, "srv": obs_srv,
+    }
+
+
+def _set_hooks(hooks: Optional[dict]) -> None:
+    """Install (or clear, with None) the nullable hook globals in every
+    instrumented module. Optional modules (serving) are skipped if their
+    import fails — observability must never be the thing that breaks."""
+    from ..core import autograd as _ag
+    from ..core import dispatch as _dp
+    from ..distributed import collective as _coll
+    from ..distributed import comm_task as _ct
+    from ..io import dataloader as _dl
+
+    g = (lambda k: None) if hooks is None else hooks.get
+    _dp._obs_op = g("op")
+    _dp._obs_amp = g("amp")
+    _ag._obs_node = g("node")
+    _ct._obs_task = g("task")
+    _coll._obs_coll = g("coll")
+    _dl._obs_io = g("io")
+    try:
+        from ..inference import serving as _srv
+
+        _srv._obs_srv = g("srv")
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# lifecycle
+# ---------------------------------------------------------------------------
+
+def enable(trace: Optional[bool] = None, metrics: Optional[bool] = None,
+           watchdog_: Optional[bool] = None) -> None:
+    """Turn instrumentation on. ``None`` arguments fall back to the
+    ``FLAGS_obs_*`` flags (i.e. the ``PADDLE_OBS_*`` env vars); calling
+    ``enable()`` with no arguments and no flags set enables everything —
+    "I asked for observability, give me observability"."""
+    global _trace_on, _metrics_on, _watchdog_on
+    if trace is None and metrics is None and watchdog_ is None \
+            and not (_flags.flag_value("obs_trace")
+                     or _flags.flag_value("obs_metrics")
+                     or _flags.flag_value("obs_recompile_watch")):
+        trace = metrics = watchdog_ = True
+    _trace_on = _flags.flag_value("obs_trace") if trace is None else bool(trace)
+    _metrics_on = (_flags.flag_value("obs_metrics") if metrics is None
+                   else bool(metrics))
+    _watchdog_on = (_flags.flag_value("obs_recompile_watch")
+                    if watchdog_ is None else bool(watchdog_))
+    _flags.set_flags({"obs_trace": _trace_on, "obs_metrics": _metrics_on,
+                      "obs_recompile_watch": _watchdog_on})
+    _recorder.set_capacity(_flags.flag_value("obs_buffer_size"))
+    if _trace_on or _metrics_on:
+        _set_hooks(_make_hooks())
+    else:
+        _set_hooks(None)
+    if _watchdog_on:
+        watchdog.install(_flags.flag_value("obs_recompile_threshold"))
+    else:
+        watchdog.uninstall()
+
+
+def disable() -> None:
+    """Uninstall every hook; hot paths return to the bare ``is None``
+    check. Recorded data is kept — call :func:`reset` to drop it."""
+    global _trace_on, _metrics_on, _watchdog_on
+    _trace_on = _metrics_on = _watchdog_on = False
+    _flags.set_flags({"obs_trace": False, "obs_metrics": False,
+                      "obs_recompile_watch": False})
+    _set_hooks(None)
+    watchdog.uninstall()
+
+
+def reset() -> None:
+    """Clear the ring buffer, all metric values, and watchdog state."""
+    _recorder.clear()
+    _registry.clear()
+    watchdog.reset()
+
+
+# ---------------------------------------------------------------------------
+# read-side API
+# ---------------------------------------------------------------------------
+
+def snapshot() -> dict:
+    return _registry.snapshot()
+
+
+def to_prometheus_text() -> str:
+    return _registry.to_prometheus_text()
+
+
+def export_chrome_trace(path: str) -> str:
+    """Write the host span ring buffer as trace-event JSON (loadable by
+    Perfetto / chrome://tracing). Device-side XLA activity comes from
+    ``jax.profiler`` traces; host spans opened while such a trace is active
+    also appear there via TraceAnnotation."""
+    return _recorder.export_chrome_trace(path)
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n:.1f}GiB"
+
+
+def _section(lines, title):
+    lines.append("")
+    lines.append(title)
+    lines.append("-" * len(title))
+
+
+def summary(top: int = 30) -> str:
+    """Human-readable report over everything recorded: per-op dispatch
+    counts/timings, autograd node activity, collectives, IO, serving, and
+    the recompilation table. Returns (and prints nothing) — callers decide
+    where it goes."""
+    snap = _registry.snapshot()
+    lines = [f"paddlepaddle_tpu observability summary "
+             f"(trace={'on' if _trace_on else 'off'}, "
+             f"metrics={'on' if _metrics_on else 'off'}, "
+             f"watchdog={'on' if _watchdog_on else 'off'})"]
+
+    def rows_of(counter_name):
+        return sorted(snap.get(counter_name, {}).items(),
+                      key=lambda kv: -kv[1])
+
+    op_hist = _registry.get("paddle_op_seconds")
+    ops = rows_of("paddle_op_calls_total")
+    if ops:
+        _section(lines, "Dispatch (eager ops)")
+        lines.append(f"{'Op':<32}{'Calls':>8}{'Total(ms)':>12}{'Avg(us)':>10}"
+                     f"{'p99(us)':>10}")
+        hist_snap = snap.get("paddle_op_seconds", {})
+        for key, calls in ops[:top]:
+            name = dict(key).get("op", "?")
+            h = hist_snap.get(key, {})
+            total = h.get("sum", 0.0)
+            p99 = op_hist.quantile(0.99, **dict(key)) if op_hist else 0.0
+            lines.append(f"{name:<32}{int(calls):>8}{total * 1e3:>12.2f}"
+                         f"{total / max(calls, 1) * 1e6:>10.1f}"
+                         f"{p99 * 1e6:>10.1f}")
+        if len(ops) > top:
+            lines.append(f"  ... {len(ops) - top} more ops")
+
+    cap = rows_of("paddle_autograd_nodes_captured_total")
+    ex = snap.get("paddle_autograd_nodes_executed_total", {})
+    if cap or ex:
+        _section(lines, "Autograd (grad nodes)")
+        lines.append(f"{'Op':<32}{'Captured':>10}{'Executed':>10}")
+        for key, n in cap[:top]:
+            name = dict(key).get("op", "?")
+            lines.append(f"{name:<32}{int(n):>10}{int(ex.get(key, 0)):>10}")
+
+    colls = rows_of("paddle_collective_calls_total")
+    if colls:
+        _section(lines, "Collectives (eager)")
+        byts = snap.get("paddle_collective_bytes_total", {})
+        lat = snap.get("paddle_collective_seconds", {})
+        lines.append(f"{'Collective':<24}{'Calls':>8}{'Bytes':>12}"
+                     f"{'Avg(us)':>10}")
+        for key, calls in colls:
+            name = dict(key).get("coll", "?")
+            h = lat.get(key, {})
+            avg = h.get("sum", 0.0) / max(h.get("count", 1), 1)
+            lines.append(f"{name:<24}{int(calls):>8}"
+                         f"{_fmt_bytes(byts.get(key, 0)):>12}"
+                         f"{avg * 1e6:>10.1f}")
+
+    tasks = snap.get("paddle_comm_task_seconds", {})
+    if tasks:
+        _section(lines, "Comm/region tasks")
+        lines.append(f"{'Task':<32}{'Count':>8}{'Total(ms)':>12}")
+        for key, h in sorted(tasks.items(), key=lambda kv: -kv[1]["sum"]):
+            name = dict(key).get("task", "?")
+            lines.append(f"{name:<32}{h['count']:>8}{h['sum'] * 1e3:>12.2f}")
+
+    io = snap.get("paddle_dataloader_wait_seconds", {})
+    if io or snap.get("paddle_dataloader_batches_total"):
+        _section(lines, "DataLoader")
+        h = io.get((), {})
+        batches = snap.get("paddle_dataloader_batches_total", {}).get((), 0)
+        depth = snap.get("paddle_dataloader_queue_depth", {}).get((), 0)
+        lines.append(f"batches={int(batches)}  queue_depth={depth:g}  "
+                     f"wait_total={h.get('sum', 0.0) * 1e3:.1f}ms  "
+                     f"waits={h.get('count', 0)}")
+
+    srv = snap.get("paddle_serving_request_seconds", {})
+    if srv or snap.get("paddle_serving_requests_total"):
+        _section(lines, "Serving")
+        h = srv.get((), {})
+        reqs = snap.get("paddle_serving_requests_total", {})
+        ok = reqs.get((("outcome", "ok"),), 0)
+        err = reqs.get((("outcome", "error"),), 0)
+        bs = snap.get("paddle_serving_batch_size", {}).get((), 0)
+        avg = h.get("sum", 0.0) / max(h.get("count", 1), 1)
+        lines.append(f"requests ok={int(ok)} err={int(err)}  "
+                     f"avg_latency={avg * 1e3:.2f}ms  batch_size={bs:g}")
+
+    region_stats = _recorder.stats()
+    if region_stats and _trace_on:
+        _section(lines, f"Host spans (ring buffer, "
+                        f"{len(_recorder.events())} events)")
+        lines.append(f"{'Span':<40}{'Count':>8}{'Total(ms)':>12}"
+                     f"{'Avg(ms)':>10}")
+        for name, (cnt, total, _mn, _mx) in sorted(
+                region_stats.items(), key=lambda kv: -kv[1][1])[:top]:
+            lines.append(f"{name:<40}{cnt:>8}{total * 1e3:>12.3f}"
+                         f"{total / max(cnt, 1) * 1e3:>10.3f}")
+
+    counts = watchdog.compile_counts()
+    if counts:
+        _section(lines, "jit compilations (watchdog)")
+        lines.append(watchdog.report())
+
+    if len(lines) == 1:
+        lines.append("  (nothing recorded — call observability.enable() "
+                     "or set PADDLE_OBS_TRACE/PADDLE_OBS_METRICS)")
+    return "\n".join(lines)
+
+
+# auto-enable from env: PADDLE_OBS_* / FLAGS_obs_* read at define_flag time
+if (_flags.flag_value("obs_trace") or _flags.flag_value("obs_metrics")
+        or _flags.flag_value("obs_recompile_watch")):
+    enable(trace=_flags.flag_value("obs_trace"),
+           metrics=_flags.flag_value("obs_metrics"),
+           watchdog_=_flags.flag_value("obs_recompile_watch"))
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Registry", "Recorder", "Event",
+    "RecordEvent", "trace_region", "exponential_buckets",
+    "enable", "disable", "reset", "is_enabled",
+    "get_recorder", "get_registry", "snapshot", "to_prometheus_text",
+    "export_chrome_trace", "summary", "watchdog",
+]

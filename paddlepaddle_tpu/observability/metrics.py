@@ -1,0 +1,279 @@
+"""Metrics registry — counters, gauges, histograms with exponential buckets.
+
+Reference surface: ``paddle.monitor``-style stat registries
+(paddle/fluid/platform/monitor.h — STAT_ADD/STAT_RESET macros over named
+int64 stats) plus the profiler's summary statistics. Exposed here with the
+two read APIs operators actually use: ``snapshot()`` (a plain dict for
+logging/assertions) and ``to_prometheus_text()`` (the exposition format, so
+a serving process can mount it on a /metrics endpoint verbatim).
+
+Label support is deliberately minimal: one optional label set per
+observation, stored keyed by the sorted (k, v) tuple. The hot-path callers
+(dispatch, collectives) use a single ``op``/``coll`` label, so cardinality
+stays bounded by the op vocabulary.
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_left
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+def exponential_buckets(start: float, factor: float, count: int) -> List[float]:
+    """``count`` upper bounds growing geometrically from ``start``."""
+    if start <= 0 or factor <= 1 or count < 1:
+        raise ValueError(
+            f"exponential_buckets needs start>0, factor>1, count>=1; got "
+            f"({start}, {factor}, {count})")
+    out, b = [], float(start)
+    for _ in range(count):
+        out.append(b)
+        b *= factor
+    return out
+
+
+# default latency buckets: 1 µs .. ~134 s in powers of 2 (seconds)
+LATENCY_BUCKETS = exponential_buckets(1e-6, 2.0, 28)
+# default size buckets: 64 B .. ~4 GiB in powers of 4
+BYTES_BUCKETS = exponential_buckets(64, 4.0, 14)
+
+
+def _label_key(labels: Optional[dict]) -> Tuple[Tuple[str, str], ...]:
+    if not labels:
+        return ()
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _esc(v: str) -> str:
+    """Prometheus exposition label-value escaping (backslash, quote, LF)."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_labels(key: Tuple[Tuple[str, str], ...], extra: str = "") -> str:
+    parts = [f'{k}="{_esc(v)}"' for k, v in key]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class Counter:
+    """Monotonically increasing count (per label set)."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self._lock = threading.Lock()
+        self._values: Dict[tuple, float] = {}
+
+    def inc(self, n: float = 1, **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0) + n
+
+    def value(self, **labels) -> float:
+        return self._values.get(_label_key(labels), 0)
+
+    def snapshot(self):
+        with self._lock:
+            return {key: v for key, v in self._values.items()}
+
+    def expose(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} counter"]
+        for key, v in sorted(self.snapshot().items()):
+            lines.append(f"{self.name}{_fmt_labels(key)} {v:g}")
+        return lines
+
+    def clear(self):
+        with self._lock:
+            self._values.clear()
+
+
+class Gauge:
+    """Last-written value (per label set)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self._lock = threading.Lock()
+        self._values: Dict[tuple, float] = {}
+
+    def set(self, v: float, **labels) -> None:
+        with self._lock:
+            self._values[_label_key(labels)] = v
+
+    def inc(self, n: float = 1, **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0) + n
+
+    def dec(self, n: float = 1, **labels) -> None:
+        self.inc(-n, **labels)
+
+    def value(self, **labels) -> float:
+        return self._values.get(_label_key(labels), 0)
+
+    def snapshot(self):
+        with self._lock:
+            return {key: v for key, v in self._values.items()}
+
+    def expose(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} gauge"]
+        for key, v in sorted(self.snapshot().items()):
+            lines.append(f"{self.name}{_fmt_labels(key)} {v:g}")
+        return lines
+
+    def clear(self):
+        with self._lock:
+            self._values.clear()
+
+
+class _HistState:
+    __slots__ = ("counts", "sum", "count", "min", "max")
+
+    def __init__(self, nbuckets):
+        self.counts = [0] * (nbuckets + 1)  # +1 for the +Inf bucket
+        self.sum = 0.0
+        self.count = 0
+        self.min = float("inf")
+        self.max = 0.0
+
+
+class Histogram:
+    """Cumulative histogram over fixed (typically exponential) buckets."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help_: str = "",
+                 buckets: Optional[Sequence[float]] = None):
+        self.name = name
+        self.help = help_
+        self.buckets = list(buckets if buckets is not None else LATENCY_BUCKETS)
+        if self.buckets != sorted(self.buckets):
+            raise ValueError(f"histogram {name}: buckets must be sorted")
+        self._lock = threading.Lock()
+        self._states: Dict[tuple, _HistState] = {}
+
+    def observe(self, v: float, **labels) -> None:
+        key = _label_key(labels)
+        # le (<=) bucket semantics: v equal to a bound counts IN that bucket
+        idx = bisect_left(self.buckets, v)
+        with self._lock:
+            st = self._states.get(key)
+            if st is None:
+                st = self._states[key] = _HistState(len(self.buckets))
+            st.counts[idx] += 1
+            st.sum += v
+            st.count += 1
+            if v < st.min:
+                st.min = v
+            if v > st.max:
+                st.max = v
+
+    def quantile(self, q: float, **labels) -> float:
+        """Upper-bound estimate of the q-quantile from bucket counts."""
+        st = self._states.get(_label_key(labels))
+        if st is None or st.count == 0:
+            return 0.0
+        target = q * st.count
+        seen = 0
+        for i, c in enumerate(st.counts):
+            seen += c
+            if seen >= target:
+                return self.buckets[i] if i < len(self.buckets) else st.max
+        return st.max
+
+    def snapshot(self):
+        with self._lock:
+            return {
+                key: {"count": st.count, "sum": st.sum, "min": st.min,
+                      "max": st.max,
+                      "buckets": dict(zip(self.buckets + [float("inf")],
+                                          st.counts))}
+                for key, st in self._states.items()
+            }
+
+    def expose(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} histogram"]
+        for key, snap in sorted(self.snapshot().items()):
+            cum = 0
+            for le, c in snap["buckets"].items():
+                cum += c
+                le_s = "+Inf" if le == float("inf") else f"{le:g}"
+                le_label = 'le="%s"' % le_s
+                lines.append(
+                    f"{self.name}_bucket{_fmt_labels(key, le_label)} {cum}")
+            lines.append(f"{self.name}_sum{_fmt_labels(key)} {snap['sum']:g}")
+            lines.append(f"{self.name}_count{_fmt_labels(key)} {snap['count']}")
+        return lines
+
+    def clear(self):
+        with self._lock:
+            self._states.clear()
+
+
+class Registry:
+    """Named metric store. ``counter``/``gauge``/``histogram`` are
+    get-or-create (idempotent, like prometheus_client), so instrumented
+    modules can resolve their metrics at install time without ordering
+    constraints."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, object] = {}
+
+    def _get_or_create(self, cls, name, help_, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, help_, **kw)
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}")
+            return m
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help_)
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help_)
+
+    def histogram(self, name: str, help_: str = "",
+                  buckets: Optional[Sequence[float]] = None) -> Histogram:
+        return self._get_or_create(Histogram, name, help_, buckets=buckets)
+
+    def get(self, name: str):
+        return self._metrics.get(name)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    def snapshot(self) -> dict:
+        """{metric_name: {label_key: value-or-hist-dict}} for everything."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return {m.name: m.snapshot() for m in metrics}
+
+    def to_prometheus_text(self) -> str:
+        with self._lock:
+            metrics = [self._metrics[n] for n in sorted(self._metrics)]
+        lines: List[str] = []
+        for m in metrics:
+            lines.extend(m.expose())
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def clear(self) -> None:
+        """Zero every metric (registrations survive — hooks keep their
+        references)."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            m.clear()

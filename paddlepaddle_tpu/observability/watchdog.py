@@ -1,0 +1,162 @@
+"""Recompilation watchdog — the #1 silent TPU perf killer, made loud.
+
+Every distinct (shapes, dtypes, static-args) signature hitting a
+``jax.jit`` triggers a fresh XLA compilation: a shape-polymorphic input
+pipeline or a python-scalar hyperparameter threaded as a traced value can
+silently recompile every step, turning a 10 ms step into seconds. XLA gives
+no per-callsite signal, but ``jax.monitoring`` publishes a
+``/jax/core/compile/backend_compile_duration`` event for each backend
+compile — this watchdog listens to it, attributes the compile to the
+nearest non-library stack frame (the user's jit callsite), and warns once a
+callsite crosses ``FLAGS_obs_recompile_threshold`` compiles (a
+"recompilation storm").
+
+Reference analogue: the reference framework logs a full program-cache miss
+per build (paddle/fluid/framework/ir pass timing); here the cache is
+jax.jit's and the miss signal is the monitoring event.
+
+``jax.monitoring`` listeners cannot be unregistered individually, so ONE
+process-wide listener is installed on first ``install()`` and gated by the
+module ``_active`` flag afterwards — disable costs one bool check per
+compile, which only ever fires on the slow path anyway.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import traceback
+from typing import Dict, List, Optional
+
+_logger = logging.getLogger("paddlepaddle_tpu.observability")
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_lock = threading.Lock()
+_active = False
+_listener_installed = False
+_threshold = 3
+# callsite "file:line" -> [compiles, total_s, last_stack_summary]
+_sites: Dict[str, list] = {}
+_compile_log: List[dict] = []
+_warned: set = set()
+_on_storm = None  # test/user hook: callback(site, count)
+
+_SKIP_SUBSTRINGS = (
+    "/jax/", "/jaxlib/", "jax/_src", "importlib", "/threading.py",
+    "/contextlib.py", "/functools.py", "paddlepaddle_tpu/observability/",
+)
+
+
+def _callsite() -> tuple:
+    """(site_id, summary): the deepest frame that is not jax/library
+    machinery — the user (or framework) line whose jit call compiled."""
+    stack = traceback.extract_stack()
+    for fr in reversed(stack):
+        fn = fr.filename.replace("\\", "/")
+        if any(s in fn for s in _SKIP_SUBSTRINGS):
+            continue
+        return (f"{fr.filename}:{fr.lineno}",
+                f"{fr.filename}:{fr.lineno} in {fr.name}: {fr.line}")
+    return ("<unknown>", "<unknown callsite>")
+
+
+def _on_compile(dur_s: float) -> None:
+    from . import _metrics_if_enabled, _recorder_if_tracing
+
+    site, summary = _callsite()
+    storm = None
+    with _lock:
+        rec = _sites.setdefault(site, [0, 0.0, summary])
+        rec[0] += 1
+        rec[1] += dur_s
+        rec[2] = summary
+        _compile_log.append(
+            {"site": site, "duration_s": dur_s, "ordinal": rec[0]})
+        if len(_compile_log) > 1000:
+            del _compile_log[:100]
+        if rec[0] >= _threshold and site not in _warned:
+            _warned.add(site)
+            storm = (site, rec[0], rec[1], summary)
+    reg = _metrics_if_enabled()
+    if reg is not None:
+        reg.counter("paddle_jit_compiles_total",
+                    "backend (XLA) compilations").inc(site=site)
+        reg.histogram("paddle_jit_compile_seconds",
+                      "backend compile wall time").observe(dur_s)
+    tracer = _recorder_if_tracing()
+    if tracer is not None:
+        tracer.record_complete("jit_compile", "compile", dur_s,
+                               {"site": site})
+    if storm is not None:
+        site, n, total, summary = storm
+        _logger.warning(
+            "recompilation storm: %s has compiled %d times (%.2fs total "
+            "compile time). A jit hit with a new signature recompiles the "
+            "whole program — check for shape-polymorphic inputs (pad/bucket "
+            "them) or python values that change per call (mark them "
+            "static or hoist them). Offending callsite:\n  %s",
+            site, n, total, summary)
+        if _on_storm is not None:
+            _on_storm(site, n)
+
+
+def _listener(event: str, duration_secs: float, **_kw) -> None:
+    if _active and event == _COMPILE_EVENT:
+        try:
+            _on_compile(duration_secs)
+        except Exception:  # never let telemetry break a compile
+            _logger.debug("recompile watchdog failed", exc_info=True)
+
+
+def install(threshold: Optional[int] = None) -> None:
+    global _active, _listener_installed, _threshold
+    if threshold is not None:
+        _threshold = max(int(threshold), 1)
+    with _lock:
+        if not _listener_installed:
+            import jax.monitoring
+
+            jax.monitoring.register_event_duration_secs_listener(_listener)
+            _listener_installed = True
+    _active = True
+
+
+def uninstall() -> None:
+    global _active
+    _active = False
+
+
+def set_storm_callback(cb) -> None:
+    global _on_storm
+    _on_storm = cb
+
+
+def reset() -> None:
+    with _lock:
+        _sites.clear()
+        _compile_log.clear()
+        _warned.clear()
+
+
+def compile_counts() -> Dict[str, int]:
+    with _lock:
+        return {site: rec[0] for site, rec in _sites.items()}
+
+
+def compile_log() -> List[dict]:
+    with _lock:
+        return list(_compile_log)
+
+
+def report() -> str:
+    """Per-callsite compile table, most-compiled first."""
+    with _lock:
+        rows = sorted(_sites.items(), key=lambda kv: -kv[1][0])
+    lines = [f"{'Compiles':>9}  {'Total(s)':>9}  Callsite"]
+    for site, (n, total, _summary) in rows:
+        marker = "  <-- storm" if n >= _threshold else ""
+        lines.append(f"{n:>9}  {total:>9.2f}  {site}{marker}")
+    if not rows:
+        lines.append("  (no compilations observed)")
+    return "\n".join(lines)

@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from jax import shard_map as _shard_map
+from ...core.jax_compat import shard_map as _shard_map
 
 NEG_INF = -1e30
 

@@ -36,7 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from jax import shard_map as _shard_map
+from ..core.jax_compat import shard_map as _shard_map
 
 from .schedules import (OP_B, OP_B_LAST, OP_BW, OP_BW_LAST, OP_BX,
                         OP_BX_LAST, OP_F, OP_IDLE, PipelineSchedule,

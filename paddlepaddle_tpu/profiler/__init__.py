@@ -3,19 +3,26 @@ Profiler with scheduler/on_trace_ready, RecordEvent annotations,
 chrome-tracing export; C++ host tracer + CUPTI device tracer).
 
 TPU-native: jax.profiler is the device tracer (XPlane/TensorBoard +
-Perfetto); RecordEvent maps to jax.profiler.TraceAnnotation so host
-annotations land in the same timeline. Summary statistics are host-side
-wall-time aggregates per RecordEvent name.
+Perfetto); host spans ride the framework-wide observability recorder
+(observability/recorder.py) — ONE event pipeline, so ``RecordEvent``
+regions, dispatch op spans and collective spans all land in the same ring
+buffer, chrome-trace export, and ``observability.summary()`` table. Each
+span also opens a ``jax.profiler.TraceAnnotation`` so it interleaves with
+XLA device activity in TensorBoard/Perfetto. Summary statistics here are
+the recorder's per-name aggregates for the "record_event" category.
 """
 
 from __future__ import annotations
 
 import os
 import time
-from collections import defaultdict
 from typing import Callable, Iterable, Optional
 
 import jax
+
+from ..observability import get_recorder
+
+_RECORD_EVENT_CAT = "record_event"
 
 
 class ProfilerTarget:
@@ -55,41 +62,48 @@ def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
     return sched
 
 
-_event_stats = defaultdict(lambda: [0, 0.0])  # name -> [count, total_s]
-
-
 class RecordEvent:
-    """Host annotation (reference: paddle/phi/api/profiler/event_tracing.h:32);
-    shows up in the jax trace via TraceAnnotation and in summary()."""
+    """Host annotation (reference: paddle/phi/api/profiler/event_tracing.h:32).
+
+    A thin wrapper over the observability recorder's explicit span path:
+    always records (no ``PADDLE_OBS_*`` flags needed), opens a
+    ``jax.profiler.TraceAnnotation`` (device-timeline interleaving), and
+    registers in the comm-task registry so a watchdog timeout names the
+    active region (CommTaskManager-style attribution)."""
 
     def __init__(self, name: str, event_type=None):
         self.name = name
-        self._ann = None
         self._t0 = None
+        self._ann = None
+        self._task = None
 
     def begin(self):
         from ..distributed import comm_task as _ct
 
+        if self._t0 is not None:
+            return
+        self._task = _ct.begin_task(self.name, group="region")
         self._ann = jax.profiler.TraceAnnotation(self.name)
         self._ann.__enter__()
         self._t0 = time.perf_counter()
-        # registered in the comm-task registry so a watchdog timeout names
-        # the active region (CommTaskManager-style attribution)
-        self._task = _ct.begin_task(self.name, group="region")
 
     def end(self):
         from ..distributed import comm_task as _ct
 
-        if getattr(self, "_task", None) is not None:
-            _ct.end_task(self._task)
-            self._task = None
-        if self._t0 is not None:
-            stats = _event_stats[self.name]
-            stats[0] += 1
-            stats[1] += time.perf_counter() - self._t0
+        if self._t0 is None:
+            return
+        # per-instance timing (not the recorder's thread-local stack):
+        # begin/end are user-driven, so pairs may overlap without nesting
+        # or span threads — record_complete handles both
+        dur = time.perf_counter() - self._t0
+        self._t0 = None
         if self._ann is not None:
             self._ann.__exit__(None, None, None)
             self._ann = None
+        get_recorder().record_complete(self.name, _RECORD_EVENT_CAT, dur)
+        if self._task is not None:
+            _ct.end_task(self._task)
+            self._task = None
 
     def __enter__(self):
         self.begin()
@@ -102,7 +116,9 @@ class RecordEvent:
 
 def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
     def handler(prof):
-        prof.export(dir_name)
+        os.makedirs(dir_name, exist_ok=True)
+        prof.export(os.path.join(
+            dir_name, f"{worker_name or 'host'}_trace.json"))
 
     return handler
 
@@ -147,14 +163,24 @@ class Profiler:
         return f"step {self._step}: {dt * 1000:.2f} ms"
 
     def export(self, path: str, format: str = "json"):
-        # device trace already written to self._dir by stop_trace
+        """Write the host span ring buffer as chrome trace-event JSON at
+        ``path`` (device XPlane traces are already in the logdir from
+        stop_trace; this adds the host timeline Perfetto can overlay)."""
+        if path and format == "json":
+            # export_chrome_tracing handlers pass the trace DIRECTORY —
+            # drop the host timeline in a file alongside the device XPlanes
+            if path.endswith(os.sep) or os.path.isdir(path):
+                os.makedirs(path, exist_ok=True)
+                path = os.path.join(path, "host_trace.json")
+            return get_recorder().export_chrome_trace(path)
         return self._dir
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms"):
         lines = [f"{'Event':<40}{'Calls':<8}{'Total(ms)':<12}{'Avg(ms)':<10}"]
-        for name, (cnt, total) in sorted(_event_stats.items(),
-                                         key=lambda kv: -kv[1][1]):
+        stats = get_recorder().stats(_RECORD_EVENT_CAT)
+        for name, (cnt, total, _mn, _mx) in sorted(stats.items(),
+                                                   key=lambda kv: -kv[1][1]):
             lines.append(f"{name:<40}{cnt:<8}{total * 1e3:<12.3f}{total / max(cnt, 1) * 1e3:<10.3f}")
         out = "\n".join(lines)
         print(out)

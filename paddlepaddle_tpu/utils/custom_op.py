@@ -85,8 +85,8 @@ class CustomOp:
                 f"custom op {self.name!r} was registered without a "
                 "sharding_rule; plain calls already propagate GSPMD "
                 "shardings")
+        from ..core.jax_compat import shard_map
         from ..parallel.mpu import _current_mesh
-        from jax import shard_map
 
         mesh = mesh or _current_mesh()
         if mesh is None:

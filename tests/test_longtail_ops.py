@@ -143,8 +143,11 @@ def test_top_p_sampling():
 
 def test_reference_tensor_namespace_closed():
     """Every reference python/paddle/tensor export exists here."""
+    import os
     import re
 
+    if not os.path.exists("/root/reference"):
+        pytest.skip("reference tree not present")
     src = open("/root/reference/python/paddle/tensor/__init__.py").read()
     ref = set(re.findall(r"'(\w+)'", src))
     missing = sorted(n for n in ref
@@ -156,8 +159,11 @@ def test_reference_tensor_namespace_closed():
 def test_top_level_namespace_closed():
     """Every real reference python/paddle export exists (excluding the
     regex's build-env string captures)."""
+    import os
     import re
 
+    if not os.path.exists("/root/reference"):
+        pytest.skip("reference tree not present")
     src = open("/root/reference/python/paddle/__init__.py").read()
     ref = set(re.findall(r"'(\w+)'", src))
     junk = {"32_", "AMD64", "AddDllDirectory", "CINN_CONFIG_PATH", "Library",

@@ -86,10 +86,13 @@ def test_vision_zoo_variant_tail_forward():
     vision/models __all__ (python/paddle/vision/models/__init__.py:64)
     now resolves, and the new size/activation variants run forward."""
     import ast
+    import os
 
     from paddlepaddle_tpu.vision import models as M
 
     ref = "/root/reference/python/paddle/vision/models/__init__.py"
+    if not os.path.exists(ref):
+        pytest.skip("reference tree not present")
     tree = ast.parse(open(ref).read())
     names = next(
         [ast.literal_eval(e) for e in n.value.elts]

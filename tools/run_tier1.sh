@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Tier-1 verification — THE command builders and CI run (keep identical to
+# the "Tier-1 verify" line in ROADMAP.md; edit both together).
+#
+# Counts pass dots from the pytest progress line so a partial hang still
+# reports how far it got; exits with pytest's own status.
+set -o pipefail
+cd "$(dirname "$0")/.."
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
+    2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+exit $rc
