@@ -214,6 +214,7 @@ from . import nn  # noqa: E402,F401
 from . import observability  # noqa: E402,F401
 from . import optimizer  # noqa: E402,F401
 from . import profiler  # noqa: E402,F401
+from . import resilience  # noqa: E402,F401
 
 # optional extras: serving/deployment (inference), audio features, ONNX
 # export — guarded so a missing heavy dep degrades to a clear error on

@@ -109,3 +109,14 @@ define_flag("obs_buffer_size", 100000,
 define_flag("obs_recompile_threshold", 3,
             "compiles from one callsite before the recompilation watchdog "
             "flags a storm", env="PADDLE_OBS_RECOMPILE_THRESHOLD")
+
+# Resilience family (resilience/): checkpoint integrity verification; the
+# chaos engine reads its PADDLE_CHAOS_* env vars directly (lazily at the
+# first seam hit, so launcher-spawned workers pick them up per process).
+define_flag("ckpt_verify_crc", True,
+            "verify per-shard CRC32 (checkpoint format v3) when loading; "
+            "corrupted shards raise CheckpointCorruptionError instead of "
+            "loading silently-wrong weights", env="PADDLE_CKPT_VERIFY")
+define_flag("watchdog_rearm", True,
+            "re-arm the step watchdog after a timed-out step retires, so "
+            "every hung step is reported (not only the first)")

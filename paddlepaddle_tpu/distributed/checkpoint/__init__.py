@@ -23,7 +23,21 @@ TPU-native design (format v2):
   ``jax.make_array_from_callback`` — loading a dp4×tp2 checkpoint into a
   dp2×fsdp2×tp2 job reads each byte once, no global gather.
 
-Format v1 (one global-value file per tensor) is still readable.
+Format v3 adds INTEGRITY (resilience PR): every shard record carries the
+``crc32`` of its file; single-host saves stage into a hidden temp directory
+and commit with an atomic rename (a checkpoint directory either exists
+fully-written or not at all — a kill mid-save can never leave a torn
+``metadata.json``); multi-host saves commit via an atomic ``os.replace`` of
+the merged ``metadata.json`` (no metadata ⇒ uncommitted). Shard writes pass
+through a filesystem retry policy and the ``ckpt.write_shard`` chaos seam.
+On load, CRCs are verified per shard file (``FLAGS_ckpt_verify_crc`` /
+``PADDLE_CKPT_VERIFY``, default on), raising
+:class:`~paddlepaddle_tpu.resilience.integrity.CheckpointCorruptionError`;
+``resilience.CheckpointManager`` layers newest-valid fallback and
+keep-last-K GC on top.
+
+Formats v1 (one global-value file per tensor) and v2 (no CRCs) are still
+readable.
 """
 
 from __future__ import annotations
@@ -32,6 +46,7 @@ import atexit
 import json
 import os
 import re
+import shutil
 import threading
 import time
 from dataclasses import dataclass, field
@@ -40,12 +55,34 @@ from typing import Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
+from ...core import flags as _flags
 from ...core.tensor import Tensor
+from ...resilience.chaos import chaos_point
+from ...resilience.integrity import CheckpointCorruptionError, file_crc32
+from ...resilience.retry import RetryPolicy, call_with_retry
 
 _META_NAME = "metadata.json"
+_FORMAT = "paddlepaddle_tpu.dist_ckpt.v3"
 _pending_saves = []
 _path_last_save: Dict[str, threading.Thread] = {}  # write-order chain per path
-_path_last_lock = threading.Lock()
+# RLock, not Lock: a preemption SIGTERM handler may trigger an emergency
+# save while the interrupted main-thread frame is inside one of the (tiny,
+# single-dict-op) critical sections below — a non-reentrant lock would
+# deadlock the handler and forfeit the emergency checkpoint
+_path_last_lock = threading.RLock()
+
+# checkpoint filesystem I/O retry: shared-fs blips (ESTALE, EIO, injected
+# faults) are transient; three quick attempts before surfacing
+_FS_RETRY = RetryPolicy(max_attempts=3, base_delay=0.05, max_delay=0.5)
+
+
+class CheckpointSaveError(RuntimeError):
+    """One or more async writer threads failed; ``errors`` holds all of
+    them (wait_all_saves surfaces every failure, not just the first)."""
+
+    def __init__(self, message: str, errors):
+        super().__init__(message)
+        self.errors = list(errors)
 
 
 @dataclass
@@ -158,8 +195,7 @@ def _merge_rank_metadata(path: str, world: int, timeout: float,
             os.remove(os.path.join(path, _rank_meta_name(r, epoch)))
         except OSError:
             pass
-    meta = {"tensors": {}, "format": "paddlepaddle_tpu.dist_ckpt.v2",
-            "world_size": world}
+    meta = {"tensors": {}, "format": _FORMAT, "world_size": world}
     for r in sorted(ranks):
         for key, rec in ranks[r]["tensors"].items():
             tgt = meta["tensors"].setdefault(key, {
@@ -173,8 +209,29 @@ def _merge_rank_metadata(path: str, world: int, timeout: float,
             for s in rec["shards"]:
                 if tuple(map(tuple, s["box"])) not in have:
                     tgt["shards"].append(s)
-    with open(os.path.join(path, _META_NAME), "w") as f:
+    # the merged metadata IS the multi-host commit point: write it atomically
+    # so a crash mid-merge leaves an (ignorable) uncommitted dir, never a
+    # truncated metadata.json
+    tmp = os.path.join(path, _META_NAME + ".tmp")
+    with open(tmp, "w") as f:
         json.dump(meta, f, indent=1)
+    os.replace(tmp, os.path.join(path, _META_NAME))
+
+
+def _commit_staging(staging: str, path: str) -> None:
+    """Atomic checkpoint commit: the fully-written staging dir takes the
+    final name in one rename. Overwrites swap the old dir aside first — a
+    crash between the renames leaves the old checkpoint recoverable under
+    ``*.__old__*``, but never a torn directory at ``path``."""
+    if os.path.isdir(path):
+        trash = f"{path}.__old__.{os.getpid()}"
+        if os.path.exists(trash):
+            shutil.rmtree(trash)
+        os.rename(path, trash)
+        os.rename(staging, path)
+        shutil.rmtree(trash, ignore_errors=True)
+    else:
+        os.rename(staging, path)
 
 
 def save_state_dict(state_dict: Dict[str, object], path: str,
@@ -200,9 +257,9 @@ def save_state_dict(state_dict: Dict[str, object], path: str,
     world = get_world_size() if process_count is None else process_count
     epoch = _save_epochs.get((path, pid), 0)
     _save_epochs[(path, pid)] = epoch + 1
-    os.makedirs(path, exist_ok=True)
-    meta = {"tensors": {}, "format": "paddlepaddle_tpu.dist_ckpt.v2"}
-    items = []  # (fpath, device_or_host_array)
+    meta = {"tensors": {}, "format": _FORMAT}
+    items = []  # (fname, device_or_host_array) — dir resolved at write time
+    rec_by_file: Dict[str, dict] = {}  # fname -> shard record (gets crc32)
     used_names = set()
     for key, val in state_dict.items():
         arr = val._data if isinstance(val, Tensor) else val
@@ -233,10 +290,12 @@ def save_state_dict(state_dict: Dict[str, object], path: str,
         used_names.update(_files(base))
         shard_recs = []
         for fname, (box, data) in zip(_files(base), shards):
-            shard_recs.append({"file": fname, "box": box})
+            rec = {"file": fname, "box": box}
+            shard_recs.append(rec)
+            rec_by_file[fname] = rec
             if isinstance(data, jax.Array):
                 data.copy_to_host_async()  # enqueue d2h DMA; get later is cheap
-            items.append((os.path.join(path, fname), data))
+            items.append((fname, data))
         meta["tensors"][key] = {
             "shape": list(shape),
             "dtype": str(dtype),
@@ -245,20 +304,53 @@ def save_state_dict(state_dict: Dict[str, object], path: str,
         }
 
     def write():
-        for fpath, data in items:
-            np.save(fpath, np.asarray(jax.device_get(data)))
+        # single-host: stage into a hidden sibling dir and commit by rename,
+        # so a kill mid-save can never leave a torn checkpoint at ``path``.
+        # Multi-host writes in place on the shared path (several hosts own
+        # one directory); there the merged metadata.json is the commit point.
+        staging = None
+        tgt = path
         if world == 1:
-            with open(os.path.join(path, _META_NAME), "w") as f:
+            parent = os.path.dirname(os.path.abspath(path))
+            staging = os.path.join(
+                parent,
+                f".{os.path.basename(path)}.staging.{os.getpid()}.e{epoch}")
+            if os.path.exists(staging):
+                shutil.rmtree(staging)
+            os.makedirs(staging)
+            tgt = staging
+        else:
+            os.makedirs(path, exist_ok=True)
+        try:
+            for fname, data in items:
+                host = np.asarray(jax.device_get(data))
+                fpath = os.path.join(tgt, fname)
+
+                def _write_one(fp=fpath, arr=host):
+                    chaos_point("ckpt.write_shard")
+                    np.save(fp, arr)
+
+                call_with_retry(_write_one, policy=_FS_RETRY,
+                                name="ckpt.write_shard")
+                # integrity record (format v3): CRC of the bytes on disk
+                rec_by_file[fname]["crc32"] = file_crc32(fpath)
+            if world == 1:
+                with open(os.path.join(tgt, _META_NAME), "w") as f:
+                    json.dump(meta, f, indent=1)
+                _commit_staging(staging, path)
+                return
+            # rank record LAST: its existence tells the coordinator this
+            # host's data files are durably on the shared path
+            tmp = os.path.join(path, _rank_meta_name(pid, epoch) + ".tmp")
+            with open(tmp, "w") as f:
                 json.dump(meta, f, indent=1)
-            return
-        # rank record LAST: its existence tells the coordinator this
-        # host's data files are durably on the shared path
-        tmp = os.path.join(path, _rank_meta_name(pid, epoch) + ".tmp")
-        with open(tmp, "w") as f:
-            json.dump(meta, f, indent=1)
-        os.replace(tmp, os.path.join(path, _rank_meta_name(pid, epoch)))
-        if pid == coordinator_rank:
-            _merge_rank_metadata(path, world, merge_timeout, epoch)
+            os.replace(tmp, os.path.join(path, _rank_meta_name(pid, epoch)))
+            if pid == coordinator_rank:
+                _merge_rank_metadata(path, world, merge_timeout, epoch)
+        except BaseException:
+            if staging is not None:
+                shutil.rmtree(staging, ignore_errors=True)
+            raise
 
     if async_save:
         box = {}
@@ -298,17 +390,32 @@ def save_state_dict(state_dict: Dict[str, object], path: str,
 
 
 def wait_all_saves():
-    """Join outstanding async saves; re-raises the first write failure so a
-    torn checkpoint can't silently report success."""
-    first_error = None
+    """Join outstanding async saves; surfaces EVERY writer-thread failure
+    (one failure re-raised as-is, several wrapped in
+    :class:`CheckpointSaveError` with ``.errors``), and always clears the
+    pending list — a failed flush must not poison the next save."""
+    errors = []
+    # pop-then-join: each processed entry leaves the list immediately, so a
+    # failed flush can never poison the next wait. Deliberately NO blanket
+    # clear on interrupt — entries still in the list may be LIVE writer
+    # threads, and dropping them would make the atexit flush skip saves the
+    # train loop believes written.
     while _pending_saves:
         t = _pending_saves.pop()
-        t.join()
+        try:
+            t.join()
+        except BaseException:  # interrupted mid-join: t may still be writing
+            _pending_saves.append(t)
+            raise
         err = getattr(t, "_error_box", {}).get("error")
-        if err is not None and first_error is None:
-            first_error = err
-    if first_error is not None:
-        raise first_error
+        if err is not None:
+            errors.append(err)
+    if len(errors) == 1:
+        raise errors[0]
+    if errors:
+        raise CheckpointSaveError(
+            f"{len(errors)} async checkpoint saves failed: "
+            + "; ".join(f"{type(e).__name__}: {e}" for e in errors), errors)
 
 
 def _wait_all_saves_at_exit():
@@ -338,9 +445,13 @@ class _ShardReader:
 
     def __init__(self, path: str, rec: dict):
         self.shape = tuple(rec["shape"])
-        if "shards" in rec:  # v2
+        self._crcs = {}
+        if "shards" in rec:  # v2/v3
             self.shards = [(tuple(map(tuple, s["box"])),
                             os.path.join(path, s["file"])) for s in rec["shards"]]
+            for s in rec["shards"]:
+                if "crc32" in s:
+                    self._crcs[os.path.join(path, s["file"])] = s["crc32"]
         else:  # v1: one file holding the global value
             self.shards = [(tuple((0, d) for d in self.shape),
                             os.path.join(path, rec["file"]))]
@@ -348,6 +459,19 @@ class _ShardReader:
 
     def _mmap(self, fpath):
         if fpath not in self._maps:
+            # v3 integrity: verify the file's CRC once, before any bytes are
+            # trusted — a bit-flipped shard loads as a clean error, not as
+            # silently-wrong weights (gate: FLAGS_ckpt_verify_crc)
+            crc = self._crcs.get(fpath)
+            if crc is not None and _flags.flag_value("ckpt_verify_crc"):
+                actual = file_crc32(fpath)
+                if actual != crc:
+                    from ...resilience.integrity import _count_corruption
+
+                    _count_corruption(fpath)
+                    raise CheckpointCorruptionError(
+                        f"{fpath}: CRC mismatch (recorded {crc:#010x}, "
+                        f"actual {actual:#010x})")
             try:
                 self._maps[fpath] = np.load(fpath, mmap_mode="r")
             except ValueError:  # dtypes numpy can't mmap (e.g. saved objects)

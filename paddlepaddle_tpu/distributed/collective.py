@@ -21,6 +21,7 @@ import jax.numpy as jnp
 
 from ..core.dispatch import apply_op, unwrap, wrap
 from ..core.tensor import Tensor
+from ..resilience.chaos import chaos_point
 
 
 class ReduceOp:
@@ -146,6 +147,9 @@ def _collective_op(bytes_arg=None):
 
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
+            # chaos seam: every eager collective entry (resilience/chaos.py);
+            # a no-op global check unless PADDLE_CHAOS_POINTS arms it
+            chaos_point("collective.launch")
             obs = _obs_coll
             if obs is None:
                 return fn(*args, **kwargs)

@@ -22,6 +22,9 @@ import subprocess
 import sys
 import time
 
+# a SIGTERMed launcher (preemption) exits 143 itself after draining workers
+_SIGNAL_EXIT = {signal.SIGTERM: 143, signal.SIGINT: 130}
+
 
 def _parse_args(argv=None):
     p = argparse.ArgumentParser(
@@ -76,6 +79,19 @@ def _worker_env(args, local_rank: int, world_size: int, master_addr,
     return env
 
 
+def _count_restart(local_rank: int, rc: int) -> None:
+    """Restart events feed the observability registry, so the launcher's
+    /metrics (or a snapshot dump) shows fault handling happen."""
+    try:
+        from ...observability import safe_inc
+
+        safe_inc("paddle_launch_restarts_total",
+                 "workers respawned by the launch watch loop, by exit code",
+                 exit_code=rc)
+    except Exception:
+        pass
+
+
 def launch(argv=None) -> int:
     args = _parse_args(argv)
     spec = str(args.nnodes)
@@ -108,7 +124,10 @@ def launch(argv=None) -> int:
         from ..fleet.elastic import ElasticManager, ElasticNode
         from ..store import TCPStore
 
-        client = store or TCPStore(master_addr, master_port)
+        # rendezvous: a non-master node routinely dials before the master's
+        # store is up — TCPStore.__init__'s connect retry backs off under
+        # this timeout instead of failing the whole node on the first dial
+        client = store or TCPStore(master_addr, master_port, timeout=60.0)
         enode = ElasticNode(client, node_id=f"node{args.node_rank}")
         enode.register()
         if store is not None:  # master node runs the membership watcher
@@ -142,6 +161,9 @@ def launch(argv=None) -> int:
         env = _worker_env(args, local_rank, world_size, master_addr,
                           master_port, node_index=node_index)
         env["PADDLE_WORLD_VERSION"] = str(world_version)
+        # incarnation counter: training scripts read this to distinguish a
+        # fresh start from a post-failure resume (checkpoint restore path)
+        env["PADDLE_RESTART_NUM"] = str(restarts[local_rank])
         cmd = [sys.executable, args.training_script] + args.training_script_args
         stdout = None
         if args.log_dir:
@@ -153,7 +175,19 @@ def launch(argv=None) -> int:
     for i in range(args.nproc_per_node):
         spawn(i)
 
+    stopping = {"requested": False, "code": 0}
+
     def shutdown(signum=None, frame=None):
+        if signum is not None and not stopping["requested"]:
+            # a signaled launcher is being preempted/cancelled: forward the
+            # TERM to workers (their preemption handlers checkpoint), give
+            # them the grace window, and DO NOT restart them — the old
+            # handler fell back into the watch loop, which respawned the
+            # just-terminated workers
+            stopping["requested"] = True
+            stopping["code"] = _SIGNAL_EXIT.get(signum, 1)
+            print(f"[launch] signal {signum}: draining workers, no restarts",
+                  file=sys.stderr)
         for p in procs.values():
             if p.poll() is None:
                 p.terminate()
@@ -171,7 +205,11 @@ def launch(argv=None) -> int:
     exit_code = 0
     try:
         while procs:
+            if stopping["requested"]:
+                return stopping["code"]
             time.sleep(0.5)
+            if stopping["requested"]:
+                return stopping["code"]
             # elastic scale event: membership changed -> relaunch every local
             # worker against the new world (reference manager.py:237-316)
             if enode is not None and enode.world_changed(world_version):
@@ -196,15 +234,23 @@ def launch(argv=None) -> int:
                     spawn(i)
                 continue
             for lr, p in list(procs.items()):
+                if stopping["requested"]:
+                    # SIGTERM can land mid-reap: the handler already
+                    # terminated everyone — don't respawn workers we just
+                    # told to drain
+                    return stopping["code"]
                 rc = p.poll()
                 if rc is None:
                     continue
                 if rc == 0:
                     procs.pop(lr)
+                elif stopping["requested"]:
+                    procs.pop(lr)  # terminated by the drain; never respawn
                 elif restarts[lr] < args.max_restarts:
                     restarts[lr] += 1
                     print(f"[launch] worker {lr} exited {rc}; restart "
                           f"{restarts[lr]}/{args.max_restarts}", file=sys.stderr)
+                    _count_restart(lr, rc)
                     spawn(lr)
                 else:
                     print(f"[launch] worker {lr} failed with {rc}; aborting job",

@@ -20,6 +20,28 @@ import threading
 import time
 from typing import Optional
 
+from ..resilience.chaos import ChaosError, chaos_point
+from ..resilience.retry import RetryPolicy, call_with_retry
+
+# transient-failure handling at the DCN seams (resilience PR): get/set absorb
+# transport blips and injected faults with quick backoff; connect retries
+# under the caller's rendezvous timeout (workers routinely dial the store
+# before the launcher/master has finished binding it). RuntimeError is
+# included because the native client surfaces ALL transport failures as
+# RuntimeError("TCPStore.xxx failed"). The 10 s deadline is what keeps the
+# policy from multiplying the store's own BLOCKING-GET timeout: a fast
+# transport error retries, but an attempt that already burned the blocking
+# timeout (key never appeared) exceeds the deadline and surfaces at once
+_STORE_RETRY = RetryPolicy(
+    max_attempts=3, base_delay=0.05, max_delay=1.0, deadline=10.0,
+    retry_on=(OSError, TimeoutError, RuntimeError))
+
+
+def _connect_policy(timeout: float) -> RetryPolicy:
+    return RetryPolicy(max_attempts=30, base_delay=0.1, max_delay=2.0,
+                       deadline=timeout)
+
+
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _SRC = os.path.join(_REPO_ROOT, "native", "tcp_store.cpp")
 _LIB_PATH = os.path.join(_REPO_ROOT, "native", "libtcpstore.so")
@@ -90,36 +112,64 @@ class TCPStore:
                 raise RuntimeError(f"TCPStore: cannot bind port {port}")
             port = self._lib.tcpstore_server_port(self._server)
         self.port = port
-        self._client = self._lib.tcpstore_client_create(
-            host.encode(), port, self._timeout_ms)
-        if not self._client:
+
+        def _connect():
+            chaos_point("store.connect")
+            client = self._lib.tcpstore_client_create(
+                host.encode(), port, self._timeout_ms)
+            if not client:
+                raise ConnectionError(
+                    f"TCPStore: cannot connect to {host}:{port}")
+            return client
+
+        try:
+            self._client = call_with_retry(
+                _connect, policy=_connect_policy(timeout),
+                name="store.connect")
+        except BaseException:
             if self._server:
                 self._lib.tcpstore_server_destroy(self._server)
-            raise RuntimeError(f"TCPStore: cannot connect to {host}:{port}")
+                self._server = None
+            raise
 
     # -- reference API -------------------------------------------------------
+    # get/set retry transient failures (injected or transport-level); add is
+    # deliberately NOT retried — a retry after a lost response would double
+    # the increment (rank assignment relies on exactly-once add)
     def set(self, key: str, value) -> None:
-        if self._py:
-            return self._py.set(key, value)
-        data = value if isinstance(value, bytes) else str(value).encode()
-        if self._lib.tcpstore_set(self._client, key.encode(), data, len(data)) != 0:
-            raise RuntimeError("TCPStore.set failed")
+        data = (value if isinstance(value, bytes)
+                else str(value).encode()) if not self._py else value
+
+        def _set():
+            chaos_point("store.set")
+            if self._py:
+                return self._py.set(key, data)
+            if self._lib.tcpstore_set(self._client, key.encode(), data,
+                                      len(data)) != 0:
+                raise RuntimeError("TCPStore.set failed")
+
+        call_with_retry(_set, policy=_STORE_RETRY, name="store.set")
 
     def get(self, key: str) -> bytes:
         from .comm_task import comm_task
 
-        if self._py:
-            with comm_task(f"store.get({key!r})", group="dcn"):
+        def _get():
+            chaos_point("store.get")
+            if self._py:
                 return self._py.get(key)
-        # two-call protocol: fetch stages the value natively and reports its
-        # exact size, copy drains it — values of arbitrary size round-trip
-        with comm_task(f"store.get({key!r})", group="dcn"), self._get_lock:
-            n = self._lib.tcpstore_fetch(self._client, key.encode())
-            if n < 0:
-                raise RuntimeError(f"TCPStore.get({key!r}) failed ({n})")
-            buf = ctypes.create_string_buffer(max(int(n), 1))
-            got = self._lib.tcpstore_copy(self._client, buf, int(n))
-        return buf.raw[:got]
+            # two-call protocol: fetch stages the value natively and reports
+            # its exact size, copy drains it — values of arbitrary size
+            # round-trip
+            with self._get_lock:
+                n = self._lib.tcpstore_fetch(self._client, key.encode())
+                if n < 0:
+                    raise RuntimeError(f"TCPStore.get({key!r}) failed ({n})")
+                buf = ctypes.create_string_buffer(max(int(n), 1))
+                got = self._lib.tcpstore_copy(self._client, buf, int(n))
+            return buf.raw[:got]
+
+        with comm_task(f"store.get({key!r})", group="dcn"):
+            return call_with_retry(_get, policy=_STORE_RETRY, name="store.get")
 
     def add(self, key: str, amount: int = 1) -> int:
         if self._py:
@@ -236,9 +286,12 @@ class _PyStore:
         deadline = time.time() + timeout
         while True:
             try:
+                chaos_point("store.connect")
                 self._sock = socket.create_connection((host, self.port), timeout=timeout)
                 break
-            except OSError:
+            # ChaosError too: injected connect faults must exercise this
+            # retry loop exactly like the native path's connect policy
+            except (OSError, ChaosError):
                 if time.time() > deadline:
                     raise
                 time.sleep(0.1)
@@ -296,7 +349,11 @@ def create_or_get_global_tcp_store() -> TCPStore:
         world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
         # under the launcher the STORE IS HOSTED BY THE LAUNCHER (it must
         # outlive worker restarts for elastic re-admission) — every worker,
-        # rank 0 included, connects as a client
+        # rank 0 included, connects as a client. Rendezvous races (worker up
+        # before the store binds, or a restarted worker re-dialing during a
+        # scale event) are absorbed by the CONNECT retry inside
+        # TCPStore.__init__ (backoff under the store timeout) — no outer
+        # retry here, which would only multiply that budget.
         _global_store = TCPStore(
             host, port,
             is_master=(rank == 0 and not launcher_hosts_store()),

@@ -23,6 +23,8 @@ import time
 import traceback
 from typing import Callable, Optional
 
+from ..resilience.chaos import chaos_point
+
 
 class StepTimeout(RuntimeError):
     pass
@@ -38,7 +40,10 @@ class Watchdog:
         self._lock = threading.Lock()
         self._current = None  # (name, start_time)
         self._stop = threading.Event()
-        self._fired = False
+        # the task (identity) the watchdog last fired for: a new step re-arms
+        # the watchdog (FLAGS_watchdog_rearm), so every hung step is reported
+        # — the old boolean latch went dead after the first timeout ever
+        self._fired_for = None
         self._thread: Optional[threading.Thread] = None
         self.last_in_flight = []  # populated at timeout for on_timeout consumers
 
@@ -68,6 +73,7 @@ class Watchdog:
 
         class _Task:
             def __enter__(self):
+                chaos_point("step")  # injection seam: step execution
                 with wd._lock:
                     wd._current = (name, time.monotonic())
                 return self
@@ -92,8 +98,11 @@ class Watchdog:
                 continue
             name, start = cur
             elapsed = time.monotonic() - start
-            if elapsed > self.timeout and not self._fired:
-                self._fired = True
+            if elapsed > self.timeout and cur is not self._fired_for:
+                if self._fired_for is not None and not self._rearm():
+                    continue  # legacy one-shot latch opted back in
+                self._fired_for = cur  # once per step; a NEW step re-arms
+                self._count_timeout(name)
                 from .comm_task import in_flight
 
                 # snapshot for programmatic consumers (on_timeout handlers)
@@ -107,6 +116,27 @@ class Watchdog:
                 if self.abort:
                     # non-zero exit lets the launcher's watch loop restart us
                     os._exit(114)
+
+    @staticmethod
+    def _rearm() -> bool:
+        try:
+            from ..core import flags as _flags
+
+            return bool(_flags.flag_value("watchdog_rearm"))
+        except Exception:
+            return True
+
+    @staticmethod
+    def _count_timeout(name: str) -> None:
+        # observability: operators see hang handling happen (cold path)
+        try:
+            from ..observability import safe_inc
+
+            safe_inc("paddle_watchdog_step_timeouts_total",
+                     "steps that exceeded the watchdog timeout, by step name",
+                     step=name)
+        except Exception:
+            pass
 
     def _dump(self, name, elapsed):
         from .comm_task import format_in_flight

@@ -5,6 +5,7 @@ of the reference's per-device prefetch queues in data_feed.cc)."""
 
 from .dataloader import (  # noqa: F401
     DataLoader,
+    DataLoaderWorkerError,
     WorkerInfo,
     get_worker_info,
     np_collate_fn,

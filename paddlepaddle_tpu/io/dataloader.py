@@ -28,6 +28,7 @@ import numpy as np
 
 from ..core.dispatch import wrap
 from ..core.tensor import Tensor
+from ..resilience.chaos import chaos_point
 from .dataset import IterableDataset
 from .sampler import BatchSampler
 
@@ -92,8 +93,27 @@ def _wrap_leaves(obj):
     return obj
 
 
-class _RemoteTraceback(RuntimeError):
-    """Worker-side exception re-raised in the parent with the remote trace."""
+class DataLoaderWorkerError(RuntimeError):
+    """A DataLoader worker process failed: it raised (the remote traceback
+    is attached), died without reporting (killed / startup crash), or the
+    parent timed out waiting on it. Raised in the parent instead of blocking
+    forever on the data queue."""
+
+
+# internal alias (historical name; the public exception is the one above)
+_RemoteTraceback = DataLoaderWorkerError
+
+
+def _count_worker_deaths(n: int) -> None:
+    # cold path (a worker just died); keeps observability off the hot loop
+    try:
+        from ..observability import safe_inc
+
+        safe_inc("paddle_dataloader_worker_deaths_total",
+                 "DataLoader worker processes that died without reporting "
+                 "an error", n)
+    except Exception:
+        pass
 
 
 def _is_pickle_error(e):
@@ -208,6 +228,7 @@ def _worker_loop(dataset, index_queue, data_queue, collate_fn, init_fn,
                 for item in iter(dataset):
                     batch.append(item)
                     if len(batch) == batch_size:
+                        chaos_point("dataloader.worker")
                         data_queue.put(("data", epoch, (worker_id, seq),
                                         _to_np_leaves(collate_fn(batch))))
                         batch, seq = [], seq + 1
@@ -220,6 +241,9 @@ def _worker_loop(dataset, index_queue, data_queue, collate_fn, init_fn,
                 job = index_queue.get()
                 if job is None:
                     break
+                # chaos seam: a killed/failing worker here exercises the
+                # parent's dead-worker detection (DataLoaderWorkerError)
+                chaos_point("dataloader.worker")
                 epoch, bidx, indices = job
                 data_queue.put(
                     ("data", epoch, bidx,
@@ -328,6 +352,7 @@ class _WorkerPool:
                     dead = [w for w, p in enumerate(self.procs)
                             if not p.is_alive()]
                     codes = [self.procs[w].exitcode for w in dead]
+                    _count_worker_deaths(len(dead))
                     hint = ""
                     if self.start_method != "fork" and codes and all(
                             c == 1 for c in codes):

@@ -79,6 +79,17 @@ def is_enabled() -> bool:
     return _trace_on or _metrics_on or _watchdog_on
 
 
+def safe_inc(name: str, help_: str = "", n: float = 1, **labels) -> None:
+    """Best-effort counter increment for COLD-path fault events (retries,
+    restarts, corruption, preemption, watchdog timeouts): always records —
+    operators must see fault handling even without ``enable()`` — and never
+    raises, because fault handling must not fail on account of metrics."""
+    try:
+        _registry.counter(name, help_).inc(n, **labels)
+    except Exception:
+        pass
+
+
 class RecordEvent(trace_region):
     """Explicit host annotation: always records (no flags needed) and opens
     a ``jax.profiler.TraceAnnotation``. ``paddle.profiler.RecordEvent`` is a
@@ -431,7 +442,7 @@ if (_flags.flag_value("obs_trace") or _flags.flag_value("obs_metrics")
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "Recorder", "Event",
     "RecordEvent", "trace_region", "exponential_buckets",
-    "enable", "disable", "reset", "is_enabled",
+    "enable", "disable", "reset", "is_enabled", "safe_inc",
     "get_recorder", "get_registry", "snapshot", "to_prometheus_text",
     "export_chrome_trace", "summary", "watchdog",
 ]

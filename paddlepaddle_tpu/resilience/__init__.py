@@ -1,0 +1,53 @@
+"""Fault-tolerant training runtime — failure as a testable, survivable event.
+
+Reference surface: the reference stack's failure handling spans
+CommTaskManager timeout/abort (paddle/phi/core/distributed/
+comm_task_manager.h:37), the elastic launcher's restart/re-admission loop
+(python/paddle/distributed/launch/controllers/), and async checkpointing.
+This package makes that machinery *provable*:
+
+* :mod:`~.chaos` — flag-gated (``PADDLE_CHAOS_*``), seeded, deterministic
+  fault injection at the runtime's hot seams (store ops, collective launch,
+  checkpoint shard writes, DataLoader workers, step execution);
+* :mod:`~.retry` — ``RetryPolicy`` + ``retry``/``call_with_retry`` with
+  exponential backoff, jitter and deadlines, applied at the store,
+  checkpoint-I/O and rendezvous seams;
+* :mod:`~.preemption` — SIGTERM → emergency save → drain async saves →
+  restart-eligible exit, closing the ``launch --max_restarts`` elastic loop;
+* :mod:`~.integrity` — checkpoint CRC validation, newest-valid fallback,
+  and :class:`~.integrity.CheckpointManager` (keep-last-K GC).
+
+All retry/restart/corruption events emit through the observability metrics
+registry (``paddle_retry_*``, ``paddle_chaos_*``, ``paddle_ckpt_*``,
+``paddle_preemptions_total``), so operators can watch fault handling happen.
+"""
+
+from . import chaos, integrity, preemption, retry  # noqa: F401
+from .chaos import ChaosError, chaos_point  # noqa: F401
+from .integrity import (  # noqa: F401
+    CheckpointCorruptionError,
+    CheckpointManager,
+    find_latest_valid_checkpoint,
+    validate_checkpoint,
+)
+from .preemption import (  # noqa: F401
+    RESTART_EXIT_CODE,
+    PreemptionHandler,
+    install_preemption_handler,
+    preemption_requested,
+    uninstall_preemption_handler,
+)
+# NB: the ``retry`` decorator itself stays at ``resilience.retry.retry`` —
+# re-exporting it here would shadow the submodule name
+from .retry import RetryPolicy, call_with_retry  # noqa: F401
+
+__all__ = [
+    "chaos", "retry", "preemption", "integrity",
+    "ChaosError", "chaos_point",
+    "RetryPolicy", "call_with_retry",
+    "PreemptionHandler", "install_preemption_handler",
+    "preemption_requested", "uninstall_preemption_handler",
+    "RESTART_EXIT_CODE",
+    "CheckpointCorruptionError", "CheckpointManager",
+    "find_latest_valid_checkpoint", "validate_checkpoint",
+]

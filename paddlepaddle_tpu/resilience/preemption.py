@@ -1,0 +1,165 @@
+"""Preemption-aware shutdown: SIGTERM → emergency checkpoint → restartable
+exit.
+
+On TPU fleets preemption is routine: the scheduler sends SIGTERM, grants a
+grace window, then SIGKILLs. The handler installed here closes the elastic
+loop end-to-end:
+
+1. runs the registered emergency callbacks (typically a
+   ``save_state_dict``/:class:`~.integrity.CheckpointManager.save` of the
+   live training state);
+2. drains pending async checkpoint writes
+   (:func:`distributed.checkpoint.wait_all_saves`) so nothing the train
+   loop believes saved is lost mid-flight;
+3. exits with a restart-eligible code (default 143 = 128+SIGTERM) so
+   ``distributed.launch --max_restarts`` respawns the worker, which resumes
+   from the checkpoint just written.
+
+Training loops that prefer a clean step boundary over a mid-step save can
+poll :func:`preemption_requested` instead (``install(exit_on_signal=False)``)
+and checkpoint+exit themselves.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+from typing import Callable, List, Optional
+
+__all__ = [
+    "PreemptionHandler", "install_preemption_handler",
+    "preemption_requested", "uninstall_preemption_handler",
+    "RESTART_EXIT_CODE",
+]
+
+# 128 + SIGTERM: the conventional "terminated, eligible for restart" code the
+# launcher's watch loop restarts (any nonzero is restart-eligible there; this
+# one additionally tells a human WHY the worker exited)
+RESTART_EXIT_CODE = 143
+
+
+class PreemptionHandler:
+    def __init__(self, exit_code: int = RESTART_EXIT_CODE,
+                 exit_on_signal: bool = True):
+        self.exit_code = exit_code
+        self.exit_on_signal = exit_on_signal
+        self._callbacks: List[Callable[[], None]] = []
+        self._requested = threading.Event()
+        self._prev_handlers = {}
+        self._installed = False
+        self._lock = threading.Lock()
+
+    def register(self, callback: Callable[[], None]) -> None:
+        """Add an emergency callback (run in registration order on signal)."""
+        self._callbacks.append(callback)
+
+    def requested(self) -> bool:
+        return self._requested.is_set()
+
+    # -- signal plumbing ----------------------------------------------------
+    def install(self, signals=(signal.SIGTERM,)) -> "PreemptionHandler":
+        """Hook every signal in ``signals`` not already hooked — per-signal
+        idempotent, so a later install(signals=(SIGUSR1,)) extends an
+        existing SIGTERM handler instead of being silently ignored."""
+        with self._lock:
+            for sig in signals:
+                if sig not in self._prev_handlers:
+                    self._prev_handlers[sig] = signal.signal(
+                        sig, self._on_signal)
+            self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        with self._lock:
+            for sig, prev in self._prev_handlers.items():
+                try:
+                    signal.signal(sig, prev)
+                except (ValueError, OSError):
+                    pass
+            self._prev_handlers.clear()
+            self._installed = False
+
+    def _on_signal(self, signum, frame):
+        self._requested.set()
+        sys.stderr.write(
+            f"[preemption] signal {signum} received: emergency checkpoint "
+            f"then exit({self.exit_code})\n")
+        sys.stderr.flush()
+        try:
+            from ..observability import safe_inc
+
+            safe_inc("paddle_preemptions_total",
+                     "preemption signals handled (emergency save + "
+                     "restartable exit)",
+                     signal=signal.Signals(signum).name)
+        except Exception:
+            pass
+        self.drain()
+        if self.exit_on_signal:
+            # os._exit, not sys.exit: the signal may interrupt arbitrary
+            # frames (including native code) where SystemExit is swallowed;
+            # state was just flushed, a prompt exit is the safe move
+            os._exit(self.exit_code)
+
+    def drain(self) -> None:
+        """Run emergency callbacks then flush pending async checkpoint
+        writes. Callable directly from cooperative (polling) loops."""
+        for cb in self._callbacks:
+            try:
+                cb()
+            except Exception:
+                import traceback
+
+                sys.stderr.write("[preemption] emergency callback failed:\n"
+                                 + traceback.format_exc())
+        try:
+            from ..distributed import checkpoint as dist_ckpt
+
+            dist_ckpt.wait_all_saves()
+        except Exception as e:
+            sys.stderr.write(
+                f"[preemption] draining async saves failed: {e!r}\n")
+        sys.stderr.flush()
+
+
+_handler: Optional[PreemptionHandler] = None
+
+
+def install_preemption_handler(*callbacks: Callable[[], None],
+                               exit_code: Optional[int] = None,
+                               exit_on_signal: Optional[bool] = None,
+                               signals=(signal.SIGTERM,)) -> PreemptionHandler:
+    """Install (or extend) the process-wide preemption handler. When a
+    handler already exists, ``exit_code``/``exit_on_signal`` only override
+    its configuration if EXPLICITLY passed — a library adding a callback
+    with defaults must not flip a cooperative (polling) handler back into
+    exit-on-signal mode."""
+    global _handler
+    if _handler is None:
+        _handler = PreemptionHandler(
+            exit_code=RESTART_EXIT_CODE if exit_code is None else exit_code,
+            exit_on_signal=True if exit_on_signal is None else exit_on_signal)
+        _handler.install(signals)
+    else:
+        if exit_code is not None:
+            _handler.exit_code = exit_code
+        if exit_on_signal is not None:
+            _handler.exit_on_signal = exit_on_signal
+        _handler.install(signals)  # hooks any not-yet-hooked signals
+    for cb in callbacks:
+        _handler.register(cb)
+    return _handler
+
+
+def uninstall_preemption_handler() -> None:
+    global _handler
+    if _handler is not None:
+        _handler.uninstall()
+        _handler = None
+
+
+def preemption_requested() -> bool:
+    """True once a preemption signal arrived (cooperative-polling mode)."""
+    return _handler is not None and _handler.requested()

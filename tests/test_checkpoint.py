@@ -79,7 +79,7 @@ def test_sharded_save_writes_per_shard_files(tmp_path):
 
     meta = dist_ckpt.get_checkpoint_metadata(str(tmp_path / "ckpt"))
     rec = meta["tensors"]["w"]
-    assert meta["format"].endswith("v2")
+    assert meta["format"].endswith("v3")
     assert len(rec["shards"]) == 2  # deduped: 8 device shards -> 2 unique
     boxes = sorted(tuple(map(tuple, s["box"])) for s in rec["shards"])
     assert boxes == [((0, 8), (0, 8)), ((0, 8), (8, 16))]

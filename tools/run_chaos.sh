@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Chaos suite: run every fault-injection test (pytest -m chaos, including the
+# slow end-to-end elastic drills) under a FIXED chaos seed, so a failure here
+# is replayable bit-for-bit. Tier-1 timing is unaffected: the long chaos
+# tests are also marked `slow` and the fast tier runs with -m "not slow".
+#
+# Usage: tools/run_chaos.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export PADDLE_CHAOS_SEED="${PADDLE_CHAOS_SEED:-1234}"
+
+echo "[run_chaos] seed=${PADDLE_CHAOS_SEED}"
+exec python -m pytest tests/ -q -m chaos \
+    -p no:cacheprovider -p no:xdist -p no:randomly "$@"
