@@ -120,3 +120,34 @@ define_flag("ckpt_verify_crc", True,
 define_flag("watchdog_rearm", True,
             "re-arm the step watchdog after a timed-out step retires, so "
             "every hung step is reported (not only the first)")
+
+# Serving robustness family (inference/serving.py + inference/robustness.py):
+# fleet-wide defaults for the ServingEngine's overload/failure protection.
+# 0 means "off" for the bound-style flags; constructor arguments win.
+define_flag("serving_max_queue", 0,
+            "bound on queued generation requests; submits past it shed with "
+            "ServerOverloadedError (0 = unbounded, the seed behavior)",
+            env="PADDLE_SERVING_MAX_QUEUE")
+define_flag("serving_max_queue_wait_s", 0.0,
+            "shed submits whose estimated queue wait (EWMA of decode-attempt "
+            "time x depth) exceeds this many seconds (0 = off)",
+            env="PADDLE_SERVING_MAX_QUEUE_WAIT_S")
+define_flag("serving_default_deadline_s", 0.0,
+            "default per-request deadline applied when submit() passes none "
+            "(0 = no deadline)", env="PADDLE_SERVING_DEADLINE_S")
+define_flag("serving_breaker_threshold", 5,
+            "consecutive decode failures that open the serving circuit "
+            "breaker (submits then fail fast with CircuitOpenError)",
+            env="PADDLE_SERVING_BREAKER_THRESHOLD")
+define_flag("serving_breaker_reset_s", 30.0,
+            "seconds an open serving breaker waits before letting one "
+            "half-open probe request through",
+            env="PADDLE_SERVING_BREAKER_RESET_S")
+define_flag("serving_decode_timeout_s", 0.0,
+            "engine-thread watchdog: a decode attempt in flight longer than "
+            "this trips the breaker open (0 = watchdog off)",
+            env="PADDLE_SERVING_DECODE_TIMEOUT_S")
+define_flag("serving_drain_timeout_s", 30.0,
+            "default drain(timeout): how long a draining engine lets "
+            "in-flight slots finish before shedding the remainder",
+            env="PADDLE_SERVING_DRAIN_TIMEOUT_S")

@@ -154,4 +154,14 @@ class PredictorPool:
         return len(self._preds)
 
 
+from .robustness import (  # noqa: F401,E402
+    CircuitBreaker,
+    CircuitOpenError,
+    DeadlineExceededError,
+    EngineDrainingError,
+    RequestCancelledError,
+    RequestValidationError,
+    ServerOverloadedError,
+    ServingError,
+)
 from .serving import GenerationResult, ServingEngine  # noqa: F401,E402

@@ -10,6 +10,7 @@ per connection; tensors cross as raw little-endian buffers (f32/i64/i32/u8).
 
 from __future__ import annotations
 
+import json
 import os
 import socket
 import struct
@@ -20,7 +21,11 @@ import numpy as np
 
 _MAGIC = 0x50444331
 _DTYPES = [np.dtype("<f4"), np.dtype("<i8"), np.dtype("<i4"), np.dtype("u1")]
-_OP_RUN, _OP_INFO = 1, 2
+_OP_RUN, _OP_INFO, _OP_HEALTH = 1, 2, 3
+
+# a frame length past this is garbage (or an attack), not a request: reply
+# with an error frame and close instead of trying to buffer it
+_MAX_FRAME = 1 << 28  # 256 MiB
 
 
 def _pack_tensor(name: str, arr: np.ndarray) -> bytes:
@@ -65,13 +70,19 @@ def _unpack_tensor(c: _Cursor) -> Tuple[str, np.ndarray]:
 
 
 class CApiServer:
-    """Serves a Predictor (or any (named inputs) -> [outputs] callable)."""
+    """Serves a Predictor (or any (named inputs) -> [outputs] callable).
+
+    ``health_fn`` (optional) backs the ``_OP_HEALTH`` frame — pass
+    ``ServingEngine.health`` (or any () -> dict) and native clients get the
+    readiness snapshot as JSON without touching Python."""
 
     def __init__(self, predictor, socket_path: str,
                  input_names: Optional[Sequence[str]] = None,
-                 output_names: Optional[Sequence[str]] = None):
+                 output_names: Optional[Sequence[str]] = None,
+                 health_fn: Optional[Callable[[], dict]] = None):
         self.predictor = predictor
         self.path = socket_path
+        self.health_fn = health_fn
         self.input_names = list(input_names if input_names is not None
                                 else predictor.get_input_names())
         self.output_names = list(output_names if output_names is not None
@@ -79,6 +90,7 @@ class CApiServer:
         self._sock: Optional[socket.socket] = None
         self._threads: List[threading.Thread] = []
         self._conns: List[socket.socket] = []
+        self._conns_lock = threading.Lock()
         self._stop = threading.Event()
 
     # -- protocol -----------------------------------------------------------
@@ -89,11 +101,18 @@ class CApiServer:
         m = msg.encode()[:4096]
         return struct.pack("<IB", _MAGIC, 1) + struct.pack("<I", len(m)) + m
 
-    def _handle(self, req: bytes) -> bytes:
+    def _handle(self, req: bytes) -> Tuple[bytes, bool]:
+        """Returns (reply frame, close_connection). A malformed frame (bad
+        magic, truncated payload, garbage tensor header) gets an ERROR
+        frame and a close — never an unhandled struct.error that kills the
+        connection thread with no reply on the wire."""
         c = _Cursor(req)
-        if c.take("I") != _MAGIC:
-            return self._reply_err("bad magic")
-        op = c.take("B")
+        try:
+            if c.take("I") != _MAGIC:
+                return self._reply_err("bad magic"), True
+            op = c.take("B")
+        except struct.error:
+            return self._reply_err("malformed frame: truncated header"), True
         if op == _OP_INFO:
             body = struct.pack("<I", len(self.input_names))
             for n in self.input_names:
@@ -101,12 +120,25 @@ class CApiServer:
             body += struct.pack("<I", len(self.output_names))
             for n in self.output_names:
                 body += struct.pack("<I", len(n)) + n.encode()
-            return self._reply_ok(body)
+            return self._reply_ok(body), False
+        if op == _OP_HEALTH:
+            try:
+                snap = self.health_fn() if self.health_fn is not None \
+                    else {"state": "serving", "ok": True}
+                payload = json.dumps(snap, default=str).encode()
+            except Exception as e:
+                return self._reply_err(f"health probe failed: {e}"), False
+            return (self._reply_ok(struct.pack("<I", len(payload)) + payload),
+                    False)
         if op != _OP_RUN:
-            return self._reply_err(f"unknown op {op}")
+            return self._reply_err(f"unknown op {op}"), False
         try:
             n = c.take("I")
             named = dict(_unpack_tensor(c) for _ in range(n))
+        except Exception:  # struct.error / bad dtype code / absurd dims
+            return (self._reply_err("malformed frame: truncated or invalid "
+                                    "tensor payload"), True)
+        try:
             inputs = [named[k] for k in self.input_names]
             outs = self.predictor.run(inputs)
             # the name snapshot may predate the first run (Predictor only
@@ -118,29 +150,44 @@ class CApiServer:
             body = struct.pack("<I", len(outs))
             for name, o in zip(names, outs):
                 body += _pack_tensor(name, np.asarray(o))
-            return self._reply_ok(body)
+            return self._reply_ok(body), False
         except Exception as e:  # surfaced as PD_PredictorGetLastError
-            return self._reply_err(f"{type(e).__name__}: {e}")
+            return self._reply_err(f"{type(e).__name__}: {e}"), False
 
     # -- transport ----------------------------------------------------------
     def _serve_conn(self, conn: socket.socket):
-        with conn:
-            while not self._stop.is_set():
-                head = b""
-                while len(head) < 8:
-                    chunk = conn.recv(8 - len(head))
-                    if not chunk:
+        try:
+            with conn:
+                while not self._stop.is_set():
+                    head = b""
+                    while len(head) < 8:
+                        chunk = conn.recv(8 - len(head))
+                        if not chunk:
+                            return
+                        head += chunk
+                    (length,) = struct.unpack("<Q", head)
+                    if length > _MAX_FRAME:
+                        reply = self._reply_err(
+                            f"frame length {length} exceeds max "
+                            f"{_MAX_FRAME} bytes")
+                        conn.sendall(struct.pack("<Q", len(reply)) + reply)
                         return
-                    head += chunk
-                (length,) = struct.unpack("<Q", head)
-                buf = b""
-                while len(buf) < length:
-                    chunk = conn.recv(min(1 << 20, length - len(buf)))
-                    if not chunk:
+                    buf = b""
+                    while len(buf) < length:
+                        chunk = conn.recv(min(1 << 20, length - len(buf)))
+                        if not chunk:
+                            return
+                        buf += chunk
+                    reply, close = self._handle(buf)
+                    conn.sendall(struct.pack("<Q", len(reply)) + reply)
+                    if close:
                         return
-                    buf += chunk
-                reply = self._handle(buf)
-                conn.sendall(struct.pack("<Q", len(reply)) + reply)
+        finally:
+            with self._conns_lock:
+                try:
+                    self._conns.remove(conn)
+                except ValueError:
+                    pass   # stop() already cleared the list
 
     def start(self):
         if os.path.exists(self.path):
@@ -158,7 +205,8 @@ class CApiServer:
                 t = threading.Thread(target=self._serve_conn, args=(conn,),
                                      daemon=True)
                 t.start()
-                self._conns.append(conn)
+                with self._conns_lock:
+                    self._conns.append(conn)
                 # prune finished handlers so a long-lived server does not
                 # accumulate dead Thread objects per connection
                 self._threads = [x for x in self._threads if x.is_alive()]
@@ -173,12 +221,13 @@ class CApiServer:
         self._stop.set()
         if self._sock is not None:
             self._sock.close()
-        for conn in self._conns:      # unblock handlers waiting in recv
+        with self._conns_lock:
+            conns, self._conns = self._conns[:], []
+        for conn in conns:            # unblock handlers waiting in recv
             try:
                 conn.shutdown(socket.SHUT_RDWR)
             except OSError:
                 pass
-        self._conns.clear()
         if os.path.exists(self.path):
             os.unlink(self.path)
 
@@ -189,6 +238,8 @@ class CApiServer:
         self.stop()
 
 
-def serve_predictor(predictor, socket_path: str) -> CApiServer:
+def serve_predictor(predictor, socket_path: str,
+                    health_fn: Optional[Callable[[], dict]] = None
+                    ) -> CApiServer:
     """Start serving ``predictor`` for native clients; returns the server."""
-    return CApiServer(predictor, socket_path).start()
+    return CApiServer(predictor, socket_path, health_fn=health_fn).start()

@@ -257,10 +257,25 @@ class BatchDecodeEngine:
         keep consuming compute as phantom active lanes in every chunk."""
         if slots is None:
             self.active = jnp.zeros((self.S,), bool)
+            self._first_pending.clear()
         else:
             for i in slots:
                 self.active = self.active.at[int(i)].set(False)
-        self._first_pending.clear()
+                # only THIS slot's pending first token: other slots' pending
+                # syncs must survive a single-slot reset
+                self._first_pending.pop(int(i), None)
+
+    def release_slot(self, slot: int):
+        """Free one slot without delivering a result — the cancellation /
+        deadline path: the device lane goes inactive (no phantom compute),
+        the host slot is recycled, and the next admission may reuse it. The
+        caller owns failing the request's future."""
+        self.reset_slots([slot])
+        self._host_slots[int(slot)] = _Slot()
+
+    def busy_slots(self) -> int:
+        """Host-visible count of slots holding an in-flight request."""
+        return sum(1 for s in self._host_slots if s.req is not None)
 
     def _decode_chunk(self):
         (self.caches, self.tokens, self.lens, self.active, self.budgets,

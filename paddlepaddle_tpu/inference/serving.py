@@ -1,4 +1,5 @@
-"""Serving engine — request queue + batched KV-cache decode.
+"""Serving engine — request queue + batched KV-cache decode, wrapped in an
+overload-and-failure protection layer.
 
 Reference surface: the Predictor/predictor-pool deployment layer
 (paddle/fluid/inference/api/paddle_inference_api.h:52,229 — config,
@@ -20,22 +21,84 @@ schedulers:
 * ``mode="static"`` — groups compatible requests (same prompt-length
   bucket and sampling params) into one batched ``generate_cached`` call;
   simpler, kept for models without the cache-vector-position path.
+
+Robustness layer (robustness.py), all opt-in except the circuit breaker:
+
+* admission control — ``max_queue`` bounds the queue and sheds with a typed
+  :class:`~.robustness.ServerOverloadedError` (queue depth + retry-after
+  hint); ``max_queue_wait_s`` sheds on estimated wait; prompt/budget are
+  validated against ``max_len`` at submit;
+* deadlines & cancellation — per-request ``deadline_s`` sheds expired
+  requests before they're decoded; ``GenerationResult.cancel()`` frees an
+  in-flight slot so a departed client stops burning chip time;
+* circuit breaker — N consecutive decode failures open it (submits fail
+  fast, slots reset), half-open probe recovery, optional hung-decode
+  watchdog (``decode_timeout_s``) that trips it;
+* graceful drain — ``drain(timeout)`` stops admission, finishes in-flight
+  slots, sheds the rest; ``install_preemption_hook()`` registers the drain
+  with :mod:`~..resilience.preemption` so SIGTERM drains before exit 143;
+* ``health()`` — readiness snapshot (queue depth, busy slots, breaker
+  state, last-decode age), also served as the ``_OP_HEALTH`` frame by
+  :class:`~.c_api_server.CApiServer`.
+
+Chaos seams (resilience.chaos): ``serving.admit`` fires inside submit after
+admission checks pass; ``serving.decode`` fires before each decode attempt,
+so an armed fault storm exercises the breaker exactly like a sick model.
 """
 
 from __future__ import annotations
 
 import queue
+import sys
 import threading
 import time
-from typing import List, Optional
+from collections import deque
+from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..core import flags as _flags
+from ..resilience.chaos import chaos_point
+from .robustness import (
+    CircuitBreaker,
+    CircuitOpenError,
+    DeadlineExceededError,
+    EngineDrainingError,
+    QueueWaitEstimator,
+    RequestCancelledError,
+    RequestValidationError,
+    ServerOverloadedError,
+)
+
 # observability hook: _obs_srv(event, value) with events "latency" (seconds
-# submit-to-result for one completed request), "error" (a request failed),
-# "batch_size" (decode slots / requests active in the current batch).
+# submit-to-result for one completed request), "error"/"cancelled" (a request
+# failed / was cancelled), "batch_size" (decode slots / requests active in
+# the current batch), "queue_depth" (requests waiting, queue + deferred),
+# "batch" (value "ok"|"error": one decode attempt's outcome).
 # None when observability is off.
 _obs_srv = None
+
+_BREAKER_STATE_NUM = {"closed": 0, "half_open": 1, "open": 2}
+
+
+def _safe_inc(name: str, help_: str, n: float = 1, **labels) -> None:
+    """Cold-path fault counter (sheds, breaker flips, drains, hangs):
+    always records, never raises, costs nothing on the serve path."""
+    try:
+        from ..observability import safe_inc
+
+        safe_inc(name, help_, n, **labels)
+    except Exception:
+        pass
+
+
+def _safe_set(name: str, help_: str, value: float, **labels) -> None:
+    try:
+        from ..observability import safe_set
+
+        safe_set(name, help_, value, **labels)
+    except Exception:
+        pass
 
 
 class GenerationResult:
@@ -45,10 +108,26 @@ class GenerationResult:
         self._event = threading.Event()
         self._output = None
         self._error: Optional[BaseException] = None
+        self._cancelled = False
         self._t_submit = time.perf_counter()
 
     def done(self) -> bool:
         return self._event.is_set()
+
+    def cancelled(self) -> bool:
+        return self._cancelled
+
+    def cancel(self) -> bool:
+        """Cancel the request: the future fails with
+        :class:`RequestCancelledError` immediately, a queued request is
+        dropped at pop time, and an in-flight decode slot is released on
+        the next scheduler cycle (the chip stops spending on it). Returns
+        True if the request had not already finished."""
+        self._cancelled = True
+        if self._event.is_set():
+            return False
+        self._set(error=RequestCancelledError("request cancelled by client"))
+        return True
 
     def result(self, timeout: Optional[float] = None) -> np.ndarray:
         if not self._event.wait(timeout):
@@ -67,13 +146,15 @@ class GenerationResult:
         if obs is not None:
             if error is None:
                 obs("latency", time.perf_counter() - self._t_submit)
+            elif isinstance(error, RequestCancelledError):
+                obs("cancelled", 1)
             else:
                 obs("error", 1)
 
 
 class GenerationRequest:
     def __init__(self, prompt_ids, max_new_tokens, temperature, top_k,
-                 eos_token_id):
+                 eos_token_id, deadline: Optional[float] = None):
         arr = np.asarray(prompt_ids, np.int32)
         if arr.ndim == 2 and arr.shape[0] == 1:
             arr = arr[0]
@@ -87,6 +168,7 @@ class GenerationRequest:
         self.temperature = float(temperature)
         self.top_k = int(top_k)
         self.eos_token_id = eos_token_id
+        self.deadline = deadline            # absolute time.monotonic(), or None
         self.result = GenerationResult()
 
     def batch_key(self):
@@ -96,12 +178,30 @@ class GenerationRequest:
                 self.eos_token_id)
 
 
+def _flag_or(value, flag_name, off_value=0):
+    """Constructor default plumbing: explicit argument wins, else the
+    FLAGS_serving_* flag. The "off" sentinel (0 / 0.0) maps to None from
+    BOTH sources — an explicit ``max_queue=0`` means unbounded exactly like
+    the flag's documented default, not a queue that sheds everything."""
+    if value is None:
+        value = _flags.flag_value(flag_name)
+    return None if value == off_value else value
+
+
 class ServingEngine:
     """Batched generation server over a model exposing ``generate_cached``."""
 
     def __init__(self, model, max_batch_size: int = 8,
                  max_wait_ms: float = 5.0, mode: str = "continuous",
-                 max_len: Optional[int] = None, decode_chunk: int = 16):
+                 max_len: Optional[int] = None, decode_chunk: int = 16,
+                 max_queue: Optional[int] = None,
+                 max_queue_wait_s: Optional[float] = None,
+                 default_deadline_s: Optional[float] = None,
+                 breaker_threshold: Optional[int] = None,
+                 breaker_reset_s: Optional[float] = None,
+                 decode_timeout_s: Optional[float] = None,
+                 drain_timeout_s: Optional[float] = None,
+                 drain_on_sigterm: bool = False):
         if mode not in ("continuous", "static"):
             raise ValueError(f"mode must be 'continuous' or 'static', got {mode!r}")
         self.model = model
@@ -109,11 +209,43 @@ class ServingEngine:
         self.max_batch_size = max_batch_size
         self.max_wait = max_wait_ms / 1e3
         self._queue: "queue.Queue[GenerationRequest]" = queue.Queue()
+        self._deferred: "deque[GenerationRequest]" = deque()  # FIFO, drained
+        # ahead of the queue — a batch-incompatible request parks here and
+        # becomes a later leader instead of rotating behind newer arrivals
         self._stop = threading.Event()
+        self._draining = threading.Event()
+        self._drained = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._watchdog_thread: Optional[threading.Thread] = None
         self._stats_lock = threading.Lock()
         self.stats = {"requests": 0, "batches": 0, "batched_requests": 0,
-                      "decode_tokens": 0}
+                      "decode_tokens": 0, "batches_failed": 0, "shed": 0,
+                      "cancelled": 0, "deadline_expired": 0,
+                      "decode_failures": 0}
+        # robustness limits: explicit args win, else FLAGS_serving_* (whose
+        # 0 default means "off"), so a fleet can arm them by env alone
+        self.max_queue = _flag_or(max_queue, "serving_max_queue")
+        self.max_queue_wait_s = _flag_or(max_queue_wait_s,
+                                         "serving_max_queue_wait_s", 0.0)
+        self.default_deadline_s = _flag_or(default_deadline_s,
+                                           "serving_default_deadline_s", 0.0)
+        self.decode_timeout_s = _flag_or(decode_timeout_s,
+                                         "serving_decode_timeout_s", 0.0)
+        self.drain_timeout_s = (drain_timeout_s if drain_timeout_s is not None
+                                else _flags.flag_value("serving_drain_timeout_s"))
+        self._breaker = CircuitBreaker(
+            threshold=(breaker_threshold if breaker_threshold is not None
+                       else _flags.flag_value("serving_breaker_threshold")),
+            reset_s=(breaker_reset_s if breaker_reset_s is not None
+                     else _flags.flag_value("serving_breaker_reset_s")),
+            on_transition=self._on_breaker_transition)
+        self._estimator = QueueWaitEstimator()
+        self._decode_started_at: Optional[float] = None
+        self._hang_tripped = False
+        self._last_decode_ok: Optional[float] = None
+        self._drain_on_sigterm = bool(drain_on_sigterm)
+        self._limits_armed = (self.max_queue is not None
+                              or self.max_queue_wait_s is not None)
         self._engine = None
         if mode == "continuous":
             from .decode_engine import BatchDecodeEngine
@@ -121,34 +253,243 @@ class ServingEngine:
             self._engine = BatchDecodeEngine(
                 model, max_slots=max_batch_size, max_len=max_len,
                 chunk=decode_chunk)
+            self._max_len = self._engine.L
+            self._top_k_cap = self._engine.TOP_K_CAP
+        else:
+            self._max_len = max_len or getattr(
+                getattr(model, "config", None), "max_position_embeddings",
+                None)
+            self._top_k_cap = None
 
     def _bump(self, key, n=1):
         with self._stats_lock:
             self.stats[key] += n
 
+    # -- admission control ---------------------------------------------------
+    def _on_breaker_transition(self, old: str, new: str) -> None:
+        sys.stderr.write(f"[serving] circuit breaker {old} -> {new}\n")
+        _safe_inc("paddle_serving_breaker_transitions_total",
+                  "serving circuit-breaker state transitions", to=new)
+        _safe_set("paddle_serving_breaker_state",
+                  "serving breaker state (0 closed, 1 half-open, 2 open)",
+                  _BREAKER_STATE_NUM[new])
+
+    def _shed(self, reason: str, exc: BaseException) -> None:
+        self._bump("shed")
+        _safe_inc("paddle_serving_shed_total",
+                  "requests shed by serving admission control, by reason",
+                  reason=reason)
+        raise exc
+
+    def _queue_depth(self) -> int:
+        return self._queue.qsize() + len(self._deferred)
+
+    def _check_admission(self, req: GenerationRequest) -> None:
+        """Every reason a request may not enter the queue, cheapest first.
+        With no limits configured this is a handful of attribute reads
+        (breaker state is read lock-free while closed) —
+        tools/check_serving_overhead.py holds that path under 5% vs seed."""
+        if req.max_new_tokens < 1:
+            raise RequestValidationError(
+                f"max_new_tokens must be >= 1, got {req.max_new_tokens}")
+        ml = self._max_len
+        if ml is not None and req.prompt_ids.shape[1] + req.max_new_tokens > ml:
+            raise RequestValidationError(
+                f"prompt {req.prompt_ids.shape[1]} + {req.max_new_tokens} "
+                f"new tokens exceeds engine max_len {ml} (model "
+                f"max_position_embeddings caps the KV cache) — shorten the "
+                "prompt or lower max_new_tokens")
+        if self._top_k_cap is not None and req.top_k > self._top_k_cap:
+            raise RequestValidationError(
+                f"top_k {req.top_k} exceeds the continuous engine's static "
+                f"filter cap {self._top_k_cap} (use the static "
+                "serving mode or lower top_k)")
+        if self._draining.is_set():
+            self._shed("draining", EngineDrainingError(
+                "serving engine is draining; no new requests admitted"))
+        breaker = self._breaker
+        if breaker._state != "closed" and not breaker.allow():
+            self._shed("breaker_open", CircuitOpenError(
+                f"decode circuit breaker is open after "
+                f"{breaker.consecutive_failures} consecutive "
+                "failures; submits fail fast until a half-open probe "
+                "succeeds",
+                retry_after_s=breaker.retry_after_s()))
+        if req.deadline is not None and time.monotonic() >= req.deadline:
+            self._bump("deadline_expired")
+            self._shed("deadline", DeadlineExceededError(
+                "request deadline expired before admission"))
+        if self._limits_armed:
+            depth = self._queue_depth()
+            est = self._estimator.estimate_wait_s(depth, self.max_batch_size)
+            if self.max_queue is not None and depth >= self.max_queue:
+                self._shed("queue_full", ServerOverloadedError(
+                    f"serving queue full ({depth} >= max_queue "
+                    f"{self.max_queue})", queue_depth=depth,
+                    retry_after_s=max(est, self.max_wait)))
+            if (self.max_queue_wait_s is not None
+                    and est > self.max_queue_wait_s):
+                self._shed("queue_wait", ServerOverloadedError(
+                    f"estimated queue wait {est:.2f}s exceeds "
+                    f"max_queue_wait_s {self.max_queue_wait_s:g}",
+                    queue_depth=depth, retry_after_s=est))
+        chaos_point("serving.admit")
+
     # -- client API ----------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens=32, temperature=0.0,
-               top_k=0, eos_token_id=None) -> GenerationResult:
-        req = GenerationRequest(prompt_ids, max_new_tokens, temperature,
-                                top_k, eos_token_id)
+               top_k=0, eos_token_id=None,
+               deadline_s: Optional[float] = None) -> GenerationResult:
+        """Queue one generation request; raises a typed
+        :mod:`~.robustness` error instead of queueing when the request
+        cannot (validation), or should not (overload, open breaker,
+        draining, expired deadline), be served."""
+        dl = deadline_s if deadline_s is not None else self.default_deadline_s
+        req = GenerationRequest(
+            prompt_ids, max_new_tokens, temperature, top_k, eos_token_id,
+            deadline=None if dl is None else time.monotonic() + dl)
+        self._check_admission(req)
         if self._thread is None:
             self.start()  # lazy start: a future must always have a server
         self._bump("requests")
         self._queue.put(req)
+        if self._draining.is_set():
+            # lost the race with a concurrent drain(): its shed sweep may
+            # already have passed this request by, and a loop thread (re)
+            # started above exits immediately while draining — fail the
+            # future here so no caller blocks on a request no server owns
+            t = self._thread
+            if (t is None or not t.is_alive() or self._drained.is_set()) \
+                    and not req.result.done():
+                self._bump("shed")
+                _safe_inc("paddle_serving_shed_total",
+                          "requests shed by serving admission control, "
+                          "by reason", reason="draining")
+                req.result._set(error=EngineDrainingError(
+                    "serving engine drained while the request was being "
+                    "submitted"))
         return req.result
 
     def generate(self, prompt_ids, timeout: float = 300.0, **kw) -> np.ndarray:
         return self.submit(prompt_ids, **kw).result(timeout)
 
+    def health(self) -> Dict[str, object]:
+        """Readiness/liveness snapshot — what a probe endpoint (or the C
+        protocol's ``_OP_HEALTH`` frame) reports."""
+        now = time.monotonic()
+        alive = self._thread is not None and self._thread.is_alive()
+        state = ("draining" if self._draining.is_set() and alive
+                 else "serving" if alive else "stopped")
+        busy = self._engine.busy_slots() if self._engine is not None else 0
+        started = self._decode_started_at
+        breaker = self._breaker.state
+        with self._stats_lock:
+            stats = dict(self.stats)
+        return {
+            "state": state,
+            "mode": self.mode,
+            "ok": alive and not self._draining.is_set()
+                  and breaker != "open",
+            "queue_depth": self._queue_depth(),
+            "busy_slots": busy,
+            "max_slots": self.max_batch_size,
+            "max_queue": self.max_queue,
+            "breaker": breaker,
+            "breaker_consecutive_failures":
+                self._breaker.consecutive_failures,
+            "decode_inflight_s":
+                0.0 if started is None else now - started,
+            "last_decode_ok_age_s":
+                None if self._last_decode_ok is None
+                else now - self._last_decode_ok,
+            "estimated_queue_wait_s": self._estimator.estimate_wait_s(
+                self._queue_depth(), self.max_batch_size),
+            "stats": stats,
+        }
+
     # -- lifecycle -----------------------------------------------------------
     def start(self):
         if self._thread is None:
             self._stop.clear()
+            self._drained.clear()
             self._thread = threading.Thread(target=self._loop, daemon=True)
             self._thread.start()
+            if self.decode_timeout_s is not None \
+                    and (self._watchdog_thread is None
+                         or not self._watchdog_thread.is_alive()):
+                self._watchdog_thread = threading.Thread(
+                    target=self._watchdog_loop, daemon=True)
+                self._watchdog_thread.start()
+            if self._drain_on_sigterm:
+                self.install_preemption_hook()
         return self
 
+    def install_preemption_hook(self, timeout: Optional[float] = None):
+        """Register ``drain(timeout)`` as a preemption emergency callback:
+        a SIGTERM'd serving host finishes in-flight requests (bounded by
+        the drain timeout), sheds the rest with a typed error, and only
+        then exits 143 — instead of futures dying mid-decode."""
+        from ..resilience.preemption import install_preemption_handler
+
+        return install_preemption_handler(lambda: self.drain(timeout))
+
+    def drain(self, timeout: Optional[float] = None) -> Dict[str, object]:
+        """Graceful shutdown: stop admission (submits raise
+        :class:`EngineDrainingError`), let in-flight slots finish up to
+        ``timeout`` seconds, shed everything still waiting with a typed
+        error, then stop the engine thread. Idempotent."""
+        timeout = self.drain_timeout_s if timeout is None else timeout
+        t0 = time.monotonic()
+        self._draining.set()
+        finished = True
+        if self._thread is not None:
+            finished = self._drained.wait(timeout)
+        with self._stats_lock:
+            shed_before = self.stats["shed"]
+        try:
+            self._shutdown(EngineDrainingError(
+                "request shed: serving engine drained before it was served"))
+        except RuntimeError:
+            finished = False       # engine thread overran the stop join
+        with self._stats_lock:
+            shed = self.stats["shed"] - shed_before
+        _safe_inc("paddle_serving_drains_total",
+                  "graceful drains completed",
+                  outcome="clean" if finished else "timeout")
+        obs = _obs_srv
+        if obs is not None:
+            obs("queue_depth", 0)
+        return {"clean": finished, "shed": shed,
+                "wall_s": round(time.monotonic() - t0, 3)}
+
+    def _shed_waiting(self, error: BaseException) -> int:
+        """Fail everything queued or deferred (engine thread must be down
+        or draining-idle; the deque is only touched by a live loop)."""
+        n = 0
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if not req.result.done():
+                req.result._set(error=error)
+                n += 1
+        while self._deferred:
+            req = self._deferred.popleft()
+            if not req.result.done():
+                req.result._set(error=error)
+                n += 1
+        if n:
+            self._bump("shed", n)
+            _safe_inc("paddle_serving_shed_total",
+                      "requests shed by serving admission control, by reason",
+                      n, reason="drain" if isinstance(
+                          error, EngineDrainingError) else "stop")
+        return n
+
     def stop(self):
+        self._shutdown(RuntimeError("serving engine stopped"))
+
+    def _shutdown(self, shed_error: BaseException):
         self._stop.set()
         overran = False
         if self._thread is not None:
@@ -161,19 +502,16 @@ class ServingEngine:
                 overran = True
             else:
                 self._thread = None
+        if self._watchdog_thread is not None \
+                and not self._watchdog_thread.is_alive():
+            self._watchdog_thread = None
         # fail whatever is still queued or mid-decode: a caller must never
         # block on a future no server will serve
-        while True:
-            try:
-                req = self._queue.get_nowait()
-            except queue.Empty:
-                break
-            req.result._set(error=RuntimeError("serving engine stopped"))
+        self._shed_waiting(shed_error)
         if self._engine is not None:
             for i, s in enumerate(self._engine._host_slots):
                 if s.req is not None and not s.req.result.done():
-                    s.req.result._set(
-                        error=RuntimeError("serving engine stopped"))
+                    s.req.result._set(error=shed_error)
                     self._engine._host_slots[i] = type(s)()
             self._engine.reset_slots()  # no phantom active device lanes
         if overran:
@@ -194,16 +532,78 @@ class ServingEngine:
         return False
 
     # -- scheduler -----------------------------------------------------------
+    def _precheck(self, req: GenerationRequest) -> bool:
+        """True when a popped request should be served; cancelled/expired
+        ones are failed (shed) here, BEFORE they cost any decode."""
+        if req.result._event.is_set():  # cancel() already failed the future
+            self._bump("cancelled")
+            _safe_inc("paddle_serving_cancelled_total",
+                      "requests cancelled by clients")
+            return False
+        if req.deadline is not None and time.monotonic() >= req.deadline:
+            self._bump("deadline_expired")
+            _safe_inc("paddle_serving_shed_total",
+                      "requests shed by serving admission control, by reason",
+                      reason="deadline")
+            req.result._set(error=DeadlineExceededError(
+                "request deadline expired while queued"))
+            return False
+        return True
+
+    def _next_request(self, block: bool,
+                      timeout: float = 0.05) -> Optional[GenerationRequest]:
+        """Pop the next serveable request: the deferred FIFO drains ahead
+        of the queue (no reordering behind newer arrivals)."""
+        while self._deferred:
+            req = self._deferred.popleft()
+            if self._precheck(req):
+                return req
+        while True:
+            try:
+                req = (self._queue.get(timeout=timeout) if block
+                       else self._queue.get_nowait())
+            except queue.Empty:
+                return None
+            if self._precheck(req):
+                return req
+
+    def _requeue_expired_sweep(self) -> None:
+        """While the breaker is open nothing is popped for decode — sweep
+        the waiting set so expired/cancelled requests still shed promptly.
+        Queue entries migrate to the deferred FIFO (which drains first), so
+        arrival order is preserved."""
+        while True:
+            try:
+                self._deferred.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        kept = deque(r for r in self._deferred if self._precheck(r))
+        self._deferred = kept
+
     def _collect_batch(self) -> List[GenerationRequest]:
-        """One leader request + everything compatible that arrives within the
-        batching window, up to max_batch_size."""
-        try:
-            leader = self._queue.get(timeout=0.1)
-        except queue.Empty:
+        """One leader request + everything compatible, up to max_batch_size:
+        first from the deferred FIFO, then whatever arrives within the
+        batching window. Incompatible queue arrivals are parked in the
+        deferred FIFO — drained ahead of the queue next cycle, so a
+        mismatched request becomes the next leader instead of starving
+        behind a stream of compatible newer ones."""
+        leader = self._next_request(block=True, timeout=0.1)
+        if leader is None:
             return []
+        if self._breaker.state == "half_open":
+            return [leader]     # one-request probe decides the breaker
         batch = [leader]
+        keep: "deque[GenerationRequest]" = deque()
+        while self._deferred and len(batch) < self.max_batch_size:
+            req = self._deferred.popleft()
+            if not self._precheck(req):
+                continue
+            if req.batch_key() == leader.batch_key():
+                batch.append(req)
+            else:
+                keep.append(req)
+        self._deferred.extendleft(reversed(keep))  # keep FIFO order
         deadline = time.monotonic() + self.max_wait
-        leftovers = []
         while len(batch) < self.max_batch_size:
             rest = deadline - time.monotonic()
             if rest <= 0:
@@ -212,88 +612,194 @@ class ServingEngine:
                 req = self._queue.get(timeout=rest)
             except queue.Empty:
                 break
+            if not self._precheck(req):
+                continue
             if req.batch_key() == leader.batch_key():
                 batch.append(req)
             else:
-                leftovers.append(req)
-        for req in leftovers:  # incompatible: back to the queue, keep order
-            self._queue.put(req)
+                self._deferred.append(req)  # FIFO-parked, next cycle's leader
         return batch
 
+    def _watchdog_loop(self):
+        """Engine-thread watchdog: a decode attempt that exceeds
+        ``decode_timeout_s`` trips the breaker — the hung thread cannot be
+        interrupted (it may be inside XLA), but new submits fail fast and
+        health() goes not-ok instead of the queue silently growing."""
+        interval = max(0.005, min(1.0, self.decode_timeout_s / 4))
+        while not self._stop.wait(interval):
+            started = self._decode_started_at
+            if (started is not None and not self._hang_tripped
+                    and time.monotonic() - started > self.decode_timeout_s):
+                self._hang_tripped = True
+                sys.stderr.write(
+                    f"[serving] decode in flight for more than "
+                    f"{self.decode_timeout_s:g}s — tripping breaker\n")
+                _safe_inc("paddle_serving_decode_hangs_total",
+                          "decode attempts the watchdog declared hung")
+                self._breaker.trip()
+
+    def _decode_attempt(self, fn) -> bool:
+        """Run one decode attempt (a static batch or a continuous chunk)
+        under the chaos seam, the hang watchdog and the breaker. Returns
+        True on success; on failure the caller has already been handed the
+        exception via ``fn``'s own cleanup contract."""
+        self._hang_tripped = False
+        self._decode_started_at = time.monotonic()
+        try:
+            chaos_point("serving.decode")
+            fn()
+        finally:
+            dt = time.monotonic() - self._decode_started_at
+            self._decode_started_at = None
+        self._estimator.observe(dt)
+        return True
+
     def _loop(self):
-        if self.mode == "continuous":
-            return self._loop_continuous()
+        try:
+            if self.mode == "continuous":
+                self._loop_continuous()
+            else:
+                self._loop_static()
+        finally:
+            self._drained.set()
+
+    def _loop_static(self):
+        obs = None
         while not self._stop.is_set():
+            if self._draining.is_set():
+                return   # current batch finished; drain() sheds the rest
+            obs = _obs_srv
+            if obs is not None:
+                obs("queue_depth", self._queue_depth())
+            if not self._breaker.allow():
+                self._requeue_expired_sweep()
+                time.sleep(0.02)
+                continue
             batch = self._collect_batch()
             if not batch:
                 continue
-            self._bump("batches")
-            self._bump("batched_requests", len(batch))
-            if _obs_srv is not None:
-                _obs_srv("batch_size", len(batch))
             try:
-                ids = np.concatenate([r.prompt_ids for r in batch], axis=0)
-                leader = batch[0]
-                out = self.model.generate_cached(
-                    ids,
-                    max_new_tokens=max(r.max_new_tokens for r in batch),
-                    temperature=leader.temperature, top_k=leader.top_k,
-                    eos_token_id=leader.eos_token_id)
-                out = np.asarray(out.numpy())
-                plen = leader.prompt_ids.shape[1]
-                for i, req in enumerate(batch):
-                    row = out[i, : plen + req.max_new_tokens]
-                    req.result._set(output=row)
+                self._decode_attempt(lambda: self._run_static_batch(batch))
             except BaseException as e:  # noqa: BLE001 — deliver to callers
                 for req in batch:
                     req.result._set(error=e)
+                self._bump("batches_failed")
+                self._bump("decode_failures")
+                self._breaker.record_failure()
+                if obs is not None:
+                    obs("batch", "error")
+                continue
+            # outcome-tagged accounting AFTER the attempt: a failed batch
+            # must not count as served
+            self._breaker.record_success()
+            self._last_decode_ok = time.monotonic()
+            self._bump("batches")
+            self._bump("batched_requests", len(batch))
+            if obs is not None:
+                obs("batch_size", len(batch))
+                obs("batch", "ok")
+
+    def _run_static_batch(self, batch: List[GenerationRequest]) -> None:
+        ids = np.concatenate([r.prompt_ids for r in batch], axis=0)
+        leader = batch[0]
+        out = self.model.generate_cached(
+            ids,
+            max_new_tokens=max(r.max_new_tokens for r in batch),
+            temperature=leader.temperature, top_k=leader.top_k,
+            eos_token_id=leader.eos_token_id)
+        out = np.asarray(out.numpy())
+        plen = leader.prompt_ids.shape[1]
+        for i, req in enumerate(batch):
+            row = out[i, : plen + req.max_new_tokens]
+            req.result._set(output=row)
+
+    def _sweep_slots(self) -> None:
+        """Release in-flight slots whose client departed (cancel) or whose
+        deadline passed — the chip stops spending on them mid-decode."""
+        eng = self._engine
+        now = time.monotonic()
+        for i, s in enumerate(eng._host_slots):
+            req = s.req
+            if req is None:
+                continue
+            if req.result.done():       # cancelled (first outcome won)
+                eng.release_slot(i)
+                self._bump("cancelled")
+                _safe_inc("paddle_serving_cancelled_total",
+                          "requests cancelled by clients")
+            elif req.deadline is not None and now >= req.deadline:
+                req.result._set(error=DeadlineExceededError(
+                    "request deadline expired mid-decode"))
+                eng.release_slot(i)
+                self._bump("deadline_expired")
+                _safe_inc("paddle_serving_shed_total",
+                          "requests shed by serving admission control, "
+                          "by reason", reason="deadline")
 
     def _loop_continuous(self):
         """Continuous batching: admit queued requests into free decode slots,
         run multi-step decode chunks, retire finished slots mid-flight. The
         BatchDecodeEngine delivers each request's future on retirement."""
         eng = self._engine
-        waiting = None  # FIFO head that found no free slot — NOT re-queued
-        # behind newer arrivals (that would rotate the queue every chunk and
-        # starve early requests under sustained load)
         while not self._stop.is_set():
-            admitted = False
+            self._sweep_slots()
             busy = any(s.req is not None for s in eng._host_slots)
-            while True:
-                if waiting is not None:
-                    req, waiting = waiting, None
-                else:
-                    try:
-                        req = self._queue.get(timeout=0.05 if not busy else 0)
-                    except queue.Empty:
-                        break
-                try:
-                    if eng._admit(req):
-                        admitted = True
-                        busy = True
-                        self._bump("batched_requests")
-                    else:
-                        waiting = req   # hold the head; decode to free a slot
-                        break
-                except BaseException as e:  # noqa: BLE001
-                    req.result._set(error=e)
-            if busy:
-                if _obs_srv is not None:
-                    _obs_srv("batch_size",
-                             sum(1 for s in eng._host_slots
-                                 if s.req is not None))
-                before = eng.stats["tokens_out"]
-                try:
-                    eng._decode_chunk()
-                except BaseException as e:  # noqa: BLE001 — fail the slots
-                    for i, s in enumerate(eng._host_slots):
-                        if s.req is not None:
-                            s.req.result._set(error=e)
-                            eng._host_slots[i] = type(s)()
-                    eng.reset_slots()  # clear phantom device lanes too
+            draining = self._draining.is_set()
+            if draining and not busy:
+                return               # in-flight finished; drain() sheds rest
+            admitted = False
+            if not draining:
+                if self._breaker.allow():
+                    probe = self._breaker.state == "half_open"
+                    while True:
+                        req = self._next_request(block=not busy)
+                        if req is None:
+                            break
+                        try:
+                            if eng._admit(req):
+                                admitted = True
+                                busy = True
+                                self._bump("batched_requests")
+                                if probe:
+                                    break   # one-request half-open probe
+                            else:
+                                # no free slot: hold at the FIFO head, decode
+                                # to free one — never rotated behind arrivals
+                                self._deferred.appendleft(req)
+                                break
+                        except BaseException as e:  # noqa: BLE001
+                            req.result._set(error=e)
+                elif not busy:
+                    self._requeue_expired_sweep()
+                    time.sleep(0.02)
                     continue
-                self._bump("decode_tokens", eng.stats["tokens_out"] - before)
-                if admitted:
-                    self._bump("batches")
-        if waiting is not None:
-            waiting.result._set(error=RuntimeError("serving engine stopped"))
+            obs = _obs_srv
+            if obs is not None:
+                obs("queue_depth", self._queue_depth())
+            if not busy:
+                continue
+            if obs is not None:
+                obs("batch_size",
+                    sum(1 for s in eng._host_slots if s.req is not None))
+            before = eng.stats["tokens_out"]
+            try:
+                self._decode_attempt(eng._decode_chunk)
+            except BaseException as e:  # noqa: BLE001 — fail the slots
+                for i, s in enumerate(eng._host_slots):
+                    if s.req is not None:
+                        s.req.result._set(error=e)
+                        eng._host_slots[i] = type(s)()
+                eng.reset_slots()  # clear phantom device lanes too
+                self._bump("batches_failed")
+                self._bump("decode_failures")
+                self._breaker.record_failure()
+                if obs is not None:
+                    obs("batch", "error")
+                continue
+            self._breaker.record_success()
+            self._last_decode_ok = time.monotonic()
+            self._bump("decode_tokens", eng.stats["tokens_out"] - before)
+            if obs is not None:
+                obs("batch", "ok")
+            if admitted:
+                self._bump("batches")

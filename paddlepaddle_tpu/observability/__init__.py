@@ -81,11 +81,23 @@ def is_enabled() -> bool:
 
 def safe_inc(name: str, help_: str = "", n: float = 1, **labels) -> None:
     """Best-effort counter increment for COLD-path fault events (retries,
-    restarts, corruption, preemption, watchdog timeouts): always records —
-    operators must see fault handling even without ``enable()`` — and never
-    raises, because fault handling must not fail on account of metrics."""
+    restarts, corruption, preemption, watchdog timeouts, load sheds):
+    always records — operators must see fault handling even without
+    ``enable()`` — and never raises, because fault handling must not fail
+    on account of metrics."""
     try:
         _registry.counter(name, help_).inc(n, **labels)
+    except Exception:
+        pass
+
+
+def safe_set(name: str, help_: str = "", value: float = 0.0,
+             **labels) -> None:
+    """Best-effort gauge write, same contract as :func:`safe_inc` — used
+    for cold-path state gauges (serving breaker state) that must be
+    visible even with metrics off."""
+    try:
+        _registry.gauge(name, help_).set(value, **labels)
     except Exception:
         pass
 
@@ -141,6 +153,10 @@ def _make_hooks():
                             "submit-to-result generation latency")
     srv_batch = reg.gauge("paddle_serving_batch_size",
                           "active decode slots / batched requests")
+    srv_qdepth = reg.gauge("paddle_serving_queue_depth",
+                           "generation requests waiting for a decode slot")
+    srv_batches = reg.counter("paddle_serving_batches_total",
+                              "decode attempts, by outcome (ok/error)")
 
     def obs_op(name, dur):
         if _metrics_on:
@@ -203,8 +219,14 @@ def _make_hooks():
             srv_requests.inc(outcome="ok")
         elif event == "error":
             srv_requests.inc(outcome="error")
+        elif event == "cancelled":
+            srv_requests.inc(outcome="cancelled")
         elif event == "batch_size":
             srv_batch.set(value)
+        elif event == "queue_depth":
+            srv_qdepth.set(value)
+        elif event == "batch":
+            srv_batches.inc(outcome=value)
 
     return {
         "op": obs_op, "amp": obs_amp, "node": obs_node, "task": obs_task,
@@ -399,16 +421,31 @@ def summary(top: int = 30) -> str:
                      f"waits={h.get('count', 0)}")
 
     srv = snap.get("paddle_serving_request_seconds", {})
-    if srv or snap.get("paddle_serving_requests_total"):
+    if srv or snap.get("paddle_serving_requests_total") \
+            or snap.get("paddle_serving_shed_total"):
         _section(lines, "Serving")
         h = srv.get((), {})
         reqs = snap.get("paddle_serving_requests_total", {})
         ok = reqs.get((("outcome", "ok"),), 0)
         err = reqs.get((("outcome", "error"),), 0)
+        cancelled = reqs.get((("outcome", "cancelled"),), 0)
         bs = snap.get("paddle_serving_batch_size", {}).get((), 0)
+        qd = snap.get("paddle_serving_queue_depth", {}).get((), 0)
         avg = h.get("sum", 0.0) / max(h.get("count", 1), 1)
-        lines.append(f"requests ok={int(ok)} err={int(err)}  "
-                     f"avg_latency={avg * 1e3:.2f}ms  batch_size={bs:g}")
+        lines.append(f"requests ok={int(ok)} err={int(err)} "
+                     f"cancelled={int(cancelled)}  "
+                     f"avg_latency={avg * 1e3:.2f}ms  batch_size={bs:g}  "
+                     f"queue_depth={qd:g}")
+        sheds = snap.get("paddle_serving_shed_total", {})
+        if sheds:
+            parts = " ".join(f"{dict(k).get('reason', '?')}={int(v)}"
+                             for k, v in sorted(sheds.items()))
+            lines.append(f"sheds: {parts}")
+        breaker = snap.get("paddle_serving_breaker_state", {}).get((), None)
+        if breaker is not None:
+            name = {0: "closed", 1: "half_open", 2: "open"}.get(
+                int(breaker), "?")
+            lines.append(f"breaker: {name}")
 
     region_stats = _recorder.stats()
     if region_stats and _trace_on:
@@ -442,7 +479,7 @@ if (_flags.flag_value("obs_trace") or _flags.flag_value("obs_metrics")
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "Recorder", "Event",
     "RecordEvent", "trace_region", "exponential_buckets",
-    "enable", "disable", "reset", "is_enabled", "safe_inc",
+    "enable", "disable", "reset", "is_enabled", "safe_inc", "safe_set",
     "get_recorder", "get_registry", "snapshot", "to_prometheus_text",
     "export_chrome_trace", "summary", "watchdog",
 ]

@@ -8,7 +8,8 @@ This package makes that machinery *provable*:
 
 * :mod:`~.chaos` — flag-gated (``PADDLE_CHAOS_*``), seeded, deterministic
   fault injection at the runtime's hot seams (store ops, collective launch,
-  checkpoint shard writes, DataLoader workers, step execution);
+  checkpoint shard writes, DataLoader workers, step execution, serving
+  admission/decode);
 * :mod:`~.retry` — ``RetryPolicy`` + ``retry``/``call_with_retry`` with
   exponential backoff, jitter and deadlines, applied at the store,
   checkpoint-I/O and rendezvous seams;

@@ -14,6 +14,10 @@ Injection points are named seams the runtime already calls through::
     ckpt.write_shard                        checkpoint shard file write
     dataloader.worker                       per-batch inside a worker process
     step                                    watchdog-bracketed train step
+    serving.admit                           ServingEngine submit admission
+    serving.decode                          serving decode attempt (a chaos
+                                            storm here exercises the
+                                            serving circuit breaker)
 
 Each ``chaos_point(name)`` call is a no-op (one module-global ``is None``
 check) until chaos is armed, either programmatically via :func:`configure`

@@ -18,6 +18,12 @@ loop end-to-end:
 Training loops that prefer a clean step boundary over a mid-step save can
 poll :func:`preemption_requested` instead (``install(exit_on_signal=False)``)
 and checkpoint+exit themselves.
+
+Serving hosts register here too:
+``ServingEngine.install_preemption_hook()`` adds a graceful ``drain()`` as
+an emergency callback, so a SIGTERM'd serving process finishes in-flight
+generations (bounded by the drain timeout) and sheds the rest with a typed
+error before the exit(143).
 """
 
 from __future__ import annotations

@@ -69,9 +69,10 @@ def test_serving_mixed_shapes_and_threads():
 def test_serving_error_propagates():
     m = _model()
     with ServingEngine(m) as eng:
-        fut = eng.submit(np.zeros((200,), np.int32), max_new_tokens=4)
+        # admission control rejects an unservable request AT SUBMIT (typed
+        # RequestValidationError, still a ValueError) instead of queueing it
         with pytest.raises(ValueError, match="max_position_embeddings"):
-            fut.result(60)
+            eng.submit(np.zeros((200,), np.int32), max_new_tokens=4)
 
 
 def test_onnx_export_requires_input_spec():

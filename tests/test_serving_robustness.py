@@ -1,0 +1,683 @@
+"""Serving robustness: admission control, deadlines & cancellation, circuit
+breaker, graceful drain, health probes (inference/robustness.py + serving.py
++ c_api_server.py).
+
+Reference surface: the bounded predictor-pool deployment layer
+(paddle/fluid/inference/api/paddle_inference_api.h:229) — callers never see
+an unbounded queue and a sick predictor is contained; the load-shedding /
+deadline-propagation playbook is "The Tail at Scale" (Dean & Barroso).
+
+Most tests drive the STATIC scheduler with an instant fake model so the
+protection layer is exercised without JAX compiles; the continuous-engine
+tests (cancel-frees-slot, chaos breaker drill) use the real tiny Llama.
+"""
+
+import json
+import os
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddlepaddle_tpu.inference import (
+    CircuitOpenError,
+    DeadlineExceededError,
+    EngineDrainingError,
+    RequestCancelledError,
+    RequestValidationError,
+    ServerOverloadedError,
+    ServingEngine,
+)
+from paddlepaddle_tpu.inference.robustness import (
+    CircuitBreaker,
+    QueueWaitEstimator,
+)
+from paddlepaddle_tpu.inference.serving import GenerationRequest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class _Out:
+    def __init__(self, a):
+        self._a = a
+
+    def numpy(self):
+        return self._a
+
+
+class FakeModel:
+    """generate_cached lookalike: echoes the prompt + zeros, with injectable
+    latency and failures — the serving layer can't tell it from a model."""
+
+    def __init__(self, delay_s=0.0, fail_next=0):
+        self.delay_s = delay_s
+        self.fail_next = fail_next
+        self.calls = 0
+        self.batch_sizes = []
+
+    def generate_cached(self, ids, max_new_tokens, temperature=0.0, top_k=0,
+                        eos_token_id=None):
+        self.calls += 1
+        self.batch_sizes.append(ids.shape[0])
+        if self.fail_next > 0:
+            self.fail_next -= 1
+            raise RuntimeError("synthetic decode failure")
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return _Out(np.concatenate(
+            [ids, np.zeros((ids.shape[0], max_new_tokens), np.int32)],
+            axis=1))
+
+
+def _prompt(n=4, v=0):
+    return np.full((n,), v, np.int32)
+
+
+def _static_engine(model=None, **kw):
+    kw.setdefault("mode", "static")
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("max_wait_ms", 5.0)
+    kw.setdefault("max_len", 64)
+    return ServingEngine(model or FakeModel(), **kw)
+
+
+# -- admission control -------------------------------------------------------
+
+def test_overload_sheds_typed_and_accepted_complete():
+    eng = _static_engine(FakeModel(delay_s=0.05), max_batch_size=1,
+                         max_queue=2)
+    futs, sheds = [], []
+    try:
+        for _ in range(12):
+            try:
+                futs.append(eng.submit(_prompt(), max_new_tokens=2))
+            except ServerOverloadedError as e:
+                sheds.append(e)
+        assert sheds, "burst past max_queue must shed"
+        for e in sheds:
+            assert e.queue_depth >= 2
+            assert e.retry_after_s >= 0.0
+        for f in futs:        # every accepted request completes
+            assert f.result(30).shape == (6,)
+        assert eng.stats["shed"] == len(sheds)
+        assert eng.health()["queue_depth"] == 0
+    finally:
+        eng.stop()
+
+
+def test_off_sentinels_disable_limits():
+    """0 / 0.0 mean OFF from the constructor exactly like from the flags:
+    max_queue=0 is unbounded (the seed behavior), not shed-everything."""
+    eng = _static_engine(max_queue=0, max_queue_wait_s=0.0,
+                         default_deadline_s=0.0, decode_timeout_s=0.0)
+    try:
+        assert eng.max_queue is None
+        assert eng.max_queue_wait_s is None
+        assert eng.default_deadline_s is None
+        assert eng.decode_timeout_s is None
+        futs = [eng.submit(_prompt(), max_new_tokens=2) for _ in range(16)]
+        for f in futs:
+            f.result(30)       # nothing shed, no deadline, no watchdog
+        assert eng.stats["shed"] == 0
+        assert eng._watchdog_thread is None
+    finally:
+        eng.stop()
+
+
+def test_queue_wait_estimate_sheds():
+    eng = _static_engine(FakeModel(delay_s=0.1), max_batch_size=1,
+                         max_queue_wait_s=0.15)
+    try:
+        first = eng.submit(_prompt(), max_new_tokens=2)
+        first.result(10)      # seeds the EWMA with ~0.1s per attempt
+        futs = [eng.submit(_prompt(), max_new_tokens=2)]  # depth 0: admitted
+        with pytest.raises(ServerOverloadedError, match="estimated"):
+            for _ in range(20):   # estimated wait grows with depth
+                futs.append(eng.submit(_prompt(), max_new_tokens=2))
+        for f in futs:
+            f.result(30)
+    finally:
+        eng.stop()
+
+
+def test_validation_rejects_at_submit():
+    eng = _static_engine(max_len=16)
+    try:
+        with pytest.raises(RequestValidationError, match="max_len"):
+            eng.submit(_prompt(14), max_new_tokens=8)
+        with pytest.raises(ValueError):   # subclass contract for old callers
+            eng.submit(_prompt(14), max_new_tokens=8)
+        with pytest.raises(RequestValidationError, match="max_new_tokens"):
+            eng.submit(_prompt(), max_new_tokens=0)
+        assert eng.stats["requests"] == 0   # nothing was queued
+    finally:
+        eng.stop()
+
+
+# -- deadlines & cancellation ------------------------------------------------
+
+def test_deadline_expired_sheds_before_admission():
+    eng = _static_engine()
+    try:
+        with pytest.raises(DeadlineExceededError):
+            eng.submit(_prompt(), max_new_tokens=2, deadline_s=0.0)
+        assert eng.stats["deadline_expired"] == 1
+    finally:
+        eng.stop()
+
+
+def test_deadline_expired_in_queue_is_shed_not_decoded():
+    model = FakeModel(delay_s=0.15)
+    eng = _static_engine(model, max_batch_size=1)
+    try:
+        head = eng.submit(_prompt(), max_new_tokens=2)        # occupies engine
+        doomed = eng.submit(_prompt(6), max_new_tokens=2, deadline_s=0.01)
+        with pytest.raises(DeadlineExceededError):
+            doomed.result(10)
+        head.result(10)
+        # the expired request never reached the model (prompt length 6
+        # would have been its own batch)
+        assert all(b == 1 for b in model.batch_sizes)
+        assert model.calls == 1
+    finally:
+        eng.stop()
+
+
+def test_cancel_queued_request():
+    eng = _static_engine(FakeModel(delay_s=0.1), max_batch_size=1)
+    try:
+        head = eng.submit(_prompt(), max_new_tokens=2)
+        queued = eng.submit(_prompt(6), max_new_tokens=2)
+        assert queued.cancel() is True
+        assert queued.cancel() is False       # already finished
+        with pytest.raises(RequestCancelledError):
+            queued.result(5)
+        head.result(10)
+    finally:
+        eng.stop()
+
+
+# -- circuit breaker ---------------------------------------------------------
+
+def test_breaker_unit_cycle():
+    transitions = []
+    b = CircuitBreaker(threshold=2, reset_s=0.1,
+                       on_transition=lambda o, n: transitions.append((o, n)))
+    assert b.state == "closed" and b.allow()
+    b.record_failure()
+    assert b.state == "closed"
+    b.record_failure()
+    assert b.state == "open" and not b.allow()
+    assert b.retry_after_s() > 0
+    time.sleep(0.12)
+    assert b.allow() and b.state == "half_open"   # probe window
+    b.record_failure()                            # probe failed
+    assert b.state == "open"
+    time.sleep(0.12)
+    assert b.allow()
+    b.record_success()                            # probe succeeded
+    assert b.state == "closed" and b.consecutive_failures == 0
+    assert ("closed", "open") in transitions
+    assert ("half_open", "closed") in transitions
+
+
+def test_breaker_opens_then_recovers_static():
+    model = FakeModel(fail_next=3)
+    eng = _static_engine(model, max_batch_size=1, breaker_threshold=3,
+                         breaker_reset_s=0.2)
+    try:
+        for _ in range(3):
+            f = eng.submit(_prompt(), max_new_tokens=2)
+            with pytest.raises(RuntimeError, match="synthetic"):
+                f.result(10)
+        # breaker is open: fail-fast submits with a retry hint
+        deadline = time.time() + 2
+        saw_open = False
+        while time.time() < deadline:
+            try:
+                f = eng.submit(_prompt(), max_new_tokens=2)
+                break
+            except CircuitOpenError as e:
+                saw_open = True
+                assert e.retry_after_s <= 0.2 + 0.05
+                time.sleep(0.02)
+        else:
+            pytest.fail("breaker never let the probe through")
+        assert saw_open
+        f.result(10)       # half-open probe succeeded (failures exhausted)
+        assert eng._breaker.state == "closed"
+        assert eng.health()["ok"]
+        assert eng.stats["decode_failures"] == 3
+        assert eng.stats["batches_failed"] == 3
+    finally:
+        eng.stop()
+
+
+def test_hung_decode_watchdog_trips_breaker():
+    model = FakeModel(delay_s=0.5)
+    eng = _static_engine(model, max_batch_size=1, breaker_threshold=100,
+                         breaker_reset_s=10.0, decode_timeout_s=0.05)
+    try:
+        slow = eng.submit(_prompt(), max_new_tokens=2)
+        time.sleep(0.2)     # watchdog interval + timeout elapse mid-decode
+        assert eng._breaker.state == "open"     # tripped while hung
+        assert not eng.health()["ok"]
+        with pytest.raises(CircuitOpenError):
+            eng.submit(_prompt(), max_new_tokens=2)
+        slow.result(10)     # the hung decode eventually returned fine...
+        time.sleep(0.05)
+        assert eng._breaker.state == "closed"   # ...which closes the breaker
+    finally:
+        eng.stop()
+
+
+# -- graceful drain ----------------------------------------------------------
+
+def test_drain_finishes_inflight_and_sheds_rest():
+    eng = _static_engine(FakeModel(delay_s=0.1), max_batch_size=1)
+    try:
+        futs = [eng.submit(_prompt(), max_new_tokens=2) for _ in range(5)]
+        res = eng.drain(timeout=0.25)
+        assert all(f.done() for f in futs)
+        served = shed = 0
+        for f in futs:
+            try:
+                f.result(0)
+                served += 1
+            except EngineDrainingError:
+                shed += 1
+        assert served >= 1           # in-flight work finished
+        assert shed == res["shed"] and shed >= 1
+        with pytest.raises(EngineDrainingError):
+            eng.submit(_prompt(), max_new_tokens=2)
+        assert eng.health()["state"] == "stopped"
+    finally:
+        eng.stop()
+
+
+def test_drain_idempotent_and_clean_when_idle():
+    eng = _static_engine()
+    eng.submit(_prompt(), max_new_tokens=2).result(10)
+    res = eng.drain(timeout=5)
+    assert res["clean"] and res["shed"] == 0
+    assert eng.drain(timeout=1)["shed"] == 0      # second drain is a no-op
+
+
+def test_sigterm_drains_before_exit_143(tmp_path):
+    """Acceptance: a SIGTERM'd serving host drains in-flight requests via
+    resilience.preemption and exits with the restart-eligible 143."""
+    sentinel = tmp_path / "drained.json"
+    script = tmp_path / "serve_and_term.py"
+    script.write_text(f"""
+import json, os, signal, sys, time
+import numpy as np
+sys.path.insert(0, {_REPO!r})
+sys.path.insert(0, {os.path.join(_REPO, 'tests')!r})
+from test_serving_robustness import FakeModel, _static_engine
+from paddlepaddle_tpu.resilience.preemption import install_preemption_handler
+
+eng = _static_engine(FakeModel(delay_s=0.05), max_batch_size=1)
+eng.install_preemption_hook(timeout=5.0)
+# second callback runs AFTER the drain: snapshot what the drain left behind
+results = {{}}
+futs = [eng.submit(np.full((4,), 0, np.int32), max_new_tokens=2)
+        for _ in range(3)]
+def snapshot():
+    h = eng.health()
+    results["state"] = h["state"]
+    results["done"] = all(f.done() for f in futs)
+    open({str(sentinel)!r}, "w").write(json.dumps(results))
+install_preemption_handler(snapshot)
+os.kill(os.getpid(), signal.SIGTERM)
+time.sleep(30)   # never reached: the handler exits 143
+""")
+    proc = subprocess.run([sys.executable, str(script)], timeout=60,
+                          capture_output=True, text=True,
+                          env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 143, (proc.returncode, proc.stderr[-2000:])
+    data = json.loads(sentinel.read_text())
+    assert data["done"] is True          # nothing left hanging
+    assert data["state"] == "stopped"
+
+
+# -- scheduler fairness (deferred FIFO) --------------------------------------
+
+def test_incompatible_request_not_starved():
+    """Seed bug: an incompatible leftover was re-queued behind newer
+    arrivals every cycle. Now it parks in a FIFO deferred list drained
+    ahead of the queue — it becomes the NEXT batch's leader."""
+    eng = _static_engine(max_wait_ms=20.0)
+    # fill the queue before the loop starts: no thread, direct puts
+    reqs = [GenerationRequest(_prompt(4), 2, 0.0, 0, None),
+            GenerationRequest(_prompt(8), 2, 0.0, 0, None),   # incompatible
+            GenerationRequest(_prompt(4), 2, 0.0, 0, None),
+            GenerationRequest(_prompt(4), 2, 0.0, 0, None)]
+    for r in reqs:
+        eng._queue.put(r)
+    b1 = eng._collect_batch()
+    assert [r.prompt_ids.shape[1] for r in b1] == [4, 4, 4]
+    b2 = eng._collect_batch()          # the deferred 8-prompt leads NOW,
+    assert [r.prompt_ids.shape[1] for r in b2] == [8]   # not behind arrivals
+    # sustained compatible load cannot push a deferred request back
+    eng._queue.put(GenerationRequest(_prompt(8), 2, 0.0, 0, None))
+    eng._queue.put(GenerationRequest(_prompt(4), 2, 0.0, 0, None))
+    b3 = eng._collect_batch()
+    lead = b3[0].prompt_ids.shape[1]
+    b4 = eng._collect_batch()
+    assert {lead, b4[0].prompt_ids.shape[1]} == {4, 8}
+
+
+# -- static-mode outcome accounting ------------------------------------------
+
+def test_static_batch_outcome_accounting():
+    import paddlepaddle_tpu.observability as obs
+
+    model = FakeModel(fail_next=1)
+    eng = _static_engine(model, max_batch_size=1, breaker_threshold=10)
+    obs.enable(trace=False, metrics=True, watchdog_=False)
+    try:
+        bad = eng.submit(_prompt(), max_new_tokens=2)
+        with pytest.raises(RuntimeError):
+            bad.result(10)
+        good = eng.submit(_prompt(), max_new_tokens=2)
+        good.result(10)
+        # a failed batch is NOT counted as served
+        assert eng.stats["batches"] == 1
+        assert eng.stats["batches_failed"] == 1
+        snap = obs.snapshot()
+        batches = snap.get("paddle_serving_batches_total", {})
+        assert batches.get((("outcome", "error"),)) == 1
+        assert batches.get((("outcome", "ok"),)) == 1
+    finally:
+        obs.disable()
+        obs.reset()
+        eng.stop()
+
+
+# -- health probe over the C protocol ----------------------------------------
+
+class _DummyPredictor:
+    def get_input_names(self):
+        return ["input_0"]
+
+    def get_output_names(self):
+        return ["output_0"]
+
+    def run(self, inputs):
+        return [np.asarray(inputs[0], np.float32)]
+
+
+def _send_frame(path, payload):
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.connect(path)
+    s.sendall(struct.pack("<Q", len(payload)) + payload)
+    head = b""
+    while len(head) < 8:
+        chunk = s.recv(8 - len(head))
+        if not chunk:
+            s.close()
+            return None, None
+        head += chunk
+    (n,) = struct.unpack("<Q", head)
+    body = b""
+    while len(body) < n:
+        body += s.recv(n - len(body))
+    return s, body
+
+
+def test_capi_health_frame_and_malformed_frames(tmp_path):
+    from paddlepaddle_tpu.inference.c_api_server import (
+        _MAGIC, _OP_HEALTH, CApiServer)
+
+    eng = _static_engine()
+    path = str(tmp_path / "pd.sock")
+    srv = CApiServer(_DummyPredictor(), path, health_fn=eng.health)
+    srv.start()
+    try:
+        # health frame: JSON readiness snapshot
+        s, body = _send_frame(path, struct.pack("<IB", _MAGIC, _OP_HEALTH))
+        magic, status = struct.unpack_from("<IB", body)
+        assert magic == _MAGIC and status == 0
+        (ln,) = struct.unpack_from("<I", body, 5)
+        snap = json.loads(body[9:9 + ln].decode())
+        assert snap["mode"] == "static"
+        assert {"state", "ok", "queue_depth", "breaker"} <= set(snap)
+        s.close()
+
+        # bad magic: error frame, then the server closes the connection
+        s, body = _send_frame(path, struct.pack("<IB", 0xDEAD, 7))
+        assert struct.unpack_from("<IB", body)[1] == 1
+        s.settimeout(5)
+        assert s.recv(1) == b""       # closed by server
+        s.close()
+
+        # truncated frame (shorter than the header): typed error, no crash
+        s, body = _send_frame(path, b"\x01\x02")
+        assert struct.unpack_from("<IB", body)[1] == 1
+        assert b"malformed" in body
+        s.close()
+
+        # truncated tensor payload inside a RUN op
+        garbage = struct.pack("<IB", _MAGIC, 1) + struct.pack("<I", 3)
+        s, body = _send_frame(path, garbage)
+        assert struct.unpack_from("<IB", body)[1] == 1
+        s.close()
+
+        # absurd length prefix: error frame instead of buffering 2^60 bytes
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(path)
+        s.sendall(struct.pack("<Q", 1 << 60))
+        head = s.recv(8)
+        (n,) = struct.unpack("<Q", head)
+        body = b""
+        while len(body) < n:
+            body += s.recv(n - len(body))
+        assert struct.unpack_from("<IB", body)[1] == 1
+        assert b"exceeds max" in body
+        s.close()
+
+        # the server survived all of it: a well-formed RUN still works
+        x = np.arange(4, dtype=np.float32)
+        t = (struct.pack("<I", 7) + b"input_0" + struct.pack("<B", 0)
+             + struct.pack("<I", 1) + struct.pack("<q", 4) + x.tobytes())
+        frame = struct.pack("<IB", _MAGIC, 1) + struct.pack("<I", 1) + t
+        s, body = _send_frame(path, frame)
+        assert struct.unpack_from("<IB", body)[1] == 0
+        s.close()
+        assert len(srv._conns) <= 1       # closed connections were pruned
+    finally:
+        srv.stop()
+        eng.stop()
+
+
+# -- chaos drills ------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_chaos_admit_seam_fires():
+    from paddlepaddle_tpu.resilience import chaos
+
+    chaos.configure("serving.admit:exc:@1",
+                    seed=int(os.environ.get("PADDLE_CHAOS_SEED", "1234")))
+    eng = _static_engine()
+    try:
+        with pytest.raises(chaos.ChaosError):
+            eng.submit(_prompt(), max_new_tokens=2)
+        eng.submit(_prompt(), max_new_tokens=2).result(10)  # next one fine
+        assert chaos.fire_counts().get("serving.admit") == 1
+    finally:
+        chaos.disable()
+        eng.stop()
+
+
+@pytest.mark.chaos
+def test_chaos_decode_storm_opens_breaker_then_recovers():
+    """Acceptance drill (static scheduler, instant model): an injected
+    serving.decode fault storm opens the breaker; the engine recovers to
+    serving WITHOUT a restart once the half-open probe passes."""
+    from paddlepaddle_tpu.resilience import chaos
+
+    chaos.configure("serving.decode:exc:x3",
+                    seed=int(os.environ.get("PADDLE_CHAOS_SEED", "1234")))
+    eng = _static_engine(max_batch_size=1, breaker_threshold=3,
+                         breaker_reset_s=0.2)
+    try:
+        for _ in range(3):
+            f = eng.submit(_prompt(), max_new_tokens=2)
+            with pytest.raises(chaos.ChaosError):
+                f.result(10)
+        assert eng._breaker.state == "open"
+        with pytest.raises(CircuitOpenError):
+            eng.submit(_prompt(), max_new_tokens=2)
+        time.sleep(0.25)                  # storm exhausted + reset window
+        eng.submit(_prompt(), max_new_tokens=2).result(10)
+        assert eng._breaker.state == "closed"
+        assert chaos.fire_counts()["serving.decode"] == 3
+        assert eng.health()["ok"]
+    finally:
+        chaos.disable()
+        eng.stop()
+
+
+# -- continuous engine (real model) ------------------------------------------
+
+def _llama():
+    import paddlepaddle_tpu as paddle
+    from paddlepaddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    return LlamaForCausalLM(LlamaConfig.tiny(
+        vocab_size=64, hidden_size=32, layers=2, heads=4, kv_heads=2,
+        max_len=96))
+
+
+def test_continuous_cancel_frees_slot_mid_decode():
+    m = _llama()
+    rng = np.random.default_rng(0)
+    p = rng.integers(0, 64, (8,)).astype(np.int32)
+    with ServingEngine(m, max_batch_size=2, decode_chunk=4) as eng:
+        eng.submit(p, max_new_tokens=4).result(300)      # warm the compiles
+        doomed = eng.submit(rng.integers(0, 64, (8,)).astype(np.int32),
+                            max_new_tokens=80)
+        assert doomed.cancel() is True
+        with pytest.raises(RequestCancelledError):
+            doomed.result(30)
+        # the slot is released and reusable: another request completes and
+        # no phantom lane stays busy
+        out = eng.submit(p, max_new_tokens=4).result(120)
+        assert out.shape[0] == 12
+        deadline = time.time() + 10
+        while time.time() < deadline and eng._engine.busy_slots():
+            time.sleep(0.05)
+        assert eng._engine.busy_slots() == 0
+        assert eng.stats["cancelled"] >= 1
+
+
+@pytest.mark.chaos
+def test_chaos_continuous_breaker_recovery():
+    """The same storm through the CONTINUOUS engine: failed chunks fail the
+    slots, open the breaker, and the engine serves again after recovery —
+    deterministic under PADDLE_CHAOS_SEED."""
+    from paddlepaddle_tpu.resilience import chaos
+
+    m = _llama()
+    rng = np.random.default_rng(1)
+    p = rng.integers(0, 64, (8,)).astype(np.int32)
+    # ONE slot: each injected failure is its own decode attempt, so the
+    # storm deterministically reaches the breaker threshold
+    eng = ServingEngine(m, max_batch_size=1, decode_chunk=4,
+                        breaker_threshold=2, breaker_reset_s=0.2)
+    # observe transitions via the synchronous callback — sampling .state
+    # from the test thread can miss the short-lived "open" phase entirely
+    transitions = []
+    orig = eng._breaker._on_transition
+    eng._breaker._on_transition = \
+        lambda o, n: (transitions.append((o, n)), orig(o, n))
+    try:
+        eng.submit(p, max_new_tokens=4).result(300)      # warm the compiles
+        chaos.configure("serving.decode:exc:x2",
+                        seed=int(os.environ.get("PADDLE_CHAOS_SEED", "1234")))
+        failed = [eng.submit(rng.integers(0, 64, (8,)).astype(np.int32),
+                             max_new_tokens=4) for _ in range(2)]
+        for f in failed:
+            with pytest.raises(chaos.ChaosError):
+                f.result(120)
+        deadline = time.time() + 10
+        while time.time() < deadline \
+                and ("closed", "open") not in transitions:
+            time.sleep(0.02)
+        assert ("closed", "open") in transitions, transitions
+        time.sleep(0.25)                  # storm exhausted + reset window
+        out = eng.submit(p, max_new_tokens=4).result(120)   # recovered
+        assert out.shape[0] == 12
+        assert eng._breaker.state == "closed"
+        assert eng.stats["decode_failures"] >= 2
+    finally:
+        chaos.disable()
+        eng.stop()
+
+
+# -- soak --------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_soak_overload_burst_and_recovery():
+    """Acceptance: with max_queue=8, a 64-request burst yields typed sheds
+    (never a hang or an unbounded queue), every accepted request completes,
+    and the queue-depth gauge returns to 0."""
+    import paddlepaddle_tpu.observability as obs
+
+    obs.enable(trace=False, metrics=True, watchdog_=False)
+    eng = _static_engine(FakeModel(delay_s=0.01), max_batch_size=4,
+                         max_queue=8)
+    accepted, sheds, lock = [], [], threading.Lock()
+    try:
+        def client(i):
+            for j in range(8):
+                try:
+                    f = eng.submit(_prompt(v=i), max_new_tokens=2)
+                    with lock:
+                        accepted.append(f)
+                except ServerOverloadedError as e:
+                    assert e.queue_depth >= 8
+                    with lock:
+                        sheds.append(e)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert len(accepted) + len(sheds) == 64
+        assert sheds, "a 64-burst into max_queue=8 must shed"
+        for f in accepted:
+            assert f.result(60).shape == (6,)     # all accepted complete
+        time.sleep(0.3)       # idle loop republishes the depth gauge
+        snap = obs.snapshot()
+        assert snap["paddle_serving_queue_depth"][()] == 0
+        shed_counts = snap.get("paddle_serving_shed_total", {})
+        total_shed = sum(v for k, v in shed_counts.items()
+                         if dict(k).get("reason") == "queue_full")
+        assert total_shed == len(sheds)
+        assert eng.health()["ok"]
+        text = obs.to_prometheus_text()
+        assert "paddle_serving_shed_total" in text
+    finally:
+        obs.disable()
+        obs.reset()
+        eng.stop()
+
+
+def test_queue_wait_estimator_unit():
+    est = QueueWaitEstimator(alpha=0.5)
+    assert est.estimate_wait_s(100, 4) == 0.0     # never sheds blind
+    est.observe(1.0)
+    assert est.estimate_wait_s(0, 4) == 0.0       # nothing ahead of it
+    assert est.estimate_wait_s(8, 4) == pytest.approx(2.0)
+    est.observe(0.0)
+    assert est.ewma_s == pytest.approx(0.5)

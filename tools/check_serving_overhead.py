@@ -1,0 +1,207 @@
+#!/usr/bin/env python
+"""CI gate: robustness-layer overhead on the serving fast path.
+
+The serving robustness layer's contract is that with NO limits configured
+(no max_queue, no deadline, breaker closed) a submit pays only a handful of
+attribute reads on top of the seed engine's queue put. This script runs the
+same 64-request burst through (a) the current ServingEngine in static mode
+and (b) an inlined replica of the SEED scheduler (pre-robustness submit +
+collect + decode loop), both over a fake model whose decode costs exactly
+0.5ms per batch (the floor of a real tiny-model step), and FAILS (exit 1)
+if the median paired end-to-end latency ratio exceeds the budget.
+
+Usage:  python tools/check_serving_overhead.py [--requests 64]
+            [--budget 0.05] [--repeats 7]
+
+(No JAX needed: static mode never imports the decode engine.)
+"""
+
+import argparse
+import os
+import queue
+import statistics
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+class _Out:
+    def __init__(self, a):
+        self._a = a
+
+    def numpy(self):
+        return self._a
+
+
+class TinyDecodeModel:
+    """generate_cached costing exactly 0.5ms per batch — the floor of one
+    tiny-model decode step. A zero-work model would make the denominator
+    pure Python scheduler time (~15us/request), where a 5% budget means
+    <750ns of admission work — unmeasurable against GIL jitter and not
+    what the contract is about: the robustness layer must not add >5% to
+    SERVING latency."""
+
+    DECODE_S = 0.0005
+
+    def generate_cached(self, ids, max_new_tokens, temperature=0.0, top_k=0,
+                        eos_token_id=None):
+        # spin, don't sleep: time.sleep(0.5ms) actually sleeps 0.5-0.7ms
+        # depending on timer slack, and that jitter (x8 batches) would
+        # swamp the ~100us of overhead this gate exists to bound
+        end = time.perf_counter() + self.DECODE_S
+        while time.perf_counter() < end:
+            pass
+        return _Out(np.concatenate(
+            [ids, np.zeros((ids.shape[0], max_new_tokens), np.int32)],
+            axis=1))
+
+
+class SeedStaticEngine:
+    """The seed ServingEngine's static scheduler, verbatim semantics:
+    unbounded queue.Queue, leader + compatible window, no admission checks.
+    Kept here (not in the package) purely as the A/B baseline."""
+
+    def __init__(self, model, max_batch_size=8, max_wait_ms=5.0):
+        self.model = model
+        self.max_batch_size = max_batch_size
+        self.max_wait = max_wait_ms / 1e3
+        self._queue = queue.Queue()
+        self._stop = threading.Event()
+        self._thread = None
+
+    def submit(self, prompt_ids, max_new_tokens=32):
+        from paddlepaddle_tpu.inference.serving import GenerationRequest
+
+        req = GenerationRequest(prompt_ids, max_new_tokens, 0.0, 0, None)
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+        self._queue.put(req)
+        return req.result
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def _collect_batch(self):
+        try:
+            leader = self._queue.get(timeout=0.1)
+        except queue.Empty:
+            return []
+        batch = [leader]
+        deadline = time.monotonic() + self.max_wait
+        leftovers = []
+        while len(batch) < self.max_batch_size:
+            rest = deadline - time.monotonic()
+            if rest <= 0:
+                break
+            try:
+                req = self._queue.get(timeout=rest)
+            except queue.Empty:
+                break
+            if req.batch_key() == leader.batch_key():
+                batch.append(req)
+            else:
+                leftovers.append(req)
+        for req in leftovers:
+            self._queue.put(req)
+        return batch
+
+    def _loop(self):
+        while not self._stop.is_set():
+            batch = self._collect_batch()
+            if not batch:
+                continue
+            try:
+                ids = np.concatenate([r.prompt_ids for r in batch], axis=0)
+                leader = batch[0]
+                out = self.model.generate_cached(
+                    ids,
+                    max_new_tokens=max(r.max_new_tokens for r in batch),
+                    temperature=leader.temperature, top_k=leader.top_k,
+                    eos_token_id=leader.eos_token_id)
+                out = np.asarray(out.numpy())
+                plen = leader.prompt_ids.shape[1]
+                for i, req in enumerate(batch):
+                    req.result._set(output=out[i, : plen + req.max_new_tokens])
+            except BaseException as e:  # noqa: BLE001
+                for req in batch:
+                    req.result._set(error=e)
+
+
+def _run_bursts(make_engine, n_requests, bursts):
+    """Best (min) per-burst submit-to-done latency over ``bursts`` rounds
+    on ONE engine: a single 64-request burst finishes in ~2ms, far below
+    scheduler jitter (GIL handoffs, futex wakeups), so the minimum — the
+    run with the least interference — is the stable per-engine signal."""
+    prompt = np.arange(8, dtype=np.int32)
+    eng = make_engine()
+    times = []
+    try:
+        for _ in range(bursts):
+            t0 = time.perf_counter()
+            futs = [eng.submit(prompt, max_new_tokens=4)
+                    for _ in range(n_requests)]
+            for f in futs:
+                f.result(60)
+            times.append(time.perf_counter() - t0)
+        return min(times)
+    finally:
+        eng.stop()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--requests", type=int, default=64,
+                    help="requests per burst (default 64)")
+    ap.add_argument("--budget", type=float, default=0.05,
+                    help="max relative overhead, no limits configured "
+                         "(default 0.05)")
+    ap.add_argument("--repeats", type=int, default=7,
+                    help="paired rounds; median ratio compared (default 7)")
+    ap.add_argument("--bursts", type=int, default=25,
+                    help="bursts per round, median taken (default 25)")
+    args = ap.parse_args()
+
+    from paddlepaddle_tpu.inference.serving import ServingEngine
+
+    # max_wait 20ms >> submit cadence: every burst forms exactly 64/8 FULL
+    # batches in both engines — otherwise a GIL hiccup mid-burst splits a
+    # batch and the extra 0.5ms decode dwarfs the overhead being measured
+    def current():
+        # NO limits configured: max_queue/deadline off, breaker closed —
+        # this is the fast path the budget protects
+        return ServingEngine(TinyDecodeModel(), mode="static", max_batch_size=8,
+                             max_wait_ms=20.0)
+
+    def seed():
+        return SeedStaticEngine(TinyDecodeModel(), max_batch_size=8,
+                                max_wait_ms=20.0)
+
+    _run_bursts(current, args.requests, 3)   # warm both paths (thread
+    _run_bursts(seed, args.requests, 3)      # spawn, allocator, imports)
+    rounds = [(_run_bursts(current, args.requests, args.bursts),
+               _run_bursts(seed, args.requests, args.bursts))
+              for _ in range(args.repeats)]
+    overhead = statistics.median(a / b for a, b in rounds) - 1.0
+    cur = min(a for a, _ in rounds)
+    base = min(b for _, b in rounds)
+    print(f"{args.requests}-request burst: current={cur * 1e3:.1f}ms "
+          f"seed-replica={base * 1e3:.1f}ms "
+          f"median-paired overhead={overhead:+.2%}, "
+          f"budget {args.budget:.0%}")
+    if overhead >= args.budget:
+        print(f"FAIL: no-limits serving fast path overhead {overhead:.2%} "
+              f">= {args.budget:.0%} budget", file=sys.stderr)
+        return 1
+    print("OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,6 +4,13 @@
 # is replayable bit-for-bit. Tier-1 timing is unaffected: the long chaos
 # tests are also marked `slow` and the fast tier runs with -m "not slow".
 #
+# Covered drills (the -m chaos marker picks up all of them):
+#   * resilience: store/collective/checkpoint/dataloader/step seams,
+#     elastic-restart + SIGTERM-drain end-to-end (test_chaos_elastic.py)
+#   * serving: serving.admit / serving.decode seams — fault storm opens the
+#     circuit breaker, half-open probe recovers the engine without restart
+#     (test_serving_robustness.py)
+#
 # Usage: tools/run_chaos.sh [extra pytest args...]
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -12,5 +19,8 @@ export JAX_PLATFORMS=cpu
 export PADDLE_CHAOS_SEED="${PADDLE_CHAOS_SEED:-1234}"
 
 echo "[run_chaos] seed=${PADDLE_CHAOS_SEED}"
+echo "[run_chaos] drills: $(python -m pytest tests/ -q -m chaos --collect-only \
+    -p no:cacheprovider -p no:xdist -p no:randomly 2>/dev/null \
+    | grep -c '::' || true) chaos-marked tests"
 exec python -m pytest tests/ -q -m chaos \
     -p no:cacheprovider -p no:xdist -p no:randomly "$@"
