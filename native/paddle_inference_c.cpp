@@ -13,7 +13,7 @@
 //
 // Build: g++ -O2 -fPIC -shared -o libpaddle_inference_c.so paddle_inference_c.cpp
 // Protocol (little-endian):
-//   request : u32 magic 'PDC1' | u8 op (1=RUN, 2=INFO, 3=HEALTH) | body
+//   request : u32 magic 'PDC1' | u8 op (1=RUN, 2=INFO, 3=HEALTH, 4=METRICS) | body
 //   RUN body: u32 n | n * tensor      tensor: u32 name_len | name |
 //             u8 dtype (0 f32, 1 i64, 2 i32, 3 u8) | u32 ndim |
 //             i64 dims[ndim] | payload
@@ -21,12 +21,19 @@
 //             health_fn (ServingEngine.health() when one is wired) without
 //             touching the predictor, so a load balancer can poll it while
 //             the chip is busy.
+//   METRICS : no body. Telemetry scrape: the server answers with its
+//             metrics_fn (default: the process-wide observability
+//             registry's Prometheus exposition text, identical to the
+//             HTTP exporter's /metrics). An empty registry is an OK reply
+//             with text_len 0, not an error.
 //   reply   : u32 magic | u8 status (0 ok) | RUN: u32 n | tensors
 //                                          | INFO: u32 n_in | names | u32 n_out | names
 //                                          | HEALTH: u32 json_len | json
 //                                            (UTF-8 object: state, ok,
 //                                            queue_depth, busy_slots,
 //                                            breaker, ... — keys additive)
+//                                          | METRICS: u32 text_len | text
+//                                            (Prometheus exposition UTF-8)
 //             status!=0: u32 msg_len | msg
 //   Framing: every request/reply is length-prefixed with u64 len. The
 //   server validates frames: bad magic, a truncated payload, or a length

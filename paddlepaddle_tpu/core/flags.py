@@ -110,6 +110,37 @@ define_flag("obs_recompile_threshold", 3,
             "compiles from one callsite before the recompilation watchdog "
             "flags a storm", env="PADDLE_OBS_RECOMPILE_THRESHOLD")
 
+# Fleet telemetry plane (observability/exporter.py, aggregate.py, flight.py):
+# per-rank HTTP exporter, rank-0 store-based aggregation, crash flight
+# recorder. All off by default like the rest of the obs family.
+define_flag("obs_export", False,
+            "start the per-rank HTTP telemetry exporter (/metrics /healthz "
+            "/vars /trace) when observability is imported; "
+            "distributed.launch --obs_export sets this for every worker",
+            env="PADDLE_OBS_EXPORT")
+define_flag("obs_port", 9470,
+            "base port for the telemetry exporter; a worker listens on "
+            "obs_port + rank (falls back to an ephemeral port if taken)",
+            env="PADDLE_OBS_PORT")
+define_flag("obs_export_host", "127.0.0.1",
+            "bind address for the telemetry exporter (0.0.0.0 to scrape "
+            "across hosts)", env="PADDLE_OBS_EXPORT_HOST")
+define_flag("obs_publish_interval_s", 2.0,
+            "seconds between fleet snapshot publications from each worker "
+            "into the TCPStore control plane",
+            env="PADDLE_OBS_PUBLISH_INTERVAL_S")
+define_flag("obs_blackbox", False,
+            "arm the crash flight recorder: a bounded ring of structured "
+            "runtime events dumped as JSONL + all-thread stacks on "
+            "unhandled exception, watchdog timeout, preemption, breaker "
+            "open, or chaos kill", env="PADDLE_OBS_BLACKBOX")
+define_flag("obs_blackbox_dir", "",
+            "directory for black-box dumps (empty = <tmpdir>/paddle_blackbox)",
+            env="PADDLE_OBS_BLACKBOX_DIR")
+define_flag("obs_blackbox_events", 2048,
+            "flight recorder ring capacity (structured events)",
+            env="PADDLE_OBS_BLACKBOX_EVENTS")
+
 # Resilience family (resilience/): checkpoint integrity verification; the
 # chaos engine reads its PADDLE_CHAOS_* env vars directly (lazily at the
 # first seam hit, so launcher-spawned workers pick them up per process).

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 
 from ..core.dispatch import apply_op, unwrap, wrap
 from ..core.tensor import Tensor
+from ..observability.flight import record as _flight_record
 from ..resilience.chaos import chaos_point
 
 
@@ -150,6 +151,9 @@ def _collective_op(bytes_arg=None):
             # chaos seam: every eager collective entry (resilience/chaos.py);
             # a no-op global check unless PADDLE_CHAOS_POINTS arms it
             chaos_point("collective.launch")
+            # black box: collective launches are flight-recorder events so a
+            # crash dump shows what the rank was coordinating when it died
+            _flight_record("collective", name)
             obs = _obs_coll
             if obs is None:
                 return fn(*args, **kwargs)

@@ -42,6 +42,15 @@ def _parse_args(argv=None):
     p.add_argument("--log_dir", type=str, default=None)
     p.add_argument("--max_restarts", type=int, default=0)
     p.add_argument("--run_mode", type=str, default="collective")
+    p.add_argument("--obs_export", action="store_true",
+                   default=os.environ.get("PADDLE_OBS_EXPORT", "").lower()
+                   in ("1", "true", "yes", "on"),
+                   help="start a telemetry exporter in every worker "
+                        "(/metrics /healthz /vars /trace on obs_port+rank); "
+                        "rank 0 additionally serves the fleet-merged view")
+    p.add_argument("--obs_port", type=int, default=0,
+                   help="base exporter port (0 = FLAGS_obs_port default); "
+                        "worker rank r listens on obs_port + r")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -70,6 +79,14 @@ def _worker_env(args, local_rank: int, world_size: int, master_addr,
         # state); workers always connect as clients, rank 0 included
         "PADDLE_LAUNCH_STORE": "1",
     })
+    if args.obs_export:
+        # fleet telemetry plane: every worker starts its exporter on
+        # obs_port + rank and publishes snapshots into the launcher's
+        # store; rank 0 serves the merged view (observability/aggregate.py)
+        env["PADDLE_OBS_EXPORT"] = "1"
+        env.setdefault("PADDLE_OBS_METRICS", "1")  # an empty /metrics helps no one
+        if args.obs_port:
+            env["PADDLE_OBS_PORT"] = str(args.obs_port)
     if args.devices:
         env["CUDA_VISIBLE_DEVICES"] = args.devices  # env parity; unused on TPU
     # make the framework importable in workers even when not pip-installed
@@ -94,6 +111,16 @@ def _count_restart(local_rank: int, rc: int) -> None:
 
 def launch(argv=None) -> int:
     args = _parse_args(argv)
+    # PADDLE_OBS_EXPORT in the shell autostarts an exporter in THIS process
+    # at import time — on the launcher that squats rank 0's deterministic
+    # port (obs_port + 0) and would force the real rank 0 onto an ephemeral
+    # one. The launcher serves no telemetry; release it before spawning.
+    try:
+        from ...observability import stop_exporter
+
+        stop_exporter()
+    except Exception:
+        pass
     spec = str(args.nnodes)
     lo = int(spec.split(":")[0])
     hi = int(spec.split(":")[1]) if ":" in spec else lo

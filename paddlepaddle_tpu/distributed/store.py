@@ -337,10 +337,21 @@ class _PyStore:
 
 
 _global_store: Optional[TCPStore] = None
+_global_store_lock = threading.Lock()
 
 
 def create_or_get_global_tcp_store() -> TCPStore:
-    """Reference: python/paddle/distributed/parallel.py:1134."""
+    """Reference: python/paddle/distributed/parallel.py:1134.
+
+    Thread-safe: the fleet-telemetry autostart thread
+    (observability/__init__.py) and the main thread's rendezvous can race
+    here; without the lock both could construct a store (and on a
+    self-hosting rank 0, the second master bind would fail)."""
+    with _global_store_lock:
+        return _create_or_get_locked()
+
+
+def _create_or_get_locked() -> TCPStore:
     global _global_store
     if _global_store is None:
         host = os.environ.get("MASTER_ADDR", "127.0.0.1")

@@ -23,6 +23,7 @@ import time
 import traceback
 from typing import Callable, Optional
 
+from ..observability import flight
 from ..resilience.chaos import chaos_point
 
 
@@ -39,6 +40,7 @@ class Watchdog:
         self.poll_interval = poll_interval
         self._lock = threading.Lock()
         self._current = None  # (name, start_time)
+        self._steps = 0  # monotonically-increasing step ordinal (flight)
         self._stop = threading.Event()
         # the task (identity) the watchdog last fired for: a new step re-arms
         # the watchdog (FLAGS_watchdog_rearm), so every hung step is reported
@@ -73,12 +75,29 @@ class Watchdog:
 
         class _Task:
             def __enter__(self):
-                chaos_point("step")  # injection seam: step execution
+                with wd._lock:
+                    wd._steps += 1
+                    ordinal = wd._steps
+                self._ordinal = ordinal
+                # black box first, chaos second: an injected kill at the
+                # step seam must leave the in-flight step in the dump
+                flight.record("step", name, phase="begin", ordinal=ordinal)
+                try:
+                    chaos_point("step")  # injection seam: step execution
+                except BaseException:
+                    # an exc injection aborts the step before __exit__ can
+                    # run — close the flight span or it reads as a stale
+                    # in-flight step in a later unrelated dump
+                    flight.record("step", name, phase="end",
+                                  ordinal=ordinal, ok=False)
+                    raise
                 with wd._lock:
                     wd._current = (name, time.monotonic())
                 return self
 
             def __exit__(self, *exc):
+                flight.record("step", name, phase="end",
+                              ordinal=self._ordinal, ok=exc[0] is None)
                 with wd._lock:
                     wd._current = None
                 return False
@@ -153,3 +172,9 @@ class Watchdog:
             sys.stderr.write(f"--- thread {tid} ---\n")
             sys.stderr.write("".join(traceback.format_stack(frame)))
         sys.stderr.flush()
+        # black box: stderr dies with the process (or scrolls away in a
+        # worker log); the flight recorder persists the same report — the
+        # hung step, every thread's stack, the in-flight comm-task table
+        flight.record("watchdog_timeout", name,
+                      elapsed_s=round(elapsed, 3), timeout_s=self.timeout)
+        flight.dump("step_timeout")

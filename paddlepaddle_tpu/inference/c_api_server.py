@@ -21,7 +21,7 @@ import numpy as np
 
 _MAGIC = 0x50444331
 _DTYPES = [np.dtype("<f4"), np.dtype("<i8"), np.dtype("<i4"), np.dtype("u1")]
-_OP_RUN, _OP_INFO, _OP_HEALTH = 1, 2, 3
+_OP_RUN, _OP_INFO, _OP_HEALTH, _OP_METRICS = 1, 2, 3, 4
 
 # a frame length past this is garbage (or an attack), not a request: reply
 # with an error frame and close instead of trying to buffer it
@@ -74,15 +74,22 @@ class CApiServer:
 
     ``health_fn`` (optional) backs the ``_OP_HEALTH`` frame — pass
     ``ServingEngine.health`` (or any () -> dict) and native clients get the
-    readiness snapshot as JSON without touching Python."""
+    readiness snapshot as JSON without touching Python. ``metrics_fn``
+    (optional) backs the ``_OP_METRICS`` frame — it defaults to the
+    process-wide ``observability.to_prometheus_text()``, so a native client
+    (or a sidecar scraper with a UDS pipe) can pull the same exposition
+    text the HTTP exporter serves; an empty registry yields an OK frame
+    with a zero-length payload, not an error."""
 
     def __init__(self, predictor, socket_path: str,
                  input_names: Optional[Sequence[str]] = None,
                  output_names: Optional[Sequence[str]] = None,
-                 health_fn: Optional[Callable[[], dict]] = None):
+                 health_fn: Optional[Callable[[], dict]] = None,
+                 metrics_fn: Optional[Callable[[], str]] = None):
         self.predictor = predictor
         self.path = socket_path
         self.health_fn = health_fn
+        self.metrics_fn = metrics_fn
         self.input_names = list(input_names if input_names is not None
                                 else predictor.get_input_names())
         self.output_names = list(output_names if output_names is not None
@@ -128,6 +135,19 @@ class CApiServer:
                 payload = json.dumps(snap, default=str).encode()
             except Exception as e:
                 return self._reply_err(f"health probe failed: {e}"), False
+            return (self._reply_ok(struct.pack("<I", len(payload)) + payload),
+                    False)
+        if op == _OP_METRICS:
+            try:
+                if self.metrics_fn is not None:
+                    text = self.metrics_fn()
+                else:
+                    from ..observability import to_prometheus_text
+
+                    text = to_prometheus_text()
+                payload = text.encode()
+            except Exception as e:
+                return self._reply_err(f"metrics scrape failed: {e}"), False
             return (self._reply_ok(struct.pack("<I", len(payload)) + payload),
                     False)
         if op != _OP_RUN:

@@ -273,12 +273,35 @@ class ServingEngine:
         _safe_set("paddle_serving_breaker_state",
                   "serving breaker state (0 closed, 1 half-open, 2 open)",
                   _BREAKER_STATE_NUM[new])
+        try:
+            from ..observability import flight
+
+            flight.record("breaker", "serving",
+                          **{"from": old, "to": new})
+            if new == "open":
+                # an opening breaker means the engine is sick; capture the
+                # black box while the evidence (recent decode failures, the
+                # engine thread's stack) is still in the ring. Deferred to
+                # a thread: this callback runs UNDER the breaker lock, and
+                # a dump fsync (possibly to network storage) must not
+                # freeze every submit's allow() check behind it
+                threading.Thread(
+                    target=lambda: flight.dump("breaker_open"),
+                    daemon=True, name="flight-breaker-dump").start()
+        except Exception:
+            pass
 
     def _shed(self, reason: str, exc: BaseException) -> None:
         self._bump("shed")
         _safe_inc("paddle_serving_shed_total",
                   "requests shed by serving admission control, by reason",
                   reason=reason)
+        try:
+            from ..observability import flight
+
+            flight.record("shed", reason)
+        except Exception:
+            pass
         raise exc
 
     def _queue_depth(self) -> int:
@@ -421,6 +444,20 @@ class ServingEngine:
                 self._watchdog_thread.start()
             if self._drain_on_sigterm:
                 self.install_preemption_hook()
+            # if this process runs a telemetry exporter, serve this
+            # engine's readiness under /healthz (the HTTP analogue of the
+            # C protocol's _OP_HEALTH frame)
+            try:
+                from ..observability import exporter as _exporter
+
+                served = _exporter.get()
+                if served is not None:
+                    # unique: a second engine in this process must not
+                    # clobber the first's provider entry
+                    self._health_reg_name = served.register_health(
+                        "serving", self.health, unique=True)
+            except Exception:
+                pass
         return self
 
     def install_preemption_hook(self, timeout: Optional[float] = None):
@@ -487,6 +524,19 @@ class ServingEngine:
         return n
 
     def stop(self):
+        # deliberate stop: a later /healthz must not keep reporting this
+        # engine (a stopped-on-purpose engine is not an unhealthy process)
+        try:
+            from ..observability import exporter as _exporter
+
+            served = _exporter.get()
+            if served is not None:
+                # guarded: only drop OUR entry, never a sibling engine's
+                served.unregister_health(
+                    getattr(self, "_health_reg_name", "serving"),
+                    fn=self.health)
+        except Exception:
+            pass
         self._shutdown(RuntimeError("serving engine stopped"))
 
     def _shutdown(self, shed_error: BaseException):

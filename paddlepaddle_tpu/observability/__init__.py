@@ -35,10 +35,11 @@ before import.
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 from ..core import flags as _flags
-from . import watchdog
+from . import flight, watchdog
 from .metrics import (  # noqa: F401
     BYTES_BUCKETS,
     LATENCY_BUCKETS,
@@ -355,6 +356,17 @@ def summary(top: int = 30) -> str:
              f"(trace={'on' if _trace_on else 'off'}, "
              f"metrics={'on' if _metrics_on else 'off'}, "
              f"watchdog={'on' if _watchdog_on else 'off'})"]
+    # rank/world attribution so a summary pasted from a multi-host job says
+    # WHICH worker it came from
+    try:
+        import socket as _socket
+
+        from ..distributed import env as _denv
+
+        lines.append(f"rank {_denv.get_rank()}/{_denv.get_world_size()}  "
+                     f"host {_socket.gethostname()}  pid {os.getpid()}")
+    except Exception:
+        lines.append(f"rank ?/?  pid {os.getpid()}")
 
     def rows_of(counter_name):
         return sorted(snap.get(counter_name, {}).items(),
@@ -463,10 +475,94 @@ def summary(top: int = 30) -> str:
         _section(lines, "jit compilations (watchdog)")
         lines.append(watchdog.report())
 
-    if len(lines) == 1:
+    if len(lines) == 2:  # only the title + rank header
         lines.append("  (nothing recorded — call observability.enable() "
                      "or set PADDLE_OBS_TRACE/PADDLE_OBS_METRICS)")
     return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# fleet telemetry plane (exporter / aggregation / black box)
+# ---------------------------------------------------------------------------
+
+_fleet_publisher = None  # the autostarted FleetPublisher, so it can be stopped
+
+
+def start_exporter(port: Optional[int] = None, host: Optional[str] = None):
+    """Start (or return) this process's HTTP telemetry exporter — serves
+    ``/metrics``, ``/healthz``, ``/vars``, ``/trace`` on
+    ``FLAGS_obs_port + rank`` (see :mod:`~.exporter`)."""
+    from . import exporter
+
+    return exporter.start(port=port, host=host)
+
+
+_fleet_stopped = False  # stop_exporter() may race the autostart thread
+
+
+def stop_exporter() -> None:
+    """Stop the exporter AND the autostarted fleet publisher (if any) —
+    tearing telemetry down must not leave a thread publishing to the
+    store forever. Safe against the autostart thread still dialing the
+    store: the flag makes a late-arriving publisher stop itself."""
+    global _fleet_publisher, _fleet_stopped
+    from . import exporter
+
+    _fleet_stopped = True
+    exporter.stop()
+    pub, _fleet_publisher = _fleet_publisher, None
+    if pub is not None:
+        pub.stop(final_publish=False)
+
+
+def _autostart_fleet() -> None:
+    """Under a multi-process launch, publish snapshots into the launcher's
+    TCPStore and (on rank 0) serve the merged fleet view. Runs on a daemon
+    thread: the store dial must never block (or break) worker import."""
+    global _fleet_publisher
+    world = flight._world()
+    if world <= 1 or _fleet_stopped:
+        return
+    try:
+        from ..distributed.store import create_or_get_global_tcp_store
+        from . import aggregate as _aggregate
+        from . import exporter as _exporter
+
+        rank = flight._rank()
+        # torch-style jobs (RANK/WORLD_SIZE only): pin the PADDLE_* names
+        # BEFORE touching the global store, exactly like host_collectives
+        # does — otherwise the store factory would see rank 0 / world 1
+        # and cache a wrong (self-hosted) store that later poisons the
+        # training rendezvous. If the dial FAILS (stale torchrun env
+        # pointing at a dead master), unpin: telemetry must not leave the
+        # process lying about its rank identity as a side effect.
+        pinned = []
+        for k, v in (("PADDLE_TRAINER_ID", rank),
+                     ("PADDLE_TRAINERS_NUM", world)):
+            if k not in os.environ:
+                os.environ[k] = str(v)
+                pinned.append(k)
+        try:
+            store = create_or_get_global_tcp_store()
+        except BaseException:
+            for k in pinned:
+                os.environ.pop(k, None)
+            raise
+        _fleet_publisher = _aggregate.FleetPublisher(store, rank).start()
+        if _fleet_stopped:  # stop_exporter() won the race mid-dial
+            pub, _fleet_publisher = _fleet_publisher, None
+            if pub is not None:  # stop_exporter may have swapped it first
+                pub.stop(final_publish=False)
+            return
+        if rank == 0:
+            served = _exporter.get()
+            if served is not None:
+                _aggregate.install_fleet_routes(served, store, world,
+                                                local_rank=0)
+    except Exception as e:
+        import sys as _sys
+
+        _sys.stderr.write(f"[obs] fleet telemetry autostart failed: {e!r}\n")
 
 
 # auto-enable from env: PADDLE_OBS_* / FLAGS_obs_* read at define_flag time
@@ -476,10 +572,29 @@ if (_flags.flag_value("obs_trace") or _flags.flag_value("obs_metrics")
            metrics=_flags.flag_value("obs_metrics"),
            watchdog_=_flags.flag_value("obs_recompile_watch"))
 
+if _flags.flag_value("obs_blackbox"):
+    try:
+        flight.enable()
+    except Exception:
+        pass
+
+if _flags.flag_value("obs_export"):
+    try:
+        start_exporter()
+    except Exception as _e:
+        import sys as _sys
+
+        _sys.stderr.write(f"[obs] exporter autostart failed: {_e!r}\n")
+    import threading as _threading
+
+    _threading.Thread(target=_autostart_fleet, daemon=True,
+                      name="obs-fleet-autostart").start()
+
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "Recorder", "Event",
     "RecordEvent", "trace_region", "exponential_buckets",
     "enable", "disable", "reset", "is_enabled", "safe_inc", "safe_set",
     "get_recorder", "get_registry", "snapshot", "to_prometheus_text",
-    "export_chrome_trace", "summary", "watchdog",
+    "export_chrome_trace", "summary", "watchdog", "flight",
+    "start_exporter", "stop_exporter",
 ]

@@ -50,6 +50,19 @@ def _esc(v: str) -> str:
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
 
+def format_value(v: float) -> str:
+    """Exposition sample-value formatting that never loses precision: a
+    ``%g`` (6 significant digits) silently corrupts counters past ~1e6 —
+    real on any long job — so integral values print as exact integers and
+    everything else as the shortest round-tripping repr."""
+    f = float(v)
+    if f != f or f in (float("inf"), float("-inf")):
+        return f"{f:g}"  # nan/inf spellings Prometheus understands
+    if f == int(f) and abs(f) < 1e17:
+        return str(int(f))
+    return repr(f)
+
+
 def _fmt_labels(key: Tuple[Tuple[str, str], ...], extra: str = "") -> str:
     parts = [f'{k}="{_esc(v)}"' for k, v in key]
     if extra:
@@ -84,7 +97,7 @@ class Counter:
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} counter"]
         for key, v in sorted(self.snapshot().items()):
-            lines.append(f"{self.name}{_fmt_labels(key)} {v:g}")
+            lines.append(f"{self.name}{_fmt_labels(key)} {format_value(v)}")
         return lines
 
     def clear(self):
@@ -126,7 +139,7 @@ class Gauge:
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} gauge"]
         for key, v in sorted(self.snapshot().items()):
-            lines.append(f"{self.name}{_fmt_labels(key)} {v:g}")
+            lines.append(f"{self.name}{_fmt_labels(key)} {format_value(v)}")
         return lines
 
     def clear(self):
@@ -210,13 +223,150 @@ class Histogram:
                 le_label = 'le="%s"' % le_s
                 lines.append(
                     f"{self.name}_bucket{_fmt_labels(key, le_label)} {cum}")
-            lines.append(f"{self.name}_sum{_fmt_labels(key)} {snap['sum']:g}")
+            lines.append(f"{self.name}_sum{_fmt_labels(key)} "
+                         f"{format_value(snap['sum'])}")
             lines.append(f"{self.name}_count{_fmt_labels(key)} {snap['count']}")
         return lines
 
     def clear(self):
         with self._lock:
             self._states.clear()
+
+
+def snapshot_to_jsonable(snap: dict) -> dict:
+    """Registry ``snapshot()`` re-shaped for JSON: tuple label keys become
+    ``{"labels": {...}, "value": ...}`` rows, histogram bucket bounds become
+    strings (``"+Inf"`` for the overflow bucket), non-finite floats become
+    null — strict-JSON consumers (browsers, jq) must be able to load the
+    ``/vars`` endpoint verbatim."""
+    import math
+
+    def scalar(v):
+        return None if isinstance(v, float) and not math.isfinite(v) else v
+
+    out = {}
+    for metric, by_key in snap.items():
+        rows = []
+        for key, v in sorted(by_key.items()):
+            if isinstance(v, dict):  # histogram state
+                v = dict(v, sum=scalar(v.get("sum")),
+                         min=scalar(v.get("min")), max=scalar(v.get("max")),
+                         buckets={("+Inf" if le == float("inf") else f"{le:g}"): c
+                                  for le, c in v.get("buckets", {}).items()})
+            else:
+                v = scalar(v)
+            rows.append({"labels": dict(key), "value": v})
+        out[metric] = rows
+    return out
+
+
+def _unesc(v: str) -> str:
+    """Inverse of :func:`_esc` (exposition label-value escaping)."""
+    out, i = [], 0
+    while i < len(v):
+        c = v[i]
+        if c == "\\" and i + 1 < len(v):
+            nxt = v[i + 1]
+            out.append({"\\": "\\", '"': '"', "n": "\n"}.get(nxt, "\\" + nxt))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _parse_label_block(line: str, start: int, lineno: int):
+    """Parse ``{k="v",...}`` beginning at ``line[start] == '{'``; returns
+    (labels dict, index just past the closing brace)."""
+    labels: Dict[str, str] = {}
+    i = start + 1
+    while i < len(line) and line[i] != "}":
+        eq = line.find("=", i)
+        if eq < 0 or line[eq + 1: eq + 2] != '"':
+            raise ValueError(f"line {lineno}: malformed label block")
+        key = line[i:eq].strip().lstrip(",").strip()
+        j = eq + 2  # scan the quoted value, honoring backslash escapes
+        raw = []
+        while j < len(line):
+            c = line[j]
+            if c == "\\" and j + 1 < len(line):
+                raw.append(line[j:j + 2])
+                j += 2
+                continue
+            if c == '"':
+                break
+            raw.append(c)
+            j += 1
+        if j >= len(line):
+            raise ValueError(f"line {lineno}: unterminated label value")
+        labels[key] = _unesc("".join(raw))
+        i = j + 1
+    if i >= len(line) or line[i] != "}":
+        raise ValueError(f"line {lineno}: unterminated label block")
+    return labels, i + 1
+
+
+def parse_prometheus_text(text: str) -> Dict[str, dict]:
+    """Strict parser for the exposition subset :meth:`Registry.
+    to_prometheus_text` emits. Returns ``{family_name: {"help": str,
+    "type": str, "samples": [(sample_name, labels_dict, value), ...]}}``.
+
+    Strict means it *raises* ``ValueError`` on anything the emitter should
+    never produce: a sample before its ``# HELP``/``# TYPE`` pair, a TYPE
+    for an undeclared family, an unknown metric type, a malformed label
+    block, or a histogram-suffixed sample whose base family is not a
+    histogram. Used both by the round-trip exposition tests and by the
+    fleet aggregator (which re-labels every sample with its rank).
+    """
+    families: Dict[str, dict] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            name, _, help_ = line[len("# HELP "):].partition(" ")
+            if not name:
+                raise ValueError(f"line {lineno}: HELP without a name")
+            families.setdefault(
+                name, {"help": help_, "type": None, "samples": []}
+            )["help"] = help_
+            continue
+        if line.startswith("# TYPE "):
+            name, _, kind = line[len("# TYPE "):].partition(" ")
+            if name not in families:
+                raise ValueError(f"line {lineno}: TYPE before HELP for {name!r}")
+            if kind not in ("counter", "gauge", "histogram"):
+                raise ValueError(f"line {lineno}: unknown type {kind!r}")
+            families[name]["type"] = kind
+            continue
+        if line.startswith("#"):
+            continue  # comments are legal exposition
+        brace = line.find("{")
+        space = line.find(" ")
+        if brace != -1 and (space == -1 or brace < space):
+            sample_name = line[:brace]
+            labels, end = _parse_label_block(line, brace, lineno)
+            value_text = line[end:].strip()
+        else:
+            sample_name, _, value_text = line.partition(" ")
+            labels = {}
+        try:
+            value = float(value_text)
+        except ValueError:
+            raise ValueError(f"line {lineno}: bad sample value {value_text!r}")
+        fam = families.get(sample_name)
+        if fam is None:
+            for suffix in ("_bucket", "_sum", "_count"):
+                if sample_name.endswith(suffix):
+                    base = families.get(sample_name[:-len(suffix)])
+                    if base is not None and base["type"] == "histogram":
+                        fam = base
+                        break
+        if fam is None or fam["type"] is None:
+            raise ValueError(
+                f"line {lineno}: sample {sample_name!r} has no declared "
+                f"HELP/TYPE family")
+        fam["samples"].append((sample_name, labels, value))
+    return families
 
 
 class Registry:

@@ -143,6 +143,16 @@ class Recorder:
     def events(self) -> List[Event]:
         return list(self._events)
 
+    def signature(self) -> tuple:
+        """O(1) change detector over the ring (length + newest event's
+        identity) — lets periodic exporters skip re-serializing an
+        unchanged multi-MB trace."""
+        try:
+            last = self._events[-1]
+        except IndexError:
+            return (0, None)
+        return (len(self._events), (last.ts_us, last.dur_us, last.name))
+
     def stats(self, cat: Optional[str] = None) -> Dict[str, tuple]:
         """name -> (count, total_s, min_s, max_s), a consistent copy.
         ``cat`` restricts to one category (e.g. the profiler reports only

@@ -84,6 +84,9 @@ def _on_compile(dur_s: float) -> None:
                     "backend (XLA) compilations").inc(site=site)
         reg.histogram("paddle_jit_compile_seconds",
                       "backend compile wall time").observe(dur_s)
+    from . import flight
+
+    flight.record("recompile", site, duration_s=round(dur_s, 4))
     tracer = _recorder_if_tracing()
     if tracer is not None:
         tracer.record_complete("jit_compile", "compile", dur_s,

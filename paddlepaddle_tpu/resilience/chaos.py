@@ -213,12 +213,13 @@ def _emit_metric(point: str, mode: str) -> None:
     # cold path (an injection is firing); observability import stays out of
     # the un-armed fast path entirely
     try:
-        from ..observability import safe_inc
+        from ..observability import flight, safe_inc
     except Exception:
         return
     safe_inc("paddle_chaos_injections_total",
              "synthetic faults fired by the chaos engine, by point and mode",
              point=point, mode=mode)
+    flight.record("chaos", point, mode=mode)
 
 
 def chaos_point(name: str) -> None:
@@ -246,6 +247,14 @@ def chaos_point(name: str) -> None:
             sys.stderr.write(
                 f"[chaos] kill injected at {name!r} (exit {code})\n")
             sys.stderr.flush()
+            # os._exit skips atexit AND excepthooks: flush the black box
+            # here or the drill that killed the worker leaves no evidence
+            try:
+                from ..observability import flight
+
+                flight.dump(f"chaos_kill:{name}")
+            except Exception:
+                pass
             os._exit(code)
         else:
             raise ChaosError(f"chaos injected at {name!r} "

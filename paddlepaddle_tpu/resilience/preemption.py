@@ -94,12 +94,17 @@ class PreemptionHandler:
             f"then exit({self.exit_code})\n")
         sys.stderr.flush()
         try:
-            from ..observability import safe_inc
+            from ..observability import flight, safe_inc
 
+            sig_name = signal.Signals(signum).name
             safe_inc("paddle_preemptions_total",
                      "preemption signals handled (emergency save + "
-                     "restartable exit)",
-                     signal=signal.Signals(signum).name)
+                     "restartable exit)", signal=sig_name)
+            # flush the black box BEFORE draining: if an emergency save
+            # hangs past the grace window, SIGKILL lands with the evidence
+            # already on disk
+            flight.record("preemption", sig_name, exit_code=self.exit_code)
+            flight.dump("preemption")
         except Exception:
             pass
         self.drain()

@@ -72,12 +72,16 @@ def compute_delay(policy: RetryPolicy, attempt: int,
     return base + base * policy.jitter * r
 
 
-def _count(name: str, help_: str, op: str) -> None:
+def _count(name: str, help_: str, op: str, **flight_data) -> None:
     try:
-        from ..observability import safe_inc
+        from ..observability import flight, safe_inc
     except Exception:
         return
     safe_inc(name, help_, op=op)
+    # flight-recorder breadcrumb: a crash dump shows the retry storm that
+    # preceded it ("retry" = about to back off, "retry_exhausted" = gave up)
+    kind = "retry_exhausted" if name.endswith("exhausted_total") else "retry"
+    flight.record(kind, op, **flight_data)
 
 
 def call_with_retry(fn: Callable, *args, policy: Optional[RetryPolicy] = None,
@@ -104,7 +108,9 @@ def call_with_retry(fn: Callable, *args, policy: Optional[RetryPolicy] = None,
                     time.monotonic() - start + delay > policy.deadline):
                 break
             _count("paddle_retry_attempts_total",
-                   "retries performed after a transient failure, by op", op)
+                   "retries performed after a transient failure, by op", op,
+                   attempt=attempt, delay_s=round(delay, 4),
+                   error=f"{type(e).__name__}: {e}"[:200])
             if on_retry is not None:
                 on_retry(attempt, e, delay)
             sleep(delay)

@@ -9,6 +9,8 @@ GetInput*/Output* / PD_TensorReshape / CopyFrom/ToCpu / PD_PredictorRun).
 
 import ctypes
 import os
+import socket
+import struct
 import subprocess
 
 import numpy as np
@@ -148,3 +150,126 @@ def test_c_api_server_reports_errors(tmp_path):
             assert b"deliberate failure" in lib.PD_PredictorGetLastError(p)
         finally:
             lib.PD_PredictorDestroy(p)
+
+
+# ---------------------------------------------------------------------------
+# _OP_METRICS (op 4): the protocol-level telemetry scrape. Driven with a raw
+# python socket speaking the wire format, so these run without g++.
+# ---------------------------------------------------------------------------
+
+class _NullPredictor:
+    def get_input_names(self):
+        return ["input_0"]
+
+    def get_output_names(self):
+        return ["output_0"]
+
+    def run(self, inputs):
+        return inputs
+
+
+def _recv_exact(s, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = s.recv(n - len(buf))
+        if not chunk:
+            return buf  # peer closed
+        buf += chunk
+    return buf
+
+
+def _rpc(sock_path, payload):
+    """One length-prefixed request; returns (status, body) or (None, b"")
+    if the server closed without replying."""
+    with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+        s.connect(sock_path)
+        s.settimeout(10)
+        s.sendall(struct.pack("<Q", len(payload)) + payload)
+        head = _recv_exact(s, 8)
+        if len(head) < 8:
+            return None, b""
+        (length,) = struct.unpack("<Q", head)
+        frame = _recv_exact(s, length)
+        magic, status = struct.unpack_from("<IB", frame)
+        assert magic == 0x50444331
+        return status, frame[5:]
+
+
+def _unpack_text(body):
+    (n,) = struct.unpack_from("<I", body)
+    return body[4:4 + n]
+
+
+def test_c_metrics_frame_round_trips_exposition_text(tmp_path):
+    from paddlepaddle_tpu.inference.c_api_server import _MAGIC, CApiServer
+
+    text = ('# HELP paddle_probe_total scrape probe\n'
+            '# TYPE paddle_probe_total counter\n'
+            'paddle_probe_total{op="frame"} 3\n')
+    sock = str(tmp_path / "pd.sock")
+    with CApiServer(_NullPredictor(), sock, metrics_fn=lambda: text):
+        status, body = _rpc(sock, struct.pack("<IB", _MAGIC, 4))
+    assert status == 0
+    assert _unpack_text(body).decode() == text
+
+
+def test_c_metrics_frame_empty_registry_is_ok_not_error(tmp_path):
+    """metrics_fn yielding nothing (empty registry) must answer an OK frame
+    with a zero-length payload — a scraper polling a fresh process is not
+    an error condition."""
+    from paddlepaddle_tpu.inference.c_api_server import _MAGIC, CApiServer
+
+    sock = str(tmp_path / "pd.sock")
+    with CApiServer(_NullPredictor(), sock, metrics_fn=lambda: ""):
+        status, body = _rpc(sock, struct.pack("<IB", _MAGIC, 4))
+    assert status == 0
+    assert _unpack_text(body) == b""
+
+
+def test_c_metrics_frame_default_reads_observability_registry(tmp_path):
+    import paddlepaddle_tpu.observability as obs
+    from paddlepaddle_tpu.inference.c_api_server import _MAGIC, CApiServer
+
+    obs.safe_inc("paddle_c_api_probe_total", "seeded by the metrics test")
+    try:
+        sock = str(tmp_path / "pd.sock")
+        with CApiServer(_NullPredictor(), sock):  # no metrics_fn: default
+            status, body = _rpc(sock, struct.pack("<IB", _MAGIC, 4))
+        assert status == 0
+        text = _unpack_text(body).decode()
+        assert "paddle_c_api_probe_total" in text
+        # the frame carries real exposition text, not a repr of something
+        assert "# TYPE paddle_c_api_probe_total counter" in text
+    finally:
+        obs.reset()
+
+
+def test_c_metrics_frame_error_surfaces_as_error_frame(tmp_path):
+    from paddlepaddle_tpu.inference.c_api_server import _MAGIC, CApiServer
+
+    def boom():
+        raise RuntimeError("registry on fire")
+
+    sock = str(tmp_path / "pd.sock")
+    with CApiServer(_NullPredictor(), sock, metrics_fn=boom):
+        status, body = _rpc(sock, struct.pack("<IB", _MAGIC, 4))
+    assert status == 1
+    assert b"registry on fire" in _unpack_text(body)
+
+
+def test_c_garbage_frame_gets_error_reply_then_close(tmp_path):
+    """Garbage (bad magic) gets an explicit error frame and a closed
+    connection — never a hang or a thread death with nothing on the wire."""
+    from paddlepaddle_tpu.inference.c_api_server import CApiServer
+
+    sock = str(tmp_path / "pd.sock")
+    with CApiServer(_NullPredictor(), sock):
+        status, body = _rpc(sock, b"\xde\xad\xbe\xef\x04garbage")
+        assert status == 1
+        assert b"bad magic" in _unpack_text(body)
+        # the server closed the desynced stream: a follow-up on a NEW
+        # connection still works
+        from paddlepaddle_tpu.inference.c_api_server import _MAGIC
+
+        status2, _ = _rpc(sock, struct.pack("<IB", _MAGIC, 2))
+        assert status2 == 0

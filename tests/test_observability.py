@@ -93,6 +93,114 @@ def test_prometheus_exposition(clean_obs):
     assert "paddle_y_seconds_count" in text
 
 
+def test_prometheus_text_strict_round_trip(clean_obs):
+    """Acceptance for exposition correctness: a strict parse of
+    to_prometheus_text() over every registered family must see a HELP/TYPE
+    pair, exact label round-trips (incl. escaping), and the histogram
+    invariants — cumulative buckets, +Inf bucket == _count, _sum match."""
+    from paddlepaddle_tpu.observability.metrics import parse_prometheus_text
+
+    reg = Registry()
+    c = reg.counter("paddle_rt_total", "a counter")
+    c.inc(3, op="add")
+    c.inc(2)  # unlabeled series alongside labeled ones
+    # values past %g's 6 significant digits must round-trip exactly
+    c.inc(123_456_789, op="big")
+    g = reg.gauge("paddle_rt_depth", "a gauge")
+    # label escaping: backslash, quote, newline must survive the round trip
+    nasty = 'sl\\ash "quoted"\nline'
+    g.set(7.5, which=nasty)
+    h = reg.histogram("paddle_rt_seconds", "a histogram",
+                      buckets=[0.001, 0.01, 0.1, 1.0])
+    for v in (0.0005, 0.005, 0.005, 0.05, 0.5, 5.0):
+        h.observe(v, op="step")
+
+    fams = parse_prometheus_text(reg.to_prometheus_text())
+    # every registered family appears exactly once, with HELP and TYPE
+    assert set(fams) == {"paddle_rt_total", "paddle_rt_depth",
+                         "paddle_rt_seconds"}
+    for name, fam in fams.items():
+        assert fam["type"] in ("counter", "gauge", "histogram")
+        assert fam["help"], f"{name} lost its HELP text"
+
+    counter_rows = {tuple(sorted(lab.items())): v
+                    for _, lab, v in fams["paddle_rt_total"]["samples"]}
+    assert counter_rows == {(("op", "add"),): 3.0, (): 2.0,
+                            (("op", "big"),): 123_456_789.0}
+
+    (_, lab, v), = fams["paddle_rt_depth"]["samples"]
+    assert lab == {"which": nasty}  # escaping round-tripped exactly
+    assert v == 7.5
+
+    hs = fams["paddle_rt_seconds"]["samples"]
+    buckets = [(lab["le"], v) for n, lab, v in hs
+               if n == "paddle_rt_seconds_bucket"]
+    counts = [v for _, v in buckets]
+    assert counts == sorted(counts), "bucket counts must be cumulative"
+    assert [le for le, _ in buckets][-1] == "+Inf"
+    (count,) = [v for n, _, v in hs if n == "paddle_rt_seconds_count"]
+    (total,) = [v for n, _, v in hs if n == "paddle_rt_seconds_sum"]
+    assert counts[-1] == count == 6  # +Inf bucket equals _count
+    assert total == pytest.approx(0.0005 + 0.005 + 0.005 + 0.05 + 0.5 + 5.0,
+                                  rel=1e-6)
+
+    # strictness: samples without a declared family are an error, as is an
+    # unknown type
+    with pytest.raises(ValueError, match="no declared"):
+        parse_prometheus_text("paddle_orphan_total 1\n")
+    with pytest.raises(ValueError, match="unknown type"):
+        parse_prometheus_text("# HELP x h\n# TYPE x summary\nx 1\n")
+
+
+def test_prometheus_round_trip_every_registered_family(clean_obs):
+    """Drive the REAL hot-path instrumentation, then round-trip the entire
+    global registry — every family the framework registers must satisfy
+    the same invariants (this is what the /metrics endpoint serves)."""
+    from paddlepaddle_tpu.observability.metrics import parse_prometheus_text
+
+    obs.enable(trace=False, metrics=True, watchdog_=False)
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    for _ in range(3):
+        _ = paddle.add(x, x)
+    obs.safe_inc("paddle_rt_fault_total", "fault probe", reason="test")
+    obs.disable()
+
+    reg = obs.get_registry()
+    fams = parse_prometheus_text(obs.to_prometheus_text())
+    assert set(fams) == set(reg.names())
+    assert fams["paddle_op_seconds"]["samples"]  # the driven histogram
+    for name, fam in fams.items():
+        m = reg.get(name)
+        assert fam["type"] == m.kind
+        if m.kind != "histogram":
+            continue
+        # histogram invariants per label set: cumulative buckets ending at
+        # +Inf == _count, and _sum consistent with the live snapshot
+        by_labels = {}
+        for sample_name, lab, v in fam["samples"]:
+            key = tuple(sorted((k, val) for k, val in lab.items()
+                               if k != "le"))
+            row = by_labels.setdefault(key, {"buckets": [], "sum": None,
+                                             "count": None})
+            if sample_name.endswith("_bucket"):
+                row["buckets"].append((lab["le"], v))
+            elif sample_name.endswith("_sum"):
+                row["sum"] = v
+            elif sample_name.endswith("_count"):
+                row["count"] = v
+        if not by_labels:
+            continue  # registered but never observed: exposes nothing
+        for key, row in by_labels.items():
+            counts = [v for _, v in row["buckets"]]
+            assert counts == sorted(counts), (name, key)
+            assert row["buckets"][-1][0] == "+Inf"
+            assert counts[-1] == row["count"], (name, key)
+            snap = m.snapshot()[key]
+            assert row["sum"] == pytest.approx(snap["sum"], rel=1e-6,
+                                               abs=1e-12)
+            assert row["count"] == snap["count"]
+
+
 # ---------------------------------------------------------------------------
 # span recorder
 # ---------------------------------------------------------------------------
@@ -351,6 +459,19 @@ def test_watchdog_quiet_for_stable_signature(clean_obs):
 # ---------------------------------------------------------------------------
 # flags / env plumbing and off-overhead
 # ---------------------------------------------------------------------------
+
+def test_summary_carries_rank_world_header(clean_obs, monkeypatch):
+    """A summary pasted from a multi-host job must say which worker it came
+    from (rank/world from distributed/env.py, host, pid)."""
+    import os
+
+    out = obs.summary()
+    assert "rank 0/1" in out.splitlines()[1]
+    assert f"pid {os.getpid()}" in out
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "3")
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "8")
+    assert "rank 3/8" in obs.summary().splitlines()[1]
+
 
 def test_obs_flags_read_padle_obs_env(monkeypatch):
     from paddlepaddle_tpu.core import flags as flags_mod

@@ -1,12 +1,22 @@
 #!/usr/bin/env python
-"""CI gate: instrumentation-OFF overhead on the eager dispatch hot path.
+"""CI gate: observability overhead on the eager dispatch hot path.
 
-The observability layer's contract is that with ``PADDLE_OBS_*`` unset the
-only cost a dispatched op pays is one module-global read + branch. This
-script measures an N-op microloop through the instrumented entry point
-(``apply_op``) against the uninstrumented inner (``_apply_op``) and FAILS
-(exit 1) if the relative overhead exceeds the budget — so a future change
-that puts real work on the disabled path is caught before it ships.
+Three budgets, all measured as paired rounds over an N-op microloop through
+the instrumented entry point (``apply_op``) vs the uninstrumented inner
+(``_apply_op``), median ratio compared:
+
+1. **off** — with ``PADDLE_OBS_*`` unset the only cost a dispatched op pays
+   is one module-global read + branch (< ``--budget``, default 5%);
+2. **flight recorder on** — ``PADDLE_OBS_BLACKBOX`` armed: the dispatch
+   path carries NO flight seam, and the seams that do record (step
+   boundaries, collectives, faults) sit outside the op loop, so the
+   enabled hot path must also stay under the budget;
+3. **exporter running** — a live (idle) telemetry HTTP server on a daemon
+   thread must not tax the loop either.
+
+A step-bracket microbench is printed for information (the per-step cost of
+the watchdog/flight step seam) but not gated — steps are milliseconds-to-
+seconds; a few microseconds of bracket is noise.
 
 Usage:  JAX_PLATFORMS=cpu python tools/check_obs_overhead.py [--ops 10000]
             [--budget 0.05] [--repeats 5]
@@ -15,24 +25,21 @@ Usage:  JAX_PLATFORMS=cpu python tools/check_obs_overhead.py [--ops 10000]
 import argparse
 import os
 import sys
+import tempfile
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def measure(n_ops: int, repeats: int):
+def _loops(n_ops):
     import numpy as np
 
     import paddlepaddle_tpu as paddle
-    import paddlepaddle_tpu.observability as obs
     from paddlepaddle_tpu.core import dispatch
     import jax.numpy as jnp
 
-    obs.disable()
-    assert dispatch._obs_op is None, "hooks must be OFF for this benchmark"
     x = paddle.to_tensor(np.ones((2, 2), np.float32))
     y = paddle.to_tensor(np.ones((2, 2), np.float32))
-
     apply_op, _apply_op = dispatch.apply_op, dispatch._apply_op
 
     def loop_entry():
@@ -49,12 +56,91 @@ def measure(n_ops: int, repeats: int):
             _apply_op(jnp.add, (x, y), {}, "add", None)
         return time.perf_counter() - t0
 
-    # warm both paths (compile caches, allocator), then time PAIRED rounds:
-    # drift (thermal, noisy neighbors) cancels within a round and the
-    # median discards outlier rounds — same method as the pytest gate
-    loop_entry()
+    return loop_entry, loop_bare
+
+
+def measure(n_ops: int, repeats: int, setup=None, teardown=None):
+    """Paired rounds: drift (thermal, noisy neighbors) cancels within a
+    round and the median discards outlier rounds — same method as the
+    pytest gate. ``setup``/``teardown`` bracket only the ENTRY loop, so
+    the bare loop is always the no-telemetry baseline."""
+    import paddlepaddle_tpu.observability as obs
+    from paddlepaddle_tpu.core import dispatch
+
+    obs.disable()
+    assert dispatch._obs_op is None, "hooks must be OFF for this benchmark"
+    loop_entry, loop_bare = _loops(n_ops)
+    loop_entry()  # warm both paths (compile caches, allocator)
     loop_bare()
-    return [(loop_entry(), loop_bare()) for _ in range(repeats)]
+    rounds = []
+    for _ in range(repeats):
+        if setup is not None:
+            setup()
+        try:
+            a = loop_entry()
+        finally:
+            if teardown is not None:
+                teardown()
+        rounds.append((a, loop_bare()))
+    return rounds
+
+
+def _report(tag, rounds, n_ops, budget):
+    import statistics
+
+    overhead = statistics.median(a / b for a, b in rounds) - 1.0
+    instrumented = min(a for a, _ in rounds)
+    bare = min(b for _, b in rounds)
+    per_op_ns = (instrumented - bare) / n_ops * 1e9
+    print(f"[{tag}] {n_ops}-op microloop: "
+          f"instrumented={instrumented * 1e3:.1f}ms bare={bare * 1e3:.1f}ms "
+          f"median-paired overhead={overhead:+.2%} "
+          f"({per_op_ns:+.0f}ns/op at min), budget {budget:.0%}")
+    if overhead >= budget:
+        print(f"FAIL[{tag}]: overhead {overhead:.2%} >= {budget:.0%} budget",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+def _gate(tag, run_measure, n_ops, budget):
+    """One retry on failure — same policy as the pytest overhead gate: a
+    noise spike on a shared CI box must not fail the build, a real
+    regression fails both rounds."""
+    rc = _report(tag, run_measure(), n_ops, budget)
+    if rc:
+        print(f"[{tag}] over budget; retrying once (noise filter)")
+        rc = _report(tag, run_measure(), n_ops, budget)
+    return rc
+
+
+def _step_bracket_info(n_steps=2000):
+    """Informational: per-step cost of the watchdog step bracket with the
+    flight recorder armed (chaos seam + two flight events per step)."""
+    from paddlepaddle_tpu.distributed.watchdog import Watchdog
+    from paddlepaddle_tpu.observability import flight
+
+    wd = Watchdog(timeout=3600, abort=False)  # monitor not started
+
+    def loop():
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            with wd.step("bench_step"):
+                pass
+        return time.perf_counter() - t0
+
+    loop()
+    off = loop()
+    with tempfile.TemporaryDirectory() as d:
+        flight.enable(d, capacity=4096)
+        try:
+            loop()
+            on = loop()
+        finally:
+            flight.disable()
+    print(f"[info] step bracket: {off / n_steps * 1e6:.2f}us/step off, "
+          f"{on / n_steps * 1e6:.2f}us/step with flight recorder "
+          f"(+{(on - off) / n_steps * 1e6:.2f}us/step)")
 
 
 def main() -> int:
@@ -62,27 +148,49 @@ def main() -> int:
     ap.add_argument("--ops", type=int, default=10_000,
                     help="ops per timed loop (default 10000)")
     ap.add_argument("--budget", type=float, default=0.05,
-                    help="max relative overhead with obs off (default 0.05)")
+                    help="max relative overhead per gate (default 0.05)")
     ap.add_argument("--repeats", type=int, default=7,
                     help="paired rounds; median ratio is compared (default 7)")
     args = ap.parse_args()
 
-    import statistics
+    from paddlepaddle_tpu.observability import exporter, flight
 
-    rounds = measure(args.ops, args.repeats)
-    overhead = statistics.median(a / b for a, b in rounds) - 1.0
-    instrumented = min(a for a, _ in rounds)
-    bare = min(b for _, b in rounds)
-    per_op_ns = (instrumented - bare) / args.ops * 1e9
-    print(f"{args.ops}-op microloop: instrumented={instrumented * 1e3:.1f}ms "
-          f"bare={bare * 1e3:.1f}ms median-paired overhead={overhead:+.2%} "
-          f"({per_op_ns:+.0f}ns/op at min), budget {args.budget:.0%}")
-    if overhead >= args.budget:
-        print(f"FAIL: disabled-instrumentation overhead {overhead:.2%} "
-              f">= {args.budget:.0%} budget", file=sys.stderr)
-        return 1
-    print("OK")
-    return 0
+    rc = 0
+
+    # gate 1: everything off
+    rc |= _gate("off", lambda: measure(args.ops, args.repeats),
+                args.ops, args.budget)
+
+    # gate 2: flight recorder armed (the always-on black box must be
+    # viable on a production hot path)
+    with tempfile.TemporaryDirectory() as d:
+        rc |= _gate(
+            "flight-on",
+            lambda: measure(args.ops, args.repeats,
+                            setup=lambda: flight.enable(d, capacity=4096),
+                            teardown=flight.disable),
+            args.ops, args.budget)
+
+    # gate 3: idle exporter serving on a daemon thread. Started/stopped
+    # around the ENTRY loop only (like gate 2) — running it during both
+    # loops would cancel out of the paired ratio and gate nothing
+    served = {}
+
+    def _start_exporter():
+        served["e"] = exporter.TelemetryExporter(port=0).start()
+
+    def _stop_exporter():
+        served.pop("e").stop()
+
+    rc |= _gate("exporter-idle",
+                lambda: measure(args.ops, args.repeats,
+                                setup=_start_exporter,
+                                teardown=_stop_exporter),
+                args.ops, args.budget)
+
+    _step_bracket_info()
+    print("OK" if rc == 0 else "FAIL", flush=True)
+    return rc
 
 
 if __name__ == "__main__":

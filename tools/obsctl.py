@@ -1,0 +1,274 @@
+#!/usr/bin/env python
+"""obsctl — operator CLI for the fleet telemetry plane.
+
+Subcommands:
+
+  scrape TARGET [--path /metrics]
+      GET one exporter endpoint and print the body. TARGET is host:port or
+      a full URL (e.g. `obsctl scrape 127.0.0.1:9470 --path /healthz`).
+
+  aggregate TARGET [TARGET ...] [-o OUT]
+      Scrape /metrics from several per-rank exporters and print the merged
+      exposition with a rank label per series (rank = each target's
+      /healthz-reported rank, falling back to list position). The HTTP
+      twin of the store-based merge rank 0 serves itself.
+
+  merge-trace -o OUT TRACE [TRACE ...]
+      Merge per-rank chrome-trace JSON files (from /trace or
+      observability.export_chrome_trace) into ONE Perfetto file, one pid
+      per rank (rank = argument position; use --ranks to override).
+
+  blackbox tail [--dir DIR] [-n N] [--raw]
+      Render the newest flight-recorder dump in DIR (default:
+      $PADDLE_OBS_BLACKBOX_DIR or <tmpdir>/paddle_blackbox): header, the
+      last N events, in-flight steps/tasks, and thread-stack summaries.
+
+`scrape` and `blackbox tail` are stdlib-only (fast, safe on a box where
+the framework cannot import); `aggregate`/`merge-trace` import the
+observability package for the strict exposition parser and trace merger.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+import urllib.request
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _url(target: str, path: str) -> str:
+    if target.startswith("http://") or target.startswith("https://"):
+        base = target.rstrip("/")
+    else:
+        base = f"http://{target}"
+    return base + path
+
+
+def _get(target: str, path: str, timeout: float):
+    """(status, body). A 503 /healthz still carries the JSON body we want."""
+    try:
+        with urllib.request.urlopen(_url(target, path), timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def cmd_scrape(args) -> int:
+    try:
+        _status, body = _get(args.target, args.path, args.timeout)
+    except (urllib.error.URLError, OSError) as e:
+        # dead/unreachable exporter is the very thing an operator probes
+        # for — one line, not a traceback
+        sys.stderr.write(f"[obsctl] {args.target}{args.path}: {e}\n")
+        return 1
+    sys.stdout.write(body.decode(errors="replace"))
+    return 0
+
+
+def cmd_aggregate(args) -> int:
+    from paddlepaddle_tpu.observability.aggregate import (
+        merge_prometheus_texts,
+    )
+    from paddlepaddle_tpu.observability.metrics import parse_prometheus_text
+
+    scraped = []  # (reported_rank_or_None, text) per healthy target
+    for target in args.targets:
+        try:
+            status, body = _get(target, "/metrics", args.timeout)
+            if status != 200:
+                raise RuntimeError(f"HTTP {status}")
+            text = body.decode()
+            parse_prometheus_text(text)  # pre-validate: one sick target
+        except Exception as e:             # must not sink the whole merge
+            sys.stderr.write(f"[obsctl] {target}: scrape failed ({e}); "
+                             f"skipping\n")
+            continue
+        rank = None
+        try:
+            status, body = _get(target, "/healthz", args.timeout)
+            rank = int(json.loads(body).get("rank"))
+        except Exception:
+            pass  # no usable /healthz — fall back to list position
+        scraped.append((rank, text))
+    if not scraped:
+        sys.stderr.write("[obsctl] nothing scraped\n")
+        return 1
+    ranks = [r for r, _ in scraped if r is not None]
+    if len(set(ranks)) == len(scraped):
+        texts = {r: t for r, t in scraped}
+    else:
+        # colliding/missing self-reported ranks (e.g. standalone serving
+        # hosts all claiming rank 0): label by list position instead of
+        # silently dropping all but the last target
+        sys.stderr.write("[obsctl] duplicate or missing self-reported "
+                         "ranks; labeling targets by list position\n")
+        texts = {pos: t for pos, (_, t) in enumerate(scraped)}
+    merged = merge_prometheus_texts(texts)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(merged)
+        print(f"[obsctl] merged {len(texts)} rank(s) -> {args.out}")
+    else:
+        sys.stdout.write(merged)
+    return 0
+
+
+def cmd_merge_trace(args) -> int:
+    from paddlepaddle_tpu.observability.aggregate import merge_chrome_traces
+
+    ranks = ([int(r) for r in args.ranks.split(",")] if args.ranks
+             else list(range(len(args.traces))))
+    if len(ranks) != len(args.traces):
+        sys.stderr.write("[obsctl] --ranks count must match trace count\n")
+        return 2
+    docs = {}
+    for rank, path in zip(ranks, args.traces):
+        with open(path) as f:
+            docs[rank] = json.load(f)
+    merged = merge_chrome_traces(docs)
+    with open(args.out, "w") as f:
+        json.dump(merged, f)
+    print(f"[obsctl] merged {len(docs)} trace(s), "
+          f"{len(merged['traceEvents'])} events -> {args.out} "
+          f"(open in https://ui.perfetto.dev)")
+    return 0
+
+
+# -- blackbox ----------------------------------------------------------------
+
+def _blackbox_dir(explicit: str) -> str:
+    if explicit:
+        return explicit
+    env = os.environ.get("PADDLE_OBS_BLACKBOX_DIR", "").strip()
+    if env:
+        return env
+    return os.path.join(tempfile.gettempdir(), "paddle_blackbox")
+
+
+def _fmt_ts(wall: float) -> str:
+    return time.strftime("%H:%M:%S", time.localtime(wall)) \
+        + f".{int((wall % 1) * 1000):03d}"
+
+
+def _render_blackbox(path: str, last_n: int) -> None:
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                records.append(json.loads(line))
+    by_rec = {}
+    events = []
+    for r in records:
+        if r.get("rec") == "event":
+            events.append(r)
+        else:
+            by_rec.setdefault(r.get("rec"), []).append(r)
+    head = (by_rec.get("header") or [{}])[0]
+    print(f"[blackbox] {path}")
+    print(f"  reason={head.get('reason')} rank={head.get('rank')}/"
+          f"{head.get('world')} host={head.get('host')} "
+          f"pid={head.get('pid')} uptime={head.get('uptime_s')}s "
+          f"events={head.get('buffered_events')}")
+    for exc in by_rec.get("exception", []):
+        print(f"  exception: {exc.get('type')}: {exc.get('value')}")
+    shown = events[-last_n:]
+    if len(events) > len(shown):
+        print(f"  ... {len(events) - len(shown)} earlier events")
+    for ev in shown:
+        data = ev.get("data") or {}
+        extra = " ".join(f"{k}={v}" for k, v in data.items())
+        print(f"  #{ev.get('seq'):<6} {_fmt_ts(ev.get('wall', 0))} "
+              f"{ev.get('kind'):<18} {ev.get('name')} {extra}".rstrip())
+    for st in by_rec.get("in_flight_step", []):
+        data = st.get("data") or {}
+        print(f"  IN-FLIGHT STEP: {st.get('name')} "
+              f"ordinal={data.get('ordinal')} "
+              f"began {st.get('began_s_before_dump')}s before dump")
+    for infl in by_rec.get("in_flight", []):
+        for t in infl.get("tasks", []):
+            print(f"  in-flight task: {t.get('name')} "
+                  f"group={t.get('group')} {t.get('elapsed_s')}s")
+    for stacks in by_rec.get("stacks", []):
+        threads = stacks.get("threads", [])
+        names = ", ".join(t.get("name", "?") for t in threads)
+        print(f"  stacks: {len(threads)} thread(s): {names}")
+        for t in threads:
+            frames = t.get("frames", [])
+            tail = frames[-2:] if len(frames) >= 2 else frames
+            print(f"    -- {t.get('name')} (tid {t.get('tid')}):")
+            for fr in tail:
+                for ln in fr.splitlines():
+                    print(f"       {ln}")
+
+
+def cmd_blackbox(args) -> int:
+    if args.action != "tail":
+        sys.stderr.write(f"[obsctl] unknown blackbox action {args.action!r} "
+                         f"(expected: tail)\n")
+        return 2
+    d = _blackbox_dir(args.dir)
+    if not os.path.isdir(d):
+        sys.stderr.write(f"[obsctl] no black-box directory at {d}\n")
+        return 1
+    files = [os.path.join(d, f) for f in os.listdir(d)
+             if f.startswith("blackbox-") and f.endswith(".jsonl")]
+    if not files:
+        sys.stderr.write(f"[obsctl] no black-box dumps in {d}\n")
+        return 1
+    newest = max(files, key=os.path.getmtime)
+    if args.raw:
+        with open(newest) as f:
+            sys.stdout.write(f.read())
+        return 0
+    _render_blackbox(newest, args.last)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="obsctl", description=__doc__.split("\n")[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("scrape", help="GET one exporter endpoint")
+    p.add_argument("target", help="host:port or URL of a per-rank exporter")
+    p.add_argument("--path", default="/metrics")
+    p.add_argument("--timeout", type=float, default=5.0)
+    p.set_defaults(fn=cmd_scrape)
+
+    p = sub.add_parser("aggregate",
+                       help="merge /metrics from several exporters")
+    p.add_argument("targets", nargs="+")
+    p.add_argument("-o", "--out", default="")
+    p.add_argument("--timeout", type=float, default=5.0)
+    p.set_defaults(fn=cmd_aggregate)
+
+    p = sub.add_parser("merge-trace",
+                       help="merge per-rank chrome traces into one file")
+    p.add_argument("traces", nargs="+")
+    p.add_argument("-o", "--out", required=True)
+    p.add_argument("--ranks", default="",
+                   help="comma-separated rank per trace (default: position)")
+    p.set_defaults(fn=cmd_merge_trace)
+
+    p = sub.add_parser("blackbox", help="read flight-recorder dumps")
+    p.add_argument("action", help="tail = render the newest dump")
+    p.add_argument("--dir", default="")
+    p.add_argument("-n", "--last", type=int, default=40,
+                   help="events to show (default 40)")
+    p.add_argument("--raw", action="store_true",
+                   help="print the JSONL verbatim")
+    p.set_defaults(fn=cmd_blackbox)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

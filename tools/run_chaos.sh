@@ -10,6 +10,11 @@
 #   * serving: serving.admit / serving.decode seams — fault storm opens the
 #     circuit breaker, half-open probe recovers the engine without restart
 #     (test_serving_robustness.py)
+#   * black box: PADDLE_CHAOS_POINTS=step:kill:@4 under PADDLE_OBS_BLACKBOX
+#     kills a launched worker mid-step; the flight recorder's JSONL dump
+#     must carry the in-flight step event + all-thread stacks, and
+#     `tools/obsctl.py blackbox tail` must render it
+#     (test_fleet_telemetry.py::test_chaos_kill_leaves_blackbox_*)
 #
 # Usage: tools/run_chaos.sh [extra pytest args...]
 set -euo pipefail

@@ -4,6 +4,13 @@
 #
 # Counts pass dots from the pytest progress line so a partial hang still
 # reports how far it got; exits with pytest's own status.
+#
+# Suites of note: tests/test_fleet_telemetry.py (exporter endpoints, fleet
+# metric/trace merge, flight recorder, obsctl) runs its fast half here; its
+# `slow`-marked end-to-end drills (2-worker launch -> rank-0 merged
+# /metrics + Perfetto trace; chaos-kill -> black box) run under
+# tools/run_chaos.sh / -m slow. tools/check_obs_overhead.py gates the
+# off/flight-on/exporter-idle hot-path budgets separately.
 set -o pipefail
 cd "$(dirname "$0")/.."
 rm -f /tmp/_t1.log
