@@ -63,7 +63,8 @@ class BatchDecodeEngine:
     and ``.functional_state()``)."""
 
     def __init__(self, model, max_slots: int = 16, max_len: Optional[int] = None,
-                 chunk: int = 16):
+                 chunk: int = 16, quant: Optional[str] = None,
+                 quant_group_size: int = -1):
         cfg = model.config
         self.model = model
         self.cfg = cfg
@@ -71,6 +72,25 @@ class BatchDecodeEngine:
         self.L = int(max_len or cfg.max_position_embeddings)
         self.chunk = int(chunk)
         self.params = model.functional_state()
+        # weight-only quantization: params quantized ONCE here; every
+        # compiled program after this point (admission prefill + the
+        # scan-decode body) reads int8 weight buffers through the
+        # QuantizedWeight pytree leaves — cache layout, donation
+        # (caches only) and bucketed shapes are untouched. Single-chip
+        # decode is HBM-bandwidth-bound, so halving weight bytes read per
+        # step is the serving perf lever (tools/quant_ab.py measures it).
+        self.quant = quant
+        self.quant_meta: Dict[str, object] = {}
+        if quant is not None:
+            if quant != "weight_only_int8":
+                raise ValueError(
+                    f"quant={quant!r}: 'weight_only_int8' is the supported "
+                    "decode-engine scheme (int4/PTQ honestly absent — "
+                    "PARITY.md)")
+            from ..nn.quant import quantize_param_tree
+
+            self.params, self.quant_meta = quantize_param_tree(
+                self.params, algo=quant, group_size=quant_group_size)
         kvh, hd = cfg.num_key_value_heads, cfg.head_dim
         dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
         self.caches = [(jnp.zeros((self.S, self.L, kvh, hd), dtype),

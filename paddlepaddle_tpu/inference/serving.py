@@ -201,9 +201,16 @@ class ServingEngine:
                  breaker_reset_s: Optional[float] = None,
                  decode_timeout_s: Optional[float] = None,
                  drain_timeout_s: Optional[float] = None,
-                 drain_on_sigterm: bool = False):
+                 drain_on_sigterm: bool = False,
+                 quant: Optional[str] = None,
+                 quant_group_size: int = -1):
         if mode not in ("continuous", "static"):
             raise ValueError(f"mode must be 'continuous' or 'static', got {mode!r}")
+        if quant is not None and mode != "continuous":
+            raise ValueError(
+                "quant mode requires the continuous engine (static mode "
+                "decodes through the model's own generate_cached, whose "
+                "bound params are full precision)")
         self.model = model
         self.mode = mode
         self.max_batch_size = max_batch_size
@@ -247,14 +254,18 @@ class ServingEngine:
         self._limits_armed = (self.max_queue is not None
                               or self.max_queue_wait_s is not None)
         self._engine = None
+        self.quant = quant
         if mode == "continuous":
             from .decode_engine import BatchDecodeEngine
 
             self._engine = BatchDecodeEngine(
                 model, max_slots=max_batch_size, max_len=max_len,
-                chunk=decode_chunk)
+                chunk=decode_chunk, quant=quant,
+                quant_group_size=quant_group_size)
             self._max_len = self._engine.L
             self._top_k_cap = self._engine.TOP_K_CAP
+            if quant is not None:
+                self._announce_quant(self._engine.quant_meta)
         else:
             self._max_len = max_len or getattr(
                 getattr(model, "config", None), "max_position_embeddings",
@@ -264,6 +275,36 @@ class ServingEngine:
     def _bump(self, key, n=1):
         with self._stats_lock:
             self.stats[key] += n
+
+    def _announce_quant(self, meta: Dict[str, object]) -> None:
+        """One-time (construction, cold path) observability for quant mode:
+        paddle_serving_quant_* metrics, the flight-recorder header
+        annotation, and a stderr line. With quant off NONE of this runs —
+        the off path stays zero-overhead (check_serving_overhead.py)."""
+        _safe_set("paddle_serving_quant_enabled",
+                  "serving weight-only quantization armed (1 = on)", 1,
+                  mode=self.quant)
+        _safe_set("paddle_serving_quant_weights",
+                  "matmul weights quantized by the serving engine",
+                  len(meta.get("quantized", ())))
+        _safe_set("paddle_serving_quant_bytes_saved",
+                  "HBM weight bytes a decode step no longer reads",
+                  meta.get("bytes_saved", 0))
+        try:
+            from ..observability import flight
+
+            flight.annotate("serving_quant", {
+                "mode": self.quant,
+                "group_size": meta.get("group_size", -1),
+                "weights": len(meta.get("quantized", ())),
+                "bytes_saved": meta.get("bytes_saved", 0)})
+        except Exception:
+            pass
+        sys.stderr.write(
+            f"[serving] weight-only quant armed: {self.quant}, "
+            f"{len(meta.get('quantized', ()))} weights, "
+            f"{meta.get('bytes_saved', 0) / 1e6:.1f} MB HBM reads saved "
+            "per full weight pass\n")
 
     # -- admission control ---------------------------------------------------
     def _on_breaker_transition(self, old: str, new: str) -> None:
@@ -410,6 +451,7 @@ class ServingEngine:
         return {
             "state": state,
             "mode": self.mode,
+            "quant": self.quant or "off",
             "ok": alive and not self._draining.is_set()
                   and breaker != "open",
             "queue_depth": self._queue_depth(),

@@ -181,10 +181,17 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
 
 
 def linear(x, weight, bias=None, name=None):
-    """y = x @ W (+ b); W layout [in, out] (paddle convention)."""
+    """y = x @ W (+ b); W layout [in, out] (paddle convention).
+
+    Weight-only int8 serving path: when the bound weight payload is a
+    ``nn.quant.QuantizedWeight`` (the decode engine binds these —
+    ``quantize_param_tree``), the matmul lowers through its ``wo_matmul``:
+    int8 buffer resident, scale multiply hoisted past the dot. Duck-typed so
+    the float hot path pays one getattr miss, no import."""
 
     def f(a, w, b):
-        out = jnp.matmul(a, w)
+        wo = getattr(w, "wo_matmul", None)
+        out = jnp.matmul(a, w) if wo is None else wo(a).astype(a.dtype)
         if b is not None:
             out = out + b
         return out
@@ -244,14 +251,16 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=No
         return jnp.where(keep, a, 0.0).astype(a.dtype)
 
     from ..core.dispatch import _static_capture
-    from ..static.program import is_static_var, static_rng_key
+    from ..static.program import is_static_var, next_op_salt, static_rng_key
 
     if _static_capture and (is_static_var(x)):
         # static build: the key is a per-RUN feed (run_program refreshes
-        # it), folded with a per-op salt — a build-time key closure would
-        # bake ONE mask into the compiled program for every step
+        # it), folded with a per-CAPTURE salt — a build-time key closure
+        # would bake ONE mask into the compiled program for every step, and
+        # an id(x)-derived salt made two dropouts off the same activation
+        # produce byte-identical masks (correlated branches)
         kv = static_rng_key()
-        salt = id(x) & 0x7FFFFFFF
+        salt = next_op_salt()
 
         def f2(a, k):
             return _apply(a, jax.random.fold_in(k, salt))

@@ -1,35 +1,68 @@
-"""paddle.nn.quant namespace (reference: python/paddle/nn/quant/): the
-quantization layers/observers live in the quantization package here."""
+"""paddle.nn.quant — the LLM quantization surface.
 
-from ...quantization import PTQ, QAT, QuantConfig  # noqa: F401
+Reference: python/paddle/nn/quant/__init__.py. ``__all__`` closes the
+reference export list: the weight-only int8 serving ops
+(``quantized_linear.py``), the ``Stub``/``QuantStub`` markers, the
+functional layers, and the convertible-layer protocol. The quanter/observer
+FACTORY machinery lives at its reference path,
+:mod:`paddlepaddle_tpu.quantization` (``quanter``, ``BaseQuanter``,
+``observers/``, ``quanters/``), re-exported here for convenience.
 
+Serving integration (beyond the reference, see docs/quantization.md):
+:func:`quantize_param_tree` + :class:`~.qweight.QuantizedWeight` are what
+``inference.decode_engine.BatchDecodeEngine(quant="weight_only_int8")``
+uses to read int8 weights in prefill and the scan-decode body.
+"""
 
-def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
-    """Reference: nn/quant/quantized_linear.py weight_quantize — symmetric
-    per-channel int8 weight quantization returning (quantized, scales)."""
-    import jax.numpy as jnp
+from ...quantization import (  # noqa: F401  (back-compat re-exports)
+    PTQ,
+    QAT,
+    BaseQuanter,
+    QuantConfig,
+    quanter,
+)
+from .format import ConvertibleQuantedLayer, LinearQuanterDequanter  # noqa: F401
+from .functional_layers import (  # noqa: F401
+    FloatFunctionalLayer,
+    add,
+    concat,
+    divide,
+    flatten,
+    matmul,
+    multiply,
+    reshape,
+    subtract,
+    transpose,
+)
+from .quant_layers import QuantStub  # noqa: F401
+from .quantized_linear import (  # noqa: F401
+    WeightOnlyLinear,
+    llm_int8_linear,
+    quantize_param_tree,
+    weight_dequantize,
+    weight_only_linear,
+    weight_quantize,
+)
+from .qweight import QuantizedWeight  # noqa: F401
+from .stub import Stub  # noqa: F401
 
-    from ...core.dispatch import apply_op
-
-    if algo not in ("weight_only_int8", "llm.int8"):
-        raise NotImplementedError(f"weight_quantize algo {algo!r}: int8 "
-                                  "per-channel is the supported scheme")
-
-    def f(w):
-        scale = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0) / 127.0
-        safe = jnp.maximum(scale, 1e-10)   # all-zero channel: quantize to 0,
-        q = jnp.clip(jnp.round(w.astype(jnp.float32) / safe), -127, 127)
-        return q.astype(jnp.int8), scale   # not NaN (0/0)
-
-    return apply_op(f, x, op_name="weight_quantize")
-
-
-def weight_dequantize(x, scale, algo="weight_only_int8", out_dtype="float32"):
-    import jax.numpy as jnp
-
-    from ...core.dispatch import apply_op
-
-    def f(q, s):
-        return (q.astype(jnp.float32) * s).astype(out_dtype)
-
-    return apply_op(f, x, scale, op_name="weight_dequantize")
+# the reference export list (python/paddle/nn/quant/__init__.py __all__)
+__all__ = [
+    "Stub",
+    "FloatFunctionalLayer",
+    "add",
+    "subtract",
+    "multiply",
+    "divide",
+    "reshape",
+    "transpose",
+    "concat",
+    "flatten",
+    "matmul",
+    "QuantStub",
+    "ConvertibleQuantedLayer",
+    "weight_only_linear",
+    "llm_int8_linear",
+    "weight_quantize",
+    "weight_dequantize",
+]

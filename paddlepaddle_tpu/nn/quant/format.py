@@ -1,0 +1,99 @@
+"""Reference: python/paddle/nn/quant/format.py — the convert protocol a
+quantized training layer implements so export passes can swap it for its
+inference form (``ConvertibleQuantedLayer``)."""
+
+from __future__ import annotations
+
+import abc
+
+import numpy as np
+
+from ..layer import Layer
+
+
+class LinearQuanterDequanter(Layer):
+    """Quant→dequant pair baked from a trained quanter (reference
+    format.LinearQuanterDequanter): at inference the pair is a static
+    fake-quant with the learned scale — XLA folds it into neighbours.
+    ``scale``: scalar (per-tensor) or array (per-channel, broadcastable
+    against the input)."""
+
+    def __init__(self, scale, quant_bits: int = 8):
+        super().__init__()
+        self.scale = np.maximum(np.asarray(scale, np.float32), 1e-9)
+        self.quant_bits = int(quant_bits)
+
+    def forward(self, x):
+        import jax.numpy as jnp
+
+        from ...core.dispatch import apply_op
+
+        qmax = 2.0 ** (self.quant_bits - 1) - 1
+        s = jnp.asarray(self.scale)
+
+        def f(a):
+            return (jnp.clip(jnp.round(a / s * qmax), -qmax, qmax)
+                    * (s / qmax)).astype(a.dtype)
+
+        return apply_op(f, x, op_name="quant_dequant")
+
+
+def _quanter_scale(quanter):
+    """A quanter's learned scale: the BaseQuanter ``scales()`` contract
+    first (FakeQuanterChannelWiseAbsMax stores per-channel state there),
+    the round-5 ``scale`` buffer second. None = nothing learned yet."""
+    scales = getattr(quanter, "scales", None)
+    val = None
+    if callable(scales):
+        try:
+            val = scales()
+        except Exception:
+            val = None
+    if val is None:
+        val = getattr(quanter, "scale", None)
+    if val is None:
+        return None
+    return np.asarray(val.numpy() if hasattr(val, "numpy") else val,
+                      np.float32)
+
+
+class ConvertibleQuantedLayer(Layer, metaclass=abc.ABCMeta):
+    """A quantized-for-training layer that knows how to convert itself to
+    inference form (reference format.ConvertibleQuantedLayer contract)."""
+
+    def __init__(self):
+        super().__init__()
+        self.converted = False
+
+    @abc.abstractmethod
+    def weights_to_quanters(self):
+        """[(weight_attr_name, quanter_attr_name)] pairs to bake."""
+
+    @abc.abstractmethod
+    def activation_quanters(self):
+        """Names of activation quanter sublayers to bake."""
+
+    def _bake(self, q_name: str) -> None:
+        quanter = getattr(self, q_name, None)
+        if quanter is None:
+            return
+        scale = _quanter_scale(quanter)
+        if scale is None:
+            return      # nothing calibrated: keep the live quanter
+        bits = getattr(quanter, "quant_bits", None)
+        if bits is None and callable(getattr(quanter, "bit_length", None)):
+            bits = quanter.bit_length()
+        setattr(self, q_name,
+                LinearQuanterDequanter(scale, quant_bits=int(bits or 8)))
+
+    def convert(self):
+        """Bake each trained weight AND activation quanter into a static
+        quant→dequant (idempotent)."""
+        if self.converted:
+            return self
+        for _w_name, q_name in self.weights_to_quanters():
+            self._bake(q_name)
+        for q_name in self.activation_quanters():
+            self._bake(q_name)
+        self.converted = True
+        return self

@@ -1,0 +1,77 @@
+"""Reference: python/paddle/nn/quant/functional_layers.py — layer-wrapped
+tensor arithmetic (``add``/``matmul``/``reshape``…). The reference needs
+these so graph passes can find-and-quantize functional call sites; here they
+are thin Layer wrappers over the same eager ops, kept for API parity (a
+quant config can target them like any other layer type)."""
+
+from __future__ import annotations
+
+from .. import functional  # noqa: F401  (parity: reference imports it too)
+from ...core.dispatch import apply_op
+from ..layer import Layer
+
+__all__ = [
+    "FloatFunctionalLayer", "add", "subtract", "multiply", "divide",
+    "reshape", "transpose", "concat", "flatten", "matmul",
+]
+
+
+class FloatFunctionalLayer(Layer):
+    """Base for the functional wrappers (reference class of the same name)."""
+
+    def __init__(self):
+        super().__init__()
+
+
+def _binary(name, jfn):
+    class _Op(FloatFunctionalLayer):
+        def forward(self, x, y, _jfn=jfn):
+            return apply_op(_jfn, x, y, op_name=name)
+
+    _Op.__name__ = name
+    return _Op
+
+
+def _import_jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+_jnp = _import_jnp()
+
+add = _binary("add", lambda a, b: a + b)
+subtract = _binary("subtract", lambda a, b: a - b)
+multiply = _binary("multiply", lambda a, b: a * b)
+divide = _binary("divide", lambda a, b: a / b)
+matmul = _binary("matmul", _jnp.matmul)
+
+
+class reshape(FloatFunctionalLayer):
+    def forward(self, x, shape):
+        return apply_op(lambda a: _jnp.reshape(a, shape), x,
+                        op_name="reshape")
+
+
+class transpose(FloatFunctionalLayer):
+    def forward(self, x, perm=None):
+        return apply_op(lambda a: _jnp.transpose(a, perm), x,
+                        op_name="transpose")
+
+
+class concat(FloatFunctionalLayer):
+    def forward(self, x, axis=0):
+        return apply_op(lambda *parts: _jnp.concatenate(parts, axis=axis),
+                        *x, op_name="concat")
+
+
+class flatten(FloatFunctionalLayer):
+    def forward(self, x, start_axis=0, stop_axis=-1):
+        def f(a):
+            nd = a.ndim
+            lo = start_axis % nd
+            hi = stop_axis % nd
+            shape = (a.shape[:lo] + (-1,) + a.shape[hi + 1:])
+            return _jnp.reshape(a, shape)
+
+        return apply_op(f, x, op_name="flatten")

@@ -1,0 +1,30 @@
+"""Reference: python/paddle/nn/quant/quant_layers.py — the fake-quant
+layers QAT wires into a model. The TPU-native fake-quant core (simulated
+int8 in f32/bf16 compute with an STE gradient, fused by XLA into the
+surrounding ops) lives in :mod:`paddlepaddle_tpu.quantization`; this module
+keeps the reference import path and adds ``QuantStub``."""
+
+from __future__ import annotations
+
+from ...quantization import FakeQuanterWithAbsMax
+from ..layer import Layer
+
+__all__ = ["QuantStub", "FakeQuantAbsMax"]
+
+# reference name for the absmax fake quanter layer
+FakeQuantAbsMax = FakeQuanterWithAbsMax
+
+
+class QuantStub(Layer):
+    """Input quantization stub (reference quant_layers.QuantStub): fake-
+    quantizes whatever flows through it with a moving-absmax scale — the
+    live form :class:`~.stub.Stub` converts into under QAT."""
+
+    def __init__(self, quant_bits: int = 8, moving_rate: float = 0.9,
+                 name=None):
+        super().__init__()
+        self._quanter = FakeQuanterWithAbsMax(quant_bits=quant_bits,
+                                              moving_rate=moving_rate)
+
+    def forward(self, x):
+        return self._quanter(x)

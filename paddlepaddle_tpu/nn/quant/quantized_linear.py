@@ -1,0 +1,338 @@
+"""Weight-only int8 linear algebra for LLM serving.
+
+Reference surface: python/paddle/nn/quant/quantized_linear.py —
+``weight_quantize`` / ``weight_dequantize`` / ``weight_only_linear`` /
+``llm_int8_linear``. The reference lowers these to hand-written CUTLASS
+kernels; here the lowering is the :class:`~.qweight.QuantizedWeight`
+formulation (int8 buffer resident, scale multiply hoisted past the dot,
+XLA fuses the s8→bf16 convert into the matmul) — see qweight.py for the
+bandwidth argument, tools/quant_ab.py for the measured A/B.
+
+Layouts (paddle convention, matching ``nn.Linear``): weight ``[in, out]``;
+per-channel scales ``[out]`` (``group_size == -1``) or group-wise scales
+``[in // group_size, out]`` (reference supports 64 / 128; any positive
+divisor of ``in`` is accepted here).
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import apply_op, unwrap
+from ...core.tensor import Tensor
+from ..layer import Layer
+from .qweight import QuantizedWeight
+
+_ALGOS = ("weight_only_int8", "llm.int8")
+
+
+def _check_algo(algo: str) -> None:
+    if algo not in _ALGOS:
+        raise NotImplementedError(
+            f"weight quantize algo {algo!r}: int8 weight-only schemes "
+            f"{_ALGOS} are supported (weight_only_int4 / PTQ calibration "
+            "are honestly absent — PARITY.md)")
+
+
+def _check_group(k: int, group_size: int) -> None:
+    if group_size == -1:
+        return
+    if group_size <= 0 or k % group_size != 0:
+        raise ValueError(
+            f"group_size {group_size} must be -1 (per-channel) or a "
+            f"positive divisor of in_features {k} (reference uses 64/128)")
+
+
+def _quantize_array(w, group_size: int = -1):
+    """Symmetric int8 quantization of ``w [in, out]``. Returns
+    ``(q int8, scale f32)`` with scale [out] or [in//g, out]."""
+    wf = w.astype(jnp.float32)
+    if group_size == -1:
+        absmax = jnp.max(jnp.abs(wf), axis=0)               # [out]
+    else:
+        k, n = wf.shape
+        absmax = jnp.max(jnp.abs(wf.reshape(k // group_size, group_size, n)),
+                         axis=1)                            # [G, out]
+    scale = absmax / 127.0
+    safe = jnp.maximum(scale, 1e-10)    # all-zero channel: quantize to 0,
+    if group_size == -1:                # not NaN (0/0)
+        q = jnp.clip(jnp.round(wf / safe), -127, 127)
+    else:
+        k, n = wf.shape
+        wg = wf.reshape(k // group_size, group_size, n)
+        q = jnp.clip(jnp.round(wg / safe[:, None, :]), -127, 127
+                     ).reshape(k, n)
+    return q.astype(jnp.int8), scale
+
+
+def weight_quantize(x, algo: str = "weight_only_int8", arch=None,
+                    group_size: int = -1):
+    """Reference: nn/quant/quantized_linear.py ``weight_quantize`` —
+    symmetric int8 weight quantization returning ``(quantized, scales)``.
+
+    ``group_size == -1``: per-output-channel scales ``[out]``; else
+    group-wise over the in dim: scales ``[in // group_size, out]``."""
+    _check_algo(algo)
+    arr = unwrap(x)
+    if arr.ndim != 2:
+        raise ValueError(
+            f"weight_quantize expects a 2-D matmul weight [in, out], got "
+            f"shape {tuple(arr.shape)}")
+    _check_group(arr.shape[0], group_size)
+    return apply_op(lambda w: _quantize_array(w, group_size), x,
+                    op_name="weight_quantize")
+
+
+def weight_dequantize(x, scale, algo: str = "weight_only_int8",
+                      out_dtype="float32", group_size: int = -1):
+    """Inverse of :func:`weight_quantize` (debug / export — serving never
+    materializes the dequantized weight)."""
+    _check_algo(algo)
+
+    def f(q, s):
+        return QuantizedWeight(q, s, group_size=group_size,
+                               out_dtype=jnp.dtype(out_dtype)).dequantize()
+
+    return apply_op(f, x, scale, op_name="weight_dequantize")
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype: str = "int8", arch=None,
+                       group_size: int = -1):
+    """Reference: ``weight_only_linear`` — ``y = x @ dequant(W) (+ b)``
+    lowered so the int8 buffer is the only weight-sized HBM read.
+
+    ``weight`` is either a :class:`QuantizedWeight` payload (scales inside)
+    or the raw int8 tensor from :func:`weight_quantize` with
+    ``weight_scale`` passed alongside."""
+    if weight_dtype != "int8":
+        raise NotImplementedError(
+            f"weight_dtype {weight_dtype!r}: int8 is the supported scheme "
+            "(int4 honestly absent — PARITY.md)")
+    wq = weight._data if isinstance(weight, Tensor) else weight
+    if isinstance(wq, QuantizedWeight):
+        qw = wq
+        if weight_scale is not None:
+            raise ValueError("weight is already a QuantizedWeight carrying "
+                             "its scales; don't pass weight_scale too")
+    else:
+        if weight_scale is None:
+            raise ValueError(
+                "weight_only_linear needs weight_scale when weight is a raw "
+                "int8 tensor (use weight_quantize to produce both)")
+        q_arr = unwrap(weight)
+        s_arr = unwrap(weight_scale)
+        k = np.asarray(q_arr).shape[0] if not hasattr(q_arr, "shape") \
+            else q_arr.shape[0]
+        _check_group(k, group_size)
+        # the scale SHAPE must agree with the scheme: a [G, out] group-wise
+        # scale under the default group_size=-1 would broadcast against the
+        # matmul output and return silently-wrong values
+        s_ndim = getattr(s_arr, "ndim", np.asarray(s_arr).ndim)
+        want = 1 if group_size == -1 else 2
+        if s_ndim != want:
+            raise ValueError(
+                f"weight_scale is {s_ndim}-D but group_size={group_size} "
+                f"implies {'per-channel [out]' if want == 1 else 'group-wise [in//group_size, out]'} "
+                "scales — pass the group_size the weight was quantized with")
+        if group_size != -1 and s_arr.shape[0] != k // group_size:
+            raise ValueError(
+                f"group-wise weight_scale has {s_arr.shape[0]} groups but "
+                f"in_features {k} / group_size {group_size} = "
+                f"{k // group_size}")
+        qw = QuantizedWeight(q_arr, s_arr, group_size=group_size)
+
+    def f(a, q, s, b):
+        w = QuantizedWeight(q, s, group_size=qw.group_size,
+                            out_dtype=qw.out_dtype)
+        out = w.wo_matmul(a)
+        if b is not None:
+            out = out + b
+        return out.astype(a.dtype)
+
+    return apply_op(f, x, qw.q, qw.scale, bias, op_name="weight_only_linear")
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None,
+                    threshold: float = 6.0):
+    """Reference: ``llm_int8_linear`` — LLM.int8() (Dettmers et al., 2022)
+    mixed-precision decomposition:
+
+    * activation feature columns whose absmax exceeds ``threshold`` are the
+      OUTLIERS: they stay full precision and multiply the (per-channel)
+      dequantized weight rows;
+    * the rest is dynamically quantized per-token (row absmax / 127) and
+      contracted int8 × int8 with int32 accumulation, then dequantized by
+      ``row_scale × weight_scale``.
+
+    Static-shape formulation (TPU: no data-dependent shapes): both paths
+    run over masked copies of ``x`` instead of gathered outlier columns.
+    ``weight``: int8 [in, out] (or a per-channel QuantizedWeight);
+    ``weight_scale``: [out]."""
+    wq = weight._data if isinstance(weight, Tensor) else weight
+    if isinstance(wq, QuantizedWeight):
+        if wq.group_size != -1:
+            raise ValueError("llm_int8_linear takes per-channel scales "
+                             "(group_size=-1); group-wise is weight_only")
+        q_in, s_in = wq.q, wq.scale
+    else:
+        if weight_scale is None:
+            raise ValueError("llm_int8_linear needs weight_scale when "
+                             "weight is a raw int8 tensor")
+        q_in, s_in = unwrap(weight), unwrap(weight_scale)
+
+    def f(a, q, s, b):
+        af = a.astype(jnp.float32)
+        # outlier feature columns, judged over every token in the batch
+        colmax = jnp.max(jnp.abs(af), axis=tuple(range(af.ndim - 1)))
+        outlier = colmax > threshold                           # [in]
+        a_in = jnp.where(outlier, 0.0, af)
+        a_out = jnp.where(outlier, af, 0.0)
+        # per-token dynamic quantization of the inlier block
+        row_scale = jnp.max(jnp.abs(a_in), axis=-1, keepdims=True) / 127.0
+        row_safe = jnp.maximum(row_scale, 1e-10)
+        aq = jnp.clip(jnp.round(a_in / row_safe), -127, 127).astype(jnp.int8)
+        acc = jnp.einsum("...k,kn->...n", aq, q,
+                         preferred_element_type=jnp.int32)
+        y = acc.astype(jnp.float32) * row_scale * s[None, :]
+        # fp16-path outliers against the dequantized weight rows (masked x is
+        # zero everywhere else, so only outlier rows contribute)
+        y = y + jnp.matmul(a_out, q.astype(jnp.float32) * s[None, :])
+        if b is not None:
+            y = y + b
+        return y.astype(a.dtype)
+
+    return apply_op(f, x, q_in, s_in, bias, op_name="llm_int8_linear")
+
+
+# ---------------------------------------------------------------------------
+# serving integration: quantize a functional-state pytree once
+# ---------------------------------------------------------------------------
+
+# matmul weights worth quantizing: 2-D floating ".weight" params that are
+# NOT token embeddings (a gather, not a matmul) or rope tables
+_DEFAULT_SKIP = re.compile(r"embed_tokens|rope_|position_embedding")
+
+
+def quantize_param_tree(params: dict, algo: str = "weight_only_int8",
+                        group_size: int = -1, include=None):
+    """Quantize every eligible matmul weight of a ``functional_state()``
+    dict into a :class:`QuantizedWeight` payload — the one-time construction
+    step of the quantized decode engine.
+
+    ``include``: optional predicate ``(name, array) -> bool`` overriding the
+    default selection. Returns ``(new_params, meta)`` where ``meta`` records
+    what was quantized and the HBM bytes the decode step no longer reads."""
+    _check_algo(algo)
+    out = {}
+    names = []
+    skipped = []        # would-be-quantized weights group_size excluded
+    bytes_fp = 0
+    bytes_q = 0
+    for name, arr in params.items():
+        if include is not None:
+            # an explicit predicate picks the names, but a selected weight
+            # must still BE quantizable — fail loudly, not deep in reshape
+            eligible = bool(include(name, arr))
+            if eligible:
+                if getattr(arr, "ndim", 0) != 2 \
+                        or not jnp.issubdtype(arr.dtype, jnp.floating):
+                    raise ValueError(
+                        f"include selected {name!r} (shape "
+                        f"{tuple(getattr(arr, 'shape', ()))}, dtype "
+                        f"{getattr(arr, 'dtype', '?')}): only 2-D floating "
+                        "matmul weights are quantizable")
+                _check_group(arr.shape[0], group_size)
+        else:
+            eligible = (getattr(arr, "ndim", 0) == 2
+                        and jnp.issubdtype(arr.dtype, jnp.floating)
+                        and name.endswith(".weight")
+                        and not _DEFAULT_SKIP.search(name))
+            if eligible and group_size != -1 \
+                    and arr.shape[0] % group_size != 0:
+                # a weight silently left at full precision while quant
+                # reports armed misattributes the A/B — record and warn
+                eligible = False
+                skipped.append(name)
+        if not eligible:
+            out[name] = arr
+            continue
+        q, s = _quantize_array(jnp.asarray(arr), group_size)
+        out[name] = QuantizedWeight(q, s, group_size=group_size,
+                                    out_dtype=arr.dtype)
+        names.append(name)
+        bytes_fp += arr.size * arr.dtype.itemsize
+        bytes_q += q.size * 1 + s.size * s.dtype.itemsize
+    if not names:
+        # silently serving full precision while /healthz reports quant armed
+        # is the worst outcome — a group size that excludes every weight (or
+        # a model with no matmul weights) must fail at construction
+        raise ValueError(
+            f"quantize_param_tree selected NO weights (group_size="
+            f"{group_size}, {len(params)} params): every 2-D matmul weight "
+            "failed eligibility — is group_size a divisor of the model's "
+            "in_features?")
+    if skipped:
+        import warnings
+
+        warnings.warn(
+            f"quantize_param_tree: {len(skipped)} matmul weight(s) stay "
+            f"FULL PRECISION — in_features not divisible by group_size "
+            f"{group_size}: {skipped[:4]}{'…' if len(skipped) > 4 else ''} "
+            "(per-channel group_size=-1 quantizes everything)",
+            stacklevel=2)
+    meta = {
+        "algo": algo,
+        "group_size": group_size,
+        "quantized": names,
+        "skipped_indivisible": skipped,
+        "bytes_fp": int(bytes_fp),
+        "bytes_q": int(bytes_q),
+        "bytes_saved": int(bytes_fp - bytes_q),
+    }
+    return out, meta
+
+
+class WeightOnlyLinear(Layer):
+    """Inference-only Linear over a pre-quantized int8 weight (the layer
+    form of :func:`weight_only_linear`; the reference keeps this in its
+    inference-model passes). Build one from a float layer with
+    :meth:`from_linear`."""
+
+    def __init__(self, weight, weight_scale, bias=None,
+                 group_size: int = -1, out_dtype="float32"):
+        super().__init__()
+        q = unwrap(weight)
+        s = unwrap(weight_scale)
+        _check_group(q.shape[0], group_size)
+        self.group_size = int(group_size)
+        self.out_dtype = out_dtype
+        self.register_buffer("quant_weight", Tensor(q))
+        self.register_buffer("weight_scale", Tensor(s))
+        self.bias = None
+        if bias is not None:
+            self.register_buffer("bias", bias if isinstance(bias, Tensor)
+                                 else Tensor(unwrap(bias)))
+        self.in_features, self.out_features = int(q.shape[0]), int(q.shape[1])
+
+    @classmethod
+    def from_linear(cls, linear, algo: str = "weight_only_int8",
+                    group_size: int = -1):
+        q, s = weight_quantize(linear.weight, algo=algo,
+                               group_size=group_size)
+        return cls(q, s, bias=getattr(linear, "bias", None),
+                   group_size=group_size,
+                   out_dtype=np.dtype(linear.weight._data.dtype).name)
+
+    def forward(self, x):
+        return weight_only_linear(x, self.quant_weight, bias=self.bias,
+                                  weight_scale=self.weight_scale,
+                                  group_size=self.group_size)
+
+    def extra_repr(self):
+        g = self.group_size
+        return (f"in={self.in_features}, out={self.out_features}, int8 "
+                + ("per-channel" if g == -1 else f"group_size={g}"))

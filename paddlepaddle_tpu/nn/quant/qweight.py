@@ -1,0 +1,120 @@
+"""QuantizedWeight — the int8 weight payload the serving path binds in
+place of a bf16/f32 matmul weight.
+
+Reference role: the opaque int8 buffers `weight_only_linear` /
+`llm_int8_linear` consume (python/paddle/nn/quant/quantized_linear.py).
+
+TPU-native design: the payload is a registered jax PYTREE NODE holding the
+int8 tensor and its scales, so it can ride anywhere a plain array can —
+through ``Layer.bind_state``, a ``jax.jit`` parameter pytree, or a donated
+argument list — and reconstruct itself inside a trace with tracer leaves.
+``F.linear`` detects it (duck-typed on ``wo_matmul``) and lowers the
+weight-only matmul with the scale HOISTED PAST the dot:
+
+    per-channel:  y = (x @ q.astype(cd)) * scale            # scale [out]
+    group-wise:   y = Σ_g (x_g @ q_g.astype(cd)) * scale_g  # scale [G, out]
+
+so the only weight bytes read from HBM are the int8 buffer — the
+``convert(s8→bf16)`` feeding the dot fuses into the matmul, and the scale
+multiply lands on the small [tokens, out] result (or the [tokens, G, out]
+partials), never on a materialized full-precision weight. On a
+memory-bandwidth-bound decode step this halves the dominant traffic term
+(weight bytes) vs bf16.
+
+This module deliberately imports nothing from the rest of the framework
+(only jax) so the eager linear hot path can consume it without import
+cycles.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_DTYPES = {"int8": jnp.int8}
+
+
+class QuantizedWeight:
+    """Weight-only-quantized matmul weight: int8 ``q`` with logical layout
+    ``[in, out]`` plus per-channel (``scale [out]``) or group-wise
+    (``scale [in//group_size, out]``) dequant scales.
+
+    ``group_size == -1`` means per-(output-)channel scales.
+    """
+
+    __slots__ = ("q", "scale", "group_size", "out_dtype")
+
+    def __init__(self, q, scale, group_size: int = -1, out_dtype=jnp.float32):
+        self.q = q
+        self.scale = scale
+        self.group_size = int(group_size)
+        self.out_dtype = jnp.dtype(out_dtype)
+
+    # -- array-like surface (enough for shape/dtype probes) ------------------
+    @property
+    def shape(self):
+        return tuple(self.q.shape)
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+    @property
+    def dtype(self):
+        # the STORAGE dtype: int8. Non-differentiable by construction, so an
+        # accidental grad trace through a bound quantized weight is refused
+        # by the dispatcher's is_differentiable check instead of silently
+        # producing garbage int8 cotangents.
+        return self.q.dtype
+
+    def __repr__(self):
+        return (f"QuantizedWeight(shape={self.shape}, "
+                f"group_size={self.group_size}, "
+                f"scale={tuple(self.scale.shape)}, "
+                f"out_dtype={self.out_dtype.name})")
+
+    # -- lowering ------------------------------------------------------------
+    def dequantize(self):
+        """Materialize the full-precision weight [in, out] (debug/export —
+        the serving path never calls this)."""
+        if self.group_size == -1:
+            return (self.q.astype(self.out_dtype)
+                    * self.scale.astype(self.out_dtype)[None, :])
+        k, n = self.q.shape
+        g = self.group_size
+        qg = self.q.reshape(k // g, g, n).astype(self.out_dtype)
+        return (qg * self.scale.astype(self.out_dtype)[:, None, :]
+                ).reshape(k, n)
+
+    def wo_matmul(self, x):
+        """``x @ W`` with the int8 buffer resident and the scale multiply
+        hoisted onto the matmul OUTPUT (per-channel) or the per-group
+        partials (group-wise). ``x``: [..., in]."""
+        cd = x.dtype if jnp.issubdtype(x.dtype, jnp.floating) \
+            else self.out_dtype
+        if self.group_size == -1:
+            out = jnp.matmul(x, self.q.astype(cd))
+            return out * self.scale.astype(cd)
+        k, n = self.q.shape
+        g = self.group_size
+        xg = x.reshape(x.shape[:-1] + (k // g, g))
+        qg = self.q.reshape(k // g, g, n)
+        # per-group partial sums [..., G, out]; the group scales apply to the
+        # partials (small), then the group axis reduces — int8 stays the only
+        # weight-sized operand
+        part = jnp.einsum("...gk,gkn->...gn", xg, qg.astype(cd))
+        return jnp.sum(part * self.scale.astype(cd), axis=-2)
+
+
+def _flatten(w: QuantizedWeight):
+    return (w.q, w.scale), (w.group_size, str(w.out_dtype))
+
+
+def _unflatten(aux, children):
+    q, scale = children
+    group_size, out_dtype = aux
+    return QuantizedWeight(q, scale, group_size=group_size,
+                           out_dtype=out_dtype)
+
+
+jax.tree_util.register_pytree_node(QuantizedWeight, _flatten, _unflatten)

@@ -1,0 +1,26 @@
+"""Reference: python/paddle/nn/quant/stub.py — ``Stub``, the marker layer a
+user drops where an activation quanter should be inserted; QAT swaps it for
+the configured quanter, and until then it is identity."""
+
+from __future__ import annotations
+
+from ..layer import Layer
+
+
+class Stub(Layer):
+    """Identity placeholder for a to-be-inserted quanter.
+
+    ``observer``: optional quanter/observer FACTORY (e.g. a
+    :func:`~...quantization.factory.quanter`-produced class partial) that
+    :class:`~...quantization.QAT` uses for this site instead of the global
+    activation config."""
+
+    def __init__(self, observer=None):
+        super().__init__()
+        self._observer = observer
+        self._layer = None          # QAT installs the live quanter here
+
+    def forward(self, x):
+        if self._layer is not None:
+            return self._layer(x)
+        return x

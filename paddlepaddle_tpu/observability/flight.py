@@ -53,8 +53,20 @@ from ..core import flags as _flags
 
 __all__ = [
     "FlightRecorder", "enable", "disable", "is_enabled", "get",
-    "record", "dump", "default_dir",
+    "record", "dump", "annotate", "default_dir",
 ]
+
+# process-level header annotations (serving quant mode, etc.): kept OUTSIDE
+# the recorder so a subsystem can annotate before/without the recorder being
+# armed — enabling later still dumps them. Plain dict set; no lock needed
+# (atomic under the GIL, dumps snapshot via dict()).
+_annotations: dict = {}
+
+
+def annotate(key: str, value) -> None:
+    """Attach a key to every future black-box header (e.g. the serving
+    engine's quant mode). Values must be JSON-serializable."""
+    _annotations[str(key)] = value
 
 
 def _rank() -> int:
@@ -178,6 +190,8 @@ class FlightRecorder:
             "dump_ordinal": n,
             "buffered_events": len(events),
         }]
+        if _annotations:
+            lines[0]["annotations"] = dict(_annotations)
         for ev in events:
             lines.append(dict(ev, rec="event"))
         if exc_info is not None:

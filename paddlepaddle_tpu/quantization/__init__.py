@@ -219,3 +219,16 @@ class PTQ:
                     apply_op(lambda a: _fake_quant(a, jnp.asarray(_s)), inputs[0]),
                 ) + tuple(inputs[1:]))
         return model
+
+
+# -- reference module layout (round-6): factory + observers/ + quanters/ ----
+# imported at the END so the subpackages can pull the classes defined above
+from .base_quanter import BaseQuanter, ObserveWrapper  # noqa: E402,F401
+from .factory import (  # noqa: E402,F401
+    ObserverFactory,
+    QuanterFactory,
+    observer,
+    quanter,
+)
+from . import observers  # noqa: E402,F401
+from . import quanters  # noqa: E402,F401

@@ -1,0 +1,51 @@
+"""Reference: python/paddle/quantization/base_quanter.py — the abstract
+layer every quanter (fake-quant layer) implements, so QAT/PTQ drivers and
+export passes can interrogate scales/bits uniformly."""
+
+from __future__ import annotations
+
+import abc
+
+import numpy as np
+
+from ..nn.layer import Layer
+
+
+class BaseQuanter(Layer, metaclass=abc.ABCMeta):
+    """Abstract quanter: a Layer whose forward simulates quantization and
+    which exposes its calibration state (reference contract)."""
+
+    def __init__(self):
+        super().__init__()
+
+    @abc.abstractmethod
+    def scales(self):
+        """Quantization scale(s) — scalar or per-channel array."""
+
+    def zero_points(self):
+        """Symmetric schemes have none (reference returns None too)."""
+        return None
+
+    def quant_axis(self):
+        """Per-channel axis, or None for per-tensor."""
+        return None
+
+    @abc.abstractmethod
+    def bit_length(self) -> int:
+        """Quantization bit width."""
+
+
+class ObserveWrapper(Layer):
+    """Reference base_observer's observe-a-layer helper: runs the wrapped
+    observer on every forward input, passes the tensor through unchanged."""
+
+    def __init__(self, observer, observed: Layer):
+        super().__init__()
+        self._observer = observer
+        self.observed = observed
+
+    def forward(self, *args, **kwargs):
+        first = args[0]
+        self._observer.observe(np.asarray(
+            first.numpy() if hasattr(first, "numpy") else first))
+        return self.observed(*args, **kwargs)

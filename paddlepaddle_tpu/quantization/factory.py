@@ -1,0 +1,89 @@
+"""Reference: python/paddle/quantization/factory.py — the ``quanter``
+class decorator and ``QuanterFactory``.
+
+A quanter class decorated with ``@quanter("MyQuanter")`` gains a FACTORY
+alias: calling the factory with constructor kwargs returns a partial that
+``QuantConfig`` can instantiate per-layer later (the reference's
+two-stage construction, so one config line fans out to many layer sites):
+
+    @quanter("MovingAbsMax")
+    class MyQuanter(BaseQuanter): ...
+
+    cfg = QuantConfig(activation=MovingAbsMax(moving_rate=0.95))
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+_FACTORIES: Dict[str, "ObserverFactory"] = {}
+
+
+class ObserverFactory:
+    """Deferred constructor: holds (cls, kwargs); ``_instance()`` builds the
+    live quanter/observer (reference ObserverFactory/QuanterFactory)."""
+
+    def __init__(self, cls, *args, **kwargs):
+        self.cls = cls
+        self.args = args
+        self.kwargs = kwargs
+        self.partial_class = lambda: cls(*args, **kwargs)
+
+    def __call__(self, *args, **kwargs):
+        """Calling a factory with new kwargs refines it (the decorated-name
+        usage: ``MovingAbsMax(moving_rate=0.95)``)."""
+        merged = dict(self.kwargs)
+        merged.update(kwargs)
+        return type(self)(self.cls, *(args or self.args), **merged)
+
+    def _instance(self, layer=None):
+        return self.cls(*self.args, **self.kwargs)
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self.cls.__name__}, "
+                f"kwargs={self.kwargs})")
+
+
+class QuanterFactory(ObserverFactory):
+    pass
+
+
+def quanter(class_name: str):
+    """Class decorator registering a quanter and exporting ``class_name`` as
+    its factory in the class's defining module (reference semantics: the
+    factory name is importable next to the class)."""
+
+    def deco(cls):
+        factory = QuanterFactory(cls)
+        _FACTORIES[class_name] = factory
+        import sys
+
+        mod = sys.modules.get(cls.__module__)
+        if mod is not None:
+            setattr(mod, class_name, factory)
+        cls._quanter_factory_name = class_name
+        return cls
+
+    return deco
+
+
+def observer(class_name: str):
+    """Observer-flavoured registration (reference factory has both)."""
+
+    def deco(cls):
+        factory = ObserverFactory(cls)
+        _FACTORIES[class_name] = factory
+        import sys
+
+        mod = sys.modules.get(cls.__module__)
+        if mod is not None:
+            setattr(mod, class_name, factory)
+        cls._observer_factory_name = class_name
+        return cls
+
+    return deco
+
+
+def lookup(class_name: str):
+    """Registered factory by name (None when absent)."""
+    return _FACTORIES.get(class_name)

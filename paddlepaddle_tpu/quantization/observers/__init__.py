@@ -1,0 +1,73 @@
+"""Reference module path: python/paddle/quantization/observers/ —
+calibration observers. The per-tensor absmax/EMA observers live in the
+package root (round-5 PTQ); this module closes the reference path and adds
+the weight-shaped observers the int8 serving path calibrates with."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import AbsmaxObserver, BaseObserver, EMAObserver  # noqa: F401
+from ..factory import observer
+
+__all__ = [
+    "BaseObserver", "AbsmaxObserver", "EMAObserver",
+    "AbsMaxChannelWiseWeightObserver", "GroupWiseWeightObserver",
+]
+
+
+@observer("AbsMaxChannelWiseWeightObserverFactory")
+class AbsMaxChannelWiseWeightObserver(BaseObserver):
+    """Per-output-channel absmax over a [in, out] matmul weight (reference
+    observers/abs_max_weight.py) — the calibration behind per-channel
+    ``weight_quantize``."""
+
+    def __init__(self, quant_bits: int = 8, quant_axis: int = 1):
+        super().__init__(quant_bits)
+        self.quant_axis = quant_axis
+
+    def observe(self, x: np.ndarray):
+        reduce_axes = tuple(i for i in range(x.ndim) if i != self.quant_axis)
+        m = np.max(np.abs(x), axis=reduce_axes) if x.size else np.zeros(
+            x.shape[self.quant_axis])
+        self._scale = m if self._scale is None else np.maximum(self._scale, m)
+
+    def scales(self) -> np.ndarray:
+        return np.asarray(self._scale if self._scale is not None else 1.0,
+                          np.float32) / (2.0 ** (self.quant_bits - 1) - 1)
+
+    def scale(self):  # BaseObserver API: per-tensor view of the max channel
+        return float(np.max(self._scale)) if self._scale is not None else 1.0
+
+
+@observer("GroupWiseWeightObserverFactory")
+class GroupWiseWeightObserver(BaseObserver):
+    """Group-wise absmax over the in dim of a [in, out] weight (reference
+    observers/groupwise.py; group_size 64/128) — the calibration behind
+    group-wise ``weight_quantize``."""
+
+    def __init__(self, quant_bits: int = 8, group_size: int = 128):
+        super().__init__(quant_bits)
+        if group_size <= 0:
+            raise ValueError(f"group_size must be positive, got {group_size}")
+        self.group_size = group_size
+
+    def observe(self, x: np.ndarray):
+        if x.ndim != 2:
+            raise ValueError(
+                f"GroupWiseWeightObserver expects a 2-D weight, got shape "
+                f"{x.shape}")
+        k, n = x.shape
+        if k % self.group_size != 0:
+            raise ValueError(
+                f"in dim {k} not divisible by group_size {self.group_size}")
+        m = np.max(np.abs(x.reshape(k // self.group_size, self.group_size, n)),
+                   axis=1)
+        self._scale = m if self._scale is None else np.maximum(self._scale, m)
+
+    def scales(self) -> np.ndarray:
+        return np.asarray(self._scale if self._scale is not None else 1.0,
+                          np.float32) / (2.0 ** (self.quant_bits - 1) - 1)
+
+    def scale(self):
+        return float(np.max(self._scale)) if self._scale is not None else 1.0

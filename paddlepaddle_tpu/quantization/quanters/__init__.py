@@ -1,0 +1,62 @@
+"""Reference module path: python/paddle/quantization/quanters/ — the
+fake-quant layers QAT inserts. ``FakeQuanterWithAbsMax`` (per-tensor moving
+absmax) lives in the package root; this module closes the reference path,
+registers the factory spelling, and adds the per-channel weight quanter."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import FakeQuanterWithAbsMax, _fake_quant  # noqa: F401
+from ..factory import QuanterFactory, quanter
+from ...core.dispatch import apply_op
+from ...core.tensor import Tensor
+from ...nn.layer import Layer
+
+__all__ = [
+    "FakeQuanterWithAbsMax", "FakeQuanterWithAbsMaxObserver",
+    "FakeQuanterChannelWiseAbsMax",
+]
+
+# reference factory spelling (quanters/abs_max.py exports both the class and
+# a Factory-producing alias)
+FakeQuanterWithAbsMaxObserver = QuanterFactory(FakeQuanterWithAbsMax)
+
+
+@quanter("FakeQuanterChannelWiseAbsMaxFactory")
+class FakeQuanterChannelWiseAbsMax(Layer):
+    """Per-output-channel fake quantization for matmul weights (reference
+    quanters/channel_wise_abs_max.py): each channel carries its own absmax
+    scale — the QAT twin of per-channel ``weight_quantize``."""
+
+    def __init__(self, quant_bits: int = 8, quant_axis: int = 1, name=None):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.quant_axis = quant_axis
+        self._scale = None          # lazily sized on first forward
+
+    def scales(self):
+        return None if self._scale is None else self._scale.numpy()
+
+    def bit_length(self):
+        return self.quant_bits
+
+    def forward(self, x):
+        import jax.numpy as jnp
+
+        axis = self.quant_axis % x.ndim
+        reduce_axes = tuple(i for i in range(x.ndim) if i != axis)
+        cur = apply_op(lambda a: jnp.max(jnp.abs(a), axis=reduce_axes), x)
+        if self._scale is None:
+            self._scale = Tensor(np.asarray(cur.numpy(), np.float32))
+        else:
+            self._scale._replace_data(jnp.maximum(
+                self._scale._data, cur._data.astype(jnp.float32)))
+        shape = [1] * x.ndim
+        shape[axis] = -1
+
+        def f(a, s):
+            return _fake_quant(a, s.reshape(shape).astype(jnp.float32),
+                               self.quant_bits).astype(a.dtype)
+
+        return apply_op(f, x, self._scale)

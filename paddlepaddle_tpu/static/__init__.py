@@ -146,6 +146,33 @@ class Executor:
             outs = list(out) if isinstance(out, (list, tuple)) else [out]
         else:
             fetch_list = list(fetch_list or [])
+            # the book-style exe.run(fetch_list=[loss.name]) form: variable
+            # NAMES resolve through the program's global block (reference
+            # executor accepts both; an opaque jit TypeError served no one)
+            block = program.global_block()
+            resolved = []
+            for v in fetch_list:
+                if isinstance(v, str):
+                    if block.has_var(v):
+                        v = block.var(v)
+                    else:
+                        # persistable parameters are concrete Tensors on op
+                        # inputs, not block variables — the reference
+                        # resolves those by name too (fetching a parameter
+                        # after a train run is the book's inspect idiom)
+                        v = next(
+                            (t for op in block.ops for t in op.inputs
+                             if not isinstance(t, StaticVariable)
+                             and isinstance(t, Tensor)
+                             and getattr(t, "name", None) == v), v)
+                        if isinstance(v, str):
+                            raise ValueError(
+                                f"fetch_list name {v!r} matches no variable "
+                                f"or parameter in this program (variables: "
+                                f"e.g. {sorted(block.vars)[:8]}) — fetch "
+                                "the Variable object or its .name")
+                resolved.append(v)
+            fetch_list = resolved
             n_user = len(fetch_list)
             grad_slots = []
             for entry in program._minimize_ops:

@@ -187,7 +187,12 @@ class _ProgramIR:
     def clone(self, for_test=False):
         """Real clone (reference framework.py Program.clone): test clones
         KEEP only forward ops, DROP train-only side effects (running-stat
-        writes), and substitute each train-sensitive op's eval form."""
+        writes), and substitute each train-sensitive op's eval form.
+
+        The reserved ``__rng__`` feed (per-run dropout keys) is STRIPPED
+        from substituted eval ops: the eval form ignores the key, and
+        keeping the edge made ``save_inference_model`` demand a feed the
+        user can't supply (KeyError ``'__rng__'`` on any dropout model)."""
         new = type(self)()
         new._feed_targets = dict(self._feed_targets)
         new._static_params = list(getattr(self, "_static_params", []))
@@ -195,6 +200,7 @@ class _ProgramIR:
         nb = new.global_block()
         nb.vars.update(new._feed_targets)   # feeds stay name-resolvable
         kept = set()
+        rng = self._feed_targets.get(RNG_FEED) if for_test else None
         for op in self.global_block().ops:
             if for_test:
                 if op.role != "forward":
@@ -205,7 +211,10 @@ class _ProgramIR:
                         # DROP it — if a kept op still consumed its output,
                         # lowering raises loudly at build
                         continue
-                    op2 = Operation(op.type, op.eval_call, op.inputs,
+                    call, inputs = op.eval_call, op.inputs
+                    if rng is not None and any(t is rng for t in inputs):
+                        call, inputs = _strip_rng_inputs(call, inputs, rng)
+                    op2 = Operation(op.type, call, inputs,
                                     op.outputs, op.out_treedef,
                                     attrs=dict(op.attrs, is_test=True))
                     nb.append_op(op2)
@@ -213,6 +222,12 @@ class _ProgramIR:
                     continue
             nb.append_op(op)   # ops are immutable: share nodes
             kept.add(id(op))
+        if rng is not None and not any(
+                t is rng for op in nb.ops for t in op.inputs):
+            # no kept op reads per-run randomness: the reserved feed must
+            # not survive into the test program (export would require it)
+            new._feed_targets.pop(RNG_FEED, None)
+            nb.vars.pop(RNG_FEED, None)
         if not for_test:
             new._param_grads = list(self._param_grads)
             new._state_writes = list(self._state_writes)
@@ -275,6 +290,22 @@ def capture(name, run, leaves, tensor_pos, datas, eval_fn=None):
 RNG_FEED = "__rng__"
 
 
+def _strip_rng_inputs(call, inputs, rng_var):
+    """Drop the ``__rng__`` feed from an eval-substituted op: the eval form
+    ignores the key, so a constant stands in at its argument positions and
+    the edge disappears from the graph (exportable without the feed)."""
+    positions = tuple(i for i, t in enumerate(inputs) if t is rng_var)
+    kept = [t for t in inputs if t is not rng_var]
+
+    def wrapped(*vals):
+        vals = list(vals)
+        for p in positions:
+            vals.insert(p, jnp.zeros((2,), np.uint32))
+        return call(*vals)
+
+    return wrapped, kept
+
+
 def static_rng_key():
     """Per-RUN randomness for captured ops (dropout): a reserved feed
     variable holding a PRNG key that run_program refreshes on every train
@@ -291,6 +322,20 @@ def static_rng_key():
         prog._feed_targets[RNG_FEED] = v
         prog.global_block().vars[RNG_FEED] = v
     return v
+
+
+def next_op_salt() -> int:
+    """Per-capture unique salt for randomness-consuming ops (dropout folds
+    it into the per-run ``__rng__`` key). MUST be unique per captured op:
+    the old ``id(x)`` salt made two dropouts off the SAME activation fold
+    identical keys — byte-identical masks, silently correlated branches.
+    Rides the program's fresh-name counter, so it is unique per capture and
+    deterministic for a given build order."""
+    from . import default_main_program
+
+    prog = default_main_program()
+    prog._var_counter += 1
+    return prog._var_counter
 
 
 def record_state_write(target: Tensor, source: StaticVariable):
@@ -434,9 +479,12 @@ def run_program(prog, feed, fetch_vars, train=True):
         fn, params, feed_names, extras = lower(
             prog, fetch_vars, feed_names=sorted(feed_arrays), train=train)
         jfn = jax.jit(fn)
-        cached = (jfn, params, feed_names, extras)
+        # the entry PINS its fetch vars: the key is id()-based, and a
+        # garbage-collected fetch target's recycled id() would otherwise
+        # let a NEW variable silently hit this stale compiled program
+        cached = (jfn, params, feed_names, extras, tuple(fetch_vars))
         prog._exec_cache[key] = cached
-    jfn, params, feed_names, extras = cached
+    jfn, params, feed_names, extras = cached[:4]
     outs, extra_vals = jfn(
         tuple(feed_arrays[n] for n in feed_names),
         tuple(p._data for p in params))

@@ -1,0 +1,364 @@
+"""Weight-only int8 LLM serving path (r6: nn/quant + quantized decode).
+
+Covers the reference surface python/paddle/nn/quant/quantized_linear.py
+(weight_quantize / weight_dequantize / weight_only_linear /
+llm_int8_linear), the quanter/observer factory paths
+(paddle/quantization/{factory,observers,quanters}), and the serving
+integration: a BatchDecodeEngine built with quant="weight_only_int8" must
+produce the SAME greedy top-1 stream as the full-precision engine on short
+prompts while reading int8 weight buffers.
+"""
+
+import numpy as np
+import pytest
+
+import paddlepaddle_tpu as paddle
+from paddlepaddle_tpu.nn import quant as nq
+
+
+# ---------------------------------------------------------------------------
+# quantize / dequantize round trip
+# ---------------------------------------------------------------------------
+
+
+def test_weight_quantize_roundtrip_per_channel():
+    w = np.random.randn(64, 32).astype(np.float32)
+    q, s = nq.weight_quantize(paddle.to_tensor(w))
+    qa, sa = q.numpy(), s.numpy()
+    assert qa.dtype == np.int8 and qa.shape == (64, 32)
+    assert sa.shape == (32,)
+    back = nq.weight_dequantize(q, s).numpy()
+    # symmetric int8: per-element error bounded by half a quantization step
+    bound = sa[None, :] * 0.5 + 1e-7
+    assert (np.abs(back - w) <= bound).all()
+    # scales are absmax/127 per OUTPUT channel
+    np.testing.assert_allclose(sa, np.abs(w).max(0) / 127.0, rtol=1e-6)
+
+
+def test_weight_quantize_roundtrip_group_wise():
+    w = np.random.randn(64, 16).astype(np.float32)
+    # plant a per-group outlier: group scales localize it, per-channel can't
+    w[0, 0] = 40.0
+    q, s = nq.weight_quantize(paddle.to_tensor(w), group_size=16)
+    assert s.numpy().shape == (4, 16)
+    back = nq.weight_dequantize(q, s, group_size=16).numpy()
+    step = np.repeat(s.numpy(), 16, axis=0)     # [in, out] per-element scale
+    assert (np.abs(back - w) <= step * 0.5 + 1e-7).all()
+    # away from the outlier's group, group scales beat the per-channel scale
+    qc, sc = nq.weight_quantize(paddle.to_tensor(w))
+    back_c = nq.weight_dequantize(qc, sc).numpy()
+    g_err = np.abs(back - w)[16:, 0].max()      # other groups, same column
+    c_err = np.abs(back_c - w)[16:, 0].max()
+    assert g_err < c_err
+
+
+def test_weight_quantize_validation():
+    w = paddle.to_tensor(np.random.randn(8, 4).astype(np.float32))
+    with pytest.raises(NotImplementedError):
+        nq.weight_quantize(w, algo="weight_only_int4")
+    with pytest.raises(ValueError):
+        nq.weight_quantize(w, group_size=3)     # not a divisor of 8
+    with pytest.raises(ValueError):
+        nq.weight_quantize(paddle.to_tensor(np.zeros(4, np.float32)))
+    # an all-zero channel must quantize to zeros, not NaN
+    wz = np.zeros((8, 2), np.float32)
+    wz[:, 1] = 1.0
+    q, s = nq.weight_quantize(paddle.to_tensor(wz))
+    assert np.isfinite(s.numpy()).all()
+    assert (q.numpy()[:, 0] == 0).all()
+
+
+def test_quantize_param_tree_validation():
+    """A selection that quantizes NOTHING must fail at construction — the
+    engine reporting quant armed while serving full precision would be the
+    silent-wrong-mode failure. An include-selected weight still has to be
+    quantizable (clear error, not a reshape crash)."""
+    import jax.numpy as jnp
+
+    params = {"a.weight": jnp.ones((10, 4), jnp.float32),
+              "b.bias": jnp.ones((4,), jnp.float32)}
+    with pytest.raises(ValueError, match="selected NO weights"):
+        nq.quantize_param_tree(params, group_size=3)   # divides nothing
+    with pytest.raises(ValueError, match="divisor"):
+        nq.quantize_param_tree(params, group_size=3,
+                               include=lambda n, a: n == "a.weight")
+    with pytest.raises(ValueError, match="quantizable"):
+        nq.quantize_param_tree(params, include=lambda n, a: n == "b.bias")
+    out, meta = nq.quantize_param_tree(params, group_size=5)
+    assert meta["quantized"] == ["a.weight"]
+
+
+# ---------------------------------------------------------------------------
+# weight_only_linear / llm_int8_linear
+# ---------------------------------------------------------------------------
+
+
+def test_weight_only_linear_matches_dequant_matmul():
+    x = np.random.randn(3, 5, 64).astype(np.float32)
+    w = np.random.randn(64, 24).astype(np.float32)
+    b = np.random.randn(24).astype(np.float32)
+    for gs in (-1, 16):
+        q, s = nq.weight_quantize(paddle.to_tensor(w), group_size=gs)
+        y = nq.weight_only_linear(paddle.to_tensor(x), q,
+                                  bias=paddle.to_tensor(b),
+                                  weight_scale=s, group_size=gs)
+        ref = x @ nq.weight_dequantize(q, s, group_size=gs).numpy() + b
+        np.testing.assert_allclose(y.numpy(), ref, rtol=2e-5, atol=2e-5)
+    with pytest.raises(NotImplementedError):
+        nq.weight_only_linear(paddle.to_tensor(x), q, weight_scale=s,
+                              weight_dtype="int4")
+    with pytest.raises(ValueError):
+        nq.weight_only_linear(paddle.to_tensor(x), q)   # scale missing
+
+
+def test_weight_only_linear_scale_scheme_mismatch():
+    """Group-wise scales under the default group_size=-1 (or vice versa)
+    must raise — the 2-D scale would broadcast against the matmul output
+    and return silently wrong values."""
+    x = paddle.to_tensor(np.random.randn(4, 64).astype(np.float32))
+    w = paddle.to_tensor(np.random.randn(64, 8).astype(np.float32))
+    qg, sg = nq.weight_quantize(w, group_size=16)
+    with pytest.raises(ValueError, match="group_size"):
+        nq.weight_only_linear(x, qg, weight_scale=sg)   # forgot group_size
+    qc, sc = nq.weight_quantize(w)
+    with pytest.raises(ValueError, match="group"):
+        nq.weight_only_linear(x, qc, weight_scale=sc, group_size=16)
+    with pytest.raises(ValueError, match="groups"):
+        nq.weight_only_linear(x, qg, weight_scale=sg.numpy()[:2],
+                              group_size=16)            # wrong group count
+
+
+def test_llm_int8_linear_outlier_decomposition():
+    x = np.random.randn(4, 64).astype(np.float32)
+    x[:, 7] *= 20.0                   # one outlier feature column (> 6.0)
+    w = np.random.randn(64, 16).astype(np.float32)
+    q, s = nq.weight_quantize(paddle.to_tensor(w), algo="llm.int8")
+    y = nq.llm_int8_linear(paddle.to_tensor(x), q, weight_scale=s).numpy()
+    ref = x @ w
+    # mixed decomposition keeps relative error small DESPITE the outlier
+    rel = np.abs(y - ref).max() / np.abs(ref).max()
+    assert rel < 0.03, rel
+    # group-wise scales are a weight_only-only feature
+    qg, sg = nq.weight_quantize(paddle.to_tensor(w), group_size=16)
+    from paddlepaddle_tpu.nn.quant import QuantizedWeight
+
+    with pytest.raises(ValueError):
+        nq.llm_int8_linear(paddle.to_tensor(x),
+                           QuantizedWeight(qg.numpy(), sg.numpy(),
+                                           group_size=16))
+
+
+def test_quantized_weight_payload_routes_f_linear():
+    """F.linear lowers a bound QuantizedWeight through wo_matmul (the
+    serving path's exact code path, without an engine)."""
+    import paddlepaddle_tpu.nn.functional as F
+    from paddlepaddle_tpu.nn.quant import QuantizedWeight
+
+    x = np.random.randn(2, 32).astype(np.float32)
+    w = np.random.randn(32, 8).astype(np.float32)
+    q, s = nq.weight_quantize(paddle.to_tensor(w))
+    payload = QuantizedWeight(q.numpy(), s.numpy())
+    lin = paddle.nn.Linear(32, 8, bias_attr=False)
+    lin.weight._data = payload          # what bind_state does in the engine
+    try:
+        y = lin(paddle.to_tensor(x)).numpy()
+    finally:
+        lin.weight._data = w
+    ref = x @ payload.dequantize()
+    np.testing.assert_allclose(y, np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_weight_only_linear_layer():
+    lin = paddle.nn.Linear(16, 4)
+    qlin = nq.WeightOnlyLinear.from_linear(lin)
+    x = paddle.to_tensor(np.random.randn(3, 16).astype(np.float32))
+    np.testing.assert_allclose(qlin(x).numpy(), lin(x).numpy(),
+                               rtol=0.05, atol=0.05)
+
+
+# ---------------------------------------------------------------------------
+# namespace closure + factory machinery
+# ---------------------------------------------------------------------------
+
+
+def test_nn_quant_closes_reference_all():
+    ref_all = {
+        "Stub", "FloatFunctionalLayer", "add", "subtract", "multiply",
+        "divide", "reshape", "transpose", "concat", "flatten", "matmul",
+        "QuantStub", "ConvertibleQuantedLayer", "weight_only_linear",
+        "llm_int8_linear", "weight_quantize", "weight_dequantize",
+    }
+    assert ref_all <= set(nq.__all__)
+    for name in nq.__all__:
+        assert getattr(nq, name, None) is not None, name
+
+
+def test_quanter_factory_and_module_paths():
+    from paddlepaddle_tpu.quantization import (
+        BaseQuanter,
+        QuantConfig,
+        QuanterFactory,
+        factory,
+        observers,
+        quanters,
+        quanter,
+    )
+
+    @quanter("MyTestQuanter")
+    class _Q(quanters.FakeQuanterChannelWiseAbsMax):
+        pass
+
+    f = factory.lookup("MyTestQuanter")
+    assert isinstance(f, QuanterFactory)
+    inst = f(quant_bits=4)._instance()
+    assert inst.quant_bits == 4
+    assert issubclass(quanters.FakeQuanterChannelWiseAbsMax, paddle.nn.Layer)
+    assert isinstance(BaseQuanter, type)
+    # observers calibrate the same scales weight_quantize uses
+    w = np.random.randn(32, 8).astype(np.float32)
+    obs = observers.AbsMaxChannelWiseWeightObserver()
+    obs.observe(w)
+    np.testing.assert_allclose(obs.scales(), np.abs(w).max(0) / 127.0,
+                               rtol=1e-6)
+    gobs = observers.GroupWiseWeightObserver(group_size=16)
+    gobs.observe(w)
+    assert gobs.scales().shape == (2, 8)
+    # QuantConfig still accepts the round-5 class-style factories
+    cfg = QuantConfig()
+    assert cfg.matches(paddle.nn.Linear(4, 2))
+
+
+def test_convertible_quanted_layer_bakes_trained_quanters():
+    """convert() must bake BOTH weight and activation quanters from their
+    actual calibration state (scales()/scale), not skip them silently."""
+    from paddlepaddle_tpu.nn.quant import (ConvertibleQuantedLayer,
+                                           LinearQuanterDequanter)
+    from paddlepaddle_tpu.quantization import FakeQuanterWithAbsMax, quanters
+
+    class QL(ConvertibleQuantedLayer):
+        def __init__(self):
+            super().__init__()
+            self.weight_quanter = quanters.FakeQuanterChannelWiseAbsMax()
+            self.act_quanter = FakeQuanterWithAbsMax()
+
+        def forward(self, x):
+            return self.act_quanter(x)
+
+        def weights_to_quanters(self):
+            return [("weight", "weight_quanter")]
+
+        def activation_quanters(self):
+            return ["act_quanter"]
+
+    layer = QL()
+    w = paddle.to_tensor(np.random.randn(8, 4).astype(np.float32))
+    layer.weight_quanter(w)          # calibrate per-channel scales
+    layer.act_quanter(w)             # calibrate the moving absmax
+    layer.convert()
+    assert isinstance(layer.weight_quanter, LinearQuanterDequanter)
+    assert layer.weight_quanter.scale.shape == (4,)     # per-channel kept
+    assert isinstance(layer.act_quanter, LinearQuanterDequanter)
+    out = layer.act_quanter(w)       # the baked pair still runs
+    s = float(layer.act_quanter.scale)          # the learned EMA absmax
+    ref = np.clip(np.round(w.numpy() / s * 127), -127, 127) * (s / 127)
+    np.testing.assert_allclose(out.numpy(), ref, atol=1e-5)
+    assert layer.convert() is layer  # idempotent
+
+
+def test_stub_and_functional_layers():
+    s = nq.Stub()
+    x = paddle.to_tensor(np.ones((2, 3), np.float32))
+    np.testing.assert_allclose(s(x).numpy(), x.numpy())
+    qs = nq.QuantStub()
+    assert qs(x).shape == x.shape
+    add = nq.add()
+    np.testing.assert_allclose(add(x, x).numpy(), 2 * np.ones((2, 3)))
+    mm = nq.matmul()
+    assert list(mm(x, paddle.to_tensor(
+        np.ones((3, 2), np.float32))).shape) == [2, 2]
+    fl = nq.flatten()
+    assert list(fl(paddle.to_tensor(
+        np.ones((2, 3, 4), np.float32))).shape) == [24]
+
+
+# ---------------------------------------------------------------------------
+# quantized decode engine
+# ---------------------------------------------------------------------------
+
+
+def _tiny_model():
+    from paddlepaddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig.tiny(vocab_size=256, hidden_size=64, layers=2,
+                           heads=4, kv_heads=2, max_len=128)
+    return LlamaForCausalLM(cfg)
+
+
+def _serve(model, prompts, new_tokens, **kw):
+    from paddlepaddle_tpu.inference.decode_engine import BatchDecodeEngine
+    from paddlepaddle_tpu.inference.serving import GenerationRequest
+
+    eng = BatchDecodeEngine(model, max_slots=4, chunk=4, **kw)
+    reqs = [GenerationRequest(p, new_tokens, 0.0, 0, None) for p in prompts]
+    eng.serve(reqs, timeout=240)
+    return eng, [np.asarray(r.result.result(5)) for r in reqs]
+
+
+@pytest.mark.slow
+def test_quantized_engine_greedy_top1_parity():
+    """Acceptance: int8 greedy top-1 == bf16/f32 greedy top-1 on short
+    prompts, with the engine reading QuantizedWeight (int8) params."""
+    from paddlepaddle_tpu.nn.quant import QuantizedWeight
+
+    model = _tiny_model()
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 256, (n,)).astype(np.int32)
+               for n in (5, 9, 17)]
+    _, base = _serve(model, prompts, 6)
+    for gs in (-1, 16):
+        eng, outs = _serve(model, prompts, 6,
+                           quant="weight_only_int8", quant_group_size=gs)
+        qw = [v for v in eng.params.values()
+              if isinstance(v, QuantizedWeight)]
+        assert len(qw) == len(eng.quant_meta["quantized"]) > 0
+        assert all(np.dtype(w.q.dtype) == np.int8 for w in qw)
+        # embeddings/norms stay full precision; every proj + lm_head is int8
+        assert not any("embed_tokens" in n
+                       for n in eng.quant_meta["quantized"])
+        assert any("lm_head" in n for n in eng.quant_meta["quantized"])
+        assert eng.quant_meta["bytes_saved"] > 0
+        for a, b in zip(base, outs):
+            np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.slow
+def test_quantized_serving_engine_health_and_validation():
+    from paddlepaddle_tpu.inference.serving import ServingEngine
+    from paddlepaddle_tpu.observability import flight, to_prometheus_text
+
+    model = _tiny_model()
+    with pytest.raises(ValueError):
+        ServingEngine(model, mode="static", quant="weight_only_int8")
+    with pytest.raises(ValueError):
+        ServingEngine(model, quant="weight_only_int4")
+    eng = ServingEngine(model, max_batch_size=2, quant="weight_only_int8")
+    try:
+        h = eng.health()
+        assert h["quant"] == "weight_only_int8"
+        out = eng.generate(np.arange(4, dtype=np.int32), max_new_tokens=3,
+                           timeout=120)
+        assert out.shape == (7,)
+        text = to_prometheus_text()
+        assert 'paddle_serving_quant_enabled{mode="weight_only_int8"} 1' \
+            in text
+        assert "paddle_serving_quant_weights" in text
+        ann = flight._annotations.get("serving_quant")
+        assert ann is not None and ann["mode"] == "weight_only_int8"
+    finally:
+        eng.stop()
+    # quant OFF: no quant field surprises, health reports "off"
+    eng2 = ServingEngine(model, max_batch_size=2)
+    try:
+        assert eng2.health()["quant"] == "off"
+    finally:
+        eng2.stop()

@@ -344,3 +344,86 @@ def test_completion_inspects_propagated_shardings(static_mode):
     assert specs[out.name] == P()
     text = format_completion(prog, specs)
     assert "fc_tensordot" in text and "dp" in text
+
+
+def test_export_inference_model_with_dropout(static_mode, tmp_path):
+    """Regression (r6): clone(for_test=True) kept the reserved __rng__ feed
+    on substituted eval ops, so save_inference_model demanded a feed the
+    user can't supply — KeyError '__rng__' on ANY dropout model."""
+    prog = paddle.static.Program()
+    with paddle.static.program_guard(prog):
+        x = paddle.static.data(name="x", shape=[None, 8], dtype="float32")
+        h = paddle.nn.functional.dropout(x, p=0.5, training=True)
+        pred = paddle.static.nn.fc(h, size=3)
+    exe = paddle.static.Executor()
+    path = str(tmp_path / "dropout_model")
+    paddle.static.save_inference_model(path, [x], [pred], exe, program=prog)
+    loaded, feed_names, fetch_targets = \
+        paddle.static.load_inference_model(path, exe)
+    assert feed_names == ["x"]            # the rng feed must NOT leak out
+    xq = np.random.default_rng(3).standard_normal((4, 8)).astype(np.float32)
+    got, = exe.run(loaded, feed={"x": xq}, fetch_list=fetch_targets)
+    # eval form: dropout is identity, so export == fc(x) with train masks off
+    test_prog = prog.clone(for_test=True)
+    assert "__rng__" not in test_prog._feed_targets
+    want, = exe.run(test_prog, feed={"x": xq}, fetch_list=[pred])
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_two_dropouts_off_same_activation_differ(static_mode):
+    """Regression (r6): the per-op rng salt was id(x) of the INPUT variable,
+    so two dropout branches off the same activation folded identical keys —
+    byte-identical masks. The salt is now unique per captured op."""
+    prog = paddle.static.Program()
+    with paddle.static.program_guard(prog):
+        x = paddle.static.data(name="x", shape=[64, 64], dtype="float32")
+        a = paddle.nn.functional.dropout(x, p=0.5, training=True)
+        b = paddle.nn.functional.dropout(x, p=0.5, training=True)
+    exe = paddle.static.Executor()
+    ra, rb = exe.run(prog, feed={"x": np.ones((64, 64), np.float32)},
+                     fetch_list=[a, b])
+    assert (ra == 0).any() and (rb == 0).any()    # both really mask
+    assert not np.array_equal(ra, rb)             # but independently
+
+
+def test_executor_run_accepts_fetch_names(static_mode):
+    """The book-style exe.run(fetch_list=[loss.name]) form resolves names
+    through the global block instead of an opaque jit TypeError."""
+    prog, x, y, pred, loss = _build_linreg()
+    exe = paddle.static.Executor()
+    feed = {"x": np.ones((2, 4), np.float32),
+            "y": np.zeros((2, 1), np.float32)}
+    by_var, = exe.run(prog, feed=feed, fetch_list=[loss])
+    by_name, = exe.run(prog, feed=feed, fetch_list=[loss.name])
+    np.testing.assert_allclose(by_name, by_var)
+    # persistable PARAMETERS resolve by name too (they are concrete op-input
+    # tensors, not block variables — the reference executor finds both)
+    param = prog.all_parameters()[0]
+    got, = exe.run(prog, feed=feed, fetch_list=[param.name])
+    np.testing.assert_allclose(got, param.numpy())
+    with pytest.raises(ValueError, match="matches no variable"):
+        exe.run(prog, feed=feed, fetch_list=["no_such_var"])
+
+
+def test_exec_cache_pins_fetch_vars(static_mode):
+    """Regression (r6): the executable-cache key uses id(fetch_var); a
+    GC'd fetch target's recycled id() must never serve a stale compiled
+    program. The cache entry now pins its fetch vars: same-id aliasing is
+    impossible while the entry lives."""
+    import gc
+
+    prog = paddle.static.Program()
+    with paddle.static.program_guard(prog):
+        x = paddle.static.data(name="x", shape=[2, 2], dtype="float32")
+        a = x * 2.0
+    exe = paddle.static.Executor()
+    feed = {"x": np.ones((2, 2), np.float32)}
+    exe.run(prog, feed=feed, fetch_list=[a])
+    pinned_ids = {id(v) for entry in prog._exec_cache.values()
+                  for v in entry[4]}
+    assert id(a) in pinned_ids
+    del a
+    gc.collect()
+    # the entry still holds the var: its id cannot be recycled into a new
+    # variable that would alias the cached program
+    assert all(len(entry) == 5 for entry in prog._exec_cache.values())
