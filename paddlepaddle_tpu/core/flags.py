@@ -140,6 +140,13 @@ define_flag("obs_blackbox_dir", "",
 define_flag("obs_blackbox_events", 2048,
             "flight recorder ring capacity (structured events)",
             env="PADDLE_OBS_BLACKBOX_EVENTS")
+define_flag("obs_perf", False,
+            "arm the performance-attribution plane (observability/perf/): "
+            "capture XLA cost_analysis FLOPs/bytes per compiled program "
+            "(train step, decode engine, static run_program), derive "
+            "measured MFU + roofline classification, and serve them as "
+            "paddle_program_* gauges and the exporter's /programs endpoint",
+            env="PADDLE_OBS_PERF")
 
 # Resilience family (resilience/): checkpoint integrity verification; the
 # chaos engine reads its PADDLE_CHAOS_* env vars directly (lazily at the

@@ -48,6 +48,45 @@ def _bucket(n: int, q: int = 128) -> int:
     return -(-n // q) * q
 
 
+_perf_mod = None
+
+
+def _perf():
+    """Cached accessor for the perf-attribution plane; the off path costs
+    one global read + attribute check per COLD call site (program build,
+    chunk boundary) — never per token."""
+    global _perf_mod
+    if _perf_mod is None:
+        try:
+            from ..observability import perf as p
+        except Exception:
+            return None
+        _perf_mod = p
+    return _perf_mod
+
+
+def _flight_record(kind: str, name: str, **data) -> None:
+    """Request-lifecycle feed into the crash flight recorder (no-op one
+    global check when the black box is disarmed)."""
+    try:
+        from ..observability import flight
+
+        flight.record(kind, name, **data)
+    except Exception:
+        pass
+
+
+def _stamp(req, attr: str, value=None) -> None:
+    """Best-effort SLO timestamp on the request's result future —
+    engine-shaped foreign request objects (tests, benches) without a
+    GenerationResult simply don't get stamped."""
+    try:
+        setattr(req.result, attr,
+                time.perf_counter() if value is None else value)
+    except Exception:
+        pass
+
+
 class _Slot:
     __slots__ = ("req", "emitted", "budget")
 
@@ -106,7 +145,9 @@ class BatchDecodeEngine:
         self.top_ks = jnp.zeros((self.S,), jnp.int32)      # 0 = no filter
         self.key = jax.random.PRNGKey(0)
         self._admit_fns: Dict[int, object] = {}
-        self._decode_fn = jax.jit(self._decode_impl, donate_argnums=(1,))
+        self._decode_fn = jax.jit(self._decode_program(self.chunk),
+                                  donate_argnums=(1,))
+        self._decode_captured = False
         self._host_slots = [_Slot() for _ in range(self.S)]
         self._first_pending: Dict[int, object] = {}  # slot -> device scalar
         self.stats = {"tokens_out": 0, "requests": 0, "decode_calls": 0}
@@ -173,35 +214,43 @@ class BatchDecodeEngine:
                 top_ks.at[slot].set(top_k),
                 key, first)
 
-    def _decode_impl(self, params, caches, tokens, lens, active, temps,
-                     eos_ids, budgets, top_ks, key):
-        """``chunk`` decode steps over all slots in one program; per-slot
+    def _decode_program(self, n_steps: int):
+        """``n_steps`` decode steps over all slots in one program; per-slot
         eos (-1 = none) and budget countdown in-graph. Returns the packed
-        [slots, chunk+1] int32 host-sync payload (emitted tokens, -1 where
-        idle, last column = active flag)."""
+        [slots, n_steps+1] int32 host-sync payload (emitted tokens, -1
+        where idle, last column = active flag). A factory so the perf
+        plane can lower an ``n_steps=1`` variant for cost capture — XLA's
+        cost analysis counts a scan body ONCE regardless of trip count,
+        so the chunk program's own count would under-report by ~chunk."""
 
-        def body(carry, _):
-            caches, tokens, lens, active, budgets, key = carry
-            logits, caches = self._forward(params, tokens[:, None], caches,
-                                           lens)
-            rows = logits[:, 0].astype(jnp.float32)
-            key, sub = jax.random.split(key)
-            nxt = self._sample(rows, temps, top_ks, sub)
-            nxt = jnp.where(active, nxt, tokens)        # frozen when inactive
-            lens = lens + active.astype(jnp.int32)
-            emitted = jnp.where(active, nxt, -1)        # -1 = no token
-            budgets = budgets - active.astype(jnp.int32)
-            active = active & ~((eos_ids >= 0) & (nxt == eos_ids)) \
-                & (budgets > 0)
-            tokens = nxt
-            return (caches, tokens, lens, active, budgets, key), emitted
+        def impl(params, caches, tokens, lens, active, temps,
+                 eos_ids, budgets, top_ks, key):
+            def body(carry, _):
+                caches, tokens, lens, active, budgets, key = carry
+                logits, caches = self._forward(params, tokens[:, None],
+                                               caches, lens)
+                rows = logits[:, 0].astype(jnp.float32)
+                key, sub = jax.random.split(key)
+                nxt = self._sample(rows, temps, top_ks, sub)
+                nxt = jnp.where(active, nxt, tokens)    # frozen when inactive
+                lens = lens + active.astype(jnp.int32)
+                emitted = jnp.where(active, nxt, -1)    # -1 = no token
+                budgets = budgets - active.astype(jnp.int32)
+                active = active & ~((eos_ids >= 0) & (nxt == eos_ids)) \
+                    & (budgets > 0)
+                tokens = nxt
+                return (caches, tokens, lens, active, budgets, key), emitted
 
-        (caches, tokens, lens, active, budgets, key), out = jax.lax.scan(
-            body, (caches, tokens, lens, active, budgets, key), None,
-            length=self.chunk)
-        packed = jnp.concatenate([out.T, active[:, None].astype(jnp.int32)],
-                                 axis=1)                # [slots, chunk+1]
-        return caches, tokens, lens, active, budgets, key, packed
+            (caches_, tokens_, lens_, active_, budgets_, key_), out = \
+                jax.lax.scan(
+                    body, (caches, tokens, lens, active, budgets, key), None,
+                    length=n_steps)
+            packed = jnp.concatenate(
+                [out.T, active_[:, None].astype(jnp.int32)],
+                axis=1)                                 # [slots, n_steps+1]
+            return caches_, tokens_, lens_, active_, budgets_, key_, packed
+
+        return impl
 
     # -- host orchestration --------------------------------------------------
     def _admit(self, req) -> bool:
@@ -219,10 +268,6 @@ class BatchDecodeEngine:
                 f"engine max_len {self.L} (model max_position_embeddings "
                 f"{self.cfg.max_position_embeddings})")
         bucket = min(_bucket(plen), self.L)
-        fn = self._admit_fns.get(bucket)
-        if fn is None:
-            fn = jax.jit(self._admit_impl, donate_argnums=(1,))
-            self._admit_fns[bucket] = fn
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :plen] = ids
         temp = float(getattr(req, "temperature", 0.0) or 0.0)
@@ -233,14 +278,30 @@ class BatchDecodeEngine:
                 f"top_k {top_k} exceeds the continuous engine's static "
                 f"filter cap {self.TOP_K_CAP} (use the static serving mode "
                 "or lower top_k)")
+        args = (self.params, self.caches, self.lens, self.tokens, self.active,
+                self.temps, self.eos_ids, self.budgets, self.top_ks,
+                jnp.asarray(padded), jnp.int32(plen), jnp.int32(slot),
+                jnp.float32(temp), jnp.int32(-1 if eos is None else int(eos)),
+                jnp.int32(req.max_new_tokens), jnp.int32(top_k), self.key)
+        fn = self._admit_fns.get(bucket)
+        if fn is None:
+            fn = jax.jit(self._admit_impl, donate_argnums=(1,))
+            p = _perf()
+            if p is not None and p.enabled():
+                # capture the bucketed prefill program's exact cost; the
+                # AOT Compiled replaces the jit entry (one compile total)
+                compiled = p.capture_jit("serving.admit", fn, args,
+                                         bucket=f"p{bucket}", quant=self.quant
+                                         or "off")
+                if compiled is not None:
+                    fn = compiled
+            self._admit_fns[bucket] = fn
         (self.caches, self.lens, self.tokens, self.active, self.temps,
-         self.eos_ids, self.budgets, self.top_ks, self.key, first) = fn(
-            self.params, self.caches, self.lens, self.tokens, self.active,
-            self.temps, self.eos_ids, self.budgets, self.top_ks,
-            jnp.asarray(padded), jnp.int32(plen), jnp.int32(slot),
-            jnp.float32(temp), jnp.int32(-1 if eos is None else int(eos)),
-            jnp.int32(req.max_new_tokens), jnp.int32(top_k), self.key)
+         self.eos_ids, self.budgets, self.top_ks, self.key, first) = fn(*args)
         self._host_slots[slot] = _Slot(req, budget=int(req.max_new_tokens))
+        _stamp(req, "_t_admit")
+        _flight_record("request", str(getattr(req, "id", "?")),
+                       phase="admit", slot=slot, bucket=bucket, plen=plen)
         self._first_pending[slot] = first   # device scalar, synced at collect
         self.stats["requests"] += 1
         return True
@@ -253,6 +314,7 @@ class BatchDecodeEngine:
             eos = getattr(s.req, "eos_token_id", None)
             if eos is not None and eos in gen:
                 gen = gen[: gen.index(eos) + 1]   # trim past eos, keep it
+            _stamp(s.req, "_n_new", len(gen))
             s.req.result._set(output=np.concatenate(
                 [prompt, np.asarray(gen, np.int32)]))
         self._host_slots[slot] = _Slot()
@@ -264,11 +326,16 @@ class BatchDecodeEngine:
             return
         slots = sorted(self._first_pending)
         vals = np.asarray(jnp.stack([self._first_pending[i] for i in slots]))
+        now = time.perf_counter()
         for i, slot in enumerate(slots):
             s = self._host_slots[slot]
             if s.req is not None:
                 s.emitted.append(int(vals[i]))
                 self.stats["tokens_out"] += 1
+                # the prefill's sampled token reaching the HOST is the
+                # honest first-token time (TTFT numerator)
+                if getattr(s.req.result, "_t_first", 1) is None:
+                    _stamp(s.req, "_t_first", now)
         self._first_pending.clear()
 
     def reset_slots(self, slots=None):
@@ -298,13 +365,35 @@ class BatchDecodeEngine:
         return sum(1 for s in self._host_slots if s.req is not None)
 
     def _decode_chunk(self):
+        args = (self.params, self.caches, self.tokens, self.lens, self.active,
+                self.temps, self.eos_ids, self.budgets, self.top_ks, self.key)
+        p = _perf()
+        perf_on = p is not None and p.enabled()
+        if perf_on and not self._decode_captured:
+            self._decode_captured = True    # capture attempted once only
+            # lower (no backend compile) a 1-step variant and scale by
+            # chunk: XLA cost analysis counts the scan body once, so the
+            # chunk program's own count would under-report by ~chunk
+            p.cost_of_lowered(
+                "serving.decode", jax.jit(self._decode_program(1)), args,
+                bucket=f"s{self.S}c{self.chunk}", scale=float(self.chunk),
+                quant=self.quant or "off", slots=self.S, chunk=self.chunk)
+        # chunks right after an admission also pay the _collect_firsts
+        # readback inside this window; only PURE decode chunks are folded
+        # into the program's wall, so wall_min measures the decode
+        # program, not an extra link roundtrip
+        pure_decode = not self._first_pending
+        t0 = time.perf_counter()
         (self.caches, self.tokens, self.lens, self.active, self.budgets,
-         self.key, packed) = self._decode_fn(
-            self.params, self.caches, self.tokens, self.lens, self.active,
-            self.temps, self.eos_ids, self.budgets, self.top_ks, self.key)
+         self.key, packed) = self._decode_fn(*args)
         self.stats["decode_calls"] += 1
         self._collect_firsts()
         pk = np.asarray(packed)                 # the ONE sync per chunk
+        if perf_on and pure_decode:
+            # the packed readback IS this chunk's host sync, so the wall
+            # is real device time (plus the per-call link floor)
+            p.observe("serving.decode", time.perf_counter() - t0,
+                      bucket=f"s{self.S}c{self.chunk}")
         em, act = pk[:, :-1], pk[:, -1].astype(bool)
         for slot, s in enumerate(self._host_slots):
             if s.req is None:

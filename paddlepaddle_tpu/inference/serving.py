@@ -48,6 +48,7 @@ so an armed fault storm exercises the breaker exactly like a sick model.
 
 from __future__ import annotations
 
+import itertools
 import queue
 import sys
 import threading
@@ -80,6 +81,21 @@ _obs_srv = None
 
 _BREAKER_STATE_NUM = {"closed": 0, "half_open": 1, "open": 2}
 
+# process-wide request ids: the join key across SLO metrics, trace spans
+# (request#<id>) and flight-recorder lifecycle events
+_REQ_IDS = itertools.count(1)
+
+
+def _flight_record(kind: str, name: str, **data) -> None:
+    """Request-lifecycle feed into the crash flight recorder; one global
+    check when the black box is disarmed, never raises."""
+    try:
+        from ..observability import flight
+
+        flight.record(kind, name, **data)
+    except Exception:
+        pass
+
 
 def _safe_inc(name: str, help_: str, n: float = 1, **labels) -> None:
     """Cold-path fault counter (sheds, breaker flips, drains, hangs):
@@ -102,7 +118,12 @@ def _safe_set(name: str, help_: str, value: float, **labels) -> None:
 
 
 class GenerationResult:
-    """Future for one request."""
+    """Future for one request. Carries the request's lifecycle timestamps
+    (submit -> admit -> first token -> finish), stamped by the engine, so
+    TTFT / TPOT / queue-wait are measured per request — :meth:`slo`
+    returns them, and completed requests feed the
+    ``paddle_serving_{ttft,tpot,queue_wait,deadline_margin}_seconds``
+    histograms plus a ``request#<id>`` span in the trace."""
 
     def __init__(self):
         self._event = threading.Event()
@@ -110,6 +131,14 @@ class GenerationResult:
         self._error: Optional[BaseException] = None
         self._cancelled = False
         self._t_submit = time.perf_counter()
+        self._t_admit: Optional[float] = None     # decode-slot admission
+        self._t_first: Optional[float] = None     # first token on host
+        self._t_done: Optional[float] = None
+        self._n_new = 0                           # tokens generated
+        self._req_id: Optional[int] = None
+        self._deadline: Optional[float] = None    # absolute monotonic
+        self._streaming = True                    # False: tokens arrive as
+        #                       one batch (static mode) — TPOT meaningless
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -136,20 +165,93 @@ class GenerationResult:
             raise self._error
         return self._output
 
+    def slo(self) -> Dict[str, object]:
+        """Per-request SLO numbers (None where the lifecycle point was
+        never reached — e.g. a shed request has no TTFT). TPOT is the
+        per-output-token average after the first token; in static serving
+        mode there is no streaming, so TTFT equals full latency."""
+        end = self._t_done
+        t_first = self._t_first
+        return {
+            "req_id": self._req_id,
+            "new_tokens": self._n_new,
+            "queue_wait_s": (None if self._t_admit is None
+                             else self._t_admit - self._t_submit),
+            "ttft_s": (None if t_first is None
+                       else t_first - self._t_submit),
+            "tpot_s": (None if (t_first is None or end is None
+                                or self._n_new < 2 or not self._streaming)
+                       else (end - t_first) / (self._n_new - 1)),
+            "latency_s": None if end is None else end - self._t_submit,
+        }
+
     def _set(self, output=None, error=None):
         if self._event.is_set():
             return  # first outcome wins: a late writer (e.g. a retiring
         self._output = output   # slot racing stop()) must not flip a result
         self._error = error
+        self._t_done = now = time.perf_counter()
         self._event.set()
         obs = _obs_srv
+        outcome = ("ok" if error is None
+                   else "cancelled" if isinstance(error, RequestCancelledError)
+                   else "error")
         if obs is not None:
             if error is None:
-                obs("latency", time.perf_counter() - self._t_submit)
+                obs("latency", now - self._t_submit)
+                s = self.slo()
+                obs("slo", {
+                    "id": self._req_id,
+                    "latency": s["latency_s"],
+                    "ttft": s["ttft_s"],
+                    "tpot": s["tpot_s"],
+                    "queue_wait": s["queue_wait_s"],
+                    "deadline_margin": (None if self._deadline is None
+                                        else self._deadline
+                                        - time.monotonic()),
+                    "tokens": self._n_new,
+                })
             elif isinstance(error, RequestCancelledError):
                 obs("cancelled", 1)
             else:
                 obs("error", 1)
+        _flight_record(
+            "request", str(self._req_id or "?"), phase="finish",
+            outcome=outcome, tokens=self._n_new,
+            latency_ms=round((now - self._t_submit) * 1e3, 3),
+            **({} if self._t_first is None else
+               {"ttft_ms": round((self._t_first - self._t_submit) * 1e3, 3)}))
+
+
+def slo_summary(results) -> Dict[str, Optional[float]]:
+    """TTFT p50/p99, TPOT and queue-wait percentiles over completed
+    :class:`GenerationResult` futures — per-request lifecycle timestamps,
+    no metrics plane needed. The SLO block ``tools/serving_bench.py`` and
+    ``tools/quant_ab.py`` print beside tokens/s, and the numbers the
+    continuous-batching work (ROADMAP item 1) must not regress: aggregate
+    throughput that costs 10x TTFT is not a win."""
+    slos = [r.slo() for r in results]
+    ttfts = sorted(s["ttft_s"] for s in slos if s["ttft_s"] is not None)
+    tpots = sorted(s["tpot_s"] for s in slos if s["tpot_s"] is not None)
+    waits = sorted(s["queue_wait_s"] for s in slos
+                   if s["queue_wait_s"] is not None)
+
+    def pct(vals, q):
+        if not vals:
+            return None
+        return vals[min(len(vals) - 1, int(q * (len(vals) - 1) + 0.5))]
+
+    def ms(v):
+        return None if v is None else round(v * 1e3, 2)
+
+    return {
+        "ttft_p50_ms": ms(pct(ttfts, 0.50)),
+        "ttft_p99_ms": ms(pct(ttfts, 0.99)),
+        "tpot_ms": ms(pct(tpots, 0.50)),
+        "tpot_p99_ms": ms(pct(tpots, 0.99)),
+        "queue_wait_p50_ms": ms(pct(waits, 0.50)),
+        "queue_wait_p99_ms": ms(pct(waits, 0.99)),
+    }
 
 
 class GenerationRequest:
@@ -169,7 +271,10 @@ class GenerationRequest:
         self.top_k = int(top_k)
         self.eos_token_id = eos_token_id
         self.deadline = deadline            # absolute time.monotonic(), or None
+        self.id = next(_REQ_IDS)
         self.result = GenerationResult()
+        self.result._req_id = self.id
+        self.result._deadline = deadline
 
     def batch_key(self):
         # static-shape batching: same prompt length and sampling config share
@@ -412,6 +517,10 @@ class ServingEngine:
             prompt_ids, max_new_tokens, temperature, top_k, eos_token_id,
             deadline=None if dl is None else time.monotonic() + dl)
         self._check_admission(req)
+        _flight_record("request", str(req.id), phase="submit",
+                       prompt=req.prompt_ids.shape[1],
+                       budget=req.max_new_tokens,
+                       queue_depth=self._queue_depth())
         if self._thread is None:
             self.start()  # lazy start: a future must always have a server
         self._bump("requests")
@@ -738,8 +847,18 @@ class ServingEngine:
         self._hang_tripped = False
         self._decode_started_at = time.monotonic()
         try:
+            from ..observability.recorder import trace_region
+
+            region = trace_region("serving.decode_chunk", "serving")
+        except Exception:
+            region = None
+        try:
             chaos_point("serving.decode")
-            fn()
+            if region is not None:
+                with region:
+                    fn()
+            else:
+                fn()
         finally:
             dt = time.monotonic() - self._decode_started_at
             self._decode_started_at = None
@@ -794,15 +913,27 @@ class ServingEngine:
     def _run_static_batch(self, batch: List[GenerationRequest]) -> None:
         ids = np.concatenate([r.prompt_ids for r in batch], axis=0)
         leader = batch[0]
+        t_admit = time.perf_counter()
+        for req in batch:
+            req.result._t_admit = t_admit
         out = self.model.generate_cached(
             ids,
             max_new_tokens=max(r.max_new_tokens for r in batch),
             temperature=leader.temperature, top_k=leader.top_k,
             eos_token_id=leader.eos_token_id)
         out = np.asarray(out.numpy())
-        plen = leader.prompt_ids.shape[1]
+        t_first = time.perf_counter()  # no streaming in static mode: the
+        plen = leader.prompt_ids.shape[1]  # first token lands with the batch
         for i, req in enumerate(batch):
             row = out[i, : plen + req.max_new_tokens]
+            req.result._t_first = t_first     # TTFT == full latency here
+            req.result._streaming = False     # ... and TPOT is undefined,
+            # not "microseconds/token" — slo() reports it as None
+            gen = row[plen:]
+            eos = req.eos_token_id
+            if eos is not None and eos in gen:  # don't count post-eos pad
+                gen = gen[: int(np.argmax(gen == eos)) + 1]
+            req.result._n_new = len(gen)
             req.result._set(output=row)
 
     def _sweep_slots(self) -> None:

@@ -47,6 +47,7 @@ class TrainStep:
         donate_argnums = (0, 1) if donate else ()
         self._step = jax.jit(self._step_impl, donate_argnums=donate_argnums)
         self._step_count = 0
+        self._cost_captured = False
 
     def _step_impl(self, params, opt_state, batch, key, lr):
         from ..core import autograd as _ag
@@ -96,10 +97,37 @@ class TrainStep:
         )
         key = prandom.next_key()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        if not self._cost_captured:
+            self._maybe_capture_cost(batch_arrays, key, lr)
         self.params, self.opt_state, loss = self._step(
             self.params, self.opt_state, batch_arrays, key, lr)
         self._step_count += 1
         return wrap(loss)
+
+    def _maybe_capture_cost(self, batch_arrays, key, lr) -> None:
+        """With the perf plane armed (PADDLE_OBS_PERF), lower the step
+        program (trace only — no extra backend compile, the jit path
+        compiles as usual) so its XLA FLOPs/bytes land in the program
+        cost registry. Wall time is NOT observed here (``__call__``
+        returns before the device finishes; an async wall would fake the
+        MFU) — bracket steps with ``obs.perf.step()`` or sync-and-
+        ``observe`` yourself, as bench.py does. With ``grad_accum > 1``
+        the microbatch scan body is counted ONCE by XLA's analysis, so
+        the count is scaled by grad_accum (recorded as ``cost_scale``;
+        the optimizer update rides the scale — a ~(a-1)*10 flops/param
+        overcount, noise against the 6N-scale step)."""
+        self._cost_captured = True
+        try:
+            from ..observability import perf as _perf
+        except Exception:
+            return
+        if not _perf.enabled():
+            return
+        _perf.cost_of_lowered(
+            "train.step", self._step,
+            (self.params, self.opt_state, batch_arrays, key, lr),
+            bucket=f"accum{self.grad_accum}", scale=float(self.grad_accum),
+            model=type(self.model).__name__)
 
     def sync_to_model(self):
         """Write the functional params back into the eager model handles.

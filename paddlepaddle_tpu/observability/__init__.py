@@ -39,7 +39,7 @@ import os
 from typing import Optional
 
 from ..core import flags as _flags
-from . import flight, watchdog
+from . import flight, perf, watchdog
 from .metrics import (  # noqa: F401
     BYTES_BUCKETS,
     LATENCY_BUCKETS,
@@ -158,6 +158,18 @@ def _make_hooks():
                            "generation requests waiting for a decode slot")
     srv_batches = reg.counter("paddle_serving_batches_total",
                               "decode attempts, by outcome (ok/error)")
+    # request-lifecycle SLO surface (perf attribution plane): the numbers
+    # a serving router load-balances on
+    srv_ttft = reg.histogram("paddle_serving_ttft_seconds",
+                             "submit-to-first-token latency (TTFT)")
+    srv_tpot = reg.histogram("paddle_serving_tpot_seconds",
+                             "per-output-token latency after the first "
+                             "(TPOT, per-request average)")
+    srv_qwait = reg.histogram("paddle_serving_queue_wait_seconds",
+                              "submit-to-decode-slot-admission queue wait")
+    srv_margin = reg.histogram("paddle_serving_deadline_margin_seconds",
+                               "seconds left on the request deadline at "
+                               "completion (near-zero = deadlines too tight)")
 
     def obs_op(name, dur):
         if _metrics_on:
@@ -203,16 +215,25 @@ def _make_hooks():
                                 {"bytes": nbytes} if nbytes else None)
 
     def obs_io(event, value):
+        if event == "wait":
+            if _metrics_on:
+                io_wait.observe(value)
+            if _trace_on:
+                # a "dataloader" span so the StepTimeline can attribute
+                # blocked-on-input time as its own step phase
+                rec.record_complete("dataloader_wait", "dataloader", value)
+            return
         if not _metrics_on:
             return
-        if event == "wait":
-            io_wait.observe(value)
-        elif event == "qdepth":
+        if event == "qdepth":
             io_depth.set(value)
         elif event == "batch":
             io_batches.inc(value)
 
     def obs_srv(event, value):
+        if event == "slo":
+            obs_slo(value)
+            return
         if not _metrics_on:
             return
         if event == "latency":
@@ -228,6 +249,25 @@ def _make_hooks():
             srv_qdepth.set(value)
         elif event == "batch":
             srv_batches.inc(outcome=value)
+
+    def obs_slo(d):
+        """One completed request's lifecycle numbers (dict from the
+        serving engine): SLO histograms + a request span in the trace."""
+        if _metrics_on:
+            if d.get("ttft") is not None:
+                srv_ttft.observe(d["ttft"])
+            if d.get("tpot") is not None:
+                srv_tpot.observe(d["tpot"])
+            if d.get("queue_wait") is not None:
+                srv_qwait.observe(d["queue_wait"])
+            if d.get("deadline_margin") is not None:
+                srv_margin.observe(d["deadline_margin"])
+        if _trace_on and d.get("latency") is not None:
+            rec.record_complete(
+                f"request#{d.get('id', '?')}", "serving.request",
+                d["latency"],
+                {k: v for k, v in d.items()
+                 if k != "latency" and v is not None})
 
     return {
         "op": obs_op, "amp": obs_amp, "node": obs_node, "task": obs_task,
@@ -306,10 +346,12 @@ def disable() -> None:
 
 
 def reset() -> None:
-    """Clear the ring buffer, all metric values, and watchdog state."""
+    """Clear the ring buffer, all metric values, watchdog state, and the
+    perf plane (program costs + step timeline)."""
     _recorder.clear()
     _registry.clear()
     watchdog.reset()
+    perf.reset()
 
 
 # ---------------------------------------------------------------------------
@@ -321,6 +363,12 @@ def snapshot() -> dict:
 
 
 def to_prometheus_text() -> str:
+    # lazy publication: every scrape sees fresh paddle_program_* roofline
+    # gauges without the perf plane paying a per-step publish
+    try:
+        perf.publish_gauges()
+    except Exception:
+        pass
     return _registry.to_prometheus_text()
 
 
@@ -458,6 +506,30 @@ def summary(top: int = 30) -> str:
             name = {0: "closed", 1: "half_open", 2: "open"}.get(
                 int(breaker), "?")
             lines.append(f"breaker: {name}")
+        ttft = snap.get("paddle_serving_ttft_seconds", {}).get((), None)
+        if ttft and ttft.get("count"):
+            h_t = _registry.get("paddle_serving_ttft_seconds")
+            tpot = snap.get("paddle_serving_tpot_seconds", {}).get((), {})
+            lines.append(
+                f"SLO: ttft p50={h_t.quantile(0.5) * 1e3:.1f}ms "
+                f"p99={h_t.quantile(0.99) * 1e3:.1f}ms "
+                f"({ttft['count']} requests)  tpot_avg="
+                f"{tpot.get('sum', 0.0) / max(tpot.get('count', 1), 1) * 1e3:.2f}"
+                f"ms/token")
+
+    try:
+        cost_rows = perf.registry().table()
+    except Exception:
+        cost_rows = []
+    if cost_rows:
+        _section(lines, "Program roofline (XLA cost_analysis x measured "
+                        "wall, perf plane)")
+        lines.append(perf.costs.render_table(cost_rows[:top]))
+
+    tl = perf._timeline
+    if tl is not None and tl.count:
+        _section(lines, "Step time decomposition")
+        lines.append(tl.render())
 
     region_stats = _recorder.stats()
     if region_stats and _trace_on:
@@ -595,6 +667,6 @@ __all__ = [
     "RecordEvent", "trace_region", "exponential_buckets",
     "enable", "disable", "reset", "is_enabled", "safe_inc", "safe_set",
     "get_recorder", "get_registry", "snapshot", "to_prometheus_text",
-    "export_chrome_trace", "summary", "watchdog", "flight",
+    "export_chrome_trace", "summary", "watchdog", "flight", "perf",
     "start_exporter", "stop_exporter",
 ]

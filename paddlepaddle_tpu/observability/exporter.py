@@ -17,7 +17,10 @@ loop beyond reading the registry/recorder:
   here); 503 when any provider reports not-ok;
 * ``/vars``     — the full metrics ``snapshot()`` as JSON;
 * ``/trace``    — the host span ring buffer as chrome-trace JSON (load in
-  Perfetto directly).
+  Perfetto directly);
+* ``/programs`` — the perf plane's program-cost table (XLA FLOPs/bytes,
+  measured wall, roofline classification) as JSON; rendered by
+  ``obsctl programs``.
 
 Auto-started per worker when ``PADDLE_OBS_EXPORT=1`` (``FLAGS_obs_export``)
 — ``distributed.launch --obs_export`` sets that for every rank it spawns.
@@ -130,6 +133,7 @@ class TelemetryExporter:
         self.register_route("/healthz", self._healthz)
         self.register_route("/vars", self._vars)
         self.register_route("/trace", self._trace)
+        self.register_route("/programs", self._programs)
 
     def _index(self):
         return 200, _JSON, json.dumps(
@@ -155,6 +159,13 @@ class TelemetryExporter:
         from . import get_recorder
 
         return 200, _JSON, json.dumps(get_recorder().to_chrome_trace())
+
+    def _programs(self):
+        from . import perf
+
+        body = dict(perf.table_jsonable(), enabled=perf.enabled(),
+                    rank=_rank())
+        return 200, _JSON, json.dumps(body, allow_nan=False, default=str)
 
     def _healthz(self):
         from . import _metrics_on, _trace_on, _watchdog_on

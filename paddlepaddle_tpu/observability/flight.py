@@ -65,7 +65,10 @@ _annotations: dict = {}
 
 def annotate(key: str, value) -> None:
     """Attach a key to every future black-box header (e.g. the serving
-    engine's quant mode). Values must be JSON-serializable."""
+    engine's quant mode). Values must be JSON-serializable — or a
+    zero-argument callable returning one, resolved at dump time (how the
+    perf plane keeps the program-cost table in crash dumps current
+    without re-annotating on every observation)."""
     _annotations[str(key)] = value
 
 
@@ -191,7 +194,15 @@ class FlightRecorder:
             "buffered_events": len(events),
         }]
         if _annotations:
-            lines[0]["annotations"] = dict(_annotations)
+            resolved = {}
+            for k, v in dict(_annotations).items():
+                if callable(v):
+                    try:
+                        v = v()
+                    except Exception as e:   # a sick annotation must not
+                        v = f"<annotation failed: {e!r}>"  # sink the dump
+                resolved[k] = v
+            lines[0]["annotations"] = resolved
         for ev in events:
             lines.append(dict(ev, rec="event"))
         if exc_info is not None:

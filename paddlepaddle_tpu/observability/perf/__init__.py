@@ -1,0 +1,118 @@
+"""Performance attribution plane — measured rooflines, step decomposition.
+
+Three tools on top of the recorder/metrics/exporter pipeline:
+
+* :mod:`~.costs` — program cost registry: exact XLA ``cost_analysis()``
+  FLOPs/bytes per compiled program (train step, the decode engine's
+  bucketed programs, static ``run_program``), combined with measured
+  wall time and the device peak specs (:mod:`~.device`) into measured
+  MFU, bandwidth utilization and a compute-vs-bandwidth-bound roofline
+  classification. Exported as ``paddle_program_*`` gauges, the
+  exporter's ``/programs`` endpoint, and ``obsctl programs``;
+* :mod:`~.steptime` — :class:`~.steptime.StepTimeline`: per-step phase
+  breakdown (compute / host dispatch / comm / data-wait) diffed from the
+  recorder's category aggregates, rendered in ``summary()`` and as
+  Perfetto counter tracks;
+* request-lifecycle SLO tracing lives in the serving engine itself
+  (TTFT/TPOT/queue-wait histograms through the standard serving hook)
+  — this package only defines the arming switch they share.
+
+Off by default: arm with ``PADDLE_OBS_PERF=1`` / ``FLAGS_obs_perf`` or
+:func:`enable`. When off, instrumented call sites pay one cached-module
+attribute read; when on, cost capture happens ONCE per compiled program
+(riding the AOT compile the call site was going to do anyway) and wall
+observation is a dict update per execution.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ...core import flags as _flags
+from . import costs, device, steptime  # noqa: F401
+from .costs import (  # noqa: F401
+    CostRegistry,
+    capture_jit,
+    cost_of_jit,
+    cost_of_lowered,
+    observe,
+    registry,
+    table_jsonable,
+)
+from .steptime import StepTimeline  # noqa: F401
+
+_enabled = False
+_timeline: Optional[StepTimeline] = None
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable() -> None:
+    """Arm cost capture + SLO attribution (idempotent). Programs compiled
+    BEFORE enabling are not retro-captured — arm before building engines
+    / train steps (or set ``PADDLE_OBS_PERF=1`` in the environment)."""
+    global _enabled
+    _enabled = True
+    _flags.set_flags({"obs_perf": True})
+    # crash dumps carry the live program-cost table: resolved at dump
+    # time (flight supports callable annotations), so the black box of a
+    # dying serving host names its programs and their measured rooflines
+    try:
+        from .. import flight
+
+        flight.annotate("program_costs",
+                        lambda: registry().table())
+    except Exception:
+        pass
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+    _flags.set_flags({"obs_perf": False})
+
+
+def reset() -> None:
+    """Clear captured costs, observations and the step timeline."""
+    registry().clear()
+    if _timeline is not None:
+        _timeline.clear()
+
+
+def timeline() -> StepTimeline:
+    """The module StepTimeline (created on first use; ``summary()`` renders
+    it when it has steps)."""
+    global _timeline
+    if _timeline is None:
+        _timeline = StepTimeline()
+    return _timeline
+
+
+def step(name: str = "step"):
+    """Convenience: ``with obs.perf.step("train"): ...`` brackets one step
+    on the module timeline."""
+    return timeline().step(name)
+
+
+def publish_gauges() -> None:
+    """Mirror the cost table into ``paddle_program_*`` gauges on the
+    observability registry (called from ``to_prometheus_text()``)."""
+    from .. import get_registry
+
+    costs.publish_gauges(get_registry())
+
+
+# arm from env (PADDLE_OBS_PERF) at import — same contract as the other
+# obs subsystems
+if _flags.flag_value("obs_perf"):
+    enable()
+
+__all__ = [
+    "enabled", "enable", "disable", "reset",
+    "capture_jit", "cost_of_jit", "cost_of_lowered", "observe", "registry",
+    "table_jsonable", "publish_gauges",
+    "timeline", "step", "StepTimeline", "CostRegistry",
+    "costs", "device", "steptime",
+]

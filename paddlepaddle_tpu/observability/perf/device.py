@@ -1,0 +1,78 @@
+"""Device peak specs — the denominators of every roofline number.
+
+One table for peak matmul FLOP/s (the MFU denominator ``bench.py`` has
+used since round 1, moved here so the cost registry and the bench share
+one definition) and one for peak HBM bandwidth (the bandwidth-bound half
+of the roofline). Values are the published per-chip peaks for the bf16
+MXU path; unknown accelerators fall back to the v4 numbers, CPU to
+deliberately tiny figures so CPU smoke runs still produce finite,
+obviously-not-a-TPU utilization numbers.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+# (device_kind substring, peak bf16 FLOP/s, peak HBM bytes/s)
+_TABLE = [
+    ("v6", 918e12, 1640e9),   # Trillium
+    ("v5p", 459e12, 2765e9),
+    ("v5", 197e12, 819e9),    # v5 lite (v5e)
+    ("v4", 275e12, 1228e9),
+    ("v3", 123e12, 900e9),
+    ("v2", 45e12, 700e9),
+]
+_DEFAULT_ACCEL = (275e12, 1228e9)   # unknown accelerator: assume v4-class
+_DEFAULT_CPU = (1e12, 100e9)        # container CPU: keeps ratios finite
+
+
+def _lookup(device) -> tuple:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, flops, bw in _TABLE:
+        if key in kind:
+            return flops, bw
+    if getattr(device, "platform", "cpu") in ("tpu", "axon"):
+        return _DEFAULT_ACCEL
+    return _DEFAULT_CPU
+
+
+def peak_flops(device=None) -> float:
+    """Peak bf16 matmul FLOP/s for ``device`` (default: jax.devices()[0])."""
+    return specs(device)["peak_flops"] if device is None \
+        else _lookup(device)[0]
+
+
+def peak_hbm_bytes_per_s(device=None) -> float:
+    """Peak HBM bandwidth in bytes/s."""
+    return specs(device)["peak_hbm_bytes_per_s"] if device is None \
+        else _lookup(device)[1]
+
+
+_specs: Optional[dict] = None
+
+
+def specs(device=None) -> dict:
+    """Resolved peak-spec dict for the process's default device (cached —
+    the registry derives every roofline number from it). Passing a device
+    bypasses the cache."""
+    global _specs
+    if device is not None:
+        flops, bw = _lookup(device)
+        return {
+            "device": str(getattr(device, "device_kind", "")
+                          or getattr(device, "platform", "?")),
+            "platform": getattr(device, "platform", "?"),
+            "peak_flops": flops,
+            "peak_hbm_bytes_per_s": bw,
+            "ridge_flops_per_byte": flops / bw,
+        }
+    if _specs is None:
+        import jax
+
+        _specs = specs(jax.devices()[0])
+    return _specs
+
+
+def reset_cache() -> None:
+    global _specs
+    _specs = None

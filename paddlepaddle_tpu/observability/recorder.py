@@ -35,28 +35,32 @@ _PID = 0  # single-process timeline; multi-host traces merge on rank metadata
 
 
 class Event:
-    """One completed span (chrome trace-event "X" phase)."""
+    """One completed span (chrome trace-event "X" phase), or — with
+    ``ph="C"`` — a counter sample rendered by Perfetto as a stacked
+    counter track (the step-time phase tracks)."""
 
-    __slots__ = ("name", "cat", "ts_us", "dur_us", "tid", "args")
+    __slots__ = ("name", "cat", "ts_us", "dur_us", "tid", "args", "ph")
 
-    def __init__(self, name, cat, ts_us, dur_us, tid, args=None):
+    def __init__(self, name, cat, ts_us, dur_us, tid, args=None, ph="X"):
         self.name = name
         self.cat = cat
         self.ts_us = ts_us
         self.dur_us = dur_us
         self.tid = tid
         self.args = args
+        self.ph = ph
 
     def to_chrome(self) -> dict:
         ev = {
             "name": self.name,
             "cat": self.cat,
-            "ph": "X",
+            "ph": self.ph,
             "ts": self.ts_us,
-            "dur": self.dur_us,
             "pid": _PID,
             "tid": self.tid,
         }
+        if self.ph == "X":
+            ev["dur"] = self.dur_us
         if self.args:
             ev["args"] = self.args
         return ev
@@ -134,6 +138,17 @@ class Recorder:
         now = time.perf_counter()
         self._record(name, cat, now, now, args)
 
+    def counter_track(self, name: str, values: dict,
+                      cat: str = "counter") -> None:
+        """Chrome "C" (counter) sample: Perfetto draws one stacked track
+        per name with one series per key in ``values``. Counter samples
+        ride the same ring buffer but stay OUT of the span aggregates
+        (they have no duration)."""
+        ev = Event(name, cat, int(time.perf_counter() * 1e6), 0,
+                   threading.get_ident(),
+                   {k: float(v) for k, v in values.items()}, ph="C")
+        self._events.append(ev)
+
     # -- introspection -------------------------------------------------------
 
     def depth(self) -> int:
@@ -152,6 +167,15 @@ class Recorder:
         except IndexError:
             return (0, None)
         return (len(self._events), (last.ts_us, last.dur_us, last.name))
+
+    def cat_totals(self) -> Dict[str, float]:
+        """Total recorded seconds per span category — the StepTimeline
+        diffs two of these to attribute one step's wall time to phases."""
+        with self._lock:
+            out: Dict[str, float] = {}
+            for (c, _name), v in self._stats.items():
+                out[c] = out.get(c, 0.0) + v[1]
+        return out
 
     def stats(self, cat: Optional[str] = None) -> Dict[str, tuple]:
         """name -> (count, total_s, min_s, max_s), a consistent copy.

@@ -25,6 +25,7 @@ dependency analysis and kernel scheduling are absorbed by XLA's scheduler.
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional, Sequence
 
 import jax
@@ -32,6 +33,21 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor
+
+_perf_mod = None
+
+
+def _perf():
+    """Cached perf-plane accessor (cost capture for compiled static
+    programs); None when observability cannot import."""
+    global _perf_mod
+    if _perf_mod is None:
+        try:
+            from ..observability import perf as p
+        except Exception:
+            return None
+        _perf_mod = p
+    return _perf_mod
 
 
 class StaticVariable(Tensor):
@@ -485,13 +501,40 @@ def run_program(prog, feed, fetch_vars, train=True):
         cached = (jfn, params, feed_names, extras, tuple(fetch_vars))
         prog._exec_cache[key] = cached
     jfn, params, feed_names, extras = cached[:4]
-    outs, extra_vals = jfn(
-        tuple(feed_arrays[n] for n in feed_names),
-        tuple(p._data for p in params))
+    feed_t = tuple(feed_arrays[n] for n in feed_names)
+    param_t = tuple(p._data for p in params)
+    perf = _perf()
+    perf_on = perf is not None and perf.enabled()
+    bucket = None
+    if perf_on:
+        # the exec cache keys on feed NAMES, not shapes (jit retraces a
+        # new batch shape transparently — execution must stay on the jit
+        # path), so the cost bucket carries the SHAPES: each shape gets
+        # its own row, its own lowering-captured flops, and its own
+        # walls — never a small batch's wall under a big batch's flops
+        shapes = ",".join("x".join(map(str, a.shape)) or "s" for a in feed_t)
+        bucket = (f"v{prog._version}:{'train' if train else 'eval'}"
+                  f":{shapes or 'noshape'}")
+        from ..observability.perf import costs as _costs
+
+        pc = _costs.registry()._get("static.run_program", bucket)
+        if pc.flops is None and not pc.meta.get("capture_attempted"):
+            pc.meta["capture_attempted"] = True   # once per shape, even
+            perf.cost_of_lowered("static.run_program", jfn,  # on failure
+                                 (feed_t, param_t), bucket=bucket)
+    t0 = time.perf_counter()
+    outs, extra_vals = jfn(feed_t, param_t)
     if train:
         for (target, _src, _op), val in zip(prog._state_writes, extra_vals):
             target._replace_data(val.astype(target._data.dtype))
-    return [Tensor._from_data(o, stop_gradient=True) for o in outs]
+    result = [Tensor._from_data(o, stop_gradient=True) for o in outs]
+    if perf_on:
+        # host-observed dispatch-to-return wall: exact on synchronous
+        # backends (CPU), a lower bound on an async accelerator unless
+        # the caller materializes the fetches
+        perf.observe("static.run_program", time.perf_counter() - t0,
+                     bucket=bucket)
+    return result
 
 
 # ---------------------------------------------------------------------------

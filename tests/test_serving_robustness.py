@@ -43,6 +43,16 @@ from paddlepaddle_tpu.inference.serving import GenerationRequest
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _await_breaker_closed(eng, timeout=5.0):
+    """The engine loop records breaker success AFTER the request future is
+    delivered, so sampling .state right after result() races the loop
+    thread by a few microseconds — poll with a deadline instead."""
+    deadline = time.time() + timeout
+    while time.time() < deadline and eng._breaker.state != "closed":
+        time.sleep(0.02)
+    return eng._breaker.state
+
+
 class _Out:
     def __init__(self, a):
         self._a = a
@@ -251,7 +261,7 @@ def test_breaker_opens_then_recovers_static():
             pytest.fail("breaker never let the probe through")
         assert saw_open
         f.result(10)       # half-open probe succeeded (failures exhausted)
-        assert eng._breaker.state == "closed"
+        assert _await_breaker_closed(eng) == "closed"
         assert eng.health()["ok"]
         assert eng.stats["decode_failures"] == 3
         assert eng.stats["batches_failed"] == 3
@@ -536,7 +546,7 @@ def test_chaos_decode_storm_opens_breaker_then_recovers():
             eng.submit(_prompt(), max_new_tokens=2)
         time.sleep(0.25)                  # storm exhausted + reset window
         eng.submit(_prompt(), max_new_tokens=2).result(10)
-        assert eng._breaker.state == "closed"
+        assert _await_breaker_closed(eng) == "closed"
         assert chaos.fire_counts()["serving.decode"] == 3
         assert eng.health()["ok"]
     finally:
@@ -615,7 +625,7 @@ def test_chaos_continuous_breaker_recovery():
         time.sleep(0.25)                  # storm exhausted + reset window
         out = eng.submit(p, max_new_tokens=4).result(120)   # recovered
         assert out.shape[0] == 12
-        assert eng._breaker.state == "closed"
+        assert _await_breaker_closed(eng) == "closed"
         assert eng.stats["decode_failures"] >= 2
     finally:
         chaos.disable()

@@ -12,7 +12,11 @@ the instrumented entry point (``apply_op``) vs the uninstrumented inner
    boundaries, collectives, faults) sit outside the op loop, so the
    enabled hot path must also stay under the budget;
 3. **exporter running** — a live (idle) telemetry HTTP server on a daemon
-   thread must not tax the loop either.
+   thread must not tax the loop either;
+4. **perf plane armed** — ``PADDLE_OBS_PERF`` on: cost capture rides
+   compile boundaries (once per program) and wall observation rides
+   chunk/step boundaries, so the per-op dispatch path must stay at the
+   bare branch cost.
 
 A step-bracket microbench is printed for information (the per-step cost of
 the watchdog/flight step seam) but not gated — steps are milliseconds-to-
@@ -186,6 +190,16 @@ def main() -> int:
                 lambda: measure(args.ops, args.repeats,
                                 setup=_start_exporter,
                                 teardown=_stop_exporter),
+                args.ops, args.budget)
+
+    # gate 4: perf-attribution plane armed (cost capture lives at compile
+    # boundaries, not in dispatch — the op loop must not notice)
+    from paddlepaddle_tpu.observability import perf
+
+    rc |= _gate("perf-on",
+                lambda: measure(args.ops, args.repeats,
+                                setup=perf.enable,
+                                teardown=perf.disable),
                 args.ops, args.budget)
 
     _step_bracket_info()

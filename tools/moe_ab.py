@@ -113,7 +113,11 @@ def main():
             cfg, step = build(v)
             ids = np.random.default_rng(0).integers(
                 0, cfg.vocab_size, (8, 1024)).astype(np.int32)
-            dt, loss = bench._time_steps(step, ids, 8)
+            # per-variant tag: all variants share shapes, so a shared tag
+            # would mix one variant's flops with another's wall_min in the
+            # cost registry's ("bench.<tag>", "per_step") row
+            dt, loss, _cost = bench._time_steps(step, ids, 8,
+                                                tag=f"moe_ab_{v}")
             toks = 8 * 1024 * 8 / dt
             print(f"{v:12s} {dt/8*1e3:7.2f} ms/step  {toks:8.0f} tok/s  "
                   f"loss={float(np.asarray(loss)):.3f}", flush=True)

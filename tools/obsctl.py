@@ -18,14 +18,20 @@ Subcommands:
       observability.export_chrome_trace) into ONE Perfetto file, one pid
       per rank (rank = argument position; use --ranks to override).
 
+  programs TARGET [--json]
+      Render one exporter's /programs endpoint — the perf plane's
+      per-program roofline table (XLA FLOPs/bytes, measured wall, MFU,
+      bandwidth utilization, compute/bandwidth-bound classification).
+
   blackbox tail [--dir DIR] [-n N] [--raw]
       Render the newest flight-recorder dump in DIR (default:
       $PADDLE_OBS_BLACKBOX_DIR or <tmpdir>/paddle_blackbox): header, the
       last N events, in-flight steps/tasks, and thread-stack summaries.
 
-`scrape` and `blackbox tail` are stdlib-only (fast, safe on a box where
-the framework cannot import); `aggregate`/`merge-trace` import the
-observability package for the strict exposition parser and trace merger.
+`scrape`, `programs` and `blackbox tail` are stdlib-only (fast, safe on a
+box where the framework cannot import); `aggregate`/`merge-trace` import
+the observability package for the strict exposition parser and trace
+merger.
 """
 
 from __future__ import annotations
@@ -68,6 +74,56 @@ def cmd_scrape(args) -> int:
         sys.stderr.write(f"[obsctl] {args.target}{args.path}: {e}\n")
         return 1
     sys.stdout.write(body.decode(errors="replace"))
+    return 0
+
+
+def _fnum(v, suffixes=((1e12, "T"), (1e9, "G"), (1e6, "M"), (1e3, "k"))):
+    if v is None:
+        return "-"
+    for scale, suf in suffixes:
+        if abs(v) >= scale:
+            return f"{v / scale:.2f}{suf}"
+    return f"{v:.3g}"
+
+
+def cmd_programs(args) -> int:
+    """Stdlib-only /programs renderer (mirrors perf.costs.render_table so
+    it works on a box where the framework cannot import)."""
+    try:
+        status, body = _get(args.target, "/programs", args.timeout)
+    except (urllib.error.URLError, OSError) as e:
+        sys.stderr.write(f"[obsctl] {args.target}/programs: {e}\n")
+        return 1
+    if status != 200:
+        sys.stderr.write(f"[obsctl] {args.target}/programs: HTTP {status}\n")
+        return 1
+    doc = json.loads(body)
+    if args.json:
+        print(json.dumps(doc, indent=1))
+        return 0
+    dev = doc.get("device") or {}
+    print(f"[programs] {args.target}  device={dev.get('device')}  "
+          f"peak={_fnum(dev.get('peak_flops'))}FLOP/s  "
+          f"hbm={_fnum(dev.get('peak_hbm_bytes_per_s'))}B/s  "
+          f"perf_plane={'on' if doc.get('enabled') else 'off'}")
+    rows = doc.get("programs") or []
+    if not rows:
+        print("  (no programs captured — arm PADDLE_OBS_PERF=1 before "
+              "building engines/train steps)")
+        return 0
+    print(f"  {'Program':<28}{'Bucket':>10}{'Calls':>7}{'FLOPs':>9}"
+          f"{'Bytes':>9}{'Wall(ms)':>10}{'MFU':>7}{'BW%':>7}  Bound")
+    for r in rows:
+        wall = r.get("wall_s_min")
+        mfu = r.get("mfu")
+        bw = r.get("hbm_util")
+        print(f"  {str(r.get('program'))[:28]:<28}"
+              f"{str(r.get('bucket', ''))[:10]:>10}{r.get('calls', 0):>7}"
+              f"{_fnum(r.get('flops')):>9}{_fnum(r.get('hbm_bytes')):>9}"
+              f"{'-' if wall is None else format(wall * 1e3, '.3f'):>10}"
+              f"{'-' if mfu is None else format(mfu, '.3f'):>7}"
+              f"{'-' if bw is None else format(bw * 100, '.1f'):>7}"
+              f"  {r.get('bound', '-')}")
     return 0
 
 
@@ -241,6 +297,14 @@ def main(argv=None) -> int:
     p.add_argument("--path", default="/metrics")
     p.add_argument("--timeout", type=float, default=5.0)
     p.set_defaults(fn=cmd_scrape)
+
+    p = sub.add_parser("programs",
+                       help="render one exporter's /programs roofline table")
+    p.add_argument("target", help="host:port or URL of a per-rank exporter")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw JSON instead of the table")
+    p.add_argument("--timeout", type=float, default=5.0)
+    p.set_defaults(fn=cmd_programs)
 
     p = sub.add_parser("aggregate",
                        help="merge /metrics from several exporters")

@@ -1,0 +1,226 @@
+#!/usr/bin/env python
+"""Perf regression gate — compare bench/serving artifacts against a baseline.
+
+The first automated guard on the r01->r05 perf trajectory: given a baseline
+record (a ``BENCH_r*.json`` driver artifact or a raw ``bench.py`` JSON line)
+and a current one, compare every shared metric with direction-aware
+tolerances and exit nonzero on regression:
+
+* **higher-is-better** (tokens/s, images/s, MFU): regression when
+  ``(base - cur) / base > tol`` (default ``--tol 0.05``);
+* **lower-is-better** (TTFT p50/p99, TPOT, step_ms): regression when
+  ``(cur - base) / base > tol-latency`` (default 0.25 — latency tails are
+  noisier than throughput means).
+
+Serving SLO artifacts (the JSON lines ``tools/serving_bench.py`` /
+``tools/quant_ab.py`` print) are compared with ``--serving CUR BASE``.
+Metrics present in the baseline but missing from the current artifact are
+reported as warnings (``--strict`` promotes them to failures): a bench that
+silently stopped reporting a number must not pass as "no regression".
+
+Usage:
+    python tools/perf_gate.py --baseline BENCH_r05.json --current out.json
+    python tools/perf_gate.py --baseline BENCH_r05.json --current out.json \
+        --serving serving_now.json serving_base.json
+    python tools/perf_gate.py --baseline BENCH_r05.json --dry-run
+        # parse + report only, always exit 0 (the run_tier1 smoke)
+
+Exit codes: 0 ok / 1 regression (or missing metric under --strict) /
+2 unusable inputs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, Optional, Tuple
+
+HIGHER = "higher"   # throughput/utilization: dropping is a regression
+LOWER = "lower"     # latency: rising is a regression
+
+
+def _first_json(text: str) -> Optional[dict]:
+    """Last parseable JSON object line (benches print progress first)."""
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    return None
+
+
+def load_record(path: str) -> dict:
+    """Load a driver ``BENCH_r*.json`` (uses its ``parsed`` field), a raw
+    bench stdout capture, or a plain JSON object."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        doc = _first_json(text)
+    if doc is None:
+        raise ValueError(f"{path}: no JSON object found")
+    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: expected a JSON object")
+    return doc
+
+
+def bench_metrics(doc: dict) -> Dict[str, Tuple[float, str]]:
+    """{metric_name: (value, direction)} extracted from a bench record."""
+    out: Dict[str, Tuple[float, str]] = {}
+
+    def put(name, value, direction=HIGHER):
+        if isinstance(value, (int, float)):
+            out[name] = (float(value), direction)
+
+    put("llama.tokens_per_sec", doc.get("value"))
+    detail = doc.get("detail") or {}
+    put("llama.mfu", detail.get("mfu"))
+    put("llama.mfu_measured", detail.get("mfu_measured"))
+    configs = detail.get("configs") or {}
+    moe = configs.get("moe") or {}
+    put("moe.tokens_per_sec", moe.get("tokens_per_sec"))
+    put("moe.mfu_active", moe.get("mfu_active"))
+    rn = configs.get("resnet50") or {}
+    put("resnet50.images_per_sec", rn.get("images_per_sec"))
+    put("resnet50.mfu_measured", rn.get("mfu_measured"))
+    put("resnet50.step_ms", rn.get("step_ms"), LOWER)
+    lm = configs.get("llama_max") or {}
+    put("llama_max.tokens_per_sec", lm.get("tokens_per_sec"))
+    put("llama_max.mfu", lm.get("mfu"))
+    return out
+
+
+def serving_metrics(doc: dict) -> Dict[str, Tuple[float, str]]:
+    """SLO metrics from a serving_bench / quant_ab JSON line."""
+    out: Dict[str, Tuple[float, str]] = {}
+    body = doc.get("serving_bench") or doc.get("quant_ab") or doc
+
+    def put(name, value, direction):
+        if isinstance(value, (int, float)):
+            out[name] = (float(value), direction)
+
+    put("serving.aggregate_tok_s", body.get("aggregate_tok_s"), HIGHER)
+    for slo_src in (body,) + tuple(
+            body.get(k) for k in ("bf16", "int8") if isinstance(
+                body.get(k), dict)):
+        prefix = "serving" if slo_src is body else (
+            "quant.bf16" if slo_src is body.get("bf16") else "quant.int8")
+        put(f"{prefix}.ttft_p50_ms", slo_src.get("ttft_p50_ms"), LOWER)
+        put(f"{prefix}.ttft_p99_ms", slo_src.get("ttft_p99_ms"), LOWER)
+        put(f"{prefix}.tpot_ms", slo_src.get("tpot_ms"), LOWER)
+        put(f"{prefix}.decode_tok_s", slo_src.get("decode_tok_s"), HIGHER)
+    return out
+
+
+def compare(base: Dict[str, Tuple[float, str]],
+            cur: Dict[str, Tuple[float, str]],
+            tol: float, tol_latency: float) -> Tuple[list, list]:
+    """(failures, report_lines) over metrics present in the baseline."""
+    failures, lines = [], []
+    for name in sorted(base):
+        bval, direction = base[name]
+        centry = cur.get(name)
+        if centry is None:
+            lines.append(f"  {name:<28} base={bval:<12g} MISSING in current")
+            failures.append(("missing", name))
+            continue
+        cval = centry[0]
+        budget = tol if direction == HIGHER else tol_latency
+        if bval == 0:
+            delta = 0.0
+        elif direction == HIGHER:
+            delta = (bval - cval) / abs(bval)    # >0 = got worse
+        else:
+            delta = (cval - bval) / abs(bval)
+        verdict = "ok"
+        if delta > budget:
+            verdict = f"REGRESSION ({delta:+.1%} worse > {budget:.0%} budget)"
+            failures.append(("regression", name))
+        elif delta < -0.02:
+            verdict = f"improved ({-delta:+.1%})"
+        lines.append(f"  {name:<28} base={bval:<12g} cur={cval:<12g} "
+                     f"{verdict}")
+    return failures, lines
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--baseline", required=True,
+                    help="baseline record (BENCH_r*.json or bench output)")
+    ap.add_argument("--current",
+                    help="current record to gate (default: baseline vs "
+                    "itself — a wiring smoke)")
+    ap.add_argument("--serving", nargs=2, metavar=("CUR", "BASE"),
+                    help="also gate a pair of serving_bench/quant_ab "
+                    "artifacts (current, baseline)")
+    ap.add_argument("--tol", type=float, default=0.05,
+                    help="throughput/MFU regression budget (default 5%%)")
+    ap.add_argument("--tol-latency", type=float, default=0.25,
+                    help="TTFT/TPOT/step-time regression budget "
+                    "(default 25%%)")
+    ap.add_argument("--strict", action="store_true",
+                    help="metrics missing from the current artifact fail "
+                    "the gate instead of warning")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="report only; always exit 0 (CI smoke)")
+    args = ap.parse_args(argv)
+
+    try:
+        base = bench_metrics(load_record(args.baseline))
+        cur = bench_metrics(load_record(args.current or args.baseline))
+    except (OSError, ValueError) as e:
+        sys.stderr.write(f"[perf_gate] {e}\n")
+        return 2
+    if not base:
+        sys.stderr.write(f"[perf_gate] {args.baseline}: no gateable "
+                         "metrics found\n")
+        return 2
+
+    failures, lines = compare(base, cur, args.tol, args.tol_latency)
+    print(f"[perf_gate] bench: {args.current or args.baseline} vs "
+          f"{args.baseline} (tol {args.tol:.0%} throughput, "
+          f"{args.tol_latency:.0%} latency)")
+    print("\n".join(lines))
+
+    if args.serving:
+        try:
+            scur = serving_metrics(load_record(args.serving[0]))
+            sbase = serving_metrics(load_record(args.serving[1]))
+        except (OSError, ValueError) as e:
+            sys.stderr.write(f"[perf_gate] serving: {e}\n")
+            return 2
+        sfail, slines = compare(sbase, scur, args.tol, args.tol_latency)
+        failures += sfail
+        print(f"[perf_gate] serving: {args.serving[0]} vs {args.serving[1]}")
+        print("\n".join(slines))
+
+    regressions = [n for kind, n in failures if kind == "regression"]
+    missing = [n for kind, n in failures if kind == "missing"]
+    if missing and not args.strict:
+        print(f"[perf_gate] warning: {len(missing)} baseline metric(s) "
+              f"missing from current ({', '.join(missing)}) — "
+              "--strict to fail on this")
+    bad = bool(regressions) or (args.strict and bool(missing))
+    if args.dry_run:
+        print(f"[perf_gate] dry-run: would "
+              f"{'FAIL' if bad else 'pass'} ({len(regressions)} "
+              f"regression(s), {len(missing)} missing)")
+        return 0
+    if bad:
+        print(f"[perf_gate] FAIL: {len(regressions)} regression(s)"
+              + (f", {len(missing)} missing metric(s)" if args.strict
+                 and missing else ""))
+        return 1
+    print("[perf_gate] OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,7 +10,14 @@
 # `slow`-marked end-to-end drills (2-worker launch -> rank-0 merged
 # /metrics + Perfetto trace; chaos-kill -> black box) run under
 # tools/run_chaos.sh / -m slow. tools/check_obs_overhead.py gates the
-# off/flight-on/exporter-idle hot-path budgets separately.
+# off/flight-on/exporter-idle/perf-on hot-path budgets separately.
+#
+# Perf regression gate (not run here — needs a bench artifact): after a
+# bench run, `python tools/perf_gate.py --baseline BENCH_r05.json
+# --current <new>.json` exits nonzero on a tokens/s / MFU / TTFT
+# regression beyond tolerance; `--baseline BENCH_r05.json --dry-run` is
+# the wiring smoke (always exit 0) and is covered by
+# tests/test_perf_attribution.py in this tier.
 set -o pipefail
 cd "$(dirname "$0")/.."
 rm -f /tmp/_t1.log

@@ -18,6 +18,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
+from paddlepaddle_tpu.inference.serving import slo_summary
+
 
 def main():
     ap = argparse.ArgumentParser()
@@ -66,16 +68,20 @@ def main():
         dt = time.perf_counter() - t0
     new_tokens = sum(len(o) - len(p) for o, p in zip(outs, prompts))
     agg = new_tokens / dt
+    slo = slo_summary(futs)
     print(f"continuous x{args.slots} slots, {args.reqs} reqs: "
           f"{agg:8.1f} tok/s aggregate ({new_tokens} tokens in {dt:.2f}s, "
           f"{agg / max(single_tps, 1e-9):.1f}x single)")
+    print(f"SLO: ttft p50={slo['ttft_p50_ms']}ms p99={slo['ttft_p99_ms']}ms"
+          f"  tpot={slo['tpot_ms']}ms/token"
+          f"  queue_wait p99={slo['queue_wait_p99_ms']}ms")
     import json
 
-    print(json.dumps({"serving_bench": {
+    print(json.dumps({"serving_bench": dict({
         "slots": args.slots, "requests": args.reqs,
         "new_tokens_per_req": args.new_tokens,
         "single_tok_s": round(single_tps, 1),
-        "aggregate_tok_s": round(agg, 1)}}))
+        "aggregate_tok_s": round(agg, 1)}, **slo)}))
 
 
 if __name__ == "__main__":
