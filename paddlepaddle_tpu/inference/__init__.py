@@ -159,6 +159,7 @@ from .robustness import (  # noqa: F401,E402
     CircuitOpenError,
     DeadlineExceededError,
     EngineDrainingError,
+    KVCapacityError,
     RequestCancelledError,
     RequestValidationError,
     ServerOverloadedError,

@@ -1,4 +1,4 @@
-"""Continuous-batching decode engine — slot-based KV pool, ragged lengths.
+"""Continuous-batching decode engine — paged KV pool, ragged lengths.
 
 Reference surface: the serving-grade batched attention stack —
 paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu (paged,
@@ -6,29 +6,42 @@ blocked KV) surfaced via python/paddle/incubate/nn/functional/
 block_multihead_attention.py, plus the fused-transformer decode loop.
 
 TPU-native redesign: block tables and page indirection exist on GPU because
-the allocator hands out scattered pages; under XLA the idiomatic equivalent
-is a STATIC slot-contiguous KV pool [slots, max_len, kvh, hd] per layer with
-per-slot length counters — same admission/eviction flexibility (a slot is a
-page-run), zero gather indirection in the attention inner loop, and every
-shape static so each program compiles ONCE:
+the allocator hands out scattered pages; the first engine here kept a
+STATIC slot-contiguous KV pool [slots, max_len, kvh, hd] per layer instead
+(zero gather indirection, every shape static). That shape has the
+reference's ORIGINAL problem back: every admitted request reserves
+``max_len`` worth of HBM whatever its real length, so mixed long/short
+traffic caps concurrency at ``slots``, not at real KV bytes. The paged
+layout (``kv_layout="paged"``, the default) fixes it the static-shape way:
 
-* PREFILL/DECODE SPLIT: admission is ONE compiled call (per prompt-length
-  bucket) that prefills the sequence through a scratch cache, scatters its
-  K/V prefix into the pool slot, samples the first token, and updates every
-  per-slot state vector in-graph. Decode is one compiled multi-step program
-  over ALL slots (b=slots, s=1) with PER-SLOT positions (ragged lengths) —
-  rope, cache writes, and causal masking all index by the slot's own length
-  (models/llama.py _cached_attention vector pos path).
-* DEVICE-RESIDENT BOOKKEEPING: lens/tokens/active/temps/eos live on device;
-  eos and budget termination happen in-graph. The host syncs ONCE per
-  decode chunk (a packed [slots, chunk+1] array of emitted tokens + active
-  flags): on the tunneled platform every host sync costs up to ~100 ms RTT
-  (BASELINE.md), so per-admit or per-token syncs would drown the chip —
-  the first engine draft did exactly that and measured 0.4x a SINGLE
-  sequence; this design is what makes batching actually win.
-* CONTINUOUS BATCHING: finished slots (eos / budget) retire and free slots
-  admit queued requests mid-flight; per-slot sampling params ride device
-  vectors, so mixed requests share one program.
+* PAGED KV POOL: one ``[num_pages, page_size, kvh, hd]`` buffer per layer
+  plus a device-resident page table ``[slots, max_len/page_size]`` int32.
+  The decode body GATHERS each layer's logical ``[slots, L]`` view through
+  the page table (the XLA equivalent of the GPU block table — a gather
+  index, not pointer chasing), runs the UNCHANGED ragged-attention math,
+  and scatters the one newly written position back to its physical page.
+  Admission allocates pages from a host-side free list
+  (:mod:`~.kv_pool`), scatters the prefill prefix page-by-page, and slot
+  retirement returns pages — so concurrency is bounded by total KV bytes
+  in flight, not ``slots x max_len``. Pages are reserved for the FULL
+  prompt+budget at admission (static-shape JAX favors upfront
+  reservation over vLLM's lazy growth: no mid-flight OOM preemption
+  path needed), which still kills the dominant waste — the
+  ``max_len - (prompt+budget)`` tail every request used to hold.
+* SHARED-PREFIX (PROMPT) CACHE: page-aligned prompt prefixes declared via
+  ``prefix_len`` are content-hashed; a miss runs the normal full prefill
+  and pins the prefix pages read-only (ref-counted), a hit prefills ONLY
+  the tail against the cached prefix pages gathered as context — N
+  requests sharing a system prompt pay one prefill plus N short tails.
+  Refcount-0 entries stay cached and are LRU-evicted when the free list
+  runs dry.
+* PREFILL/DECODE SPLIT, DEVICE-RESIDENT BOOKKEEPING, CONTINUOUS
+  BATCHING: unchanged from the slot-contiguous engine — admission is one
+  compiled call per prompt-length bucket, decode is one compiled
+  multi-step program over all slots with per-slot positions, the host
+  syncs ONCE per decode chunk, finished slots retire and free slots admit
+  mid-flight. ``kv_layout="contiguous"`` keeps the old pool byte-for-byte
+  (the parity/A-B baseline).
 """
 
 from __future__ import annotations
@@ -42,6 +55,10 @@ import numpy as np
 
 from ..core import autograd as _ag
 from ..core.dispatch import unwrap
+from .kv_pool import PagePool, PrefixCache, pages_needed, prefix_hash
+from .robustness import KVCapacityError
+from .robustness import safe_inc as _safe_inc
+from .robustness import safe_set as _safe_set
 
 
 def _bucket(n: int, q: int = 128) -> int:
@@ -103,13 +120,19 @@ class BatchDecodeEngine:
 
     def __init__(self, model, max_slots: int = 16, max_len: Optional[int] = None,
                  chunk: int = 16, quant: Optional[str] = None,
-                 quant_group_size: int = -1):
+                 quant_group_size: int = -1, kv_layout: str = "paged",
+                 page_size: int = 64, num_pages: Optional[int] = None,
+                 prefix_cache: bool = True):
         cfg = model.config
+        if kv_layout not in ("paged", "contiguous"):
+            raise ValueError(
+                f"kv_layout must be 'paged' or 'contiguous', got {kv_layout!r}")
         self.model = model
         self.cfg = cfg
         self.S = int(max_slots)
         self.L = int(max_len or cfg.max_position_embeddings)
         self.chunk = int(chunk)
+        self.kv_layout = kv_layout
         self.params = model.functional_state()
         # weight-only quantization: params quantized ONCE here; every
         # compiled program after this point (admission prefill + the
@@ -132,9 +155,41 @@ class BatchDecodeEngine:
                 self.params, algo=quant, group_size=quant_group_size)
         kvh, hd = cfg.num_key_value_heads, cfg.head_dim
         dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
-        self.caches = [(jnp.zeros((self.S, self.L, kvh, hd), dtype),
-                        jnp.zeros((self.S, self.L, kvh, hd), dtype))
-                       for _ in range(cfg.num_hidden_layers)]
+        if kv_layout == "paged":
+            self.page_size = int(page_size)
+            if self.page_size < 1:
+                raise ValueError(f"page_size must be >= 1, got {page_size}")
+            self.P = pages_needed(self.L, self.page_size)   # pages per slot
+            # default capacity: every slot can hold max_len, ceil'd to
+            # whole pages — the contiguous pool's admission CONTRACT, and
+            # its exact bytes when page_size divides max_len (otherwise
+            # each slot's share rounds up to a whole page, worst case
+            # page_size-1 tokens/slot; plus the null page). Size
+            # num_pages BELOW S*P to serve more slots than the worst
+            # case could ever fit contiguously
+            n_pages = (self.S * self.P + 1 if num_pages is None
+                       else int(num_pages))
+            self.pool = PagePool(n_pages, self.page_size)
+            self.prefix = PrefixCache()
+            self.prefix_enabled = bool(prefix_cache)
+            self.page_table = jnp.zeros((self.S, self.P), jnp.int32)
+            self.caches = [
+                (jnp.zeros((n_pages, self.page_size, kvh, hd), dtype),
+                 jnp.zeros((n_pages, self.page_size, kvh, hd), dtype))
+                for _ in range(cfg.num_hidden_layers)]
+            self._slot_pages: List[List[int]] = [[] for _ in range(self.S)]
+            self._slot_prefix: List[Optional[str]] = [None] * self.S
+            self._kv_gauges(total=True)
+        else:
+            self.page_size = 0
+            self.P = 0
+            self.pool = None
+            self.prefix = None
+            self.prefix_enabled = False
+            self.page_table = None
+            self.caches = [(jnp.zeros((self.S, self.L, kvh, hd), dtype),
+                            jnp.zeros((self.S, self.L, kvh, hd), dtype))
+                           for _ in range(cfg.num_hidden_layers)]
         # device-resident per-slot state: [lens, tokens, active, budgets]
         self.lens = jnp.zeros((self.S,), jnp.int32)
         self.tokens = jnp.zeros((self.S,), jnp.int32)     # last emitted token
@@ -144,13 +199,59 @@ class BatchDecodeEngine:
         self.budgets = jnp.zeros((self.S,), jnp.int32)     # new tokens left
         self.top_ks = jnp.zeros((self.S,), jnp.int32)      # 0 = no filter
         self.key = jax.random.PRNGKey(0)
-        self._admit_fns: Dict[int, object] = {}
+        self._admit_fns: Dict[object, object] = {}
         self._decode_fn = jax.jit(self._decode_program(self.chunk),
                                   donate_argnums=(1,))
         self._decode_captured = False
         self._host_slots = [_Slot() for _ in range(self.S)]
         self._first_pending: Dict[int, object] = {}  # slot -> device scalar
-        self.stats = {"tokens_out": 0, "requests": 0, "decode_calls": 0}
+        self.stats = {"tokens_out": 0, "requests": 0, "decode_calls": 0,
+                      "peak_busy": 0}
+
+    # -- paged-pool observability -------------------------------------------
+    def _kv_gauges(self, total: bool = False) -> None:
+        """Pool occupancy gauges — refreshed on the per-request host paths
+        (admit/retire), never per token."""
+        if self.kv_layout != "paged":
+            return
+        if total:
+            _safe_set("paddle_serving_kv_pages_total",
+                      "allocatable KV pages in the paged pool",
+                      self.pool.usable)
+        _safe_set("paddle_serving_kv_pages_free",
+                  "KV pages currently on the free list",
+                  self.pool.free_count)
+
+    def kv_stats(self) -> Dict[str, object]:
+        """KV-pool snapshot for ``health()``/``/healthz`` and the serving
+        bench: layout, page accounting, prefix-cache hit data."""
+        cfg = self.cfg
+        kvh, hd = cfg.num_key_value_heads, cfg.head_dim
+        itemsize = np.dtype(self.caches[0][0].dtype).itemsize
+        per_tok = 2 * kvh * hd * itemsize * cfg.num_hidden_layers
+        if self.kv_layout != "paged":
+            return {"layout": "contiguous",
+                    "kv_bytes": int(self.S * self.L * per_tok)}
+        pool, pfx = self.pool, self.prefix
+        return {
+            "layout": "paged",
+            "page_size": self.page_size,
+            "pages_total": pool.usable,
+            "pages_free": pool.free_count,
+            "pages_used": pool.used,
+            "pages_peak": pool.peak_used,
+            "occupancy": round(pool.used / max(pool.usable, 1), 4),
+            "page_bytes": int(self.page_size * per_tok),
+            "kv_bytes": int(pool.num_pages * self.page_size * per_tok),
+            "prefix": {
+                "enabled": self.prefix_enabled,
+                "entries": len(pfx),
+                "cached_pages": pfx.cached_pages,
+                "hits": pfx.hits,
+                "misses": pfx.misses,
+                "evictions": pfx.evictions,
+            },
+        }
 
     # -- compiled pieces ----------------------------------------------------
     def _forward(self, params, toks, caches, pos):
@@ -163,6 +264,40 @@ class BatchDecodeEngine:
             else:
                 logits = unwrap(self.model.lm_head(hidden))
         return logits, [(unwrap(k), unwrap(v)) for k, v in new_caches]
+
+    def _forward_paged(self, params, toks, pools, page_table, lens):
+        """One decode step through the page table: each layer gathers its
+        logical ``[S, P*page_size]`` K/V view (the page table IS the gather
+        index), runs the unchanged ragged-attention math against it, and
+        scatters the single newly written position back to its physical
+        page. Retired slots' table rows are zeroed, so their writes land in
+        the sacrificial null page."""
+        S, ps = self.S, self.page_size
+        rows = jnp.arange(S, dtype=jnp.int32)
+        phys = page_table[rows, lens // ps]        # [S] physical page
+        off = lens % ps                            # [S] offset inside it
+        with _ag.no_grad(), self.model.bind_state(params):
+            mdl = self.model.model
+            x = mdl.embed_tokens(toks)
+            cos, sin = mdl.rope_cos, mdl.rope_sin
+            new_pools = []
+            for layer, (kp, vp) in zip(mdl.layers, pools):
+                kview = kp[page_table].reshape(
+                    S, self.P * ps, *kp.shape[2:])
+                vview = vp[page_table].reshape(
+                    S, self.P * ps, *vp.shape[2:])
+                x, (kc, vc) = layer(x, cos, sin, None,
+                                    cache=(kview, vview), pos=lens)
+                kc, vc = unwrap(kc), unwrap(vc)
+                kp = kp.at[phys, off].set(kc[rows, lens])
+                vp = vp.at[phys, off].set(vc[rows, lens])
+                new_pools.append((kp, vp))
+            hidden = mdl.norm(x)
+            if self.model.lm_head is None:
+                logits = unwrap(hidden) @ unwrap(mdl.embed_tokens.weight).T
+            else:
+                logits = unwrap(self.model.lm_head(hidden))
+        return logits, new_pools
 
     TOP_K_CAP = 128  # static bound for the in-graph per-slot top-k filter
 
@@ -180,12 +315,30 @@ class BatchDecodeEngine:
         sampled = jax.random.categorical(key, scaled).astype(jnp.int32)
         return jnp.where(temps <= 0.0, greedy, sampled)
 
+    def _set_slot_state(self, caches, lens, tokens, active, temps, eos_ids,
+                        budgets, top_ks, key, slot, plen, temp, eos, budget,
+                        top_k, first):
+        """Shared admission epilogue: every per-slot state element set
+        in-graph; the slot is born inactive when its first token already
+        ends it."""
+        done = ((eos >= 0) & (first == eos)) | (budget <= 1)
+        return (caches,
+                lens.at[slot].set(plen),
+                tokens.at[slot].set(first),
+                active.at[slot].set(~done),
+                temps.at[slot].set(temp),
+                eos_ids.at[slot].set(eos),
+                budgets.at[slot].set(budget - 1),
+                top_ks.at[slot].set(top_k),
+                key, first)
+
     def _admit_impl(self, params, caches, lens, tokens, active, temps,
                     eos_ids, budgets, top_ks, ids, plen, slot, temp, eos,
                     budget, top_k, key):
-        """ONE compiled admission: prefill ids[1, bucket] through a scratch
-        cache, scatter the K/V prefix into pool slot ``slot``, sample the
-        first token, set every per-slot state element. No host syncs."""
+        """ONE compiled admission (contiguous layout): prefill ids[1, bucket]
+        through a scratch cache, scatter the K/V prefix into pool slot
+        ``slot``, sample the first token, set every per-slot state element.
+        No host syncs."""
         bucket = ids.shape[1]
         kvh, hd = self.cfg.num_key_value_heads, self.cfg.head_dim
         dtype = caches[0][0].dtype
@@ -202,17 +355,93 @@ class BatchDecodeEngine:
             kc = jax.lax.dynamic_update_slice(kc, ks, (slot, zero, zero, zero))
             vc = jax.lax.dynamic_update_slice(vc, vs, (slot, zero, zero, zero))
             out_caches.append((kc, vc))
-        # the slot is born inactive when its first token already ends it
-        done = ((eos >= 0) & (first == eos)) | (budget <= 1)
-        return (out_caches,
-                lens.at[slot].set(plen),
-                tokens.at[slot].set(first),
-                active.at[slot].set(~done),
-                temps.at[slot].set(temp),
-                eos_ids.at[slot].set(eos),
-                budgets.at[slot].set(budget - 1),
-                top_ks.at[slot].set(top_k),
-                key, first)
+        return self._set_slot_state(out_caches, lens, tokens, active, temps,
+                                    eos_ids, budgets, top_ks, key, slot,
+                                    plen, temp, eos, budget, top_k, first)
+
+    def _admit_paged_impl(self, params, pools, page_table, lens, tokens,
+                          active, temps, eos_ids, budgets, top_ks, ids, plen,
+                          slot, temp, eos, budget, top_k, key):
+        """Paged admission: same scratch prefill, but the K/V prefix is
+        scattered PAGE-BY-PAGE to the physical pages the host wrote into
+        this slot's page-table row before the call. Scratch positions past
+        the slot's reservation hit row entries of 0 — the null page."""
+        bucket = ids.shape[1]
+        ps = self.page_size
+        npg = pages_needed(bucket, ps)
+        pad = npg * ps - bucket
+        kvh, hd = self.cfg.num_key_value_heads, self.cfg.head_dim
+        dtype = pools[0][0].dtype
+        scratch = [(jnp.zeros((1, bucket, kvh, hd), dtype),
+                    jnp.zeros((1, bucket, kvh, hd), dtype))
+                   for _ in range(self.cfg.num_hidden_layers)]
+        logits, scratch = self._forward(params, ids, scratch, jnp.int32(0))
+        row = logits[0, plen - 1].astype(jnp.float32)
+        key, sub = jax.random.split(key)
+        first = self._sample(row[None], temp[None], top_k[None], sub)[0]
+        dest = jax.lax.dynamic_slice(page_table, (slot, jnp.int32(0)),
+                                     (1, npg))[0]
+        out_pools = []
+        for (kp, vp), (ks, vs) in zip(pools, scratch):
+            if pad:
+                ks = jnp.pad(ks, ((0, 0), (0, pad), (0, 0), (0, 0)))
+                vs = jnp.pad(vs, ((0, 0), (0, pad), (0, 0), (0, 0)))
+            kp = kp.at[dest].set(ks[0].reshape(npg, ps, kvh, hd))
+            vp = vp.at[dest].set(vs[0].reshape(npg, ps, kvh, hd))
+            out_pools.append((kp, vp))
+        return self._set_slot_state(out_pools, lens, tokens, active, temps,
+                                    eos_ids, budgets, top_ks, key, slot,
+                                    plen, temp, eos, budget, top_k, first)
+
+    def _admit_prefix_program(self, n_pfx: int, tail_bucket: int):
+        """Prefix-HIT admission factory (compiled per (prefix pages, tail
+        bucket)): gather the cached prefix pages as read-only context,
+        prefill ONLY the tail at positions [aligned, aligned+tail), scatter
+        the tail's K/V to the slot's private pages, sample the first token.
+        The prefix pages are never written — that is what makes them
+        shareable across slots."""
+        ps = self.page_size
+        aligned = n_pfx * ps
+        npg_tail = pages_needed(tail_bucket, ps)
+        pad = npg_tail * ps - tail_bucket
+
+        def impl(params, pools, page_table, lens, tokens, active, temps,
+                 eos_ids, budgets, top_ks, ids, tail_plen, slot, temp, eos,
+                 budget, top_k, key):
+            kvh, hd = self.cfg.num_key_value_heads, self.cfg.head_dim
+            dtype = pools[0][0].dtype
+            row_pages = jax.lax.dynamic_slice(
+                page_table, (slot, jnp.int32(0)), (1, self.P))[0]
+            pfx = row_pages[:n_pfx]
+            scratch = []
+            for kp, vp in pools:
+                kpfx = kp[pfx].reshape(1, aligned, kvh, hd)
+                vpfx = vp[pfx].reshape(1, aligned, kvh, hd)
+                zk = jnp.zeros((1, tail_bucket, kvh, hd), dtype)
+                scratch.append((jnp.concatenate([kpfx, zk], axis=1),
+                                jnp.concatenate([vpfx, zk], axis=1)))
+            logits, scratch = self._forward(params, ids, scratch,
+                                            jnp.int32(aligned))
+            row = logits[0, tail_plen - 1].astype(jnp.float32)
+            key2, sub = jax.random.split(key)
+            first = self._sample(row[None], temp[None], top_k[None], sub)[0]
+            dest = row_pages[n_pfx:n_pfx + npg_tail]
+            out_pools = []
+            for (kp, vp), (ks, vs) in zip(pools, scratch):
+                kt = ks[:, aligned:]
+                vt = vs[:, aligned:]
+                if pad:
+                    kt = jnp.pad(kt, ((0, 0), (0, pad), (0, 0), (0, 0)))
+                    vt = jnp.pad(vt, ((0, 0), (0, pad), (0, 0), (0, 0)))
+                kp = kp.at[dest].set(kt[0].reshape(npg_tail, ps, kvh, hd))
+                vp = vp.at[dest].set(vt[0].reshape(npg_tail, ps, kvh, hd))
+                out_pools.append((kp, vp))
+            return self._set_slot_state(
+                out_pools, lens, tokens, active, temps, eos_ids, budgets,
+                top_ks, key2, slot, aligned + tail_plen, temp, eos, budget,
+                top_k, first)
+
+        return impl
 
     def _decode_program(self, n_steps: int):
         """``n_steps`` decode steps over all slots in one program; per-slot
@@ -221,24 +450,38 @@ class BatchDecodeEngine:
         where idle, last column = active flag). A factory so the perf
         plane can lower an ``n_steps=1`` variant for cost capture — XLA's
         cost analysis counts a scan body ONCE regardless of trip count,
-        so the chunk program's own count would under-report by ~chunk."""
+        so the chunk program's own count would under-report by ~chunk.
+        Paged layout threads the pool through the scan carry and reads the
+        (loop-invariant) page table as a plain capture-free argument."""
 
-        def impl(params, caches, tokens, lens, active, temps,
-                 eos_ids, budgets, top_ks, key):
-            def body(carry, _):
-                caches, tokens, lens, active, budgets, key = carry
+        paged = self.kv_layout == "paged"
+
+        def step(caches, tokens, lens, active, temps, budgets, top_ks,
+                 eos_ids, key, params, page_table):
+            if paged:
+                logits, caches = self._forward_paged(
+                    params, tokens[:, None], caches, page_table, lens)
+            else:
                 logits, caches = self._forward(params, tokens[:, None],
                                                caches, lens)
-                rows = logits[:, 0].astype(jnp.float32)
-                key, sub = jax.random.split(key)
-                nxt = self._sample(rows, temps, top_ks, sub)
-                nxt = jnp.where(active, nxt, tokens)    # frozen when inactive
-                lens = lens + active.astype(jnp.int32)
-                emitted = jnp.where(active, nxt, -1)    # -1 = no token
-                budgets = budgets - active.astype(jnp.int32)
-                active = active & ~((eos_ids >= 0) & (nxt == eos_ids)) \
-                    & (budgets > 0)
-                tokens = nxt
+            rows = logits[:, 0].astype(jnp.float32)
+            key, sub = jax.random.split(key)
+            nxt = self._sample(rows, temps, top_ks, sub)
+            nxt = jnp.where(active, nxt, tokens)    # frozen when inactive
+            lens = lens + active.astype(jnp.int32)
+            emitted = jnp.where(active, nxt, -1)    # -1 = no token
+            budgets = budgets - active.astype(jnp.int32)
+            active = active & ~((eos_ids >= 0) & (nxt == eos_ids)) \
+                & (budgets > 0)
+            return caches, nxt, lens, active, budgets, key, emitted
+
+        def run(params, caches, page_table, tokens, lens, active, temps,
+                eos_ids, budgets, top_ks, key):
+            def body(carry, _):
+                caches, tokens, lens, active, budgets, key = carry
+                caches, tokens, lens, active, budgets, key, emitted = step(
+                    caches, tokens, lens, active, temps, budgets, top_ks,
+                    eos_ids, key, params, page_table)
                 return (caches, tokens, lens, active, budgets, key), emitted
 
             (caches_, tokens_, lens_, active_, budgets_, key_), out = \
@@ -250,12 +493,72 @@ class BatchDecodeEngine:
                 axis=1)                                 # [slots, n_steps+1]
             return caches_, tokens_, lens_, active_, budgets_, key_, packed
 
-        return impl
+        if paged:
+            return run
+
+        def run_contiguous(params, caches, tokens, lens, active, temps,
+                           eos_ids, budgets, top_ks, key):
+            return run(params, caches, None, tokens, lens, active, temps,
+                       eos_ids, budgets, top_ks, key)
+
+        return run_contiguous
 
     # -- host orchestration --------------------------------------------------
+    def _prefix_plan(self, req, ids, plen):
+        """(aligned, n_pfx, hash, entry) for a request's declared shared
+        prefix — only FULL pages are shareable, and at least one tail token
+        must remain so the first sample has logits to read."""
+        pfx_len = int(getattr(req, "prefix_len", 0) or 0)
+        if (self.kv_layout != "paged" or not self.prefix_enabled
+                or pfx_len <= 0):
+            return 0, 0, None, None
+        if pfx_len > plen:
+            raise ValueError(
+                f"prefix_len {pfx_len} exceeds the prompt length {plen}")
+        ps = self.page_size
+        aligned = (pfx_len // ps) * ps
+        if aligned == plen:
+            aligned -= ps            # keep >= 1 tail token to sample from
+        if aligned < ps:
+            return 0, 0, None, None  # too short to share a full page
+        n_pfx = aligned // ps
+        h = prefix_hash(ids, aligned)
+        return aligned, n_pfx, h, self.prefix.lookup(h)
+
+    def _reserve_pages(self, plen: int, budget: int, n_pfx_cached: int,
+                       exclude: Optional[str] = None):
+        """Allocate the request's private pages (full prompt+budget
+        reservation minus cached prefix pages). Returns the page list, or
+        None when the pool cannot satisfy it RIGHT NOW (caller waits for
+        retirements); raises :class:`KVCapacityError` when it could never
+        fit — judged on the TOTAL need (a hit's pinned prefix pages count
+        against capacity too, so a hit that would fit privately but not
+        alongside its own prefix is typed-rejected, not spun on). LRU
+        refcount-0 prefixes are evicted when the free list runs dry;
+        ``exclude`` protects the entry this request is about to hit."""
+        total = pages_needed(plen + budget, self.page_size)
+        need = total - n_pfx_cached
+        if total > self.pool.usable:
+            raise KVCapacityError(
+                f"prompt {plen} + {budget} new tokens needs {total} KV "
+                f"pages (page_size {self.page_size}) but the pool holds "
+                f"only {self.pool.usable} even when empty — raise "
+                "num_pages or shorten the request", pages_needed=total,
+                pages_capacity=self.pool.usable)
+        if self.pool.free_count < need:
+            evicted = self.prefix.evict_until(self.pool, need,
+                                              exclude=exclude)
+            if evicted:
+                _safe_inc("paddle_serving_kv_prefix_evictions_total",
+                          "prefix-cache entries LRU-evicted for pages",
+                          evicted)
+            if self.pool.free_count < need:
+                return None
+        return self.pool.alloc(need)
+
     def _admit(self, req) -> bool:
         """Prefill ``req`` into a free slot (one compiled call, no host
-        sync); False when no slot is free."""
+        sync); False when no slot (or, paged, no pages) is free."""
         free = [i for i, s in enumerate(self._host_slots) if s.req is None]
         if not free:
             return False
@@ -268,8 +571,6 @@ class BatchDecodeEngine:
                 f"engine max_len {self.L} (model max_position_embeddings "
                 f"{self.cfg.max_position_embeddings})")
         bucket = min(_bucket(plen), self.L)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :plen] = ids
         temp = float(getattr(req, "temperature", 0.0) or 0.0)
         eos = getattr(req, "eos_token_id", None)
         top_k = int(getattr(req, "top_k", 0) or 0)
@@ -278,33 +579,130 @@ class BatchDecodeEngine:
                 f"top_k {top_k} exceeds the continuous engine's static "
                 f"filter cap {self.TOP_K_CAP} (use the static serving mode "
                 "or lower top_k)")
-        args = (self.params, self.caches, self.lens, self.tokens, self.active,
-                self.temps, self.eos_ids, self.budgets, self.top_ks,
-                jnp.asarray(padded), jnp.int32(plen), jnp.int32(slot),
-                jnp.float32(temp), jnp.int32(-1 if eos is None else int(eos)),
+        aligned = n_pfx = 0
+        h = entry = None
+        if self.kv_layout == "paged":
+            aligned, n_pfx, h, entry = self._prefix_plan(req, ids, plen)
+            hit = entry is not None
+            private = self._reserve_pages(plen, req.max_new_tokens,
+                                          n_pfx if hit else 0,
+                                          exclude=h if hit else None)
+            if private is None:
+                return False          # pool dry: decode frees pages later
+            self._slot_pages[slot] = private
+            row = np.zeros((self.P,), np.int32)
+            if hit:
+                # safe: the reservation above excluded this entry from
+                # eviction, so the hash still resolves
+                self.prefix.ref(h)
+                row[:n_pfx] = entry.pages
+                row[n_pfx:n_pfx + len(private)] = private
+                self._slot_prefix[slot] = h
+                _safe_inc("paddle_serving_kv_prefix_hits_total",
+                          "prefix-cache hits (prefill work skipped)")
+            else:
+                row[:len(private)] = private
+            self.page_table = self.page_table.at[slot].set(jnp.asarray(row))
+            self._kv_gauges()
+        state = (self.lens, self.tokens, self.active, self.temps,
+                 self.eos_ids, self.budgets, self.top_ks)
+        if self.kv_layout == "paged" and entry is not None:
+            # HIT: prefill only the tail against the cached prefix pages
+            tail = plen - aligned
+            tail_bucket = min(_bucket(tail),
+                              self.cfg.max_position_embeddings - aligned,
+                              self.P * self.page_size - aligned)
+            padded = np.zeros((1, tail_bucket), np.int32)
+            padded[0, :tail] = ids[0, aligned:]
+            fn_key = ("pfx", n_pfx, tail_bucket)
+            args = (self.params, self.caches, self.page_table) + state + (
+                jnp.asarray(padded), jnp.int32(tail), jnp.int32(slot),
+                jnp.float32(temp),
+                jnp.int32(-1 if eos is None else int(eos)),
                 jnp.int32(req.max_new_tokens), jnp.int32(top_k), self.key)
-        fn = self._admit_fns.get(bucket)
+            build = lambda: jax.jit(  # noqa: E731
+                self._admit_prefix_program(n_pfx, tail_bucket),
+                donate_argnums=(1,))
+            perf_bucket = f"pfx{n_pfx}t{tail_bucket}"
+        else:
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :plen] = ids
+            tail_args = (jnp.asarray(padded), jnp.int32(plen),
+                         jnp.int32(slot), jnp.float32(temp),
+                         jnp.int32(-1 if eos is None else int(eos)),
+                         jnp.int32(req.max_new_tokens), jnp.int32(top_k),
+                         self.key)
+            if self.kv_layout == "paged":
+                args = (self.params, self.caches,
+                        self.page_table) + state + tail_args
+                build = lambda: jax.jit(self._admit_paged_impl,  # noqa: E731
+                                        donate_argnums=(1,))
+            else:
+                args = (self.params, self.caches) + state + tail_args
+                build = lambda: jax.jit(self._admit_impl,  # noqa: E731
+                                        donate_argnums=(1,))
+            fn_key = bucket
+            perf_bucket = f"p{bucket}"
+        fn = self._admit_fns.get(fn_key)
         if fn is None:
-            fn = jax.jit(self._admit_impl, donate_argnums=(1,))
+            fn = build()
             p = _perf()
             if p is not None and p.enabled():
                 # capture the bucketed prefill program's exact cost; the
                 # AOT Compiled replaces the jit entry (one compile total)
                 compiled = p.capture_jit("serving.admit", fn, args,
-                                         bucket=f"p{bucket}", quant=self.quant
+                                         bucket=perf_bucket, quant=self.quant
                                          or "off")
                 if compiled is not None:
                     fn = compiled
-            self._admit_fns[bucket] = fn
-        (self.caches, self.lens, self.tokens, self.active, self.temps,
-         self.eos_ids, self.budgets, self.top_ks, self.key, first) = fn(*args)
+            self._admit_fns[fn_key] = fn
+        try:
+            (self.caches, self.lens, self.tokens, self.active, self.temps,
+             self.eos_ids, self.budgets, self.top_ks, self.key, first) = \
+                fn(*args)
+        except BaseException:
+            # the reservation must not outlive a failed admission (a
+            # compile/dispatch error here would otherwise leak the pages
+            # until a full reset)
+            self._release_kv(slot)
+            raise
+        if self.kv_layout == "paged" and h is not None and entry is None:
+            # MISS with a declared prefix: the full prefill just wrote the
+            # prefix pages — pin them shared (this slot holds the first
+            # ref); the slot keeps only its private tail/decode pages
+            self.prefix.register(h, self._slot_pages[slot][:n_pfx], aligned)
+            self.prefix.misses += 1
+            self._slot_pages[slot] = self._slot_pages[slot][n_pfx:]
+            self._slot_prefix[slot] = h
         self._host_slots[slot] = _Slot(req, budget=int(req.max_new_tokens))
+        self.stats["peak_busy"] = max(self.stats["peak_busy"],
+                                      self.busy_slots())
         _stamp(req, "_t_admit")
         _flight_record("request", str(getattr(req, "id", "?")),
-                       phase="admit", slot=slot, bucket=bucket, plen=plen)
+                       phase="admit", slot=slot, bucket=bucket, plen=plen,
+                       **({"prefix_hit": entry is not None} if h else {}))
         self._first_pending[slot] = first   # device scalar, synced at collect
         self.stats["requests"] += 1
         return True
+
+    def _release_kv(self, slot: int, zero_row: bool = True) -> None:
+        """Return a slot's private pages to the free list, drop its prefix
+        ref, and (by default) zero its page-table row so in-flight decode
+        writes land in the null page. Idempotent."""
+        if self.kv_layout != "paged":
+            return
+        pages = self._slot_pages[slot]
+        if pages:
+            self.pool.free(pages)
+            self._slot_pages[slot] = []
+        h = self._slot_prefix[slot]
+        if h is not None:
+            self.prefix.unref(h)
+            self._slot_prefix[slot] = None
+        if zero_row:
+            self.page_table = self.page_table.at[slot].set(
+                jnp.zeros((self.P,), jnp.int32))
+        self._kv_gauges()
 
     def _retire(self, slot: int):
         s = self._host_slots[slot]
@@ -317,6 +715,7 @@ class BatchDecodeEngine:
             _stamp(s.req, "_n_new", len(gen))
             s.req.result._set(output=np.concatenate(
                 [prompt, np.asarray(gen, np.int32)]))
+        self._release_kv(slot)
         self._host_slots[slot] = _Slot()
 
     def _collect_firsts(self):
@@ -341,16 +740,22 @@ class BatchDecodeEngine:
     def reset_slots(self, slots=None):
         """Deactivate device-side slot state (all slots, or the given list)
         — REQUIRED after a failed decode or engine stop, or retired rows
-        keep consuming compute as phantom active lanes in every chunk."""
+        keep consuming compute as phantom active lanes in every chunk.
+        Paged layout also returns the slots' pages to the free list."""
         if slots is None:
             self.active = jnp.zeros((self.S,), bool)
             self._first_pending.clear()
+            if self.kv_layout == "paged":
+                for i in range(self.S):
+                    self._release_kv(i, zero_row=False)
+                self.page_table = jnp.zeros((self.S, self.P), jnp.int32)
         else:
             for i in slots:
                 self.active = self.active.at[int(i)].set(False)
                 # only THIS slot's pending first token: other slots' pending
                 # syncs must survive a single-slot reset
                 self._first_pending.pop(int(i), None)
+                self._release_kv(int(i))
 
     def release_slot(self, slot: int):
         """Free one slot without delivering a result — the cancellation /
@@ -365,8 +770,14 @@ class BatchDecodeEngine:
         return sum(1 for s in self._host_slots if s.req is not None)
 
     def _decode_chunk(self):
-        args = (self.params, self.caches, self.tokens, self.lens, self.active,
-                self.temps, self.eos_ids, self.budgets, self.top_ks, self.key)
+        if self.kv_layout == "paged":
+            args = (self.params, self.caches, self.page_table, self.tokens,
+                    self.lens, self.active, self.temps, self.eos_ids,
+                    self.budgets, self.top_ks, self.key)
+        else:
+            args = (self.params, self.caches, self.tokens, self.lens,
+                    self.active, self.temps, self.eos_ids, self.budgets,
+                    self.top_ks, self.key)
         p = _perf()
         perf_on = p is not None and p.enabled()
         if perf_on and not self._decode_captured:
@@ -423,7 +834,18 @@ class BatchDecodeEngine:
         deadline = t0 + timeout
         while (pending or any(s.req is not None for s in self._host_slots)) \
                 and time.perf_counter() < deadline:
-            while pending and self._admit(pending[0]):
+            while pending:
+                try:
+                    if not self._admit(pending[0]):
+                        break                  # no slot/pages free: decode
+                except ValueError as e:
+                    # unservable request (max_len / top_k / KV capacity):
+                    # fail ITS future and keep serving the rest — one bad
+                    # request must not abandon the whole list
+                    try:
+                        pending[0].result._set(error=e)
+                    except Exception:
+                        pass
                 pending.pop(0)
             if any(s.req is not None for s in self._host_slots):
                 self._decode_chunk()

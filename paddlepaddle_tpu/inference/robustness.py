@@ -35,8 +35,33 @@ from typing import Callable, Optional
 __all__ = [
     "ServingError", "ServerOverloadedError", "DeadlineExceededError",
     "RequestCancelledError", "CircuitOpenError", "EngineDrainingError",
-    "RequestValidationError", "CircuitBreaker", "QueueWaitEstimator",
+    "RequestValidationError", "KVCapacityError", "CircuitBreaker",
+    "QueueWaitEstimator", "safe_inc", "safe_set",
 ]
+
+
+def safe_inc(name: str, help_: str, n: float = 1, **labels) -> None:
+    """Cold-path fault/event counter (sheds, breaker flips, drains,
+    prefix hits/evictions): always records, never raises, costs nothing
+    on the serve path. Shared by serving.py and decode_engine.py — one
+    lazy-import-and-swallow wrapper, not three copies."""
+    try:
+        from ..observability import safe_inc as inc
+
+        inc(name, help_, n, **labels)
+    except Exception:
+        pass
+
+
+def safe_set(name: str, help_: str, value: float, **labels) -> None:
+    """Best-effort cold-path gauge write, same contract as
+    :func:`safe_inc`."""
+    try:
+        from ..observability import safe_set as set_
+
+        set_(name, help_, value, **labels)
+    except Exception:
+        pass
 
 
 class ServingError(RuntimeError):
@@ -80,6 +105,21 @@ class RequestValidationError(ValueError, ServingError):
     """The request can never be served (prompt + budget over ``max_len``,
     non-positive budget) — rejected at submit, before it costs a queue
     slot. A ``ValueError`` so pre-existing callers' handlers still match."""
+
+
+class KVCapacityError(RequestValidationError):
+    """The request's prompt + token budget needs more KV pages than the
+    paged pool holds EVEN WHEN EMPTY — waiting for retirements can never
+    help, so it is rejected at submit (shed, reason ``kv_capacity``)
+    instead of deadlocking at the head of the queue. Before the paged
+    pool, admission only checked against ``max_len``; a pool sized below
+    ``slots x max_len`` makes this its own failure mode."""
+
+    def __init__(self, msg: str, pages_needed: int = 0,
+                 pages_capacity: int = 0):
+        super().__init__(msg)
+        self.pages_needed = int(pages_needed)
+        self.pages_capacity = int(pages_capacity)
 
 
 class CircuitBreaker:

@@ -16,7 +16,12 @@ schedulers:
   the BatchDecodeEngine (decode_engine.py): ragged prompt lengths, mixed
   sampling params and budgets share ONE compiled multi-step decode program
   with per-slot cache positions; finished slots retire and free slots admit
-  queued requests mid-flight. The TPU-native equivalent of the reference's
+  queued requests mid-flight. KV lives in a PAGED pool by default
+  (``kv_layout="paged"``): a device page table gathers each slot's
+  logical cache, admission reserves pages for the request's REAL
+  prompt+budget (not ``max_len``), and ``submit(prefix_len=…)`` shares
+  page-aligned system-prompt prefixes across requests through a
+  ref-counted prompt cache. The TPU-native equivalent of the reference's
   paged block_multi_head_attention serving path.
 * ``mode="static"`` — groups compatible requests (same prompt-length
   bucket and sampling params) into one batched ``generate_cached`` call;
@@ -53,6 +58,7 @@ import queue
 import sys
 import threading
 import time
+import weakref
 from collections import deque
 from typing import Dict, List, Optional
 
@@ -60,16 +66,20 @@ import numpy as np
 
 from ..core import flags as _flags
 from ..resilience.chaos import chaos_point
+from .kv_pool import pages_needed
 from .robustness import (
     CircuitBreaker,
     CircuitOpenError,
     DeadlineExceededError,
     EngineDrainingError,
+    KVCapacityError,
     QueueWaitEstimator,
     RequestCancelledError,
     RequestValidationError,
     ServerOverloadedError,
 )
+from .robustness import safe_inc as _rob_safe_inc
+from .robustness import safe_set as _rob_safe_set
 
 # observability hook: _obs_srv(event, value) with events "latency" (seconds
 # submit-to-result for one completed request), "error"/"cancelled" (a request
@@ -97,24 +107,10 @@ def _flight_record(kind: str, name: str, **data) -> None:
         pass
 
 
-def _safe_inc(name: str, help_: str, n: float = 1, **labels) -> None:
-    """Cold-path fault counter (sheds, breaker flips, drains, hangs):
-    always records, never raises, costs nothing on the serve path."""
-    try:
-        from ..observability import safe_inc
-
-        safe_inc(name, help_, n, **labels)
-    except Exception:
-        pass
-
-
-def _safe_set(name: str, help_: str, value: float, **labels) -> None:
-    try:
-        from ..observability import safe_set
-
-        safe_set(name, help_, value, **labels)
-    except Exception:
-        pass
+# cold-path metric wrappers shared with decode_engine (robustness.py):
+# always record, never raise, cost nothing on the serve path
+_safe_inc = _rob_safe_inc
+_safe_set = _rob_safe_set
 
 
 class GenerationResult:
@@ -256,7 +252,8 @@ def slo_summary(results) -> Dict[str, Optional[float]]:
 
 class GenerationRequest:
     def __init__(self, prompt_ids, max_new_tokens, temperature, top_k,
-                 eos_token_id, deadline: Optional[float] = None):
+                 eos_token_id, deadline: Optional[float] = None,
+                 prefix_len: Optional[int] = None):
         arr = np.asarray(prompt_ids, np.int32)
         if arr.ndim == 2 and arr.shape[0] == 1:
             arr = arr[0]
@@ -271,6 +268,10 @@ class GenerationRequest:
         self.top_k = int(top_k)
         self.eos_token_id = eos_token_id
         self.deadline = deadline            # absolute time.monotonic(), or None
+        # leading prompt tokens forming a SHARED prefix (system prompt) —
+        # the paged engine content-hashes its page-aligned head so N
+        # requests with one system prompt pay one prefill plus N tails
+        self.prefix_len = None if prefix_len is None else int(prefix_len)
         self.id = next(_REQ_IDS)
         self.result = GenerationResult()
         self.result._req_id = self.id
@@ -308,7 +309,11 @@ class ServingEngine:
                  drain_timeout_s: Optional[float] = None,
                  drain_on_sigterm: bool = False,
                  quant: Optional[str] = None,
-                 quant_group_size: int = -1):
+                 quant_group_size: int = -1,
+                 kv_layout: str = "paged",
+                 kv_page_size: int = 64,
+                 kv_num_pages: Optional[int] = None,
+                 prefix_cache: bool = True):
         if mode not in ("continuous", "static"):
             raise ValueError(f"mode must be 'continuous' or 'static', got {mode!r}")
         if quant is not None and mode != "continuous":
@@ -366,9 +371,37 @@ class ServingEngine:
             self._engine = BatchDecodeEngine(
                 model, max_slots=max_batch_size, max_len=max_len,
                 chunk=decode_chunk, quant=quant,
-                quant_group_size=quant_group_size)
+                quant_group_size=quant_group_size, kv_layout=kv_layout,
+                page_size=kv_page_size, num_pages=kv_num_pages,
+                prefix_cache=prefix_cache)
             self._max_len = self._engine.L
             self._top_k_cap = self._engine.TOP_K_CAP
+            # page-pool capacity admission facts (None = contiguous): a
+            # request needing more pages than the pool HOLDS must be shed
+            # at submit, not deadlock at the head of the queue
+            self._kv_page_size = (self._engine.page_size
+                                  if kv_layout == "paged" else None)
+            self._kv_capacity = (self._engine.pool.usable
+                                 if kv_layout == "paged" else None)
+            try:
+                from ..observability import flight
+
+                # CALLABLE annotation (resolved at dump time): a crash
+                # dump carries the pool occupancy / prefix-hit state at
+                # the moment of death, not at construction. Weakly bound:
+                # the module-global annotation dict must not pin a
+                # dropped engine's device buffers (params + KV pools)
+                # alive for the life of the process
+                eng_ref = weakref.ref(self._engine)
+
+                def _kv_annotation():
+                    eng = eng_ref()
+                    return (eng.kv_stats() if eng is not None
+                            else {"layout": "engine-released"})
+
+                flight.annotate("serving_kv", _kv_annotation)
+            except Exception:
+                pass
             if quant is not None:
                 self._announce_quant(self._engine.quant_meta)
         else:
@@ -376,6 +409,8 @@ class ServingEngine:
                 getattr(model, "config", None), "max_position_embeddings",
                 None)
             self._top_k_cap = None
+            self._kv_page_size = None
+            self._kv_capacity = None
 
     def _bump(self, key, n=1):
         with self._stats_lock:
@@ -473,6 +508,30 @@ class ServingEngine:
                 f"top_k {req.top_k} exceeds the continuous engine's static "
                 f"filter cap {self._top_k_cap} (use the static "
                 "serving mode or lower top_k)")
+        if req.prefix_len is not None and not (
+                0 <= req.prefix_len <= req.prompt_ids.shape[1]):
+            raise RequestValidationError(
+                f"prefix_len {req.prefix_len} must be within the prompt "
+                f"(length {req.prompt_ids.shape[1]})")
+        if self._kv_capacity is not None:
+            # page-pool capacity, not just max_len: a pool sized below
+            # slots x max_len can be too small for a request that passes
+            # the length check — shed it typed instead of queueing
+            # forever. Total need governs even on a prefix hit (the
+            # pinned prefix pages occupy capacity too), so this check is
+            # EXACT — the engine's own raise can only fire for direct
+            # BatchDecodeEngine users
+            ps = self._kv_page_size
+            need = pages_needed(
+                req.prompt_ids.shape[1] + req.max_new_tokens, ps)
+            if need > self._kv_capacity:
+                self._shed("kv_capacity", KVCapacityError(
+                    f"prompt {req.prompt_ids.shape[1]} + "
+                    f"{req.max_new_tokens} new tokens needs {need} KV pages "
+                    f"(page_size {ps}) but the pool holds only "
+                    f"{self._kv_capacity} even when empty — raise "
+                    "kv_num_pages or shorten the request",
+                    pages_needed=need, pages_capacity=self._kv_capacity))
         if self._draining.is_set():
             self._shed("draining", EngineDrainingError(
                 "serving engine is draining; no new requests admitted"))
@@ -507,15 +566,20 @@ class ServingEngine:
     # -- client API ----------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens=32, temperature=0.0,
                top_k=0, eos_token_id=None,
-               deadline_s: Optional[float] = None) -> GenerationResult:
+               deadline_s: Optional[float] = None,
+               prefix_len: Optional[int] = None) -> GenerationResult:
         """Queue one generation request; raises a typed
         :mod:`~.robustness` error instead of queueing when the request
         cannot (validation), or should not (overload, open breaker,
-        draining, expired deadline), be served."""
+        draining, expired deadline), be served. ``prefix_len`` declares
+        the leading shared prefix (system prompt) for the paged engine's
+        prompt cache; ignored by the static scheduler and the contiguous
+        layout."""
         dl = deadline_s if deadline_s is not None else self.default_deadline_s
         req = GenerationRequest(
             prompt_ids, max_new_tokens, temperature, top_k, eos_token_id,
-            deadline=None if dl is None else time.monotonic() + dl)
+            deadline=None if dl is None else time.monotonic() + dl,
+            prefix_len=prefix_len)
         self._check_admission(req)
         _flight_record("request", str(req.id), phase="submit",
                        prompt=req.prompt_ids.shape[1],
@@ -557,10 +621,13 @@ class ServingEngine:
         breaker = self._breaker.state
         with self._stats_lock:
             stats = dict(self.stats)
+        kv = (self._engine.kv_stats() if self._engine is not None
+              else {"layout": "none"})
         return {
             "state": state,
             "mode": self.mode,
             "quant": self.quant or "off",
+            "kv": kv,
             "ok": alive and not self._draining.is_set()
                   and breaker != "open",
             "queue_depth": self._queue_depth(),

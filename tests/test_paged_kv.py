@@ -1,0 +1,409 @@
+"""Paged KV pool + shared-prefix cache (inference/kv_pool.py +
+decode_engine.py kv_layout="paged").
+
+The acceptance surface of the paged engine: exact greedy token parity with
+the slot-contiguous layout (bf16 and weight-only int8, both group-size
+schemes), prefix-cache hits emitting identical tokens to misses,
+ref-count/LRU-eviction unit behavior, the typed admission error when a
+request can never fit the pool, strictly-more-concurrency at a fixed KV
+byte budget, and page bookkeeping across every release path
+(retire/cancel/failure)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import paddlepaddle_tpu as paddle
+from paddlepaddle_tpu.inference import KVCapacityError, ServingEngine
+from paddlepaddle_tpu.inference.decode_engine import BatchDecodeEngine
+from paddlepaddle_tpu.inference.kv_pool import (
+    PagePool,
+    PrefixCache,
+    pages_needed,
+    prefix_hash,
+)
+from paddlepaddle_tpu.inference.serving import GenerationRequest
+
+
+def _model(dtype="float32"):
+    from paddlepaddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    return LlamaForCausalLM(LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=192,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=96, dtype=dtype))
+
+
+def _req(ids, n, temp=0.0, top_k=0, eos=None, prefix_len=None):
+    r = GenerationRequest(ids, n, temp, top_k, eos)
+    r.prefix_len = prefix_len
+    return r
+
+
+def _serve(eng, reqs, timeout=240):
+    eng.serve(reqs, timeout=timeout)
+    return [np.asarray(r.result.result(5)) for r in reqs]
+
+
+# -- host-side pool/prefix bookkeeping units ---------------------------------
+
+def test_page_pool_unit():
+    pool = PagePool(num_pages=9, page_size=16)
+    assert pool.usable == 8 and pool.free_count == 8 and pool.used == 0
+    a = pool.alloc(3)
+    assert len(a) == 3 and 0 not in a          # null page never handed out
+    assert pool.used == 3 and pool.peak_used == 3
+    b = pool.alloc(5)
+    assert pool.free_count == 0 and pool.peak_used == 8
+    with pytest.raises(RuntimeError, match="exhausted"):
+        pool.alloc(1)
+    pool.free(a)
+    assert pool.free_count == 3 and pool.peak_used == 8
+    with pytest.raises(ValueError, match="double free"):
+        pool.free([a[0]])
+    with pytest.raises(ValueError, match="invalid page"):
+        pool.free([0])
+    pool.free(b)
+    assert pool.used == 0
+    assert pages_needed(96, 16) == 6 and pages_needed(97, 16) == 7
+
+
+def test_prefix_cache_refcount_and_lru_eviction():
+    pool = PagePool(num_pages=11, page_size=16)
+    cache = PrefixCache()
+    pa, pb, pc = pool.alloc(2), pool.alloc(2), pool.alloc(2)
+    cache.register("a", pa, 32)
+    cache.register("b", pb, 32)
+    cache.register("c", pc, 32)
+    # registration holds one ref each — nothing evictable yet
+    assert cache.evict_until(pool, 10) == 0
+    cache.unref("a")                  # refcount 0, oldest
+    cache.unref("b")                  # refcount 0, newer
+    cache.ref("b")                    # back in use AND freshly used
+    cache.unref("b")
+    # need 6 free (have 4): evicts "a" first (LRU among refcount-0)
+    assert cache.evict_until(pool, 6) == 1
+    assert cache.lookup("a") is None and cache.lookup("b") is not None
+    assert pool.free_count == 6 and cache.evictions == 1
+    # "c" still referenced: asking for more than free+evictable stalls
+    assert cache.evict_until(pool, 10) == 1          # "b" goes too
+    assert pool.free_count == 8 and cache.lookup("c") is not None
+    cache.unref("c")
+    cache.clear(pool)
+    assert len(cache) == 0 and pool.free_count == 10
+    # hash is content- AND length-keyed
+    ids = np.arange(64, dtype=np.int32)
+    assert prefix_hash(ids, 32) != prefix_hash(ids, 16)
+    assert prefix_hash(ids, 32) == prefix_hash(ids.copy(), 32)
+
+
+# -- parity ------------------------------------------------------------------
+
+def test_paged_contiguous_greedy_parity_bf16():
+    """Exact greedy token parity, paged vs slot-contiguous, on a bf16
+    model with ragged prompts/budgets/eos and mid-flight admission —
+    the tentpole acceptance bar."""
+    m = _model("bfloat16")
+    rng = np.random.default_rng(0)
+    specs = [(5, 8, None), (17, 4, None), (3, 12, 7), (40, 6, None),
+             (9, 8, 3), (22, 3, None)]
+    prompts = [rng.integers(0, 128, (n,)).astype(np.int32)
+               for n, _, _ in specs]
+
+    def run(**kw):
+        eng = BatchDecodeEngine(m, max_slots=4, chunk=4, **kw)
+        reqs = [_req(p, mx, eos=e)
+                for p, (_, mx, e) in zip(prompts, specs)]
+        return eng, _serve(eng, reqs)
+
+    _, base = run(kv_layout="contiguous")
+    eng, outs = run(kv_layout="paged", page_size=16)
+    for a, b in zip(base, outs):
+        np.testing.assert_array_equal(a, b)
+    # all pages returned after every slot retired
+    st = eng.kv_stats()
+    assert st["pages_used"] == 0 and st["pages_peak"] > 0
+
+
+@pytest.mark.parametrize("gs", [-1, 16])
+def test_paged_contiguous_greedy_parity_int8(gs):
+    """quant="weight_only_int8" composes with the paged pool: the decode
+    body reads int8 weights through the same gather path, token-exact
+    against the contiguous int8 engine (per-channel and group-wise)."""
+    m = _model("bfloat16")
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(0, 128, (n,)).astype(np.int32)
+               for n in (5, 11, 21)]
+
+    def run(layout):
+        eng = BatchDecodeEngine(m, max_slots=4, chunk=4, kv_layout=layout,
+                                page_size=16, quant="weight_only_int8",
+                                quant_group_size=gs)
+        return _serve(eng, [_req(p, 6) for p in prompts])
+
+    for a, b in zip(run("contiguous"), run("paged")):
+        np.testing.assert_array_equal(a, b)
+
+
+# -- shared-prefix cache -----------------------------------------------------
+
+def test_prefix_hit_emits_identical_tokens():
+    """The hit path (tail-only prefill against cached prefix pages) must
+    emit exactly the tokens of the miss path / no-cache path, and the
+    cache must count one miss + N-1 hits with the prefix pages pinned."""
+    m = _model()
+    rng = np.random.default_rng(2)
+    system = rng.integers(0, 128, (35,)).astype(np.int32)  # aligns to 32
+    prompts = [np.concatenate(
+        [system, rng.integers(0, 128, (k,)).astype(np.int32)])
+        for k in (4, 7, 9)]
+
+    eng0 = BatchDecodeEngine(m, max_slots=4, chunk=4, page_size=16,
+                             prefix_cache=False)
+    base = _serve(eng0, [_req(p, 8, prefix_len=len(system))
+                         for p in prompts])
+
+    eng1 = BatchDecodeEngine(m, max_slots=4, chunk=4, page_size=16)
+    outs = _serve(eng1, [_req(p, 8, prefix_len=len(system))
+                         for p in prompts])
+    for a, b in zip(base, outs):
+        np.testing.assert_array_equal(a, b)
+    st = eng1.kv_stats()
+    assert st["prefix"] == {"enabled": True, "entries": 1,
+                            "cached_pages": 2, "hits": 2, "misses": 1,
+                            "evictions": 0}
+    # only the pinned prefix remains resident after all slots retired
+    assert st["pages_used"] == st["prefix"]["cached_pages"] == 2
+    # a fresh request re-hits the still-cached entry
+    more = _serve(eng1, [_req(prompts[0], 8, prefix_len=len(system))])
+    np.testing.assert_array_equal(more[0], base[0])
+    assert eng1.kv_stats()["prefix"]["hits"] == 3
+
+
+def test_prefix_eviction_when_free_list_dry():
+    """Refcount-0 prefix entries are LRU-evicted to satisfy a new
+    admission instead of blocking it."""
+    m = _model()
+    rng = np.random.default_rng(3)
+    # pool of 6 pages (ps=16): a 35+5+4-token prefix request uses 3, of
+    # which 2 stay pinned after retirement
+    eng = BatchDecodeEngine(m, max_slots=2, chunk=4, page_size=16,
+                            num_pages=7)
+    system = rng.integers(0, 128, (35,)).astype(np.int32)
+    p1 = np.concatenate([system, rng.integers(0, 128, (5,)).astype(np.int32)])
+    _serve(eng, [_req(p1, 4, prefix_len=35)])
+    assert eng.kv_stats()["pages_used"] == 2          # the cached prefix
+    # a request needing 6 pages (> 6 - 2 = 4 free) forces the eviction
+    big = rng.integers(0, 128, (88,)).astype(np.int32)
+    ref = BatchDecodeEngine(m, max_slots=2, chunk=4, page_size=16)
+    expect = _serve(ref, [_req(big, 8)])[0]
+    out = _serve(eng, [_req(big, 8)])[0]
+    np.testing.assert_array_equal(out, expect)
+    st = eng.kv_stats()
+    assert st["prefix"]["evictions"] == 1 and st["prefix"]["entries"] == 0
+
+
+def test_prefix_hit_never_evicts_its_own_entry():
+    """A hit whose private reservation triggers eviction must evict OTHER
+    refcount-0 entries, never the entry it is about to reference — and a
+    hit whose TOTAL need (pinned prefix + private) exceeds capacity is
+    typed-rejected, not spun on."""
+    from paddlepaddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    m = LlamaForCausalLM(LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=192,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128))
+    rng = np.random.default_rng(9)
+    sys_a = rng.integers(0, 128, (35,)).astype(np.int32)   # 2 pages aligned
+    sys_b = rng.integers(0, 128, (35,)).astype(np.int32)
+    eng = BatchDecodeEngine(m, max_slots=2, chunk=4, page_size=16,
+                            num_pages=9)                   # 8 usable
+    for s in (sys_a, sys_b):        # register both, retire -> refcount 0
+        p = np.concatenate([s, rng.integers(0, 128, (5,)).astype(np.int32)])
+        _serve(eng, [_req(p, 4, prefix_len=35)])
+    assert eng.kv_stats()["pages_used"] == 4               # A + B pinned
+    # hit on A needing 5 private pages (total 7): free is 4, so eviction
+    # must take B — with A excluded, A survives and the hit succeeds
+    big = np.concatenate([sys_a,
+                          rng.integers(0, 128, (27,)).astype(np.int32)])
+    ref = m.generate_cached(big[None], max_new_tokens=40,
+                            temperature=0.0).numpy()[0]
+    out = _serve(eng, [_req(big, 40, prefix_len=35)])[0]
+    np.testing.assert_array_equal(out, ref)
+    st = eng.kv_stats()
+    assert st["prefix"]["evictions"] == 1                  # B, not A
+    assert st["prefix"]["entries"] == 1 and st["prefix"]["hits"] == 1
+    # total-need capacity: the same hit against a 6-usable pool can never
+    # fit beside its own pinned prefix -> typed error, even on a hit
+    eng2 = BatchDecodeEngine(m, max_slots=2, chunk=4, page_size=16,
+                             num_pages=7)                  # 6 usable
+    p = np.concatenate([sys_a, rng.integers(0, 128, (5,)).astype(np.int32)])
+    _serve(eng2, [_req(p, 4, prefix_len=35)])
+    with pytest.raises(KVCapacityError) as ei:
+        eng2._admit(_req(big, 40, prefix_len=35))          # total 7 > 6
+    assert ei.value.pages_needed == 7 and ei.value.pages_capacity == 6
+    # serve() fails the oversized future typed and still serves the rest
+    r_bad, r_ok = _req(big, 40, prefix_len=35), _req(p, 4, prefix_len=35)
+    eng2.serve([r_bad, r_ok], timeout=240)
+    with pytest.raises(KVCapacityError):
+        r_bad.result.result(1)
+    assert len(np.asarray(r_ok.result.result(5))) == 44
+
+
+# -- capacity & concurrency --------------------------------------------------
+
+def test_kv_capacity_typed_error_at_submit():
+    """A prompt+budget that cannot fit the page pool EVEN EMPTY is shed
+    with the typed error at submit (the PR-3 path), not queued forever;
+    the engine raises the same error for direct users."""
+    m = _model()
+    rng = np.random.default_rng(4)
+    big = rng.integers(0, 128, (80,)).astype(np.int32)
+    with ServingEngine(m, max_batch_size=2, decode_chunk=4,
+                       kv_page_size=16, kv_num_pages=5) as eng:
+        with pytest.raises(KVCapacityError, match="KV pages") as ei:
+            eng.submit(big, max_new_tokens=16)        # needs 6 > 4 usable
+        assert ei.value.pages_needed == 6 and ei.value.pages_capacity == 4
+        assert isinstance(ei.value, ValueError)       # client contract
+        assert eng.stats["shed"] == 1
+        # a fitting request still serves
+        out = eng.generate(rng.integers(0, 128, (10,)).astype(np.int32),
+                           max_new_tokens=4, timeout=120)
+        assert len(out) == 14
+    eng2 = BatchDecodeEngine(m, max_slots=2, chunk=4, page_size=16,
+                             num_pages=5)
+    with pytest.raises(KVCapacityError):
+        eng2._admit(_req(big, 16))
+
+
+def test_paged_concurrency_exceeds_contiguous_at_same_budget():
+    """At a KV byte budget worth TWO contiguous max_len slots, the paged
+    pool runs SIX short requests concurrently — the tentpole's
+    concurrency-by-real-bytes claim."""
+    m = _model()
+    rng = np.random.default_rng(5)
+    # budget: 2 slots x ceil(96/16)=6 pages = 12 pages
+    eng = BatchDecodeEngine(m, max_slots=6, chunk=4, page_size=16,
+                            num_pages=13)
+    prompts = [rng.integers(0, 128, (8,)).astype(np.int32)
+               for _ in range(6)]
+    reqs = [_req(p, 8) for p in prompts]              # 1 page each
+    outs = _serve(eng, reqs)
+    assert eng.stats["peak_busy"] == 6                # > the 2 contiguous
+    for p, o in zip(prompts, outs):
+        ref = m.generate_cached(p[None], max_new_tokens=8,
+                                temperature=0.0).numpy()[0]
+        np.testing.assert_array_equal(o, ref)
+    # when the pool IS dry, admission waits (returns False) instead of
+    # failing — and proceeds after a retirement frees pages
+    eng2 = BatchDecodeEngine(m, max_slots=4, chunk=4, page_size=16,
+                             num_pages=5)             # 4 usable pages
+    r1, r2 = _req(prompts[0], 40), _req(prompts[1], 40)  # 3 pages each
+    assert eng2._admit(r1) is True
+    assert eng2._admit(r2) is False                   # 1 page free < 3
+    outs2 = _serve(eng2, [r2])                        # serve retires r1 too
+    assert len(np.asarray(r1.result.result(5))) == 48
+    assert len(outs2[0]) == 48
+
+
+def test_release_paths_return_pages():
+    """Every way a slot dies gives its pages back: normal retire, cancel
+    (release_slot), and the decode-failure reset."""
+    m = _model()
+    rng = np.random.default_rng(6)
+    eng = BatchDecodeEngine(m, max_slots=3, chunk=4, page_size=16)
+    free0 = eng.pool.free_count
+    reqs = [_req(rng.integers(0, 128, (9,)).astype(np.int32), 6)
+            for _ in range(3)]
+    for r in reqs:
+        assert eng._admit(r)
+    assert eng.pool.free_count < free0
+    eng.release_slot(0)                               # cancel path
+    eng.reset_slots()                                 # failure path
+    assert eng.pool.free_count == free0
+    assert all(not p for p in eng._slot_pages)
+    # page-table rows are zeroed so in-flight writes hit the null page
+    assert int(np.asarray(eng.page_table).sum()) == 0
+
+
+# -- observability -----------------------------------------------------------
+
+def test_kv_gauges_and_health_block():
+    import paddlepaddle_tpu.observability as obs
+
+    m = _model()
+    rng = np.random.default_rng(7)
+    system = rng.integers(0, 128, (35,)).astype(np.int32)
+    with ServingEngine(m, max_batch_size=2, decode_chunk=4,
+                       kv_page_size=16) as eng:
+        p = np.concatenate([system,
+                            rng.integers(0, 128, (6,)).astype(np.int32)])
+        eng.generate(p, max_new_tokens=4, prefix_len=35, timeout=120)
+        eng.generate(p, max_new_tokens=4, prefix_len=35, timeout=120)
+        h = eng.health()
+        assert h["kv"]["layout"] == "paged"
+        assert h["kv"]["pages_total"] == eng._engine.pool.usable
+        assert h["kv"]["prefix"]["hits"] == 1
+    text = obs.to_prometheus_text()
+    for name in ("paddle_serving_kv_pages_total",
+                 "paddle_serving_kv_pages_free",
+                 "paddle_serving_kv_prefix_hits_total"):
+        assert name in text, name
+    # the contiguous layout reports itself too (the A/B's other arm)
+    with ServingEngine(m, max_batch_size=2, kv_layout="contiguous") as eng2:
+        assert eng2.health()["kv"]["layout"] == "contiguous"
+        assert eng2.health()["kv"]["kv_bytes"] > 0
+
+
+# -- robustness against the paged engine -------------------------------------
+
+@pytest.mark.chaos
+def test_chaos_decode_storm_paged_breaker_recovery():
+    """The PR-3 chaos drill re-run against the PAGED engine with a shared
+    prefix in flight: injected decode faults fail the in-flight requests
+    and return their pages, the breaker opens then recovers, and the
+    prefix cache still serves hits afterwards."""
+    from paddlepaddle_tpu.resilience import chaos
+
+    m = _model()
+    rng = np.random.default_rng(8)
+    system = rng.integers(0, 128, (35,)).astype(np.int32)
+    p = np.concatenate([system, rng.integers(0, 128, (6,)).astype(np.int32)])
+    # ONE slot: each injected failure is its own decode attempt, so the
+    # storm deterministically reaches the breaker threshold
+    eng = ServingEngine(m, max_batch_size=1, decode_chunk=4,
+                        kv_page_size=16, breaker_threshold=2,
+                        breaker_reset_s=0.2)
+    transitions = []
+    orig = eng._breaker._on_transition
+    eng._breaker._on_transition = \
+        lambda o, n: (transitions.append((o, n)), orig(o, n))
+    try:
+        ref = eng.generate(p, max_new_tokens=6, prefix_len=35, timeout=300)
+        chaos.configure("serving.decode:exc:x2", seed=1)
+        failed = [eng.submit(np.concatenate(
+            [system, rng.integers(0, 128, (6,)).astype(np.int32)]),
+            max_new_tokens=6, prefix_len=35) for _ in range(2)]
+        for f in failed:
+            with pytest.raises(chaos.ChaosError):
+                f.result(120)
+        deadline = time.time() + 10
+        while time.time() < deadline \
+                and ("closed", "open") not in transitions:
+            time.sleep(0.02)
+        assert ("closed", "open") in transitions, transitions
+        chaos.disable()
+        # pages of the failed slots came back (only the prefix is pinned)
+        assert eng._engine.kv_stats()["pages_used"] == 2
+        time.sleep(0.25)                  # reset window
+        out = eng.generate(p, max_new_tokens=6, prefix_len=35, timeout=120)
+        np.testing.assert_array_equal(out, ref)
+        assert eng.stats["decode_failures"] >= 2
+        assert eng._engine.kv_stats()["prefix"]["hits"] >= 3
+    finally:
+        chaos.disable()
+        eng.stop()

@@ -484,6 +484,34 @@ def test_perf_gate_synthetic(tmp_path):
     assert _gate(["--baseline", str(bad)]) == 2
 
 
+def test_perf_gate_paged_kv_serving_fields(tmp_path):
+    """The paged-KV serving_bench columns gate direction-aware: hit rate /
+    concurrency / mixed tokens/s falling is a regression, occupancy
+    RISING is a regression (it's memory per workload, lower = better)."""
+    bench, _ = _bench_doc()
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(bench))
+
+    def serving(hit=0.9, conc=8, occ=0.5, mixed=800.0):
+        return {"serving_bench": {
+            "aggregate_tok_s": 500.0, "ttft_p50_ms": 10.0,
+            "prefix_hit_rate": hit, "concurrency_peak": conc,
+            "kv_occupancy_peak": occ, "mixed_tok_s": mixed}}
+
+    sbase = tmp_path / "sbase.json"
+    sbase.write_text(json.dumps(serving()))
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(serving(hit=0.95, occ=0.4)))  # improvements
+    assert _gate(["--baseline", str(base), "--current", str(base),
+                  "--serving", str(good), str(sbase)]) == 0
+    for bad_kw in ({"hit": 0.5}, {"conc": 4}, {"mixed": 600.0},
+                   {"occ": 0.9}):
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps(serving(**bad_kw)))
+        assert _gate(["--baseline", str(base), "--current", str(base),
+                      "--serving", str(bad), str(sbase)]) == 1, bad_kw
+
+
 def test_perf_gate_real_baseline_dry_run():
     """The run_tier1 smoke: the shipped BENCH_r05.json parses and the
     gate passes against itself."""

@@ -107,6 +107,14 @@ def serving_metrics(doc: dict) -> Dict[str, Tuple[float, str]]:
             out[name] = (float(value), direction)
 
     put("serving.aggregate_tok_s", body.get("aggregate_tok_s"), HIGHER)
+    # paged-KV / prefix-cache columns (serving_bench --profile mixed/prefix):
+    # throughput-and-packing numbers fall under --tol, occupancy (a
+    # memory-per-workload number, lower = better packing) under the
+    # latency budget since it's the noisier tail-ish statistic
+    put("serving.mixed_tok_s", body.get("mixed_tok_s"), HIGHER)
+    put("serving.prefix_hit_rate", body.get("prefix_hit_rate"), HIGHER)
+    put("serving.concurrency_peak", body.get("concurrency_peak"), HIGHER)
+    put("serving.kv_occupancy_peak", body.get("kv_occupancy_peak"), LOWER)
     for slo_src in (body,) + tuple(
             body.get(k) for k in ("bf16", "int8") if isinstance(
                 body.get(k), dict)):

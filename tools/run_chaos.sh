@@ -9,7 +9,10 @@
 #     elastic-restart + SIGTERM-drain end-to-end (test_chaos_elastic.py)
 #   * serving: serving.admit / serving.decode seams — fault storm opens the
 #     circuit breaker, half-open probe recovers the engine without restart
-#     (test_serving_robustness.py)
+#     (test_serving_robustness.py; the continuous-engine drills run against
+#     the PAGED KV pool — the default — and test_paged_kv.py adds the
+#     paged-specific drill: failed slots return their pages and the
+#     shared-prefix cache survives the storm)
 #   * black box: PADDLE_CHAOS_POINTS=step:kill:@4 under PADDLE_OBS_BLACKBOX
 #     kills a launched worker mid-step; the flight recorder's JSONL dump
 #     must carry the in-flight step event + all-thread stacks, and

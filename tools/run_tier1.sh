@@ -17,7 +17,9 @@
 # --current <new>.json` exits nonzero on a tokens/s / MFU / TTFT
 # regression beyond tolerance; `--baseline BENCH_r05.json --dry-run` is
 # the wiring smoke (always exit 0) and is covered by
-# tests/test_perf_attribution.py in this tier.
+# tests/test_perf_attribution.py in this tier. The --serving pair also
+# gates the paged-KV serving_bench fields (mixed_tok_s, prefix_hit_rate,
+# concurrency_peak higher-is-better; kv_occupancy_peak lower-is-better).
 set -o pipefail
 cd "$(dirname "$0")/.."
 rm -f /tmp/_t1.log

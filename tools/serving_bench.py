@@ -1,15 +1,28 @@
-"""Serving throughput: continuous batching vs single-sequence decode.
+"""Serving throughput: continuous batching, paged-KV A/B, prefix cache.
 
-The BASELINE.md serving card: N concurrent ragged requests on the 254M
-flagship, aggregate new tokens/sec. Single-sequence generate_cached was
-293 tok/s in round 3 (and the per-call floor makes it worse today); the
-slot-based continuous engine amortizes all slots into one multi-step
-compiled decode program.
+The BASELINE.md serving card. Three workload profiles:
 
-Run on the TPU: python tools/serving_bench.py [--slots 16] [--reqs 32]
+* ``uniform``  — the original card: N concurrent ragged requests,
+  aggregate new tokens/sec vs a single-sequence generate_cached baseline.
+* ``mixed``    — mixed short/long prompts under a FIXED KV byte budget:
+  the paged pool admits by real prompt+budget pages, the contiguous pool
+  by worst-case ``max_len`` slots. ``--ab`` runs both layouts at the same
+  HBM budget and prints concurrency + tokens/s side by side — the paged
+  engine must sustain strictly more concurrent sequences.
+* ``prefix``   — every request shares one system prompt (``--prefix-len``)
+  plus a short unique tail, submitted with ``prefix_len=`` so the paged
+  engine's prompt cache turns N prefills into 1 prefill + N tails.
+  Reported against a control run with the cache disabled (TTFT delta).
+
+Reports KV-pool occupancy, prefix hit rate and peak concurrency next to
+the TTFT/TPOT SLO columns; ``tools/perf_gate.py`` gates the JSON artifact.
+
+Run on the TPU: python tools/serving_bench.py [--profile mixed --ab]
+CPU-container smoke: add ``--hidden 128 --layers 2 --max-len 1024``.
 """
 
 import argparse
+import json
 import os
 import sys
 import time
@@ -18,70 +31,203 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
-from paddlepaddle_tpu.inference.serving import slo_summary
+from paddlepaddle_tpu.inference.serving import ServingEngine, slo_summary
+
+
+def build_model(args):
+    from paddlepaddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=args.hidden,
+                      intermediate_size=args.hidden * 4,
+                      num_hidden_layers=args.layers,
+                      num_attention_heads=max(args.hidden // 64, 4),
+                      num_key_value_heads=max(args.hidden // 128, 2),
+                      max_position_embeddings=args.max_len,
+                      dtype="bfloat16")
+    return LlamaForCausalLM(cfg)
+
+
+def gen_prompts(args, cfg, rng):
+    """[(prompt_ids, prefix_len|None)] for the chosen profile."""
+    V = cfg.vocab_size
+    lo, hi = 32, 256
+    if args.profile == "mixed":
+        # half short, half long — the fragmentation workload the paged
+        # pool exists for (long requests must not reserve max_len for
+        # every short one)
+        out = []
+        long_hi = min(args.max_len - args.new_tokens - 1, 768)
+        for i in range(args.reqs):
+            n = (int(rng.integers(32, 64)) if i % 2 == 0
+                 else int(rng.integers(long_hi // 2, long_hi)))
+            out.append((rng.integers(0, V, (n,)).astype(np.int32), None))
+        return out
+    if args.profile == "prefix":
+        system = rng.integers(0, V, (args.prefix_len,)).astype(np.int32)
+        out = []
+        for _ in range(args.reqs):
+            tail = rng.integers(0, V, (int(rng.integers(16, 48)),))
+            out.append((np.concatenate([system, tail.astype(np.int32)]),
+                        args.prefix_len))
+        return out
+    return [(rng.integers(0, V, (int(rng.integers(lo, hi)),)).astype(np.int32),
+             None) for _ in range(args.reqs)]
+
+
+def run_serving(model, prompts, args, kv_layout, slots, num_pages=None,
+                prefix_cache=True, warm=True):
+    """One engine pass over the workload; returns the metrics row."""
+    with ServingEngine(model, max_batch_size=slots,
+                       decode_chunk=args.chunk, kv_layout=kv_layout,
+                       kv_page_size=args.page_size, kv_num_pages=num_pages,
+                       prefix_cache=prefix_cache) as eng:
+        if warm:
+            # warm EVERY prefill bucket the prompts will hit + the decode
+            # program, so compile time doesn't pollute the timed window
+            rng = np.random.default_rng(7)
+            for blen in sorted({-(-len(p) // 128) * 128 for p, _ in prompts}):
+                eng.generate(rng.integers(0, model.config.vocab_size,
+                                          (min(blen, eng._max_len
+                                               - args.new_tokens) - 1,)
+                                          ).astype(np.int32),
+                             max_new_tokens=4)
+            pl = next((pl for _, pl in prompts if pl), None)
+            if pl and prefix_cache and eng._engine.kv_layout == "paged":
+                # warm the prefix-HIT admit program with a throwaway
+                # system prompt (miss registers it, hit compiles the
+                # tail-only program), then evict it and zero the counters
+                V = model.config.vocab_size
+                sysp = rng.integers(0, V, (pl,)).astype(np.int32)
+                for _ in range(2):
+                    eng.generate(np.concatenate(
+                        [sysp, rng.integers(0, V, (24,)).astype(np.int32)]),
+                        max_new_tokens=4, prefix_len=pl)
+                pfx, pool = eng._engine.prefix, eng._engine.pool
+                pfx.evict_until(pool, pool.usable)
+                pfx.hits = pfx.misses = pfx.evictions = 0
+        if eng._engine.kv_layout == "paged":
+            # occupancy peak must measure the WORKLOAD, not warm traffic
+            eng._engine.pool.peak_used = eng._engine.pool.used
+        eng._engine.stats["peak_busy"] = 0
+        t0 = time.perf_counter()
+        futs = [eng.submit(p, max_new_tokens=args.new_tokens, prefix_len=pl)
+                for p, pl in prompts]
+        outs = [f.result(1800) for f in futs]
+        dt = time.perf_counter() - t0
+        kv = eng._engine.kv_stats()
+        peak_busy = eng._engine.stats["peak_busy"]
+    new_tokens = sum(len(o) - len(p) for o, (p, _) in zip(outs, prompts))
+    row = {"kv_layout": kv_layout, "slots": slots,
+           "aggregate_tok_s": round(new_tokens / max(dt, 1e-9), 1),
+           "wall_s": round(dt, 2), "new_tokens": new_tokens,
+           "concurrency_peak": peak_busy}
+    row.update(slo_summary(futs))
+    if kv["layout"] == "paged":
+        row["kv_pages_total"] = kv["pages_total"]
+        row["kv_occupancy_peak"] = round(
+            kv["pages_peak"] / max(kv["pages_total"], 1), 4)
+        pfx = kv["prefix"]
+        looked = pfx["hits"] + pfx["misses"]
+        row["prefix_hit_rate"] = (round(pfx["hits"] / looked, 4)
+                                  if looked else None)
+        row["prefix_evictions"] = pfx["evictions"]
+    return row
+
+
+def fmt(row, label):
+    print(f"{label:<22} {row['aggregate_tok_s']:8.1f} tok/s  "
+          f"concurrency_peak={row['concurrency_peak']}"
+          + (f"  occupancy_peak={row['kv_occupancy_peak']:.0%}"
+             if "kv_occupancy_peak" in row else "")
+          + (f"  prefix_hit_rate={row['prefix_hit_rate']}"
+             if row.get("prefix_hit_rate") is not None else ""))
+    print(f"{'':<22} SLO: ttft p50={row['ttft_p50_ms']}ms "
+          f"p99={row['ttft_p99_ms']}ms  tpot={row['tpot_ms']}ms/token  "
+          f"queue_wait p99={row['queue_wait_p99_ms']}ms", flush=True)
 
 
 def main():
     ap = argparse.ArgumentParser()
+    ap.add_argument("--profile", choices=("uniform", "mixed", "prefix"),
+                    default="uniform")
     ap.add_argument("--slots", type=int, default=16)
     ap.add_argument("--reqs", type=int, default=32)
     ap.add_argument("--new-tokens", type=int, default=64)
     ap.add_argument("--chunk", type=int, default=32)
+    ap.add_argument("--kv-layout", choices=("paged", "contiguous"),
+                    default="paged")
+    ap.add_argument("--page-size", type=int, default=64)
+    ap.add_argument("--num-pages", type=int, default=None,
+                    help="paged-pool capacity (default: slots x max_len "
+                    "worth — the contiguous pool's bytes)")
+    ap.add_argument("--ab", action="store_true",
+                    help="run paged AND contiguous at the same KV byte "
+                    "budget (--budget-slots contiguous slots define it)")
+    ap.add_argument("--budget-slots", type=int, default=None,
+                    help="contiguous slots whose bytes fix the A/B budget "
+                    "(default slots//2)")
+    ap.add_argument("--prefix-len", type=int, default=256,
+                    help="shared system-prompt length (prefix profile)")
+    ap.add_argument("--hidden", type=int, default=1024)
+    ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--max-len", type=int, default=2048)
     args = ap.parse_args()
 
-    from paddlepaddle_tpu.inference.serving import ServingEngine
-    from paddlepaddle_tpu.models import LlamaConfig, LlamaForCausalLM
-
-    cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
-                      intermediate_size=4096, num_hidden_layers=12,
-                      num_attention_heads=16, num_key_value_heads=8,
-                      max_position_embeddings=2048, dtype="bfloat16")
-    model = LlamaForCausalLM(cfg)
+    model = build_model(args)
+    cfg = model.config
     rng = np.random.default_rng(0)
-    prompts = [rng.integers(0, cfg.vocab_size,
-                            (int(rng.integers(32, 256)),)).astype(np.int32)
-               for _ in range(args.reqs)]
+    prompts = gen_prompts(args, cfg, rng)
 
     # single-sequence baseline (one request, same budget)
-    t0 = time.perf_counter()
-    model.generate_cached(prompts[0][None], max_new_tokens=args.new_tokens,
+    p0 = prompts[0][0]
+    model.generate_cached(p0[None], max_new_tokens=args.new_tokens,
                           temperature=0.0)
     t0 = time.perf_counter()  # second call: compiled
-    model.generate_cached(prompts[0][None], max_new_tokens=args.new_tokens,
+    model.generate_cached(p0[None], max_new_tokens=args.new_tokens,
                           temperature=0.0)
     single_dt = time.perf_counter() - t0
     single_tps = args.new_tokens / single_dt
     print(f"single-sequence: {single_tps:8.1f} tok/s "
           f"({args.new_tokens} tokens in {single_dt:.2f}s)", flush=True)
 
-    with ServingEngine(model, max_batch_size=args.slots,
-                       decode_chunk=args.chunk) as eng:
-        # warm EVERY prefill bucket the prompts will hit + the decode program
-        for blen in sorted({-(-len(p) // 128) * 128 for p in prompts}):
-            eng.generate(rng.integers(0, cfg.vocab_size,
-                                      (blen - 1,)).astype(np.int32),
-                         max_new_tokens=4)
-        t0 = time.perf_counter()
-        futs = [eng.submit(p, max_new_tokens=args.new_tokens)
-                for p in prompts]
-        outs = [f.result(900) for f in futs]
-        dt = time.perf_counter() - t0
-    new_tokens = sum(len(o) - len(p) for o, p in zip(outs, prompts))
-    agg = new_tokens / dt
-    slo = slo_summary(futs)
-    print(f"continuous x{args.slots} slots, {args.reqs} reqs: "
-          f"{agg:8.1f} tok/s aggregate ({new_tokens} tokens in {dt:.2f}s, "
-          f"{agg / max(single_tps, 1e-9):.1f}x single)")
-    print(f"SLO: ttft p50={slo['ttft_p50_ms']}ms p99={slo['ttft_p99_ms']}ms"
-          f"  tpot={slo['tpot_ms']}ms/token"
-          f"  queue_wait p99={slo['queue_wait_p99_ms']}ms")
-    import json
+    body = {"profile": args.profile, "requests": args.reqs,
+            "new_tokens_per_req": args.new_tokens,
+            "single_tok_s": round(single_tps, 1)}
 
-    print(json.dumps({"serving_bench": dict({
-        "slots": args.slots, "requests": args.reqs,
-        "new_tokens_per_req": args.new_tokens,
-        "single_tok_s": round(single_tps, 1),
-        "aggregate_tok_s": round(agg, 1)}, **slo)}))
+    if args.ab:
+        # fixed KV byte budget: slots_c contiguous slots' worth of pool
+        slots_c = args.budget_slots or max(args.slots // 2, 1)
+        pages_budget = slots_c * (-(-cfg.max_position_embeddings
+                                    // args.page_size)) + 1
+        print(f"A/B at a fixed KV budget = {slots_c} contiguous slots "
+              f"({pages_budget - 1} pages of {args.page_size}):")
+        con = run_serving(model, prompts, args, "contiguous", slots_c)
+        fmt(con, f"contiguous x{slots_c}")
+        pag = run_serving(model, prompts, args, "paged", args.slots,
+                          num_pages=pages_budget)
+        fmt(pag, f"paged x{args.slots}")
+        body.update(pag)         # headline row = the paged engine
+        body["contiguous"] = con
+        body["kv_budget_slots"] = slots_c
+    else:
+        row = run_serving(model, prompts, args, args.kv_layout, args.slots,
+                          num_pages=args.num_pages)
+        fmt(row, f"{args.kv_layout} x{args.slots}")
+        body.update(row)
+        print(f"({row['aggregate_tok_s'] / max(single_tps, 1e-9):.1f}x "
+              "single-sequence)")
+
+    if args.profile == "prefix":
+        # control: same workload, prompt cache off — the TTFT delta IS the
+        # prefill work the cache removes
+        ctl = run_serving(model, prompts, args, args.kv_layout, args.slots,
+                          num_pages=args.num_pages, prefix_cache=False)
+        fmt(ctl, "prefix-cache OFF")
+        body["no_prefix_cache"] = ctl
+    if args.profile == "mixed":
+        body["mixed_tok_s"] = body["aggregate_tok_s"]
+
+    print(json.dumps({"serving_bench": body}))
 
 
 if __name__ == "__main__":
