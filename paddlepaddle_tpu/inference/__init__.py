@@ -159,10 +159,12 @@ from .robustness import (  # noqa: F401,E402
     CircuitOpenError,
     DeadlineExceededError,
     EngineDrainingError,
+    FleetUnavailableError,
     KVCapacityError,
     RequestCancelledError,
     RequestValidationError,
     ServerOverloadedError,
     ServingError,
 )
+from .router import ReplicaClient, ServingRouter  # noqa: F401,E402
 from .serving import GenerationResult, ServingEngine  # noqa: F401,E402

@@ -35,8 +35,8 @@ from typing import Callable, Optional
 __all__ = [
     "ServingError", "ServerOverloadedError", "DeadlineExceededError",
     "RequestCancelledError", "CircuitOpenError", "EngineDrainingError",
-    "RequestValidationError", "KVCapacityError", "CircuitBreaker",
-    "QueueWaitEstimator", "safe_inc", "safe_set",
+    "RequestValidationError", "KVCapacityError", "FleetUnavailableError",
+    "CircuitBreaker", "QueueWaitEstimator", "safe_inc", "safe_set",
 ]
 
 
@@ -122,6 +122,21 @@ class KVCapacityError(RequestValidationError):
         self.pages_capacity = int(pages_capacity)
 
 
+class FleetUnavailableError(ServingError):
+    """Every replica behind the :class:`~.router.ServingRouter` is out of
+    rotation (evicted by its breaker, draining, or dead) — the fleet as a
+    whole cannot admit the request. Carries the replica census and a
+    retry-after hint (the soonest half-open probe window among the evicted
+    replicas) so clients back off instead of hammering a dead fleet."""
+
+    def __init__(self, msg: str, replicas: int = 0, healthy: int = 0,
+                 retry_after_s: float = 0.0):
+        super().__init__(msg)
+        self.replicas = int(replicas)
+        self.healthy = int(healthy)
+        self.retry_after_s = float(retry_after_s)
+
+
 class CircuitBreaker:
     """Consecutive-failure circuit breaker with half-open probe recovery.
 
@@ -199,6 +214,15 @@ class CircuitBreaker:
         with self._lock:
             self._consecutive = max(self._consecutive, self.threshold)
             self._transition("open")
+
+    def reset(self) -> None:
+        """Return to ``closed`` with zero failures. For backend
+        replacement (engine restart after drain, a router replica swapped
+        for a fresh one): the new backend must not inherit its
+        predecessor's failure history or sit out a stale reset window."""
+        with self._lock:
+            self._consecutive = 0
+            self._transition("closed")
 
     def allow(self) -> bool:
         """True when work may proceed (closed, or open long enough that a

@@ -1,0 +1,815 @@
+"""Serving fleet router — health-aware load balancing, replica failover,
+rolling restart over N decode engines.
+
+Reference surface: the reference deployment layer's predictor POOL
+(paddle/fluid/inference/api/paddle_inference_api.h:229 PredictorPool) scaled
+from "a pool of handles in one process" to "a fleet of replica engines
+behind one front door". PRs 3–7 built every signal a fleet needs —
+``health()`` snapshots with ``est_wait_s``/``inflight``/``pages_free``,
+``drain(timeout)``, per-engine circuit breakers, per-request SLO stamps,
+and a replica-local prefix cache; :class:`ServingRouter` is the component
+that finally *uses* them together, turning one engine into a service.
+
+Mechanics (all stdlib, no JAX imports — the replicas own the chips):
+
+* **health-aware balancing** — a prober thread polls every replica's
+  ``health()`` each ``probe_interval_s``; picks go to the healthy replica
+  with the least estimated wait (snapshot ``est_wait_s``, live router-side
+  in-flight count as the tiebreak). A per-replica
+  :class:`~.robustness.CircuitBreaker` evicts a replica whose probes or
+  requests keep failing and re-admits it via the half-open window once a
+  probe sees ``ok`` again.
+* **failover with retry** — a request whose replica dies mid-flight
+  (breaker-open, typed infra shed, chaos kill) is re-submitted to another
+  replica under a :class:`~..resilience.retry.RetryPolicy`: bounded
+  attempts, jittered exponential backoff between fleet-wide rounds, and
+  deadline-aware — no retry is ever scheduled past the request's
+  ``deadline_s``. Requests that can never succeed anywhere (validation,
+  expired deadline, client cancel) are NOT retried. When every replica is
+  out of rotation, submits raise a typed
+  :class:`~.robustness.FleetUnavailableError` (with the soonest half-open
+  window as the retry hint).
+* **rolling restart** — :meth:`ServingRouter.rolling_restart` takes one
+  replica out of rotation, drains it (in-flight requests finish; queued
+  ones shed typed and FAIL OVER to the other replicas), restarts it with a
+  fresh engine, waits until its health probe reads ok, re-admits it, then
+  proceeds to the next — a deploy drops zero requests.
+* **prefix-affine routing** — requests declaring ``prefix_len`` rendezvous-
+  hash their prefix tokens over the healthy replicas, so every request
+  sharing a system prompt lands on the replica whose paged prefix cache
+  (PR 7) already holds its pages; the router falls back to least-loaded
+  when the preferred replica is unhealthy or saturated
+  (``affinity_max_wait_s``).
+
+The replica seam is :class:`ReplicaClient` — the four-method surface the
+router needs (``submit/health/drain/restart``). The in-process form wraps a
+:class:`~.serving.ServingEngine` factory; a remote replica (HTTP
+``/healthz`` + the C-API submit protocol) slots in by implementing the same
+surface.
+
+Observability: ``paddle_router_{picks,retries,failovers,evictions,
+readmissions}_total`` counters + ``paddle_router_replicas_healthy`` gauge
+(cold paths, via safe_inc/safe_set), a ``router`` block in
+:meth:`ServingRouter.health`, and eviction/failover/rolling-restart events
+through the crash flight recorder.
+
+Invariant the chaos drill enforces (tests/test_router.py): every submitted
+request's future resolves — completed, or failed with a typed error. Zero
+silently-lost futures, whatever dies.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import heapq
+import itertools
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..resilience.retry import RetryPolicy, compute_delay
+from .robustness import (
+    CircuitBreaker,
+    CircuitOpenError,
+    DeadlineExceededError,
+    EngineDrainingError,
+    FleetUnavailableError,
+    RequestCancelledError,
+    ServerOverloadedError,
+    ServingError,
+)
+from .robustness import safe_inc as _safe_inc
+from .robustness import safe_set as _safe_set
+from .serving import _REQ_IDS, GenerationResult, ServingEngine
+from .serving import _flight_record  # one disarmed-check wrapper, not two
+
+
+def _retryable(exc: BaseException) -> bool:
+    """May another replica serve this request? Infra failures — overload,
+    open breaker, draining replica, or anything that is NOT a typed
+    serving error (decode blew up, chaos, dead replica) — yes. Failures
+    that travel with the request (validation, expired deadline, client
+    cancel) or with the whole fleet (FleetUnavailableError) — no."""
+    if isinstance(exc, (CircuitOpenError, EngineDrainingError,
+                        ServerOverloadedError)):
+        return True
+    return not isinstance(exc, ServingError)
+
+
+class ReplicaClient:
+    """The seam between the router and ONE replica. In-process form: owns a
+    :class:`~.serving.ServingEngine` built by ``factory`` (a zero-arg
+    callable), rebuilt fresh on :meth:`restart`. A remote replica — HTTP
+    ``/healthz`` for :meth:`health`, the C-API frame protocol for
+    :meth:`submit` — implements this same surface and slots in unchanged.
+
+    ``kill()`` is the chaos seam: abrupt replica death. In-flight futures
+    fail untyped (the router's failover path), and the replica refuses
+    everything — including health probes — until :meth:`restart`.
+    """
+
+    def __init__(self, factory: Callable[[], ServingEngine],
+                 name: str = "replica"):
+        self._factory = factory
+        self.name = name
+        self.engine = factory()
+        self.generation = 0          # bumped per fresh engine
+        self._killed = False
+
+    def start(self) -> "ReplicaClient":
+        if self._killed:
+            raise ConnectionError(f"replica {self.name} is dead")
+        self.engine.start()
+        return self
+
+    def submit(self, prompt_ids, **kw) -> GenerationResult:
+        if self._killed:
+            raise ConnectionError(f"replica {self.name} is dead")
+        return self.engine.submit(prompt_ids, **kw)
+
+    def health(self) -> Dict[str, object]:
+        if self._killed:
+            raise ConnectionError(f"replica {self.name} is dead")
+        return self.engine.health()
+
+    def drain(self, timeout: Optional[float] = None) -> Dict[str, object]:
+        return self.engine.drain(timeout)
+
+    def stop(self) -> None:
+        try:
+            self.engine.stop()
+        except RuntimeError:
+            pass          # overran the join: futures were already failed
+
+    def restart(self, drain_timeout: Optional[float] = None) -> None:
+        """Drain the current engine (in-flight finishes, queued sheds
+        typed), replace it with a FRESH one from the factory, start it.
+        Also the recovery path after :meth:`kill`."""
+        old = self.engine
+        try:
+            old.drain(drain_timeout)
+        except Exception:
+            pass
+        try:
+            old.stop()
+        except RuntimeError:
+            pass
+        self.engine = self._factory()
+        self.engine.start()
+        self.generation += 1
+        self._killed = False
+
+    def kill(self) -> None:
+        """Chaos seam: the replica dies NOW. ``stop()`` fails its in-flight
+        and queued futures (untyped RuntimeError — exactly what a crashed
+        process looks like to its callers), and every later submit/health
+        raises until :meth:`restart`."""
+        self._killed = True
+        try:
+            self.engine.stop()
+        except RuntimeError:
+            pass
+
+
+class _Replica:
+    """Router-side state for one replica: breaker, rotation flag, live
+    in-flight count, last health snapshot."""
+
+    __slots__ = ("name", "client", "breaker", "in_rotation", "inflight",
+                 "snapshot")
+
+    def __init__(self, name: str, client: ReplicaClient,
+                 breaker: CircuitBreaker):
+        self.name = name
+        self.client = client
+        self.breaker = breaker
+        self.in_rotation = True      # False only during rolling restart
+        self.inflight = 0            # router-submitted, not yet resolved
+        self.snapshot: Optional[Dict[str, object]] = None
+
+
+class _Pending:
+    """One router request across its (re)submission attempts."""
+
+    __slots__ = ("prompt_ids", "kw", "future", "deadline", "prefix_key",
+                 "attempts", "tried", "last_error", "inner")
+
+    def __init__(self, prompt_ids, kw, future, deadline, prefix_key):
+        self.prompt_ids = prompt_ids
+        self.kw = kw                          # engine submit kwargs
+        self.future = future                  # the router-owned future
+        self.deadline = deadline              # absolute monotonic, or None
+        self.prefix_key = prefix_key          # rendezvous key bytes, or None
+        self.attempts = 0                     # submissions tried so far
+        self.tried: set = set()               # replica names this round
+        self.last_error: Optional[BaseException] = None
+        self.inner: Optional[GenerationResult] = None   # current replica fut
+
+
+class ServingRouter:
+    """Front door over N replica engines with the engine's own surface:
+    ``submit()/generate()/drain()/health()`` (plus ``rolling_restart()``).
+
+    ``replicas`` is a list of zero-arg engine factories (each wrapped in a
+    :class:`ReplicaClient` named ``r0..rN-1``) and/or ready
+    :class:`ReplicaClient` instances. Factories matter: rolling restart
+    replaces a replica's engine with a FRESH build, it does not resurrect
+    the old object.
+    """
+
+    def __init__(self, replicas: Sequence,
+                 probe_interval_s: float = 0.25,
+                 breaker_threshold: int = 3,
+                 breaker_reset_s: float = 1.0,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 affinity_max_wait_s: float = 1.0,
+                 drain_timeout_s: Optional[float] = None):
+        if not replicas:
+            raise ValueError("ServingRouter needs at least one replica")
+        self._replicas: List[_Replica] = []
+        for i, r in enumerate(replicas):
+            client = r if isinstance(r, ReplicaClient) \
+                else ReplicaClient(r, name=f"r{i}")
+            rep = _Replica(client.name, client, CircuitBreaker(
+                threshold=breaker_threshold, reset_s=breaker_reset_s))
+            # transition callback needs the replica it guards
+            rep.breaker._on_transition = \
+                (lambda old, new, _rep=rep:
+                 self._on_breaker_transition(_rep, old, new))
+            self._replicas.append(rep)
+        if len({r.name for r in self._replicas}) != len(self._replicas):
+            raise ValueError("replica names must be unique")
+        self.probe_interval_s = float(probe_interval_s)
+        self.retry_policy = retry_policy or RetryPolicy(
+            max_attempts=3, base_delay=0.05, max_delay=1.0)
+        self.affinity_max_wait_s = float(affinity_max_wait_s)
+        self.drain_timeout_s = drain_timeout_s
+        self._stats_lock = threading.Lock()
+        self.stats = {"submitted": 0, "completed": 0, "failed": 0,
+                      "picks": 0, "retries": 0, "failovers": 0,
+                      "evictions": 0, "readmissions": 0,
+                      "rolling_restarts": 0}
+        self._stop = threading.Event()
+        self._draining = threading.Event()
+        self._prober: Optional[threading.Thread] = None
+        self._retrier: Optional[threading.Thread] = None
+        self._retry_cv = threading.Condition()
+        self._retry_heap: List = []          # (due, seq, _Pending)
+        self._retry_seq = itertools.count()
+        self._started = False
+        self._health_reg_name = None
+
+    # -- bookkeeping ---------------------------------------------------------
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._stats_lock:
+            self.stats[key] += n
+
+    def _on_breaker_transition(self, rep: _Replica, old: str,
+                               new: str) -> None:
+        sys.stderr.write(
+            f"[router] replica {rep.name} breaker {old} -> {new}\n")
+        if new == "open":
+            self._bump("evictions")
+            _safe_inc("paddle_router_evictions_total",
+                      "replicas evicted from rotation by their breaker",
+                      replica=rep.name)
+            _flight_record("router", rep.name, event="eviction",
+                           consecutive=rep.breaker.consecutive_failures)
+        elif new == "closed" and old in ("open", "half_open"):
+            self._bump("readmissions")
+            _safe_inc("paddle_router_readmissions_total",
+                      "evicted replicas re-admitted to rotation",
+                      replica=rep.name)
+            _flight_record("router", rep.name, event="readmission")
+
+    # -- health probing ------------------------------------------------------
+    def _probe_once(self) -> int:
+        """Poll every replica's health; feed the per-replica breaker
+        (failures accumulate to eviction; a half-open window + an ok probe
+        re-admits). Returns — and gauges — the healthy count."""
+        healthy = 0
+        for rep in self._replicas:
+            try:
+                snap = rep.client.health()
+                ok = bool(snap.get("ok", False))
+            except Exception:
+                snap, ok = None, False
+            rep.snapshot = snap
+            b = rep.breaker
+            if not rep.in_rotation:
+                continue     # deliberately out (rolling restart): its
+                #              transitional not-ok is neither failure
+                #              evidence nor re-admission input
+            if ok:
+                # an ok probe re-admits ONLY through the half-open window
+                # (evicted + reset elapsed): it must neither let a replica
+                # jump its reset window nor clear a closed breaker's
+                # REQUEST-failure streak — "/healthz looks fine but
+                # requests fail" is still grounds for eviction
+                if b.state != "closed" and b.allow():
+                    b.record_success()
+            else:
+                b.record_failure()
+            if rep.in_rotation and ok and b.state != "open":
+                healthy += 1
+        _safe_set("paddle_router_replicas_healthy",
+                  "replicas currently in rotation and passing health probes",
+                  healthy)
+        return healthy
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.probe_interval_s):
+            self._probe_once()
+
+    # -- retry scheduling ----------------------------------------------------
+    def _retry_loop(self) -> None:
+        while True:
+            with self._retry_cv:
+                while not self._stop.is_set() and (
+                        not self._retry_heap
+                        or self._retry_heap[0][0] > time.monotonic()):
+                    wait = (None if not self._retry_heap else
+                            max(0.0, self._retry_heap[0][0]
+                                - time.monotonic()))
+                    self._retry_cv.wait(wait)
+                if self._stop.is_set():
+                    return
+                due = []
+                now = time.monotonic()
+                while self._retry_heap and self._retry_heap[0][0] <= now:
+                    due.append(heapq.heappop(self._retry_heap)[2])
+            for pend in due:
+                self._dispatch(pend)
+
+    def _schedule(self, pend: _Pending, delay: float) -> None:
+        with self._retry_cv:
+            # drain()/stop() set their flag BEFORE sweeping the heap under
+            # this same lock — so a push either lands before the sweep
+            # (and is swept) or observes the flag here. No entry can
+            # strand behind an exiting retrier thread: zero lost futures
+            if self._draining.is_set() or self._stop.is_set():
+                self._finish_fail(pend, EngineDrainingError(
+                    "request shed: serving router drained before it was "
+                    "served"))
+                return
+            heapq.heappush(self._retry_heap,
+                           (time.monotonic() + delay,
+                            next(self._retry_seq), pend))
+            self._retry_cv.notify()
+
+    # -- pick policy ---------------------------------------------------------
+    def _candidates(self, exclude=()) -> List[_Replica]:
+        out = []
+        for rep in self._replicas:
+            if not rep.in_rotation or rep.name in exclude:
+                continue
+            if not rep.breaker.allow():
+                continue              # evicted; half-open lets a probe pick
+            snap = rep.snapshot
+            if ((snap is None or not snap.get("ok", False))
+                    and rep.breaker.state == "closed"):
+                # the probe already knows this replica is not serving
+                # (draining, stopped, dead — health() raising leaves
+                # snapshot None) even though our breaker hasn't tripped
+                # yet — don't route into a known wall. Half-open still
+                # lets one traffic probe through
+                continue
+            out.append(rep)
+        return out
+
+    @staticmethod
+    def _load_score(rep: _Replica):
+        snap = rep.snapshot or {}
+        est = snap.get("est_wait_s")
+        if est is None:
+            est = snap.get("estimated_queue_wait_s") or 0.0
+        depth = snap.get("queue_depth") or 0
+        return (round(float(est), 6), rep.inflight + int(depth), rep.name)
+
+    def _pick(self, pend: _Pending) -> Optional[_Replica]:
+        """Least-estimated-wait among healthy replicas; prefix-carrying
+        requests prefer their rendezvous-hash replica (stable as replicas
+        come and go — only keys owned by a lost replica move) unless it is
+        saturated."""
+        cands = self._candidates(exclude=pend.tried)
+        if not cands:
+            return None
+        if pend.prefix_key is not None:
+            pref = max(cands, key=lambda r: hashlib.sha1(
+                pend.prefix_key + r.name.encode()).digest())
+            est = self._load_score(pref)[0]
+            if est <= self.affinity_max_wait_s:
+                return pref
+        return min(cands, key=self._load_score)
+
+    # -- dispatch / failover -------------------------------------------------
+    def _finish_ok(self, pend: _Pending, inner: GenerationResult) -> None:
+        fut = pend.future
+        # carry the replica future's SLO stamps so fleet-level slo_summary
+        # reports real TTFT/queue-wait (measured from ROUTER submit time)
+        fut._t_admit = inner._t_admit
+        fut._t_first = inner._t_first
+        fut._n_new = inner._n_new
+        fut._streaming = inner._streaming
+        self._bump("completed")
+        fut._set(output=inner._output)
+
+    def _finish_fail(self, pend: _Pending, err: BaseException,
+                     sync: bool = False) -> None:
+        self._bump("failed")
+        if sync:
+            raise err
+        pend.future._set(error=err)
+
+    def _fleet_unavailable(self) -> FleetUnavailableError:
+        # soonest POSITIVE half-open window among evicted replicas; a
+        # fleet that is out without open breakers (all dead/draining,
+        # breakers still closed) hints one probe interval — never 0.0,
+        # which would invite a tight resubmit loop against a dead fleet
+        windows = [w for w in (r.breaker.retry_after_s()
+                               for r in self._replicas) if w > 0]
+        return FleetUnavailableError(
+            f"no healthy replica in rotation ({len(self._replicas)} total; "
+            "all evicted, draining or dead)",
+            replicas=len(self._replicas), healthy=0,
+            retry_after_s=min(windows) if windows else self.probe_interval_s)
+
+    def _may_retry(self, pend: _Pending, delay: float = 0.0) -> bool:
+        """Budget check before any resubmission: bounded attempts, and
+        never schedule work past the request's deadline."""
+        if pend.attempts >= self.retry_policy.max_attempts:
+            return False
+        if pend.deadline is not None and (
+                time.monotonic() + delay >= pend.deadline):
+            return False
+        return True
+
+    def _backoff_or_fail(self, pend: _Pending,
+                         err: BaseException) -> None:
+        """End of a fleet-wide round (every candidate tried, or none
+        existed): back off jittered-exponentially and try a fresh round,
+        or fail the future typed when the budget (attempts or deadline)
+        is spent."""
+        delay = compute_delay(self.retry_policy, max(pend.attempts, 1))
+        if self._draining.is_set() or not self._may_retry(pend, delay):
+            self._finish_fail(pend, err)
+            return
+        pend.tried.clear()
+        self._schedule(pend, delay)   # the retry counter ticks when the
+        #                               resubmission actually dispatches
+
+    def _dispatch(self, pend: _Pending, sync: bool = False) -> None:
+        """Submit ``pend`` to the best replica; on submit-time infra
+        errors walk the remaining replicas in the same round. ``sync``
+        (the caller's first attempt) reports terminal failures by raising
+        — the engine's own submit contract — instead of failing the
+        future."""
+        while True:
+            if pend.future.done():
+                return                     # cancelled while waiting
+            if self._draining.is_set():
+                self._finish_fail(pend, EngineDrainingError(
+                    "serving router is draining; no new requests admitted"),
+                    sync)
+                return
+            now = time.monotonic()
+            if pend.deadline is not None and now >= pend.deadline:
+                self._finish_fail(
+                    pend, pend.last_error or DeadlineExceededError(
+                        "request deadline expired before a replica could "
+                        "serve it"), sync)
+                return
+            rep = self._pick(pend)
+            if rep is None:
+                # no candidate left this round: with no failure seen yet
+                # the whole fleet is out (typed FleetUnavailableError);
+                # otherwise surface the last replica's typed refusal
+                err = pend.last_error or self._fleet_unavailable()
+                if sync:
+                    self._finish_fail(pend, err, True)  # fail fast at submit
+                self._backoff_or_fail(pend, err)
+                return
+            pend.attempts += 1
+            pend.tried.add(rep.name)
+            if pend.attempts > 1:
+                # a resubmission actually performed (same-round walk,
+                # post-backoff round, or mid-flight failover redispatch)
+                self._bump("retries")
+                _safe_inc("paddle_router_retries_total",
+                          "request resubmissions performed by the router")
+            kw = dict(pend.kw)
+            if pend.deadline is not None:
+                kw["deadline_s"] = max(pend.deadline - now, 1e-3)
+            try:
+                inner = rep.client.submit(pend.prompt_ids, **kw)
+            except BaseException as e:  # noqa: BLE001 — classify below
+                if _retryable(e):
+                    if rep.in_rotation and not isinstance(
+                            e, ServerOverloadedError):
+                        # overload is BACKPRESSURE from a healthy engine
+                        # (typed, retry_after hint), not sickness — route
+                        # around it without burning eviction evidence, or
+                        # a fleet-wide burst would evict every healthy
+                        # replica at once
+                        rep.breaker.record_failure()
+                    pend.last_error = e
+                    if not self._may_retry(pend):
+                        self._finish_fail(pend, e, sync)
+                        return
+                    continue          # same round, next replica
+                self._finish_fail(pend, e, sync)
+                return
+            pend.inner = inner
+            if pend.future.done():
+                # cancel landed between the top-of-loop check and the
+                # submit: the stale-inner cancel callback missed this
+                # brand-new inner — don't decode a full budget for a
+                # departed client
+                inner.cancel()
+                return
+            with self._stats_lock:
+                rep.inflight += 1
+                self.stats["picks"] += 1
+            _safe_inc("paddle_router_picks_total",
+                      "requests routed to a replica, by replica",
+                      replica=rep.name)
+            inner._add_done_callback(
+                lambda _inner, _pend=pend, _rep=rep:
+                self._on_inner_done(_pend, _rep, _inner))
+            return
+
+    def _on_inner_done(self, pend: _Pending, rep: _Replica,
+                       inner: GenerationResult) -> None:
+        """A replica future resolved (runs on that replica's engine
+        thread). Success delivers; retryable failure fails over to another
+        replica within the retry budget — the mid-flight path the chaos
+        drill exists for."""
+        with self._stats_lock:
+            rep.inflight = max(0, rep.inflight - 1)
+        err = inner._error
+        fut = pend.future
+        if fut.done():
+            return                    # client cancelled the router future
+        if err is None:
+            rep.breaker.record_success()
+            self._finish_ok(pend, inner)
+            return
+        if isinstance(err, RequestCancelledError) or not _retryable(err):
+            self._finish_fail(pend, err)
+            return
+        if rep.in_rotation:
+            # a deliberately-restarting replica's drain sheds are not
+            # evidence of sickness — only in-rotation failures evict
+            rep.breaker.record_failure()
+        pend.last_error = err
+        pend.tried = {rep.name}       # new round, but not straight back
+        self._bump("failovers")
+        _safe_inc("paddle_router_failovers_total",
+                  "requests re-routed after a mid-flight replica failure",
+                  replica=rep.name)
+        _flight_record("router", rep.name, event="failover",
+                       req=str(fut._req_id or "?"),
+                       error=f"{type(err).__name__}: {err}"[:200])
+        if not self._may_retry(pend):
+            self._finish_fail(pend, err)
+            return
+        self._dispatch(pend)
+
+    # -- client API ----------------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens: int = 32,
+               temperature: float = 0.0, top_k: int = 0,
+               eos_token_id=None, deadline_s: Optional[float] = None,
+               prefix_len: Optional[int] = None) -> GenerationResult:
+        """Route one generation request into the fleet. Raises typed at
+        submit exactly like the engine (validation, expired deadline,
+        :class:`FleetUnavailableError` when no replica is in rotation);
+        infra failures AFTER admission fail over transparently and
+        surface only when the retry budget is spent."""
+        if self._draining.is_set():
+            raise EngineDrainingError(
+                "serving router is draining; no new requests admitted")
+        self.start()
+        fut = GenerationResult()
+        fut._req_id = next(_REQ_IDS)
+        fut._obs_emit = False   # the replica-side inner future feeds the
+        #       SLO histograms + flight ring; the wrapper must not record
+        #       the same request twice (slo()/slo_summary still work — the
+        #       inner stamps are copied over on delivery)
+        deadline = (None if deadline_s is None
+                    else time.monotonic() + float(deadline_s))
+        fut._deadline = deadline
+        prefix_key = None
+        if prefix_len:
+            arr = np.asarray(prompt_ids, np.int32).reshape(-1)
+            prefix_key = arr[: int(prefix_len)].tobytes()
+        pend = _Pending(
+            prompt_ids,
+            {"max_new_tokens": max_new_tokens, "temperature": temperature,
+             "top_k": top_k, "eos_token_id": eos_token_id,
+             "prefix_len": prefix_len},
+            fut, deadline, prefix_key)
+        self._bump("submitted")
+        # a client cancel must reach the replica currently decoding it
+        fut._add_done_callback(
+            lambda f, _pend=pend: (_pend.inner.cancel()
+                                   if f.cancelled() and _pend.inner is not None
+                                   else None))
+        self._dispatch(pend, sync=True)
+        return fut
+
+    def generate(self, prompt_ids, timeout: float = 300.0,
+                 **kw) -> np.ndarray:
+        return self.submit(prompt_ids, **kw).result(timeout)
+
+    def health(self) -> Dict[str, object]:
+        """Fleet snapshot: the ``router`` block (census + pick/retry/
+        failover/eviction counters) plus one per-replica summary of the
+        fields picks are made on."""
+        reps: Dict[str, object] = {}
+        healthy = 0
+        for rep in self._replicas:
+            snap = rep.snapshot or {}
+            ok = (rep.in_rotation and rep.breaker.state != "open"
+                  and bool(snap.get("ok", False)))
+            healthy += ok
+            reps[rep.name] = {
+                "ok": ok,
+                "in_rotation": rep.in_rotation,
+                "breaker": rep.breaker.state,
+                "inflight": rep.inflight,
+                "est_wait_s": snap.get("est_wait_s"),
+                "queue_depth": snap.get("queue_depth"),
+                "pages_free": snap.get("pages_free"),
+                "generation": rep.client.generation,
+            }
+        with self._stats_lock:
+            stats = dict(self.stats)
+        alive = self._started and not self._stop.is_set()
+        state = ("draining" if self._draining.is_set() and alive
+                 else "serving" if alive else "stopped")
+        return {
+            "state": state,
+            "ok": alive and not self._draining.is_set() and healthy > 0,
+            "router": {"replicas": len(self._replicas), "healthy": healthy,
+                       **stats},
+            "replicas": reps,
+        }
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "ServingRouter":
+        if self._started and not self._stop.is_set():
+            return self
+        self._stop.clear()
+        self._draining.clear()
+        for rep in self._replicas:
+            try:
+                rep.client.start()
+            except Exception:
+                pass                  # the prober will keep it evicted
+        self._probe_once()
+        self._prober = threading.Thread(target=self._probe_loop,
+                                        daemon=True, name="router-prober")
+        self._prober.start()
+        self._retrier = threading.Thread(target=self._retry_loop,
+                                         daemon=True, name="router-retrier")
+        self._retrier.start()
+        self._started = True
+        try:
+            from ..observability import exporter as _exporter
+
+            served = _exporter.get()
+            if served is not None:
+                self._health_reg_name = served.register_health(
+                    "router", self.health, unique=True)
+        except Exception:
+            pass
+        return self
+
+    def _fail_scheduled(self, err: BaseException) -> int:
+        """Fail every pending resubmission waiting in the retry heap —
+        drain/stop must leave no future unresolved."""
+        with self._retry_cv:
+            waiting = [p for _, _, p in self._retry_heap]
+            self._retry_heap.clear()
+            self._retry_cv.notify()
+        n = 0
+        for pend in waiting:
+            if not pend.future.done():
+                self._finish_fail(pend, err)
+                n += 1
+        return n
+
+    def drain(self, timeout: Optional[float] = None) -> Dict[str, object]:
+        """Fleet-wide graceful shutdown: stop admission (submits raise
+        :class:`EngineDrainingError`), fail queued resubmissions typed,
+        drain every replica (their in-flight requests finish, their queued
+        ones shed — and, with admission closed, fail typed rather than
+        failing over). Idempotent."""
+        timeout = self.drain_timeout_s if timeout is None else timeout
+        t0 = time.monotonic()
+        self._draining.set()
+        shed = self._fail_scheduled(EngineDrainingError(
+            "request shed: serving router drained before it was served"))
+        clean = True
+        for rep in self._replicas:
+            try:
+                res = rep.client.drain(timeout)
+                clean = clean and bool(res.get("clean", True))
+                shed += int(res.get("shed", 0))
+            except Exception:
+                clean = False
+        _safe_inc("paddle_router_drains_total", "fleet drains completed",
+                  outcome="clean" if clean else "timeout")
+        return {"clean": clean, "shed": shed,
+                "wall_s": round(time.monotonic() - t0, 3)}
+
+    def stop(self) -> None:
+        self._draining.set()
+        self._fail_scheduled(RuntimeError("serving router stopped"))
+        self._stop.set()
+        with self._retry_cv:
+            self._retry_cv.notify()
+        for t in (self._prober, self._retrier):
+            if t is not None:
+                t.join(timeout=5)
+        self._prober = self._retrier = None
+        self._started = False
+        for rep in self._replicas:
+            rep.client.stop()
+        try:
+            from ..observability import exporter as _exporter
+
+            served = _exporter.get()
+            if served is not None:
+                served.unregister_health(
+                    self._health_reg_name or "router", fn=self.health)
+        except Exception:
+            pass
+
+    def __enter__(self) -> "ServingRouter":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb):
+        self.stop()
+        return False
+
+    # -- rolling restart -----------------------------------------------------
+    def rolling_restart(self, drain_timeout: Optional[float] = None,
+                        health_timeout: float = 60.0) -> Dict[str, object]:
+        """Restart every replica one at a time without dropping traffic:
+        take it out of rotation (no new picks), drain it (in-flight
+        finishes; queued requests shed typed and fail over to the rest),
+        build a fresh engine, wait until its health probe reads ok, put it
+        back. Stops early — replica left OUT of rotation — if a restarted
+        replica never turns healthy, so a bad deploy cannot take the whole
+        fleet down one "upgrade" at a time."""
+        self.start()
+        drain_timeout = (self.drain_timeout_s if drain_timeout is None
+                         else drain_timeout)
+        rounds = []
+        all_ok = True
+        for rep in self._replicas:
+            t0 = time.monotonic()
+            _flight_record("router", rep.name, event="rolling_restart",
+                           phase="begin")
+            rep.in_rotation = False
+            rep.client.restart(drain_timeout)
+            deadline = time.monotonic() + health_timeout
+            ok = False
+            while time.monotonic() < deadline:
+                try:
+                    snap = rep.client.health()
+                    ok = bool(snap.get("ok", False))
+                except Exception:
+                    ok = False
+                if ok:
+                    rep.snapshot = snap
+                    break
+                time.sleep(0.02)
+            round_info = {"replica": rep.name, "ok": ok,
+                          "generation": rep.client.generation,
+                          "wall_s": round(time.monotonic() - t0, 3)}
+            _flight_record("router", rep.name, event="rolling_restart",
+                           phase="end", ok=ok)
+            if not ok:
+                all_ok = False
+                rounds.append(round_info)
+                sys.stderr.write(
+                    f"[router] rolling restart ABORTED: replica {rep.name} "
+                    f"did not turn healthy within {health_timeout:g}s — "
+                    "left out of rotation, remaining replicas not "
+                    "restarted\n")
+                break
+            # fresh engine: forget the old one's failure history so the
+            # replica is immediately pickable, not half-open-gated
+            rep.breaker.reset()
+            rep.in_rotation = True
+            rounds.append(round_info)
+        self._bump("rolling_restarts")
+        _safe_inc("paddle_router_rolling_restarts_total",
+                  "fleet rolling restarts", outcome="ok" if all_ok
+                  else "aborted")
+        return {"ok": all_ok, "replicas": rounds}

@@ -123,9 +123,17 @@ class GenerationResult:
 
     def __init__(self):
         self._event = threading.Event()
+        self._lock = threading.Lock()  # one-writer-wins arbitration: the
+        #   router adds ROUTINE concurrent writers (client cancel() vs the
+        #   winning replica's delivery) — check-then-act alone could tear
+        #   the outcome (error=None AND output=None observed by a waiter)
         self._output = None
         self._error: Optional[BaseException] = None
         self._cancelled = False
+        self._callbacks: List = []     # run once, after the outcome is set
+        self._obs_emit = True          # False: a wrapper future (router)
+        #           whose replica-side inner future already feeds the SLO
+        #           histograms + flight ring — one request, one record
         self._t_submit = time.perf_counter()
         self._t_admit: Optional[float] = None     # decode-slot admission
         self._t_first: Optional[float] = None     # first token on host
@@ -161,6 +169,29 @@ class GenerationResult:
             raise self._error
         return self._output
 
+    def _add_done_callback(self, fn) -> None:
+        """Run ``fn(self)`` exactly once when the outcome lands (now, if it
+        already has). The router's failover path hangs off this — a failed
+        replica future re-dispatches without a waiter thread per request.
+        Callbacks run on whichever thread sets the outcome (usually the
+        engine loop), must not block, and never raise into the engine."""
+        self._callbacks.append(fn)
+        if self._event.is_set():
+            self._drain_callbacks()
+
+    def _drain_callbacks(self) -> None:
+        # pop-one-at-a-time: a concurrent _set/_add_done_callback race may
+        # drain in parallel, but each callback is popped (and so run) once
+        while True:
+            try:
+                fn = self._callbacks.pop(0)
+            except IndexError:
+                return
+            try:
+                fn(self)
+            except Exception:
+                pass
+
     def slo(self) -> Dict[str, object]:
         """Per-request SLO numbers (None where the lifecycle point was
         never reached — e.g. a shed request has no TTFT). TPOT is the
@@ -182,13 +213,16 @@ class GenerationResult:
         }
 
     def _set(self, output=None, error=None):
-        if self._event.is_set():
-            return  # first outcome wins: a late writer (e.g. a retiring
-        self._output = output   # slot racing stop()) must not flip a result
-        self._error = error
-        self._t_done = now = time.perf_counter()
-        self._event.set()
-        obs = _obs_srv
+        with self._lock:
+            if self._event.is_set():
+                return  # first outcome wins: a late writer (a retiring
+            #   slot racing stop(), a delivery racing cancel()) must not
+            #   flip — or tear — a result
+            self._output = output
+            self._error = error
+            self._t_done = now = time.perf_counter()
+            self._event.set()
+        obs = _obs_srv if self._obs_emit else None
         outcome = ("ok" if error is None
                    else "cancelled" if isinstance(error, RequestCancelledError)
                    else "error")
@@ -211,12 +245,15 @@ class GenerationResult:
                 obs("cancelled", 1)
             else:
                 obs("error", 1)
-        _flight_record(
-            "request", str(self._req_id or "?"), phase="finish",
-            outcome=outcome, tokens=self._n_new,
-            latency_ms=round((now - self._t_submit) * 1e3, 3),
-            **({} if self._t_first is None else
-               {"ttft_ms": round((self._t_first - self._t_submit) * 1e3, 3)}))
+        if self._obs_emit:
+            _flight_record(
+                "request", str(self._req_id or "?"), phase="finish",
+                outcome=outcome, tokens=self._n_new,
+                latency_ms=round((now - self._t_submit) * 1e3, 3),
+                **({} if self._t_first is None else
+                   {"ttft_ms": round((self._t_first - self._t_submit)
+                                     * 1e3, 3)}))
+        self._drain_callbacks()
 
 
 def slo_summary(results) -> Dict[str, Optional[float]]:
@@ -357,6 +394,7 @@ class ServingEngine:
                      else _flags.flag_value("serving_breaker_reset_s")),
             on_transition=self._on_breaker_transition)
         self._estimator = QueueWaitEstimator()
+        self._static_inflight = 0     # static scheduler's current batch size
         self._decode_started_at: Optional[float] = None
         self._hang_tripped = False
         self._last_decode_ok: Optional[float] = None
@@ -623,6 +661,8 @@ class ServingEngine:
             stats = dict(self.stats)
         kv = (self._engine.kv_stats() if self._engine is not None
               else {"layout": "none"})
+        est = self._estimator.estimate_wait_s(self._queue_depth(),
+                                              self.max_batch_size)
         return {
             "state": state,
             "mode": self.mode,
@@ -632,6 +672,14 @@ class ServingEngine:
                   and breaker != "open",
             "queue_depth": self._queue_depth(),
             "busy_slots": busy,
+            # the fields the fleet router balances on, surfaced through
+            # /healthz unchanged: estimated wait for a NEW request,
+            # requests currently being decoded, KV headroom (None when
+            # the engine has no paged pool)
+            "est_wait_s": est,
+            "inflight": busy if self.mode == "continuous"
+                        else self._static_inflight,
+            "pages_free": kv.get("pages_free"),
             "max_slots": self.max_batch_size,
             "max_queue": self.max_queue,
             "breaker": breaker,
@@ -642,14 +690,24 @@ class ServingEngine:
             "last_decode_ok_age_s":
                 None if self._last_decode_ok is None
                 else now - self._last_decode_ok,
-            "estimated_queue_wait_s": self._estimator.estimate_wait_s(
-                self._queue_depth(), self.max_batch_size),
+            "estimated_queue_wait_s": est,
             "stats": stats,
         }
 
     # -- lifecycle -----------------------------------------------------------
     def start(self):
         if self._thread is None:
+            if self._draining.is_set():
+                # restart after a COMPLETED drain (thread gone): re-open
+                # admission and re-arm the failure machinery — the drained
+                # engine's breaker history and hang latch belong to the
+                # previous serving epoch, not this one. Rolling restarts
+                # (inference/router.py) depend on this: drain -> start
+                # must yield a replica that admits again.
+                self._draining.clear()
+                self._breaker.reset()
+                self._hang_tripped = False
+                self._decode_started_at = None
             self._stop.clear()
             self._drained.clear()
             self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -956,6 +1014,7 @@ class ServingEngine:
             batch = self._collect_batch()
             if not batch:
                 continue
+            self._static_inflight = len(batch)
             try:
                 self._decode_attempt(lambda: self._run_static_batch(batch))
             except BaseException as e:  # noqa: BLE001 — deliver to callers
@@ -967,6 +1026,8 @@ class ServingEngine:
                 if obs is not None:
                     obs("batch", "error")
                 continue
+            finally:
+                self._static_inflight = 0
             # outcome-tagged accounting AFTER the attempt: a failed batch
             # must not count as served
             self._breaker.record_success()

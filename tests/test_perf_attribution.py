@@ -492,11 +492,12 @@ def test_perf_gate_paged_kv_serving_fields(tmp_path):
     base = tmp_path / "base.json"
     base.write_text(json.dumps(bench))
 
-    def serving(hit=0.9, conc=8, occ=0.5, mixed=800.0):
+    def serving(hit=0.9, conc=8, occ=0.5, mixed=800.0, avail=1.0):
         return {"serving_bench": {
             "aggregate_tok_s": 500.0, "ttft_p50_ms": 10.0,
             "prefix_hit_rate": hit, "concurrency_peak": conc,
-            "kv_occupancy_peak": occ, "mixed_tok_s": mixed}}
+            "kv_occupancy_peak": occ, "mixed_tok_s": mixed,
+            "availability": avail}}
 
     sbase = tmp_path / "sbase.json"
     sbase.write_text(json.dumps(serving()))
@@ -505,7 +506,7 @@ def test_perf_gate_paged_kv_serving_fields(tmp_path):
     assert _gate(["--baseline", str(base), "--current", str(base),
                   "--serving", str(good), str(sbase)]) == 0
     for bad_kw in ({"hit": 0.5}, {"conc": 4}, {"mixed": 600.0},
-                   {"occ": 0.9}):
+                   {"occ": 0.9}, {"avail": 0.8}):
         bad = tmp_path / "bad.json"
         bad.write_text(json.dumps(serving(**bad_kw)))
         assert _gate(["--baseline", str(base), "--current", str(base),

@@ -1,0 +1,579 @@
+"""Serving fleet router: health-aware balancing, breaker eviction +
+half-open re-admission, mid-flight failover, deadline-bounded retries,
+prefix-affine routing, rolling restart, and the replica-kill chaos drill
+(inference/router.py).
+
+Most tests drive fleets of STATIC fake-model engines so the routing layer
+is exercised without JAX compiles; one continuous-engine test runs the
+router over two real tiny-Llama replicas. The invariant every drill
+asserts: each submitted request's future resolves — completed, or failed
+with a meaningful error. Zero silently-lost futures, whatever dies.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddlepaddle_tpu.inference import (
+    DeadlineExceededError,
+    EngineDrainingError,
+    FleetUnavailableError,
+    ReplicaClient,
+    RequestValidationError,
+    ServingEngine,
+    ServingError,
+    ServingRouter,
+)
+from test_serving_robustness import FakeModel, _prompt
+
+# a long interval keeps the prober quiet so tests drive probes explicitly
+# via router._probe_once() where determinism matters
+_QUIET = 60.0
+
+
+def _factory(model=None, **kw):
+    kw.setdefault("mode", "static")
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("max_wait_ms", 2.0)
+    kw.setdefault("max_len", 64)
+    return lambda: ServingEngine(model() if callable(model)
+                                 else (model or FakeModel()), **kw)
+
+
+def _resolve_all(futs, timeout=60):
+    """Wait for every future; return (oks, errors) — the zero-lost-futures
+    check every drill runs through."""
+    oks, errs = [], []
+    for f in futs:
+        try:
+            oks.append(f.result(timeout))
+        except Exception as e:  # noqa: BLE001 — collected for assertions
+            errs.append(e)
+    return oks, errs
+
+
+# -- balancing ---------------------------------------------------------------
+
+def test_pick_least_estimated_wait():
+    r = ServingRouter([_factory(), _factory(), _factory()],
+                      probe_interval_s=_QUIET)
+    r.start()
+    try:
+        r._probe_once()
+        loaded, idle, mid = r._replicas
+        loaded.snapshot = dict(loaded.snapshot, est_wait_s=2.0, ok=True)
+        mid.snapshot = dict(mid.snapshot, est_wait_s=0.5, ok=True)
+        idle.snapshot = dict(idle.snapshot, est_wait_s=0.0, ok=True)
+
+        class _P:  # minimal pending shim for _pick
+            tried = set()
+            prefix_key = None
+
+        assert r._pick(_P()) is idle
+        # live router-side inflight breaks est-wait ties
+        idle.inflight = 5
+        idle.snapshot = dict(idle.snapshot, est_wait_s=0.5)
+        assert r._pick(_P()) is mid
+    finally:
+        r.stop()
+
+
+def test_traffic_spreads_and_availability_accounting():
+    r = ServingRouter([_factory(FakeModel(delay_s=0.01)),
+                       _factory(FakeModel(delay_s=0.01))],
+                      probe_interval_s=0.05)
+    try:
+        futs = [r.submit(_prompt(), max_new_tokens=2) for _ in range(16)]
+        oks, errs = _resolve_all(futs)
+        assert len(oks) == 16 and not errs
+        h = r.health()
+        assert h["ok"] and h["router"]["healthy"] == 2
+        assert h["router"]["submitted"] == 16
+        assert h["router"]["completed"] == 16
+        assert h["router"]["failed"] == 0
+        assert h["router"]["picks"] == 16
+        # both replicas actually served traffic (least-loaded spreads)
+        assert all(rep.client.engine.stats["requests"] > 0
+                   for rep in r._replicas)
+    finally:
+        r.stop()
+
+
+# -- breaker eviction + half-open re-admission -------------------------------
+
+def test_breaker_evicts_sick_replica_then_readmits():
+    sick_model = FakeModel(fail_next=3)
+    r = ServingRouter([_factory(sick_model, max_batch_size=1),
+                       _factory(FakeModel(), max_batch_size=1)],
+                      probe_interval_s=_QUIET, breaker_threshold=3,
+                      breaker_reset_s=30.0)
+    r.start()
+    try:
+        r._probe_once()
+        sick, healthy = r._replicas
+        # force traffic at the sick replica until its breaker opens: each
+        # submit fails mid-flight there, fails over, and completes on the
+        # healthy one — callers never see the failures
+        healthy.snapshot = dict(healthy.snapshot, est_wait_s=5.0)
+        served = 0
+        while sick.breaker.state != "open" and served < 10:
+            assert r.submit(_prompt(), max_new_tokens=2).result(30) \
+                .shape == (6,)
+            served += 1
+        assert sick.breaker.state == "open"
+        assert r.stats["evictions"] == 1
+        assert r.stats["failovers"] >= 3
+        assert not r.health()["replicas"]["r0"]["ok"]
+        # evicted: picks avoid it entirely (failures are exhausted, so a
+        # pick reaching it WOULD succeed — rotation must not send one)
+        healthy.snapshot = dict(healthy.snapshot, est_wait_s=0.0)
+        before = sick.client.engine.stats["requests"]
+        for _ in range(4):
+            r.submit(_prompt(), max_new_tokens=2).result(30)
+        assert sick.client.engine.stats["requests"] == before
+        # reset window passes (rewound, not slept — deterministic) -> the
+        # ok health probe re-admits through half-open
+        sick.breaker._opened_at -= 31.0
+        r._probe_once()
+        assert sick.breaker.state == "closed"
+        assert r.stats["readmissions"] == 1
+        assert r.health()["replicas"]["r0"]["ok"]
+    finally:
+        r.stop()
+
+
+def test_ok_probe_does_not_clear_request_failure_streak():
+    """A replica whose /healthz reads ok while its requests fail must
+    still reach eviction: probes only re-admit through half-open, they
+    never reset a closed breaker's failure count."""
+    r = ServingRouter([_factory()], probe_interval_s=_QUIET,
+                      breaker_threshold=3)
+    r.start()
+    try:
+        rep = r._replicas[0]
+        rep.breaker.record_failure()
+        rep.breaker.record_failure()
+        r._probe_once()                       # health ok — but 2 failures
+        assert rep.breaker.consecutive_failures == 2
+        rep.breaker.record_failure()          # ...so the 3rd still opens
+        assert rep.breaker.state == "open"
+    finally:
+        r.stop()
+
+
+# -- mid-flight failover -----------------------------------------------------
+
+def test_midflight_kill_fails_over_and_preserves_results():
+    r = ServingRouter([_factory(FakeModel(delay_s=0.05), max_batch_size=1),
+                       _factory(FakeModel(delay_s=0.05), max_batch_size=1)],
+                      probe_interval_s=0.05, breaker_reset_s=0.3)
+    try:
+        futs = [r.submit(_prompt(n=4, v=i), max_new_tokens=2)
+                for i in range(8)]
+        r._replicas[0].client.kill()          # dies holding queued work
+        oks, errs = _resolve_all(futs)
+        assert not errs, [type(e).__name__ for e in errs]
+        # every result is the caller's own prompt + its new tokens
+        for i, out in enumerate(oks):
+            assert out.shape == (6,)
+            assert (out[:4] == i).all()
+        assert r.stats["failovers"] >= 1
+        assert r.health()["router"]["completed"] == 8
+    finally:
+        r.stop()
+
+
+def test_all_replicas_out_is_typed_fleet_unavailable():
+    r = ServingRouter([_factory()], probe_interval_s=_QUIET,
+                      breaker_reset_s=5.0)
+    r.start()
+    try:
+        r._replicas[0].client.kill()
+        for _ in range(3):
+            r._probe_once()                   # probes evict the dead replica
+        assert r._replicas[0].breaker.state == "open"
+        with pytest.raises(FleetUnavailableError) as ei:
+            r.submit(_prompt(), max_new_tokens=2)
+        assert ei.value.replicas == 1
+        assert ei.value.retry_after_s > 0     # soonest half-open window
+        assert isinstance(ei.value, ServingError)
+    finally:
+        r.stop()
+
+
+def test_validation_error_not_retried():
+    """Request-shaped failures travel WITH the request: no replica can
+    serve them, so they surface immediately with zero retries."""
+    r = ServingRouter([_factory(max_len=16), _factory(max_len=16)],
+                      probe_interval_s=_QUIET)
+    try:
+        with pytest.raises(RequestValidationError):
+            r.submit(_prompt(14), max_new_tokens=8)
+        assert r.stats["retries"] == 0
+        assert r.stats["failovers"] == 0
+    finally:
+        r.stop()
+
+
+# -- deadlines vs retries ----------------------------------------------------
+
+def test_retries_never_pass_the_deadline():
+    """All replicas failing + a generous attempt budget: the request's
+    deadline bounds the whole retry dance — the future resolves (typed)
+    no later than deadline + one backoff, never after."""
+    from paddlepaddle_tpu.resilience.retry import RetryPolicy
+
+    always_sick = lambda: FakeModel(fail_next=10 ** 6)  # noqa: E731
+    r = ServingRouter([_factory(always_sick, max_batch_size=1),
+                       _factory(always_sick, max_batch_size=1)],
+                      probe_interval_s=0.05, breaker_threshold=100,
+                      retry_policy=RetryPolicy(max_attempts=1000,
+                                               base_delay=0.02,
+                                               max_delay=0.05))
+    try:
+        t0 = time.monotonic()
+        fut = r.submit(_prompt(), max_new_tokens=2, deadline_s=0.4)
+        with pytest.raises((RuntimeError, DeadlineExceededError)):
+            fut.result(30)
+        wall = time.monotonic() - t0
+        assert wall < 0.4 + 0.3, f"retries ran {wall:.2f}s past the deadline"
+        assert fut.done()
+    finally:
+        r.stop()
+
+
+def test_expired_deadline_rejected_at_submit():
+    r = ServingRouter([_factory()], probe_interval_s=_QUIET)
+    try:
+        with pytest.raises(DeadlineExceededError):
+            r.submit(_prompt(), max_new_tokens=2, deadline_s=0.0)
+    finally:
+        r.stop()
+
+
+# -- prefix-affine routing ---------------------------------------------------
+
+def test_prefix_affinity_stable_and_spread():
+    r = ServingRouter([_factory(), _factory(), _factory(), _factory()],
+                      probe_interval_s=_QUIET)
+    r.start()
+    try:
+        r._probe_once()
+        rng = np.random.default_rng(0)
+
+        def route(prefix_ids):
+            class _P:
+                tried = set()
+                prefix_key = prefix_ids.tobytes()
+
+            return r._pick(_P()).name
+
+        prefixes = [rng.integers(0, 1000, (16,)).astype(np.int32)
+                    for _ in range(12)]
+        homes = {p.tobytes(): route(p) for p in prefixes}
+        # stable: the same system prompt always routes to the same replica
+        for p in prefixes:
+            for _ in range(3):
+                assert route(p) == homes[p.tobytes()]
+        # spread: 12 distinct prefixes land on more than one replica —
+        # random routing would, affinity-by-hash must too (it shards the
+        # prefix-cache working set instead of piling onto one replica)
+        assert len(set(homes.values())) > 1
+        # unhealthy preferred replica: rendezvous falls to the next choice
+        p0 = prefixes[0]
+        home = next(rep for rep in r._replicas if rep.name == homes[
+            p0.tobytes()])
+        home.in_rotation = False
+        moved = route(p0)
+        assert moved != home.name
+        home.in_rotation = True
+        assert route(p0) == home.name         # ...and back when it returns
+        # saturated preferred replica: falls back to least-loaded
+        home.snapshot = dict(home.snapshot,
+                             est_wait_s=r.affinity_max_wait_s + 1.0)
+        assert route(p0) != home.name
+    finally:
+        r.stop()
+
+
+def test_prefix_affinity_hit_rate_beats_random():
+    """End-to-end over fake engines: N requests sharing 3 system prompts
+    each land on their prefix's home replica — every replica sees requests
+    from at most... exactly the prefixes it owns, while random/least-loaded
+    routing scatters them. (The real cache-hit-rate win is measured by
+    tools/serving_bench.py --profile prefix --replicas N.)"""
+    r = ServingRouter([_factory(), _factory(), _factory()],
+                      probe_interval_s=0.05)
+    try:
+        rng = np.random.default_rng(1)
+        prefixes = [rng.integers(0, 1000, (8,)).astype(np.int32)
+                    for _ in range(3)]
+        owners = {}
+        for k, pfx in enumerate(prefixes):
+            for _ in range(6):
+                tail = rng.integers(0, 1000, (4,)).astype(np.int32)
+                fut = r.submit(np.concatenate([pfx, tail]),
+                               max_new_tokens=2, prefix_len=8)
+                fut.result(30)
+                # the replica that served it is the one whose inflight we
+                # can't see anymore — recover it from engine request counts
+            owners[k] = [rep.client.engine.stats["requests"]
+                         for rep in r._replicas]
+        # per-prefix deltas: each prefix's 6 requests all hit ONE replica
+        prev = [0, 0, 0]
+        for k in range(3):
+            delta = [owners[k][i] - prev[i] for i in range(3)]
+            assert sorted(delta) == [0, 0, 6], delta
+            prev = owners[k]
+    finally:
+        r.stop()
+
+
+# -- rolling restart ---------------------------------------------------------
+
+def test_rolling_restart_drops_zero_requests():
+    from paddlepaddle_tpu.resilience.retry import RetryPolicy
+
+    r = ServingRouter([_factory(FakeModel(delay_s=0.01), max_batch_size=2),
+                       _factory(FakeModel(delay_s=0.01), max_batch_size=2),
+                       _factory(FakeModel(delay_s=0.01), max_batch_size=2)],
+                      probe_interval_s=0.05,
+                      # generous budget: a request could be drain-shed by
+                      # one restarting replica and land on the next one up
+                      retry_policy=RetryPolicy(max_attempts=8,
+                                               base_delay=0.01,
+                                               max_delay=0.05))
+    r.start()
+    futs, stop = [], threading.Event()
+    lock = threading.Lock()
+
+    def client():
+        while not stop.is_set():
+            try:
+                f = r.submit(_prompt(), max_new_tokens=2)
+            except ServingError:
+                continue          # admission refusals are typed + visible;
+            with lock:            # the drill cares about ACCEPTED requests
+                futs.append(f)
+            time.sleep(0.002)
+
+    threads = [threading.Thread(target=client) for _ in range(3)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.1)           # traffic flowing
+        res = r.rolling_restart(drain_timeout=5.0, health_timeout=10.0)
+        stop.set()
+        for t in threads:
+            t.join(30)
+        assert res["ok"], res
+        assert [x["generation"] for x in res["replicas"]] == [1, 1, 1]
+        with lock:
+            taken = list(futs)
+        assert len(taken) > 10    # the restart happened UNDER traffic
+        oks, errs = _resolve_all(taken)
+        assert not errs, [f"{type(e).__name__}: {e}" for e in errs[:5]]
+        assert len(oks) == len(taken)       # zero dropped requests
+        h = r.health()
+        assert h["ok"] and h["router"]["healthy"] == 3
+        assert h["router"]["rolling_restarts"] == 1
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(5)
+        r.stop()
+
+
+def test_rolling_restart_aborts_on_unhealthy_replica():
+    """A restarted replica that never turns healthy stops the rollout:
+    it stays OUT of rotation and the remaining replicas keep their old
+    engines — a bad deploy cannot walk the whole fleet down."""
+    r = ServingRouter([_factory(), _factory()], probe_interval_s=_QUIET)
+    r.start()
+    try:
+        broken = r._replicas[0].client
+        orig_restart = broken.restart
+
+        def bad_restart(drain_timeout=None):
+            orig_restart(drain_timeout)
+            broken.engine.drain(0.1)        # new engine comes up not-ok
+
+        broken.restart = bad_restart
+        res = r.rolling_restart(drain_timeout=1.0, health_timeout=0.3)
+        assert not res["ok"]
+        assert len(res["replicas"]) == 1    # r1 was never touched
+        assert not r._replicas[0].in_rotation
+        assert r._replicas[1].client.generation == 0
+        # the fleet still serves on the untouched replica
+        assert r.submit(_prompt(), max_new_tokens=2).result(30).shape == (6,)
+    finally:
+        r.stop()
+
+
+# -- drain / lifecycle -------------------------------------------------------
+
+def test_router_drain_is_typed_and_idempotent():
+    r = ServingRouter([_factory(FakeModel(delay_s=0.05), max_batch_size=1),
+                       _factory(FakeModel(delay_s=0.05), max_batch_size=1)],
+                      probe_interval_s=0.05)
+    try:
+        futs = [r.submit(_prompt(), max_new_tokens=2) for _ in range(6)]
+        res = r.drain(timeout=1.0)
+        oks, errs = _resolve_all(futs, timeout=10)
+        assert len(oks) + len(errs) == 6
+        assert all(isinstance(e, EngineDrainingError) for e in errs)
+        with pytest.raises(EngineDrainingError):
+            r.submit(_prompt(), max_new_tokens=2)
+        assert r.drain(timeout=0.5)["shed"] == 0     # idempotent
+        assert r.health()["state"] == "draining"
+        assert res["wall_s"] >= 0
+    finally:
+        r.stop()
+
+
+def test_router_metrics_and_flight_events():
+    import paddlepaddle_tpu.observability as obs
+    from paddlepaddle_tpu.observability import flight
+
+    obs.reset()     # cold-path counters record even with obs off: earlier
+    obs.enable(trace=False, metrics=True, watchdog_=False)  # tests' traffic
+    flight.enable(capacity=256)                             # must not leak in
+    r = ServingRouter([_factory(max_batch_size=1),
+                       _factory(max_batch_size=1)],
+                      probe_interval_s=_QUIET, breaker_threshold=2,
+                      breaker_reset_s=30.0)
+    r.start()
+    try:
+        r._probe_once()
+        for _ in range(4):
+            r.submit(_prompt(), max_new_tokens=2).result(30)
+        r._replicas[0].client.kill()
+        r._probe_once()
+        r._probe_once()                       # threshold 2 -> eviction
+        assert r._replicas[0].breaker.state == "open"
+        snap = obs.snapshot()
+        picks = snap.get("paddle_router_picks_total", {})
+        assert sum(picks.values()) == 4
+        evs = snap.get("paddle_router_evictions_total", {})
+        assert sum(evs.values()) == 1
+        assert snap["paddle_router_replicas_healthy"][()] == 1
+        events = [e for e in flight.get().events()
+                  if e.get("kind") == "router"]
+        assert any((e.get("data") or {}).get("event") == "eviction"
+                   for e in events)
+        text = obs.to_prometheus_text()
+        assert "paddle_router_picks_total" in text
+        assert "paddle_router_replicas_healthy" in text
+    finally:
+        flight.disable()
+        obs.disable()
+        obs.reset()
+        r.stop()
+
+
+# -- chaos drill -------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_chaos_kill_one_replica_under_mixed_load():
+    """Acceptance drill: 3 replicas under a mixed short/long workload; a
+    serving.decode fault storm rages and one replica is killed mid-decode.
+    Every submitted future resolves (completed or typed-failed — zero
+    silently lost), the fleet keeps serving afterwards, and the dead
+    replica's breaker opens then re-admits once it is restarted."""
+    from paddlepaddle_tpu.resilience import chaos
+
+    r = ServingRouter(
+        [_factory(lambda: FakeModel(delay_s=0.01), max_batch_size=2)
+         for _ in range(3)],
+        probe_interval_s=0.05, breaker_threshold=3, breaker_reset_s=0.3)
+    r.start()
+    try:
+        # mixed workload: half short, half long prompts, submitted while
+        # the storm is armed — chaos fires inside whichever replica's
+        # decode attempt hits the seam next
+        chaos.configure("serving.decode:exc:x4",
+                        seed=int(os.environ.get("PADDLE_CHAOS_SEED", "1234")))
+        rng = np.random.default_rng(2)
+        futs = []
+        for i in range(18):
+            n = 4 if i % 2 == 0 else int(rng.integers(16, 32))
+            futs.append(r.submit(_prompt(n=n, v=i % 7), max_new_tokens=2))
+            if i == 8:
+                r._replicas[1].client.kill()      # dies mid-flight
+        oks, errs = _resolve_all(futs, timeout=60)
+        assert len(oks) + len(errs) == 18         # zero lost futures
+        for e in errs:
+            # meaningful, not lost: typed serving errors, decode/chaos
+            # RuntimeErrors, or the dead replica's ConnectionError when
+            # the retry budget lands on it before the next probe
+            assert isinstance(e, (ServingError, RuntimeError,
+                                  ConnectionError)), e
+        # the fleet kept serving: the storm + kill cost at most a few
+        # requests, not the workload
+        assert len(oks) >= 14, f"only {len(oks)}/18 completed"
+        # the dead replica was evicted...
+        deadline = time.time() + 5
+        while time.time() < deadline \
+                and r._replicas[1].breaker.state != "open":
+            time.sleep(0.05)
+        assert r._replicas[1].breaker.state == "open"
+        # ...the survivors still serve...
+        assert r.submit(_prompt(), max_new_tokens=2).result(30).shape == (6,)
+        # ...and recovery re-admits through the half-open probe
+        r._replicas[1].client.restart()
+        deadline = time.time() + 10
+        while time.time() < deadline \
+                and r._replicas[1].breaker.state != "closed":
+            time.sleep(0.05)
+        assert r._replicas[1].breaker.state == "closed"
+        assert r.stats["readmissions"] >= 1
+        h = r.health()
+        assert h["ok"] and h["router"]["healthy"] == 3
+        # then a rolling restart across the whole fleet drops nothing
+        futs = [r.submit(_prompt(), max_new_tokens=2) for _ in range(6)]
+        res = r.rolling_restart(drain_timeout=5.0, health_timeout=10.0)
+        assert res["ok"]
+        oks, errs = _resolve_all(futs)
+        assert len(oks) == 6 and not errs
+    finally:
+        chaos.disable()
+        r.stop()
+
+
+# -- continuous engines (real model) -----------------------------------------
+
+def test_router_over_continuous_engines():
+    """Two real tiny-Llama continuous-batching replicas behind the router:
+    results are real generations, prefix-affine requests land on one
+    replica's prompt cache, and health exposes the paged-pool headroom."""
+    import paddlepaddle_tpu as paddle
+    from paddlepaddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny(
+        vocab_size=64, hidden_size=32, layers=2, heads=4, kv_heads=2,
+        max_len=128))
+    factory = lambda: ServingEngine(  # noqa: E731
+        model, max_batch_size=2, decode_chunk=4, kv_page_size=16)
+    rng = np.random.default_rng(3)
+    with ServingRouter([factory, factory], probe_interval_s=0.1) as r:
+        p = rng.integers(0, 64, (8,)).astype(np.int32)
+        out = r.submit(p, max_new_tokens=4).result(300)
+        assert out.shape == (12,) and (out[:8] == p).all()
+        # shared system prompt: all three land on ONE replica's cache
+        sysp = rng.integers(0, 64, (18,)).astype(np.int32)
+        futs = [r.submit(np.concatenate(
+            [sysp, rng.integers(0, 64, (4,)).astype(np.int32)]),
+            max_new_tokens=3, prefix_len=18) for _ in range(3)]
+        for f in futs:
+            assert f.result(300).shape == (25,)
+        hits = [rep.client.engine._engine.prefix.hits
+                for rep in r._replicas]
+        assert sorted(hits) == [0, 2], hits    # 1 miss + 2 hits, one home
+        h = r.health()
+        assert h["ok"] and h["router"]["completed"] == 4
+        assert all(v["pages_free"] is not None
+                   for v in h["replicas"].values())

@@ -311,6 +311,34 @@ def test_drain_finishes_inflight_and_sheds_rest():
         eng.stop()
 
 
+def test_restart_after_drain_reopens_admission():
+    """Regression: drain() used to leave the engine permanently refusing
+    admission — start() after a COMPLETED drain must re-open it (drain
+    state cleared, breaker/watchdog re-armed). Rolling restart
+    (inference/router.py) is built on this sequence."""
+    eng = _static_engine(breaker_threshold=2)
+    try:
+        eng.submit(_prompt(), max_new_tokens=2).result(10)
+        eng._breaker.trip()                     # sick engine going down...
+        res = eng.drain(timeout=5)
+        assert res["clean"]
+        with pytest.raises(EngineDrainingError):
+            eng.submit(_prompt(), max_new_tokens=2)
+        eng.start()                             # ...comes back clean
+        assert eng._breaker.state == "closed"   # old epoch's history gone
+        out = eng.submit(_prompt(), max_new_tokens=2).result(10)
+        assert out.shape == (6,)
+        h = eng.health()
+        assert h["state"] == "serving" and h["ok"]
+        # drain -> start -> drain again still works (the router does this
+        # on every rolling restart)
+        assert eng.drain(timeout=5)["clean"]
+        eng.start()
+        eng.submit(_prompt(), max_new_tokens=2).result(10)
+    finally:
+        eng.stop()
+
+
 def test_drain_idempotent_and_clean_when_idle():
     eng = _static_engine()
     eng.submit(_prompt(), max_new_tokens=2).result(10)

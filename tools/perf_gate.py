@@ -115,6 +115,9 @@ def serving_metrics(doc: dict) -> Dict[str, Tuple[float, str]]:
     put("serving.prefix_hit_rate", body.get("prefix_hit_rate"), HIGHER)
     put("serving.concurrency_peak", body.get("concurrency_peak"), HIGHER)
     put("serving.kv_occupancy_peak", body.get("kv_occupancy_peak"), LOWER)
+    # fleet-router column (serving_bench --replicas N): completed/submitted
+    # under the workload — the availability the failover path defends
+    put("serving.availability", body.get("availability"), HIGHER)
     for slo_src in (body,) + tuple(
             body.get(k) for k in ("bf16", "int8") if isinstance(
                 body.get(k), dict)):

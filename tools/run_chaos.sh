@@ -13,6 +13,12 @@
 #     the PAGED KV pool — the default — and test_paged_kv.py adds the
 #     paged-specific drill: failed slots return their pages and the
 #     shared-prefix cache survives the storm)
+#   * fleet router: 3 replicas under a mixed workload, a serving.decode
+#     fault storm + one replica killed mid-decode — every future resolves
+#     (completed or typed, zero silently lost), the fleet keeps serving,
+#     the dead replica's breaker opens then re-admits after restart, and a
+#     full rolling restart drops zero requests
+#     (test_router.py::test_chaos_kill_one_replica_under_mixed_load)
 #   * black box: PADDLE_CHAOS_POINTS=step:kill:@4 under PADDLE_OBS_BLACKBOX
 #     kills a launched worker mid-step; the flight recorder's JSONL dump
 #     must carry the in-flight step event + all-thread stacks, and

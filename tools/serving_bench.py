@@ -14,6 +14,15 @@ The BASELINE.md serving card. Three workload profiles:
   engine's prompt cache turns N prefills into 1 prefill + N tails.
   Reported against a control run with the cache disabled (TTFT delta).
 
+``--replicas N`` routes the same profiles through the
+:class:`~paddlepaddle_tpu.inference.router.ServingRouter` over N replica
+engines instead of one: the report adds per-replica tokens/s, the fleet
+aggregate, the failover count, and **availability**
+(completed/submitted — the number the chaos drill defends and
+``tools/perf_gate.py`` gates higher-is-better). The prefix profile is the
+interesting one here: prefix-affine routing must keep the hit rate
+fleet-wide, not divide it by N.
+
 Reports KV-pool occupancy, prefix hit rate and peak concurrency next to
 the TTFT/TPOT SLO columns; ``tools/perf_gate.py`` gates the JSON artifact.
 
@@ -74,6 +83,33 @@ def gen_prompts(args, cfg, rng):
              None) for _ in range(args.reqs)]
 
 
+def warm_engine(eng, model, prompts, args, prefix_cache=True):
+    """Warm EVERY prefill bucket the prompts will hit + the decode program
+    (and, for the prefix profile, the prefix-HIT admit program), so compile
+    time doesn't pollute the timed window."""
+    rng = np.random.default_rng(7)
+    for blen in sorted({-(-len(p) // 128) * 128 for p, _ in prompts}):
+        eng.generate(rng.integers(0, model.config.vocab_size,
+                                  (min(blen, eng._max_len
+                                       - args.new_tokens) - 1,)
+                                  ).astype(np.int32),
+                     max_new_tokens=4)
+    pl = next((pl for _, pl in prompts if pl), None)
+    if pl and prefix_cache and eng._engine.kv_layout == "paged":
+        # warm the prefix-HIT admit program with a throwaway system
+        # prompt (miss registers it, hit compiles the tail-only
+        # program), then evict it and zero the counters
+        V = model.config.vocab_size
+        sysp = rng.integers(0, V, (pl,)).astype(np.int32)
+        for _ in range(2):
+            eng.generate(np.concatenate(
+                [sysp, rng.integers(0, V, (24,)).astype(np.int32)]),
+                max_new_tokens=4, prefix_len=pl)
+        pfx, pool = eng._engine.prefix, eng._engine.pool
+        pfx.evict_until(pool, pool.usable)
+        pfx.hits = pfx.misses = pfx.evictions = 0
+
+
 def run_serving(model, prompts, args, kv_layout, slots, num_pages=None,
                 prefix_cache=True, warm=True):
     """One engine pass over the workload; returns the metrics row."""
@@ -82,29 +118,7 @@ def run_serving(model, prompts, args, kv_layout, slots, num_pages=None,
                        kv_page_size=args.page_size, kv_num_pages=num_pages,
                        prefix_cache=prefix_cache) as eng:
         if warm:
-            # warm EVERY prefill bucket the prompts will hit + the decode
-            # program, so compile time doesn't pollute the timed window
-            rng = np.random.default_rng(7)
-            for blen in sorted({-(-len(p) // 128) * 128 for p, _ in prompts}):
-                eng.generate(rng.integers(0, model.config.vocab_size,
-                                          (min(blen, eng._max_len
-                                               - args.new_tokens) - 1,)
-                                          ).astype(np.int32),
-                             max_new_tokens=4)
-            pl = next((pl for _, pl in prompts if pl), None)
-            if pl and prefix_cache and eng._engine.kv_layout == "paged":
-                # warm the prefix-HIT admit program with a throwaway
-                # system prompt (miss registers it, hit compiles the
-                # tail-only program), then evict it and zero the counters
-                V = model.config.vocab_size
-                sysp = rng.integers(0, V, (pl,)).astype(np.int32)
-                for _ in range(2):
-                    eng.generate(np.concatenate(
-                        [sysp, rng.integers(0, V, (24,)).astype(np.int32)]),
-                        max_new_tokens=4, prefix_len=pl)
-                pfx, pool = eng._engine.prefix, eng._engine.pool
-                pfx.evict_until(pool, pool.usable)
-                pfx.hits = pfx.misses = pfx.evictions = 0
+            warm_engine(eng, model, prompts, args, prefix_cache)
         if eng._engine.kv_layout == "paged":
             # occupancy peak must measure the WORKLOAD, not warm traffic
             eng._engine.pool.peak_used = eng._engine.pool.used
@@ -132,6 +146,102 @@ def run_serving(model, prompts, args, kv_layout, slots, num_pages=None,
                                   if looked else None)
         row["prefix_evictions"] = pfx["evictions"]
     return row
+
+
+def run_fleet(model, prompts, args):
+    """Route the workload through a ServingRouter over N replica engines:
+    fleet + per-replica tokens/s, failover count, availability."""
+    from paddlepaddle_tpu.inference.router import ServingRouter
+
+    def factory():
+        return ServingEngine(model, max_batch_size=args.slots,
+                             decode_chunk=args.chunk,
+                             kv_layout=args.kv_layout,
+                             kv_page_size=args.page_size,
+                             kv_num_pages=args.num_pages)
+
+    router = ServingRouter([factory for _ in range(args.replicas)],
+                           probe_interval_s=0.2)
+    router.start()
+    try:
+        engines = [rep.client.engine for rep in router._replicas]
+        for eng in engines:
+            warm_engine(eng, model, prompts, args)
+            if eng._engine.kv_layout == "paged":
+                eng._engine.pool.peak_used = eng._engine.pool.used
+            eng._engine.stats["peak_busy"] = 0
+        before = [(eng.stats["decode_tokens"], eng.stats["requests"])
+                  for eng in engines]
+        t0 = time.perf_counter()
+        # a synchronous refusal (overload shed, fleet unavailable) counts
+        # against availability exactly like an in-flight failure — the
+        # bench must produce its artifact UNDER the failure conditions
+        # availability exists to measure, not die on them
+        futs, submitted = [], 0
+        for p, pl in prompts:
+            submitted += 1
+            try:
+                futs.append((p, router.submit(
+                    p, max_new_tokens=args.new_tokens, prefix_len=pl)))
+            except Exception as e:  # noqa: BLE001
+                sys.stderr.write(
+                    f"  submit refused: {type(e).__name__}: {e}\n")
+        new_tokens = completed = 0
+        for p, f in futs:
+            try:
+                out = f.result(1800)
+            except Exception as e:  # noqa: BLE001 — availability is the metric
+                sys.stderr.write(
+                    f"  request failed: {type(e).__name__}: {e}\n")
+            else:
+                completed += 1
+                new_tokens += len(out) - len(p)
+        dt = time.perf_counter() - t0
+        h = router.health()["router"]
+        per_replica = []
+        hits = misses = 0
+        for rep, eng, (tok0, req0) in zip(router._replicas, engines, before):
+            pr = {"replica": rep.name,
+                  "tok_s": round((eng.stats["decode_tokens"] - tok0)
+                                 / max(dt, 1e-9), 1),
+                  "requests": eng.stats["requests"] - req0}
+            kv = eng._engine.kv_stats()
+            if kv["layout"] == "paged":
+                pr["prefix_hits"] = kv["prefix"]["hits"]
+                hits += kv["prefix"]["hits"]
+                misses += kv["prefix"]["misses"]
+            per_replica.append(pr)
+        row = {"replicas": args.replicas, "kv_layout": args.kv_layout,
+               "slots_per_replica": args.slots,
+               "aggregate_tok_s": round(new_tokens / max(dt, 1e-9), 1),
+               "wall_s": round(dt, 2), "new_tokens": new_tokens,
+               "availability": round(completed / max(submitted, 1), 4),
+               "failovers": h["failovers"], "retries": h["retries"],
+               "per_replica": per_replica}
+        if hits + misses:
+            # FLEET-wide hit rate: prefix-affine routing must keep it,
+            # not divide it by the replica count
+            row["prefix_hit_rate"] = round(hits / (hits + misses), 4)
+        row.update(slo_summary([f for _, f in futs]))
+        return row
+    finally:
+        router.stop()
+
+
+def fmt_fleet(row):
+    print(f"fleet x{row['replicas']:<14} {row['aggregate_tok_s']:8.1f} "
+          f"tok/s  availability={row['availability']:.3f}  "
+          f"failovers={row['failovers']}"
+          + (f"  prefix_hit_rate={row['prefix_hit_rate']}"
+             if row.get("prefix_hit_rate") is not None else ""))
+    for pr in row["per_replica"]:
+        print(f"  {pr['replica']:<20} {pr['tok_s']:8.1f} tok/s  "
+              f"requests={pr['requests']}"
+              + (f"  prefix_hits={pr['prefix_hits']}"
+                 if "prefix_hits" in pr else ""))
+    print(f"{'':<22} SLO: ttft p50={row['ttft_p50_ms']}ms "
+          f"p99={row['ttft_p99_ms']}ms  tpot={row['tpot_ms']}ms/token  "
+          f"queue_wait p99={row['queue_wait_p99_ms']}ms", flush=True)
 
 
 def fmt(row, label):
@@ -168,6 +278,10 @@ def main():
                     "(default slots//2)")
     ap.add_argument("--prefix-len", type=int, default=256,
                     help="shared system-prompt length (prefix profile)")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="route the workload through a ServingRouter over "
+                    "N replica engines (per-replica + fleet tokens/s, "
+                    "failovers, availability)")
     ap.add_argument("--hidden", type=int, default=1024)
     ap.add_argument("--layers", type=int, default=12)
     ap.add_argument("--max-len", type=int, default=2048)
@@ -193,6 +307,18 @@ def main():
     body = {"profile": args.profile, "requests": args.reqs,
             "new_tokens_per_req": args.new_tokens,
             "single_tok_s": round(single_tps, 1)}
+
+    if args.replicas > 1:
+        if args.ab:
+            ap.error("--ab compares one engine's KV layouts; "
+                     "run it with --replicas 1")
+        row = run_fleet(model, prompts, args)
+        fmt_fleet(row)
+        body.update(row)
+        if args.profile == "mixed":
+            body["mixed_tok_s"] = body["aggregate_tok_s"]
+        print(json.dumps({"serving_bench": body}))
+        return
 
     if args.ab:
         # fixed KV byte budget: slots_c contiguous slots' worth of pool
