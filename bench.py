@@ -73,12 +73,25 @@ def _time_steps(step, ids, iters, batch=None, tag="train_step"):
 
     Params/opt-state are donated through every call and rebound, so peak
     memory matches the plain step-by-step loop.
+
+    A ShardedTrainStep (detected by its `_param_sh` table) rides the SAME
+    slope harness: its batch lands via `_batch_sharding`, its buffers
+    thread through `_step_impl`, and the chain is jitted with the step's
+    own param/opt shardings donated through the carry — the timed program
+    is the GSPMD-partitioned step the plan produces. Cost capture is
+    skipped there (the sharded `_step` signature differs, and mesh rows
+    quote tokens/s + scaling columns, not registry MFU).
     """
     import jax.numpy as jnp
 
+    sharded = hasattr(step, "_param_sh")
     if batch is None:
         ids = jnp.asarray(ids)
         batch = (ids, ids)
+    if sharded:
+        batch = tuple(jax.device_put(jnp.asarray(b),
+                                     step._batch_sharding(jnp.asarray(b)))
+                      for b in batch)
     else:
         batch = tuple(jnp.asarray(b) for b in batch)
     lr = jnp.asarray(step.optimizer.get_lr(), jnp.float32)
@@ -88,14 +101,25 @@ def _time_steps(step, ids, iters, batch=None, tag="train_step"):
         def f(p, o):
             def body(carry, kk):
                 p_, o_ = carry
-                p2, o2, loss = step._step_impl(p_, o_, batch, kk, lr)
+                if sharded:
+                    p2, o2, loss = step._step_impl(p_, step.buffers, o_,
+                                                   batch, kk, lr)
+                else:
+                    p2, o2, loss = step._step_impl(p_, o_, batch, kk, lr)
                 return (p2, o2), loss
 
             (pf, of), losses = jax.lax.scan(
                 body, (p, o), jax.random.split(key0, k_steps))
             return pf, of, losses[-1]
 
-        return jax.jit(f, donate_argnums=(0, 1))
+        kw = {}
+        if sharded:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            kw = dict(in_shardings=(step._param_sh, step._opt_sh),
+                      out_shardings=(step._param_sh, step._opt_sh,
+                                     NamedSharding(step.mesh, P())))
+        return jax.jit(f, donate_argnums=(0, 1), **kw)
 
     k_lo, k_hi = 2, max(iters, 4)
     f_lo, f_hi = make(k_lo), make(k_hi)
@@ -104,13 +128,14 @@ def _time_steps(step, ids, iters, batch=None, tag="train_step"):
     # cost-registry capture (always on for the bench — a lowering, not an
     # extra backend compile): XLA-counted flops/bytes of ONE train step
     cost = None
-    try:
-        c = _step_cost(tag, step, batch, key0, lr)
-        if c is not None and c.get("flops"):
-            cost = {"flops_per_step": c["flops"],
-                    "bytes_per_step": c.get("bytes_accessed")}
-    except Exception:
-        cost = None
+    if not sharded:
+        try:
+            c = _step_cost(tag, step, batch, key0, lr)
+            if c is not None and c.get("flops"):
+                cost = {"flops_per_step": c["flops"],
+                        "bytes_per_step": c.get("bytes_accessed")}
+        except Exception:
+            cost = None
 
     def run(f):
         nonlocal p, o
@@ -340,6 +365,222 @@ def _bench_resnet50(peak, on_accel):
     return out
 
 
+# -- multi-chip mesh mode (--mesh dpXmpY) ------------------------------------
+
+def _bench_mesh_train(make_model, rules, spec, batch, seq, iters,
+                      vocab_size, tag, extra=None):
+    """One model config on a mesh through the sharding plan, with the
+    SAME-config SAME-seed 1-chip TrainStep as the baseline — both timed
+    by the one `_time_steps` slope harness, so the record's columns are
+    directly comparable:
+
+    * ``scaling_efficiency`` = mesh / (1chip × n_devices);
+    * ``throughput_retention`` = mesh / 1chip — on a FORCED-HOST virtual
+      mesh every "device" shares one CPU, so efficiency is bounded by
+      1/n_devices and retention is the honest signal (on real chips it
+      reads n_devices × efficiency);
+    * ``final_loss`` vs ``loss_1chip`` is a real same-init parity column,
+      not an init-noise delta.
+    """
+    import paddlepaddle_tpu as paddle
+    from paddlepaddle_tpu.distributed.shard_plan import train_plan
+    from paddlepaddle_tpu.jit.train import TrainStep
+    from paddlepaddle_tpu.optimizer import AdamW
+    from paddlepaddle_tpu.parallel import ShardedTrainStep
+
+    plan = train_plan(spec, rules=rules, data_axes=("dp",))
+    loss_fn = lambda m, ids, labels: m(ids, labels=labels)  # noqa: E731
+    ids = np.random.default_rng(0).integers(
+        0, vocab_size, (batch, seq)).astype(np.int32)
+
+    def build(step_cls, **kw):
+        paddle.seed(0)
+        model = make_model()
+        return step_cls(model, AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters(),
+                                     multi_precision=True), loss_fn, **kw)
+
+    dt1, loss1, _ = _time_steps(build(TrainStep), ids, iters,
+                                tag=f"{tag}_1chip")
+    tps1 = batch * seq * iters / dt1
+    dt, loss, _ = _time_steps(build(ShardedTrainStep, plan=plan), ids,
+                              iters, tag=f"{tag}@{spec}")
+    tps = batch * seq * iters / dt
+    row = {
+        "mesh": spec, "devices": plan.n_devices,
+        "tokens_per_sec": round(tps, 1),
+        "tokens_per_sec_1chip": round(tps1, 1),
+        "scaling_efficiency": round(tps / max(tps1 * plan.n_devices, 1e-9), 4),
+        "throughput_retention": round(tps / max(tps1, 1e-9), 4),
+        "final_loss": round(_sync(loss), 4),
+        "loss_1chip": round(_sync(loss1), 4),
+        "batch": batch, "seq": seq,
+    }
+    if extra:
+        row.update(extra(plan, tps))
+    return row
+
+
+def _bench_llama_mesh(cfg, batch, seq, iters, peak, spec):
+    """The llama config on a dpXmpY mesh (DP×TP rule table)."""
+    from paddlepaddle_tpu.models import LlamaForCausalLM
+
+    n = cfg.num_params()
+    model_flops = 6 * n + 12 * cfg.num_hidden_layers * cfg.hidden_size * seq
+    return _bench_mesh_train(
+        lambda: LlamaForCausalLM(cfg), None, spec, batch, seq, iters,
+        cfg.vocab_size, "llama",
+        extra=lambda plan, tps: {
+            "params": n,
+            "mfu_per_chip": round(
+                tps * model_flops / (peak * plan.n_devices), 4)})
+
+
+def _bench_moe_mesh(cfg, batch, seq, iters, peak, spec):
+    """The MoE config on a dpXepY mesh: expert banks sharded on "ep"
+    (expert parallelism), einsum dispatch (the ep-clean SPMD lowering)."""
+    from paddlepaddle_tpu.distributed.shard_plan import moe_train_rules
+    from paddlepaddle_tpu.models.moe import MoEForCausalLM
+
+    return _bench_mesh_train(
+        lambda: MoEForCausalLM(cfg), moe_train_rules(), spec, batch, seq,
+        iters, cfg.vocab_size, "moe",
+        extra=lambda plan, tps: {"experts": cfg.num_experts,
+                                 "topk": cfg.num_experts_per_tok})
+
+
+def _bench_decode_tp(cfg, tp, n_reqs=6, new_tokens=16):
+    """Tensor-parallel decode through the continuous engine: aggregate
+    tokens/s at tp=1 vs tp=N over the same greedy workload, plus the
+    token-exactness bit the acceptance criteria pin."""
+    from paddlepaddle_tpu.distributed.shard_plan import decode_plan
+    from paddlepaddle_tpu.inference.decode_engine import BatchDecodeEngine
+    from paddlepaddle_tpu.inference.serving import GenerationRequest
+    from paddlepaddle_tpu.models import LlamaForCausalLM
+
+    import paddlepaddle_tpu as paddle
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size,
+                            (int(rng.integers(8, 33)),)).astype(np.int32)
+               for _ in range(n_reqs)]
+
+    def run(plan):
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        eng = BatchDecodeEngine(model, max_slots=4, chunk=8, plan=plan)
+        reqs = [GenerationRequest(p, new_tokens, 0.0, 0, None)
+                for p in prompts]
+        eng.serve(reqs[:1], timeout=600)       # warm: compile out of window
+        reqs = [GenerationRequest(p, new_tokens, 0.0, 0, None)
+                for p in prompts]
+        t0 = time.perf_counter()
+        eng.serve(reqs, timeout=600)
+        dt = time.perf_counter() - t0
+        outs = [np.asarray(r.result.result(5)) for r in reqs]
+        toks = sum(len(o) - len(p) for o, p in zip(outs, prompts))
+        return toks / max(dt, 1e-9), outs
+
+    tps1, outs1 = run(None)
+    tpsN, outsN = run(decode_plan(f"mp{tp}"))
+    return {
+        "mesh": f"mp{tp}", "devices": tp,
+        "tok_s": round(tpsN, 1), "tok_s_1chip": round(tps1, 1),
+        "speedup": round(tpsN / max(tps1, 1e-9), 3),
+        "token_exact": bool(all(np.array_equal(a, b)
+                                for a, b in zip(outs1, outsN))),
+    }
+
+
+def run_multichip(n_devices: int, on_accel: bool, mesh: str = None):
+    """Per-config multi-chip record — the MULTICHIP_r*.json payload:
+    real tokens/s + scaling-efficiency columns per mesh config (not a bare
+    n_devices probe). CPU containers run the tiny shapes; the same code
+    scales the real configs on a chip mesh."""
+    from paddlepaddle_tpu.models import LlamaConfig
+    from paddlepaddle_tpu.models.moe import MoEConfig
+
+    dp = max(n_devices // 2, 1)
+    llama_mesh = mesh or (f"dp{dp}mp2" if n_devices % 2 == 0
+                          else f"dp{n_devices}")
+    ep = 4 if n_devices % 4 == 0 else (2 if n_devices % 2 == 0 else 1)
+    moe_mesh = f"dp{max(n_devices // ep, 1)}ep{ep}"
+    if mesh is not None:
+        # an explicit spec parameterizes the LLAMA row; the MoE row needs
+        # an ep axis and the decode row an mp-only mesh, so they keep
+        # their auto-derived shapes — say so instead of silently ignoring
+        import sys as _sys
+
+        _sys.stderr.write(
+            f"[bench] --mesh {mesh} applies to the llama config; moe runs "
+            f"{moe_mesh} (expert parallel), decode_tp runs mp2\n")
+
+    if on_accel:
+        lcfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=4096,
+            num_hidden_layers=12, num_attention_heads=16,
+            num_key_value_heads=8, max_position_embeddings=2048,
+            dtype="bfloat16")
+        mcfg = MoEConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=768,
+            num_hidden_layers=8, num_attention_heads=16,
+            num_key_value_heads=8, num_experts=16, num_experts_per_tok=2,
+            max_position_embeddings=2048, dtype="bfloat16",
+            dispatch_mode="einsum")
+        batch, seq, iters = 8, 1024, 5
+        dcfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=4096,
+            num_hidden_layers=12, num_attention_heads=16,
+            num_key_value_heads=8, max_position_embeddings=2048,
+            dtype="bfloat16")
+    else:
+        lcfg = LlamaConfig.tiny(vocab_size=512, hidden_size=128, layers=2,
+                                heads=4, kv_heads=2, max_len=256)
+        mcfg = MoEConfig(vocab_size=256, hidden_size=64,
+                         intermediate_size=128, num_hidden_layers=2,
+                         num_attention_heads=4, num_key_value_heads=4,
+                         num_experts=16, num_experts_per_tok=2,
+                         max_position_embeddings=128,
+                         dispatch_mode="einsum")
+        batch, seq, iters = 8, 64, 3
+        dcfg = LlamaConfig.tiny(vocab_size=256, hidden_size=64, layers=2,
+                                heads=4, kv_heads=2, max_len=128)
+
+    peak = _peak_flops(jax.devices()[0])
+    entries = [
+        ("llama", lambda: _bench_llama_mesh(lcfg, batch, seq, iters,
+                                            peak, llama_mesh)),
+        ("moe", lambda: _bench_moe_mesh(mcfg, batch, seq, iters, peak,
+                                        moe_mesh)),
+    ]
+    if on_accel:
+        # largest-fit candidate on the mesh (remat like the 1-chip
+        # llama_max row); CPU containers skip it — the tiny llama row
+        # already exercises the same code path
+        xkw = dict(_LLAMA_MAX_CANDIDATES)["0.7b"]
+        xcfg = LlamaConfig(vocab_size=32000, max_position_embeddings=2048,
+                           dtype="bfloat16", recompute=True, **xkw)
+        entries.append(("llama_max", lambda: _bench_llama_mesh(
+            xcfg, batch, seq, iters, peak, llama_mesh)))
+    if n_devices % 2 == 0:
+        entries.append(("decode_tp",
+                        lambda: _bench_decode_tp(dcfg, tp=2)))
+    else:
+        # tp=1 vs tp=1 would run the same workload twice and emit a
+        # degenerate row (speedup ~1, trivially-true token_exact) into
+        # the gated artifact — record the skip instead
+        entries.append(("decode_tp", lambda: {
+            "skipped": f"tensor-parallel decode needs an even device "
+                       f"count, have {n_devices}"}))
+    configs = {}
+    for name, fn in entries:
+        try:
+            configs[name] = fn()
+        except Exception as e:  # one config must not kill the record
+            configs[name] = {"error": f"{type(e).__name__}: {e}"[:200]}
+    return {"n_devices": n_devices, "configs": configs}
+
+
 _SECONDARY = {"moe": _bench_moe, "resnet50": _bench_resnet50}
 for _n, _ in _LLAMA_MAX_CANDIDATES:
     _SECONDARY[f"llama_max:{_n}"] = (
@@ -371,6 +612,17 @@ def main():
     dev = jax.devices()[0]
     on_accel = dev.platform not in ("cpu",)
     peak = _peak_flops(dev)
+
+    if len(sys.argv) > 1 and sys.argv[1] == "--mesh":
+        # multi-chip mode: llama / MoE DP(+TP/EP) train configs through the
+        # sharding plan + the tp decode engine, with scaling-efficiency
+        # columns vs the same-config 1-chip step. `--mesh auto` picks
+        # dp(N/2)mp2 over all visible devices.
+        spec = sys.argv[2] if len(sys.argv) > 2 else "auto"
+        spec = None if spec == "auto" else spec
+        print(json.dumps({"multichip": run_multichip(
+            len(jax.devices()), on_accel, mesh=spec)}))
+        return
 
     if len(sys.argv) > 2 and sys.argv[1] == "--config":
         fn = _SECONDARY[sys.argv[2]]

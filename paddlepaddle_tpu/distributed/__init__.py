@@ -84,6 +84,16 @@ from .auto_parallel import (  # noqa: F401
     to_static,
 )
 from .mesh import ProcessMesh, auto_mesh, get_mesh, set_mesh  # noqa: F401
+from .shard_plan import (  # noqa: F401
+    ShardingPlan,
+    decode_plan,
+    dp_tp_train_rules,
+    mesh_from_spec,
+    moe_train_rules,
+    parse_mesh_spec,
+    tp_decode_rules,
+    train_plan,
+)
 from .parallel import DataParallel  # noqa: F401
 from .placement import Partial, Placement, Replicate, Shard  # noqa: F401
 from .sharding_api import reshard, shard_layer, shard_optimizer, shard_tensor  # noqa: F401

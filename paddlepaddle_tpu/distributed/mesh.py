@@ -70,6 +70,15 @@ class ProcessMesh:
     def __repr__(self):
         return f"ProcessMesh(shape={self._shape}, dim_names={self._dim_names})"
 
+    @staticmethod
+    def from_spec(spec: str) -> "ProcessMesh":
+        """Compact-spec constructor: ``ProcessMesh.from_spec("dp2mp4")`` —
+        axis order in the string is the mesh axis order (put ``mp`` last so
+        tensor-parallel peers are ICI neighbors)."""
+        from .shard_plan import mesh_from_spec
+
+        return mesh_from_spec(spec)
+
     # -- jax bridge ---------------------------------------------------------
     def to_jax(self) -> Mesh:
         if self._jax_mesh is None:

@@ -122,7 +122,7 @@ class BatchDecodeEngine:
                  chunk: int = 16, quant: Optional[str] = None,
                  quant_group_size: int = -1, kv_layout: str = "paged",
                  page_size: int = 64, num_pages: Optional[int] = None,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True, mesh=None, plan=None):
         cfg = model.config
         if kv_layout not in ("paged", "contiguous"):
             raise ValueError(
@@ -153,6 +153,32 @@ class BatchDecodeEngine:
 
             self.params, self.quant_meta = quantize_param_tree(
                 self.params, algo=quant, group_size=quant_group_size)
+        # tensor-parallel decode: a sharding plan (distributed.shard_plan)
+        # places params — including the int8 QuantizedWeight leaves, whose
+        # q and scales shard together — column/row-parallel on its "mp"
+        # axis and the KV pools on kv heads, so a model bigger than one
+        # chip serves through the same compiled programs (XLA partitions
+        # them and inserts the ICI collectives). Order matters: quantize
+        # first (host-side, whole tensors), shard second.
+        self.plan = plan
+        if self.plan is None and mesh is not None:
+            from ..distributed.shard_plan import ShardingPlan, decode_plan
+
+            self.plan = (mesh if isinstance(mesh, ShardingPlan)
+                         else decode_plan(mesh))
+        if self.plan is not None:
+            # loud, not silent: a head count tp doesn't divide would fit
+            # away to a FULLY REPLICATED pool on every chip — the exact
+            # memory surprise tensor parallelism exists to avoid
+            self.plan.validate_divisible(
+                num_attention_heads=cfg.num_attention_heads,
+                num_key_value_heads=cfg.num_key_value_heads,
+                intermediate_size=cfg.intermediate_size,
+                vocab_size=cfg.vocab_size)  # lm_head is typically the
+            #   largest serving weight; a vocab tp doesn't divide would
+            #   silently replicate it on every chip
+            self.params = self.plan.shard(self.params)
+            self._mesh_gauges()
         kvh, hd = cfg.num_key_value_heads, cfg.head_dim
         dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
         if kv_layout == "paged":
@@ -190,15 +216,23 @@ class BatchDecodeEngine:
             self.caches = [(jnp.zeros((self.S, self.L, kvh, hd), dtype),
                             jnp.zeros((self.S, self.L, kvh, hd), dtype))
                            for _ in range(cfg.num_hidden_layers)]
+        if self.plan is not None:
+            # commit the pools (kv heads on "mp") and every host-rebuilt
+            # array (replicated): deterministic placements, so the jitted
+            # programs never re-specialize on a sharding-inference guess
+            self.caches = [(self.plan.shard_kv(k), self.plan.shard_kv(v))
+                           for k, v in self.caches]
+            if self.page_table is not None:
+                self.page_table = self.plan.replicate(self.page_table)
         # device-resident per-slot state: [lens, tokens, active, budgets]
-        self.lens = jnp.zeros((self.S,), jnp.int32)
-        self.tokens = jnp.zeros((self.S,), jnp.int32)     # last emitted token
-        self.active = jnp.zeros((self.S,), bool)
-        self.temps = jnp.zeros((self.S,), jnp.float32)
-        self.eos_ids = jnp.full((self.S,), -1, jnp.int32)  # -1 = no eos
-        self.budgets = jnp.zeros((self.S,), jnp.int32)     # new tokens left
-        self.top_ks = jnp.zeros((self.S,), jnp.int32)      # 0 = no filter
-        self.key = jax.random.PRNGKey(0)
+        self.lens = self._repl(jnp.zeros((self.S,), jnp.int32))
+        self.tokens = self._repl(jnp.zeros((self.S,), jnp.int32))  # last tok
+        self.active = self._repl(jnp.zeros((self.S,), bool))
+        self.temps = self._repl(jnp.zeros((self.S,), jnp.float32))
+        self.eos_ids = self._repl(jnp.full((self.S,), -1, jnp.int32))
+        self.budgets = self._repl(jnp.zeros((self.S,), jnp.int32))  # left
+        self.top_ks = self._repl(jnp.zeros((self.S,), jnp.int32))  # 0 = off
+        self.key = self._repl(jax.random.PRNGKey(0))
         self._admit_fns: Dict[object, object] = {}
         self._decode_fn = jax.jit(self._decode_program(self.chunk),
                                   donate_argnums=(1,))
@@ -207,6 +241,30 @@ class BatchDecodeEngine:
         self._first_pending: Dict[int, object] = {}  # slot -> device scalar
         self.stats = {"tokens_out": 0, "requests": 0, "decode_calls": 0,
                       "peak_busy": 0}
+
+    def _repl(self, x):
+        """Replicate-commit under a plan (identity single-chip)."""
+        return x if self.plan is None else self.plan.replicate(x)
+
+    def mesh_info(self) -> Dict[str, object]:
+        """Mesh/sharding snapshot for ``health()``/``/healthz`` — the
+        parallelism block the fleet router and ``/metrics`` see."""
+        if self.plan is None:
+            return {"enabled": False}
+        return self.plan.describe()
+
+    def _mesh_gauges(self) -> None:
+        """One-time (construction, cold path) mesh gauges."""
+        axes = "x".join(f"{a}{s}" for a, s in self.plan.axes.items())
+        _safe_set("paddle_mesh_devices",
+                  "devices in the serving engine's mesh",
+                  self.plan.n_devices, axes=axes)
+        _safe_set("paddle_mesh_axes",
+                  "named axes in the serving engine's mesh",
+                  len(self.plan.axes), axes=axes)
+        _safe_set("paddle_tp_degree",
+                  "tensor-parallel degree of the decode engine",
+                  self.plan.tp_degree)
 
     # -- paged-pool observability -------------------------------------------
     def _kv_gauges(self, total: bool = False) -> None:
@@ -743,12 +801,13 @@ class BatchDecodeEngine:
         keep consuming compute as phantom active lanes in every chunk.
         Paged layout also returns the slots' pages to the free list."""
         if slots is None:
-            self.active = jnp.zeros((self.S,), bool)
+            self.active = self._repl(jnp.zeros((self.S,), bool))
             self._first_pending.clear()
             if self.kv_layout == "paged":
                 for i in range(self.S):
                     self._release_kv(i, zero_row=False)
-                self.page_table = jnp.zeros((self.S, self.P), jnp.int32)
+                self.page_table = self._repl(
+                    jnp.zeros((self.S, self.P), jnp.int32))
         else:
             for i in slots:
                 self.active = self.active.at[int(i)].set(False)

@@ -350,7 +350,9 @@ class ServingEngine:
                  kv_layout: str = "paged",
                  kv_page_size: int = 64,
                  kv_num_pages: Optional[int] = None,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True,
+                 mesh=None,
+                 plan=None):
         if mode not in ("continuous", "static"):
             raise ValueError(f"mode must be 'continuous' or 'static', got {mode!r}")
         if quant is not None and mode != "continuous":
@@ -358,6 +360,12 @@ class ServingEngine:
                 "quant mode requires the continuous engine (static mode "
                 "decodes through the model's own generate_cached, whose "
                 "bound params are full precision)")
+        if (mesh is not None or plan is not None) and mode != "continuous":
+            raise ValueError(
+                "tensor-parallel serving (mesh=/plan=) requires the "
+                "continuous engine — static mode decodes through the "
+                "model's own generate_cached, whose bound params are "
+                "single-chip")
         self.model = model
         self.mode = mode
         self.max_batch_size = max_batch_size
@@ -411,7 +419,7 @@ class ServingEngine:
                 chunk=decode_chunk, quant=quant,
                 quant_group_size=quant_group_size, kv_layout=kv_layout,
                 page_size=kv_page_size, num_pages=kv_num_pages,
-                prefix_cache=prefix_cache)
+                prefix_cache=prefix_cache, mesh=mesh, plan=plan)
             self._max_len = self._engine.L
             self._top_k_cap = self._engine.TOP_K_CAP
             # page-pool capacity admission facts (None = contiguous): a
@@ -661,6 +669,8 @@ class ServingEngine:
             stats = dict(self.stats)
         kv = (self._engine.kv_stats() if self._engine is not None
               else {"layout": "none"})
+        mesh = (self._engine.mesh_info() if self._engine is not None
+                else {"enabled": False})
         est = self._estimator.estimate_wait_s(self._queue_depth(),
                                               self.max_batch_size)
         return {
@@ -668,6 +678,9 @@ class ServingEngine:
             "mode": self.mode,
             "quant": self.quant or "off",
             "kv": kv,
+            # replica parallelism for the fleet router / /metrics: mesh
+            # axes+devices and the tp degree this engine decodes at
+            "mesh": mesh,
             "ok": alive and not self._draining.is_set()
                   and breaker != "open",
             "queue_depth": self._queue_depth(),

@@ -99,8 +99,18 @@ class ShardedTrainStep:
     """
 
     def __init__(self, model: Layer, optimizer: Optimizer, loss_fn: Callable,
-                 mesh, rules, data_axes=("dp", "fsdp"), seq_axis: Optional[str] = None,
-                 donate: bool = True):
+                 mesh=None, rules=None, data_axes=("dp", "fsdp"),
+                 seq_axis: Optional[str] = None, donate: bool = True,
+                 plan=None):
+        if plan is not None:
+            # a distributed.ShardingPlan carries mesh + rules + data axes
+            # in one object; explicit args win where given
+            mesh = mesh if mesh is not None else plan.mesh
+            rules = rules if rules is not None else plan.rules
+            if data_axes == ("dp", "fsdp") and plan.data_axes:
+                data_axes = plan.data_axes
+        if mesh is None or rules is None:
+            raise ValueError("ShardedTrainStep needs mesh+rules or plan=")
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn

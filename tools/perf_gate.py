@@ -94,6 +94,18 @@ def bench_metrics(doc: dict) -> Dict[str, Tuple[float, str]]:
     lm = configs.get("llama_max") or {}
     put("llama_max.tokens_per_sec", lm.get("tokens_per_sec"))
     put("llama_max.mfu", lm.get("mfu"))
+    # multichip record (bench.py --mesh / the MULTICHIP dryrun line):
+    # gate the per-config mesh THROUGHPUT columns only, higher-is-better.
+    # scaling_efficiency / throughput_retention / speedup are the same
+    # signal divided by the (gated) 1-chip rate — gating them too would
+    # double-fail every real regression and flap on the ratio noise the
+    # BASELINE.md multichip section documents
+    mc = doc.get("multichip") or {}
+    for cname, row in sorted((mc.get("configs") or {}).items()):
+        if not isinstance(row, dict) or "error" in row:
+            continue
+        put(f"multichip.{cname}.tokens_per_sec", row.get("tokens_per_sec"))
+        put(f"multichip.{cname}.tok_s", row.get("tok_s"))
     return out
 
 
@@ -118,6 +130,13 @@ def serving_metrics(doc: dict) -> Dict[str, Tuple[float, str]]:
     # fleet-router column (serving_bench --replicas N): completed/submitted
     # under the workload — the availability the failover path defends
     put("serving.availability", body.get("availability"), HIGHER)
+    # tensor-parallel column (serving_bench --tp N): throughput up, TTFT/
+    # TPOT down — a plan change that tanks the tp engine must not pass
+    tp = body.get("tp")
+    if isinstance(tp, dict):
+        put("serving.tp_tok_s", tp.get("aggregate_tok_s"), HIGHER)
+        put("serving.tp_ttft_p50_ms", tp.get("ttft_p50_ms"), LOWER)
+        put("serving.tp_tpot_ms", tp.get("tpot_ms"), LOWER)
     for slo_src in (body,) + tuple(
             body.get(k) for k in ("bf16", "int8") if isinstance(
                 body.get(k), dict)):

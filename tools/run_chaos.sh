@@ -19,6 +19,10 @@
 #     the dead replica's breaker opens then re-admits after restart, and a
 #     full rolling restart drops zero requests
 #     (test_router.py::test_chaos_kill_one_replica_under_mixed_load)
+#   * tp fleet: two TENSOR-PARALLEL (mesh mp2) replicas behind the router
+#     under a serving.decode storm — zero lost futures, rolling restart of
+#     tp engines comes back healthy
+#     (test_shard_plan.py::test_tp_engine_behind_router_drains_and_fails_over)
 #   * black box: PADDLE_CHAOS_POINTS=step:kill:@4 under PADDLE_OBS_BLACKBOX
 #     kills a launched worker mid-step; the flight recorder's JSONL dump
 #     must carry the in-flight step event + all-thread stacks, and

@@ -12,6 +12,13 @@
 # tools/run_chaos.sh / -m slow. tools/check_obs_overhead.py gates the
 # off/flight-on/exporter-idle/perf-on hot-path budgets separately.
 #
+# Sharding-plan suite: tests/test_shard_plan.py (plan spec resolution,
+# QuantizedWeight placement, tp=2 token-exact decode, dp=2 loss parity,
+# tp-replica router drill) runs on the 8-device virtual CPU platform
+# tests/conftest.py forces; on a box with < 2 visible devices and no
+# host-device override the module SKIPS (not errors) — CI without the
+# override stays green, it just doesn't exercise the mesh.
+#
 # Perf regression gate (not run here — needs a bench artifact): after a
 # bench run, `python tools/perf_gate.py --baseline BENCH_r05.json
 # --current <new>.json` exits nonzero on a tokens/s / MFU / TTFT

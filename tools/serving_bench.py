@@ -111,12 +111,16 @@ def warm_engine(eng, model, prompts, args, prefix_cache=True):
 
 
 def run_serving(model, prompts, args, kv_layout, slots, num_pages=None,
-                prefix_cache=True, warm=True):
-    """One engine pass over the workload; returns the metrics row."""
+                prefix_cache=True, warm=True, tp=1):
+    """One engine pass over the workload; returns the metrics row.
+    ``tp > 1`` serves through a tensor-parallel engine (sharding plan over
+    an ``mp``-axis mesh: weights column/row-parallel, KV pool sharded on
+    kv heads — docs/distributed.md)."""
     with ServingEngine(model, max_batch_size=slots,
                        decode_chunk=args.chunk, kv_layout=kv_layout,
                        kv_page_size=args.page_size, kv_num_pages=num_pages,
-                       prefix_cache=prefix_cache) as eng:
+                       prefix_cache=prefix_cache,
+                       mesh=(f"mp{tp}" if tp > 1 else None)) as eng:
         if warm:
             warm_engine(eng, model, prompts, args, prefix_cache)
         if eng._engine.kv_layout == "paged":
@@ -135,6 +139,8 @@ def run_serving(model, prompts, args, kv_layout, slots, num_pages=None,
            "aggregate_tok_s": round(new_tokens / max(dt, 1e-9), 1),
            "wall_s": round(dt, 2), "new_tokens": new_tokens,
            "concurrency_peak": peak_busy}
+    if tp > 1:
+        row["tp"] = tp
     row.update(slo_summary(futs))
     if kv["layout"] == "paged":
         row["kv_pages_total"] = kv["pages_total"]
@@ -282,6 +288,12 @@ def main():
                     help="route the workload through a ServingRouter over "
                     "N replica engines (per-replica + fleet tokens/s, "
                     "failovers, availability)")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="also run the workload through a TENSOR-PARALLEL "
+                    "engine (mesh mp<N>, weights + kv heads sharded) and "
+                    "report its tok/s + TTFT beside the 1-chip row; needs "
+                    "N visible devices (CPU: XLA_FLAGS="
+                    "--xla_force_host_platform_device_count=N)")
     ap.add_argument("--hidden", type=int, default=1024)
     ap.add_argument("--layers", type=int, default=12)
     ap.add_argument("--max-len", type=int, default=2048)
@@ -307,6 +319,10 @@ def main():
     body = {"profile": args.profile, "requests": args.reqs,
             "new_tokens_per_req": args.new_tokens,
             "single_tok_s": round(single_tps, 1)}
+
+    if args.tp > 1 and (args.replicas > 1 or args.ab):
+        ap.error("--tp compares one engine against its tensor-parallel "
+                 "form; run it with --replicas 1 and without --ab")
 
     if args.replicas > 1:
         if args.ab:
@@ -342,6 +358,18 @@ def main():
         body.update(row)
         print(f"({row['aggregate_tok_s'] / max(single_tps, 1e-9):.1f}x "
               "single-sequence)")
+
+    if args.tp > 1:
+        # tensor-parallel column: same workload through a plan-sharded
+        # engine (single-chip row above is the baseline). On a real mesh
+        # this is the models-bigger-than-one-chip row; on a forced-host
+        # CPU mesh the speedup reads ~1x (shared silicon) and the value
+        # is the parity + HBM-per-chip column
+        tpr = run_serving(model, prompts, args, args.kv_layout, args.slots,
+                          num_pages=args.num_pages, tp=args.tp)
+        fmt(tpr, f"tp{args.tp} x{args.slots}")
+        body["tp"] = tpr
+        body["tp_tok_s"] = tpr["aggregate_tok_s"]
 
     if args.profile == "prefix":
         # control: same workload, prompt cache off — the TTFT delta IS the
