@@ -1,0 +1,207 @@
+"""Persistent XLA compilation cache wiring — warm-disk restarts skip
+backend compile.
+
+Reference analogue: the reference framework's program/kernel caches that
+``save_inference_model`` deployments rely on to avoid rebuilding per
+process. JAX-native: XLA's persistent compilation cache
+(``jax_compilation_cache_dir``) keyed by the optimized HLO, shared across
+processes through a directory. This module wires it through the
+``FLAGS_compile_cache_dir`` / ``PADDLE_COMPILE_CACHE`` flag family
+(:func:`maybe_autoinstall` runs at package import, so arming a fleet is an
+env var, no code change), counts hits/misses/seconds from the
+``jax.monitoring`` cache events, and surfaces them as
+``paddle_compile_cache_*`` metrics plus the ``cache`` block inside
+``health()``/``/healthz``'s compile section.
+
+What the cache does and does not buy: a warm-disk restart still pays
+python tracing and cache retrieval (tens of milliseconds per program)
+but skips the backend compile (seconds to minutes) — the recompile
+watchdog labels these fast-path compiles distinctly so a warm restart no
+longer reads as a recompilation storm. AOT serving bundles
+(:mod:`~..inference.compile_plan`) go further and skip the retrace too.
+
+Listeners follow the watchdog's pattern: ``jax.monitoring`` listeners
+cannot be unregistered, so one process-wide pair is installed on first
+:func:`install` and gated by ``_active`` afterwards.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional
+
+from . import flags as _flags
+
+_lock = threading.Lock()
+_active = False
+_listener_installed = False
+_state: Dict[str, object] = {"enabled": False, "dir": None}
+_counts: Dict[str, float] = {"hits": 0, "misses": 0, "retrieval_s": 0.0,
+                             "saved_s": 0.0, "backend_compile_s": 0.0}
+
+# event names shared with observability/watchdog.py's hit/miss labeling —
+# defined once so a jax rename cannot desync the cache counters from the
+# watchdog's storm suppression
+CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+_COUNT_EVENTS = {
+    CACHE_HIT_EVENT: "hits",
+    CACHE_MISS_EVENT: "misses",
+}
+_DURATION_EVENTS = {
+    "/jax/compilation_cache/cache_retrieval_time_sec": "retrieval_s",
+    "/jax/compilation_cache/compile_time_saved_sec": "saved_s",
+    "/jax/core/compile/backend_compile_duration": "backend_compile_s",
+}
+
+
+def _safe_metric(fn_name: str, *args, **kw) -> None:
+    """Metrics are best-effort and gated by the obs family; cache
+    accounting must never break a compile."""
+    try:
+        from .. import observability as _obs
+
+        getattr(_obs, fn_name)(*args, **kw)
+    except Exception:
+        pass
+
+
+def _on_event(event: str, **_kw) -> None:
+    if not _active:
+        return
+    field = _COUNT_EVENTS.get(event)
+    if field is None:
+        return
+    with _lock:
+        _counts[field] += 1
+    _safe_metric("safe_inc", f"paddle_compile_cache_{field}_total",
+                 f"persistent compile cache {field}")
+
+
+def _on_duration(event: str, duration_secs: float, **_kw) -> None:
+    if not _active:
+        return
+    field = _DURATION_EVENTS.get(event)
+    if field is None:
+        return
+    with _lock:
+        # saved_s can go NEGATIVE for tiny programs (retrieval costs more
+        # than the compile it replaced) — keep the honest cumulative sum,
+        # which is why these export as gauges, not counters
+        _counts[field] += float(duration_secs)
+        val = _counts[field]
+    _safe_metric("safe_set", f"paddle_compile_cache_{field[:-2]}_seconds",
+                 f"cumulative persistent-cache {field[:-2]} seconds", val)
+
+
+def install(cache_dir: Optional[str] = None,
+            min_compile_secs: Optional[float] = None) -> bool:
+    """Point jax at a persistent compilation cache directory and start
+    counting its events. ``cache_dir`` defaults to
+    ``FLAGS_compile_cache_dir`` (env ``PADDLE_COMPILE_CACHE``); empty
+    means leave the cache off. Returns True when armed."""
+    global _active, _listener_installed
+    if cache_dir is None:
+        cache_dir = _flags.flag_value("compile_cache_dir")
+    if not cache_dir:
+        return False
+    if min_compile_secs is None:
+        min_compile_secs = _flags.flag_value("compile_cache_min_compile_secs")
+    import jax
+
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # default jax policy only persists compiles > 1s / large entries —
+    # serving programs at small test scales would never cache, so the
+    # flag default (0.0) persists everything and the flag raises the bar
+    # on boxes where cache I/O matters
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      float(min_compile_secs))
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    # jax initializes its cache AT MOST ONCE, on the first compile — and
+    # framework import itself compiles a few host ops before any user
+    # code runs, latching "no cache" forever. Reset the latch so the
+    # directory set above actually takes effect
+    try:
+        from jax.experimental.compilation_cache import compilation_cache \
+            as _jcc
+
+        _jcc.reset_cache()
+    except Exception:
+        try:
+            from jax._src import compilation_cache as _jcc
+
+            _jcc.reset_cache()
+        except Exception:
+            pass
+    with _lock:
+        if not _listener_installed:
+            jax.monitoring.register_event_listener(_on_event)
+            jax.monitoring.register_event_duration_secs_listener(_on_duration)
+            _listener_installed = True
+        _state["enabled"] = True
+        _state["dir"] = cache_dir
+    _active = True
+    _safe_metric("safe_set", "paddle_compile_cache_enabled",
+                 "persistent XLA compile cache armed (1 = on)", 1)
+    return True
+
+
+def uninstall() -> None:
+    """Disarm: stop counting and detach the cache directory (existing
+    entries stay on disk for the next install)."""
+    global _active
+    _active = False
+    with _lock:
+        _state["enabled"] = False
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", None)
+    except Exception:
+        pass
+    _safe_metric("safe_set", "paddle_compile_cache_enabled",
+                 "persistent XLA compile cache armed (1 = on)", 0)
+
+
+def maybe_autoinstall() -> bool:
+    """Arm the cache iff the flag/env names a directory — called at
+    package import so ``PADDLE_COMPILE_CACHE=/path python serve.py`` is
+    the whole deployment story."""
+    try:
+        if _flags.flag_value("compile_cache_dir"):
+            return install()
+    except Exception as e:
+        # never fatal at import — but an armed-by-env cache that silently
+        # stays off means every restart pays full compiles with no signal
+        import sys
+
+        sys.stderr.write(
+            "[compile-cache] PADDLE_COMPILE_CACHE set but the persistent "
+            f"compile cache could not be armed ({type(e).__name__}: {e}); "
+            "restarts will pay full backend compiles\n")
+        _safe_metric("safe_set", "paddle_compile_cache_enabled",
+                     "persistent XLA compile cache armed (1 = on)", 0)
+    return False
+
+
+def reset_stats() -> None:
+    with _lock:
+        for k in _counts:
+            _counts[k] = 0 if k in ("hits", "misses") else 0.0
+
+
+def stats() -> Dict[str, object]:
+    """Snapshot for ``health()`` compile blocks and benches."""
+    with _lock:
+        return {
+            "enabled": bool(_state["enabled"]),
+            "dir": _state["dir"],
+            "hits": int(_counts["hits"]),
+            "misses": int(_counts["misses"]),
+            "retrieval_s": round(_counts["retrieval_s"], 4),
+            "saved_s": round(_counts["saved_s"], 4),
+            "backend_compile_s": round(_counts["backend_compile_s"], 4),
+        }

@@ -148,6 +148,22 @@ define_flag("obs_perf", False,
             "paddle_program_* gauges and the exporter's /programs endpoint",
             env="PADDLE_OBS_PERF")
 
+# Compile-cache family (core/compile_cache.py + inference/compile_plan.py):
+# persistent XLA compilation cache so warm-disk restarts skip backend
+# compile. Armed at package import when the dir is set (env alone deploys
+# it fleet-wide); hit/miss/seconds surface as paddle_compile_cache_*.
+define_flag("compile_cache_dir", "",
+            "directory for JAX's persistent XLA compilation cache "
+            "(jax_compilation_cache_dir); empty = cache off. Restarting a "
+            "serving process against a warm directory skips backend "
+            "compiles — seconds instead of minutes to first token",
+            env="PADDLE_COMPILE_CACHE")
+define_flag("compile_cache_min_compile_secs", 0.0,
+            "only compiles at least this long are persisted to the compile "
+            "cache (0 = persist everything; raise it where cache I/O costs "
+            "more than small recompiles)",
+            env="PADDLE_COMPILE_CACHE_MIN_SECS")
+
 # Resilience family (resilience/): checkpoint integrity verification; the
 # chaos engine reads its PADDLE_CHAOS_* env vars directly (lazily at the
 # first seam hit, so launcher-spawned workers pick them up per process).

@@ -154,6 +154,11 @@ class PredictorPool:
         return len(self._preds)
 
 
+from .compile_plan import (  # noqa: F401,E402
+    BundleMismatchError,
+    CompilePlan,
+    prompt_buckets,
+)
 from .robustness import (  # noqa: F401,E402
     CircuitBreaker,
     CircuitOpenError,

@@ -46,6 +46,7 @@ layout (``kv_layout="paged"``, the default) fixes it the static-shape way:
 
 from __future__ import annotations
 
+import sys
 import time
 from typing import Dict, List, Optional
 
@@ -55,6 +56,7 @@ import numpy as np
 
 from ..core import autograd as _ag
 from ..core.dispatch import unwrap
+from . import compile_plan as _cp
 from .kv_pool import PagePool, PrefixCache, pages_needed, prefix_hash
 from .robustness import KVCapacityError
 from .robustness import safe_inc as _safe_inc
@@ -93,6 +95,19 @@ def _flight_record(kind: str, name: str, **data) -> None:
         pass
 
 
+def _expected_compiles(label: str):
+    """Recompile-watchdog region for PLANNED compiles (warmup, bundle
+    save): counted, never storm-flagged. Falls back to a no-op context."""
+    try:
+        from ..observability import watchdog
+
+        return watchdog.expected_compiles(label)
+    except Exception:
+        import contextlib
+
+        return contextlib.nullcontext()
+
+
 def _stamp(req, attr: str, value=None) -> None:
     """Best-effort SLO timestamp on the request's result future —
     engine-shaped foreign request objects (tests, benches) without a
@@ -122,7 +137,8 @@ class BatchDecodeEngine:
                  chunk: int = 16, quant: Optional[str] = None,
                  quant_group_size: int = -1, kv_layout: str = "paged",
                  page_size: int = 64, num_pages: Optional[int] = None,
-                 prefix_cache: bool = True, mesh=None, plan=None):
+                 prefix_cache: bool = True, mesh=None, plan=None,
+                 bundle: Optional[str] = None):
         cfg = model.config
         if kv_layout not in ("paged", "contiguous"):
             raise ValueError(
@@ -233,14 +249,27 @@ class BatchDecodeEngine:
         self.budgets = self._repl(jnp.zeros((self.S,), jnp.int32))  # left
         self.top_ks = self._repl(jnp.zeros((self.S,), jnp.int32))  # 0 = off
         self.key = self._repl(jax.random.PRNGKey(0))
-        self._admit_fns: Dict[object, object] = {}
-        self._decode_fn = jax.jit(self._decode_program(self.chunk),
-                                  donate_argnums=(1,))
+        # program registry: every compiled program the engine serves with,
+        # keyed by compile-plan key ("decode" / "admit_p<bucket>" /
+        # "admit_pfx<n>t<bucket>"). Values are lazy jax.jit wrappers until
+        # first use, warmup, or a bundle load replaces them with AOT
+        # Compiled executables; _warmed tracks keys whose compile already
+        # happened so warmup never double-compiles
+        self._programs: Dict[str, object] = {}
+        self._warmed: set = set()
+        self._warm_info: Optional[Dict[str, object]] = None
+        self._bundle_info: Optional[Dict[str, object]] = None
         self._decode_captured = False
         self._host_slots = [_Slot() for _ in range(self.S)]
         self._first_pending: Dict[int, object] = {}  # slot -> device scalar
         self.stats = {"tokens_out": 0, "requests": 0, "decode_calls": 0,
                       "peak_busy": 0}
+        self.compile_plan = _cp.CompilePlan.for_engine(self)
+        if bundle is not None:
+            # never fatal: a stale/foreign bundle logs and falls back to
+            # the lazy build path — a deploy with a bad artifact serves
+            # slow, it does not crash-loop
+            self.load_serving_bundle(bundle)
 
     def _repl(self, x):
         """Replicate-commit under a plan (identity single-chip)."""
@@ -561,6 +590,237 @@ class BatchDecodeEngine:
 
         return run_contiguous
 
+    # -- compile plan: program registry, warmup, bundles ---------------------
+    def _build_program(self, key: str):
+        """The lazy ``jax.jit`` wrapper for one plan key (no compile yet).
+        The single construction seam: _admit, warmup() and bundle save all
+        build through here, so the plan IS what the engine compiles."""
+        kind, info = _cp.parse_key(key)
+        if kind == "decode":
+            return jax.jit(self._decode_program(self.chunk),
+                           donate_argnums=(1,))
+        if kind == "prefix":
+            return jax.jit(
+                self._admit_prefix_program(info["n_pfx"],
+                                           info["tail_bucket"]),
+                donate_argnums=(1,))
+        impl = (self._admit_paged_impl if self.kv_layout == "paged"
+                else self._admit_impl)
+        return jax.jit(impl, donate_argnums=(1,))
+
+    def _decode_args(self) -> tuple:
+        """THE decode program's argument tuple — shared by the serve path
+        (_decode_chunk) and the plan seam (warmup/bundle lowering), so an
+        AOT Compiled can never be specialized to avals the serve path
+        doesn't pass."""
+        if self.kv_layout == "paged":
+            return (self.params, self.caches, self.page_table, self.tokens,
+                    self.lens, self.active, self.temps, self.eos_ids,
+                    self.budgets, self.top_ks, self.key)
+        return (self.params, self.caches, self.tokens, self.lens,
+                self.active, self.temps, self.eos_ids, self.budgets,
+                self.top_ks, self.key)
+
+    def _admit_args(self, key: str, ids, plen: int, slot: int, temp: float,
+                    eos: int, budget: int, top_k: int) -> tuple:
+        """THE admission argument tuple for one program key — shared by
+        _admit (live request values) and the plan seam (zero examples:
+        only avals matter for lowering and treedefs)."""
+        kind, _ = _cp.parse_key(key)
+        state = (self.lens, self.tokens, self.active, self.temps,
+                 self.eos_ids, self.budgets, self.top_ks)
+        tail = (ids, jnp.int32(plen), jnp.int32(slot), jnp.float32(temp),
+                jnp.int32(eos), jnp.int32(budget), jnp.int32(top_k),
+                self.key)
+        head = ((self.params, self.caches, self.page_table)
+                if kind == "prefix" or self.kv_layout == "paged"
+                else (self.params, self.caches))
+        return head + state + tail
+
+    def _example_args(self, key: str) -> tuple:
+        """Concrete arguments with the EXACT avals (shape/dtype/sharding)
+        the serve path passes for ``key`` — used to AOT-lower in warmup()/
+        save, and to rebuild bundle pytree structures at load. Never
+        executed, so live state buffers double as examples."""
+        kind, info = _cp.parse_key(key)
+        if kind == "decode":
+            return self._decode_args()
+        width = (info["tail_bucket"] if kind == "prefix"
+                 else info["bucket"])
+        return self._admit_args(key, jnp.zeros((1, width), jnp.int32),
+                                plen=1, slot=0, temp=0.0, eos=-1, budget=1,
+                                top_k=0)
+
+    def _out_template(self, key: str) -> tuple:
+        """A pytree with the program's OUTPUT structure (leaves are
+        placeholders — treedefs carry structure only). Lets a bundle load
+        reconstruct out_trees from the live engine instead of pickling
+        treedefs with custom (QuantizedWeight) nodes."""
+        kind, _ = _cp.parse_key(key)
+        if kind == "decode":
+            return (self.caches, self.tokens, self.lens, self.active,
+                    self.budgets, self.key, jnp.int32(0))
+        return (self.caches, self.lens, self.tokens, self.active,
+                self.temps, self.eos_ids, self.budgets, self.top_ks,
+                self.key, jnp.int32(0))
+
+    def warmup(self, keys: Optional[List[str]] = None) -> Dict[str, object]:
+        """Compile the plan EAGERLY (AOT lower+compile, nothing executed)
+        so no request ever lands on a cold program — the explicit form of
+        what the first requests used to pay implicitly. Idempotent per
+        program; already-served or bundle-loaded keys are skipped. With a
+        persistent compile cache armed, a warm-disk restart's warmup is
+        retrieval, not compilation. Returns the warmup summary also kept
+        in ``compile_info()``."""
+        from ..core import compile_cache as _cc
+
+        if keys is None:
+            keys = self.compile_plan.keys()
+        t0 = time.perf_counter()
+        cache0 = _cc.stats()
+        compiled_n = skipped = 0
+        p = _perf()
+        perf_on = p is not None and p.enabled()
+        with _expected_compiles("warmup"):
+            for key in keys:
+                if key in self._warmed:
+                    skipped += 1
+                    continue
+                fn = self._programs.get(key)
+                if fn is None:
+                    fn = self._build_program(key)
+                if not hasattr(fn, "lower"):    # already an AOT Compiled
+                    self._warmed.add(key)
+                    skipped += 1
+                    continue
+                compiled = None
+                kind, info = _cp.parse_key(key)
+                if perf_on and kind != "decode":
+                    # same capture the lazy path does: the Compiled
+                    # replaces the jit entry, one compile total, exact
+                    # costs recorded
+                    bucket = (f"pfx{info['n_pfx']}t{info['tail_bucket']}"
+                              if kind == "prefix" else f"p{info['bucket']}")
+                    compiled = p.capture_jit(
+                        "serving.admit", fn, self._example_args(key),
+                        bucket=bucket, quant=self.quant or "off")
+                if compiled is None:
+                    compiled = fn.lower(*self._example_args(key)).compile()
+                self._programs[key] = compiled
+                self._warmed.add(key)
+                compiled_n += 1
+            self._warm_bookkeeping_ops()
+        cache1 = _cc.stats()
+        self._warm_info = {
+            "programs": len(keys),
+            "compiled": compiled_n,
+            "skipped": skipped,
+            "wall_s": round(time.perf_counter() - t0, 3),
+            "cache_hits": cache1["hits"] - cache0["hits"],
+        }
+        _safe_set("paddle_serving_warmup_seconds",
+                  "wall seconds the last engine warmup spent compiling",
+                  self._warm_info["wall_s"])
+        _safe_set("paddle_serving_warmup_programs",
+                  "programs compiled by the last engine warmup",
+                  compiled_n)
+        _flight_record("compile", "warmup", **self._warm_info)
+        return dict(self._warm_info)
+
+    def _warm_bookkeeping_ops(self) -> None:
+        """Flush the tiny host-side op compiles the first requests would
+        otherwise pay (page-table row writes use STATIC slot indices, so
+        each slot is its own ~10 ms program; likewise the first-token
+        stack per pending count). Pure copies — engine state untouched.
+        Without this, a fully warmed/bundled engine still shows a handful
+        of ms-scale compiles in its first serve window."""
+        try:
+            if self.kv_layout == "paged":
+                pt = self.page_table
+                zrow = jnp.zeros((self.P,), jnp.int32)
+                for slot in range(self.S):
+                    pt = pt.at[slot].set(zrow)
+                pt.block_until_ready()
+            act = self.active
+            for slot in range(self.S):
+                act = act.at[slot].set(False)
+            act.block_until_ready()
+            firsts = [jnp.int32(0)] * self.S
+            for k in range(1, self.S + 1):
+                np.asarray(jnp.stack(firsts[:k]))
+        except Exception:
+            pass          # best-effort: a miss here costs ms, not minutes
+
+    def save_serving_bundle(self, path: str,
+                            keys: Optional[List[str]] = None
+                            ) -> Dict[str, object]:
+        """Serialize the engine's compiled programs + manifest to ``path``
+        (every plan entry plus traffic-built prefix variants; programs not
+        yet compiled are AOT-compiled first). A process built with
+        ``bundle=path`` then serves without a single retrace or backend
+        compile. See :mod:`~.compile_plan` for format and commit rules."""
+        with _expected_compiles("bundle_save"):
+            manifest = _cp.save_bundle(self, path, keys=keys)
+        _flight_record("compile", "bundle_save", path=str(path),
+                       programs=len(manifest["entries"]),
+                       wall_s=manifest.get("save_wall_s"))
+        return manifest
+
+    def load_serving_bundle(self, path: str, strict: bool = False) -> bool:
+        """Load an AOT bundle into the program registry. Non-strict (the
+        constructor path) NEVER raises: any mismatch/corruption logs one
+        stderr line, bumps ``paddle_serving_bundle_fallbacks_total`` and
+        leaves the engine on the normal lazy-build path."""
+        try:
+            manifest = _cp.load_bundle(self, path)
+        except Exception as e:
+            if strict:
+                raise
+            sys.stderr.write(
+                f"[serving] bundle {path} not loaded "
+                f"({type(e).__name__}: {e}); falling back to lazy program "
+                "builds\n")
+            _safe_inc("paddle_serving_bundle_fallbacks_total",
+                      "serving bundles rejected at load (engine fell back "
+                      "to compiling)", reason=type(e).__name__)
+            self._bundle_info = {"loaded": False, "path": str(path),
+                                 "error": f"{type(e).__name__}: {e}"}
+            _flight_record("compile", "bundle_fallback", path=str(path),
+                           error=f"{type(e).__name__}: {str(e)[:200]}")
+            return False
+        self._bundle_info = {
+            "loaded": True,
+            "path": str(path),
+            "programs": len(manifest.get("entries", [])),
+            "fingerprint": str(manifest.get("fingerprint"))[:16],
+        }
+        _safe_set("paddle_serving_bundle_loaded",
+                  "an AOT serving bundle is live in this engine (1 = yes)",
+                  1)
+        _safe_set("paddle_serving_bundle_programs",
+                  "programs loaded from the serving bundle",
+                  self._bundle_info["programs"])
+        _flight_record("compile", "bundle_load", path=str(path),
+                       programs=self._bundle_info["programs"])
+        return True
+
+    def compile_info(self) -> Dict[str, object]:
+        """The ``compile`` block of ``health()``/``/healthz``: plan size/
+        fingerprint, how many programs are built/warm, bundle + warmup
+        status, persistent-cache counters."""
+        from ..core import compile_cache as _cc
+
+        plan = self.compile_plan
+        return {
+            "plan": {"entries": len(plan.entries),
+                     "fingerprint": plan.fingerprint()[:16]},
+            "programs_built": len(self._programs),
+            "programs_warmed": len(self._warmed),
+            "warmup": self._warm_info,
+            "bundle": self._bundle_info or {"loaded": False},
+            "cache": _cc.stats(),
+        }
+
     # -- host orchestration --------------------------------------------------
     def _prefix_plan(self, req, ids, plen):
         """(aligned, n_pfx, hash, entry) for a request's declared shared
@@ -662,8 +922,6 @@ class BatchDecodeEngine:
                 row[:len(private)] = private
             self.page_table = self.page_table.at[slot].set(jnp.asarray(row))
             self._kv_gauges()
-        state = (self.lens, self.tokens, self.active, self.temps,
-                 self.eos_ids, self.budgets, self.top_ks)
         if self.kv_layout == "paged" and entry is not None:
             # HIT: prefill only the tail against the cached prefix pages
             tail = plen - aligned
@@ -672,38 +930,22 @@ class BatchDecodeEngine:
                               self.P * self.page_size - aligned)
             padded = np.zeros((1, tail_bucket), np.int32)
             padded[0, :tail] = ids[0, aligned:]
-            fn_key = ("pfx", n_pfx, tail_bucket)
-            args = (self.params, self.caches, self.page_table) + state + (
-                jnp.asarray(padded), jnp.int32(tail), jnp.int32(slot),
-                jnp.float32(temp),
-                jnp.int32(-1 if eos is None else int(eos)),
-                jnp.int32(req.max_new_tokens), jnp.int32(top_k), self.key)
-            build = lambda: jax.jit(  # noqa: E731
-                self._admit_prefix_program(n_pfx, tail_bucket),
-                donate_argnums=(1,))
+            fn_key = _cp.prefix_admit_key(n_pfx, tail_bucket)
+            prog_plen = tail
             perf_bucket = f"pfx{n_pfx}t{tail_bucket}"
         else:
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :plen] = ids
-            tail_args = (jnp.asarray(padded), jnp.int32(plen),
-                         jnp.int32(slot), jnp.float32(temp),
-                         jnp.int32(-1 if eos is None else int(eos)),
-                         jnp.int32(req.max_new_tokens), jnp.int32(top_k),
-                         self.key)
-            if self.kv_layout == "paged":
-                args = (self.params, self.caches,
-                        self.page_table) + state + tail_args
-                build = lambda: jax.jit(self._admit_paged_impl,  # noqa: E731
-                                        donate_argnums=(1,))
-            else:
-                args = (self.params, self.caches) + state + tail_args
-                build = lambda: jax.jit(self._admit_impl,  # noqa: E731
-                                        donate_argnums=(1,))
-            fn_key = bucket
+            fn_key = _cp.admit_key(bucket)
+            prog_plen = plen
             perf_bucket = f"p{bucket}"
-        fn = self._admit_fns.get(fn_key)
+        args = self._admit_args(
+            fn_key, jnp.asarray(padded), plen=prog_plen, slot=slot,
+            temp=temp, eos=-1 if eos is None else int(eos),
+            budget=req.max_new_tokens, top_k=top_k)
+        fn = self._programs.get(fn_key)
         if fn is None:
-            fn = build()
+            fn = self._build_program(fn_key)
             p = _perf()
             if p is not None and p.enabled():
                 # capture the bucketed prefill program's exact cost; the
@@ -713,7 +955,7 @@ class BatchDecodeEngine:
                                          or "off")
                 if compiled is not None:
                     fn = compiled
-            self._admit_fns[fn_key] = fn
+            self._programs[fn_key] = fn
         try:
             (self.caches, self.lens, self.tokens, self.active, self.temps,
              self.eos_ids, self.budgets, self.top_ks, self.key, first) = \
@@ -724,6 +966,9 @@ class BatchDecodeEngine:
             # until a full reset)
             self._release_kv(slot)
             raise
+        # only AFTER the first call succeeds: a failed first admission
+        # (chaos, OOM) must not mask this key from a later warmup()
+        self._warmed.add(fn_key)
         if self.kv_layout == "paged" and h is not None and entry is None:
             # MISS with a declared prefix: the full prefill just wrote the
             # prefix pages — pin them shared (this slot holds the first
@@ -829,14 +1074,7 @@ class BatchDecodeEngine:
         return sum(1 for s in self._host_slots if s.req is not None)
 
     def _decode_chunk(self):
-        if self.kv_layout == "paged":
-            args = (self.params, self.caches, self.page_table, self.tokens,
-                    self.lens, self.active, self.temps, self.eos_ids,
-                    self.budgets, self.top_ks, self.key)
-        else:
-            args = (self.params, self.caches, self.tokens, self.lens,
-                    self.active, self.temps, self.eos_ids, self.budgets,
-                    self.top_ks, self.key)
+        args = self._decode_args()
         p = _perf()
         perf_on = p is not None and p.enabled()
         if perf_on and not self._decode_captured:
@@ -853,9 +1091,16 @@ class BatchDecodeEngine:
         # into the program's wall, so wall_min measures the decode
         # program, not an extra link roundtrip
         pure_decode = not self._first_pending
+        fn = self._programs.get("decode")
+        if fn is None:
+            fn = self._build_program("decode")
+            self._programs["decode"] = fn
         t0 = time.perf_counter()
         (self.caches, self.tokens, self.lens, self.active, self.budgets,
-         self.key, packed) = self._decode_fn(*args)
+         self.key, packed) = fn(*args)
+        # post-success: a failed first chunk must not mask the key from a
+        # later warmup()
+        self._warmed.add("decode")
         self.stats["decode_calls"] += 1
         self._collect_firsts()
         pk = np.asarray(packed)                 # the ONE sync per chunk

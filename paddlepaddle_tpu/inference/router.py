@@ -135,6 +135,16 @@ class ReplicaClient:
             raise ConnectionError(f"replica {self.name} is dead")
         return self.engine.health()
 
+    def warmup(self) -> Dict[str, object]:
+        """Pre-compile the replica's whole plan (rolling restart calls
+        this between the fresh build and re-admission). Duck-typed: an
+        engine without a warmup surface — a bare test double, a remote
+        replica that warms itself at boot — reports a no-op."""
+        if self._killed:
+            raise ConnectionError(f"replica {self.name} is dead")
+        fn = getattr(self.engine, "warmup", None)
+        return fn() if callable(fn) else {"programs": 0, "compiled": 0}
+
     def drain(self, timeout: Optional[float] = None) -> Dict[str, object]:
         return self.engine.drain(timeout)
 
@@ -758,12 +768,17 @@ class ServingRouter:
 
     # -- rolling restart -----------------------------------------------------
     def rolling_restart(self, drain_timeout: Optional[float] = None,
-                        health_timeout: float = 60.0) -> Dict[str, object]:
+                        health_timeout: float = 60.0,
+                        warmup: bool = True) -> Dict[str, object]:
         """Restart every replica one at a time without dropping traffic:
         take it out of rotation (no new picks), drain it (in-flight
         finishes; queued requests shed typed and fail over to the rest),
-        build a fresh engine, wait until its health probe reads ok, put it
-        back. Stops early — replica left OUT of rotation — if a restarted
+        build a fresh engine, PRE-WARM its compile plan while it is still
+        out of rotation (``warmup=False`` skips it — e.g. replicas that
+        load an AOT bundle and are warm by construction), wait until its
+        health probe reads ok, put it back. The first request routed to
+        the restarted replica therefore never lands on a cold program.
+        Stops early — replica left OUT of rotation — if a restarted
         replica never turns healthy, so a bad deploy cannot take the whole
         fleet down one "upgrade" at a time."""
         self.start()
@@ -777,6 +792,24 @@ class ServingRouter:
                            phase="begin")
             rep.in_rotation = False
             rep.client.restart(drain_timeout)
+            warm_info = None
+            if warmup:
+                # compiles happen HERE, outside rotation — not on the
+                # first unlucky routed request after re-admission
+                try:
+                    warm_info = rep.client.warmup()
+                    _safe_inc("paddle_router_prewarms_total",
+                              "replicas pre-warmed during rolling restart",
+                              replica=rep.name)
+                    _flight_record("router", rep.name, event="prewarm",
+                                   **(warm_info or {}))
+                except Exception as e:
+                    # warm-later is degraded, not fatal: the health gate
+                    # below still decides re-admission
+                    sys.stderr.write(
+                        f"[router] replica {rep.name} pre-warm failed "
+                        f"({type(e).__name__}: {e}); first requests may "
+                        "pay compiles\n")
             deadline = time.monotonic() + health_timeout
             ok = False
             while time.monotonic() < deadline:
@@ -791,6 +824,7 @@ class ServingRouter:
                 time.sleep(0.02)
             round_info = {"replica": rep.name, "ok": ok,
                           "generation": rep.client.generation,
+                          "warmup": warm_info,
                           "wall_s": round(time.monotonic() - t0, 3)}
             _flight_record("router", rep.name, event="rolling_restart",
                            phase="end", ok=ok)

@@ -352,9 +352,15 @@ class ServingEngine:
                  kv_num_pages: Optional[int] = None,
                  prefix_cache: bool = True,
                  mesh=None,
-                 plan=None):
+                 plan=None,
+                 bundle: Optional[str] = None):
         if mode not in ("continuous", "static"):
             raise ValueError(f"mode must be 'continuous' or 'static', got {mode!r}")
+        if bundle is not None and mode != "continuous":
+            raise ValueError(
+                "bundle= requires the continuous engine (static mode "
+                "decodes through the model's own generate_cached; AOT "
+                "bundles serialize the decode engine's compiled programs)")
         if quant is not None and mode != "continuous":
             raise ValueError(
                 "quant mode requires the continuous engine (static mode "
@@ -419,7 +425,8 @@ class ServingEngine:
                 chunk=decode_chunk, quant=quant,
                 quant_group_size=quant_group_size, kv_layout=kv_layout,
                 page_size=kv_page_size, num_pages=kv_num_pages,
-                prefix_cache=prefix_cache, mesh=mesh, plan=plan)
+                prefix_cache=prefix_cache, mesh=mesh, plan=plan,
+                bundle=bundle)
             self._max_len = self._engine.L
             self._top_k_cap = self._engine.TOP_K_CAP
             # page-pool capacity admission facts (None = contiguous): a
@@ -655,6 +662,28 @@ class ServingEngine:
     def generate(self, prompt_ids, timeout: float = 300.0, **kw) -> np.ndarray:
         return self.submit(prompt_ids, **kw).result(timeout)
 
+    # -- cold-start control --------------------------------------------------
+    def warmup(self) -> Dict[str, object]:
+        """Compile the engine's whole plan eagerly so the first request
+        never lands on a cold program (the router pre-warms restarted
+        replicas through this before re-admission). Static mode has no
+        plan to walk — its programs belong to the model's own
+        ``generate_cached`` — so it returns a no-op summary rather than
+        raising: a fleet can warm heterogeneous replicas blindly."""
+        if self._engine is None:
+            return {"programs": 0, "compiled": 0, "skipped": 0,
+                    "wall_s": 0.0, "mode": "static"}
+        return self._engine.warmup()
+
+    def save_serving_bundle(self, path: str) -> Dict[str, object]:
+        """Serialize the decode engine's compiled programs + manifest to
+        ``path`` — the artifact ``ServingEngine(..., bundle=path)`` then
+        serves from with zero retraces (see docs/serving.md)."""
+        if self._engine is None:
+            raise ValueError(
+                "save_serving_bundle requires the continuous engine")
+        return self._engine.save_serving_bundle(path)
+
     def health(self) -> Dict[str, object]:
         """Readiness/liveness snapshot — what a probe endpoint (or the C
         protocol's ``_OP_HEALTH`` frame) reports."""
@@ -671,6 +700,12 @@ class ServingEngine:
               else {"layout": "none"})
         mesh = (self._engine.mesh_info() if self._engine is not None
                 else {"enabled": False})
+        if self._engine is not None:
+            compile_block = self._engine.compile_info()
+        else:
+            from ..core import compile_cache as _cc
+
+            compile_block = {"cache": _cc.stats()}
         est = self._estimator.estimate_wait_s(self._queue_depth(),
                                               self.max_batch_size)
         return {
@@ -681,6 +716,10 @@ class ServingEngine:
             # replica parallelism for the fleet router / /metrics: mesh
             # axes+devices and the tp degree this engine decodes at
             "mesh": mesh,
+            # cold-start state: compile plan + warmup/bundle status +
+            # persistent-cache counters — what a deploy watches to know a
+            # restarted replica is warm before routing to it
+            "compile": compile_block,
             "ok": alive and not self._draining.is_set()
                   and breaker != "open",
             "queue_depth": self._queue_depth(),

