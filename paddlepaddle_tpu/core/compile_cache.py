@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import os
 import threading
+from contextlib import contextmanager
 from typing import Dict, Optional
 
 from . import flags as _flags
@@ -96,6 +97,50 @@ def _on_duration(event: str, duration_secs: float, **_kw) -> None:
                  f"cumulative persistent-cache {field[:-2]} seconds", val)
 
 
+def _reset_jax_cache_latch() -> None:
+    """Drop jax's once-per-process compilation-cache latch AND its live
+    cache object so the CURRENT ``jax_compilation_cache_dir`` value is
+    re-read at the next compile. Without this, install() after the first
+    compile is a no-op — and uninstall() leaves the old directory live:
+    jax caches the "is the cache used" decision and the cache handle the
+    first time any compile asks, and never re-reads the config."""
+    try:
+        from jax.experimental.compilation_cache import compilation_cache \
+            as _jcc
+
+        _jcc.reset_cache()
+    except Exception:
+        try:
+            from jax._src import compilation_cache as _jcc
+
+            _jcc.reset_cache()
+        except Exception:
+            pass
+
+
+@contextmanager
+def cache_bypassed():
+    """Compiles inside this context skip the persistent cache entirely
+    (read AND write) and produce REAL backend executables.
+
+    Exists for AOT bundle saves: on this jaxlib's CPU backend,
+    re-serializing an executable that was itself DESERIALIZED (a
+    persistent-cache hit) yields a payload with no kernel object code —
+    it fails at load time with "Symbols not found". A bundle save that
+    finds such an executable recompiles it in here. Concurrent compiles
+    on other threads harmlessly miss the cache for the duration."""
+    import jax
+
+    prev = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    _reset_jax_cache_latch()
+    try:
+        yield
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+        _reset_jax_cache_latch()
+
+
 def install(cache_dir: Optional[str] = None,
             min_compile_secs: Optional[float] = None) -> bool:
     """Point jax at a persistent compilation cache directory and start
@@ -124,18 +169,7 @@ def install(cache_dir: Optional[str] = None,
     # framework import itself compiles a few host ops before any user
     # code runs, latching "no cache" forever. Reset the latch so the
     # directory set above actually takes effect
-    try:
-        from jax.experimental.compilation_cache import compilation_cache \
-            as _jcc
-
-        _jcc.reset_cache()
-    except Exception:
-        try:
-            from jax._src import compilation_cache as _jcc
-
-            _jcc.reset_cache()
-        except Exception:
-            pass
+    _reset_jax_cache_latch()
     with _lock:
         if not _listener_installed:
             jax.monitoring.register_event_listener(_on_event)
@@ -160,6 +194,10 @@ def uninstall() -> None:
         import jax
 
         jax.config.update("jax_compilation_cache_dir", None)
+        # drop jax's latched cache handle too: without the reset the OLD
+        # directory keeps serving hits and absorbing writes for the rest
+        # of the process — "detached" must mean detached
+        _reset_jax_cache_latch()
     except Exception:
         pass
     _safe_metric("safe_set", "paddle_compile_cache_enabled",

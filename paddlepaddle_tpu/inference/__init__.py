@@ -173,3 +173,4 @@ from .robustness import (  # noqa: F401,E402
 )
 from .router import ReplicaClient, ServingRouter  # noqa: F401,E402
 from .serving import GenerationResult, ServingEngine  # noqa: F401,E402
+from .speculative import SpeculativeDecoder  # noqa: F401,E402

@@ -54,8 +54,15 @@ MANIFEST_NAME = "manifest.json"
 #   "admit_pfx<n>t<bucket>"  — prefix-HIT admission (n cached pages,
 #                              tail bucket) — built on traffic, bundled
 #                              when present
+#   "draft_admit_p<bucket>"  — speculative: draft-model prompt prefill
+#   "draft_k<K>"             — speculative: K greedy draft proposals
+#   "verify_k<K>"            — speculative: one batched target verify
+#                              over K+1 positions + masked accept/reject
 _ADMIT_RE = re.compile(r"^admit_p(\d+)$")
 _PREFIX_RE = re.compile(r"^admit_pfx(\d+)t(\d+)$")
+_DRAFT_ADMIT_RE = re.compile(r"^draft_admit_p(\d+)$")
+_DRAFT_RE = re.compile(r"^draft_k(\d+)$")
+_VERIFY_RE = re.compile(r"^verify_k(\d+)$")
 
 
 def decode_key() -> str:
@@ -70,6 +77,18 @@ def prefix_admit_key(n_pfx: int, tail_bucket: int) -> str:
     return f"admit_pfx{int(n_pfx)}t{int(tail_bucket)}"
 
 
+def draft_admit_key(bucket: int) -> str:
+    return f"draft_admit_p{int(bucket)}"
+
+
+def draft_key(k: int) -> str:
+    return f"draft_k{int(k)}"
+
+
+def verify_key(k: int) -> str:
+    return f"verify_k{int(k)}"
+
+
 def parse_key(key: str) -> Tuple[str, Dict[str, int]]:
     """(kind, info) for a program key; raises ValueError on garbage so a
     tampered bundle entry fails loud instead of building nonsense."""
@@ -82,6 +101,15 @@ def parse_key(key: str) -> Tuple[str, Dict[str, int]]:
     if m:
         return "prefix", {"n_pfx": int(m.group(1)),
                           "tail_bucket": int(m.group(2))}
+    m = _DRAFT_ADMIT_RE.match(key)
+    if m:
+        return "draft_admit", {"bucket": int(m.group(1))}
+    m = _DRAFT_RE.match(key)
+    if m:
+        return "draft", {"k": int(m.group(1))}
+    m = _VERIFY_RE.match(key)
+    if m:
+        return "verify", {"k": int(m.group(1))}
     raise ValueError(f"unrecognized compile-plan program key {key!r}")
 
 
@@ -152,15 +180,37 @@ class CompilePlan:
                                  if engine.quant else -1),
             "mesh": (engine.plan.describe()
                      if engine.plan is not None else None),
+            # speculative decoding: draft arch + quant + k make the
+            # draft/verify programs (and the decode path's semantics)
+            # exchangeable — a draft-model swap MUST change the
+            # fingerprint so a stale bundle falls back loudly instead of
+            # serving another draft's executables
+            "spec": (engine.spec.facts()
+                     if getattr(engine, "spec", None) is not None else None),
             "jax": jax.__version__,
             "jaxlib": jaxlib.__version__,
             "platform": jax.default_backend(),
             "n_devices": jax.device_count(),
         }
-        entries = [PlanEntry(decode_key(), "decode",
-                             {"slots": engine.S, "chunk": engine.chunk})]
+        spec_on = getattr(engine, "spec", None) is not None
+        entries = []
+        if not spec_on:
+            # a speculative engine routes EVERY chunk through the
+            # draft/verify programs, so the plain chunked-decode scan —
+            # the single most expensive compile in the plan — would be
+            # dead weight in warmup and bundles
+            entries.append(PlanEntry(decode_key(), "decode",
+                                     {"slots": engine.S,
+                                      "chunk": engine.chunk}))
         for b in prompt_buckets(engine.L):
             entries.append(PlanEntry(admit_key(b), "admit", {"bucket": b}))
+        if spec_on:
+            k = engine.spec.k
+            for b in prompt_buckets(engine.L):
+                entries.append(PlanEntry(draft_admit_key(b), "draft_admit",
+                                         {"bucket": b}))
+            entries.append(PlanEntry(draft_key(k), "draft", {"k": k}))
+            entries.append(PlanEntry(verify_key(k), "verify", {"k": k}))
         return cls(entries, facts)
 
     def keys(self) -> List[str]:
@@ -230,7 +280,25 @@ def save_bundle(engine, path: str,
                 fn = jit_fn.lower(*engine._example_args(key)).compile()
                 engine._programs[key] = fn
                 engine._warmed.add(key)
-            payload, _in_tree, _out_tree = _se.serialize(fn)
+            payload, in_tree, out_tree = _se.serialize(fn)
+            try:
+                _se.deserialize_and_load(payload, in_tree, out_tree)
+            except Exception:
+                # a payload that cannot load back is worse than no bundle
+                # (it fails at RESTART, the moment the bundle exists for).
+                # Known cause on this jaxlib's CPU backend: ``fn`` was
+                # itself deserialized (a persistent-cache hit), and
+                # re-serializing such an executable drops the kernel
+                # object code. Recompile for real with the cache detached
+                # and serialize THAT; a second probe failure is fatal.
+                from ..core.compile_cache import cache_bypassed
+
+                with cache_bypassed():
+                    fn = engine._build_program(key).lower(
+                        *engine._example_args(key)).compile()
+                engine._programs[key] = fn
+                payload, in_tree, out_tree = _se.serialize(fn)
+                _se.deserialize_and_load(payload, in_tree, out_tree)
             fname = f"{key}.xc"
             with open(os.path.join(staging, fname), "wb") as f:
                 f.write(payload)
@@ -324,7 +392,13 @@ def load_bundle(engine, path: str) -> Dict[str, object]:
         # out of the serialization format entirely
         in_tree = tree_structure((engine._example_args(key), {}))
         out_tree = tree_structure(engine._out_template(key))
-        loaded[key] = _se.deserialize_and_load(payload, in_tree, out_tree)
+        try:
+            loaded[key] = _se.deserialize_and_load(payload, in_tree,
+                                                   out_tree)
+        except Exception as e:
+            raise BundleMismatchError(
+                f"bundle entry {key}: executable failed to deserialize "
+                f"({type(e).__name__}: {e})", [key]) from e
     engine._programs.update(loaded)
     engine._warmed.update(loaded)
     return manifest

@@ -120,12 +120,14 @@ def _stamp(req, attr: str, value=None) -> None:
 
 
 class _Slot:
-    __slots__ = ("req", "emitted", "budget")
+    __slots__ = ("req", "emitted", "budget", "spec_steps", "spec_accepted")
 
     def __init__(self, req=None, budget=0):
         self.req = req
         self.emitted: List[int] = []
         self.budget = budget
+        self.spec_steps = 0       # speculative verify steps this request saw
+        self.spec_accepted = 0    # draft tokens the verifier accepted for it
 
 
 class BatchDecodeEngine:
@@ -138,7 +140,8 @@ class BatchDecodeEngine:
                  quant_group_size: int = -1, kv_layout: str = "paged",
                  page_size: int = 64, num_pages: Optional[int] = None,
                  prefix_cache: bool = True, mesh=None, plan=None,
-                 bundle: Optional[str] = None):
+                 bundle: Optional[str] = None, draft=None, spec_k: int = 0,
+                 draft_quant: Optional[str] = None):
         cfg = model.config
         if kv_layout not in ("paged", "contiguous"):
             raise ValueError(
@@ -264,6 +267,24 @@ class BatchDecodeEngine:
         self._first_pending: Dict[int, object] = {}  # slot -> device scalar
         self.stats = {"tokens_out": 0, "requests": 0, "decode_calls": 0,
                       "peak_busy": 0}
+        # speculative decoding: a draft model proposes spec_k greedy
+        # tokens per slot and ONE batched target forward verifies all
+        # k+1 positions — same emitted stream (greedy acceptance is
+        # token-exact by construction), >1 token per target weight-read
+        # at any nonzero acceptance rate. See inference/speculative.py.
+        self.spec = None
+        if draft is not None or spec_k:
+            if draft is None or not spec_k:
+                raise ValueError(
+                    "speculative decoding needs BOTH draft= (a small "
+                    "model or its config) and spec_k= (proposals per "
+                    "target step)")
+            from .speculative import SpeculativeDecoder
+
+            self.spec = SpeculativeDecoder(self, draft, spec_k,
+                                           draft_quant=draft_quant)
+            self._spec_steps_per_chunk = max(
+                1, self.chunk // (self.spec.k + 1))
         self.compile_plan = _cp.CompilePlan.for_engine(self)
         if bundle is not None:
             # never fatal: a stale/foreign bundle logs and falls back to
@@ -340,6 +361,12 @@ class BatchDecodeEngine:
             },
         }
 
+    def spec_info(self) -> Dict[str, object]:
+        """The ``spec`` block of ``health()``/``/healthz``: draft config,
+        k, and live acceptance — ``{"enabled": False}`` when speculative
+        decoding is off."""
+        return {"enabled": False} if self.spec is None else self.spec.info()
+
     # -- compiled pieces ----------------------------------------------------
     def _forward(self, params, toks, caches, pos):
         """One model step: toks [b, s] -> (logits, caches')."""
@@ -353,16 +380,27 @@ class BatchDecodeEngine:
         return logits, [(unwrap(k), unwrap(v)) for k, v in new_caches]
 
     def _forward_paged(self, params, toks, pools, page_table, lens):
-        """One decode step through the page table: each layer gathers its
+        """One forward over ``toks [S, W]`` at per-slot positions
+        ``lens..lens+W-1`` through the page table: each layer gathers its
         logical ``[S, P*page_size]`` K/V view (the page table IS the gather
         index), runs the unchanged ragged-attention math against it, and
-        scatters the single newly written position back to its physical
-        page. Retired slots' table rows are zeroed, so their writes land in
-        the sacrificial null page."""
-        S, ps = self.S, self.page_size
-        rows = jnp.arange(S, dtype=jnp.int32)
-        phys = page_table[rows, lens // ps]        # [S] physical page
-        off = lens % ps                            # [S] offset inside it
+        scatters all W newly written positions back to their physical
+        pages. W=1 is the chunked decode step; the speculative verify
+        program runs W=k+1 through the SAME implementation, so the two
+        paths cannot diverge. Retired slots' table rows are zeroed and
+        positions past ``max_len`` are redirected explicitly, so
+        out-of-stream writes land in the sacrificial null page — never in
+        another slot's pages."""
+        S, ps, P, L = self.S, self.page_size, self.P, self.L
+        W = toks.shape[1]
+        rows = jnp.arange(S, dtype=jnp.int32)[:, None]         # [S, 1]
+        pos = lens[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]
+        pos_g = jnp.minimum(pos, P * ps - 1)
+        page_idx = jnp.minimum(pos // ps, P - 1)
+        phys = jnp.where(
+            pos < L,
+            page_table[jnp.broadcast_to(rows, pos.shape), page_idx], 0)
+        off = pos % ps
         with _ag.no_grad(), self.model.bind_state(params):
             mdl = self.model.model
             x = mdl.embed_tokens(toks)
@@ -370,14 +408,14 @@ class BatchDecodeEngine:
             new_pools = []
             for layer, (kp, vp) in zip(mdl.layers, pools):
                 kview = kp[page_table].reshape(
-                    S, self.P * ps, *kp.shape[2:])
+                    S, P * ps, *kp.shape[2:])
                 vview = vp[page_table].reshape(
-                    S, self.P * ps, *vp.shape[2:])
+                    S, P * ps, *vp.shape[2:])
                 x, (kc, vc) = layer(x, cos, sin, None,
                                     cache=(kview, vview), pos=lens)
                 kc, vc = unwrap(kc), unwrap(vc)
-                kp = kp.at[phys, off].set(kc[rows, lens])
-                vp = vp.at[phys, off].set(vc[rows, lens])
+                kp = kp.at[phys, off].set(kc[rows, pos_g])
+                vp = vp.at[phys, off].set(vc[rows, pos_g])
                 new_pools.append((kp, vp))
             hidden = mdl.norm(x)
             if self.model.lm_head is None:
@@ -604,9 +642,31 @@ class BatchDecodeEngine:
                 self._admit_prefix_program(info["n_pfx"],
                                            info["tail_bucket"]),
                 donate_argnums=(1,))
+        if kind in ("draft_admit", "draft", "verify"):
+            if self.spec is None:
+                raise ValueError(
+                    f"program key {key!r} needs speculative decoding "
+                    "(draft=/spec_k=) armed on this engine")
+            if kind == "draft_admit":
+                return jax.jit(self.spec.draft_admit_impl,
+                               donate_argnums=(1,))
+            if kind == "draft":
+                return jax.jit(self.spec.draft_program(info["k"]),
+                               donate_argnums=(1,))
+            return jax.jit(self.spec.verify_program(info["k"]),
+                           donate_argnums=(1,))
         impl = (self._admit_paged_impl if self.kv_layout == "paged"
                 else self._admit_impl)
         return jax.jit(impl, donate_argnums=(1,))
+
+    def _program(self, key: str):
+        """Registry lookup with lazy build — the serve-path accessor the
+        spec chunk and draft admission share with warmup/bundles."""
+        fn = self._programs.get(key)
+        if fn is None:
+            fn = self._build_program(key)
+            self._programs[key] = fn
+        return fn
 
     def _decode_args(self) -> tuple:
         """THE decode program's argument tuple — shared by the serve path
@@ -645,6 +705,20 @@ class BatchDecodeEngine:
         kind, info = _cp.parse_key(key)
         if kind == "decode":
             return self._decode_args()
+        if kind == "draft_admit":
+            return (self.spec.draft_params, self.spec.draft_caches,
+                    self.spec.prev_tokens,
+                    jnp.zeros((1, info["bucket"]), jnp.int32),
+                    jnp.int32(1), jnp.int32(0))
+        if kind == "draft":
+            return (self.spec.draft_params, self.spec.draft_caches,
+                    self.spec.prev_tokens, self.tokens, self.lens,
+                    self.active)
+        if kind == "verify":
+            return (self.params, self.caches, self.page_table, self.lens,
+                    self.tokens, self.spec.prev_tokens, self.active,
+                    self.budgets, self.eos_ids,
+                    jnp.zeros((self.S, info["k"]), jnp.int32))
         width = (info["tail_bucket"] if kind == "prefix"
                  else info["bucket"])
         return self._admit_args(key, jnp.zeros((1, width), jnp.int32),
@@ -656,10 +730,19 @@ class BatchDecodeEngine:
         placeholders — treedefs carry structure only). Lets a bundle load
         reconstruct out_trees from the live engine instead of pickling
         treedefs with custom (QuantizedWeight) nodes."""
-        kind, _ = _cp.parse_key(key)
+        kind, info = _cp.parse_key(key)
         if kind == "decode":
             return (self.caches, self.tokens, self.lens, self.active,
                     self.budgets, self.key, jnp.int32(0))
+        if kind == "draft_admit":
+            return (self.spec.draft_caches, self.spec.prev_tokens)
+        if kind == "draft":
+            return (self.spec.draft_caches,
+                    jnp.zeros((self.S, info["k"]), jnp.int32))
+        if kind == "verify":
+            return (self.caches, self.lens, self.tokens,
+                    self.spec.prev_tokens, self.active, self.budgets,
+                    jnp.zeros((self.S, info["k"] + 3), jnp.int32))
         return (self.caches, self.lens, self.tokens, self.active,
                 self.temps, self.eos_ids, self.budgets, self.top_ks,
                 self.key, jnp.int32(0))
@@ -695,10 +778,13 @@ class BatchDecodeEngine:
                     continue
                 compiled = None
                 kind, info = _cp.parse_key(key)
-                if perf_on and kind != "decode":
+                if perf_on and kind in ("admit", "prefix"):
                     # same capture the lazy path does: the Compiled
                     # replaces the jit entry, one compile total, exact
-                    # costs recorded
+                    # costs recorded. Only the TARGET admission kinds:
+                    # draft_admit under "serving.admit" would collide
+                    # with the target's bucket label in the cost
+                    # registry, and draft/verify keys carry no bucket
                     bucket = (f"pfx{info['n_pfx']}t{info['tail_bucket']}"
                               if kind == "prefix" else f"p{info['bucket']}")
                     compiled = p.capture_jit(
@@ -748,6 +834,12 @@ class BatchDecodeEngine:
             firsts = [jnp.int32(0)] * self.S
             for k in range(1, self.S + 1):
                 np.asarray(jnp.stack(firsts[:k]))
+            if self.spec is not None and self._spec_steps_per_chunk > 1:
+                # the spec chunk's payload concat is the one host-level op
+                # its serve path adds — flush its ~ms compile here too
+                parts = [jnp.zeros((self.S, self.spec.k + 3), jnp.int32)
+                         ] * self._spec_steps_per_chunk
+                np.asarray(jnp.concatenate(parts, axis=1))
         except Exception:
             pass          # best-effort: a miss here costs ms, not minutes
 
@@ -897,6 +989,12 @@ class BatchDecodeEngine:
                 f"top_k {top_k} exceeds the continuous engine's static "
                 f"filter cap {self.TOP_K_CAP} (use the static serving mode "
                 "or lower top_k)")
+        if self.spec is not None and temp > 0.0:
+            raise ValueError(
+                f"temperature {temp:g} with speculative decoding armed: "
+                "greedy acceptance is token-exact for temperature 0 only "
+                "(sampling-correct rejection resampling is a planned "
+                "seam) — send temperature=0 or serve without spec_k")
         aligned = n_pfx = 0
         h = entry = None
         if self.kv_layout == "paged":
@@ -977,6 +1075,26 @@ class BatchDecodeEngine:
             self.prefix.misses += 1
             self._slot_pages[slot] = self._slot_pages[slot][n_pfx:]
             self._slot_prefix[slot] = h
+        if self.spec is not None:
+            # draft prefill rides every admission: the draft keeps no
+            # prefix cache, so it prefills the FULL prompt at the plain
+            # bucket even when the target admission was a prefix HIT
+            dpad = np.zeros((1, bucket), np.int32)
+            dpad[0, :plen] = ids
+            dkey = _cp.draft_admit_key(bucket)
+            try:
+                (self.spec.draft_caches, self.spec.prev_tokens) = \
+                    self._program(dkey)(
+                        self.spec.draft_params, self.spec.draft_caches,
+                        self.spec.prev_tokens, jnp.asarray(dpad),
+                        jnp.int32(plen), jnp.int32(slot))
+            except BaseException:
+                # the target-side admission already committed: deactivate
+                # the device lane and return the pages, or a failed draft
+                # prefill leaks the whole reservation
+                self.reset_slots([slot])
+                raise
+            self._warmed.add(dkey)
         self._host_slots[slot] = _Slot(req, budget=int(req.max_new_tokens))
         self.stats["peak_busy"] = max(self.stats["peak_busy"],
                                       self.busy_slots())
@@ -1016,6 +1134,12 @@ class BatchDecodeEngine:
             if eos is not None and eos in gen:
                 gen = gen[: gen.index(eos) + 1]   # trim past eos, keep it
             _stamp(s.req, "_n_new", len(gen))
+            if self.spec is not None:
+                # accepted counts ride the result future so slo()
+                # consumers and benches can report tokens-per-target-step
+                # per request, not just engine-wide
+                _stamp(s.req, "_spec_steps", s.spec_steps)
+                _stamp(s.req, "_spec_accepted", s.spec_accepted)
             s.req.result._set(output=np.concatenate(
                 [prompt, np.asarray(gen, np.int32)]))
         self._release_kv(slot)
@@ -1023,12 +1147,15 @@ class BatchDecodeEngine:
 
     def _collect_firsts(self):
         """ONE host sync for every first token admitted since the last
-        collect (stacked on device, then a single transfer)."""
+        collect (stacked on device, then a single transfer). Returns the
+        slots whose ``_t_first`` was stamped by THIS collect — the spec
+        chunk uses it to count tokens that landed at the same sync."""
         if not self._first_pending:
-            return
+            return []
         slots = sorted(self._first_pending)
         vals = np.asarray(jnp.stack([self._first_pending[i] for i in slots]))
         now = time.perf_counter()
+        stamped = []
         for i, slot in enumerate(slots):
             s = self._host_slots[slot]
             if s.req is not None:
@@ -1038,7 +1165,9 @@ class BatchDecodeEngine:
                 # honest first-token time (TTFT numerator)
                 if getattr(s.req.result, "_t_first", 1) is None:
                     _stamp(s.req, "_t_first", now)
+                    stamped.append(slot)
         self._first_pending.clear()
+        return stamped
 
     def reset_slots(self, slots=None):
         """Deactivate device-side slot state (all slots, or the given list)
@@ -1073,7 +1202,66 @@ class BatchDecodeEngine:
         """Host-visible count of slots holding an in-flight request."""
         return sum(1 for s in self._host_slots if s.req is not None)
 
+    def _spec_chunk(self):
+        """The speculative serve step: per outer step, ONE draft program
+        call (k greedy proposals) then ONE verify call (batched target
+        forward + masked accept/reject); the chunk's payloads stay on
+        device and sync to the host as a single transfer, exactly the
+        non-spec chunk's cadence. Rejected tokens cost nothing to roll
+        back — ``lens`` simply didn't advance past them."""
+        spec = self.spec
+        k = spec.k
+        steps = self._spec_steps_per_chunk
+        dkey, vkey = _cp.draft_key(k), _cp.verify_key(k)
+        dfn = self._program(dkey)
+        vfn = self._program(vkey)
+        parts = []
+        for _ in range(steps):
+            spec.draft_caches, props = dfn(
+                spec.draft_params, spec.draft_caches, spec.prev_tokens,
+                self.tokens, self.lens, self.active)
+            (self.caches, self.lens, self.tokens, spec.prev_tokens,
+             self.active, self.budgets, payload) = vfn(
+                self.params, self.caches, self.page_table, self.lens,
+                self.tokens, spec.prev_tokens, self.active, self.budgets,
+                self.eos_ids, props)
+            parts.append(payload)
+        # post-success, exactly like the non-spec chunk: a failed first
+        # call must not mask these keys from a later warmup()
+        self._warmed.add(dkey)
+        self._warmed.add(vkey)
+        self.stats["decode_calls"] += 1
+        stamped = self._collect_firsts()
+        pk = np.asarray(parts[0] if steps == 1
+                        else jnp.concatenate(parts, axis=1))
+        blocks = pk.reshape(self.S, steps, k + 3)
+        em = blocks[:, :, : k + 1]           # emitted tokens, -1 padded
+        acc = blocks[:, :, k + 1]            # raw accepted-run lengths
+        act = blocks[:, -1, k + 2].astype(bool)
+        chunk_emitted = 0
+        for slot, s in enumerate(self._host_slots):
+            if s.req is None:
+                continue
+            toks = [int(t) for t in em[slot].ravel() if t >= 0]
+            s.emitted.extend(toks)
+            chunk_emitted += len(toks)
+            self.stats["tokens_out"] += len(toks)
+            live = acc[slot][acc[slot] >= 0]
+            s.spec_steps += int(live.size)
+            s.spec_accepted += int(live.sum())
+            if slot in stamped and toks:
+                # this sync delivered the admission's first token AND the
+                # chunk's tokens at the same instant — record how many, so
+                # slo()'s TPOT divides by tokens that arrived AFTER
+                # _t_first instead of fabricating a k-times-faster stream
+                _stamp(s.req, "_n_at_first", 1 + len(toks))
+            if not act[slot] or len(s.emitted) >= s.budget:
+                self._retire(slot)
+        spec.record_chunk(acc, chunk_emitted)
+
     def _decode_chunk(self):
+        if self.spec is not None:
+            return self._spec_chunk()
         args = self._decode_args()
         p = _perf()
         perf_on = p is not None and p.enabled()

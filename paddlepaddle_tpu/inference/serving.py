@@ -139,6 +139,11 @@ class GenerationResult:
         self._t_first: Optional[float] = None     # first token on host
         self._t_done: Optional[float] = None
         self._n_new = 0                           # tokens generated
+        self._n_at_first = 1     # tokens already delivered at _t_first: 1
+        #   on the one-token-per-step path (bit-identical TPOT), stamped
+        #   higher by multi-token (speculative) engines whose first host
+        #   sync lands a burst — TPOT must divide by tokens that arrived
+        #   AFTER _t_first, not assume one token per decode chunk
         self._req_id: Optional[int] = None
         self._deadline: Optional[float] = None    # absolute monotonic
         self._streaming = True                    # False: tokens arrive as
@@ -207,8 +212,10 @@ class GenerationResult:
             "ttft_s": (None if t_first is None
                        else t_first - self._t_submit),
             "tpot_s": (None if (t_first is None or end is None
-                                or self._n_new < 2 or not self._streaming)
-                       else (end - t_first) / (self._n_new - 1)),
+                                or self._n_new <= self._n_at_first
+                                or not self._streaming)
+                       else (end - t_first)
+                       / (self._n_new - self._n_at_first)),
             "latency_s": None if end is None else end - self._t_submit,
         }
 
@@ -353,9 +360,17 @@ class ServingEngine:
                  prefix_cache: bool = True,
                  mesh=None,
                  plan=None,
-                 bundle: Optional[str] = None):
+                 bundle: Optional[str] = None,
+                 draft=None,
+                 spec_k: int = 0,
+                 draft_quant: Optional[str] = None):
         if mode not in ("continuous", "static"):
             raise ValueError(f"mode must be 'continuous' or 'static', got {mode!r}")
+        if (draft is not None or spec_k) and mode != "continuous":
+            raise ValueError(
+                "speculative decoding (draft=/spec_k=) requires the "
+                "continuous engine — static mode decodes through the "
+                "model's own generate_cached")
         if bundle is not None and mode != "continuous":
             raise ValueError(
                 "bundle= requires the continuous engine (static mode "
@@ -426,7 +441,11 @@ class ServingEngine:
                 quant_group_size=quant_group_size, kv_layout=kv_layout,
                 page_size=kv_page_size, num_pages=kv_num_pages,
                 prefix_cache=prefix_cache, mesh=mesh, plan=plan,
-                bundle=bundle)
+                bundle=bundle, draft=draft, spec_k=spec_k,
+                draft_quant=draft_quant)
+            self._spec_enabled = self._engine.spec is not None
+            if self._spec_enabled:
+                self._announce_spec()
             self._max_len = self._engine.L
             self._top_k_cap = self._engine.TOP_K_CAP
             # page-pool capacity admission facts (None = contiguous): a
@@ -464,6 +483,7 @@ class ServingEngine:
             self._top_k_cap = None
             self._kv_page_size = None
             self._kv_capacity = None
+            self._spec_enabled = False
 
     def _bump(self, key, n=1):
         with self._stats_lock:
@@ -498,6 +518,25 @@ class ServingEngine:
             f"{len(meta.get('quantized', ()))} weights, "
             f"{meta.get('bytes_saved', 0) / 1e6:.1f} MB HBM reads saved "
             "per full weight pass\n")
+
+    def _announce_spec(self) -> None:
+        """One-time (construction, cold path) observability for
+        speculative decoding: gauges + a stderr line. The flight-recorder
+        ``serving_spec`` header annotation (draft arch, k, live
+        acceptance at dump time) is installed by the decoder itself. With
+        speculation off none of this runs — the off path stays
+        zero-overhead."""
+        spec = self._engine.spec
+        draft = spec.describe_draft()
+        _safe_set("paddle_serving_spec_enabled",
+                  "speculative decoding armed (1 = on)", 1,
+                  k=spec.k, draft_quant=spec.draft_quant or "off")
+        _safe_set("paddle_serving_spec_k",
+                  "draft proposals per speculative target step", spec.k)
+        sys.stderr.write(
+            f"[serving] speculative decoding armed: k={spec.k}, draft "
+            f"{draft['params_m']}M params ({draft['hidden_size']}h x "
+            f"{draft['num_hidden_layers']}L, quant {draft['quant']})\n")
 
     # -- admission control ---------------------------------------------------
     def _on_breaker_transition(self, old: str, new: str) -> None:
@@ -561,6 +600,13 @@ class ServingEngine:
                 f"top_k {req.top_k} exceeds the continuous engine's static "
                 f"filter cap {self._top_k_cap} (use the static "
                 "serving mode or lower top_k)")
+        if self._spec_enabled and req.temperature > 0.0:
+            raise RequestValidationError(
+                f"temperature {req.temperature:g} with speculative "
+                "decoding armed: greedy acceptance is token-exact for "
+                "temperature 0 only (sampling-correct rejection "
+                "resampling is a planned seam) — send temperature=0 or "
+                "serve this engine without spec_k")
         if req.prefix_len is not None and not (
                 0 <= req.prefix_len <= req.prompt_ids.shape[1]):
             raise RequestValidationError(
@@ -713,6 +759,11 @@ class ServingEngine:
             "mode": self.mode,
             "quant": self.quant or "off",
             "kv": kv,
+            # speculative decoding: draft config, k, live acceptance rate
+            # and tokens-per-target-step — what a deploy watches to know
+            # the speculation is actually paying for its draft overhead
+            "spec": (self._engine.spec_info() if self._engine is not None
+                     else {"enabled": False}),
             # replica parallelism for the fleet router / /metrics: mesh
             # axes+devices and the tp degree this engine decodes at
             "mesh": mesh,

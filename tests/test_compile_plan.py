@@ -207,10 +207,30 @@ def test_compile_cache_hits_and_watchdog_labels(model, tmp_path):
         assert any(e.get("planned") == "warmup" for e in log)
         outs = _serve(e2, _reqs(n=1))
         assert len(outs[0]) == 11
+        # a bundle saved from the HIT engine must load back: e2's
+        # executables are cache-DESERIALIZED, and re-serializing those
+        # yields payloads with no kernel object code on this jaxlib's
+        # CPU backend ("Symbols not found" at load). save_bundle probes
+        # every payload and recompiles for real, cache detached
+        hit_path = str(tmp_path / "hit_bundle")
+        e2.save_serving_bundle(hit_path)
+        e3 = BatchDecodeEngine(model, max_slots=2, chunk=4, page_size=16,
+                               bundle=hit_path)
+        assert e3._bundle_info["loaded"] is True, \
+            e3._bundle_info.get("error")
     finally:
         compile_cache.uninstall()
         watchdog.set_storm_callback(None)
     assert compile_cache.stats()["enabled"] is False
+    # uninstall must DETACH, not just stop counting: jax latches its
+    # cache handle + "cache used" decision at the first compile, and a
+    # stale latch keeps the old directory serving hits and absorbing
+    # writes for the rest of the process (the ordering bug that poisoned
+    # later engines' bundle saves with cache-deserialized executables)
+    from jax._src import compilation_cache as _jcc
+
+    assert _jcc._cache is None, \
+        "uninstall left jax's latched persistent-cache handle live"
 
 
 def test_compile_cache_flag_family():

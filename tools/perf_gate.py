@@ -130,6 +130,15 @@ def serving_metrics(doc: dict) -> Dict[str, Tuple[float, str]]:
     # fleet-router column (serving_bench --replicas N): completed/submitted
     # under the workload — the availability the failover path defends
     put("serving.availability", body.get("availability"), HIGHER)
+    # speculative column (serving_bench --spec-k N): gate the throughput;
+    # the acceptance rate is a DRAFT-QUALITY number, not an engine-perf
+    # number (a better-trained draft raises it, an engine change cannot),
+    # so it is reported informationally by main(), never gated
+    spec = body.get("spec")
+    if isinstance(spec, dict):
+        put("serving.spec_tok_s", spec.get("aggregate_tok_s"), HIGHER)
+        put("serving.spec_ttft_p50_ms", spec.get("ttft_p50_ms"), LOWER)
+        put("serving.spec_tpot_ms", spec.get("tpot_ms"), LOWER)
     # tensor-parallel column (serving_bench --tp N): throughput up, TTFT/
     # TPOT down — a plan change that tanks the tp engine must not pass
     tp = body.get("tp")
@@ -246,15 +255,23 @@ def main(argv=None) -> int:
 
     if args.serving:
         try:
-            scur = serving_metrics(load_record(args.serving[0]))
-            sbase = serving_metrics(load_record(args.serving[1]))
+            rec_cur = load_record(args.serving[0])
+            rec_base = load_record(args.serving[1])
         except (OSError, ValueError) as e:
             sys.stderr.write(f"[perf_gate] serving: {e}\n")
             return 2
-        sfail, slines = compare(sbase, scur, args.tol, args.tol_latency)
+        sfail, slines = compare(serving_metrics(rec_base),
+                                serving_metrics(rec_cur),
+                                args.tol, args.tol_latency)
         failures += sfail
         print(f"[perf_gate] serving: {args.serving[0]} vs {args.serving[1]}")
         print("\n".join(slines))
+        for label, rec in (("cur", rec_cur), ("base", rec_base)):
+            sb = rec.get("serving_bench") or rec
+            rate = sb.get("spec_acceptance_rate")
+            if rate is not None:
+                print(f"[perf_gate] info: spec_acceptance_rate[{label}]="
+                      f"{rate} (informational — draft quality, not gated)")
 
     regressions = [n for kind, n in failures if kind == "regression"]
     missing = [n for kind, n in failures if kind == "missing"]

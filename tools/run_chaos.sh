@@ -13,6 +13,12 @@
 #     the PAGED KV pool — the default — and test_paged_kv.py adds the
 #     paged-specific drill: failed slots return their pages and the
 #     shared-prefix cache survives the storm)
+#   * speculative decoding: a serving.decode fault storm lands MID-
+#     SPECULATION (draft proposals in flight) — every future resolves
+#     typed, the breaker opens and recovers, every speculated page
+#     returns to the pool, and the post-recovery output is still
+#     token-exact (test_speculative.py::
+#     test_chaos_decode_storm_mid_speculation)
 #   * fleet router: 3 replicas under a mixed workload, a serving.decode
 #     fault storm + one replica killed mid-decode — every future resolves
 #     (completed or typed, zero silently lost), the fleet keeps serving,

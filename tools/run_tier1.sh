@@ -28,6 +28,16 @@
 # perf_gate's coldstart.* lower-is-better metrics and BASELINE.md; use
 # --preset tiny as the quick smoke).
 #
+# Speculative-decoding suite: tests/test_speculative.py runs its fast
+# half here (token-exact greedy parity weak-draft + self-draft, rollback
+# page accounting, cancel mid-speculation, warmup -> compile-free serve
+# window with spec programs, bundle round trip + draft-swap fingerprint
+# fallback, honest multi-token TPOT); the int8-draft and k-sweep parity
+# variants are `slow`-marked and the breaker-storm drill is
+# `chaos`-marked (tools/run_chaos.sh). The A/B artifact comes from
+# `python tools/serving_bench.py --spec-k N --draft <preset>` (gated by
+# perf_gate's serving.spec_tok_s; BASELINE.md "Speculative decoding").
+#
 # Perf regression gate (not run here — needs a bench artifact): after a
 # bench run, `python tools/perf_gate.py --baseline BENCH_r05.json
 # --current <new>.json` exits nonzero on a tokens/s / MFU / TTFT

@@ -14,6 +14,20 @@ The BASELINE.md serving card. Three workload profiles:
   engine's prompt cache turns N prefills into 1 prefill + N tails.
   Reported against a control run with the cache disabled (TTFT delta).
 
+``--spec-k N --draft <preset>`` adds a SPECULATIVE row beside the plain
+one: the same workload through an engine where a draft model proposes N
+greedy tokens per slot and one batched target forward verifies them
+(docs/serving.md "Speculative decoding"). The row carries tokens/s,
+TTFT/TPOT, the measured acceptance rate, accepted-run-length p50/p99 and
+tokens-per-target-step; ``tools/perf_gate.py`` gates
+``serving.spec_tok_s`` higher-is-better (acceptance rate rides along as
+an informational column). Draft presets: ``self`` (the target itself —
+acceptance 1.0, the amortization upper bound and the CPU plumbing
+smoke), ``half``/``quarter`` (a fresh model at that fraction of the
+target's width — RANDOM weights, so acceptance ~0 on this harness; on
+real checkpoints this is where the distilled draft goes). ``--draft-
+quant`` serves the draft weight-only int8.
+
 ``--replicas N`` routes the same profiles through the
 :class:`~paddlepaddle_tpu.inference.router.ServingRouter` over N replica
 engines instead of one: the report adds per-replica tokens/s, the fleet
@@ -110,17 +124,48 @@ def warm_engine(eng, model, prompts, args, prefix_cache=True):
         pfx.hits = pfx.misses = pfx.evictions = 0
 
 
+def build_draft(args, model):
+    """Resolve the --draft preset into the engine's ``draft=`` argument:
+    the target itself for ``self``, else a scaled-down CONFIG — the
+    engine's ``resolve_draft`` builds the model and widens its rope
+    tables to ``max_len + k``, the seam a real distilled-draft config
+    would take."""
+    from paddlepaddle_tpu.models import LlamaConfig
+
+    if args.draft == "self":
+        return model
+    frac = {"half": 2, "quarter": 4}[args.draft]
+    cfg = model.config
+    hidden = max(cfg.hidden_size // frac, 64)
+    return LlamaConfig(
+        vocab_size=cfg.vocab_size, hidden_size=hidden,
+        intermediate_size=hidden * 4,
+        num_hidden_layers=max(cfg.num_hidden_layers // frac, 2),
+        num_attention_heads=max(hidden // 64, 4),
+        num_key_value_heads=max(hidden // 128, 2),
+        max_position_embeddings=cfg.max_position_embeddings,
+        dtype=cfg.dtype)
+
+
 def run_serving(model, prompts, args, kv_layout, slots, num_pages=None,
-                prefix_cache=True, warm=True, tp=1):
+                prefix_cache=True, warm=True, tp=1, spec=False):
     """One engine pass over the workload; returns the metrics row.
     ``tp > 1`` serves through a tensor-parallel engine (sharding plan over
     an ``mp``-axis mesh: weights column/row-parallel, KV pool sharded on
-    kv heads — docs/distributed.md)."""
+    kv heads — docs/distributed.md). ``spec=True`` arms speculative
+    decoding from the --spec-k/--draft args and adds the acceptance
+    columns."""
+    spec_kw = {}
+    if spec:
+        spec_kw = dict(draft=build_draft(args, model), spec_k=args.spec_k,
+                       draft_quant=("weight_only_int8" if args.draft_quant
+                                    else None))
     with ServingEngine(model, max_batch_size=slots,
                        decode_chunk=args.chunk, kv_layout=kv_layout,
                        kv_page_size=args.page_size, kv_num_pages=num_pages,
                        prefix_cache=prefix_cache,
-                       mesh=(f"mp{tp}" if tp > 1 else None)) as eng:
+                       mesh=(f"mp{tp}" if tp > 1 else None),
+                       **spec_kw) as eng:
         if warm:
             warm_engine(eng, model, prompts, args, prefix_cache)
         if eng._engine.kv_layout == "paged":
@@ -134,6 +179,7 @@ def run_serving(model, prompts, args, kv_layout, slots, num_pages=None,
         dt = time.perf_counter() - t0
         kv = eng._engine.kv_stats()
         peak_busy = eng._engine.stats["peak_busy"]
+        spec_info = eng._engine.spec_info() if spec else None
     new_tokens = sum(len(o) - len(p) for o, (p, _) in zip(outs, prompts))
     row = {"kv_layout": kv_layout, "slots": slots,
            "aggregate_tok_s": round(new_tokens / max(dt, 1e-9), 1),
@@ -151,6 +197,16 @@ def run_serving(model, prompts, args, kv_layout, slots, num_pages=None,
         row["prefix_hit_rate"] = (round(pfx["hits"] / looked, 4)
                                   if looked else None)
         row["prefix_evictions"] = pfx["evictions"]
+    if spec_info is not None:
+        row["spec_k"] = spec_info["k"]
+        row["draft"] = args.draft
+        row["draft_params_m"] = spec_info["draft"]["params_m"]
+        row["draft_quant"] = spec_info["draft"]["quant"]
+        row["acceptance_rate"] = spec_info["acceptance_rate"]
+        row["tokens_per_target_step"] = spec_info["tokens_per_target_step"]
+        row["accept_run_p50"] = spec_info["accept_run_p50"]
+        row["accept_run_p99"] = spec_info["accept_run_p99"]
+        row["rollbacks"] = spec_info["rollbacks"]
     return row
 
 
@@ -260,6 +316,14 @@ def fmt(row, label):
     print(f"{'':<22} SLO: ttft p50={row['ttft_p50_ms']}ms "
           f"p99={row['ttft_p99_ms']}ms  tpot={row['tpot_ms']}ms/token  "
           f"queue_wait p99={row['queue_wait_p99_ms']}ms", flush=True)
+    if "spec_k" in row:
+        print(f"{'':<22} spec: k={row['spec_k']} draft={row['draft']} "
+              f"({row['draft_params_m']}M, {row['draft_quant']})  "
+              f"acceptance={row['acceptance_rate']}  "
+              f"tok/target-step={row['tokens_per_target_step']}  "
+              f"run p50/p99={row['accept_run_p50']}/"
+              f"{row['accept_run_p99']}  rollbacks={row['rollbacks']}",
+              flush=True)
 
 
 def main():
@@ -294,6 +358,20 @@ def main():
                     "report its tok/s + TTFT beside the 1-chip row; needs "
                     "N visible devices (CPU: XLA_FLAGS="
                     "--xla_force_host_platform_device_count=N)")
+    ap.add_argument("--spec-k", type=int, default=0,
+                    help="arm speculative decoding with N draft proposals "
+                    "per target step and report an A/B row beside the "
+                    "plain engine (tok/s, TTFT/TPOT, acceptance rate, "
+                    "accepted-run-length p50/p99)")
+    ap.add_argument("--draft", choices=("self", "half", "quarter"),
+                    default="quarter",
+                    help="draft preset: 'self' = the target model itself "
+                    "(acceptance 1.0 — the amortization upper bound), "
+                    "'half'/'quarter' = fresh models at that fraction of "
+                    "the target width (random weights: the overhead "
+                    "lower bound on this harness)")
+    ap.add_argument("--draft-quant", action="store_true",
+                    help="serve the draft weight-only int8")
     ap.add_argument("--hidden", type=int, default=1024)
     ap.add_argument("--layers", type=int, default=12)
     ap.add_argument("--max-len", type=int, default=2048)
@@ -358,6 +436,21 @@ def main():
         body.update(row)
         print(f"({row['aggregate_tok_s'] / max(single_tps, 1e-9):.1f}x "
               "single-sequence)")
+
+    if args.spec_k > 0:
+        if args.ab or args.replicas > 1 or args.tp > 1:
+            ap.error("--spec-k A/Bs one engine against its speculative "
+                     "form; run it without --ab/--replicas/--tp")
+        spec_row = run_serving(model, prompts, args, args.kv_layout,
+                               args.slots, num_pages=args.num_pages,
+                               spec=True)
+        fmt(spec_row, f"spec k={args.spec_k} x{args.slots}")
+        base = body["aggregate_tok_s"]
+        print(f"({spec_row['aggregate_tok_s'] / max(base, 1e-9):.2f}x the "
+              "non-speculative row)")
+        body["spec"] = spec_row
+        body["spec_tok_s"] = spec_row["aggregate_tok_s"]
+        body["spec_acceptance_rate"] = spec_row["acceptance_rate"]
 
     if args.tp > 1:
         # tensor-parallel column: same workload through a plan-sharded
