@@ -140,6 +140,18 @@ define_flag("obs_blackbox_dir", "",
 define_flag("obs_blackbox_events", 2048,
             "flight recorder ring capacity (structured events)",
             env="PADDLE_OBS_BLACKBOX_EVENTS")
+define_flag("obs_reqtrace", False,
+            "arm request-journey tracing (observability/reqtrace.py): one "
+            "stitched trace per serving request — router pick, failover "
+            "attempts, queue wait, paged admission, decode chunks, "
+            "speculative rounds — served at /requests and by obsctl "
+            "requests", env="PADDLE_OBS_REQTRACE")
+define_flag("obs_reqtrace_ring", 256,
+            "completed request journeys kept in the bounded reqtrace ring",
+            env="PADDLE_OBS_REQTRACE_RING")
+define_flag("obs_reqtrace_spans", 256,
+            "span cap per request journey (overflow counts dropped_spans "
+            "instead of growing)", env="PADDLE_OBS_REQTRACE_SPANS")
 define_flag("obs_perf", False,
             "arm the performance-attribution plane (observability/perf/): "
             "capture XLA cost_analysis FLOPs/bytes per compiled program "
@@ -163,6 +175,26 @@ define_flag("compile_cache_min_compile_secs", 0.0,
             "cache (0 = persist everything; raise it where cache I/O costs "
             "more than small recompiles)",
             env="PADDLE_COMPILE_CACHE_MIN_SECS")
+
+# SLO targets (observability/reqtrace.py burn tracker): sliding-window
+# violation rates against these targets surface as paddle_slo_burn_{ttft,
+# tpot} gauges and the health() "slo_burn" block — the input signal of the
+# SLO-driven autoscaler control loop (ROADMAP item 5). 0 = target off.
+define_flag("slo_ttft_ms", 0.0,
+            "TTFT SLO target in milliseconds; nonzero arms the sliding-"
+            "window burn-rate gauge paddle_slo_burn_ttft",
+            env="PADDLE_SLO_TTFT_MS")
+define_flag("slo_tpot_ms", 0.0,
+            "TPOT SLO target in milliseconds; nonzero arms the sliding-"
+            "window burn-rate gauge paddle_slo_burn_tpot",
+            env="PADDLE_SLO_TPOT_MS")
+define_flag("slo_burn_window_s", 60.0,
+            "sliding window (seconds) the SLO burn rate is computed over",
+            env="PADDLE_SLO_BURN_WINDOW_S")
+define_flag("slo_error_budget", 0.01,
+            "allowed SLO violation fraction; burn = violation_rate / "
+            "budget (1.0 = spending the budget exactly as it accrues)",
+            env="PADDLE_SLO_ERROR_BUDGET")
 
 # Resilience family (resilience/): checkpoint integrity verification; the
 # chaos engine reads its PADDLE_CHAOS_* env vars directly (lazily at the

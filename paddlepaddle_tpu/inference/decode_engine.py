@@ -108,6 +108,13 @@ def _expected_compiles(label: str):
         return contextlib.nullcontext()
 
 
+def _trace_of(req):
+    """The request's journey (observability.reqtrace), or None — the off
+    path and engine-shaped foreign request objects (benches, tests)
+    without a GenerationResult cost one getattr chain here."""
+    return getattr(getattr(req, "result", None), "_trace", None)
+
+
 def _stamp(req, attr: str, value=None) -> None:
     """Best-effort SLO timestamp on the request's result future —
     engine-shaped foreign request objects (tests, benches) without a
@@ -997,6 +1004,7 @@ class BatchDecodeEngine:
                 "seam) — send temperature=0 or serve without spec_k")
         aligned = n_pfx = 0
         h = entry = None
+        pages_reserved = None
         if self.kv_layout == "paged":
             aligned, n_pfx, h, entry = self._prefix_plan(req, ids, plen)
             hit = entry is not None
@@ -1005,6 +1013,7 @@ class BatchDecodeEngine:
                                           exclude=h if hit else None)
             if private is None:
                 return False          # pool dry: decode frees pages later
+            pages_reserved = len(private)
             self._slot_pages[slot] = private
             row = np.zeros((self.P,), np.int32)
             if hit:
@@ -1099,6 +1108,22 @@ class BatchDecodeEngine:
         self.stats["peak_busy"] = max(self.stats["peak_busy"],
                                       self.busy_slots())
         _stamp(req, "_t_admit")
+        tr = _trace_of(req)
+        if tr is not None:
+            try:
+                res = req.result
+                tr.event("queue.wait", t0=res._t_submit, t1=res._t_admit)
+                tr.event(
+                    "admit", slot=slot, bucket=bucket, plen=plen,
+                    **({} if pages_reserved is None
+                       else {"pages": pages_reserved}),
+                    **({} if h is None
+                       else {"prefix": "hit" if entry is not None
+                             else "miss", "prefix_pages": n_pfx}))
+                if self.spec is not None:
+                    tr.event("spec.draft_prefill", bucket=bucket)
+            except Exception:
+                pass
         _flight_record("request", str(getattr(req, "id", "?")),
                        phase="admit", slot=slot, bucket=bucket, plen=plen,
                        **({"prefix_hit": entry is not None} if h else {}))
@@ -1166,6 +1191,9 @@ class BatchDecodeEngine:
                 if getattr(s.req.result, "_t_first", 1) is None:
                     _stamp(s.req, "_t_first", now)
                     stamped.append(slot)
+                    tr = _trace_of(s.req)
+                    if tr is not None:
+                        tr.event("first_token", t0=now)
         self._first_pending.clear()
         return stamped
 
@@ -1212,6 +1240,7 @@ class BatchDecodeEngine:
         spec = self.spec
         k = spec.k
         steps = self._spec_steps_per_chunk
+        t0 = time.perf_counter()
         dkey, vkey = _cp.draft_key(k), _cp.verify_key(k)
         dfn = self._program(dkey)
         vfn = self._program(vkey)
@@ -1249,6 +1278,10 @@ class BatchDecodeEngine:
             live = acc[slot][acc[slot] >= 0]
             s.spec_steps += int(live.size)
             s.spec_accepted += int(live.sum())
+            tr = _trace_of(s.req)
+            if tr is not None and live.size:
+                tr.event("spec.round", t0=t0, t1=time.perf_counter(),
+                         tokens=len(toks), **spec.round_summary(acc[slot]))
             if slot in stamped and toks:
                 # this sync delivered the admission's first token AND the
                 # chunk's tokens at the same instant — record how many, so
@@ -1298,12 +1331,19 @@ class BatchDecodeEngine:
             p.observe("serving.decode", time.perf_counter() - t0,
                       bucket=f"s{self.S}c{self.chunk}")
         em, act = pk[:, :-1], pk[:, -1].astype(bool)
+        t_sync = None
         for slot, s in enumerate(self._host_slots):
             if s.req is None:
                 continue
             toks = [int(t) for t in em[slot] if t >= 0]
             s.emitted.extend(toks)
             self.stats["tokens_out"] += len(toks)
+            tr = _trace_of(s.req)
+            if tr is not None and toks:
+                if t_sync is None:
+                    t_sync = time.perf_counter()
+                tr.event("decode.chunk", t0=t0, t1=t_sync,
+                         tokens=len(toks))
             if not act[slot] or len(s.emitted) >= s.budget:
                 self._retire(slot)
 

@@ -189,7 +189,7 @@ class _Replica:
     in-flight count, last health snapshot."""
 
     __slots__ = ("name", "client", "breaker", "in_rotation", "inflight",
-                 "snapshot")
+                 "snapshot", "no_trace")
 
     def __init__(self, name: str, client: ReplicaClient,
                  breaker: CircuitBreaker):
@@ -199,13 +199,17 @@ class _Replica:
         self.in_rotation = True      # False only during rolling restart
         self.inflight = 0            # router-submitted, not yet resolved
         self.snapshot: Optional[Dict[str, object]] = None
+        self.no_trace = False        # client rejected the trace kwarg (a
+        #   remote implementation of the seam predating request-journey
+        #   tracing): submits to it go out without the journey
 
 
 class _Pending:
     """One router request across its (re)submission attempts."""
 
     __slots__ = ("prompt_ids", "kw", "future", "deadline", "prefix_key",
-                 "attempts", "tried", "last_error", "inner")
+                 "attempts", "tried", "last_error", "inner", "trace",
+                 "t_attempt")
 
     def __init__(self, prompt_ids, kw, future, deadline, prefix_key):
         self.prompt_ids = prompt_ids
@@ -217,6 +221,9 @@ class _Pending:
         self.tried: set = set()               # replica names this round
         self.last_error: Optional[BaseException] = None
         self.inner: Optional[GenerationResult] = None   # current replica fut
+        self.trace = None                     # reqtrace Journey, or None
+        self.t_attempt: Optional[float] = None  # current attempt's dispatch
+        #                                         stamp (perf_counter)
 
 
 class ServingRouter:
@@ -419,10 +426,16 @@ class ServingRouter:
     def _finish_ok(self, pend: _Pending, inner: GenerationResult) -> None:
         fut = pend.future
         # carry the replica future's SLO stamps so fleet-level slo_summary
-        # reports real TTFT/queue-wait (measured from ROUTER submit time)
+        # reports real TTFT/latency (measured from ROUTER submit time).
+        # Queue wait is PER ATTEMPT: the winning inner's own submit time
+        # becomes the wrapper's dispatch stamp, so a failed-over request
+        # reports the wait of the attempt that served it — not the first
+        # attempt's decode plus the backoff booked as "queue wait"
         fut._t_admit = inner._t_admit
         fut._t_first = inner._t_first
+        fut._t_dispatch = inner._t_submit
         fut._n_new = inner._n_new
+        fut._n_at_first = inner._n_at_first
         fut._streaming = inner._streaming
         self._bump("completed")
         fut._set(output=inner._output)
@@ -431,6 +444,20 @@ class ServingRouter:
                      sync: bool = False) -> None:
         self._bump("failed")
         if sync:
+            # the raise IS the delivery: the future is never set, so the
+            # journey must close here or it would sit in the in-flight
+            # map forever (one leak per refused request)
+            tr = pend.trace
+            if tr is not None:
+                try:
+                    from ..observability import reqtrace as _rt
+
+                    tr.event("router.reject", replica="router",
+                             error=f"{type(err).__name__}: {err}"[:200],
+                             retryable=False)
+                    _rt.finish_future(tr, pend.future, "rejected")
+                except Exception:
+                    pass
             raise err
         pend.future._set(error=err)
 
@@ -468,6 +495,10 @@ class ServingRouter:
             self._finish_fail(pend, err)
             return
         pend.tried.clear()
+        if pend.trace is not None:
+            pend.trace.event("router.backoff", replica="router",
+                             delay_s=round(delay, 4),
+                             after_attempt=pend.attempts)
         self._schedule(pend, delay)   # the retry counter ticks when the
         #                               resubmission actually dispatches
 
@@ -493,6 +524,16 @@ class ServingRouter:
                         "serve it"), sync)
                 return
             rep = self._pick(pend)
+            tr = pend.trace
+            if rep is not None and tr is not None:
+                try:
+                    cand = {r.name: (self._load_score(r)[0])
+                            for r in self._candidates(exclude=pend.tried)}
+                except Exception:
+                    cand = {}
+                tr.set_replica(rep.name)
+                tr.event("router.pick", replica=rep.name,
+                         attempt=pend.attempts + 1, candidates=cand)
             if rep is None:
                 # no candidate left this round: with no failure seen yet
                 # the whole fleet is out (typed FleetUnavailableError);
@@ -511,11 +552,43 @@ class ServingRouter:
                 _safe_inc("paddle_router_retries_total",
                           "request resubmissions performed by the router")
             kw = dict(pend.kw)
+            if rep.no_trace:
+                kw.pop("trace", None)
             if pend.deadline is not None:
                 kw["deadline_s"] = max(pend.deadline - now, 1e-3)
+            pend.t_attempt = time.perf_counter()
             try:
                 inner = rep.client.submit(pend.prompt_ids, **kw)
             except BaseException as e:  # noqa: BLE001 — classify below
+                if (isinstance(e, TypeError) and "trace" in kw
+                        and "trace" in f"{e}"):
+                    # a trace-unaware replica client choked on the
+                    # journey kwarg: remember, undo this pick's
+                    # bookkeeping, and retry — arming an observability
+                    # flag must never burn breaker evidence or take a
+                    # healthy fleet out of rotation
+                    rep.no_trace = True
+                    pend.attempts -= 1
+                    pend.tried.discard(rep.name)
+                    if tr is not None and tr.replicas \
+                            and tr.replicas[-1] == rep.name:
+                        tr.attempts -= 1
+                        tr.replicas.pop()
+                        for i in range(len(tr.spans) - 1, -1, -1):
+                            s = tr.spans[i]
+                            if (s.get("name") == "router.pick"
+                                    and s.get("replica") == rep.name):
+                                del tr.spans[i]
+                                break
+                    continue
+                if tr is not None:
+                    # submit-time refusal: breaker rejection, overload
+                    # backpressure, draining replica, dead connection —
+                    # each lands as its own span with the typed cause
+                    tr.event("router.reject", t0=pend.t_attempt,
+                             replica=rep.name,
+                             error=f"{type(e).__name__}: {e}"[:200],
+                             retryable=_retryable(e))
                 if _retryable(e):
                     if rep.in_rotation and not isinstance(
                             e, ServerOverloadedError):
@@ -561,6 +634,16 @@ class ServingRouter:
             rep.inflight = max(0, rep.inflight - 1)
         err = inner._error
         fut = pend.future
+        tr = pend.trace
+        if tr is not None and pend.t_attempt is not None:
+            # the attempt child span: dispatch -> inner resolution, tagged
+            # with the replica and (on failure) the typed cause — the
+            # stitched journey's failover evidence
+            tr.event("router.attempt", t0=pend.t_attempt,
+                     t1=time.perf_counter(), replica=rep.name,
+                     attempt=pend.attempts, ok=err is None,
+                     **({} if err is None else
+                        {"error": f"{type(err).__name__}: {err}"[:200]}))
         if fut.done():
             return                    # client cancelled the router future
         if err is None:
@@ -615,12 +698,35 @@ class ServingRouter:
         if prefix_len:
             arr = np.asarray(prompt_ids, np.int32).reshape(-1)
             prefix_key = arr[: int(prefix_len)].tobytes()
-        pend = _Pending(
-            prompt_ids,
-            {"max_new_tokens": max_new_tokens, "temperature": temperature,
-             "top_k": top_k, "eos_token_id": eos_token_id,
-             "prefix_len": prefix_len},
-            fut, deadline, prefix_key)
+        tr = None
+        try:
+            from ..observability import reqtrace as _rt
+
+            if _rt.enabled():
+                # the journey is minted HERE, at the fleet front door, and
+                # crosses the ReplicaClient seam as a submit kwarg — the
+                # wrapper future owns it (closes it on delivery); every
+                # replica-side stage stamps into the same object
+                tr = _rt.mint(fut._req_id)
+        except Exception:
+            tr = None
+        fut._trace = tr
+        fut._trace_owner = tr is not None
+        kw = {"max_new_tokens": max_new_tokens, "temperature": temperature,
+              "top_k": top_k, "eos_token_id": eos_token_id,
+              "prefix_len": prefix_len}
+        if tr is not None:
+            # only when tracing is armed: a foreign replica engine built
+            # before the trace kwarg existed keeps working with it off
+            kw["trace"] = tr
+        pend = _Pending(prompt_ids, kw, fut, deadline, prefix_key)
+        pend.trace = tr
+        if tr is not None:
+            arr = np.asarray(prompt_ids, np.int32).reshape(-1)
+            tr.event("submit", replica="router", prompt=int(arr.size),
+                     budget=int(max_new_tokens),
+                     **({} if deadline_s is None
+                        else {"deadline_s": float(deadline_s)}))
         self._bump("submitted")
         # a client cancel must reach the replica currently decoding it
         fut._add_done_callback(
@@ -660,11 +766,20 @@ class ServingRouter:
         alive = self._started and not self._stop.is_set()
         state = ("draining" if self._draining.is_set() and alive
                  else "serving" if alive else "stopped")
+        try:
+            from ..observability import reqtrace as _rt
+
+            slo_burn = _rt.burn_snapshot()
+        except Exception:
+            slo_burn = {"enabled": False}
         return {
             "state": state,
             "ok": alive and not self._draining.is_set() and healthy > 0,
             "router": {"replicas": len(self._replicas), "healthy": healthy,
                        **stats},
+            # fleet-level SLO burn rate (sliding window vs FLAGS_slo_*_ms
+            # targets): the autoscaler's scale-up/down input signal
+            "slo_burn": slo_burn,
             "replicas": reps,
         }
 

@@ -148,6 +148,13 @@ class GenerationResult:
         self._deadline: Optional[float] = None    # absolute monotonic
         self._streaming = True                    # False: tokens arrive as
         #                       one batch (static mode) — TPOT meaningless
+        self._trace = None       # reqtrace Journey riding this request
+        self._trace_owner = False  # True on the future whose _set closes
+        #   the journey (the router wrapper, or an engine-direct future);
+        #   replica-side inner futures carry the journey but never close it
+        self._t_dispatch: Optional[float] = None  # winning attempt's own
+        #   submit time (router failover): queue wait is measured per
+        #   attempt, not from the first submit across every retry
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -204,11 +211,17 @@ class GenerationResult:
         mode there is no streaming, so TTFT equals full latency."""
         end = self._t_done
         t_first = self._t_first
+        # queue wait is PER ATTEMPT: after a router failover the winning
+        # attempt's own submit time (_t_dispatch) is the base — measuring
+        # from the first submit would book the failed attempt's decode and
+        # the backoff as "queue wait". TTFT/latency stay client-relative.
+        t_base = (self._t_dispatch if self._t_dispatch is not None
+                  else self._t_submit)
         return {
             "req_id": self._req_id,
             "new_tokens": self._n_new,
             "queue_wait_s": (None if self._t_admit is None
-                             else self._t_admit - self._t_submit),
+                             else self._t_admit - t_base),
             "ttft_s": (None if t_first is None
                        else t_first - self._t_submit),
             "tpot_s": (None if (t_first is None or end is None
@@ -260,6 +273,21 @@ class GenerationResult:
                 **({} if self._t_first is None else
                    {"ttft_ms": round((self._t_first - self._t_submit)
                                      * 1e3, 3)}))
+        try:
+            if (error is None and self._obs_emit
+                    and (_flags.flag_value("slo_ttft_ms") > 0
+                         or _flags.flag_value("slo_tpot_ms") > 0)):
+                from ..observability import reqtrace as _rt
+
+                s = self.slo()
+                _rt.slo_observe(s["ttft_s"], s["tpot_s"])
+            tr = self._trace
+            if tr is not None and self._trace_owner:
+                from ..observability import reqtrace as _rt
+
+                _rt.finish_future(tr, self, outcome)
+        except Exception:
+            pass       # observability must never break request delivery
         self._drain_callbacks()
 
 
@@ -666,20 +694,39 @@ class ServingEngine:
     def submit(self, prompt_ids, max_new_tokens=32, temperature=0.0,
                top_k=0, eos_token_id=None,
                deadline_s: Optional[float] = None,
-               prefix_len: Optional[int] = None) -> GenerationResult:
+               prefix_len: Optional[int] = None,
+               trace=None) -> GenerationResult:
         """Queue one generation request; raises a typed
         :mod:`~.robustness` error instead of queueing when the request
         cannot (validation), or should not (overload, open breaker,
         draining, expired deadline), be served. ``prefix_len`` declares
         the leading shared prefix (system prompt) for the paged engine's
         prompt cache; ignored by the static scheduler and the contiguous
-        layout."""
+        layout. ``trace`` is a propagated request journey
+        (:mod:`~..observability.reqtrace`) — the router passes its
+        journey across the replica seam here; with none passed and
+        tracing armed, the engine mints one (and this future owns it)."""
         dl = deadline_s if deadline_s is not None else self.default_deadline_s
         req = GenerationRequest(
             prompt_ids, max_new_tokens, temperature, top_k, eos_token_id,
             deadline=None if dl is None else time.monotonic() + dl,
             prefix_len=prefix_len)
         self._check_admission(req)
+        tr = trace
+        if tr is None:
+            try:
+                from ..observability import reqtrace as _rt
+
+                if _rt.enabled():
+                    tr = _rt.mint(req.id)
+                    req.result._trace_owner = tr is not None
+            except Exception:
+                tr = None
+        req.result._trace = tr
+        if tr is not None:
+            tr.event("engine.submit", prompt=req.prompt_ids.shape[1],
+                     budget=req.max_new_tokens,
+                     queue_depth=self._queue_depth())
         _flight_record("request", str(req.id), phase="submit",
                        prompt=req.prompt_ids.shape[1],
                        budget=req.max_new_tokens,
@@ -754,9 +801,19 @@ class ServingEngine:
             compile_block = {"cache": _cc.stats()}
         est = self._estimator.estimate_wait_s(self._queue_depth(),
                                               self.max_batch_size)
+        try:
+            from ..observability import reqtrace as _rt
+
+            slo_burn = _rt.burn_snapshot()
+        except Exception:
+            slo_burn = {"enabled": False}
         return {
             "state": state,
             "mode": self.mode,
+            # sliding-window SLO burn rate vs FLAGS_slo_{ttft,tpot}_ms —
+            # the signal the SLO-driven autoscaler (ROADMAP item 5)
+            # closes its scale-up/down loop on
+            "slo_burn": slo_burn,
             "quant": self.quant or "off",
             "kv": kv,
             # speculative decoding: draft config, k, live acceptance rate
@@ -1147,6 +1204,11 @@ class ServingEngine:
         t_admit = time.perf_counter()
         for req in batch:
             req.result._t_admit = t_admit
+            tr = req.result._trace
+            if tr is not None:
+                tr.event("queue.wait", t0=req.result._t_submit, t1=t_admit)
+                tr.event("admit", mode="static", batch=len(batch),
+                         plen=leader.prompt_ids.shape[1])
         out = self.model.generate_cached(
             ids,
             max_new_tokens=max(r.max_new_tokens for r in batch),
@@ -1165,6 +1227,10 @@ class ServingEngine:
             if eos is not None and eos in gen:  # don't count post-eos pad
                 gen = gen[: int(np.argmax(gen == eos)) + 1]
             req.result._n_new = len(gen)
+            tr = req.result._trace
+            if tr is not None:
+                tr.event("decode.batch", t0=t_admit, t1=t_first,
+                         tokens=len(gen))
             req.result._set(output=row)
 
     def _sweep_slots(self) -> None:

@@ -370,6 +370,17 @@ class SpeculativeDecoder:
         return run
 
     # -- host-side accounting -------------------------------------------------
+    def round_summary(self, acc_row: np.ndarray) -> Dict[str, int]:
+        """One slot's spec-chunk attrs for its request journey
+        (observability.reqtrace ``spec.round`` span): verify steps run
+        this chunk and draft tokens proposed/accepted at this k — defined
+        here, next to the payload format that produces ``acc_row``, so
+        the trace schema can never drift from the verify program."""
+        live = acc_row[acc_row >= 0]
+        return {"k": self.k, "steps": int(live.size),
+                "proposed": int(live.size) * self.k,
+                "accepted": int(live.sum())}
+
     def record_chunk(self, acc_matrix: np.ndarray, emitted_count: int
                      ) -> None:
         """Fold one spec chunk's accepted-run lengths (``[S, steps]``, -1

@@ -39,7 +39,7 @@ import os
 from typing import Optional
 
 from ..core import flags as _flags
-from . import flight, perf, watchdog
+from . import flight, perf, reqtrace, watchdog
 from .metrics import (  # noqa: F401
     BYTES_BUCKETS,
     LATENCY_BUCKETS,
@@ -352,6 +352,7 @@ def reset() -> None:
     _registry.clear()
     watchdog.reset()
     perf.reset()
+    reqtrace.reset()
 
 
 # ---------------------------------------------------------------------------
@@ -650,6 +651,12 @@ if _flags.flag_value("obs_blackbox"):
     except Exception:
         pass
 
+if _flags.flag_value("obs_reqtrace"):
+    try:
+        reqtrace.enable()
+    except Exception:
+        pass
+
 if _flags.flag_value("obs_export"):
     try:
         start_exporter()
@@ -668,5 +675,5 @@ __all__ = [
     "enable", "disable", "reset", "is_enabled", "safe_inc", "safe_set",
     "get_recorder", "get_registry", "snapshot", "to_prometheus_text",
     "export_chrome_trace", "summary", "watchdog", "flight", "perf",
-    "start_exporter", "stop_exporter",
+    "reqtrace", "start_exporter", "stop_exporter",
 ]

@@ -20,7 +20,12 @@ loop beyond reading the registry/recorder:
   Perfetto directly);
 * ``/programs`` — the perf plane's program-cost table (XLA FLOPs/bytes,
   measured wall, roofline classification) as JSON; rendered by
-  ``obsctl programs``.
+  ``obsctl programs``;
+* ``/requests`` — recent + in-flight request journeys (reqtrace) with
+  SLO-histogram exemplars and the burn-rate block, as strict JSON;
+  ``/requests/trace`` serves the same journeys as Perfetto-loadable
+  chrome-trace JSON (one track per replica); rendered by
+  ``obsctl requests``.
 
 Auto-started per worker when ``PADDLE_OBS_EXPORT=1`` (``FLAGS_obs_export``)
 — ``distributed.launch --obs_export`` sets that for every rank it spawns.
@@ -134,6 +139,8 @@ class TelemetryExporter:
         self.register_route("/vars", self._vars)
         self.register_route("/trace", self._trace)
         self.register_route("/programs", self._programs)
+        self.register_route("/requests", self._requests)
+        self.register_route("/requests/trace", self._requests_trace)
 
     def _index(self):
         return 200, _JSON, json.dumps(
@@ -166,6 +173,18 @@ class TelemetryExporter:
         body = dict(perf.table_jsonable(), enabled=perf.enabled(),
                     rank=_rank())
         return 200, _JSON, json.dumps(body, allow_nan=False, default=str)
+
+    def _requests(self):
+        from . import reqtrace
+
+        body = dict(reqtrace.requests_jsonable(), rank=_rank())
+        return 200, _JSON, json.dumps(body, allow_nan=False, default=str)
+
+    def _requests_trace(self):
+        from . import reqtrace
+
+        return 200, _JSON, json.dumps(reqtrace.to_chrome_trace(),
+                                      allow_nan=False, default=str)
 
     def _healthz(self):
         from . import _metrics_on, _trace_on, _watchdog_on

@@ -1,0 +1,401 @@
+"""Request-journey tracing (observability/reqtrace.py + the serving
+seams that stamp into it).
+
+The acceptance surface of ISSUE 12: one request through a 3-replica fleet
+under a forced mid-flight kill yields ONE stitched journey — router pick
+with candidate scores, the failed attempt with its cause, the successful
+attempt, admission, decode — retrievable via the exporter's ``/requests``
+endpoint and rendered by ``obsctl requests``; a speculative engine's
+journey shows draft/verify rounds with acceptance; journeys are released
+(ring-bounded, zero in-flight residue) after a soak; the SLO burn-rate
+gauges feed ``health()``; and the router failover path stamps queue wait
+PER ATTEMPT instead of reading from the first submit.
+
+Most tests drive static fake-model fleets (no JAX compiles); one
+continuous+speculative test uses a deliberately minimal tiny-Llama so the
+whole module stays seconds-cheap in tier-1.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddlepaddle_tpu.core import flags as _flags
+from paddlepaddle_tpu.inference import (
+    FleetUnavailableError,
+    ReplicaClient,
+    ServingEngine,
+    ServingRouter,
+)
+from paddlepaddle_tpu.observability import reqtrace
+from test_serving_robustness import FakeModel, _prompt
+
+
+@pytest.fixture()
+def traced():
+    """Arm reqtrace with a small ring for the duration of one test and
+    leave the process state clean afterwards."""
+    reqtrace.reset()
+    reqtrace.enable(ring=64)
+    yield reqtrace
+    reqtrace.disable()
+    reqtrace.reset()
+
+
+def _factory(model=None, **kw):
+    kw.setdefault("mode", "static")
+    kw.setdefault("max_batch_size", 2)
+    kw.setdefault("max_wait_ms", 2.0)
+    kw.setdefault("max_len", 64)
+    return lambda: ServingEngine(model() if callable(model)
+                                 else (model or FakeModel()), **kw)
+
+
+def _names(j):
+    return [s["name"] for s in j.spans]
+
+
+# -- the stitched failover journey -------------------------------------------
+
+def test_midflight_kill_yields_one_stitched_journey(traced):
+    """3-replica fleet, replica 0 dies mid-decode: the request's journey
+    contains BOTH attempts — pick with candidate scores, the failed
+    attempt tagged with the failure cause, the successful attempt, the
+    winning replica's admission — and exactly one journey exists."""
+    r = ServingRouter(
+        [_factory(FakeModel(fail_next=1, delay_s=0.01)),
+         _factory(FakeModel(delay_s=0.01)),
+         _factory(FakeModel(delay_s=0.01))],
+        probe_interval_s=60.0)
+    try:
+        fut = r.submit(_prompt(), max_new_tokens=2)
+        assert fut.result(30).shape == (6,)
+    finally:
+        r.stop()
+    js = traced.journeys()
+    assert len(js) == 1 and not traced.inflight()
+    j = js[0]
+    assert j.done and j.outcome == "ok"
+    assert j.attempts == 2 and j.replicas[0] == "r0"
+    names = _names(j)
+    for expected in ("submit", "router.pick", "queue.wait", "admit",
+                     "router.attempt", "finish"):
+        assert expected in names, (expected, names)
+    picks = [s for s in j.spans if s["name"] == "router.pick"]
+    assert len(picks) == 2
+    assert "r0" in picks[0]["candidates"]          # candidate scores ride
+    attempts = [s for s in j.spans if s["name"] == "router.attempt"]
+    assert [a["ok"] for a in attempts] == [False, True]
+    assert "synthetic decode failure" in attempts[0]["error"]
+    assert attempts[0]["replica"] == "r0"
+    assert attempts[1]["replica"] == j.replicas[1]
+    # the winning replica's engine-side spans attribute to ITS track
+    admits = [s for s in j.spans if s["name"] == "admit"]
+    assert admits[-1]["replica"] == j.replicas[1]
+    # the journey is the wrapper future's: slo numbers stitched in
+    assert j.slo and j.slo["new_tokens"] == 2
+
+
+def test_failover_queue_wait_is_stamped_per_attempt(traced):
+    """The satellite fix: after a failover the wrapper's slo() queue wait
+    reads from the WINNING attempt's own dispatch, not the first submit —
+    the failed attempt's decode and the failover dance stay out of
+    "queue wait" (they remain visible in TTFT and the attempt spans)."""
+    class _BurnsThenDies:
+        """Decode sleeps, THEN dies — the failed attempt costs real wall
+        time, exactly the conflation the per-attempt stamp removes."""
+
+        def generate_cached(self, ids, max_new_tokens, temperature=0.0,
+                            top_k=0, eos_token_id=None):
+            time.sleep(0.06)
+            raise RuntimeError("synthetic decode failure")
+
+    r = ServingRouter(
+        [_factory(_BurnsThenDies(), max_batch_size=1),
+         _factory(FakeModel(delay_s=0.01), max_batch_size=1)],
+        probe_interval_s=60.0)
+    try:
+        fut = r.submit(_prompt(), max_new_tokens=2)
+        fut.result(30)
+    finally:
+        r.stop()
+    assert fut._t_dispatch is not None
+    assert fut._t_dispatch > fut._t_submit     # attempt 2 dispatched later
+    s = fut.slo()
+    # attempt 1 burned >= 50 ms before failing over; the winning attempt's
+    # queue wait is the few-ms admission path, far under that
+    assert s["ttft_s"] >= 0.05
+    assert s["queue_wait_s"] < s["ttft_s"] - 0.04, s
+    # multi-token stamp also rides the copy (spec engines behind a router)
+    assert fut._n_at_first == 1
+
+
+def test_sync_refusal_closes_journey_no_leak(traced):
+    """A submit that raises synchronously (fleet unavailable) never sets
+    its future — the journey must still close (outcome rejected) instead
+    of leaking into the in-flight map forever."""
+    r = ServingRouter([_factory()], probe_interval_s=60.0,
+                      breaker_reset_s=5.0)
+    r.start()
+    try:
+        r._replicas[0].client.kill()
+        for _ in range(3):
+            r._probe_once()               # probes evict the dead replica
+        with pytest.raises(FleetUnavailableError):
+            r.submit(_prompt(), max_new_tokens=2)
+    finally:
+        r.stop()
+    assert not traced.inflight()          # zero leaked journeys
+    js = traced.journeys()
+    assert js and js[-1].outcome == "rejected"
+    reject = [s for s in js[-1].spans if s["name"] == "router.reject"]
+    assert reject and reject[-1]["retryable"] is False
+
+
+def test_trace_unaware_replica_client_still_serves(traced):
+    """A replica client whose submit() predates the trace kwarg (remote
+    implementations of the seam): the router drops the kwarg for that
+    replica and serves — no breaker evidence burned, no failed request —
+    so arming tracing can never take a fleet down."""
+
+    class LegacyClient(ReplicaClient):
+        def submit(self, prompt_ids, **kw):
+            if "trace" in kw:
+                raise TypeError(
+                    "submit() got an unexpected keyword argument 'trace'")
+            return super().submit(prompt_ids, **kw)
+
+    r = ServingRouter([LegacyClient(_factory(), name="legacy")],
+                      probe_interval_s=60.0)
+    try:
+        assert r.submit(_prompt(), max_new_tokens=2).result(30).shape == (6,)
+        rep = r._replicas[0]
+        assert rep.no_trace
+        assert rep.breaker.state == "closed"
+        assert r.stats["failed"] == 0
+    finally:
+        r.stop()
+    j = traced.journeys()[-1]
+    assert j.outcome == "ok" and j.attempts == 1   # retry was invisible
+    picks = [s for s in j.spans if s["name"] == "router.pick"]
+    assert len(picks) == 1                         # undone pick un-stamped
+
+
+def test_reqtrace_off_costs_nothing_and_records_nothing():
+    reqtrace.reset()
+    assert not reqtrace.enabled()
+    eng = _factory()()
+    try:
+        fut = eng.submit(_prompt(), max_new_tokens=2)
+        fut.result(30)
+    finally:
+        eng.stop()
+    assert fut._trace is None
+    assert not reqtrace.journeys() and not reqtrace.inflight()
+
+
+# -- ring bounds / release ---------------------------------------------------
+
+def test_soak_releases_journeys_ring_bounded(traced):
+    """200-request soak: every journey is closed (zero in-flight
+    residue), the ring holds at most its capacity, and per-journey span
+    caps hold — no growth anywhere."""
+    eng = _factory(max_batch_size=4)()
+    try:
+        futs = [eng.submit(_prompt(v=i % 5), max_new_tokens=2)
+                for i in range(200)]
+        for f in futs:
+            f.result(60)
+    finally:
+        eng.stop()
+    assert not traced.inflight()               # all released
+    js = traced.journeys()
+    assert len(js) == 64                       # ring-bounded (cap 64)
+    assert all(j.done for j in js)
+    assert all(len(j.spans) <= j.max_spans for j in js)
+    doc = traced.requests_jsonable()
+    assert doc["inflight_count"] == 0 and len(doc["journeys"]) == 64
+    # exemplars stay joinable: every row's trace_id resolves in the ring
+    # (rows for ring-evicted journeys are pruned, not left dangling)
+    ring_ids = {j.trace_id for j in js}
+    for block in traced.exemplars().values():
+        for row in block["slowest"]:
+            assert row["trace_id"] in ring_ids
+
+
+def test_span_cap_counts_drops_instead_of_growing(traced):
+    j = traced.mint(1)
+    j.max_spans = 8
+    for i in range(50):
+        j.event("decode.chunk", tokens=1)
+    assert len(j.spans) == 8 and j.dropped == 42
+
+
+# -- speculative engine journey ----------------------------------------------
+
+def test_spec_engine_journey_shows_rounds_with_acceptance(traced):
+    """A (self-draft) speculative engine's journey carries the
+    draft-prefill event and per-chunk spec.round spans whose
+    proposed/accepted counts reconcile with full self-acceptance."""
+    import paddlepaddle_tpu as paddle
+    from paddlepaddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(LlamaConfig(
+        vocab_size=32, hidden_size=16, intermediate_size=48,
+        num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=48, dtype="bfloat16"))
+    eng = ServingEngine(model, max_batch_size=1, decode_chunk=6,
+                        kv_page_size=16, max_len=48, draft=model, spec_k=2)
+    try:
+        p = np.arange(6, dtype=np.int32) % 32
+        fut = eng.submit(p, max_new_tokens=7)
+        out = fut.result(300)
+        assert out.shape == (13,)
+    finally:
+        eng.stop()
+    js = traced.journeys()
+    assert len(js) == 1
+    j = js[0]
+    names = _names(j)
+    assert "spec.draft_prefill" in names
+    assert "admit" in names and "first_token" in names
+    rounds = [s for s in j.spans if s["name"] == "spec.round"]
+    assert rounds, names
+    for s in rounds:
+        assert s["k"] == 2
+        assert s["proposed"] == s["steps"] * 2
+        # self-draft: the draft IS the target, greedy acceptance is total
+        assert s["accepted"] == s["proposed"]
+    # admission recorded its page reservation (paged pool)
+    admit = next(s for s in j.spans if s["name"] == "admit")
+    assert admit["pages"] >= 1 and admit["bucket"] == 48
+
+
+# -- /requests endpoint + obsctl + exemplars ---------------------------------
+
+def test_requests_endpoint_obsctl_and_exemplars(traced, capsys):
+    """The journey is retrievable via /requests (strict JSON), reachable
+    FROM the TTFT-histogram exemplar's trace_id, renders through `obsctl
+    requests` (table + waterfall), and exports as Perfetto trace events
+    with one named track per replica."""
+    import urllib.request
+
+    from paddlepaddle_tpu.observability import exporter
+
+    r = ServingRouter([_factory(FakeModel(fail_next=1)), _factory()],
+                      probe_interval_s=60.0)
+    try:
+        r.submit(_prompt(), max_new_tokens=2).result(30)
+    finally:
+        r.stop()
+    served = exporter.TelemetryExporter(port=0).start()
+    try:
+        doc = json.loads(urllib.request.urlopen(
+            served.url("/requests"), timeout=5).read())
+        assert doc["enabled"] and len(doc["journeys"]) == 1
+        j = doc["journeys"][0]
+        # exemplar -> journey join: the slowest TTFT's trace_id resolves
+        ex = doc["exemplars"]["paddle_serving_ttft_seconds"]["slowest"]
+        assert ex and ex[0]["trace_id"] == j["trace_id"]
+        assert "le" in ex[0]
+        # Perfetto export: a thread (track) metadata event per replica
+        tr = json.loads(urllib.request.urlopen(
+            served.url("/requests/trace"), timeout=5).read())
+        tracks = {e["args"]["name"] for e in tr["traceEvents"]
+                  if e.get("name") == "thread_name"}
+        assert {"router", "r0", "r1"} <= tracks
+        # obsctl: the table view and the single-journey waterfall
+        import importlib.util
+        import os
+
+        spec = importlib.util.spec_from_file_location(
+            "obsctl", os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "tools", "obsctl.py"))
+        obsctl = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(obsctl)
+        target = f"127.0.0.1:{served.port}"
+        assert obsctl.main(["requests", target]) == 0
+        out = capsys.readouterr().out
+        assert j["trace_id"] in out and "exemplars" in out
+        assert obsctl.main(["requests", target, "--id",
+                            j["trace_id"]]) == 0
+        out = capsys.readouterr().out
+        assert "router.attempt" in out and "breakdown:" in out
+    finally:
+        served.stop()
+
+
+# -- SLO burn-rate gauges ----------------------------------------------------
+
+def test_slo_burn_gauges_feed_health():
+    """Armed targets produce sliding-window burn rates in every serving
+    health() (engine AND router) plus the paddle_slo_burn_* gauges; with
+    targets at 0 the block reports disabled and costs nothing."""
+    import paddlepaddle_tpu.observability as obs
+
+    reqtrace.reset()
+    eng = _factory()()
+    try:
+        assert eng.health()["slo_burn"] == {"enabled": False}
+        # an impossible TTFT target: every request violates; a huge TPOT
+        # target: none does. budget 10% -> burn = rate / 0.1
+        _flags.set_flags({"slo_ttft_ms": 1e-6, "slo_tpot_ms": 1e6,
+                          "slo_error_budget": 0.1})
+        for _ in range(5):
+            eng.submit(_prompt(), max_new_tokens=2).result(30)
+        burn = eng.health()["slo_burn"]
+        assert burn["enabled"] and burn["ttft"]["requests"] == 5
+        assert burn["ttft"]["violations"] == 5
+        assert burn["ttft"]["burn"] == pytest.approx(10.0)
+        # static mode streams nothing, so TPOT was never measured: the
+        # block says so (no samples, burn None) instead of faking a zero
+        assert burn["tpot"]["requests"] == 0
+        assert burn["tpot"]["burn"] is None
+        snap = obs.snapshot()
+        assert snap["paddle_slo_burn_ttft"][()] == pytest.approx(10.0)
+    finally:
+        eng.stop()
+        _flags.set_flags({"slo_ttft_ms": 0.0, "slo_tpot_ms": 0.0,
+                          "slo_error_budget": 0.01})
+        reqtrace.reset()
+    # the router surfaces the same block
+    r = ServingRouter([_factory()], probe_interval_s=60.0)
+    try:
+        assert r.health()["slo_burn"] == {"enabled": False}
+    finally:
+        r.stop()
+
+
+def test_burn_window_slides():
+    reqtrace.reset()
+    _flags.set_flags({"slo_ttft_ms": 1.0, "slo_burn_window_s": 0.2})
+    try:
+        reqtrace.slo_observe(0.5, None)       # violation (500 ms > 1 ms)
+        assert reqtrace.burn_snapshot()["ttft"]["violations"] == 1
+        time.sleep(0.25)                      # sample ages out
+        assert reqtrace.burn_snapshot()["ttft"]["requests"] == 0
+    finally:
+        _flags.set_flags({"slo_ttft_ms": 0.0, "slo_burn_window_s": 60.0})
+        reqtrace.reset()
+
+
+# -- flight recorder carries in-flight journeys ------------------------------
+
+def test_flight_dump_carries_inflight_journeys(traced, tmp_path):
+    from paddlepaddle_tpu.observability import flight
+
+    flight.enable(str(tmp_path), capacity=64)
+    try:
+        j = traced.mint(7)
+        j.event("admit", slot=0)
+        path = flight.dump("test_crash")
+        assert path is not None
+        header = json.loads(open(path).readline())
+        live = header["annotations"]["reqtrace_inflight"]
+        assert any(row["trace_id"] == j.trace_id for row in live)
+    finally:
+        flight.disable()

@@ -16,7 +16,17 @@ the instrumented entry point (``apply_op``) vs the uninstrumented inner
 4. **perf plane armed** — ``PADDLE_OBS_PERF`` on: cost capture rides
    compile boundaries (once per program) and wall observation rides
    chunk/step boundaries, so the per-op dispatch path must stay at the
-   bare branch cost.
+   bare branch cost;
+5. **request-journey tracing armed** — ``PADDLE_OBS_REQTRACE`` on:
+   journeys are minted and stamped on the per-request serving seams
+   (submit, pick, admit, chunk), never per op, so the dispatch path must
+   also stay at the bare branch cost (same <5% budget, same
+   retry-once-on-noise policy).
+
+A journey-record microbench is printed for information (the per-request
+cost of mint + a typical span set + finish with reqtrace armed) but not
+gated — requests are milliseconds-to-seconds; microseconds of stamping
+are noise there.
 
 A step-bracket microbench is printed for information (the per-step cost of
 the watchdog/flight step seam) but not gated — steps are milliseconds-to-
@@ -147,6 +157,40 @@ def _step_bracket_info(n_steps=2000):
           f"(+{(on - off) / n_steps * 1e6:.2f}us/step)")
 
 
+def _journey_info(n=2000):
+    """Informational: per-request cost of one full journey record (mint +
+    a typical span set + finish feeding the exemplar lists) with
+    reqtrace armed — the actual serving-path reqtrace work."""
+    from paddlepaddle_tpu.observability import reqtrace
+
+    class _Fut:  # minimal slo()-shaped future for finish_future
+        @staticmethod
+        def slo():
+            return {"req_id": 1, "new_tokens": 16, "queue_wait_s": 0.001,
+                    "ttft_s": 0.01, "tpot_s": 0.001, "latency_s": 0.05}
+
+    reqtrace.enable(ring=512)
+    try:
+        t0 = time.perf_counter()
+        for i in range(n):
+            j = reqtrace.mint(i)
+            j.event("submit", replica="router", prompt=8, budget=16)
+            j.set_replica("r0")
+            j.event("router.pick", attempt=1, candidates={"r0": 0.0})
+            j.event("queue.wait")
+            j.event("admit", slot=0, bucket=128, pages=3)
+            for _ in range(4):
+                j.event("decode.chunk", tokens=16)
+            j.event("first_token")
+            reqtrace.finish_future(j, _Fut, "ok")
+        dt = time.perf_counter() - t0
+    finally:
+        reqtrace.disable()
+        reqtrace.reset()
+    print(f"[info] journey record: {dt / n * 1e6:.2f}us/request "
+          f"(mint + 9 spans + finish + exemplar upkeep)")
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--ops", type=int, default=10_000,
@@ -202,7 +246,22 @@ def main() -> int:
                                 teardown=perf.disable),
                 args.ops, args.budget)
 
+    # gate 5: request-journey tracing armed (journeys ride per-REQUEST
+    # serving seams — submit/pick/admit/chunk — never per-op dispatch)
+    from paddlepaddle_tpu.observability import reqtrace
+
+    def _reqtrace_off():
+        reqtrace.disable()
+        reqtrace.reset()
+
+    rc |= _gate("reqtrace-on",
+                lambda: measure(args.ops, args.repeats,
+                                setup=lambda: reqtrace.enable(ring=256),
+                                teardown=_reqtrace_off),
+                args.ops, args.budget)
+
     _step_bracket_info()
+    _journey_info()
     print("OK" if rc == 0 else "FAIL", flush=True)
     return rc
 

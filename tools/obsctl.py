@@ -23,6 +23,14 @@ Subcommands:
       per-program roofline table (XLA FLOPs/bytes, measured wall, MFU,
       bandwidth utilization, compute/bandwidth-bound classification).
 
+  requests TARGET [--id TRACE] [--perfetto OUT] [--json] [-n N]
+      Render one exporter's /requests endpoint — stitched request
+      journeys (reqtrace): the journey table with SLO columns, the
+      slowest-request exemplars and the SLO burn block; `--id` renders
+      one journey's span waterfall with the TTFT/TPOT breakdown;
+      `--perfetto` saves /requests/trace (one track per replica, open in
+      https://ui.perfetto.dev).
+
   blackbox tail [--dir DIR] [-n N] [--raw]
       Render the newest flight-recorder dump in DIR (default:
       $PADDLE_OBS_BLACKBOX_DIR or <tmpdir>/paddle_blackbox): header, the
@@ -124,6 +132,134 @@ def cmd_programs(args) -> int:
               f"{'-' if mfu is None else format(mfu, '.3f'):>7}"
               f"{'-' if bw is None else format(bw * 100, '.1f'):>7}"
               f"  {r.get('bound', '-')}")
+    return 0
+
+
+def _ms(v) -> str:
+    return "-" if v is None else f"{v * 1e3:.1f}"
+
+
+def _render_waterfall(j: dict) -> None:
+    print(f"[journey] {j.get('trace_id')}  req={j.get('req_id')}  "
+          f"outcome={j.get('outcome') or 'in-flight'}  "
+          f"attempts={j.get('attempts')}  "
+          f"replicas={','.join(j.get('replicas') or []) or '-'}")
+    slo = j.get("slo") or {}
+    if slo:
+        print(f"  slo: queue_wait={_ms(slo.get('queue_wait_s'))}ms  "
+              f"ttft={_ms(slo.get('ttft_s'))}ms  "
+              f"tpot={_ms(slo.get('tpot_s'))}ms/tok  "
+              f"latency={_ms(slo.get('latency_s'))}ms  "
+              f"tokens={slo.get('new_tokens')}")
+        # TTFT/TPOT breakdown: client-visible TTFT splits into the winning
+        # attempt's queue wait + prefill/scheduling (incl. any failed
+        # attempts and backoffs); the rest of the latency is decode tail
+        qw, ttft, lat = (slo.get("queue_wait_s"), slo.get("ttft_s"),
+                         slo.get("latency_s"))
+        if ttft is not None:
+            pre = None if qw is None else max(ttft - qw, 0.0)
+            tail = None if lat is None else max(lat - ttft, 0.0)
+            print(f"  breakdown: queue_wait {_ms(qw)}ms | "
+                  f"prefill+sched {_ms(pre)}ms | decode tail {_ms(tail)}ms")
+    if j.get("dropped_spans"):
+        print(f"  ({j['dropped_spans']} spans dropped at the per-journey "
+              "cap)")
+    print(f"  {'t(ms)':>10}{'dur(ms)':>10}  {'span':<20}{'replica':<10}"
+          "attrs")
+    for sp in j.get("spans") or []:
+        attrs = " ".join(
+            f"{k}={v}" for k, v in sp.items()
+            if k not in ("name", "t", "dur", "replica"))
+        print(f"  {sp.get('t', 0) * 1e3:>10.3f}"
+              f"{sp.get('dur', 0) * 1e3:>10.3f}  "
+              f"{str(sp.get('name', '?'))[:20]:<20}"
+              f"{str(sp.get('replica', '-'))[:10]:<10}{attrs}".rstrip())
+
+
+def cmd_requests(args) -> int:
+    """Stdlib-only /requests renderer (same contract as cmd_programs:
+    works on a box where the framework cannot import)."""
+    if args.perfetto:
+        try:
+            status, body = _get(args.target, "/requests/trace", args.timeout)
+        except (urllib.error.URLError, OSError) as e:
+            sys.stderr.write(f"[obsctl] {args.target}/requests/trace: {e}\n")
+            return 1
+        if status != 200:
+            sys.stderr.write(f"[obsctl] /requests/trace: HTTP {status}\n")
+            return 1
+        with open(args.perfetto, "wb") as f:
+            f.write(body)
+        doc = json.loads(body)
+        print(f"[obsctl] {len(doc.get('traceEvents', []))} trace events -> "
+              f"{args.perfetto} (open in https://ui.perfetto.dev)")
+        return 0
+    try:
+        status, body = _get(args.target, "/requests", args.timeout)
+    except (urllib.error.URLError, OSError) as e:
+        sys.stderr.write(f"[obsctl] {args.target}/requests: {e}\n")
+        return 1
+    if status != 200:
+        sys.stderr.write(f"[obsctl] {args.target}/requests: HTTP {status}\n")
+        return 1
+    doc = json.loads(body)
+    if args.json:
+        print(json.dumps(doc, indent=1))
+        return 0
+    rows = (doc.get("inflight") or []) + (doc.get("journeys") or [])
+    if args.id:
+        for j in rows:
+            if j.get("trace_id") == args.id:
+                _render_waterfall(j)
+                return 0
+        sys.stderr.write(f"[obsctl] no journey {args.id!r} in the ring "
+                         f"({len(rows)} available)\n")
+        return 1
+    print(f"[requests] {args.target}  reqtrace="
+          f"{'on' if doc.get('enabled') else 'off'}  "
+          f"ring={doc.get('ring_capacity')}  "
+          f"inflight={doc.get('inflight_count')}")
+    if not rows:
+        print("  (no journeys — arm PADDLE_OBS_REQTRACE=1 and send "
+              "traffic)")
+        return 0
+    print(f"  {'trace_id':<16}{'req':>6}  {'outcome':<10}{'att':>3}  "
+          f"{'replicas':<14}{'qwait':>8}{'ttft':>8}{'tpot':>8}"
+          f"{'lat':>9}{'tok':>5}{'spans':>6}")
+    for j in rows[: args.last]:
+        slo = j.get("slo") or {}
+        print(f"  {str(j.get('trace_id'))[:16]:<16}"
+              f"{str(j.get('req_id')):>6}  "
+              f"{str(j.get('outcome') or 'live')[:10]:<10}"
+              f"{j.get('attempts', 0):>3}  "
+              f"{','.join(j.get('replicas') or [])[:13]:<14}"
+              f"{_ms(slo.get('queue_wait_s')):>8}"
+              f"{_ms(slo.get('ttft_s')):>8}"
+              f"{_ms(slo.get('tpot_s')):>8}"
+              f"{_ms(slo.get('latency_s')):>9}"
+              f"{str(slo.get('new_tokens', '-')):>5}"
+              f"{len(j.get('spans') or []):>6}")
+    if len(rows) > args.last:
+        print(f"  ... {len(rows) - args.last} more journeys")
+    ex = doc.get("exemplars") or {}
+    shown = [(hist, block) for hist, block in sorted(ex.items())
+             if block.get("slowest")]
+    if shown:
+        print("  exemplars (slowest requests per SLO histogram):")
+        for hist, block in shown:
+            tops = ", ".join(
+                f"{r['value_s'] * 1e3:.1f}ms->{r['trace_id']} "
+                f"(le {r['le']})" for r in block["slowest"][:3])
+            print(f"    {hist}: {tops}")
+    burn = doc.get("slo_burn") or {}
+    if burn.get("enabled"):
+        for key in ("ttft", "tpot"):
+            b = burn.get(key) or {}
+            if b.get("enabled"):
+                print(f"  slo_burn.{key}: target={b.get('target_ms')}ms "
+                      f"window={burn.get('window_s')}s "
+                      f"violations={b.get('violations')}/"
+                      f"{b.get('requests')} burn={b.get('burn')}")
     return 0
 
 
@@ -305,6 +441,20 @@ def main(argv=None) -> int:
                    help="print the raw JSON instead of the table")
     p.add_argument("--timeout", type=float, default=5.0)
     p.set_defaults(fn=cmd_programs)
+
+    p = sub.add_parser("requests",
+                       help="render one exporter's /requests journeys")
+    p.add_argument("target", help="host:port or URL of a per-rank exporter")
+    p.add_argument("--id", default="",
+                   help="render one journey's span waterfall")
+    p.add_argument("--perfetto", default="",
+                   help="save /requests/trace (Perfetto) to this file")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw JSON instead of the table")
+    p.add_argument("-n", "--last", type=int, default=20,
+                   help="journeys to list (default 20)")
+    p.add_argument("--timeout", type=float, default=5.0)
+    p.set_defaults(fn=cmd_requests)
 
     p = sub.add_parser("aggregate",
                        help="merge /metrics from several exporters")

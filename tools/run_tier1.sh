@@ -38,6 +38,14 @@
 # `python tools/serving_bench.py --spec-k N --draft <preset>` (gated by
 # perf_gate's serving.spec_tok_s; BASELINE.md "Speculative decoding").
 #
+# Request-journey suite: tests/test_reqtrace.py (one stitched trace per
+# request: mid-flight-kill failover stitching, per-attempt queue-wait
+# stamps, speculative-round spans, ring-bounded soak, /requests endpoint
+# + obsctl requests + histogram exemplars, SLO burn-rate gauges, flight
+# in-flight journeys) runs here — all static-fake or one-layer-tiny, a
+# few seconds total. The reqtrace-on hot-path budget (<5% vs off,
+# retry-once-on-noise) is gated by tools/check_obs_overhead.py gate 5.
+#
 # Perf regression gate (not run here — needs a bench artifact): after a
 # bench run, `python tools/perf_gate.py --baseline BENCH_r05.json
 # --current <new>.json` exits nonzero on a tokens/s / MFU / TTFT
