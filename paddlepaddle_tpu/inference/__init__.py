@@ -159,10 +159,12 @@ from .compile_plan import (  # noqa: F401,E402
     CompilePlan,
     prompt_buckets,
 )
+from .fleet import FleetController, FleetPolicy  # noqa: F401,E402
 from .robustness import (  # noqa: F401,E402
     CircuitBreaker,
     CircuitOpenError,
     DeadlineExceededError,
+    DeployError,
     EngineDrainingError,
     FleetUnavailableError,
     KVCapacityError,

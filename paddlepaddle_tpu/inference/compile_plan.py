@@ -249,6 +249,61 @@ def _facts_diff(a: Dict, b: Dict) -> List[str]:
     return [k for k in keys if a.get(k) != b.get(k)]
 
 
+# -- bundle version identity (stdlib — the fleet deploy pipeline reads
+#    these without importing jax) ------------------------------------------
+
+def bundle_version_id(fingerprint: str, created_unix: float) -> str:
+    """Short human-safe version id: enough fingerprint to name the
+    compiled-program identity, plus the save second so two rebuilds of
+    the SAME facts are still tellable apart in a rollout/rollback log."""
+    return f"{str(fingerprint)[:12]}@{int(created_unix)}"
+
+
+def read_manifest(path: str) -> Dict[str, object]:
+    """Load a bundle's manifest (stdlib, no jax). Older bundles saved
+    before the ``version`` field get one derived from their fingerprint +
+    timestamp, so every manifest this returns carries a version identity
+    the rollback machinery can key on."""
+    with open(os.path.join(path, MANIFEST_NAME)) as f:
+        manifest = json.load(f)
+    if not isinstance(manifest, dict):
+        raise ValueError(f"{path}: manifest is not a JSON object")
+    if not manifest.get("version"):
+        manifest["version"] = bundle_version_id(
+            manifest.get("fingerprint", "?"),
+            manifest.get("created_unix", 0) or 0)
+    return manifest
+
+
+def validate_bundle(path: str) -> Dict[str, object]:
+    """Pre-flight a candidate bundle for the fleet deploy pipeline —
+    cheap, stdlib-only, BEFORE any replica is touched: the manifest
+    parses, the format version is supported, a fingerprint is present,
+    and every entry's payload exists and matches its sha256. Returns the
+    manifest (with ``version``). Raises :class:`BundleMismatchError` /
+    OSError / ValueError on any problem; whether the fingerprint matches
+    a given ENGINE is still decided at load time per replica."""
+    manifest = read_manifest(path)
+    if manifest.get("format_version") != BUNDLE_FORMAT_VERSION:
+        raise BundleMismatchError(
+            f"bundle format {manifest.get('format_version')!r} != "
+            f"{BUNDLE_FORMAT_VERSION}", ["format_version"])
+    if not manifest.get("fingerprint"):
+        raise BundleMismatchError("bundle manifest carries no fingerprint",
+                                  ["fingerprint"])
+    for entry in manifest.get("entries", []):
+        key = entry.get("key", "?")
+        parse_key(key)
+        fpath = os.path.join(path, entry.get("file", ""))
+        with open(fpath, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()
+        if digest != entry.get("sha256"):
+            raise BundleMismatchError(
+                f"bundle entry {key}: payload sha256 mismatch "
+                "(corrupted or tampered artifact)", [key])
+    return manifest
+
+
 def save_bundle(engine, path: str,
                 keys: Optional[List[str]] = None) -> Dict[str, object]:
     """Serialize the engine's compiled programs (every plan entry plus any
@@ -308,9 +363,12 @@ def save_bundle(engine, path: str,
                 "bytes": len(payload),
                 "sha256": hashlib.sha256(payload).hexdigest(),
             })
+        created = time.time()
         manifest = {
             "format_version": BUNDLE_FORMAT_VERSION,
-            "created_unix": round(time.time(), 3),
+            "created_unix": round(created, 3),
+            "version": bundle_version_id(
+                engine.compile_plan.fingerprint(), created),
             "fingerprint": engine.compile_plan.fingerprint(),
             "facts": engine.compile_plan.facts,
             "jax": jax.__version__,

@@ -892,6 +892,11 @@ class BatchDecodeEngine:
             "path": str(path),
             "programs": len(manifest.get("entries", [])),
             "fingerprint": str(manifest.get("fingerprint"))[:16],
+            # the version identity the fleet deploy pipeline rolls back
+            # by — health() surfaces which artifact this engine serves
+            "version": manifest.get("version") or _cp.bundle_version_id(
+                manifest.get("fingerprint", "?"),
+                manifest.get("created_unix", 0) or 0),
         }
         _safe_set("paddle_serving_bundle_loaded",
                   "an AOT serving bundle is live in this engine (1 = yes)",

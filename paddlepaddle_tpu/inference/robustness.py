@@ -36,7 +36,8 @@ __all__ = [
     "ServingError", "ServerOverloadedError", "DeadlineExceededError",
     "RequestCancelledError", "CircuitOpenError", "EngineDrainingError",
     "RequestValidationError", "KVCapacityError", "FleetUnavailableError",
-    "CircuitBreaker", "QueueWaitEstimator", "safe_inc", "safe_set",
+    "DeployError", "CircuitBreaker", "QueueWaitEstimator", "safe_inc",
+    "safe_set",
 ]
 
 
@@ -135,6 +136,24 @@ class FleetUnavailableError(ServingError):
         self.replicas = int(replicas)
         self.healthy = int(healthy)
         self.retry_after_s = float(retry_after_s)
+
+
+class DeployError(ServingError):
+    """A :meth:`~.fleet.FleetController.deploy` could not START: the
+    candidate bundle failed pre-flight validation (missing/garbled
+    manifest, corrupt payload, unsupported format), or another deploy is
+    already in flight. Raised BEFORE any replica is touched — a rejected
+    candidate costs nothing. (A deploy that starts and then fails its
+    canary gate or regresses mid-rollout does NOT raise: it rolls back
+    and reports ``ok=False`` in its result, because a bad candidate is an
+    expected outcome the pipeline exists to absorb.) Carries the stage
+    that refused and the reasons."""
+
+    def __init__(self, msg: str, stage: str = "validate",
+                 reasons: Optional[list] = None):
+        super().__init__(msg)
+        self.stage = str(stage)
+        self.reasons = list(reasons or [])
 
 
 class CircuitBreaker:

@@ -33,7 +33,18 @@ Mechanics (all stdlib, no JAX imports — the replicas own the chips):
   replica out of rotation, drains it (in-flight requests finish; queued
   ones shed typed and FAIL OVER to the other replicas), restarts it with a
   fresh engine, waits until its health probe reads ok, re-admits it, then
-  proceeds to the next — a deploy drops zero requests.
+  proceeds to the next — a deploy drops zero requests. The per-replica
+  cycle is :meth:`ServingRouter.restart_replica` (``factory=`` swaps the
+  build recipe, ``readmit=False`` holds a healthy replica out of rotation)
+  — the unit the fleet controller's deploy/rollback pipeline reuses.
+* **elastic membership** — :meth:`ServingRouter.add_replica` joins a
+  started (ideally pre-warmed) replica to the rotation under live traffic;
+  :meth:`ServingRouter.remove_replica` leaves DELIBERATELY by drain
+  (sheds fail over, no breaker evidence, the engine's ``/healthz``
+  provider unregisters, router-side breaker/prober state is dropped).
+  Rendezvous hashing bounds prefix-key movement to the joining/leaving
+  replica — the fleet-wide cache hit rate survives scaling. The
+  SLO-driven autoscaler that drives these lives in :mod:`~.fleet`.
 * **prefix-affine routing** — requests declaring ``prefix_len`` rendezvous-
   hash their prefix tokens over the healthy replicas, so every request
   sharing a system prompt lands on the replica whose paged prefix cache
@@ -145,8 +156,17 @@ class ReplicaClient:
         fn = getattr(self.engine, "warmup", None)
         return fn() if callable(fn) else {"programs": 0, "compiled": 0}
 
-    def drain(self, timeout: Optional[float] = None) -> Dict[str, object]:
-        return self.engine.drain(timeout)
+    def drain(self, timeout: Optional[float] = None,
+              reason: Optional[str] = None) -> Dict[str, object]:
+        if reason is None:
+            return self.engine.drain(timeout)
+        try:
+            # deliberate drains (scale-down) carry their reason into the
+            # engine's shed/drain accounting; a foreign engine predating
+            # the kwarg still drains fine
+            return self.engine.drain(timeout, reason=reason)
+        except TypeError:
+            return self.engine.drain(timeout)
 
     def stop(self) -> None:
         try:
@@ -154,10 +174,15 @@ class ReplicaClient:
         except RuntimeError:
             pass          # overran the join: futures were already failed
 
-    def restart(self, drain_timeout: Optional[float] = None) -> None:
+    def restart(self, drain_timeout: Optional[float] = None,
+                factory: Optional[Callable[[], ServingEngine]] = None
+                ) -> None:
         """Drain the current engine (in-flight finishes, queued sheds
         typed), replace it with a FRESH one from the factory, start it.
-        Also the recovery path after :meth:`kill`."""
+        ``factory`` REPLACES the build recipe for this and every later
+        restart — the deploy pipeline's version-switch seam (candidate
+        bundle on rollout, previous bundle on rollback). Also the
+        recovery path after :meth:`kill`."""
         old = self.engine
         try:
             old.drain(drain_timeout)
@@ -167,6 +192,8 @@ class ReplicaClient:
             old.stop()
         except RuntimeError:
             pass
+        if factory is not None:
+            self._factory = factory
         self.engine = self._factory()
         self.engine.start()
         self.generation += 1
@@ -246,17 +273,17 @@ class ServingRouter:
                  drain_timeout_s: Optional[float] = None):
         if not replicas:
             raise ValueError("ServingRouter needs at least one replica")
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_reset_s = float(breaker_reset_s)
+        # _replicas is treated as an IMMUTABLE snapshot: every reader takes
+        # one attribute load and iterates its own list; add/remove swap in
+        # a fresh list (GIL-atomic), so the fleet controller can grow and
+        # shrink the rotation under live traffic without a reader lock
         self._replicas: List[_Replica] = []
         for i, r in enumerate(replicas):
             client = r if isinstance(r, ReplicaClient) \
                 else ReplicaClient(r, name=f"r{i}")
-            rep = _Replica(client.name, client, CircuitBreaker(
-                threshold=breaker_threshold, reset_s=breaker_reset_s))
-            # transition callback needs the replica it guards
-            rep.breaker._on_transition = \
-                (lambda old, new, _rep=rep:
-                 self._on_breaker_transition(_rep, old, new))
-            self._replicas.append(rep)
+            self._replicas.append(self._make_replica(client))
         if len({r.name for r in self._replicas}) != len(self._replicas):
             raise ValueError("replica names must be unique")
         self.probe_interval_s = float(probe_interval_s)
@@ -268,7 +295,8 @@ class ServingRouter:
         self.stats = {"submitted": 0, "completed": 0, "failed": 0,
                       "picks": 0, "retries": 0, "failovers": 0,
                       "evictions": 0, "readmissions": 0,
-                      "rolling_restarts": 0}
+                      "rolling_restarts": 0, "replicas_added": 0,
+                      "replicas_removed": 0}
         self._stop = threading.Event()
         self._draining = threading.Event()
         self._prober: Optional[threading.Thread] = None
@@ -283,6 +311,22 @@ class ServingRouter:
     def _bump(self, key: str, n: int = 1) -> None:
         with self._stats_lock:
             self.stats[key] += n
+
+    def _make_replica(self, client: ReplicaClient) -> _Replica:
+        rep = _Replica(client.name, client, CircuitBreaker(
+            threshold=self.breaker_threshold, reset_s=self.breaker_reset_s))
+        # transition callback needs the replica it guards
+        rep.breaker._on_transition = \
+            (lambda old, new, _rep=rep:
+             self._on_breaker_transition(_rep, old, new))
+        return rep
+
+    def _replica(self, name: str) -> _Replica:
+        for rep in self._replicas:
+            if rep.name == name:
+                return rep
+        raise KeyError(f"no replica named {name!r} "
+                       f"(have: {[r.name for r in self._replicas]})")
 
     def _on_breaker_transition(self, rep: _Replica, old: str,
                                new: str) -> None:
@@ -881,7 +925,158 @@ class ServingRouter:
         self.stop()
         return False
 
+    # -- elastic membership --------------------------------------------------
+    def _free_name(self) -> str:
+        taken = {r.name for r in self._replicas}
+        i = len(self._replicas)
+        while f"r{i}" in taken:
+            i += 1
+        return f"r{i}"
+
+    def add_replica(self, replica, name: Optional[str] = None) -> str:
+        """Join one replica to the rotation under live traffic. ``replica``
+        is a ready :class:`ReplicaClient` (the fleet controller hands one
+        in already started and PRE-WARMED, so its first routed request
+        never lands on a cold program) or a zero-arg engine factory.
+        Rendezvous-hashed prefix keys move ONLY onto the joining replica —
+        every other prefix keeps its home — so the fleet-wide cache hit
+        rate survives a scale-up. Returns the replica name."""
+        client = replica if isinstance(replica, ReplicaClient) \
+            else ReplicaClient(replica, name=name or self._free_name())
+        if any(r.name == client.name for r in self._replicas):
+            raise ValueError(f"replica name {client.name!r} already "
+                             "in the rotation")
+        rep = self._make_replica(client)
+        if self._started and not self._stop.is_set():
+            try:
+                client.start()
+            except Exception:
+                pass              # the probe below keeps it out of picks
+            try:
+                rep.snapshot = rep.client.health()
+            except Exception:
+                rep.snapshot = None
+        self._replicas = self._replicas + [rep]     # atomic snapshot swap
+        self._bump("replicas_added")
+        _safe_inc("paddle_router_replicas_added_total",
+                  "replicas joined to the rotation", replica=rep.name)
+        _flight_record("router", rep.name, event="add")
+        return client.name
+
+    def remove_replica(self, name: str,
+                       drain_timeout: Optional[float] = None,
+                       stop: bool = True,
+                       reason: str = "scale_down") -> Dict[str, object]:
+        """Leave the rotation DELIBERATELY (scale-down): the replica stops
+        receiving picks, drains (in-flight finishes; queued sheds fail
+        over to the rest — none of it is breaker failure evidence), is
+        removed from the pick set (rendezvous keys it owned redistribute;
+        nobody else's move), and — unless ``stop=False`` — its engine is
+        stopped, which unregisters its ``/healthz`` provider. The router-
+        side breaker/prober state is dropped with the replica, so a later
+        replica reusing the name starts with a clean slate. Returns the
+        drain summary plus the final breaker state."""
+        rep = self._replica(name)
+        if len(self._replicas) <= 1:
+            raise ValueError("cannot remove the last replica; drain() or "
+                             "stop() the router instead")
+        rep.in_rotation = False    # no new picks; the prober stops feeding
+        #                            its breaker (deliberate, not sickness)
+        drain_timeout = (self.drain_timeout_s if drain_timeout is None
+                         else drain_timeout)
+        clean, shed = True, 0
+        try:
+            res = rep.client.drain(drain_timeout, reason=reason)
+            clean = bool(res.get("clean", True))
+            shed = int(res.get("shed", 0))
+        except Exception:
+            clean = False
+        self._replicas = [r for r in self._replicas if r is not rep]
+        if stop:
+            try:
+                rep.client.stop()   # unregisters the /healthz provider
+            except Exception:
+                pass
+        self._bump("replicas_removed")
+        _safe_inc("paddle_router_replicas_removed_total",
+                  "replicas removed from the rotation, by reason",
+                  replica=rep.name, reason=reason)
+        _flight_record("router", rep.name, event="remove", reason=reason,
+                       clean=clean, shed=shed)
+        return {"replica": name, "clean": clean, "shed": shed,
+                "breaker": rep.breaker.state,
+                "generation": rep.client.generation}
+
     # -- rolling restart -----------------------------------------------------
+    def restart_replica(self, replica, drain_timeout: Optional[float] = None,
+                        health_timeout: float = 60.0, warmup: bool = True,
+                        factory: Optional[Callable] = None,
+                        readmit: bool = True) -> Dict[str, object]:
+        """One replica's zero-downtime replacement cycle — the unit both
+        :meth:`rolling_restart` and the fleet controller's deploy rollout
+        are built from: out of rotation → drain (queued requests fail over
+        to the rest) → fresh engine (``factory`` swaps the build recipe:
+        a deploy hands in the candidate-bundle factory, a rollback the
+        previous one) → pre-warm while still out of rotation → health
+        gate → breaker reset + re-admission. On a failed health gate the
+        replica is LEFT out of rotation and ``ok`` is False — the caller
+        decides between abort (rolling restart) and rollback (deploy).
+        ``readmit=False`` keeps a HEALTHY replica out of rotation too: the
+        deploy pipeline probes its canary before letting it take traffic."""
+        rep = replica if isinstance(replica, _Replica) \
+            else self._replica(replica)
+        t0 = time.monotonic()
+        _flight_record("router", rep.name, event="rolling_restart",
+                       phase="begin")
+        rep.in_rotation = False
+        if factory is not None:
+            rep.client.restart(drain_timeout, factory=factory)
+        else:
+            # positional form: keeps drop-in ReplicaClient substitutes
+            # (and test doubles) with the pre-deploy signature working
+            rep.client.restart(drain_timeout)
+        warm_info = None
+        if warmup:
+            # compiles happen HERE, outside rotation — not on the
+            # first unlucky routed request after re-admission
+            try:
+                warm_info = rep.client.warmup()
+                _safe_inc("paddle_router_prewarms_total",
+                          "replicas pre-warmed during rolling restart",
+                          replica=rep.name)
+                _flight_record("router", rep.name, event="prewarm",
+                               **(warm_info or {}))
+            except Exception as e:
+                # warm-later is degraded, not fatal: the health gate
+                # below still decides re-admission
+                sys.stderr.write(
+                    f"[router] replica {rep.name} pre-warm failed "
+                    f"({type(e).__name__}: {e}); first requests may "
+                    "pay compiles\n")
+        deadline = time.monotonic() + health_timeout
+        ok = False
+        while time.monotonic() < deadline:
+            try:
+                snap = rep.client.health()
+                ok = bool(snap.get("ok", False))
+            except Exception:
+                ok = False
+            if ok:
+                rep.snapshot = snap
+                break
+            time.sleep(0.02)
+        if ok and readmit:
+            # fresh engine: forget the old one's failure history so the
+            # replica is immediately pickable, not half-open-gated
+            rep.breaker.reset()
+            rep.in_rotation = True
+        _flight_record("router", rep.name, event="rolling_restart",
+                       phase="end", ok=ok)
+        return {"replica": rep.name, "ok": ok,
+                "generation": rep.client.generation,
+                "warmup": warm_info,
+                "wall_s": round(time.monotonic() - t0, 3)}
+
     def rolling_restart(self, drain_timeout: Optional[float] = None,
                         health_timeout: float = 60.0,
                         warmup: bool = True) -> Dict[str, object]:
@@ -901,62 +1096,19 @@ class ServingRouter:
                          else drain_timeout)
         rounds = []
         all_ok = True
-        for rep in self._replicas:
-            t0 = time.monotonic()
-            _flight_record("router", rep.name, event="rolling_restart",
-                           phase="begin")
-            rep.in_rotation = False
-            rep.client.restart(drain_timeout)
-            warm_info = None
-            if warmup:
-                # compiles happen HERE, outside rotation — not on the
-                # first unlucky routed request after re-admission
-                try:
-                    warm_info = rep.client.warmup()
-                    _safe_inc("paddle_router_prewarms_total",
-                              "replicas pre-warmed during rolling restart",
-                              replica=rep.name)
-                    _flight_record("router", rep.name, event="prewarm",
-                                   **(warm_info or {}))
-                except Exception as e:
-                    # warm-later is degraded, not fatal: the health gate
-                    # below still decides re-admission
-                    sys.stderr.write(
-                        f"[router] replica {rep.name} pre-warm failed "
-                        f"({type(e).__name__}: {e}); first requests may "
-                        "pay compiles\n")
-            deadline = time.monotonic() + health_timeout
-            ok = False
-            while time.monotonic() < deadline:
-                try:
-                    snap = rep.client.health()
-                    ok = bool(snap.get("ok", False))
-                except Exception:
-                    ok = False
-                if ok:
-                    rep.snapshot = snap
-                    break
-                time.sleep(0.02)
-            round_info = {"replica": rep.name, "ok": ok,
-                          "generation": rep.client.generation,
-                          "warmup": warm_info,
-                          "wall_s": round(time.monotonic() - t0, 3)}
-            _flight_record("router", rep.name, event="rolling_restart",
-                           phase="end", ok=ok)
-            if not ok:
+        for rep in list(self._replicas):
+            round_info = self.restart_replica(
+                rep, drain_timeout=drain_timeout,
+                health_timeout=health_timeout, warmup=warmup)
+            rounds.append(round_info)
+            if not round_info["ok"]:
                 all_ok = False
-                rounds.append(round_info)
                 sys.stderr.write(
                     f"[router] rolling restart ABORTED: replica {rep.name} "
                     f"did not turn healthy within {health_timeout:g}s — "
                     "left out of rotation, remaining replicas not "
                     "restarted\n")
                 break
-            # fresh engine: forget the old one's failure history so the
-            # replica is immediately pickable, not half-open-gated
-            rep.breaker.reset()
-            rep.in_rotation = True
-            rounds.append(round_info)
         self._bump("rolling_restarts")
         _safe_inc("paddle_router_rolling_restarts_total",
                   "fleet rolling restarts", outcome="ok" if all_ok

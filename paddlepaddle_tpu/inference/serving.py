@@ -426,6 +426,9 @@ class ServingEngine:
         self._stop = threading.Event()
         self._draining = threading.Event()
         self._drained = threading.Event()
+        self._drain_reason = "drain"   # metric label for drain-shed
+        #   requests: "drain" unless the caller marked the drain
+        #   deliberate ("scale_down", "sigterm", ...)
         self._thread: Optional[threading.Thread] = None
         self._watchdog_thread: Optional[threading.Thread] = None
         self._stats_lock = threading.Lock()
@@ -903,15 +906,21 @@ class ServingEngine:
         then exits 143 — instead of futures dying mid-decode."""
         from ..resilience.preemption import install_preemption_handler
 
-        return install_preemption_handler(lambda: self.drain(timeout))
+        return install_preemption_handler(
+            lambda: self.drain(timeout, reason="sigterm"))
 
-    def drain(self, timeout: Optional[float] = None) -> Dict[str, object]:
+    def drain(self, timeout: Optional[float] = None,
+              reason: str = "drain") -> Dict[str, object]:
         """Graceful shutdown: stop admission (submits raise
         :class:`EngineDrainingError`), let in-flight slots finish up to
         ``timeout`` seconds, shed everything still waiting with a typed
-        error, then stop the engine thread. Idempotent."""
+        error, then stop the engine thread. Idempotent. ``reason`` labels
+        the shed/drain accounting — a DELIBERATE drain (the fleet
+        controller's ``scale_down``, a preemption's ``sigterm``) must read
+        as an operator action in the metrics, not as failure evidence."""
         timeout = self.drain_timeout_s if timeout is None else timeout
         t0 = time.monotonic()
+        self._drain_reason = str(reason)
         self._draining.set()
         finished = True
         if self._thread is not None:
@@ -927,7 +936,8 @@ class ServingEngine:
             shed = self.stats["shed"] - shed_before
         _safe_inc("paddle_serving_drains_total",
                   "graceful drains completed",
-                  outcome="clean" if finished else "timeout")
+                  outcome="clean" if finished else "timeout",
+                  reason=self._drain_reason)
         obs = _obs_srv
         if obs is not None:
             obs("queue_depth", 0)
@@ -955,7 +965,7 @@ class ServingEngine:
             self._bump("shed", n)
             _safe_inc("paddle_serving_shed_total",
                       "requests shed by serving admission control, by reason",
-                      n, reason="drain" if isinstance(
+                      n, reason=self._drain_reason if isinstance(
                           error, EngineDrainingError) else "stop")
         return n
 

@@ -77,7 +77,7 @@ from .metrics import LATENCY_BUCKETS
 
 __all__ = [
     "Journey", "enable", "disable", "enabled", "reset", "mint",
-    "finish_future", "slo_observe", "burn_snapshot", "journeys",
+    "finish", "finish_future", "slo_observe", "burn_snapshot", "journeys",
     "inflight", "get", "exemplars", "requests_jsonable", "to_chrome_trace",
 ]
 
@@ -271,6 +271,14 @@ def _finish(j: Journey, outcome: str, slo: Optional[dict] = None) -> None:
         if evicted is not None:
             for rows in _exemplars.values():
                 rows[:] = [r for r in rows if r["trace_id"] != evicted]
+
+
+def finish(j: Journey, outcome: str) -> None:
+    """Close a journey that has no owning future. The fleet control plane
+    mints these for its ``fleet.scale`` / ``fleet.rollout`` spans — a
+    scale decision or a deploy reads in the same waterfall/Perfetto
+    surfaces as the requests it was taken for."""
+    _finish(j, outcome)
 
 
 def finish_future(j: Journey, fut, outcome: str) -> None:

@@ -31,15 +31,21 @@ Subcommands:
       `--perfetto` saves /requests/trace (one track per replica, open in
       https://ui.perfetto.dev).
 
+  fleet TARGET [--json]
+      Render one exporter's fleet-controller health block (the ``fleet``
+      /healthz provider): replica census vs target, last scale decision +
+      reason, rollout state/version (incl. rollback reasons), SLO burn
+      readings, and the per-replica rotation/breaker/version table.
+
   blackbox tail [--dir DIR] [-n N] [--raw]
       Render the newest flight-recorder dump in DIR (default:
       $PADDLE_OBS_BLACKBOX_DIR or <tmpdir>/paddle_blackbox): header, the
       last N events, in-flight steps/tasks, and thread-stack summaries.
 
-`scrape`, `programs` and `blackbox tail` are stdlib-only (fast, safe on a
-box where the framework cannot import); `aggregate`/`merge-trace` import
-the observability package for the strict exposition parser and trace
-merger.
+`scrape`, `programs`, `fleet` and `blackbox tail` are stdlib-only (fast,
+safe on a box where the framework cannot import); `aggregate`/
+`merge-trace` import the observability package for the strict exposition
+parser and trace merger.
 """
 
 from __future__ import annotations
@@ -263,6 +269,91 @@ def cmd_requests(args) -> int:
     return 0
 
 
+def cmd_fleet(args) -> int:
+    """Stdlib-only renderer for the fleet controller's health block (the
+    ``fleet`` /healthz provider): replica census vs target, last scale
+    decision, rollout state/version, burn readings — the operator's
+    one-look answer to "what is the autoscaler doing and which bundle is
+    live". Same contract as cmd_programs/cmd_requests: works on a box
+    where the framework cannot import."""
+    try:
+        # a 503 /healthz (a provider reports not-ok) still carries the
+        # body — exactly the situation an operator probes the fleet in
+        _status, body = _get(args.target, "/healthz", args.timeout)
+    except (urllib.error.URLError, OSError) as e:
+        sys.stderr.write(f"[obsctl] {args.target}/healthz: {e}\n")
+        return 1
+    doc = json.loads(body)
+    block = None
+    for name, prov in sorted((doc.get("providers") or {}).items()):
+        if isinstance(prov, dict) and isinstance(prov.get("fleet"), dict):
+            block = prov
+            break
+    if block is None:
+        sys.stderr.write(
+            f"[obsctl] {args.target}: no fleet provider in /healthz "
+            f"(providers: {sorted(doc.get('providers') or {})}) — start a "
+            "FleetController in the exporter's process\n")
+        return 1
+    if args.json:
+        print(json.dumps(block, indent=1))
+        return 0
+    fl = block["fleet"]
+    stats = fl.get("stats") or {}
+    print(f"[fleet] {args.target}  replicas={fl.get('replicas')}/"
+          f"target {fl.get('replicas_target')}  "
+          f"healthy={fl.get('healthy')}  bounds=[{fl.get('min_replicas')},"
+          f"{fl.get('max_replicas')}]  ok={block.get('ok')}")
+    print(f"  version: {fl.get('version') or '-'}"
+          + (f"  (previous: {fl.get('previous_version')})"
+             if fl.get("previous_version") else ""))
+    auto = fl.get("autoscaler") or {}
+    last = auto.get("last_decision") or {}
+    streak = auto.get("streak") or {}
+    print(f"  autoscaler: {'running' if auto.get('running') else 'stopped'}"
+          f" (interval {auto.get('interval_s')}s, streak "
+          f"hot={streak.get('hot')} idle={streak.get('idle')})")
+    print(f"  last decision: {last.get('action') or 'none'} — "
+          f"{last.get('reason')}"
+          + (f" ({last.get('age_s')}s ago)"
+             if last.get("age_s") is not None else ""))
+    ro = fl.get("rollout") or {}
+    print(f"  rollout: {ro.get('state')}"
+          + (f"  candidate={ro.get('version')}"
+             if ro.get("state") not in (None, "idle") else "")
+          + (f"  replica={ro.get('replica')}" if ro.get("replica") else "")
+          + (f"  reasons={'; '.join(ro.get('reasons') or [])}"
+             if ro.get("reasons") else ""))
+    print(f"  scale: ups={stats.get('scale_ups')} "
+          f"downs={stats.get('scale_downs')} "
+          f"failures={stats.get('scale_up_failures')} "
+          f"last_scaleup_to_healthy="
+          f"{stats.get('scaleup_to_healthy_s')}s  "
+          f"rollouts={stats.get('rollouts')} "
+          f"rollbacks={stats.get('rollbacks')}")
+    burn = fl.get("slo_burn") or {}
+    if burn.get("enabled"):
+        for key in ("ttft", "tpot"):
+            b = burn.get(key) or {}
+            if b.get("enabled"):
+                print(f"  slo_burn.{key}: target={b.get('target_ms')}ms "
+                      f"violations={b.get('violations')}/"
+                      f"{b.get('requests')} burn={b.get('burn')}")
+    versions = fl.get("versions") or {}
+    reps = block.get("replicas") or {}
+    if reps:
+        print(f"  {'replica':<10}{'ok':<5}{'rotation':<10}{'breaker':<11}"
+              f"{'est_wait':>9}  version")
+        for name, r in sorted(reps.items()):
+            est = r.get("est_wait_s")
+            print(f"  {name[:10]:<10}{str(bool(r.get('ok'))):<5}"
+                  f"{'in' if r.get('in_rotation') else 'OUT':<10}"
+                  f"{str(r.get('breaker'))[:11]:<11}"
+                  f"{'-' if est is None else format(est, '.3f'):>9}  "
+                  f"{versions.get(name) or '-'}")
+    return 0
+
+
 def cmd_aggregate(args) -> int:
     from paddlepaddle_tpu.observability.aggregate import (
         merge_prometheus_texts,
@@ -455,6 +546,14 @@ def main(argv=None) -> int:
                    help="journeys to list (default 20)")
     p.add_argument("--timeout", type=float, default=5.0)
     p.set_defaults(fn=cmd_requests)
+
+    p = sub.add_parser("fleet",
+                       help="render one exporter's fleet-controller block")
+    p.add_argument("target", help="host:port or URL of a per-rank exporter")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw provider JSON instead of the table")
+    p.add_argument("--timeout", type=float, default=5.0)
+    p.set_defaults(fn=cmd_fleet)
 
     p = sub.add_parser("aggregate",
                        help="merge /metrics from several exporters")

@@ -139,6 +139,19 @@ def serving_metrics(doc: dict) -> Dict[str, Tuple[float, str]]:
         put("serving.spec_tok_s", spec.get("aggregate_tok_s"), HIGHER)
         put("serving.spec_ttft_p50_ms", spec.get("ttft_p50_ms"), LOWER)
         put("serving.spec_tpot_ms", spec.get("tpot_ms"), LOWER)
+    # elastic-fleet column (serving_bench --traffic [--autoscale]): the
+    # post-step TTFT p99 is the SLO the autoscaler must hold through a
+    # traffic step; dropped_requests is a HARD ZERO floor (the zero-LOWER-
+    # baseline rule below makes ANY growth an infinite regression — the
+    # fleet's zero-drop invariant is not a 25%-budget number); the
+    # scale-up wall is the bundle-armed bring-up time — it creeping up
+    # means replicas stopped arming from the AOT bundle/cache
+    fl = body.get("traffic")
+    if isinstance(fl, dict):
+        put("fleet.step_ttft_p99_ms", fl.get("step_ttft_p99_ms"), LOWER)
+        put("fleet.dropped_requests", fl.get("dropped_requests"), LOWER)
+        put("fleet.scaleup_to_healthy_s",
+            fl.get("scaleup_to_healthy_s"), LOWER)
     # tensor-parallel column (serving_bench --tp N): throughput up, TTFT/
     # TPOT down — a plan change that tanks the tp engine must not pass
     tp = body.get("tp")

@@ -25,6 +25,13 @@
 #     the dead replica's breaker opens then re-admits after restart, and a
 #     full rolling restart drops zero requests
 #     (test_router.py::test_chaos_kill_one_replica_under_mixed_load)
+#   * elastic fleet: a 4x open-loop traffic step lands WHILE a deploy
+#     rollout walks a real-engine fleet and one replica is preempted
+#     (killed abruptly) mid-rollout — every submitted future resolves
+#     completed-or-typed, the autoscaler reaches its target count, and
+#     the rollout completes or rolls back cleanly (never a mixed-version
+#     fleet)
+#     (test_fleet.py::test_chaos_4x_step_during_rollout_with_preemption)
 #   * tp fleet: two TENSOR-PARALLEL (mesh mp2) replicas behind the router
 #     under a serving.decode storm — zero lost futures, rolling restart of
 #     tp engines comes back healthy

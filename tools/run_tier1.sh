@@ -28,6 +28,18 @@
 # perf_gate's coldstart.* lower-is-better metrics and BASELINE.md; use
 # --preset tiny as the quick smoke).
 #
+# Elastic-fleet suite: tests/test_fleet.py runs its fast half here
+# (policy hysteresis/cooldown/bounds units, dynamic router membership
+# with bounded rendezvous key movement, scale-up/down over fake static
+# engines, the scale-cycle provider-leak + stale-breaker pin, deploy
+# promote/reject/rollback pins incl. the rollback-on-mid-rollout-
+# regression acceptance test, obsctl fleet rendering, open-loop traffic
+# helpers, perf_gate fleet.* fields — ~10 s, all fake-replica based);
+# the real-engine 4x-step-during-rollout + preemption drill is
+# chaos+slow-marked (tools/run_chaos.sh). The measured artifact comes
+# from `python tools/serving_bench.py --traffic step:4@10 --autoscale
+# MIN:MAX` (BASELINE.md "Elastic fleet").
+#
 # Speculative-decoding suite: tests/test_speculative.py runs its fast
 # half here (token-exact greedy parity weak-draft + self-draft, rollback
 # page accounting, cancel mid-speculation, warmup -> compile-free serve

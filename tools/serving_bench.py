@@ -37,6 +37,16 @@ aggregate, the failover count, and **availability**
 interesting one here: prefix-affine routing must keep the hit rate
 fleet-wide, not divide it by N.
 
+``--traffic step:<mult>@<t>|poisson:<rate>`` switches to an OPEN-LOOP
+arrival schedule (submissions land on the wall clock regardless of
+completions — the closed loop above hides queueing collapse) and reports
+per-window tok/s, TTFT p99 and dropped count; ``--autoscale MIN:MAX``
+arms a :class:`~paddlepaddle_tpu.inference.fleet.FleetController` over
+the ``--replicas`` initial fleet so the 4x-step claim (BASELINE.md
+"Elastic fleet") is measurable: ``tools/perf_gate.py`` gates
+``fleet.step_ttft_p99_ms`` lower-is-better, ``fleet.dropped_requests``
+as a hard zero floor, and ``fleet.scaleup_to_healthy_s`` lower-is-better.
+
 Reports KV-pool occupancy, prefix hit rate and peak concurrency next to
 the TTFT/TPOT SLO columns; ``tools/perf_gate.py`` gates the JSON artifact.
 
@@ -55,6 +65,146 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 from paddlepaddle_tpu.inference.serving import ServingEngine, slo_summary
+
+
+# -- open-loop arrival profiles (--traffic) ----------------------------------
+#
+# The closed-loop runs above submit everything at t=0 and wait: they measure
+# steady-state packing, but they HIDE queueing collapse — a fleet that takes
+# 30s to absorb a burst still posts a fine aggregate tok/s. The open-loop
+# profiles submit on a wall-clock ARRIVAL schedule regardless of completions
+# (the "fleet absorbs a 4x traffic step" claim is only measurable this way):
+#
+#   step:<mult>@<t>   deterministic arrivals at --rate req/s, multiplied by
+#                     <mult> from <t> seconds in (the autoscaler drill)
+#   poisson:<rate>    memoryless arrivals at <rate> req/s (burstier than the
+#                     deterministic schedule at the same mean)
+
+def parse_traffic(spec):
+    """'step:<mult>@<t>' | 'poisson:<rate>' -> profile dict."""
+    kind, _, rest = spec.partition(":")
+    try:
+        if kind == "step":
+            mult, sep, at = rest.partition("@")
+            if not sep:
+                raise ValueError("step needs <mult>@<t>")
+            return {"kind": "step", "mult": float(mult), "at_s": float(at)}
+        if kind == "poisson":
+            return {"kind": "poisson", "rate": float(rest)}
+    except ValueError as e:
+        raise ValueError(
+            f"unrecognized --traffic spec {spec!r}: {e} "
+            "(expected step:<mult>@<t> or poisson:<rate>)") from None
+    raise ValueError(
+        f"unrecognized --traffic profile {kind!r} "
+        "(expected step:<mult>@<t> or poisson:<rate>)")
+
+
+def arrival_offsets(traffic, base_rate, n, rng):
+    """``n`` submit-time offsets (seconds from start) for the profile."""
+    out, t = [], 0.0
+    if traffic["kind"] == "poisson":
+        for _ in range(n):
+            t += float(rng.exponential(1.0 / traffic["rate"]))
+            out.append(t)
+        return out
+    for _ in range(n):
+        rate = base_rate * (traffic["mult"] if t >= traffic["at_s"] else 1.0)
+        t += 1.0 / rate
+        out.append(t)
+    return out
+
+
+def _pct(vals, q):
+    vals = sorted(vals)
+    if not vals:
+        return None
+    return vals[min(len(vals) - 1, int(q * (len(vals) - 1) + 0.5))]
+
+
+def _ms(v):
+    return None if v is None else round(v * 1e3, 2)
+
+
+def traffic_summary(records, traffic, window_s=1.0):
+    """Headline + per-window rows from open-loop request records
+    (``t_submit``/``outcome``/``ttft_s``/``tokens``/``t_done`` per
+    request). ``dropped_requests`` counts every submitted request that
+    did NOT resolve completed (typed sheds AND failures — the zero-drop
+    claim admits neither); ``step_ttft_p99_ms`` is the TTFT p99 over
+    requests arriving AT OR AFTER the step (the post-step SLO the
+    autoscaler must hold)."""
+    ok = [r for r in records if r.get("outcome") == "ok"]
+    ttfts = [r["ttft_s"] for r in ok if r.get("ttft_s") is not None]
+    at = traffic["at_s"] if traffic["kind"] == "step" else 0.0
+    post = [r["ttft_s"] for r in ok
+            if r.get("ttft_s") is not None and r["t_submit"] >= at]
+    windows = {}
+
+    def wrow(w):
+        return windows.setdefault(w, {
+            "t_s": round(w * window_s, 3), "submitted": 0, "completed": 0,
+            "dropped": 0, "tokens": 0, "_ttfts": []})
+
+    for r in records:
+        row = wrow(int(r["t_submit"] // window_s))
+        row["submitted"] += 1
+        if r.get("outcome") == "ok":
+            if r.get("ttft_s") is not None:
+                row["_ttfts"].append(r["ttft_s"])
+        else:
+            row["dropped"] += 1
+    for r in ok:
+        # throughput is attributed to the window the tokens LANDED in
+        row = wrow(int(r.get("t_done", r["t_submit"]) // window_s))
+        row["completed"] += 1
+        row["tokens"] += int(r.get("tokens") or 0)
+    rows = []
+    for w in sorted(windows):
+        row = windows[w]
+        row["tok_s"] = round(row.pop("tokens") / window_s, 1)
+        row["ttft_p99_ms"] = _ms(_pct(row.pop("_ttfts"), 0.99))
+        rows.append(row)
+    return {
+        "submitted": len(records),
+        "completed": len(ok),
+        "dropped_requests": len(records) - len(ok),
+        "ttft_p50_ms": _ms(_pct(ttfts, 0.50)),
+        "ttft_p99_ms": _ms(_pct(ttfts, 0.99)),
+        "step_ttft_p99_ms": _ms(_pct(post, 0.99)),
+        "window_s": window_s,
+        "windows": rows,
+    }
+
+
+def run_open_loop(submit, prompts, offsets, args):
+    """Drive ``submit`` on the arrival schedule; one record per request."""
+    records, pending = [], []
+    t0 = time.perf_counter()
+    for (p, pl), off in zip(prompts, offsets):
+        lag = off - (time.perf_counter() - t0)
+        if lag > 0:
+            time.sleep(lag)
+        rec = {"t_submit": round(time.perf_counter() - t0, 4)}
+        records.append(rec)
+        try:
+            fut = submit(p, max_new_tokens=args.new_tokens, prefix_len=pl)
+        except Exception as e:  # noqa: BLE001 — a refusal IS the datum
+            rec.update(outcome="refused", error=type(e).__name__)
+            continue
+        pending.append((p, fut, rec))
+    for p, fut, rec in pending:
+        try:
+            out = fut.result(1800)
+        except Exception as e:  # noqa: BLE001
+            rec.update(outcome="failed", error=type(e).__name__)
+        else:
+            slo = fut.slo()
+            rec.update(outcome="ok", tokens=len(out) - len(p),
+                       ttft_s=slo["ttft_s"],
+                       t_done=round(rec["t_submit"]
+                                    + (slo["latency_s"] or 0.0), 4))
+    return records, round(time.perf_counter() - t0, 2)
 
 
 def build_model(args):
@@ -290,6 +440,105 @@ def run_fleet(model, prompts, args):
         router.stop()
 
 
+def run_traffic(model, prompts, args):
+    """Open-loop profile against one engine, a fixed router fleet
+    (--replicas N), or an AUTOSCALED fleet (--autoscale MIN:MAX arms a
+    FleetController whose replicas arm from the shared model; the row
+    then carries scaleup_to_healthy_s + the final census)."""
+    traffic = parse_traffic(args.traffic)
+    rng = np.random.default_rng(42)
+    offsets = arrival_offsets(traffic, args.rate, len(prompts), rng)
+
+    def engine_factory(version=None):
+        return ServingEngine(model, max_batch_size=args.slots,
+                             decode_chunk=args.chunk,
+                             kv_layout=args.kv_layout,
+                             kv_page_size=args.page_size,
+                             kv_num_pages=args.num_pages)
+
+    fc = router = eng = None
+    if args.autoscale:
+        from paddlepaddle_tpu.inference.fleet import (
+            FleetController,
+            FleetPolicy,
+        )
+
+        lo, _, hi = args.autoscale.partition(":")
+        lo, hi = int(lo), int(hi)
+        policy = FleetPolicy(
+            min_replicas=lo, max_replicas=hi,
+            scale_up_est_wait_s=args.scale_est_wait,
+            up_streak=2, down_streak=20,
+            cooldown_up_s=2.0, cooldown_down_s=60.0,
+            interval_s=0.25, health_timeout_s=300.0,
+            drain_timeout_s=30.0)
+        fc = FleetController(engine_factory,
+                             initial_replicas=max(args.replicas, lo),
+                             policy=policy, probe_interval_s=0.2)
+        fc.start(autoscaler=False)   # warm first, scale later
+        engines = [rep.client.engine for rep in fc.router._replicas]
+        submit = fc.submit
+    elif args.replicas > 1:
+        from paddlepaddle_tpu.inference.router import ServingRouter
+
+        router = ServingRouter([engine_factory
+                                for _ in range(args.replicas)],
+                               probe_interval_s=0.2)
+        router.start()
+        engines = [rep.client.engine for rep in router._replicas]
+        submit = router.submit
+    else:
+        eng = engine_factory()
+        engines = [eng]
+        submit = eng.submit
+    try:
+        for e in engines:
+            warm_engine(e, model, prompts, args)
+        if fc is not None:
+            fc.start()               # autoscaler loop joins, warmed
+        records, wall = run_open_loop(submit, prompts, offsets, args)
+        row = {"traffic": args.traffic, "rate": args.rate,
+               "replicas": (len(fc.router._replicas) if fc is not None
+                            else args.replicas),
+               "wall_s": wall}
+        row.update(traffic_summary(records, traffic, args.window))
+        if fc is not None:
+            h = fc.health()["fleet"]
+            row["autoscale"] = args.autoscale
+            row["replicas_initial"] = max(args.replicas, lo)
+            row["replicas_final"] = h["replicas"]
+            row["scale_ups"] = h["stats"]["scale_ups"]
+            row["scale_downs"] = h["stats"]["scale_downs"]
+            row["scaleup_to_healthy_s"] = h["stats"]["scaleup_to_healthy_s"]
+        return row
+    finally:
+        if fc is not None:
+            fc.stop()
+        elif router is not None:
+            router.stop()
+        else:
+            eng.stop()
+
+
+def fmt_traffic(row):
+    print(f"open-loop {row['traffic']:<14} rate={row['rate']}/s  "
+          f"completed={row['completed']}/{row['submitted']}  "
+          f"dropped={row['dropped_requests']}  "
+          f"ttft p99={row['ttft_p99_ms']}ms  "
+          f"post-step p99={row['step_ttft_p99_ms']}ms"
+          + (f"  scaleup_to_healthy={row['scaleup_to_healthy_s']}s "
+             f"(replicas {row['replicas_initial']}->"
+             f"{row['replicas_final']})"
+             if "scaleup_to_healthy_s" in row else ""))
+    print(f"  {'t(s)':>6}{'subm':>6}{'done':>6}{'drop':>6}{'tok/s':>9}"
+          f"{'ttft p99(ms)':>14}")
+    for w in row["windows"]:
+        print(f"  {w['t_s']:>6.1f}{w['submitted']:>6}{w['completed']:>6}"
+              f"{w['dropped']:>6}{w['tok_s']:>9.1f}"
+              f"{'-' if w['ttft_p99_ms'] is None else w['ttft_p99_ms']:>14}")
+    sys.stdout.flush()
+
+
 def fmt_fleet(row):
     print(f"fleet x{row['replicas']:<14} {row['aggregate_tok_s']:8.1f} "
           f"tok/s  availability={row['availability']:.3f}  "
@@ -352,6 +601,26 @@ def main():
                     help="route the workload through a ServingRouter over "
                     "N replica engines (per-replica + fleet tokens/s, "
                     "failovers, availability)")
+    ap.add_argument("--traffic", default=None,
+                    help="OPEN-LOOP arrival profile instead of the "
+                    "closed-loop submit-all: step:<mult>@<t> (base --rate "
+                    "req/s multiplied by <mult> from <t> seconds in) or "
+                    "poisson:<rate>; reports per-window tok/s + TTFT p99 "
+                    "+ dropped count (the queueing-collapse signal the "
+                    "closed loop hides)")
+    ap.add_argument("--rate", type=float, default=4.0,
+                    help="base arrival rate req/s for --traffic "
+                    "(default 4)")
+    ap.add_argument("--window", type=float, default=1.0,
+                    help="--traffic reporting window seconds (default 1)")
+    ap.add_argument("--autoscale", default=None, metavar="MIN:MAX",
+                    help="arm a FleetController over the --replicas "
+                    "initial fleet (requires --traffic): SLO/est-wait "
+                    "autoscaling between MIN and MAX replicas; the row "
+                    "adds scaleup_to_healthy_s + the final census")
+    ap.add_argument("--scale-est-wait", type=float, default=0.5,
+                    help="autoscaler scale-up est-wait bound seconds "
+                    "(default 0.5)")
     ap.add_argument("--tp", type=int, default=1,
                     help="also run the workload through a TENSOR-PARALLEL "
                     "engine (mesh mp<N>, weights + kv heads sharded) and "
@@ -401,6 +670,24 @@ def main():
     if args.tp > 1 and (args.replicas > 1 or args.ab):
         ap.error("--tp compares one engine against its tensor-parallel "
                  "form; run it with --replicas 1 and without --ab")
+
+    if args.autoscale:
+        if not args.traffic:
+            ap.error("--autoscale needs an open-loop --traffic profile "
+                     "(a closed loop cannot exercise the scale signal)")
+        lo, sep, hi = args.autoscale.partition(":")
+        if not sep or not lo.isdigit() or not hi.isdigit():
+            ap.error(f"--autoscale expects MIN:MAX (e.g. 2:4), "
+                     f"got {args.autoscale!r}")
+    if args.traffic:
+        if args.ab or args.tp > 1 or args.spec_k > 0:
+            ap.error("--traffic is the open-loop profile; run it without "
+                     "--ab/--tp/--spec-k")
+        row = run_traffic(model, prompts, args)
+        fmt_traffic(row)
+        body["traffic"] = row
+        print(json.dumps({"serving_bench": body}))
+        return
 
     if args.replicas > 1:
         if args.ab:
