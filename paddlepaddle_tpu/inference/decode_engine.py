@@ -148,7 +148,8 @@ class BatchDecodeEngine:
                  page_size: int = 64, num_pages: Optional[int] = None,
                  prefix_cache: bool = True, mesh=None, plan=None,
                  bundle: Optional[str] = None, draft=None, spec_k: int = 0,
-                 draft_quant: Optional[str] = None):
+                 draft_quant: Optional[str] = None,
+                 fused_kernels: Optional[bool] = None):
         cfg = model.config
         if kv_layout not in ("paged", "contiguous"):
             raise ValueError(
@@ -292,6 +293,13 @@ class BatchDecodeEngine:
                                            draft_quant=draft_quant)
             self._spec_steps_per_chunk = max(
                 1, self.chunk // (self.spec.k + 1))
+        # fused Pallas kernels (ops/kernels/paged_attention.py): resolved
+        # ONCE here — the decision (off / interpret / compiled /
+        # fallback+reason) is immutable engine state that joins the
+        # CompilePlan fingerprint, so a bundle built under a different
+        # kernel config is rejected loudly at load instead of silently
+        # serving a different program
+        self.fused = self._resolve_fused(fused_kernels)
         self.compile_plan = _cp.CompilePlan.for_engine(self)
         if bundle is not None:
             # never fatal: a stale/foreign bundle logs and falls back to
@@ -374,6 +382,76 @@ class BatchDecodeEngine:
         decoding is off."""
         return {"enabled": False} if self.spec is None else self.spec.info()
 
+    # -- fused kernels -------------------------------------------------------
+    def _llama_shaped_layers(self) -> bool:
+        """The fused decode path drives the layer's submodules directly
+        (projections, norms, mlp); anything not llama-decoder-shaped —
+        or carrying extra residual branches (shared_mlp) the fused loop
+        would silently skip — must fall back to the reference path."""
+        try:
+            layer = self.model.model.layers[0]
+            mdl = self.model.model
+        except Exception:
+            return False
+        attn = getattr(layer, "self_attn", None)
+        return (all(hasattr(attn, a)
+                    for a in ("q_proj", "k_proj", "v_proj", "o_proj"))
+                and all(hasattr(layer, a)
+                        for a in ("input_layernorm",
+                                  "post_attention_layernorm", "mlp"))
+                and getattr(layer, "shared_mlp", None) is None
+                and all(hasattr(mdl, a)
+                        for a in ("embed_tokens", "norm", "rope_cos",
+                                  "rope_sin")))
+
+    def _resolve_fused(self, fused_kernels: Optional[bool]) -> Dict[str, object]:
+        """Resolve the fused-kernel config for this engine: explicit
+        argument wins, else ``FLAGS_fused_kernels``. Requested-but-
+        unsupported is a LOUD non-fatal fallback (one stderr line + a
+        labeled counter) to the reference formulation — never a silent
+        behavior change and never wrong results."""
+        from ..core.flags import flag_value
+
+        want = (flag_value("fused_kernels") if fused_kernels is None
+                else bool(fused_kernels))
+        info: Dict[str, object] = {"enabled": False,
+                                   "paged_attention": "off"}
+        if not want:
+            return info
+        from ..ops.kernels import paged_attention as _pa
+
+        if self.kv_layout != "paged":
+            ok, reason = False, "kv_layout contiguous (no page table)"
+        else:
+            ok, reason = _pa.paged_attention_supported(
+                page_size=self.page_size, head_dim=self.cfg.head_dim,
+                num_heads=self.cfg.num_attention_heads,
+                num_kv_heads=self.cfg.num_key_value_heads, plan=self.plan)
+            if ok and not self._llama_shaped_layers():
+                ok, reason = False, "model layers not llama-decoder-shaped"
+        if ok:
+            mode = "interpret" if _pa.interpret_mode() else "compiled"
+            info.update(enabled=True, paged_attention=mode)
+            return info
+        info["paged_attention"] = f"fallback: {reason}"
+        sys.stderr.write(
+            f"[serving] fused paged-attention kernel unavailable "
+            f"({reason}); serving the reference pool[page_table] "
+            "formulation\n")
+        _safe_inc("paddle_fused_kernel_fallbacks_total",
+                  "fused-kernel requests that fell back to the reference "
+                  "formulation", kernel="paged_attention",
+                  reason=reason.split(" ")[0])
+        _flight_record("compile", "fused_fallback",
+                       kernel="paged_attention", reason=reason)
+        return info
+
+    def fused_info(self) -> Dict[str, object]:
+        """The ``fused`` block of ``health()``/``/healthz``: which fused
+        kernels this engine decodes through (and why not, when it fell
+        back)."""
+        return dict(self.fused)
+
     # -- compiled pieces ----------------------------------------------------
     def _forward(self, params, toks, caches, pos):
         """One model step: toks [b, s] -> (logits, caches')."""
@@ -408,6 +486,9 @@ class BatchDecodeEngine:
             pos < L,
             page_table[jnp.broadcast_to(rows, pos.shape), page_idx], 0)
         off = pos % ps
+        if self.fused.get("enabled"):
+            return self._forward_paged_fused(params, toks, pools,
+                                             page_table, lens, phys, off)
         with _ag.no_grad(), self.model.bind_state(params):
             mdl = self.model.model
             x = mdl.embed_tokens(toks)
@@ -423,6 +504,59 @@ class BatchDecodeEngine:
                 kc, vc = unwrap(kc), unwrap(vc)
                 kp = kp.at[phys, off].set(kc[rows, pos_g])
                 vp = vp.at[phys, off].set(vc[rows, pos_g])
+                new_pools.append((kp, vp))
+            hidden = mdl.norm(x)
+            if self.model.lm_head is None:
+                logits = unwrap(hidden) @ unwrap(mdl.embed_tokens.weight).T
+            else:
+                logits = unwrap(self.model.lm_head(hidden))
+        return logits, new_pools
+
+    def _forward_paged_fused(self, params, toks, pools, page_table, lens,
+                             phys, off):
+        """The fused-kernel form of :meth:`_forward_paged`: identical
+        math (same projections, rope offsets, write positions and causal
+        rule — parity is test-pinned token-exact), but each layer
+        scatters the W new K/V rows straight to their physical pages and
+        the attention WALKS THE PAGE TABLE IN-KERNEL
+        (ops/kernels/paged_attention.py) instead of materializing
+        ``pool[page_table]`` in HBM. The layer loop drives the llama
+        submodules directly — `_resolve_fused` verified the shape."""
+        import math as _math
+
+        from ..models.llama import _apply_rope
+        from ..ops.kernels.paged_attention import paged_attention
+
+        S = self.S
+        W = toks.shape[1]
+        cfg = self.cfg
+        nh, kvh, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                       cfg.head_dim)
+        rep = nh // kvh
+        scale = 1.0 / _math.sqrt(hd)
+        interp = self.fused.get("paged_attention") == "interpret"
+        with _ag.no_grad(), self.model.bind_state(params):
+            mdl = self.model.model
+            x = mdl.embed_tokens(toks)
+            cos, sin = mdl.rope_cos, mdl.rope_sin
+            new_pools = []
+            for layer, (kp, vp) in zip(mdl.layers, pools):
+                attn = layer.self_attn
+                h_pre = layer.input_layernorm(x)
+                q = attn.q_proj(h_pre).reshape([S, W, nh, hd])
+                k = attn.k_proj(h_pre).reshape([S, W, kvh, hd])
+                v = attn.v_proj(h_pre).reshape([S, W, kvh, hd])
+                q, k = _apply_rope(q, k, cos, sin, offset=lens)
+                # write first, then attend: the causal mask admits this
+                # step's own positions, exactly like the reference
+                # view-write in _cached_attention
+                kp = kp.at[phys, off].set(unwrap(k).astype(kp.dtype))
+                vp = vp.at[phys, off].set(unwrap(v).astype(vp.dtype))
+                out = paged_attention(unwrap(q), kp, vp, page_table, lens,
+                                      rep=rep, scale=scale,
+                                      interpret=interp)
+                x = x + attn.o_proj(out.reshape(S, W, nh * hd))
+                x = x + layer.mlp(layer.post_attention_layernorm(x))
                 new_pools.append((kp, vp))
             hidden = mdl.norm(x)
             if self.model.lm_head is None:
@@ -1303,6 +1437,12 @@ class BatchDecodeEngine:
         args = self._decode_args()
         p = _perf()
         perf_on = p is not None and p.enabled()
+        # fused engines get their own cost-registry bucket so an A/B in
+        # one process records the reference and fused decode programs as
+        # SEPARATE rows — the hbm_bytes delta between them is the
+        # data-movement claim the kernel makes (docs/kernels.md)
+        cost_bucket = (f"s{self.S}c{self.chunk}"
+                       + ("-fused" if self.fused.get("enabled") else ""))
         if perf_on and not self._decode_captured:
             self._decode_captured = True    # capture attempted once only
             # lower (no backend compile) a 1-step variant and scale by
@@ -1310,8 +1450,9 @@ class BatchDecodeEngine:
             # chunk program's own count would under-report by ~chunk
             p.cost_of_lowered(
                 "serving.decode", jax.jit(self._decode_program(1)), args,
-                bucket=f"s{self.S}c{self.chunk}", scale=float(self.chunk),
-                quant=self.quant or "off", slots=self.S, chunk=self.chunk)
+                bucket=cost_bucket, scale=float(self.chunk),
+                quant=self.quant or "off", slots=self.S, chunk=self.chunk,
+                fused=self.fused.get("paged_attention", "off"))
         # chunks right after an admission also pay the _collect_firsts
         # readback inside this window; only PURE decode chunks are folded
         # into the program's wall, so wall_min measures the decode
@@ -1334,7 +1475,7 @@ class BatchDecodeEngine:
             # the packed readback IS this chunk's host sync, so the wall
             # is real device time (plus the per-call link floor)
             p.observe("serving.decode", time.perf_counter() - t0,
-                      bucket=f"s{self.S}c{self.chunk}")
+                      bucket=cost_bucket)
         em, act = pk[:, :-1], pk[:, -1].astype(bool)
         t_sync = None
         for slot, s in enumerate(self._host_slots):

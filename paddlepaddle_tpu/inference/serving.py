@@ -391,7 +391,8 @@ class ServingEngine:
                  bundle: Optional[str] = None,
                  draft=None,
                  spec_k: int = 0,
-                 draft_quant: Optional[str] = None):
+                 draft_quant: Optional[str] = None,
+                 fused_kernels: Optional[bool] = None):
         if mode not in ("continuous", "static"):
             raise ValueError(f"mode must be 'continuous' or 'static', got {mode!r}")
         if (draft is not None or spec_k) and mode != "continuous":
@@ -473,7 +474,7 @@ class ServingEngine:
                 page_size=kv_page_size, num_pages=kv_num_pages,
                 prefix_cache=prefix_cache, mesh=mesh, plan=plan,
                 bundle=bundle, draft=draft, spec_k=spec_k,
-                draft_quant=draft_quant)
+                draft_quant=draft_quant, fused_kernels=fused_kernels)
             self._spec_enabled = self._engine.spec is not None
             if self._spec_enabled:
                 self._announce_spec()
@@ -824,6 +825,11 @@ class ServingEngine:
             # the speculation is actually paying for its draft overhead
             "spec": (self._engine.spec_info() if self._engine is not None
                      else {"enabled": False}),
+            # fused Pallas kernels (docs/kernels.md): which data-movement
+            # kernels this engine decodes through — "off", "interpret"
+            # (CPU), "compiled" (TPU) or "fallback: <reason>"
+            "fused": (self._engine.fused_info() if self._engine is not None
+                      else {"enabled": False}),
             # replica parallelism for the fleet router / /metrics: mesh
             # axes+devices and the tp degree this engine decodes at
             "mesh": mesh,

@@ -39,7 +39,9 @@ class MoEConfig:
     # "sorted" (counting-sort + static capacity buffers + batched einsum,
     # single-chip perf; default) | "dropless" (ragged_dot, no token drops) |
     # "einsum" (GShard one-hot, cleanest ep-sharded SPMD lowering — use for
-    # ep meshes) — see parallel.moe.MoELayer
+    # ep meshes) | "fused" (Pallas gather-GEMM dispatch kernel: indices
+    # read in-kernel, no HBM-resident gathered activations; loud fallback
+    # to "sorted" on unsupported configs) — see parallel.moe.MoELayer
     dispatch_mode: str = "sorted"
 
     def as_llama(self) -> LlamaConfig:

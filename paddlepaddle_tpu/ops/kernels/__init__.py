@@ -4,4 +4,22 @@ These are the TPU-native equivalents of the reference's fused CUDA kernels
 (paddle/phi/kernels/fusion/gpu/: flash-attn via dynload, fused_rope,
 fused_rms_norm, fused_bias_act …). Each kernel has an XLA fallback used on
 CPU (tests run on a virtual CPU mesh) and when FLAGS_use_pallas_kernels=0.
+
+The FLAGS_fused_kernels family (gather_gemm.py + paged_attention.py —
+the two measured data-movement floors, docs/kernels.md) additionally runs
+in Pallas INTERPRET mode on CPU so parity is test-pinned in the tier-1
+environment, and falls back LOUDLY to the reference formulation on any
+unsupported config.
 """
+
+
+def interpret_mode() -> bool:
+    """True when fused kernels must run under the Pallas interpreter —
+    any backend without a Mosaic compiler (the CPU tier-1 environment).
+    ONE definition for every kernel in this package: the backend list is
+    exactly the kind of literal that grows, and two copies drifting
+    would route one kernel compiled and another interpreted on the same
+    host."""
+    import jax
+
+    return jax.default_backend() not in ("tpu", "axon")

@@ -1,0 +1,153 @@
+"""Fused gather-GEMM MoE dispatch — Pallas TPU kernel reading expert
+inputs through the dispatch indices INSIDE the kernel (+ interpret-mode
+execution on CPU).
+
+The r5 decomposition (BASELINE.md "Round-5: MoE") ends at ~21 ms/step of
+dispatch data movement the XLA formulations cannot remove: the capacity
+path materializes the gathered ``[E*C, d]`` activations in HBM (written
+by the dispatch gather, read back by the first expert GEMM) and the two
+inner ``[E*C, 2h]``/``[E*C, h]`` FFN intermediates besides, and
+``ragged_dot``/megablox ``gmm`` measured 2-4x slower at these shapes
+(tools/moe_dispatch_bench.py). This kernel is the megablox-style move r5
+names: grid (expert, token-block); the dispatch indices ride in as a
+SCALAR-PREFETCH operand; each block DMAs its tokens' rows straight from
+``x`` in HBM into VMEM by index and runs the whole expert FFN
+(gate|up -> silu*mul -> down, f32 accumulation) before anything touches
+HBM again — the gathered activations and both FFN intermediates never
+exist in HBM. Per step the kernel writes only the ``[E*C, d]`` expert
+output the combine gather reads, cutting the formulation's HBM traffic
+by the three dropped round trips (the cost-registry rows in
+tools/moe_dispatch_bench.py are the verifier).
+
+Semantics are EXACTLY the capacity path's
+(:func:`~paddlepaddle_tpu.parallel.moe._gathered_capacity_moe_ffn`):
+static ``[E, C]`` slot buffers, tokens beyond capacity dropped, invalid
+slots (sentinel index) contributing zero rows. The backward pass is the
+reference gather formulation (recomputed; gather-only vjps) — fusing the
+two backward GEMMs is a named follow-up seam in docs/kernels.md, so
+training steps fuse the forward half today and inference/forward-only
+paths get the full win.
+
+Runs compiled on TPU backends and in Pallas interpret mode elsewhere
+(CPU tier-1), which is how parity vs the einsum dispatch is test-pinned
+without an accelerator (tests/test_fused_kernels.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ...core.flags import flag_value
+from . import interpret_mode
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+
+def gather_gemm_supported(*, d_model: int, d_hidden: int) -> tuple:
+    """(ok, reason) — the fallback matrix for the dispatch kernel; a
+    False routes the layer to the reference ``sorted`` formulation."""
+    if not _HAS_PALLAS:
+        return False, "pallas unavailable"
+    if not flag_value("fused_gather_gemm"):
+        return False, "FLAGS_fused_gather_gemm off"
+    if not interpret_mode():
+        # Mosaic wants lane-aligned GEMM operands; interpret mode (CPU
+        # tests) accepts any width so tiny parity configs still run
+        if d_model % 128 or d_hidden % 128:
+            return False, (f"d_model {d_model} / d_hidden {d_hidden} "
+                           "not 128-lane aligned")
+    return True, "ok"
+
+
+def _block_m(C: int) -> int:
+    """Token-block size: whole capacity when small, 128-row tiles when
+    large — always rounded up to a multiple of 8 so the (bm, d) VMEM
+    blocks stay sublane-aligned for Mosaic at ANY capacity (small C or
+    odd capacity_factor products; the wrapper pads the slack with
+    sentinel slots and slices it back off)."""
+    return 128 if C >= 128 else -(-C // 8) * 8
+
+
+def _gather_ffn_kernel(se_ref, x_ref, wgu_ref, wd_ref, o_ref,
+                       xb_ref, sems, *, block_m, n_tokens, d_hidden):
+    """Grid (expert e, token-block c): gather block_m rows of x by the
+    prefetched slot->token indices, run the expert FFN, write the block
+    of expert output. f32 accumulation on both GEMMs."""
+    e, c = pl.program_id(0), pl.program_id(1)
+    bm, h = block_m, d_hidden
+
+    def row_copy(i):
+        # sentinel (>= n_tokens) marks an unfilled slot: clamp the DMA to
+        # a real row (cheap) and zero it below — never an OOB gather
+        idx = jnp.minimum(se_ref[e, c * bm + i], n_tokens - 1)
+        return pltpu.make_async_copy(
+            x_ref.at[pl.ds(idx, 1), :], xb_ref.at[pl.ds(i, 1), :],
+            sems.at[i])
+
+    for i in range(bm):
+        row_copy(i).start()
+    for i in range(bm):
+        row_copy(i).wait()
+
+    valid = se_ref[e, pl.ds(c * bm, bm)] < n_tokens
+    xb = xb_ref[:].astype(jnp.float32) * valid[:, None].astype(jnp.float32)
+    gu = jnp.dot(xb, wgu_ref[0].astype(jnp.float32),
+                 preferred_element_type=jnp.float32)      # [bm, 2h]
+    hmid = jax.nn.silu(gu[:, :h]) * gu[:, h:]
+    out = jnp.dot(hmid, wd_ref[0].astype(jnp.float32),
+                  preferred_element_type=jnp.float32)     # [bm, d]
+    o_ref[0] = out.astype(o_ref.dtype)
+
+
+def gather_gemm_ffn(x, slot_entry, wgu, wd, *, capacity, interpret=None):
+    """Fused dispatch + expert FFN: returns ``out [E*capacity, d]`` in
+    x's dtype, out[e*C + c] = FFN_e(x[slot_entry[e*C + c]]) (zero where
+    slot_entry carries the >=T sentinel). ``wgu`` is the concatenated
+    ``[E, d, 2h]`` gate|up bank, ``wd`` the ``[E, h, d]`` down bank."""
+    T, d = x.shape
+    E, _, h2 = wgu.shape
+    h = h2 // 2
+    C = int(capacity)
+    if interpret is None:
+        interpret = interpret_mode()
+    bm = _block_m(C)
+    C_pad = -(-C // bm) * bm
+    se = jnp.asarray(slot_entry, jnp.int32).reshape(E, C)
+    if C_pad != C:
+        se = jnp.concatenate(
+            [se, jnp.full((E, C_pad - C), T, jnp.int32)], axis=1)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(E, C_pad // bm),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),          # x stays in HBM
+            pl.BlockSpec((1, d, h2), lambda e, c, se: (e, 0, 0)),
+            pl.BlockSpec((1, h, d), lambda e, c, se: (e, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bm, d), lambda e, c, se: (e, c, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((bm, d), x.dtype),                  # gathered rows
+            pltpu.SemaphoreType.DMA((bm,)),
+        ],
+    )
+    kernel = functools.partial(_gather_ffn_kernel, block_m=bm, n_tokens=T,
+                               d_hidden=h)
+    # the kernel body is dtype-explicit (int32 indices, f32 accumulators)
+    # so it traces identically with the package's global x64 on or off
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((E, C_pad, d), x.dtype),
+        interpret=interpret,
+    )(se, x, wgu, wd)
+    return out[:, :C, :].reshape(E * C, d)

@@ -1,0 +1,206 @@
+"""Paged-attention decode — Pallas TPU kernel walking the page table
+in-kernel (+ interpret-mode execution on CPU).
+
+The r7 paged-KV engine (inference/decode_engine.py `_forward_paged`)
+reaches each slot's logical KV view by MATERIALIZING ``pool[page_table]``
+in HBM every layer of every decode step — a real gather of
+``[slots, P*page_size, kvh, hd]`` bytes that exists only to be read once
+by attention and thrown away (BASELINE.md r7 budgets <=5% chunk overhead
+for it). This kernel removes the round trip the way PagedAttention
+(vLLM, arXiv:2309.06180) and the TPU flash kernels (r1-r4 exemplars in
+this directory) do: the page table rides in as a SCALAR-PREFETCH operand
+and the kernel's BlockSpec ``index_map`` walks it — grid step (slot s,
+page j) DMAs physical page ``page_table[s, j]`` straight from the pool
+into VMEM, so the gathered view never exists in HBM.
+
+Shape contract (the engine's decode/verify forward):
+
+* ``q``          — ``[S, W, h, hd]``: W new positions per slot (W=1 is
+  the chunked decode step; the speculative verify program runs W=k+1
+  through the same kernel).
+* ``k_pool/v_pool`` — ``[pages, page_size, kvh, hd]`` (page 0 is the
+  engine's sacrificial null page).
+* ``page_table`` — ``[S, P]`` int32 physical page per logical page.
+* ``lens``       — ``[S]`` int32: the slot's length BEFORE this step's
+  writes; query w attends keys ``k_pos <= lens + w`` (the same
+  bottom-right causal rule as the reference view math).
+
+Masking rules (the fallback-free safety story):
+
+* positions past ``lens + w`` are masked with -1e30 before the softmax —
+  garbage in not-yet-written page tails is never read into a result;
+* logical pages wholly beyond the slot's visible window have their
+  index_map REDIRECTED to physical page 0 (the null page), so a retired
+  slot's zeroed table row or an over-long walk costs one cached null-page
+  read, not a wild gather — and the mask discards whatever it held;
+* inactive slots (lens stale, table zeroed) compute masked garbage the
+  engine already discards host-side (`active` gating) — identical to the
+  reference formulation's behavior.
+
+One online-softmax pass per slot (f32 running max / denominator /
+accumulator in VMEM scratch), pages visited in logical order, K and V
+pages each read exactly once per step: HBM traffic drops from
+``gather(view) + attention-read`` to ``attention-read`` alone. The
+kernel runs compiled on TPU backends and in Pallas INTERPRET mode
+elsewhere (CPU tier-1: same program, emulated grid), which is how parity
+is test-pinned without an accelerator (tests/test_fused_kernels.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ...core.flags import flag_value
+from . import interpret_mode
+
+try:  # pallas import is cheap; kernels only compile when called on TPU
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+NEG_INF = -1e30
+
+
+def paged_attention_supported(*, page_size: int, head_dim: int,
+                              num_heads: int, num_kv_heads: int,
+                              plan=None) -> tuple:
+    """(ok, reason) — the fallback matrix for the decode kernel. The
+    engine calls this ONCE at construction; a False here is a loud
+    fallback to the reference ``pool[page_table]`` formulation, never a
+    silent behavior change (docs/kernels.md has the full matrix)."""
+    if not _HAS_PALLAS:
+        return False, "pallas unavailable"
+    if not flag_value("fused_paged_attention"):
+        return False, "FLAGS_fused_paged_attention off"
+    if plan is not None:
+        # sharded pools would need the kernel to see only the local KV
+        # shard + a head-offset — a named follow-up seam, not a silent
+        # wrong-results path
+        return False, "tensor-parallel plan (kernel is single-chip)"
+    if page_size < 8 or page_size % 8:
+        # sublane alignment: a [page_size, ...] VMEM block needs 8-row
+        # tiles on the MXU; enforced under interpret too so a CPU-tested
+        # config is exactly a TPU-servable config
+        return False, f"page_size {page_size} not a multiple of 8"
+    if num_heads % num_kv_heads:
+        return False, (f"num_heads {num_heads} not divisible by "
+                       f"num_kv_heads {num_kv_heads}")
+    if not (head_dim % 128 == 0 or head_dim in (8, 16, 32, 64)):
+        return False, f"head_dim {head_dim} not lane-aligned"
+    return True, "ok"
+
+
+def _paged_attn_kernel(pt_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+                       m_ref, l_ref, acc_ref, *, page_size, rep, scale,
+                       num_pages_per_slot):
+    """Grid (slot, logical page): online-softmax accumulate one page."""
+    s, j = pl.program_id(0), pl.program_id(1)
+    ps = page_size
+
+    @pl.when(j == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    qb = q_ref[0]                                  # [W, h, hd]
+    kb = k_ref[0].astype(jnp.float32)              # [ps, kvh, hd]
+    vb = v_ref[0].astype(jnp.float32)
+    W = qb.shape[0]
+    kvh, hd = kb.shape[1], kb.shape[2]
+
+    # bottom-right causal mask in pool coordinates: query w (at absolute
+    # position lens+w) sees keys k_pos <= lens + w — exactly the
+    # reference view math, including this step's own freshly written
+    # positions (the engine scatters new K/V before calling the kernel)
+    k_pos = j * ps + jax.lax.broadcasted_iota(jnp.int32, (W, ps), 1)
+    q_pos = lens_ref[s] + jax.lax.broadcasted_iota(jnp.int32, (W, ps), 0)
+    mask = k_pos <= q_pos
+
+    # GQA uncontracted: q regrouped [W, kvh, rep, hd] dots the unrepeated
+    # page (the r4 serving lesson — never materialize a repeated cache)
+    qg = (qb.reshape(W, kvh, rep, hd).astype(jnp.float32) * scale)
+    sblk = jax.lax.dot_general(
+        qg.transpose(1, 0, 2, 3).reshape(kvh, W * rep, hd),
+        kb.transpose(1, 2, 0),                     # [kvh, hd, ps]
+        dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)        # [kvh, W*rep, ps]
+    sblk = sblk.reshape(kvh, W, rep, ps).transpose(1, 0, 2, 3)
+    sblk = jnp.where(mask[:, None, None, :], sblk, NEG_INF)
+
+    m_prev, l_prev = m_ref[:], l_ref[:]            # [W, kvh, rep]
+    m_new = jnp.maximum(m_prev, jnp.max(sblk, axis=-1))
+    p = jnp.exp(sblk - m_new[..., None])
+    alpha = jnp.exp(m_prev - m_new)
+    l_ref[:] = l_prev * alpha + jnp.sum(p, axis=-1)
+    pv = jax.lax.dot_general(
+        p.transpose(1, 0, 2, 3).reshape(kvh, W * rep, ps),
+        vb.transpose(1, 0, 2),                     # [kvh, ps, hd]
+        dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)        # [kvh, W*rep, hd]
+    pv = pv.reshape(kvh, W, rep, hd).transpose(1, 0, 2, 3)
+    acc_ref[:] = acc_ref[:] * alpha[..., None] + pv
+    m_ref[:] = m_new
+
+    @pl.when(j == num_pages_per_slot - 1)
+    def _():
+        W_, kvh_, rep_, hd_ = acc_ref.shape
+        out = acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)[..., None]
+        o_ref[0] = out.reshape(W_, kvh_ * rep_, hd_).astype(o_ref.dtype)
+
+
+def paged_attention(q, k_pool, v_pool, page_table, lens, *, rep, scale,
+                    interpret=None):
+    """Attend ``q [S, W, h, hd]`` over each slot's paged KV through the
+    page table, in-kernel. Returns ``out [S, W, h, hd]`` in q's dtype.
+    New K/V for this step must already be scattered into the pool (the
+    engine writes pages first; the causal mask then admits them)."""
+    S, W, h, hd = q.shape
+    ps, kvh = k_pool.shape[1], k_pool.shape[2]
+    P = page_table.shape[1]
+    if interpret is None:
+        interpret = interpret_mode()
+
+    def idx_kv(s, j, pt, lens):
+        # logical pages wholly past the slot's visible window read the
+        # null page: a zeroed table row already points there, and
+        # clamping here keeps even a stale nonzero entry from pulling a
+        # real page into VMEM for fully-masked keys
+        visible = j * ps <= lens[s] + (W - 1)
+        return (jnp.where(visible, pt[s, j], 0), 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, P),
+        in_specs=[
+            pl.BlockSpec((1, W, h, hd), lambda s, j, pt, lens: (s, 0, 0, 0)),
+            pl.BlockSpec((1, ps, kvh, hd), idx_kv),
+            pl.BlockSpec((1, ps, kvh, hd), idx_kv),
+        ],
+        out_specs=pl.BlockSpec((1, W, h, hd),
+                               lambda s, j, pt, lens: (s, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((W, kvh, rep), jnp.float32),        # running max
+            pltpu.VMEM((W, kvh, rep), jnp.float32),        # denominator
+            pltpu.VMEM((W, kvh, rep, hd), jnp.float32),    # f32 accum
+        ],
+    )
+    kernel = functools.partial(
+        _paged_attn_kernel, page_size=ps, rep=rep, scale=scale,
+        num_pages_per_slot=P)
+    # the kernel body is dtype-explicit (int32 positions, f32
+    # accumulators) so it traces identically with the package's global
+    # x64 on or off
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, W, h, hd), q.dtype),
+        interpret=interpret,
+    )(jnp.asarray(page_table, jnp.int32), jnp.asarray(lens, jnp.int32),
+      q, k_pool, v_pool)

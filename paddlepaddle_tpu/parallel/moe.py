@@ -318,25 +318,16 @@ def _slot_combine_bwd(res, dy):
 _slot_combine.defvjp(_slot_combine_fwd, _slot_combine_bwd)
 
 
-def _gathered_capacity_moe_ffn(x, logits, wg, wu, wd, topk, capacity):
-    """Capacity-bounded fast dispatch — counting-sort routing + STATIC
-    [E, C, d] expert buffers run as batched einsums (XLA batches them on the
-    MXU with no ragged-size overhead), gather-only vjps.
-
-    This is the rewritten "sorted" mode: same capacity/drop semantics as the
-    reference fused-MoE path (fused_moe.py sorts tokens by expert into
-    capacity buffers), but with no lax.sort/top_k and no scatter anywhere.
-    Static shapes trade ~(capacity_factor-1) extra matmul rows for
-    ragged_dot's per-group overhead (tools/moe_dispatch_bench.py).
-    Returns (y [T, d], aux_loss).
-    """
-    T, d = x.shape
-    E = wg.shape[0]
+def _capacity_slot_maps(logits, topk, E, C, T):
+    """The capacity dispatch's routing + slot index maps, shared by the
+    sorted (einsum) and fused (gather-GEMM kernel) paths so their drop
+    semantics CANNOT drift: round-major entries (j = r*T + t — all first
+    choices fill capacity before any second choice, the einsum path's
+    shared-counter priority), counting-sorted, capacity-clipped. Returns
+    (gate_vals [T,k], aux, slots_of_entry [k,T], slot_valid [E*C],
+    slot_entry [E*C])."""
     N = T * topk
-    C = capacity
     gate_vals, expert_idx, aux = _route_topk_iter(logits, topk, E)
-    # round-major entries (j = r*T + t): all first choices fill capacity
-    # before any second choice — the einsum path's shared-counter priority
     fe = expert_idx.T.reshape(-1)
     dest, sidx, counts, offs = _counting_sort(fe, E)
     pos = dest - offs[fe]                               # rank within expert
@@ -345,17 +336,120 @@ def _gathered_capacity_moe_ffn(x, logits, wg, wu, wd, topk, capacity):
     c_of_slot = jnp.tile(jnp.arange(C, dtype=jnp.int32), E)
     slot_valid = c_of_slot < jnp.minimum(counts[e_of_slot], C)
     slot_entry = sidx[jnp.clip(offs[e_of_slot] + c_of_slot, 0, N - 1)]
+    return gate_vals, aux, slots_of_entry, slot_valid, slot_entry
+
+
+def _slot_combine_weighted(x, out, gate_vals, slots_of_entry, slot_entry,
+                           slot_valid):
+    """Shared combine epilogue: gather each entry's expert output and
+    gate-weight the k contributions back onto tokens."""
+    contrib = _slot_combine(out, slots_of_entry, slot_entry, slot_valid)
+    return (contrib
+            * jnp.swapaxes(gate_vals, 0, 1).astype(x.dtype)[..., None]
+            ).sum(0)
+
+
+def _gathered_capacity_moe_ffn(x, logits, wg, wu, wd, topk, capacity):
+    """Capacity-bounded fast dispatch — counting-sort routing + STATIC
+    [E, C, d] expert buffers run as batched einsums (XLA batches them on the
+    MXU with no ragged-size overhead), gather-only vjps. The gate+up
+    projections are fused into ONE batched matmul inside
+    :func:`_reference_expert_ffn` (the concat is a cheap weight-side copy
+    XLA folds into the operand read).
+
+    This is the rewritten "sorted" mode: same capacity/drop semantics as the
+    reference fused-MoE path (fused_moe.py sorts tokens by expert into
+    capacity buffers), but with no lax.sort/top_k and no scatter anywhere.
+    Static shapes trade ~(capacity_factor-1) extra matmul rows for
+    ragged_dot's per-group overhead (tools/moe_dispatch_bench.py).
+    Returns (y [T, d], aux_loss).
+    """
+    T = x.shape[0]
+    E = wg.shape[0]
+    gate_vals, aux, slots_of_entry, slot_valid, slot_entry = \
+        _capacity_slot_maps(logits, topk, E, capacity, T)
+    out = _reference_expert_ffn(x, slot_entry, slot_valid, slots_of_entry,
+                                wg, wu, wd, topk)
+    y = _slot_combine_weighted(x, out, gate_vals, slots_of_entry,
+                               slot_entry, slot_valid)
+    return y, aux
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(8,))
+def _fused_expert_ffn(x, slot_token, slot_entry, slot_valid, slots_of_entry,
+                      wg, wu, wd, topk):
+    """Expert FFN over the capacity slots through the FUSED gather-GEMM
+    Pallas kernel (ops/kernels/gather_gemm.py): the dispatch gather, both
+    FFN GEMMs and the activation run per (expert, token-block) entirely
+    in VMEM — the gathered ``[E*C, d]`` activations and the two FFN
+    intermediates never exist in HBM (the r5 dispatch-movement floor).
+
+    ``slot_token [E*C]`` carries the token row each slot reads (sentinel
+    T = unfilled slot -> zero row), precomputed from the same counting
+    sort the reference path uses, so drop/capacity semantics are
+    IDENTICAL to ``_gathered_capacity_moe_ffn``. Backward is the
+    reference gather formulation recomputed (gather-only vjps; fusing
+    the backward GEMMs is a named follow-up seam in docs/kernels.md)."""
+    from ..ops.kernels.gather_gemm import gather_gemm_ffn
+
+    E, d, h = wg.shape
+    C = slot_token.shape[0] // E
+    return gather_gemm_ffn(x, slot_token, jnp.concatenate([wg, wu], axis=-1),
+                           wd, capacity=C)
+
+
+def _reference_expert_ffn(x, slot_entry, slot_valid, slots_of_entry,
+                          wg, wu, wd, topk):
+    """The capacity path's FFN body (dispatch gather + batched einsums) —
+    the recompute target of the fused kernel's backward pass and the
+    numeric reference its parity tests pin against."""
+    E, d, h = wg.shape
+    C = slot_entry.shape[0] // E
     xin = _slot_dispatch(x, slot_entry, slot_valid, slots_of_entry,
                          topk).reshape(E, C, d)
-    # gate+up fused into ONE batched matmul (halves dispatch/epilogue count;
-    # the concat is a cheap weight-side copy XLA folds into the operand read)
-    h = wg.shape[-1]
     gu = jnp.einsum("ecd,edh->ech", xin, jnp.concatenate([wg, wu], axis=-1))
     hmid = jax.nn.silu(gu[..., :h]) * gu[..., h:]
-    out = jnp.einsum("ech,ehd->ecd", hmid, wd).reshape(E * C, d)
-    contrib = _slot_combine(out, slots_of_entry, slot_entry, slot_valid)
-    y = (contrib * jnp.swapaxes(gate_vals, 0, 1).astype(x.dtype)[..., None]
-         ).sum(0)
+    return jnp.einsum("ech,ehd->ecd", hmid, wd).reshape(E * C, d)
+
+
+def _fused_expert_ffn_fwd(x, slot_token, slot_entry, slot_valid,
+                          slots_of_entry, wg, wu, wd, topk):
+    out = _fused_expert_ffn(x, slot_token, slot_entry, slot_valid,
+                            slots_of_entry, wg, wu, wd, topk)
+    return out, (x, slot_entry, slot_valid, slots_of_entry, wg, wu, wd)
+
+
+def _fused_expert_ffn_bwd(topk, res, g):
+    x, slot_entry, slot_valid, slots_of_entry, wg, wu, wd = res
+    _, vjp = jax.vjp(
+        lambda x_, wg_, wu_, wd_: _reference_expert_ffn(
+            x_, slot_entry, slot_valid, slots_of_entry, wg_, wu_, wd_,
+            topk),
+        x, wg, wu, wd)
+    dx, dwg, dwu, dwd = vjp(g)
+    return dx, None, None, None, None, dwg, dwu, dwd
+
+
+_fused_expert_ffn.defvjp(_fused_expert_ffn_fwd, _fused_expert_ffn_bwd)
+
+
+def _fused_gather_gemm_moe_ffn(x, logits, wg, wu, wd, topk, capacity):
+    """Capacity dispatch with the FUSED gather-GEMM kernel — identical
+    routing/drop semantics to :func:`_gathered_capacity_moe_ffn` (same
+    counting sort, same slot maps, same combine), only the
+    dispatch-gather + expert-FFN block runs in-kernel.
+    Returns (y [T, d], aux_loss)."""
+    T = x.shape[0]
+    E = wg.shape[0]
+    gate_vals, aux, slots_of_entry, slot_valid, slot_entry = \
+        _capacity_slot_maps(logits, topk, E, capacity, T)
+    # the kernel gathers by TOKEN row (entry j reads x[j % T]); sentinel T
+    # marks unfilled slots so the kernel zeroes them without a branch
+    slot_token = jnp.where(slot_valid, slot_entry % T, T).astype(jnp.int32)
+    out = _fused_expert_ffn(x, slot_token, slot_entry, slot_valid,
+                            slots_of_entry, wg, wu, wd, topk)
+    y = _slot_combine_weighted(x, out, gate_vals, slots_of_entry,
+                               slot_entry, slot_valid)
     return y, aux
 
 
@@ -483,6 +577,13 @@ class MoELayer(Layer):
         XLA's SPMD partitioner turns the token-expert contraction into the
         ICI all_to_all, the cleanest multi-chip ep-sharded lowering — use
         this when sharding the expert bank over an ep mesh axis.
+      * "fused" — the sorted path's routing/drop semantics with the
+        dispatch gather + expert FFN run by the Pallas gather-GEMM
+        kernel (ops/kernels/gather_gemm.py): indices read in-kernel, no
+        HBM-resident gathered activations (the r5 data-movement floor).
+        Forward-fused; backward recomputes the reference formulation.
+        Unsupported configs fall back LOUDLY to "sorted"
+        (docs/kernels.md).
     Only stock gates take the fast paths (a custom ``routing()`` override
     falls back to einsum, the extension point that honors it).
     """
@@ -495,10 +596,34 @@ class MoELayer(Layer):
         self.d_hidden = d_hidden
         self.num_experts = num_experts
         self.capacity_factor = capacity_factor
-        if dispatch_mode not in ("einsum", "sorted", "dropless"):
+        if dispatch_mode not in ("einsum", "sorted", "dropless", "fused"):
             raise ValueError(
-                f"dispatch_mode must be 'einsum', 'sorted' or 'dropless', "
-                f"got {dispatch_mode!r}")
+                f"dispatch_mode must be 'einsum', 'sorted', 'dropless' or "
+                f"'fused', got {dispatch_mode!r}")
+        if dispatch_mode == "fused":
+            # resolve the fallback ONCE, loudly: an unsupported config
+            # serves the reference formulation with one stderr line, never
+            # a silent behavior change (docs/kernels.md fallback matrix)
+            from ..ops.kernels.gather_gemm import gather_gemm_supported
+
+            ok, reason = gather_gemm_supported(d_model=d_model,
+                                               d_hidden=d_hidden)
+            if not ok:
+                import sys
+
+                sys.stderr.write(
+                    f"[moe] fused gather-GEMM dispatch unavailable "
+                    f"({reason}); falling back to 'sorted'\n")
+                try:
+                    from ..inference.robustness import safe_inc
+
+                    safe_inc("paddle_fused_kernel_fallbacks_total",
+                             "fused-kernel requests that fell back to the "
+                             "reference formulation", kernel="gather_gemm",
+                             reason=reason.split(" ")[0])
+                except Exception:
+                    pass
+                dispatch_mode = "sorted"
         self.dispatch_mode = dispatch_mode
         self.gate = gate or GShardGate(d_model, num_experts)
         self.w_gate_proj = mark_placement(self.create_parameter(
@@ -535,6 +660,19 @@ class MoELayer(Layer):
             y, aux = apply_op(dropless_ffn, x_flat, self.gate.weight,
                               self.w_gate_proj, self.w_up_proj,
                               self.w_down_proj, op_name="moe_ffn_dropless")
+            self.l_aux = aux
+            return y.reshape([b, s, d])
+        if self.dispatch_mode == "fused" and stock_gate:
+            topk = max(self.gate.topk, 1)
+
+            def fused_ffn(xf, gw, wg, wu, wd):
+                logits = xf.astype(jnp.float32) @ gw.astype(jnp.float32)
+                return _fused_gather_gemm_moe_ffn(xf, logits, wg, wu, wd,
+                                                  topk, cap)
+
+            y, aux = apply_op(fused_ffn, x_flat, self.gate.weight,
+                              self.w_gate_proj, self.w_up_proj,
+                              self.w_down_proj, op_name="moe_ffn_fused")
             self.l_aux = aux
             return y.reshape([b, s, d])
         if self.dispatch_mode == "sorted" and stock_gate:

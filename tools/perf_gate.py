@@ -106,6 +106,15 @@ def bench_metrics(doc: dict) -> Dict[str, Tuple[float, str]]:
             continue
         put(f"multichip.{cname}.tokens_per_sec", row.get("tokens_per_sec"))
         put(f"multichip.{cname}.tok_s", row.get("tok_s"))
+    # MoE dispatch shoot-out (tools/moe_dispatch_bench.py {"moe_dispatch":
+    # …} line): dispatch_ms is the best capacity-semantics formulation's
+    # ms/call (the fused gather-GEMM row where it wins) — lower-is-better
+    # under the latency budget; the fused row rides along so a kernel
+    # regression can't hide behind the XLA path winning the min
+    md = doc.get("moe_dispatch")
+    if isinstance(md, dict):
+        put("moe.dispatch_ms", md.get("dispatch_ms"), LOWER)
+        put("moe.dispatch_fused_ms", md.get("fused_ms"), LOWER)
     return out
 
 
@@ -127,6 +136,12 @@ def serving_metrics(doc: dict) -> Dict[str, Tuple[float, str]]:
     put("serving.prefix_hit_rate", body.get("prefix_hit_rate"), HIGHER)
     put("serving.concurrency_peak", body.get("concurrency_peak"), HIGHER)
     put("serving.kv_occupancy_peak", body.get("kv_occupancy_peak"), LOWER)
+    # fused-kernel chunk A/B (serving_bench --fused-kernels): the paged
+    # decode chunk's premium over the contiguous no-indirection floor —
+    # the r7 <=5% budget the in-kernel page walk exists to hold; creeping
+    # up means the kernel regressed or silently fell back to the gather
+    put("serving.paged_chunk_overhead_pct",
+        body.get("paged_chunk_overhead_pct"), LOWER)
     # fleet-router column (serving_bench --replicas N): completed/submitted
     # under the workload — the availability the failover path defends
     put("serving.availability", body.get("availability"), HIGHER)

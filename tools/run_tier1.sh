@@ -58,6 +58,19 @@
 # few seconds total. The reqtrace-on hot-path budget (<5% vs off,
 # retry-once-on-noise) is gated by tools/check_obs_overhead.py gate 5.
 #
+# Fused-kernel suite: tests/test_fused_kernels.py runs its fast half here
+# (gather-GEMM vs einsum/sorted dispatch parity incl. empty experts +
+# capacity overflow, paged-attention kernel vs the gather-view reference
+# at W=1 and W=3, engine-level TOKEN-EXACT greedy parity with
+# fused_kernels armed — bf16/int8/speculative — via Pallas INTERPRET
+# mode on this CPU tier, the loud-fallback drill on unsupported configs,
+# cost-registry HBM-bytes reduction, and the perf_gate smoke for the two
+# new gated fields moe.dispatch_ms + serving.paged_chunk_overhead_pct);
+# heavy kernel shapes + int8 group-wise are `slow`-marked. The measured
+# A/B artifacts come from `python tools/serving_bench.py
+# --fused-kernels` and `python tools/moe_dispatch_bench.py`
+# (BASELINE.md "Fused kernels"; docs/kernels.md).
+#
 # Perf regression gate (not run here — needs a bench artifact): after a
 # bench run, `python tools/perf_gate.py --baseline BENCH_r05.json
 # --current <new>.json` exits nonzero on a tokens/s / MFU / TTFT

@@ -274,6 +274,90 @@ def warm_engine(eng, model, prompts, args, prefix_cache=True):
         pfx.hits = pfx.misses = pfx.evictions = 0
 
 
+# recompile-watchdog region: an A/B deliberately compiles BOTH
+# formulations' programs from the same call sites — a CPU CI run with the
+# watchdog armed must not read that as a per-callsite storm
+from paddlepaddle_tpu.observability.watchdog import (
+    expected_compiles as _expected_compiles,
+)
+
+
+def time_decode_chunks(model, args, kv_layout, fused=False, iters=8):
+    """Pure decode-chunk wall time (ms/chunk) for one engine variant:
+    fill every slot with a long-budget request, then time chunk calls
+    with no admissions inside the window (the r7 '<=5% chunk overhead'
+    methodology — one packed host sync per chunk, admissions excluded).
+    Returns (ms_per_chunk, fused_info)."""
+    from paddlepaddle_tpu.inference.decode_engine import BatchDecodeEngine
+    from paddlepaddle_tpu.inference.serving import GenerationRequest
+
+    rng = np.random.default_rng(3)
+    # every timed chunk must run with ALL slots still active: the budget
+    # covers warmup + 3 timed repetitions, clamped to the model's window —
+    # and a window too small to hold even one honest repetition is an
+    # ERROR, not a silently-drained measurement (this number feeds the
+    # gated paged_chunk_overhead_pct)
+    budget = min(args.chunk * (3 * iters + 6),
+                 model.config.max_position_embeddings - 64)
+    iters = min(iters, (budget // args.chunk - 2) // 3)
+    if iters < 1:
+        raise RuntimeError(
+            f"chunk A/B needs >= 5 chunks of {args.chunk} inside the "
+            f"model window ({model.config.max_position_embeddings}); "
+            "lower --chunk or raise --max-len")
+    eng = BatchDecodeEngine(
+        model, max_slots=args.slots, chunk=args.chunk, kv_layout=kv_layout,
+        page_size=args.page_size, num_pages=args.num_pages,
+        fused_kernels=fused)
+    for _ in range(args.slots):
+        r = GenerationRequest(
+            rng.integers(0, model.config.vocab_size, (32,)).astype(np.int32),
+            budget, 0.0, 0, None)
+        r.prefix_len = None
+        if not eng._admit(r):      # -O safe: admission IS the setup
+            raise RuntimeError("chunk A/B could not fill every slot")
+    eng._decode_chunk()            # compile + first-token sync flushed
+    eng._decode_chunk()
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            eng._decode_chunk()
+        best = min(best, (time.perf_counter() - t0) / iters)
+    info = eng.fused_info()
+    eng.reset_slots()
+    return round(best * 1e3, 3), info
+
+
+def run_chunk_ab(model, args):
+    """--fused-kernels chunk-time A/B: contiguous (the no-indirection
+    floor) vs paged reference (pool[page_table] gather) vs paged FUSED
+    (in-kernel page walk). ``paged_chunk_overhead_pct`` — the armed
+    engine's chunk time over the contiguous floor — is the r7 <=5%
+    budget perf_gate gates LOWER; the reference row rides along so the
+    kernel's own delta stays visible."""
+    with _expected_compiles("serving_bench_fused_ab"):
+        con_ms, _ = time_decode_chunks(model, args, "contiguous")
+        ref_ms, _ = time_decode_chunks(model, args, "paged")
+        fus_ms, info = time_decode_chunks(model, args, "paged", fused=True)
+    row = {
+        "contiguous_chunk_ms": con_ms,
+        "paged_chunk_ms": ref_ms,
+        "paged_fused_chunk_ms": fus_ms,
+        "paged_ref_overhead_pct": round((ref_ms - con_ms) / con_ms * 100, 2),
+        "paged_chunk_overhead_pct": round((fus_ms - con_ms) / con_ms * 100,
+                                          2),
+        "fused_info": info,
+    }
+    print(f"chunk A/B ({args.slots} slots, chunk {args.chunk}): "
+          f"contiguous {con_ms} ms  paged {ref_ms} ms "
+          f"(+{row['paged_ref_overhead_pct']}%)  "
+          f"paged+fused {fus_ms} ms "
+          f"({row['paged_chunk_overhead_pct']:+}%)  "
+          f"[{info.get('paged_attention')}]", flush=True)
+    return row
+
+
 def build_draft(args, model):
     """Resolve the --draft preset into the engine's ``draft=`` argument:
     the target itself for ``self``, else a scaled-down CONFIG — the
@@ -298,7 +382,8 @@ def build_draft(args, model):
 
 
 def run_serving(model, prompts, args, kv_layout, slots, num_pages=None,
-                prefix_cache=True, warm=True, tp=1, spec=False):
+                prefix_cache=True, warm=True, tp=1, spec=False,
+                fused=False):
     """One engine pass over the workload; returns the metrics row.
     ``tp > 1`` serves through a tensor-parallel engine (sharding plan over
     an ``mp``-axis mesh: weights column/row-parallel, KV pool sharded on
@@ -315,6 +400,10 @@ def run_serving(model, prompts, args, kv_layout, slots, num_pages=None,
                        kv_page_size=args.page_size, kv_num_pages=num_pages,
                        prefix_cache=prefix_cache,
                        mesh=(f"mp{tp}" if tp > 1 else None),
+                       # explicit bool BOTH ways: an ambient
+                       # PADDLE_FUSED_KERNELS=1 must not arm the kernel
+                       # in a row labeled (and baselined) as reference
+                       fused_kernels=bool(fused),
                        **spec_kw) as eng:
         if warm:
             warm_engine(eng, model, prompts, args, prefix_cache)
@@ -330,6 +419,7 @@ def run_serving(model, prompts, args, kv_layout, slots, num_pages=None,
         kv = eng._engine.kv_stats()
         peak_busy = eng._engine.stats["peak_busy"]
         spec_info = eng._engine.spec_info() if spec else None
+        fused_info = eng._engine.fused_info() if fused else None
     new_tokens = sum(len(o) - len(p) for o, (p, _) in zip(outs, prompts))
     row = {"kv_layout": kv_layout, "slots": slots,
            "aggregate_tok_s": round(new_tokens / max(dt, 1e-9), 1),
@@ -337,6 +427,8 @@ def run_serving(model, prompts, args, kv_layout, slots, num_pages=None,
            "concurrency_peak": peak_busy}
     if tp > 1:
         row["tp"] = tp
+    if fused_info is not None:
+        row["fused"] = fused_info
     row.update(slo_summary(futs))
     if kv["layout"] == "paged":
         row["kv_pages_total"] = kv["pages_total"]
@@ -641,6 +733,13 @@ def main():
                     "lower bound on this harness)")
     ap.add_argument("--draft-quant", action="store_true",
                     help="serve the draft weight-only int8")
+    ap.add_argument("--fused-kernels", action="store_true",
+                    help="arm the fused Pallas paged-attention kernel "
+                    "(FLAGS_fused_kernels; interpret-mode on CPU) for the "
+                    "profile run AND add a chunk-time A/B — contiguous vs "
+                    "paged-reference vs paged-fused — whose "
+                    "paged_chunk_overhead_pct (the r7 <=5% budget) "
+                    "perf_gate gates lower-is-better")
     ap.add_argument("--hidden", type=int, default=1024)
     ap.add_argument("--layers", type=int, default=12)
     ap.add_argument("--max-len", type=int, default=2048)
@@ -670,6 +769,11 @@ def main():
     if args.tp > 1 and (args.replicas > 1 or args.ab):
         ap.error("--tp compares one engine against its tensor-parallel "
                  "form; run it with --replicas 1 and without --ab")
+
+    if args.fused_kernels and (args.replicas > 1 or args.tp > 1
+                               or args.traffic):
+        ap.error("--fused-kernels A/Bs one engine's decode formulations; "
+                 "run it without --replicas/--tp/--traffic")
 
     if args.autoscale:
         if not args.traffic:
@@ -718,8 +822,10 @@ def main():
         body["kv_budget_slots"] = slots_c
     else:
         row = run_serving(model, prompts, args, args.kv_layout, args.slots,
-                          num_pages=args.num_pages)
-        fmt(row, f"{args.kv_layout} x{args.slots}")
+                          num_pages=args.num_pages,
+                          fused=args.fused_kernels)
+        fmt(row, f"{args.kv_layout} x{args.slots}"
+            + (" +fused" if args.fused_kernels else ""))
         body.update(row)
         print(f"({row['aggregate_tok_s'] / max(single_tps, 1e-9):.1f}x "
               "single-sequence)")
@@ -760,6 +866,14 @@ def main():
         body["no_prefix_cache"] = ctl
     if args.profile == "mixed":
         body["mixed_tok_s"] = body["aggregate_tok_s"]
+
+    if args.fused_kernels:
+        ab = run_chunk_ab(model, args)
+        body["fused_ab"] = ab
+        # the gated field (perf_gate serving.paged_chunk_overhead_pct,
+        # LOWER): the fused engine's decode-chunk premium over the
+        # contiguous no-indirection floor — the r7 <=5% budget
+        body["paged_chunk_overhead_pct"] = ab["paged_chunk_overhead_pct"]
 
     print(json.dumps({"serving_bench": body}))
 
