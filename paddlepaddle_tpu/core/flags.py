@@ -186,6 +186,25 @@ define_flag("obs_reqtrace_ring", 256,
 define_flag("obs_reqtrace_spans", 256,
             "span cap per request journey (overflow counts dropped_spans "
             "instead of growing)", env="PADDLE_OBS_REQTRACE_SPANS")
+define_flag("obs_tsdb", False,
+            "arm the in-process metric history plane (observability/"
+            "tsdb.py): a sampler thread diffs the metrics registry every "
+            "obs_tsdb_interval_s into bounded per-series rings (counters "
+            "as rates, gauges as values, histograms as window quantiles), "
+            "served at /query and merged fleet-wide at /fleet/query; also "
+            "arms the burn-rate alert engine (observability/alerts.py)",
+            env="PADDLE_OBS_TSDB")
+define_flag("obs_tsdb_interval_s", 2.0,
+            "seconds between metric-history samples (and alert-rule "
+            "evaluations)", env="PADDLE_OBS_TSDB_INTERVAL_S")
+define_flag("obs_tsdb_points", 512,
+            "raw-tier ring capacity per series; the coarse tier keeps the "
+            "same point count at 10x the spacing, so total history = "
+            "points * interval * 11", env="PADDLE_OBS_TSDB_POINTS")
+define_flag("obs_tsdb_publish_points", 64,
+            "most-recent points per series (each tier) published into the "
+            "TCPStore fleet plane for rank-0 /fleet/query merging; bounds "
+            "the per-rank payload", env="PADDLE_OBS_TSDB_PUBLISH_POINTS")
 define_flag("obs_perf", False,
             "arm the performance-attribution plane (observability/perf/): "
             "capture XLA cost_analysis FLOPs/bytes per compiled program "

@@ -57,6 +57,7 @@ Everything here is host-side stdlib — the replicas own the chips.
 from __future__ import annotations
 
 import itertools
+import os
 import sys
 import threading
 import time
@@ -72,7 +73,8 @@ from .robustness import safe_set as _safe_set
 from .router import ReplicaClient, ServingRouter
 from .serving import _flight_record, slo_summary
 
-__all__ = ["FleetPolicy", "FleetController", "decide", "DeployError"]
+__all__ = ["FleetPolicy", "FleetController", "decide",
+           "perf_verdict_gate", "DeployError"]
 
 
 class FleetPolicy:
@@ -151,16 +153,30 @@ def decide(policy: FleetPolicy, sig: Dict[str, object],
     burn = sig.get("burn")           # None = SLO targets not armed
     actual = int(sig.get("replicas") or 0)
     depth = int(sig.get("queue_depth") or 0)
+    alerts_sig = sig.get("alerts")   # alert engine armed: ONE definition
+    #                                  of "burn is violating" — the rule's,
+    #                                  with its multi-window + hold-down
+    #                                  semantics, not a re-derived threshold
 
     hot_reason = None
-    if burn is not None and burn > policy.scale_up_burn:
-        hot_reason = (f"slo_burn {burn:.2f} > budget "
-                      f"{policy.scale_up_burn:g}")
-    elif est > policy.scale_up_est_wait_s:
+    burn_violating = None            # None = nothing armed says either way
+    if alerts_sig is not None:
+        firing = list(alerts_sig.get("burn_firing") or ())
+        burn_violating = bool(firing)
+        if firing:
+            hot_reason = f"burn alert firing: {'+'.join(firing)}"
+    elif burn is not None:
+        burn_violating = burn > policy.scale_up_burn
+        if burn_violating:
+            hot_reason = (f"slo_burn {burn:.2f} > budget "
+                          f"{policy.scale_up_burn:g}")
+    if hot_reason is None and est > policy.scale_up_est_wait_s:
         hot_reason = (f"est_wait {est:.2f}s > "
                       f"{policy.scale_up_est_wait_s:g}s")
     idle = (est <= policy.idle_est_wait_s and depth == 0
-            and (burn is None or burn <= policy.idle_burn))
+            and (burn_violating is None or not burn_violating)
+            and (alerts_sig is not None
+                 or burn is None or burn <= policy.idle_burn))
 
     if hot_reason:
         state["hot"] = state.get("hot", 0) + 1
@@ -193,6 +209,44 @@ def decide(policy: FleetPolicy, sig: Dict[str, object],
                   f"{policy.up_streak})") or \
         (idle and f"idle (streak {state['idle']}/{policy.down_streak})") \
         or "steady"
+
+
+def perf_verdict_gate(verdict) -> Callable[[Dict], List[str]]:
+    """Build a deploy ``gate=`` callable from a ``tools/perf_gate.py
+    --json`` verdict document — a parsed dict, a JSON string, or a path to
+    the file ``--json`` wrote. The gate vetoes promotion with one reason
+    per non-ok field row (regressions and missing metrics), so CI can run
+    the bench against the candidate, gate it, and hand the machine verdict
+    straight to :meth:`FleetController.deploy` without parsing the human
+    report."""
+    import json as _json
+
+    if isinstance(verdict, (str, os.PathLike)):
+        s = str(verdict)
+        if s.lstrip().startswith("{"):
+            verdict = _json.loads(s)
+        else:
+            with open(s) as f:
+                verdict = _json.load(f)
+    if not isinstance(verdict, dict):
+        raise TypeError(f"verdict must be dict/JSON/path, got "
+                        f"{type(verdict).__name__}")
+    doc = dict(verdict)
+
+    def gate(_canary_metrics: Dict[str, object]) -> List[str]:
+        reasons = []
+        for row in doc.get("fields", ()):
+            if row.get("verdict") in ("regression", "missing"):
+                reasons.append(
+                    f"perf_gate {row.get('verdict')}: {row.get('metric')} "
+                    f"baseline={row.get('baseline')} "
+                    f"candidate={row.get('candidate')} "
+                    f"({row.get('direction', '?')} is better)")
+        if not doc.get("ok", not reasons):
+            reasons = reasons or ["perf_gate verdict not ok"]
+        return reasons
+
+    return gate
 
 
 class FleetController:
@@ -316,12 +370,24 @@ class FleetController:
                 if kb.get("enabled") and kb.get("burn") is not None:
                     v = float(kb["burn"])
                     burn = v if burn is None else max(burn, v)
-        return {"replicas": len(reps),
-                "healthy": int(h.get("router", {}).get("healthy", 0)),
-                "est_wait_max": max(est) if est else 0.0,
-                "queue_depth": depth,
-                "burn": burn,
-                "ok": bool(h.get("ok"))}
+        sig = {"replicas": len(reps),
+               "healthy": int(h.get("router", {}).get("healthy", 0)),
+               "est_wait_max": max(est) if est else 0.0,
+               "queue_depth": depth,
+               "burn": burn,
+               "ok": bool(h.get("ok"))}
+        # when the alert engine is installed, its AlertState is the single
+        # definition of "the burn is violating" (multi-window + hold-down),
+        # and decide() defers to it instead of re-deriving a threshold
+        try:
+            from ..observability import alerts as _alerts
+
+            eng = _alerts.get()
+        except Exception:
+            eng = None
+        if eng is not None:
+            sig["alerts"] = eng.signal()
+        return sig
 
     def _tick(self) -> Dict[str, object]:
         """One autoscaler evaluation (the loop calls this every

@@ -359,6 +359,17 @@ class ServingRouter:
             except Exception:
                 snap, ok = None, False
             rep.snapshot = snap
+            # per-replica load gauges on the probe tick: the same numbers
+            # picks are made on, published so the tsdb history plane (and
+            # `obsctl top`'s sparklines) can see per-replica load over time
+            if snap is not None:
+                if snap.get("est_wait_s") is not None:
+                    _safe_set("paddle_router_replica_est_wait_seconds",
+                              "probed per-replica estimated wait",
+                              float(snap["est_wait_s"]), replica=rep.name)
+                _safe_set("paddle_router_replica_inflight",
+                          "router-submitted requests in flight per replica",
+                          rep.inflight, replica=rep.name)
             b = rep.breaker
             if not rep.in_rotation:
                 continue     # deliberately out (rolling restart): its

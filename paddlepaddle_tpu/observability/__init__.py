@@ -118,6 +118,26 @@ class RecordEvent(trace_region):
 # per-event work is dict-free.
 # ---------------------------------------------------------------------------
 
+def _slo_aligned_buckets(flag_name: str):
+    """Latency bucket ladder with the armed SLO threshold inserted as an
+    exact edge: burn accounting happens AT the SLO boundary, so the target
+    must be a bucket bound — otherwise "violations" counted from the
+    nearest exponential edge over/under-state the burn by up to 2x.
+    Returns ``None`` (the default ladder) when the SLO flag is unarmed."""
+    ms = _flags.flag_value(flag_name)
+    if not ms or float(ms) <= 0:
+        return None
+    edge = float(ms) / 1e3
+    from .metrics import LATENCY_BUCKETS
+
+    buckets = list(LATENCY_BUCKETS)
+    if edge not in buckets:
+        import bisect
+
+        bisect.insort(buckets, edge)
+    return buckets
+
+
 def _make_hooks():
     reg = _registry
     rec = _recorder
@@ -161,10 +181,12 @@ def _make_hooks():
     # request-lifecycle SLO surface (perf attribution plane): the numbers
     # a serving router load-balances on
     srv_ttft = reg.histogram("paddle_serving_ttft_seconds",
-                             "submit-to-first-token latency (TTFT)")
+                             "submit-to-first-token latency (TTFT)",
+                             buckets=_slo_aligned_buckets("slo_ttft_ms"))
     srv_tpot = reg.histogram("paddle_serving_tpot_seconds",
                              "per-output-token latency after the first "
-                             "(TPOT, per-request average)")
+                             "(TPOT, per-request average)",
+                             buckets=_slo_aligned_buckets("slo_tpot_ms"))
     srv_qwait = reg.histogram("paddle_serving_queue_wait_seconds",
                               "submit-to-decode-slot-admission queue wait")
     srv_margin = reg.histogram("paddle_serving_deadline_margin_seconds",
@@ -345,14 +367,43 @@ def disable() -> None:
     watchdog.uninstall()
 
 
+def enable_history(interval_s: Optional[float] = None, rules=None,
+                   start_thread: bool = True):
+    """Arm the metric-history plane (:mod:`~.tsdb`) and its alert engine
+    (:mod:`~.alerts`) over the package registry. ``start_thread=False``
+    leaves the sampler to be driven manually (tests call
+    ``history.observe(now)`` with a synthetic clock). Returns the
+    :class:`~.tsdb.MetricHistory`."""
+    from . import alerts as _alerts
+    from . import tsdb as _tsdb
+
+    h = _tsdb.enable(interval_s=interval_s, start_thread=start_thread)
+    _alerts.install(history=h, rules=rules)
+    return h
+
+
+def disable_history() -> None:
+    """Stop the history sampler and detach the alert engine."""
+    from . import alerts as _alerts
+    from . import tsdb as _tsdb
+
+    _alerts.uninstall()
+    _tsdb.disable()
+
+
 def reset() -> None:
-    """Clear the ring buffer, all metric values, watchdog state, and the
-    perf plane (program costs + step timeline)."""
+    """Clear the ring buffer, all metric values, watchdog state, the
+    perf plane (program costs + step timeline), and tear down the
+    history/alerting plane."""
     _recorder.clear()
     _registry.clear()
     watchdog.reset()
     perf.reset()
     reqtrace.reset()
+    try:
+        disable_history()
+    except Exception:
+        pass
 
 
 # ---------------------------------------------------------------------------
@@ -657,6 +708,14 @@ if _flags.flag_value("obs_reqtrace"):
     except Exception:
         pass
 
+if _flags.flag_value("obs_tsdb"):
+    try:
+        enable_history()
+    except Exception as _e:
+        import sys as _sys
+
+        _sys.stderr.write(f"[obs] tsdb autostart failed: {_e!r}\n")
+
 if _flags.flag_value("obs_export"):
     try:
         start_exporter()
@@ -676,4 +735,5 @@ __all__ = [
     "get_recorder", "get_registry", "snapshot", "to_prometheus_text",
     "export_chrome_trace", "summary", "watchdog", "flight", "perf",
     "reqtrace", "start_exporter", "stop_exporter",
+    "enable_history", "disable_history",
 ]

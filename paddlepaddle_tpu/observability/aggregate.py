@@ -10,15 +10,20 @@ plane carries the telemetry too — no new transport:
   periodically writes three store keys —
   ``obs/clock/rank{r}``  (a ``(wall, perf_counter)`` anchor pair),
   ``obs/metrics/rank{r}`` (the Prometheus text of its registry), and
-  ``obs/trace/rank{r}``   (its chrome-trace ring buffer, when tracing) —
+  ``obs/trace/rank{r}``   (its chrome-trace ring buffer, when tracing), and
+  ``obs/tsdb/rank{r}``    (a bounded dump of its metric-history rings, when
+  the :mod:`~.tsdb` plane is armed) —
   plus a final publish at interpreter exit so a cleanly-exiting worker's
   last snapshot survives it;
 * rank 0 (:func:`install_fleet_routes`) swaps its exporter's ``/metrics``
   for :func:`merged_fleet_metrics` — every sample from every rank,
   re-labeled ``rank="r"`` via the strict exposition parser — and adds
   ``/fleet/trace`` (:func:`collect_fleet_trace`: per-rank chrome traces
-  merged into ONE Perfetto file, one ``pid`` per rank) and
-  ``/fleet/ranks`` (who has published, how stale).
+  merged into ONE Perfetto file, one ``pid`` per rank),
+  ``/fleet/ranks`` (who has published, how stale) and ``/fleet/query``
+  (:func:`collect_fleet_tsdb`: every rank's metric history, keyed by rank
+  — the seam that survives the multi-process ``ReplicaClient`` hop
+  unchanged, because history rides the store, not process memory).
 
 Clock correlation: each rank's recorder timestamps are ``perf_counter``
 microseconds with a process-private epoch. The published ``(wall, perf)``
@@ -48,8 +53,8 @@ from .metrics import (
 __all__ = [
     "FleetPublisher", "merge_prometheus_texts", "merge_chrome_traces",
     "collect_fleet_metrics", "merged_fleet_metrics", "collect_fleet_trace",
-    "fleet_status", "install_fleet_routes",
-    "metrics_key", "clock_key", "trace_key",
+    "collect_fleet_tsdb", "fleet_status", "install_fleet_routes",
+    "metrics_key", "clock_key", "trace_key", "tsdb_key",
 ]
 
 
@@ -65,6 +70,10 @@ def trace_key(rank: int) -> str:
     return f"obs/trace/rank{rank}"
 
 
+def tsdb_key(rank: int) -> str:
+    return f"obs/tsdb/rank{rank}"
+
+
 def _clock_sample() -> dict:
     return {"wall": time.time(), "perf": time.perf_counter()}
 
@@ -78,7 +87,7 @@ class FleetPublisher:
     is logged once and retried next interval."""
 
     def __init__(self, store, rank: int, interval_s: Optional[float] = None,
-                 text_fn=None, trace_fn=None):
+                 text_fn=None, trace_fn=None, tsdb_fn=None):
         self.store = store
         self.rank = int(rank)
         self.interval_s = float(
@@ -86,6 +95,7 @@ class FleetPublisher:
             else _flags.flag_value("obs_publish_interval_s"))
         self._text_fn = text_fn
         self._trace_fn = trace_fn
+        self._tsdb_fn = tsdb_fn
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._warned = False
@@ -123,6 +133,21 @@ class FleetPublisher:
         if doc is not None:
             self.store.set(trace_key(self.rank), json.dumps(
                 {"wall": clock["wall"], "trace": doc}))
+        hist = None
+        if self._tsdb_fn is not None:
+            hist = self._tsdb_fn()
+        else:
+            # publish only when the history plane is armed: the key's
+            # absence tells the rank-0 merge "this rank keeps no history",
+            # which is different from "stale"
+            from . import tsdb as _tsdb
+
+            h = _tsdb.get()
+            if h is not None:
+                hist = h.jsonable()
+        if hist is not None:
+            self.store.set(tsdb_key(self.rank), json.dumps(
+                {"wall": clock["wall"], "rank": self.rank, "tsdb": hist}))
 
     def _publish_safe(self) -> None:
         try:
@@ -322,6 +347,62 @@ def collect_fleet_trace(store, world: int,
     return merge_chrome_traces(docs, clocks)
 
 
+def _filter_tsdb_doc(doc: dict, selector: Optional[str],
+                     window_s: Optional[float], now: float) -> dict:
+    """Shape one rank's published tsdb dump like a live ``/query`` answer:
+    matched series, best tier for the window (raw while it still covers
+    the window's start, else coarse — coarse points re-emit as their
+    mean)."""
+    from . import tsdb as _tsdb
+
+    series = doc.get("series", {})
+    rows = []
+    for sid in _tsdb.match_series(series.keys(), selector):
+        ent = series[sid]
+        raw = ent.get("raw") or []
+        coarse = ent.get("coarse") or []
+        tier, pts = "raw", raw
+        if window_s is not None:
+            cutoff = now - float(window_s)
+            if raw and raw[0][0] > cutoff and coarse:
+                tier, pts = "coarse", coarse
+            pts = [p for p in pts if p[0] >= cutoff]
+        rows.append({"id": sid, "kind": ent.get("kind", "gauge"),
+                     "tier": tier, "points": [[p[0], p[1]] for p in pts]})
+    return {"interval_s": doc.get("interval_s"), "series": rows}
+
+
+def collect_fleet_tsdb(store, world: int, local_rank: Optional[int] = None,
+                       selector: Optional[str] = None,
+                       window_s: Optional[float] = None) -> dict:
+    """The ``/fleet/query`` body: every rank's published metric history,
+    keyed by rank. The serving rank answers from its live store; ranks
+    without a published ``obs/tsdb/rank{r}`` key (history plane off, or
+    not yet published) are absent from ``ranks``."""
+    from . import tsdb as _tsdb
+
+    now = time.time()
+    ranks: Dict[str, dict] = {}
+    for r in range(int(world)):
+        if local_rank is not None and r == int(local_rank):
+            h = _tsdb.get()
+            if h is not None:
+                live = h.query(selector, window_s)
+                ranks[str(r)] = {"wall": now, "interval_s": live["interval_s"],
+                                 "series": live["series"]}
+            continue
+        try:
+            if not store.check(tsdb_key(r)):
+                continue
+            doc = json.loads(store.get(tsdb_key(r)))
+        except Exception:
+            continue  # a dead rank must not fail the whole query
+        body = _filter_tsdb_doc(doc.get("tsdb", {}), selector, window_s, now)
+        ranks[str(r)] = {"wall": doc.get("wall"), **body}
+    return {"now": now, "world": int(world), "window_s": window_s,
+            "series_selector": selector, "ranks": ranks}
+
+
 def fleet_status(store, world: int) -> dict:
     """Who has published, and how stale — the ``/fleet/ranks`` body.
     Reads the few-dozen-byte clock anchor for the age, not the full
@@ -361,3 +442,16 @@ def install_fleet_routes(exporter, store, world: int,
         json.dumps(collect_fleet_trace(store, world, local_rank))))
     exporter.register_route("/fleet/ranks", lambda: (
         200, "application/json", json.dumps(fleet_status(store, world))))
+
+    def _fleet_query(params):
+        try:
+            window_s = (float(params["window"])
+                        if params.get("window") else None)
+        except ValueError as e:
+            return (400, "application/json",
+                    json.dumps({"error": f"bad parameter: {e}"}))
+        return (200, "application/json", json.dumps(collect_fleet_tsdb(
+            store, world, local_rank, params.get("series") or None,
+            window_s)))
+
+    exporter.register_param_route("/fleet/query", _fleet_query)

@@ -25,7 +25,14 @@ loop beyond reading the registry/recorder:
   SLO-histogram exemplars and the burn-rate block, as strict JSON;
   ``/requests/trace`` serves the same journeys as Perfetto-loadable
   chrome-trace JSON (one track per replica); rendered by
-  ``obsctl requests``.
+  ``obsctl requests``;
+* ``/query``    — metric history from the tsdb plane
+  (``?series=<selector>&window=<seconds>``, strict JSON); on rank 0 of a
+  launched job :mod:`~.aggregate` adds ``/fleet/query`` with every rank's
+  published history; rendered by ``obsctl query`` and ``obsctl top``;
+* ``/alerts``   — the alert engine's rule states as strict JSON; a firing
+  page-severity rule also flips ``/healthz`` to 503 via its built-in
+  ``alerts`` provider block.
 
 Auto-started per worker when ``PADDLE_OBS_EXPORT=1`` (``FLAGS_obs_export``)
 — ``distributed.launch --obs_export`` sets that for every rank it spawns.
@@ -54,6 +61,8 @@ _JSON = "application/json"
 
 # route callable: () -> (http_status, content_type, body_str_or_bytes)
 Route = Callable[[], Tuple[int, str, object]]
+# param route callable: (query_params_dict) -> same tuple
+ParamRoute = Callable[[Dict[str, str]], Tuple[int, str, object]]
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -61,15 +70,22 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):  # noqa: N802 (http.server API)
         exporter = self.server._exporter  # type: ignore[attr-defined]
-        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        raw_path, _, query = self.path.partition("?")
+        path = raw_path.rstrip("/") or "/"
+        proute = exporter._param_routes.get(path)
         route = exporter._routes.get(path)
-        if route is None:
+        if proute is None and route is None:
             body = json.dumps({"error": f"no route {path}",
-                               "routes": sorted(exporter._routes)})
+                               "routes": exporter.route_names()})
             self._send(404, _JSON, body)
             return
         try:
-            status, ctype, body = route()
+            if proute is not None:
+                from urllib.parse import parse_qsl
+
+                status, ctype, body = proute(dict(parse_qsl(query)))
+            else:
+                status, ctype, body = route()
         except Exception as e:  # a broken route must not kill the server
             status, ctype = 500, _JSON
             body = json.dumps({"error": f"{type(e).__name__}: {e}"})
@@ -100,6 +116,7 @@ class TelemetryExporter:
         self._started_mono: Optional[float] = None
         self._health_providers: Dict[str, Callable[[], dict]] = {}
         self._routes: Dict[str, Route] = {}
+        self._param_routes: Dict[str, ParamRoute] = {}
         self._install_default_routes()
 
     # -- routes --------------------------------------------------------------
@@ -107,6 +124,15 @@ class TelemetryExporter:
         """Add (or replace — the fleet aggregator replaces ``/metrics``) a
         GET route. ``fn`` returns (status, content_type, body)."""
         self._routes[path.rstrip("/") or "/"] = fn
+
+    def register_param_route(self, path: str, fn: ParamRoute) -> None:
+        """Like :meth:`register_route` but ``fn`` receives the parsed
+        query-string parameters (``/query?series=&window=`` style routes);
+        a path registered here shadows any plain route at the same path."""
+        self._param_routes[path.rstrip("/") or "/"] = fn
+
+    def route_names(self):
+        return sorted(set(self._routes) | set(self._param_routes))
 
     def register_health(self, name: str, fn: Callable[[], dict],
                         unique: bool = False) -> str:
@@ -141,10 +167,12 @@ class TelemetryExporter:
         self.register_route("/programs", self._programs)
         self.register_route("/requests", self._requests)
         self.register_route("/requests/trace", self._requests_trace)
+        self.register_param_route("/query", self._query)
+        self.register_route("/alerts", self._alerts)
 
     def _index(self):
         return 200, _JSON, json.dumps(
-            {"routes": sorted(self._routes), "rank": _rank(),
+            {"routes": self.route_names(), "rank": _rank(),
              "world": _world(), "pid": os.getpid()})
 
     def _metrics(self):
@@ -186,12 +214,43 @@ class TelemetryExporter:
         return 200, _JSON, json.dumps(reqtrace.to_chrome_trace(),
                                       allow_nan=False, default=str)
 
+    def _query(self, params: Dict[str, str]):
+        from . import tsdb
+
+        try:
+            window_s = (float(params["window"])
+                        if params.get("window") else None)
+            max_points = (int(params["max_points"])
+                          if params.get("max_points") else None)
+        except ValueError as e:
+            return 400, _JSON, json.dumps({"error": f"bad parameter: {e}"})
+        return tsdb.query_body(params.get("series") or None, window_s,
+                               max_points)
+
+    def _alerts(self):
+        from . import alerts
+
+        return alerts.alerts_body()
+
     def _healthz(self):
         from . import _metrics_on, _trace_on, _watchdog_on
         from . import flight
 
         providers = {}
         ok = True
+        # built-in provider: the alert engine (when installed) — a firing
+        # page-severity rule must flip readiness without any registration
+        # ordering between engine install and exporter start
+        try:
+            from . import alerts as _alerts
+
+            eng = _alerts.get()
+            if eng is not None:
+                snap = eng.health()
+                providers["alerts"] = snap
+                ok = ok and bool(snap.get("ok", True))
+        except Exception:
+            pass
         for name, fn in list(self._health_providers.items()):
             try:
                 snap = fn()

@@ -21,7 +21,12 @@ the instrumented entry point (``apply_op``) vs the uninstrumented inner
    journeys are minted and stamped on the per-request serving seams
    (submit, pick, admit, chunk), never per op, so the dispatch path must
    also stay at the bare branch cost (same <5% budget, same
-   retry-once-on-noise policy).
+   retry-once-on-noise policy);
+6. **history plane armed** — ``PADDLE_OBS_TSDB`` on: the TSDB samples by
+   DIFFING registry snapshots on its own daemon thread every
+   ``interval_s`` (with the alert engine riding the same tick), so the
+   per-op dispatch path pays nothing but the live sampler thread's
+   background noise — which must stay under the same budget.
 
 A journey-record microbench is printed for information (the per-request
 cost of mint + a typical span set + finish with reqtrace armed) but not
@@ -258,6 +263,18 @@ def main() -> int:
                 lambda: measure(args.ops, args.repeats,
                                 setup=lambda: reqtrace.enable(ring=256),
                                 teardown=_reqtrace_off),
+                args.ops, args.budget)
+
+    # gate 6: history plane armed — live sampler thread (0.1s tick, much
+    # hotter than the 2s default, so the gate bounds a worst case) +
+    # alert engine evaluating the default ruleset on every tick
+    import paddlepaddle_tpu.observability as obs
+
+    rc |= _gate("tsdb-on",
+                lambda: measure(args.ops, args.repeats,
+                                setup=lambda: obs.enable_history(
+                                    interval_s=0.1),
+                                teardown=obs.disable_history),
                 args.ops, args.budget)
 
     _step_bracket_info()

@@ -57,6 +57,38 @@ PRESETS = {
 }
 
 
+def _git_sha() -> str:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"], capture_output=True, text=True,
+            timeout=10, cwd=os.path.dirname(os.path.abspath(__file__)))
+        return out.stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
+
+
+def _emit(body, args):
+    """Print the final ``{"coldstart": …}`` line; mirror to ``--out``.
+
+    Same artifact contract as ``serving_bench --out``: the bench body
+    plus a ``meta`` block (git sha, unix stamp, argv) in a file
+    ``tools/perf_gate.py`` loads directly. Child-mode JSON lines are NOT
+    artifacts — only the aggregated parent report is.
+    """
+    doc = {"coldstart": body}
+    print(json.dumps(doc))
+    if not args.out:
+        return
+    art = {"meta": {"bench": "coldstart_bench", "git_sha": _git_sha(),
+                    "unix_time": int(time.time()),
+                    "argv": sys.argv[1:]}}
+    art.update(doc)
+    with open(args.out, "w") as f:
+        json.dump(art, f, indent=1)
+        f.write("\n")
+    print(f"[coldstart_bench] artifact -> {args.out}", file=sys.stderr)
+
+
 def _build_model(preset: str):
     import paddlepaddle_tpu as paddle
     from paddlepaddle_tpu.models import LlamaConfig, LlamaForCausalLM
@@ -169,6 +201,10 @@ def main(argv=None) -> int:
                     help="work dir for the bundle + compile cache "
                     "(default: a fresh temp dir)")
     ap.add_argument("--new-tokens", type=int, default=8)
+    ap.add_argument("--out", default=None, metavar="PATH",
+                    help="also write the final JSON to PATH as a "
+                    "perf_gate-ready artifact (body + meta block with "
+                    "git sha + unix stamp)")
     ap.add_argument("--child", choices=["cold", "cache", "bundle", "save"],
                     help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
@@ -221,7 +257,7 @@ def main(argv=None) -> int:
     if head:
         body["restart_to_first_token_s"] = head["restart_to_first_token_s"]
         body["compiles"] = head["compiles"]
-    print(json.dumps({"coldstart": body}))
+    _emit(body, args)
     return 0
 
 

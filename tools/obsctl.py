@@ -6,12 +6,30 @@ Subcommands:
   scrape TARGET [--path /metrics]
       GET one exporter endpoint and print the body. TARGET is host:port or
       a full URL (e.g. `obsctl scrape 127.0.0.1:9470 --path /healthz`).
+      Warns on stderr when any merged rank's snapshot age exceeds 3x the
+      publish interval (a silently-stale fleet view).
 
   aggregate TARGET [TARGET ...] [-o OUT]
       Scrape /metrics from several per-rank exporters and print the merged
       exposition with a rank label per series (rank = each target's
       /healthz-reported rank, falling back to list position). The HTTP
-      twin of the store-based merge rank 0 serves itself.
+      twin of the store-based merge rank 0 serves itself. Same staleness
+      warning as scrape.
+
+  query TARGET [SERIES] [-w SECONDS] [--fleet] [--json]
+      Render metric history from the tsdb plane (/query, or rank-0's
+      merged /fleet/query with --fleet): one row per series with tier,
+      point count, last value and a sparkline.
+
+  alerts TARGET [--json]
+      Render the alert engine's rule table (/alerts): state, severity,
+      hold-down, fire counts and the window-predicate expressions.
+
+  top TARGET [-i SECONDS] [-n FRAMES | --once]
+      Live terminal dashboard: fleet census + version, firing alerts,
+      rollout state, and per-replica est-wait/inflight sparklines from
+      /query. Redraws in place; --once / -n print frames without escape
+      codes (tests, logs).
 
   merge-trace -o OUT TRACE [TRACE ...]
       Merge per-rank chrome-trace JSON files (from /trace or
@@ -42,7 +60,8 @@ Subcommands:
       $PADDLE_OBS_BLACKBOX_DIR or <tmpdir>/paddle_blackbox): header, the
       last N events, in-flight steps/tasks, and thread-stack summaries.
 
-`scrape`, `programs`, `fleet` and `blackbox tail` are stdlib-only (fast,
+`scrape`, `programs`, `fleet`, `query`, `alerts`, `top` and `blackbox
+tail` are stdlib-only (fast,
 safe on a box where the framework cannot import); `aggregate`/
 `merge-trace` import the observability package for the strict exposition
 parser and trace merger.
@@ -79,6 +98,39 @@ def _get(target: str, path: str, timeout: float):
         return e.code, e.read()
 
 
+def _publish_interval_s() -> float:
+    try:
+        return float(os.environ.get("PADDLE_OBS_PUBLISH_INTERVAL_S") or 2.0)
+    except ValueError:
+        return 2.0
+
+
+def _warn_stale(text: str) -> None:
+    """One-line staleness warning when any merged rank's
+    ``paddle_fleet_snapshot_age_seconds`` exceeds 3x the publish interval —
+    a silently-stale merged view reads exactly like a healthy one
+    otherwise. Stdlib text scan, no framework import."""
+    import re
+
+    bound = 3.0 * _publish_interval_s()
+    stale = []
+    for m in re.finditer(
+            r'^paddle_fleet_snapshot_age_seconds\{[^}]*rank="([^"]+)"[^}]*\}'
+            r"\s+([0-9.eE+-]+)", text, re.M):
+        try:
+            age = float(m.group(2))
+        except ValueError:
+            continue
+        if age > bound:
+            stale.append(f"rank {m.group(1)}: {age:.1f}s")
+    if stale:
+        sys.stderr.write(
+            f"[obsctl] WARNING: stale fleet snapshot(s) — "
+            f"{', '.join(stale)} old (> 3x the {_publish_interval_s():g}s "
+            "publish interval); that rank's samples in this merged view "
+            "are out of date\n")
+
+
 def cmd_scrape(args) -> int:
     try:
         _status, body = _get(args.target, args.path, args.timeout)
@@ -87,7 +139,9 @@ def cmd_scrape(args) -> int:
         # for — one line, not a traceback
         sys.stderr.write(f"[obsctl] {args.target}{args.path}: {e}\n")
         return 1
-    sys.stdout.write(body.decode(errors="replace"))
+    text = body.decode(errors="replace")
+    sys.stdout.write(text)
+    _warn_stale(text)
     return 0
 
 
@@ -399,6 +453,7 @@ def cmd_aggregate(args) -> int:
         print(f"[obsctl] merged {len(texts)} rank(s) -> {args.out}")
     else:
         sys.stdout.write(merged)
+    _warn_stale(merged)
     return 0
 
 
@@ -421,6 +476,255 @@ def cmd_merge_trace(args) -> int:
           f"{len(merged['traceEvents'])} events -> {args.out} "
           f"(open in https://ui.perfetto.dev)")
     return 0
+
+
+# -- history & alerting (tsdb plane) -----------------------------------------
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def _spark(values, width: int = 24) -> str:
+    """Unicode sparkline of the last ``width`` values, scaled to their own
+    min..max (a flat series renders as a flat line, not empty)."""
+    vals = [v for v in values if v is not None][-width:]
+    if not vals:
+        return "-"
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        return _SPARK[0] * len(vals)
+    span = hi - lo
+    return "".join(
+        _SPARK[min(len(_SPARK) - 1, int((v - lo) / span * len(_SPARK)))]
+        for v in vals)
+
+
+def _get_json(target: str, path: str, timeout: float):
+    status, body = _get(target, path, timeout)
+    return status, json.loads(body)
+
+
+def cmd_query(args) -> int:
+    """Stdlib-only /query (or /fleet/query) renderer: one row per series
+    with its tier, point count, last value and a sparkline."""
+    from urllib.parse import urlencode
+
+    params = {}
+    if args.series:
+        params["series"] = args.series
+    if args.window:
+        params["window"] = str(args.window)
+    path = ("/fleet/query" if args.fleet else "/query")
+    if params:
+        path += "?" + urlencode(params)
+    try:
+        status, doc = _get_json(args.target, path, args.timeout)
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        sys.stderr.write(f"[obsctl] {args.target}{path}: {e}\n")
+        return 1
+    if status != 200:
+        sys.stderr.write(f"[obsctl] {args.target}{path}: HTTP {status} "
+                         f"({doc.get('error')})\n")
+        return 1
+    if args.json:
+        print(json.dumps(doc, indent=1))
+        return 0
+    if args.fleet:
+        ranks = doc.get("ranks") or {}
+        print(f"[fleet query] {args.target}  world={doc.get('world')}  "
+              f"ranks_reporting={len(ranks)}  "
+              f"window={doc.get('window_s') or 'all'}")
+        if not ranks:
+            print("  (no rank has published history — arm PADDLE_OBS_TSDB=1 "
+                  "on the workers)")
+            return 0
+        for r in sorted(ranks, key=int):
+            _render_query_rows(ranks[r].get("series") or [],
+                               prefix=f"rank{r} ")
+        return 0
+    if not doc.get("enabled", False):
+        print(f"[query] {args.target}: history plane off — arm "
+              "PADDLE_OBS_TSDB=1")
+        return 0
+    print(f"[query] {args.target}  series={args.series or '*'}  "
+          f"window={doc.get('window_s') or 'all'}  "
+          f"interval={doc.get('interval_s')}s")
+    _render_query_rows(doc.get("series") or [])
+    return 0
+
+
+def _render_query_rows(rows, prefix: str = "") -> None:
+    if not rows:
+        print(f"  {prefix}(no matching series)")
+        return
+    for s in rows:
+        pts = s.get("points") or []
+        vals = [p[1] for p in pts]
+        last = f"{vals[-1]:.6g}" if vals else "-"
+        print(f"  {prefix}{s.get('id'):<52} {s.get('kind'):<7}"
+              f"{s.get('tier'):<7}{len(pts):>5} pts  last={last:<12} "
+              f"{_spark(vals)}")
+
+
+def cmd_alerts(args) -> int:
+    """Stdlib-only /alerts renderer: the rule table with state, hold-down
+    and the condition expressions."""
+    try:
+        status, doc = _get_json(args.target, "/alerts", args.timeout)
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        sys.stderr.write(f"[obsctl] {args.target}/alerts: {e}\n")
+        return 1
+    if status != 200:
+        sys.stderr.write(f"[obsctl] {args.target}/alerts: HTTP {status}\n")
+        return 1
+    if args.json:
+        print(json.dumps(doc, indent=1))
+        return 0
+    if not doc.get("enabled", False):
+        print(f"[alerts] {args.target}: alert engine off — arm "
+              "PADDLE_OBS_TSDB=1")
+        return 0
+    rules = doc.get("rules") or []
+    firing = [r for r in rules if r.get("state") == "firing"]
+    print(f"[alerts] {args.target}  rules={len(rules)}  "
+          f"firing={len(firing)}  ticks={doc.get('ticks')}")
+    print(f"  {'rule':<22}{'sev':<6}{'state':<9}{'value':>10}"
+          f"{'for_s':>7}{'fired':>7}  condition")
+    for r in rules:
+        conds = " AND ".join(
+            f"{c['agg']}({c['series']}[{c['window_s']:g}s]){c['op']}"
+            f"{c['threshold']:g}" for c in r.get("conditions") or [])
+        v = r.get("value")
+        state = str(r.get("state"))
+        if state == "firing":
+            state = "FIRING"
+        print(f"  {str(r.get('name'))[:22]:<22}"
+              f"{str(r.get('severity'))[:5]:<6}"
+              f"{state:<9}"
+              f"{'-' if v is None else format(v, '.4g'):>10}"
+              f"{r.get('for_s', 0):>7g}{r.get('fired_total', 0):>7}  "
+              f"{conds}")
+    return 0
+
+
+def _top_frame(args) -> list:
+    """One rendered frame of `obsctl top` as a list of lines."""
+    lines = []
+    now = time.strftime("%H:%M:%S")
+    try:
+        _status, health = _get_json(args.target, "/healthz", args.timeout)
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        return [f"obsctl top — {args.target}  {now}  UNREACHABLE ({e})"]
+    provs = health.get("providers") or {}
+    lines.append(f"obsctl top — {args.target}  {now}  ok={health.get('ok')}  "
+                 f"rank={health.get('rank')}/{health.get('world')}  "
+                 f"uptime={health.get('uptime_s')}s")
+
+    # alerts strip
+    try:
+        _s, al = _get_json(args.target, "/alerts", args.timeout)
+    except Exception:
+        al = {"enabled": False}
+    if al.get("enabled"):
+        firing = [r for r in al.get("rules") or []
+                  if r.get("state") == "firing"]
+        if firing:
+            names = ", ".join(
+                f"{r['name']}({r['severity']}"
+                + ("" if r.get("value") is None
+                   else f" {r['value']:.3g}") + ")"
+                for r in firing)
+            lines.append(f"  ALERTS FIRING: {names}")
+        else:
+            lines.append(f"  alerts: {len(al.get('rules') or [])} rules, "
+                         "none firing")
+    else:
+        lines.append("  alerts: engine off (PADDLE_OBS_TSDB=1 to arm)")
+
+    # fleet census + rollout (from the fleet /healthz provider, if any)
+    fleet = None
+    for prov in provs.values():
+        if isinstance(prov, dict) and isinstance(prov.get("fleet"), dict):
+            fleet = prov
+            break
+    if fleet is not None:
+        fl = fleet["fleet"]
+        auto = fl.get("autoscaler") or {}
+        last = auto.get("last_decision") or {}
+        lines.append(
+            f"  fleet: replicas={fl.get('replicas')}/"
+            f"target {fl.get('replicas_target')} healthy={fl.get('healthy')}"
+            f"  version={fl.get('version') or '-'}"
+            f"  last={last.get('action') or 'none'} ({last.get('reason')})")
+        ro = fl.get("rollout") or {}
+        if ro.get("state") not in (None, "idle"):
+            lines.append(f"  rollout: {ro.get('state')} "
+                         f"candidate={ro.get('version')} "
+                         f"replica={ro.get('replica') or '-'}"
+                         + (f" reasons={'; '.join(ro['reasons'])}"
+                            if ro.get("reasons") else ""))
+
+    # per-replica sparklines from the history plane
+    try:
+        from urllib.parse import urlencode
+
+        q = urlencode({"series": "paddle_router_replica_est_wait_seconds",
+                       "window": str(args.window)})
+        _s, est = _get_json(args.target, f"/query?{q}", args.timeout)
+        q = urlencode({"series": "paddle_router_replica_inflight",
+                       "window": str(args.window)})
+        _s, infl = _get_json(args.target, f"/query?{q}", args.timeout)
+    except Exception:
+        est, infl = {"enabled": False}, {"enabled": False}
+    if est.get("enabled"):
+        def by_replica(doc):
+            out = {}
+            for s in doc.get("series") or []:
+                sid = s.get("id", "")
+                rep = sid.split('replica="', 1)[-1].split('"', 1)[0] \
+                    if 'replica="' in sid else sid
+                out[rep] = [p[1] for p in s.get("points") or []]
+            return out
+
+        est_by, infl_by = by_replica(est), by_replica(infl)
+        reps = sorted(set(est_by) | set(infl_by))
+        if reps:
+            lines.append(f"  {'replica':<10}{'est_wait':>10}  "
+                         f"{'':<24}  {'inflight':>8}")
+            for rep in reps:
+                e, i = est_by.get(rep) or [], infl_by.get(rep) or []
+                lines.append(
+                    f"  {rep[:10]:<10}"
+                    f"{e[-1] if e else 0:>10.3f}  {_spark(e):<24}  "
+                    f"{int(i[-1]) if i else 0:>8} {_spark(i)}")
+        else:
+            lines.append("  (no per-replica history yet — router probes "
+                         "feed it each tick)")
+    else:
+        lines.append("  history: plane off (PADDLE_OBS_TSDB=1 for "
+                     "sparklines)")
+    return lines
+
+
+def cmd_top(args) -> int:
+    """Live terminal dashboard: fleet census, per-replica est-wait and
+    inflight sparklines from /query, firing alerts, rollout state.
+    Redraws every --interval seconds; --once prints a single frame (no
+    escape codes), -n bounds the iterations."""
+    n = 0
+    try:
+        while True:
+            frame = _top_frame(args)
+            if args.once or args.iterations:
+                print("\n".join(frame))
+            else:
+                sys.stdout.write("\x1b[2J\x1b[H" + "\n".join(frame) + "\n")
+                sys.stdout.flush()
+            n += 1
+            if args.once or (args.iterations and n >= args.iterations):
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
 
 
 # -- blackbox ----------------------------------------------------------------
@@ -554,6 +858,45 @@ def main(argv=None) -> int:
                    help="print the raw provider JSON instead of the table")
     p.add_argument("--timeout", type=float, default=5.0)
     p.set_defaults(fn=cmd_fleet)
+
+    p = sub.add_parser("query",
+                       help="render metric history from /query or "
+                            "/fleet/query")
+    p.add_argument("target", help="host:port or URL of a per-rank exporter")
+    p.add_argument("series", nargs="?", default="",
+                   help="series selector (name, exact id, or prefix*); "
+                        "empty = every series")
+    p.add_argument("-w", "--window", type=float, default=0.0,
+                   help="window in seconds (0 = all raw history)")
+    p.add_argument("--fleet", action="store_true",
+                   help="query rank-0's merged /fleet/query instead")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw JSON instead of the table")
+    p.add_argument("--timeout", type=float, default=5.0)
+    p.set_defaults(fn=cmd_query)
+
+    p = sub.add_parser("alerts",
+                       help="render the alert engine's rule table")
+    p.add_argument("target", help="host:port or URL of a per-rank exporter")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw JSON instead of the table")
+    p.add_argument("--timeout", type=float, default=5.0)
+    p.set_defaults(fn=cmd_alerts)
+
+    p = sub.add_parser("top",
+                       help="live dashboard: census, sparklines, alerts")
+    p.add_argument("target", help="host:port or URL of a per-rank exporter")
+    p.add_argument("-i", "--interval", type=float, default=2.0,
+                   help="redraw interval seconds (default 2)")
+    p.add_argument("-n", "--iterations", type=int, default=0,
+                   help="frames to render then exit (0 = until ^C); "
+                        "frames print without escape codes")
+    p.add_argument("--once", action="store_true",
+                   help="print one frame and exit (no escape codes)")
+    p.add_argument("-w", "--window", type=float, default=120.0,
+                   help="sparkline window seconds (default 120)")
+    p.add_argument("--timeout", type=float, default=5.0)
+    p.set_defaults(fn=cmd_top)
 
     p = sub.add_parser("aggregate",
                        help="merge /metrics from several exporters")

@@ -13,10 +13,22 @@ tolerances and exit nonzero on regression:
   noisier than throughput means).
 
 Serving SLO artifacts (the JSON lines ``tools/serving_bench.py`` /
-``tools/quant_ab.py`` print) are compared with ``--serving CUR BASE``.
+``tools/quant_ab.py`` print, or the ``--out`` artifacts with a ``meta``
+block) are compared with ``--serving CUR BASE``.
 Metrics present in the baseline but missing from the current artifact are
 reported as warnings (``--strict`` promotes them to failures): a bench that
 silently stopped reporting a number must not pass as "no regression".
+
+``--json`` prints ONE machine-readable verdict object on stdout (the
+human report moves to stderr) with per-field
+baseline/candidate/delta/direction/verdict rows — the shape CI and the
+``inference/fleet.py`` deploy gate (``perf_verdict_gate``) consume
+without parsing human text::
+
+    {"ok": bool, "strict": bool, "tol": .., "tol_latency": ..,
+     "regressions": [names], "missing": [names],
+     "fields": [{"metric", "baseline", "candidate", "delta",
+                 "direction", "verdict"}, ...]}
 
 Usage:
     python tools/perf_gate.py --baseline BENCH_r05.json --current out.json
@@ -24,6 +36,8 @@ Usage:
         --serving serving_now.json serving_base.json
     python tools/perf_gate.py --baseline BENCH_r05.json --dry-run
         # parse + report only, always exit 0 (the run_tier1 smoke)
+    python tools/perf_gate.py --baseline BENCH_r05.json --current out.json \
+        --json > verdict.json
 
 Exit codes: 0 ok / 1 regression (or missing metric under --strict) /
 2 unusable inputs.
@@ -55,7 +69,9 @@ def _first_json(text: str) -> Optional[dict]:
 
 def load_record(path: str) -> dict:
     """Load a driver ``BENCH_r*.json`` (uses its ``parsed`` field), a raw
-    bench stdout capture, or a plain JSON object."""
+    bench stdout capture, a bench ``--out`` artifact (``meta`` block +
+    body — the body keys pass through untouched), or a plain JSON
+    object."""
     with open(path) as f:
         text = f.read()
     try:
@@ -208,15 +224,26 @@ def serving_metrics(doc: dict) -> Dict[str, Tuple[float, str]]:
 
 def compare(base: Dict[str, Tuple[float, str]],
             cur: Dict[str, Tuple[float, str]],
-            tol: float, tol_latency: float) -> Tuple[list, list]:
-    """(failures, report_lines) over metrics present in the baseline."""
-    failures, lines = [], []
+            tol: float, tol_latency: float) -> Tuple[list, list, list]:
+    """(failures, report_lines, rows) over metrics in the baseline.
+
+    ``rows`` are the machine-readable per-field records behind ``--json``:
+    ``{"metric", "baseline", "candidate", "delta", "direction",
+    "verdict"}`` with verdict one of ok/improved/regression/missing.
+    ``delta`` is the signed worse-ness fraction (>0 = worse, direction
+    already folded in); an infinite delta (growth over a zero LOWER
+    baseline) is published as null — the verdict carries the failure.
+    """
+    failures, lines, rows = [], [], []
     for name in sorted(base):
         bval, direction = base[name]
         centry = cur.get(name)
         if centry is None:
             lines.append(f"  {name:<28} base={bval:<12g} MISSING in current")
             failures.append(("missing", name))
+            rows.append({"metric": name, "baseline": bval,
+                         "candidate": None, "delta": None,
+                         "direction": direction, "verdict": "missing"})
             continue
         cval = centry[0]
         budget = tol if direction == HIGHER else tol_latency
@@ -232,14 +259,21 @@ def compare(base: Dict[str, Tuple[float, str]],
         else:
             delta = (cval - bval) / abs(bval)
         verdict = "ok"
+        word = "ok"
         if delta > budget:
             verdict = f"REGRESSION ({delta:+.1%} worse > {budget:.0%} budget)"
+            word = "regression"
             failures.append(("regression", name))
         elif delta < -0.02:
             verdict = f"improved ({-delta:+.1%})"
+            word = "improved"
+        rows.append({"metric": name, "baseline": bval, "candidate": cval,
+                     "delta": (round(delta, 6)
+                               if delta != float("inf") else None),
+                     "direction": direction, "verdict": word})
         lines.append(f"  {name:<28} base={bval:<12g} cur={cval:<12g} "
                      f"{verdict}")
-    return failures, lines
+    return failures, lines, rows
 
 
 def main(argv=None) -> int:
@@ -262,7 +296,18 @@ def main(argv=None) -> int:
                     "the gate instead of warning")
     ap.add_argument("--dry-run", action="store_true",
                     help="report only; always exit 0 (CI smoke)")
+    ap.add_argument("--json", action="store_true",
+                    help="print ONE machine-readable verdict object on "
+                    "stdout (per-field baseline/candidate/delta/"
+                    "direction/verdict) and move the human report to "
+                    "stderr — the shape fleet.perf_verdict_gate and CI "
+                    "consume")
     args = ap.parse_args(argv)
+
+    def say(msg):
+        # --json owns stdout (one JSON object, nothing else); the human
+        # report stays readable on stderr
+        (sys.stderr.write(msg + "\n") if args.json else print(msg))
 
     try:
         base = bench_metrics(load_record(args.baseline))
@@ -275,11 +320,11 @@ def main(argv=None) -> int:
                          "metrics found\n")
         return 2
 
-    failures, lines = compare(base, cur, args.tol, args.tol_latency)
-    print(f"[perf_gate] bench: {args.current or args.baseline} vs "
-          f"{args.baseline} (tol {args.tol:.0%} throughput, "
-          f"{args.tol_latency:.0%} latency)")
-    print("\n".join(lines))
+    failures, lines, rows = compare(base, cur, args.tol, args.tol_latency)
+    say(f"[perf_gate] bench: {args.current or args.baseline} vs "
+        f"{args.baseline} (tol {args.tol:.0%} throughput, "
+        f"{args.tol_latency:.0%} latency)")
+    say("\n".join(lines))
 
     if args.serving:
         try:
@@ -288,37 +333,49 @@ def main(argv=None) -> int:
         except (OSError, ValueError) as e:
             sys.stderr.write(f"[perf_gate] serving: {e}\n")
             return 2
-        sfail, slines = compare(serving_metrics(rec_base),
-                                serving_metrics(rec_cur),
-                                args.tol, args.tol_latency)
+        sfail, slines, srows = compare(serving_metrics(rec_base),
+                                       serving_metrics(rec_cur),
+                                       args.tol, args.tol_latency)
         failures += sfail
-        print(f"[perf_gate] serving: {args.serving[0]} vs {args.serving[1]}")
-        print("\n".join(slines))
+        rows += srows
+        say(f"[perf_gate] serving: {args.serving[0]} vs {args.serving[1]}")
+        say("\n".join(slines))
         for label, rec in (("cur", rec_cur), ("base", rec_base)):
             sb = rec.get("serving_bench") or rec
             rate = sb.get("spec_acceptance_rate")
             if rate is not None:
-                print(f"[perf_gate] info: spec_acceptance_rate[{label}]="
-                      f"{rate} (informational — draft quality, not gated)")
+                say(f"[perf_gate] info: spec_acceptance_rate[{label}]="
+                    f"{rate} (informational — draft quality, not gated)")
 
     regressions = [n for kind, n in failures if kind == "regression"]
     missing = [n for kind, n in failures if kind == "missing"]
     if missing and not args.strict:
-        print(f"[perf_gate] warning: {len(missing)} baseline metric(s) "
-              f"missing from current ({', '.join(missing)}) — "
-              "--strict to fail on this")
+        say(f"[perf_gate] warning: {len(missing)} baseline metric(s) "
+            f"missing from current ({', '.join(missing)}) — "
+            "--strict to fail on this")
     bad = bool(regressions) or (args.strict and bool(missing))
+    if args.json:
+        # the one stdout line under --json: fleet.perf_verdict_gate and
+        # CI read this verbatim. "ok" already folds --strict in; a
+        # non-strict run still lists the missing fields so a stricter
+        # consumer can veto on them
+        print(json.dumps({
+            "ok": not bad, "strict": bool(args.strict),
+            "tol": args.tol, "tol_latency": args.tol_latency,
+            "regressions": regressions, "missing": missing,
+            "fields": rows,
+        }))
     if args.dry_run:
-        print(f"[perf_gate] dry-run: would "
-              f"{'FAIL' if bad else 'pass'} ({len(regressions)} "
-              f"regression(s), {len(missing)} missing)")
+        say(f"[perf_gate] dry-run: would "
+            f"{'FAIL' if bad else 'pass'} ({len(regressions)} "
+            f"regression(s), {len(missing)} missing)")
         return 0
     if bad:
-        print(f"[perf_gate] FAIL: {len(regressions)} regression(s)"
-              + (f", {len(missing)} missing metric(s)" if args.strict
-                 and missing else ""))
+        say(f"[perf_gate] FAIL: {len(regressions)} regression(s)"
+            + (f", {len(missing)} missing metric(s)" if args.strict
+               and missing else ""))
         return 1
-    print("[perf_gate] OK")
+    say("[perf_gate] OK")
     return 0
 
 

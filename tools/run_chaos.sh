@@ -36,6 +36,12 @@
 #     under a serving.decode storm — zero lost futures, rolling restart of
 #     tp engines comes back healthy
 #     (test_shard_plan.py::test_tp_engine_behind_router_drains_and_fails_over)
+#   * history & alerting: a serving.decode latency storm against a
+#     2-replica router burns the TTFT SLO budget — the default ttft_burn
+#     rule fires within two sampler ticks, /healthz flips to 503 with the
+#     alert block, exactly ONE flight dump lands carrying the slowest
+#     request journeys, and the alert clears after the storm
+#     (test_tsdb_alerts.py::test_latency_storm_fires_ttft_burn_then_clears)
 #   * black box: PADDLE_CHAOS_POINTS=step:kill:@4 under PADDLE_OBS_BLACKBOX
 #     kills a launched worker mid-step; the flight recorder's JSONL dump
 #     must carry the in-flight step event + all-thread stacks, and

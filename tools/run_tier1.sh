@@ -71,6 +71,15 @@
 # --fused-kernels` and `python tools/moe_dispatch_bench.py`
 # (BASELINE.md "Fused kernels"; docs/kernels.md).
 #
+# History-and-alerting suite: tests/test_tsdb_alerts.py (in-process TSDB
+# ring/downsample/rate units, window quantiles, multi-window burn-rate
+# alert hold-down, alert -> one flight dump with slowest journeys,
+# /query + 2-rank /fleet/query over a real TCPStore, obsctl
+# top/alerts/query) runs here — synthetic clocks, seconds total; the
+# injected-latency-storm acceptance drill is `chaos`-marked
+# (tools/run_chaos.sh). The tsdb-on hot-path budget (<5%) is gate 6 of
+# tools/check_obs_overhead.py.
+#
 # Perf regression gate (not run here — needs a bench artifact): after a
 # bench run, `python tools/perf_gate.py --baseline BENCH_r05.json
 # --current <new>.json` exits nonzero on a tokens/s / MFU / TTFT
@@ -79,6 +88,10 @@
 # tests/test_perf_attribution.py in this tier. The --serving pair also
 # gates the paged-KV serving_bench fields (mixed_tok_s, prefix_hit_rate,
 # concurrency_peak higher-is-better; kv_occupancy_peak lower-is-better).
+# serving_bench/coldstart_bench `--out BENCH_serving_r<NN>.json` write
+# the perf_gate-ready artifact (body + meta block with git sha + unix
+# stamp); `perf_gate --json` emits the machine verdict the fleet deploy
+# gate (fleet.perf_verdict_gate) consumes.
 set -o pipefail
 cd "$(dirname "$0")/.."
 rm -f /tmp/_t1.log

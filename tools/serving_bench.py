@@ -67,6 +67,43 @@ import numpy as np
 from paddlepaddle_tpu.inference.serving import ServingEngine, slo_summary
 
 
+# -- artifact emission (--out) -----------------------------------------------
+
+def _git_sha() -> str:
+    try:
+        import subprocess
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"], capture_output=True, text=True,
+            timeout=10, cwd=os.path.dirname(os.path.abspath(__file__)))
+        return out.stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
+
+
+def _emit(body, args, bench="serving_bench"):
+    """Print the final JSON line; mirror it to ``--out`` with a meta block.
+
+    The artifact is the ``BENCH_serving_r<NN>.json`` shape
+    ``tools/perf_gate.py`` loads directly: the bench body under its usual
+    key, plus a ``meta`` block (git sha, unix stamp, argv) recording WHAT
+    produced a saved baseline — without it a months-old baseline file is
+    unattributable to a commit.
+    """
+    doc = {bench: body}
+    print(json.dumps(doc))
+    out = getattr(args, "out", None)
+    if not out:
+        return
+    art = {"meta": {"bench": bench, "git_sha": _git_sha(),
+                    "unix_time": int(time.time()),
+                    "argv": sys.argv[1:]}}
+    art.update(doc)
+    with open(out, "w") as f:
+        json.dump(art, f, indent=1)
+        f.write("\n")
+    print(f"[{bench}] artifact -> {out}", file=sys.stderr)
+
+
 # -- open-loop arrival profiles (--traffic) ----------------------------------
 #
 # The closed-loop runs above submit everything at t=0 and wait: they measure
@@ -743,6 +780,11 @@ def main():
     ap.add_argument("--hidden", type=int, default=1024)
     ap.add_argument("--layers", type=int, default=12)
     ap.add_argument("--max-len", type=int, default=2048)
+    ap.add_argument("--out", default=None, metavar="PATH",
+                    help="also write the final JSON to PATH as a "
+                    "perf_gate-ready artifact (BENCH_serving_r<NN>.json "
+                    "shape: the body plus a meta block with git sha + "
+                    "unix stamp)")
     args = ap.parse_args()
 
     model = build_model(args)
@@ -790,7 +832,7 @@ def main():
         row = run_traffic(model, prompts, args)
         fmt_traffic(row)
         body["traffic"] = row
-        print(json.dumps({"serving_bench": body}))
+        _emit(body, args)
         return
 
     if args.replicas > 1:
@@ -802,7 +844,7 @@ def main():
         body.update(row)
         if args.profile == "mixed":
             body["mixed_tok_s"] = body["aggregate_tok_s"]
-        print(json.dumps({"serving_bench": body}))
+        _emit(body, args)
         return
 
     if args.ab:
@@ -875,7 +917,7 @@ def main():
         # contiguous no-indirection floor — the r7 <=5% budget
         body["paged_chunk_overhead_pct"] = ab["paged_chunk_overhead_pct"]
 
-    print(json.dumps({"serving_bench": body}))
+    _emit(body, args)
 
 
 if __name__ == "__main__":
