@@ -13,7 +13,18 @@
 //
 // Build: g++ -O2 -fPIC -shared -o libpaddle_inference_c.so paddle_inference_c.cpp
 // Protocol (little-endian):
-//   request : u32 magic 'PDC1' | u8 op (1=RUN, 2=INFO, 3=HEALTH, 4=METRICS) | body
+//   request : u32 magic 'PDC1' | u8 op (1=RUN, 2=INFO, 3=HEALTH, 4=METRICS,
+//             5=SUBMIT, 6=DRAIN, 7=RESTART) | body
+//   Ops 5-7 are the serving-replica extension (used by the python
+//   RemoteReplicaClient; this C client does not speak them): SUBMIT is a
+//   STREAMING generation op — one submit per connection, chunk frames
+//   (status 2) then a terminal frame — and DRAIN/RESTART drive the
+//   attached ServingEngine's lifecycle. Status 3 is a TYPED error frame:
+//   still u32 len | payload, but the payload is a JSON document
+//   {type, msg, fields} a python client rehydrates into the original
+//   exception class. A legacy client reading any nonzero status as
+//   "u32 msg_len | msg" (as read_reply below does) remains correct —
+//   it shows the JSON text as the error message.
 //   RUN body: u32 n | n * tensor      tensor: u32 name_len | name |
 //             u8 dtype (0 f32, 1 i64, 2 i32, 3 u8) | u32 ndim |
 //             i64 dims[ndim] | payload

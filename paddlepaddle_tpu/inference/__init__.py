@@ -173,6 +173,11 @@ from .robustness import (  # noqa: F401,E402
     ServerOverloadedError,
     ServingError,
 )
+from .remote_replica import (  # noqa: F401,E402
+    ProcessReplicaFactory,
+    RemoteReplicaClient,
+    ReplicaSupervisor,
+)
 from .router import ReplicaClient, ServingRouter  # noqa: F401,E402
 from .serving import GenerationResult, ServingEngine  # noqa: F401,E402
 from .speculative import SpeculativeDecoder  # noqa: F401,E402

@@ -3,9 +3,29 @@
 Reference surface: paddle/fluid/inference/capi_exp/ — there the C API calls
 into the in-process C++ predictor; here the predictor is an XLA program
 owned by this Python runtime, so the C library is a native client speaking
-a length-prefixed binary protocol over a Unix domain socket, and this
-module is the listener that executes the program on the chip. One thread
-per connection; tensors cross as raw little-endian buffers (f32/i64/i32/u8).
+a length-prefixed binary protocol over a Unix domain socket (or loopback
+TCP), and this module is the listener that executes the program on the
+chip. One thread per connection; tensors cross as raw little-endian
+buffers (f32/i64/i32/u8).
+
+Beyond the predictor ops (``_OP_RUN/_OP_INFO/_OP_HEALTH/_OP_METRICS``)
+the server can front a live :class:`~.serving.ServingEngine` (pass
+``engine=``), which arms the replica-process ops the remote fleet is
+built on (:mod:`~.remote_replica`):
+
+* ``_OP_SUBMIT`` — STREAMING: one generation request per connection.
+  Request kwargs cross as JSON + the prompt as a packed tensor; the
+  server answers with chunk frames (status 2: admit / first-token /
+  progress events) and exactly one terminal frame — status 0 with the
+  SLO stamps, the stitched request-journey spans, and the output tensor,
+  or status 3 with a TYPED error document
+  (:func:`~.robustness.error_to_wire`) so the client rehydrates the
+  same exception class the in-process engine would have raised. A client
+  that disconnects mid-stream gets its request cancelled — the decode
+  slot (and its KV pages) come back on the next scheduler cycle.
+* ``_OP_DRAIN`` — graceful admission close (JSON ``{timeout, reason}``).
+* ``_OP_RESTART`` — drain + in-place engine restart for native clients;
+  the replica supervisor restarts by SIGTERM/respawn instead.
 """
 
 from __future__ import annotations
@@ -15,6 +35,7 @@ import os
 import socket
 import struct
 import threading
+from time import perf_counter as _now
 from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -22,6 +43,15 @@ import numpy as np
 _MAGIC = 0x50444331
 _DTYPES = [np.dtype("<f4"), np.dtype("<i8"), np.dtype("<i4"), np.dtype("u1")]
 _OP_RUN, _OP_INFO, _OP_HEALTH, _OP_METRICS = 1, 2, 3, 4
+_OP_SUBMIT, _OP_DRAIN, _OP_RESTART = 5, 6, 7
+
+# reply statuses. 1 carries a plain text message (the predictor ops'
+# legacy form); 3 carries a JSON error document that rehydrates into the
+# SAME typed exception client-side (robustness.error_from_wire); 2 is a
+# mid-stream submit chunk. Every nonzero status has the same
+# <u32 len | payload> body shape, so a legacy native client reading any
+# nonzero status as "error text" keeps working.
+_ST_OK, _ST_ERR, _ST_CHUNK, _ST_TYPED = 0, 1, 2, 3
 
 # a frame length past this is garbage (or an attack), not a request: reply
 # with an error frame and close instead of trying to buffer it
@@ -81,19 +111,33 @@ class CApiServer:
     text the HTTP exporter serves; an empty registry yields an OK frame
     with a zero-length payload, not an error."""
 
-    def __init__(self, predictor, socket_path: str,
+    def __init__(self, predictor, socket_path: Optional[str] = None,
                  input_names: Optional[Sequence[str]] = None,
                  output_names: Optional[Sequence[str]] = None,
                  health_fn: Optional[Callable[[], dict]] = None,
-                 metrics_fn: Optional[Callable[[], str]] = None):
+                 metrics_fn: Optional[Callable[[], str]] = None,
+                 engine=None,
+                 port: Optional[int] = None,
+                 host: str = "127.0.0.1"):
+        if socket_path is None and port is None:
+            raise ValueError("CApiServer needs socket_path= (UDS) or "
+                             "port= (loopback TCP)")
         self.predictor = predictor
         self.path = socket_path
+        self.port = port          # 0 = ephemeral; real port after start()
+        self.host = host
+        self.engine = engine      # arms _OP_SUBMIT/_OP_DRAIN/_OP_RESTART
         self.health_fn = health_fn
         self.metrics_fn = metrics_fn
-        self.input_names = list(input_names if input_names is not None
-                                else predictor.get_input_names())
-        self.output_names = list(output_names if output_names is not None
-                                 else predictor.get_output_names())
+        if predictor is None:
+            self.input_names = list(input_names or [])
+            self.output_names = list(output_names or [])
+        else:
+            self.input_names = list(input_names if input_names is not None
+                                    else predictor.get_input_names())
+            self.output_names = list(
+                output_names if output_names is not None
+                else predictor.get_output_names())
         self._sock: Optional[socket.socket] = None
         self._threads: List[threading.Thread] = []
         self._conns: List[socket.socket] = []
@@ -107,6 +151,23 @@ class CApiServer:
     def _reply_err(self, msg: str) -> bytes:
         m = msg.encode()[:4096]
         return struct.pack("<IB", _MAGIC, 1) + struct.pack("<I", len(m)) + m
+
+    def _reply_json(self, status: int, doc: dict,
+                    tail: bytes = b"") -> bytes:
+        blob = json.dumps(doc, default=str).encode()
+        return (struct.pack("<IB", _MAGIC, status)
+                + struct.pack("<I", len(blob)) + blob + tail)
+
+    def _reply_typed(self, exc: BaseException, **extra) -> bytes:
+        from .robustness import error_to_wire
+
+        doc = error_to_wire(exc)
+        doc.update(extra)
+        return self._reply_json(_ST_TYPED, doc)
+
+    @staticmethod
+    def _send_frame(conn: socket.socket, frame: bytes) -> None:
+        conn.sendall(struct.pack("<Q", len(frame)) + frame)
 
     def _handle(self, req: bytes) -> Tuple[bytes, bool]:
         """Returns (reply frame, close_connection). A malformed frame (bad
@@ -150,6 +211,32 @@ class CApiServer:
                 return self._reply_err(f"metrics scrape failed: {e}"), False
             return (self._reply_ok(struct.pack("<I", len(payload)) + payload),
                     False)
+        if op == _OP_DRAIN:
+            if self.engine is None:
+                return self._reply_err("no serving engine attached"), False
+            try:
+                kw = {}
+                if c.o < len(c.b):
+                    kw = json.loads(c.raw(c.take("I")).decode() or "{}")
+                res = self.engine.drain(kw.get("timeout"),
+                                        reason=kw.get("reason", "drain"))
+                return self._reply_json(_ST_OK, dict(res)), False
+            except Exception as e:
+                return self._reply_typed(e), False
+        if op == _OP_RESTART:
+            if self.engine is None:
+                return self._reply_err("no serving engine attached"), False
+            try:
+                kw = {}
+                if c.o < len(c.b):
+                    kw = json.loads(c.raw(c.take("I")).decode() or "{}")
+                self.engine.drain(kw.get("timeout"), reason="restart")
+                self.engine.start()
+                return self._reply_json(
+                    _ST_OK, {"ok": True,
+                             "health": self.engine.health()}), False
+            except Exception as e:
+                return self._reply_typed(e), False
         if op != _OP_RUN:
             return self._reply_err(f"unknown op {op}"), False
         try:
@@ -174,6 +261,137 @@ class CApiServer:
         except Exception as e:  # surfaced as PD_PredictorGetLastError
             return self._reply_err(f"{type(e).__name__}: {e}"), False
 
+    # -- streaming submit (one request per connection) -----------------------
+    def _handle_submit(self, c: _Cursor, conn: socket.socket) -> None:
+        """``_OP_SUBMIT``: parse kwargs + prompt, submit to the engine,
+        stream lifecycle chunks, finish with ONE terminal frame (typed
+        error or SLO header + output tensor). The connection is this
+        request's: it closes when the frame lands. A half-written stream
+        whose client disconnected cancels the request, releasing its
+        decode slot and KV pages — a dead client must not leak pages."""
+        from .robustness import RequestValidationError, error_to_wire
+
+        eng = self.engine
+        try:
+            hdr = json.loads(c.raw(c.take("I")).decode())
+            if not isinstance(hdr, dict):
+                raise ValueError("submit kwargs must be a JSON object")
+            _, prompt = _unpack_tensor(c)
+        except Exception:
+            self._send_frame(conn, self._reply_typed(RequestValidationError(
+                "malformed _OP_SUBMIT frame: truncated or invalid "
+                "kwargs/prompt payload")))
+            return
+        if eng is None:
+            self._send_frame(conn, self._reply_typed(RequestValidationError(
+                "this server has no serving engine attached "
+                "(predictor-only endpoint)")))
+            return
+        journey = None
+        tr = hdr.pop("trace", None)
+        if isinstance(tr, dict):
+            # a wire journey: a plain span collector carrying the parent
+            # trace id — NOT registered in this process's in-flight ring
+            # (the client owns the journey; replica-side spans travel
+            # back in the terminal frame and are stitched there)
+            try:
+                from ..observability import reqtrace as _rt
+
+                journey = _rt.Journey(tr.get("req_id"), 256)
+                journey.trace_id = str(tr.get("trace_id")
+                                       or journey.trace_id)
+            except Exception:
+                journey = None
+        kw = {k: hdr[k] for k in ("max_new_tokens", "temperature", "top_k",
+                                  "eos_token_id", "deadline_s",
+                                  "prefix_len")
+              if hdr.get(k) is not None}
+        if journey is not None:
+            kw["trace"] = journey
+        try:
+            fut = eng.submit(prompt, **kw)
+        except Exception as e:       # typed admission refusal, validation
+            self._send_frame(conn, self._reply_typed(e))
+            return
+        try:
+            # the client's submit() blocks on this first frame: accepted
+            # here mirrors the in-process contract where a returning
+            # submit() call IS the admission decision
+            self._send_frame(conn, self._reply_json(_ST_CHUNK,
+                                                    {"ev": "accepted"}))
+            sent_admit = sent_first = False
+            last_n = 0
+            last_tx = _now()
+            while not fut._event.wait(0.005):
+                # disconnect probe: the client never writes after the
+                # request frame, so any EOF here means it went away
+                try:
+                    if conn.recv(1, socket.MSG_DONTWAIT) == b"":
+                        fut.cancel()
+                        return
+                except (BlockingIOError, InterruptedError):
+                    pass
+                except OSError:
+                    fut.cancel()
+                    return
+                events = []
+                if not sent_admit and fut._t_admit is not None:
+                    sent_admit = True
+                    events.append({"ev": "admit"})
+                if not sent_first and fut._t_first is not None:
+                    sent_first = True
+                    last_n = fut._n_at_first
+                    events.append({"ev": "first", "n": fut._n_at_first})
+                if sent_first and fut._n_new > last_n:
+                    last_n = fut._n_new
+                    events.append({"ev": "progress", "n": last_n})
+                if not events and _now() - last_tx > 0.5:
+                    # heartbeat: a long decode with nothing to report
+                    # must not read as a dead replica to the client's
+                    # read timeout
+                    events.append({"ev": "hb"})
+                for ev in events:
+                    self._send_frame(conn, self._reply_json(_ST_CHUNK, ev))
+                if events:
+                    last_tx = _now()
+            err = fut._error
+            if err is not None:
+                doc = error_to_wire(err)
+                if journey is not None:
+                    doc["journey"] = self._journey_wire(journey)
+                self._send_frame(conn, self._reply_json(_ST_TYPED, doc))
+                return
+            out = np.ascontiguousarray(np.asarray(fut._output))
+            head = {
+                "n_new": fut._n_new,
+                "n_at_first": fut._n_at_first,
+                "streaming": bool(fut._streaming),
+                # lifecycle stamps as offsets from the REPLICA-side
+                # submit: the client re-anchors them on its own clock
+                "admit_rel": (None if fut._t_admit is None
+                              else fut._t_admit - fut._t_submit),
+                "first_rel": (None if fut._t_first is None
+                              else fut._t_first - fut._t_submit),
+                "done_rel": (None if fut._t_done is None
+                             else fut._t_done - fut._t_submit),
+            }
+            if journey is not None:
+                head["journey"] = self._journey_wire(journey)
+            self._send_frame(conn, self._reply_json(
+                _ST_OK, head, _pack_tensor("output_ids", out)))
+        except OSError:
+            # client went away mid-stream (BrokenPipe/reset): release the
+            # slot — kv.pages_free must come back to its idle value
+            fut.cancel()
+        finally:
+            if not fut.done():
+                fut.cancel()
+
+    @staticmethod
+    def _journey_wire(j) -> dict:
+        return {"trace_id": j.trace_id, "t0_wall": j.t0_wall,
+                "spans": list(j.spans), "dropped": j.dropped}
+
     # -- transport ----------------------------------------------------------
     def _serve_conn(self, conn: socket.socket):
         try:
@@ -187,6 +405,10 @@ class CApiServer:
                         head += chunk
                     (length,) = struct.unpack("<Q", head)
                     if length > _MAX_FRAME:
+                        # status 1 (not typed): the op byte lives inside
+                        # the payload we refuse to buffer, so the peer may
+                        # be a legacy native client — keep the legacy
+                        # error-frame contract here
                         reply = self._reply_err(
                             f"frame length {length} exceeds max "
                             f"{_MAX_FRAME} bytes")
@@ -198,6 +420,17 @@ class CApiServer:
                         if not chunk:
                             return
                         buf += chunk
+                    if (len(buf) >= 5
+                            and struct.unpack_from("<IB", buf)
+                            == (_MAGIC, _OP_SUBMIT)):
+                        # streaming op: owns the connection, one request
+                        # per connection, closes when the terminal frame
+                        # (or the client) goes away
+                        c = _Cursor(buf)
+                        c.take("I")
+                        c.take("B")
+                        self._handle_submit(c, conn)
+                        return
                     reply, close = self._handle(buf)
                     conn.sendall(struct.pack("<Q", len(reply)) + reply)
                     if close:
@@ -210,10 +443,17 @@ class CApiServer:
                     pass   # stop() already cleared the list
 
     def start(self):
-        if os.path.exists(self.path):
-            os.unlink(self.path)
-        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        self._sock.bind(self.path)
+        if self.port is not None:
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._sock.setsockopt(socket.SOL_SOCKET,
+                                  socket.SO_REUSEADDR, 1)
+            self._sock.bind((self.host, self.port))
+            self.port = self._sock.getsockname()[1]   # resolve port 0
+        else:
+            if os.path.exists(self.path):
+                os.unlink(self.path)
+            self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self._sock.bind(self.path)
         self._sock.listen(8)
 
         def accept_loop():
@@ -248,7 +488,7 @@ class CApiServer:
                 conn.shutdown(socket.SHUT_RDWR)
             except OSError:
                 pass
-        if os.path.exists(self.path):
+        if self.path is not None and os.path.exists(self.path):
             os.unlink(self.path)
 
     def __enter__(self):

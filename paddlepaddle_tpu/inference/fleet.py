@@ -314,11 +314,20 @@ class FleetController:
     # -- plumbing ------------------------------------------------------------
     def _engine_factory(self, version: Optional[str]):
         factory = self.factory
-        return lambda: factory(version)
+        fn = lambda: factory(version)  # noqa: E731
+        # the version rides the closure so a process-backed client's
+        # restart(factory=...) can respawn onto the new bundle path
+        fn.version = version
+        return fn
 
     def _new_client(self, version: Optional[str]) -> ReplicaClient:
         name = f"{self.name_prefix}{next(self._ids)}"
         self._versions[name] = version
+        if getattr(self.factory, "makes_clients", False):
+            # a ProcessReplicaFactory builds the whole client (supervisor
+            # + RemoteReplicaClient over a fresh OS process), not an
+            # engine — the controller manages processes, same surface
+            return self.factory(version, name=name)
         return ReplicaClient(self._engine_factory(version), name=name)
 
     def _journey(self, tag: str):

@@ -37,7 +37,7 @@ __all__ = [
     "RequestCancelledError", "CircuitOpenError", "EngineDrainingError",
     "RequestValidationError", "KVCapacityError", "FleetUnavailableError",
     "DeployError", "CircuitBreaker", "QueueWaitEstimator", "safe_inc",
-    "safe_set",
+    "safe_set", "error_to_wire", "error_from_wire",
 ]
 
 
@@ -154,6 +154,72 @@ class DeployError(ServingError):
         super().__init__(msg)
         self.stage = str(stage)
         self.reasons = list(reasons or [])
+
+
+# ---------------------------------------------------------------------------
+# wire (de)serialization — the process boundary's half of the taxonomy.
+#
+# A remote replica (inference/replica_main.py) reports failures as a typed
+# error frame: {"type": <class name>, "msg": str(exc), "fields": {...}}.
+# error_from_wire rebuilds the SAME exception class with the SAME extra
+# fields (retry_after_s, queue_depth, ...) on the client side, so the
+# router's _retryable() classification, breaker evidence, and client
+# backoff hints are byte-identical whether the replica is a thread or a
+# process. An unknown type (a replica running newer code, or a raw engine
+# crash) rehydrates as an untyped RuntimeError — which the router treats
+# as retryable infra failure, exactly what a crashed process should be.
+# ---------------------------------------------------------------------------
+
+_WIRE_FIELDS = {
+    "ServerOverloadedError": ("queue_depth", "retry_after_s"),
+    "CircuitOpenError": ("retry_after_s",),
+    "KVCapacityError": ("pages_needed", "pages_capacity"),
+    "FleetUnavailableError": ("replicas", "healthy", "retry_after_s"),
+    "DeployError": ("stage", "reasons"),
+}
+
+
+def error_to_wire(exc: BaseException) -> dict:
+    """One JSON-able dict per exception: class name, message, and the
+    class's extra constructor fields (so hints like ``retry_after_s``
+    survive the hop). Never raises — a serialization failure degrades to
+    an untyped record, not a lost error."""
+    doc = {"type": type(exc).__name__, "msg": str(exc)}
+    try:
+        fields = {}
+        for f in _WIRE_FIELDS.get(doc["type"], ()):
+            v = getattr(exc, f, None)
+            if v is not None:
+                fields[f] = v
+        if fields:
+            doc["fields"] = fields
+    except Exception:
+        pass
+    return doc
+
+
+def error_from_wire(doc: dict) -> BaseException:
+    """Rebuild the typed exception a replica process reported. Unknown
+    (or untyped) error types come back as ``RuntimeError`` — the router
+    classifies those as retryable infra failures, which is the correct
+    reading of \"the remote engine blew up\"."""
+    name = str(doc.get("type") or "RuntimeError")
+    msg = str(doc.get("msg") or "remote replica error")
+    fields = doc.get("fields") or {}
+    cls = globals().get(name)
+    if (not isinstance(cls, type) or not issubclass(cls, ServingError)):
+        # deliberate: client-side cancellation/timeouts keep their stdlib
+        # types so caller except-clauses (TimeoutError) still match
+        if name == "TimeoutError":
+            return TimeoutError(msg)
+        return RuntimeError(f"{name}: {msg}" if name != "RuntimeError"
+                            else msg)
+    try:
+        known = {f: fields[f] for f in _WIRE_FIELDS.get(name, ())
+                 if f in fields}
+        return cls(msg, **known)
+    except Exception:
+        return cls(msg)
 
 
 class CircuitBreaker:

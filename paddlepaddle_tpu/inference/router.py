@@ -113,9 +113,10 @@ def _retryable(exc: BaseException) -> bool:
 class ReplicaClient:
     """The seam between the router and ONE replica. In-process form: owns a
     :class:`~.serving.ServingEngine` built by ``factory`` (a zero-arg
-    callable), rebuilt fresh on :meth:`restart`. A remote replica — HTTP
-    ``/healthz`` for :meth:`health`, the C-API frame protocol for
-    :meth:`submit` — implements this same surface and slots in unchanged.
+    callable), rebuilt fresh on :meth:`restart`. The remote form —
+    :class:`~.remote_replica.RemoteReplicaClient`, speaking the C-API
+    frame protocol to a supervised OS process — implements this same
+    surface and slots in unchanged.
 
     ``kill()`` is the chaos seam: abrupt replica death. In-flight futures
     fail untyped (the router's failover path), and the replica refuses
@@ -816,6 +817,11 @@ class ServingRouter:
                 "pages_free": snap.get("pages_free"),
                 "generation": rep.client.generation,
             }
+            # process-backed replicas (RemoteReplicaClient over a
+            # ReplicaSupervisor) carry their supervisor block — pid,
+            # spawn/restart/crash counters, last exit — for obsctl
+            if snap.get("supervisor") is not None:
+                reps[rep.name]["supervisor"] = snap["supervisor"]
         with self._stats_lock:
             stats = dict(self.stats)
         alive = self._started and not self._stop.is_set()

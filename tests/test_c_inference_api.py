@@ -273,3 +273,94 @@ def test_c_garbage_frame_gets_error_reply_then_close(tmp_path):
 
         status2, _ = _rpc(sock, struct.pack("<IB", _MAGIC, 2))
         assert status2 == 0
+
+
+# ---------------------------------------------------------------------------
+# _OP_SUBMIT (op 5) hardening: malformed/oversized frames must come back as
+# TYPED error frames (status 3, rehydratable JSON) + close — the remote
+# replica client turns them into the same RequestValidationError the
+# in-process engine raises, and the legacy C client still reads them as
+# "u32 len + message" error text.
+# ---------------------------------------------------------------------------
+
+def _typed(body):
+    import json as _json
+
+    (n,) = struct.unpack_from("<I", body)
+    return _json.loads(body[4:4 + n])
+
+
+def test_c_submit_garbage_payload_gets_typed_frame_then_close(tmp_path):
+    from paddlepaddle_tpu.inference.c_api_server import _MAGIC, CApiServer
+    from paddlepaddle_tpu.inference.robustness import (
+        RequestValidationError,
+        error_from_wire,
+    )
+
+    sock = str(tmp_path / "pd.sock")
+    with CApiServer(_NullPredictor(), sock):
+        status, body = _rpc(
+            sock, struct.pack("<IB", _MAGIC, 5) + b"\xff" * 32)
+        assert status == 3
+        doc = _typed(body)
+        assert doc["type"] == "RequestValidationError"
+        assert "malformed" in doc["msg"]
+        assert isinstance(error_from_wire(doc), RequestValidationError)
+        # stream is closed after the typed refusal; the server lives on
+        status2, _ = _rpc(sock, struct.pack("<IB", _MAGIC, 2))
+        assert status2 == 0
+
+
+def test_c_submit_without_engine_is_a_typed_refusal(tmp_path):
+    """A predictor-only endpoint answers _OP_SUBMIT with a typed frame
+    (no engine attached), not a hang or a raw thread death."""
+    import json as _json
+
+    from paddlepaddle_tpu.inference.c_api_server import (
+        _MAGIC,
+        _pack_tensor,
+        CApiServer,
+    )
+
+    hdr = _json.dumps({"max_new_tokens": 4}).encode()
+    payload = (struct.pack("<IB", _MAGIC, 5)
+               + struct.pack("<I", len(hdr)) + hdr
+               + _pack_tensor("prompt", np.arange(4, dtype=np.int32)))
+    sock = str(tmp_path / "pd.sock")
+    with CApiServer(_NullPredictor(), sock):
+        status, body = _rpc(sock, payload)
+        assert status == 3
+        doc = _typed(body)
+        assert doc["type"] == "RequestValidationError"
+        assert "no serving engine" in doc["msg"]
+
+
+def test_c_oversized_frame_gets_error_frame_before_payload(tmp_path):
+    """A length prefix past _MAX_FRAME is refused with the LEGACY
+    status-1 error frame (the op byte is inside the payload we refuse
+    to buffer, so the peer may be a native client) and closed WITHOUT
+    reading the claimed payload — the memory-bomb guard."""
+    from paddlepaddle_tpu.inference.c_api_server import (
+        _MAX_FRAME,
+        CApiServer,
+    )
+
+    sock = str(tmp_path / "pd.sock")
+    with CApiServer(_NullPredictor(), sock):
+        with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+            s.connect(sock)
+            s.settimeout(10)
+            # claim a 1 GiB frame; send no payload at all
+            s.sendall(struct.pack("<Q", _MAX_FRAME + (1 << 30)))
+            head = _recv_exact(s, 8)
+            assert len(head) == 8
+            (length,) = struct.unpack("<Q", head)
+            frame = _recv_exact(s, length)
+            magic, status = struct.unpack_from("<IB", frame)
+            assert magic == 0x50444331
+            assert status == 1
+            (msg_len,) = struct.unpack_from("<I", frame, 5)
+            msg = frame[9:9 + msg_len].decode()
+            assert "exceeds max" in msg
+            # then close: EOF, not a hang waiting for our "payload"
+            assert s.recv(1) == b""

@@ -1,0 +1,381 @@
+"""True multi-process fleet (inference/remote_replica.py): the
+socket-backed RemoteReplicaClient against a real replica_main process,
+the ReplicaSupervisor's crash-loop handling, and router failover over
+real process death.
+
+Budget discipline: every fast test shares ONE module-scoped replica
+process (tiny preset, warmup off — a spawn is ~2.5 s and we pay it
+once); tests that must kill or crash-loop a process spawn their own.
+The full 2-process rollout + SIGKILL drill is `chaos`-marked and runs
+via tools/run_chaos.sh, not tier-1.
+"""
+
+import json
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddlepaddle_tpu.inference.c_api_server import (
+    _MAGIC,
+    _OP_SUBMIT,
+    _ST_CHUNK,
+    _pack_tensor,
+)
+from paddlepaddle_tpu.inference.remote_replica import (
+    RemoteReplicaClient,
+    ReplicaSupervisor,
+    _parse_reply,
+    _recv_frame,
+    _send_frame,
+)
+from paddlepaddle_tpu.inference.robustness import (
+    CircuitOpenError,
+    DeployError,
+    FleetUnavailableError,
+    KVCapacityError,
+    RequestValidationError,
+    ServerOverloadedError,
+    ServingError,
+    error_from_wire,
+    error_to_wire,
+)
+from paddlepaddle_tpu.observability import reqtrace
+from paddlepaddle_tpu.resilience.retry import RetryPolicy
+
+
+@pytest.fixture(scope="module")
+def replica():
+    """ONE shared replica process for every fast test in this module."""
+    sup = ReplicaSupervisor(preset="tiny", name="t0", warmup="off",
+                            ready_timeout_s=120.0)
+    cli = RemoteReplicaClient(supervisor=sup, name="t0")
+    cli.start()
+    yield cli
+    sup.stop(drain_timeout=2.0)
+
+
+# -- wire-format units (no process) ------------------------------------------
+
+def test_error_wire_roundtrip_preserves_type_and_fields():
+    cases = [
+        ServerOverloadedError("full", queue_depth=7, retry_after_s=0.25),
+        CircuitOpenError("open", retry_after_s=1.5),
+        KVCapacityError("too big", pages_needed=9, pages_capacity=4),
+        FleetUnavailableError("none", replicas=3, healthy=0,
+                              retry_after_s=0.5),
+        DeployError("gate", stage="canary", reasons=["ttft"]),
+        RequestValidationError("bad prompt"),
+    ]
+    for exc in cases:
+        back = error_from_wire(json.loads(json.dumps(error_to_wire(exc))))
+        assert type(back) is type(exc), (exc, back)
+        assert str(exc) in str(back)
+    over = error_from_wire(error_to_wire(cases[0]))
+    assert over.queue_depth == 7 and over.retry_after_s == 0.25
+    kv = error_from_wire(error_to_wire(cases[2]))
+    assert kv.pages_needed == 9 and kv.pages_capacity == 4
+
+
+def test_error_wire_unknown_types_become_retryable_runtime_errors():
+    from paddlepaddle_tpu.inference.router import _retryable
+
+    exc = error_from_wire({"type": "SomethingExotic", "msg": "boom"})
+    assert isinstance(exc, RuntimeError)
+    assert not isinstance(exc, ServingError)
+    assert _retryable(exc)         # untyped remote failure → failover
+    t = error_from_wire({"type": "TimeoutError", "msg": "late"})
+    assert isinstance(t, TimeoutError)
+    # a hostile/garbage doc still yields an exception, never a crash
+    assert isinstance(error_from_wire({}), RuntimeError)
+
+
+# -- live replica: submit parity ---------------------------------------------
+
+def test_remote_submit_roundtrip_with_slo_stamps(replica):
+    fut = replica.submit(np.arange(1, 9, dtype=np.int32),
+                         max_new_tokens=6)
+    out = fut.result(120)
+    assert out.shape == (14,)                 # 8 prompt + 6 new
+    assert np.array_equal(out[:8], np.arange(1, 9))
+    slo = fut.slo()
+    # the same stamp set the in-process engine produces, client-clocked
+    assert slo["new_tokens"] == 6
+    assert slo["ttft_s"] and slo["ttft_s"] > 0
+    assert slo["latency_s"] >= slo["ttft_s"]
+    assert slo["tpot_s"] is not None and slo["tpot_s"] >= 0
+    assert fut._t_admit is not None and fut._t_first is not None
+    assert fut._streaming
+
+
+def test_remote_typed_admission_error_is_synchronous(replica):
+    # over-long prompt: the replica's engine refuses at admission; the
+    # client's submit() must RAISE the same typed error in-process
+    # submit() would — not hand back a future that fails later
+    with pytest.raises(RequestValidationError):
+        replica.submit(np.zeros(4096, dtype=np.int32), max_new_tokens=4)
+    with pytest.raises(RequestValidationError):
+        replica.submit(np.arange(4, dtype=np.int32), max_new_tokens=0)
+    # the replica survives refusals: next request serves
+    fut = replica.submit(np.arange(4, dtype=np.int32), max_new_tokens=2)
+    assert fut.result(60).shape == (6,)
+
+
+def test_journey_stitches_across_the_process_hop(replica):
+    j = reqtrace.Journey("hop-req", 256)
+    fut = replica.submit(np.arange(6, dtype=np.int32), max_new_tokens=4,
+                         trace=j)
+    fut.result(60)
+    names = [s.get("name") for s in j.spans]
+    assert "engine.submit" in names and "admit" in names, names
+    assert "first_token" in names
+    # replica-side spans carry the replica tag and client-rebased times
+    remote = [s for s in j.spans if s.get("replica") == "t0"]
+    assert remote, j.spans
+    for s in remote:
+        assert s["t"] >= 0
+
+
+def test_health_carries_supervisor_block(replica):
+    h = replica.health()
+    assert h.get("ok") is True
+    sup = h["supervisor"]
+    assert isinstance(sup["pid"], int) and sup["pid"] > 0
+    assert sup["state"] == "serving"
+    assert sup["spawns"] >= 1 and sup["crashes"] == 0
+    assert replica.warmup().get("remote") is True
+
+
+def test_client_disconnect_mid_stream_releases_the_slot(replica):
+    baseline = replica.health().get("pages_free")
+    assert baseline is not None
+    # raw-socket half of the protocol: submit a long decode, read ONLY
+    # the accepted frame, then vanish — the server's disconnect probe
+    # must cancel the request and hand its pages back
+    hdr = json.dumps({"max_new_tokens": 64}).encode()
+    payload = (struct.pack("<IB", _MAGIC, _OP_SUBMIT)
+               + struct.pack("<I", len(hdr)) + hdr
+               + _pack_tensor("prompt", np.arange(8, dtype=np.int32)))
+    s = replica._connect()
+    _send_frame(s, payload)
+    status, _c = _parse_reply(_recv_frame(s))
+    assert status == _ST_CHUNK                # accepted
+    s.close()
+    deadline = time.monotonic() + 30
+    free = None
+    while time.monotonic() < deadline:
+        free = replica.health().get("pages_free")
+        if free == baseline:
+            break
+        time.sleep(0.1)
+    assert free == baseline, (free, baseline)
+
+
+def test_cancel_propagates_to_the_replica(replica):
+    baseline = replica.health().get("pages_free")
+    fut = replica.submit(np.arange(8, dtype=np.int32), max_new_tokens=64)
+    assert fut.cancel()
+    with pytest.raises(Exception):
+        fut.result(10)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if replica.health().get("pages_free") == baseline:
+            break
+        time.sleep(0.1)
+    assert replica.health().get("pages_free") == baseline
+
+
+# -- supervisor lifecycle (own processes) ------------------------------------
+
+def test_crash_loop_backoff_and_last_exit_capture(tmp_path):
+    """A bundle that exits at boot (strict --bundle on a path that does
+    not exist) crash-loops: spawn, die with code 3, backoff, respawn,
+    die, give up at max_respawns — with every step counted and the last
+    exit (code + final stderr line) captured for the health block."""
+    sup = ReplicaSupervisor(
+        bundle=str(tmp_path / "no-such-bundle"), preset="tiny",
+        name="crashy", warmup="off", ready_timeout_s=90.0,
+        max_respawns=1,
+        backoff=RetryPolicy(max_attempts=4, base_delay=0.05,
+                            max_delay=0.2, jitter=0.0))
+    try:
+        with pytest.raises(RuntimeError, match="never became ready"):
+            sup.start()
+    finally:
+        sup.stop()
+    assert sup.stats["spawns"] == 2           # original + one respawn
+    assert sup.stats["crashes"] == 2
+    assert sup.stats["crash_loop_backoffs"] >= 1
+    assert sup.last_exit is not None and sup.last_exit["code"] == 3
+    assert "bundle" in str(sup.last_exit.get("reason"))
+    assert sup.info()["pid"] is None
+
+
+def test_sigkill_mid_stream_fails_over_and_restart_revives():
+    """The chaos seam over a REAL process: SIGKILL mid-decode → every
+    in-flight future fails untyped (the router-failover class), the dead
+    replica refuses probes, and restart() respawns a serving process."""
+    from paddlepaddle_tpu.inference.router import _retryable
+
+    sup = ReplicaSupervisor(preset="tiny", name="victim", warmup="off",
+                            ready_timeout_s=120.0)
+    cli = RemoteReplicaClient(supervisor=sup, name="victim")
+    cli.start()
+    try:
+        # prime the decode programs so the killed request is mid-stream,
+        # not mid-compile
+        cli.submit(np.arange(8, dtype=np.int32),
+                   max_new_tokens=2).result(120)
+        fut = cli.submit(np.arange(8, dtype=np.int32), max_new_tokens=64)
+        while fut._t_admit is None and not fut.done():
+            time.sleep(0.01)
+        cli.kill()
+        with pytest.raises(Exception) as ei:
+            fut.result(30)
+        assert _retryable(ei.value), ei.value   # untyped → failover
+        with pytest.raises(ConnectionError):
+            cli.health()
+        cli.restart()
+        assert cli.health()["ok"] is True
+        assert cli.generation == 1
+        out = cli.submit(np.arange(4, dtype=np.int32),
+                         max_new_tokens=2).result(120)
+        assert out.shape == (6,)
+        assert sup.stats["restarts"] == 1
+    finally:
+        sup.stop()
+
+
+# -- the full drill: processes under the router + rollout --------------------
+
+@pytest.mark.chaos
+def test_process_fleet_drill_rollout_step_traffic_sigkill(tmp_path):
+    """PR 13's chaos drill promoted to real OS processes: a 2-process
+    fleet behind the FleetController, a REAL bundle rollout (each
+    process respawns onto ``--bundle`` in strict mode — a fallback to
+    lazy builds exits 3, so zero silent in-process fallbacks by
+    construction), 4× open-loop step traffic throughout, and one replica
+    SIGKILL'd mid-rollout. Invariants: zero lost futures, the fleet
+    serves real processes afterwards."""
+    import paddlepaddle_tpu as paddle
+    from paddlepaddle_tpu.inference.fleet import FleetController, FleetPolicy
+    from paddlepaddle_tpu.inference.remote_replica import (
+        ProcessReplicaFactory,
+    )
+    from paddlepaddle_tpu.inference.replica_main import PRESETS
+    from paddlepaddle_tpu.inference.serving import ServingEngine
+    from paddlepaddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    # the candidate bundle, saved with replica_main's exact engine
+    # geometry (bundle programs are shape-keyed; strict load proves it)
+    paddle.seed(0)
+    model = LlamaForCausalLM(
+        LlamaConfig(dtype="float32", **PRESETS["tiny"]))
+    saver = ServingEngine(model, max_batch_size=2, decode_chunk=4,
+                          kv_page_size=16)
+    saver.warmup()
+    bundle = str(tmp_path / "bundle")
+    saver.save_serving_bundle(bundle)
+    saver.drain(2.0)
+
+    factory = ProcessReplicaFactory(
+        preset="tiny", warmup="off",
+        supervisor_kw={"ready_timeout_s": 180.0})
+    ctl = FleetController(
+        factory, initial_replicas=2,
+        policy=FleetPolicy(min_replicas=2, max_replicas=2),
+        probe_interval_s=0.2, name_prefix="proc")
+    ctl.start(autoscaler=False)
+    router = ctl.router
+    try:
+        futs, stop = [], threading.Event()
+
+        def _load():
+            while not stop.is_set() and len(futs) < 160:
+                for _ in range(4):            # the 4× step
+                    try:
+                        futs.append(router.submit(
+                            np.arange(6, dtype=np.int32),
+                            max_new_tokens=4))
+                    except ServingError:
+                        pass                  # typed shed = accounted
+                time.sleep(0.1)
+
+        t = threading.Thread(target=_load, daemon=True)
+        t.start()
+        time.sleep(0.5)
+
+        # the rollout, concurrent with the step traffic
+        dep = {}
+
+        def _deploy():
+            try:
+                dep["result"] = ctl.deploy(bundle, canary_requests=2,
+                                           canary_new_tokens=2)
+            except Exception as e:  # noqa: BLE001 — asserted below
+                dep["error"] = e
+
+        d = threading.Thread(target=_deploy, daemon=True)
+        d.start()
+        # once the canary is named, SIGKILL the OTHER replica process —
+        # real death in the middle of a live rollout
+        deadline = time.monotonic() + 60
+        while (ctl.rollout.get("state") == "idle"
+               or not ctl.rollout.get("replica")) \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        canary = ctl.rollout.get("replica")
+        victim = next(r.client for r in router._replicas
+                      if r.name != canary)
+        assert victim.supervisor.pid() is not None
+        victim.kill()
+        d.join(300)
+        assert "error" not in dep, dep.get("error")
+        stop.set()
+        t.join(10)
+
+        # anything the rollout did not already revive, the router's
+        # recovery path respawns (a real process restart)
+        for rep in router._replicas:
+            try:
+                rep.client.health()
+            except Exception:
+                router.restart_replica(rep.name)
+
+        resolved = ok = 0
+        for f in futs:
+            try:
+                f.result(120)
+                ok += 1
+            except Exception:
+                pass          # typed shed or untyped infra — accounted
+            resolved += 1
+        assert resolved == len(futs)          # ZERO lost futures
+        assert ok > 0
+
+        # the fleet serves real processes after the drill
+        h = router.health()
+        assert h["router"]["healthy"] == 2, h
+        for rep in h["replicas"].values():
+            assert rep["supervisor"]["pid"] is not None
+        out = router.submit(np.arange(4, dtype=np.int32),
+                            max_new_tokens=2).result(120)
+        assert out.shape == (6,)
+
+        res = dep["result"]
+        if res.get("ok"):
+            # rollout completed: every process serves the candidate
+            # bundle, loaded strictly in a fresh interpreter
+            assert ctl.version == bundle
+            for rep in router._replicas:
+                assert rep.client.supervisor.bundle == bundle
+                assert rep.client.health()["supervisor"]["pid"]
+        else:
+            # the kill cost the candidate its gate: rolled back, still
+            # serving the previous version — an EXPECTED drill outcome,
+            # but it must say so, not hang
+            assert res.get("reasons"), res
+    finally:
+        ctl.stop()

@@ -172,6 +172,52 @@ def _child(args) -> int:
     return 0
 
 
+def _remote_row(args, cache_env) -> dict:
+    """Supervisor-spawn → first token over the wire: what a
+    process-backed fleet pays per restart. Unlike the in-process rows,
+    the timed window starts at SPAWN — interpreter + imports + model
+    build + bundle load + socket round trip are all inside it, because a
+    real restart pays all of them."""
+    from paddlepaddle_tpu.inference.remote_replica import (
+        RemoteReplicaClient,
+        ReplicaSupervisor,
+    )
+
+    sup = ReplicaSupervisor(
+        bundle=os.path.join(args.dir, "bundle"), preset=args.preset,
+        name="bench", env=cache_env,
+        # the save-side engine geometry: bundle programs are shape-keyed,
+        # so the serving engine must match or the strict load exits 3
+        engine_json=json.dumps({"max_batch_size": 4, "decode_chunk": 8,
+                                "kv_page_size": 64}))
+    cli = RemoteReplicaClient(supervisor=sup, name="bench")
+    t1 = time.perf_counter()
+    try:
+        cli.start()
+        t_ready = time.perf_counter()
+        t_sub = time.perf_counter()
+        fut = cli.submit(list(range(1, 25)),
+                         max_new_tokens=args.new_tokens)
+        fut.result(300)
+        t_first = fut._t_first or time.perf_counter()
+        info = dict(sup.ready_info)
+    finally:
+        sup.stop()
+    row = {"mode": "remote",
+           "restart_to_first_token_s": round(t_first - t1, 3),
+           "spawn_to_ready_s": round(t_ready - t1, 3),
+           "bundle": info.get("bundle")}
+    # the window comparable to the in-process rows (their clock starts
+    # AFTER model build): engine bring-up inside the replica + the first
+    # request's TTFT over the wire — what the restart STRATEGY changes,
+    # with the interpreter + import + model-build tax broken out
+    if info.get("t_engine_ready_s") is not None:
+        row["engine_to_first_token_s"] = round(
+            info["t_engine_ready_s"] + (t_first - t_sub), 3)
+        row["model_build_s"] = info.get("t_model_build_s")
+    return row
+
+
 def _run_child(args, mode: str, env_extra=None) -> dict:
     env = dict(os.environ)
     env.update(env_extra or {})
@@ -193,10 +239,14 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--preset", choices=sorted(PRESETS), default="small")
     ap.add_argument("--modes", default="cold,cache,bundle,bundle_cache",
-                    help="comma list of cold/cache/bundle/bundle_cache "
-                    "(default all; bundle_cache = AOT bundle for programs "
-                    "+ compile cache for the ms-scale host-op stragglers — "
-                    "the production restart config)")
+                    help="comma list of cold/cache/bundle/bundle_cache/"
+                    "remote (default all but remote; bundle_cache = AOT "
+                    "bundle for programs + compile cache for the ms-scale "
+                    "host-op stragglers — the production restart config; "
+                    "remote = supervisor-spawned replica process, timed "
+                    "from spawn)")
+    ap.add_argument("--remote", action="store_true",
+                    help="shorthand: add the remote row to --modes")
     ap.add_argument("--dir", default=None,
                     help="work dir for the bundle + compile cache "
                     "(default: a fresh temp dir)")
@@ -214,6 +264,8 @@ def main(argv=None) -> int:
         return _child(args)
 
     modes = [m.strip() for m in args.modes.split(",") if m.strip()]
+    if args.remote and "remote" not in modes:
+        modes.append("remote")
     body = {"preset": args.preset, "dir": args.dir}
     if "cold" in modes:
         sys.stderr.write("[coldstart] cold restart (no artifacts)...\n")
@@ -238,15 +290,27 @@ def main(argv=None) -> int:
         if not cache_primed:
             sys.stderr.write("[coldstart] priming: compile cache...\n")
             _run_child(args, "cache", cache_env)
+            cache_primed = True
         sys.stderr.write("[coldstart] bundle + cache restart...\n")
         row = _run_child(args, "bundle", cache_env)
         row["mode"] = "bundle_cache"
         body["bundle_cache"] = row
+    if "remote" in modes:
+        if "bundle_save" not in body:
+            sys.stderr.write("[coldstart] priming: save AOT bundle...\n")
+            body["bundle_save"] = _run_child(args, "save")
+        if not cache_primed:
+            sys.stderr.write("[coldstart] priming: compile cache...\n")
+            _run_child(args, "cache", cache_env)
+            cache_primed = True
+        sys.stderr.write("[coldstart] remote replica spawn...\n")
+        body["remote"] = _remote_row(args, cache_env)
 
     cold = body.get("cold", {}).get("restart_to_first_token_s")
     for mode, label in (("bundle", "speedup_bundle"),
                         ("cache_warm", "speedup_cache"),
-                        ("bundle_cache", "speedup_bundle_cache")):
+                        ("bundle_cache", "speedup_bundle_cache"),
+                        ("remote", "speedup_remote")):
         cur = body.get(mode, {}).get("restart_to_first_token_s")
         if cold and cur:
             body[label] = round(cold / cur, 2)

@@ -395,16 +395,33 @@ def cmd_fleet(args) -> int:
                       f"{b.get('requests')} burn={b.get('burn')}")
     versions = fl.get("versions") or {}
     reps = block.get("replicas") or {}
+    # process-backed fleets (RemoteReplicaClient + ReplicaSupervisor)
+    # carry a supervisor block per replica: pid, restart/crash counters,
+    # last exit — the columns that answer "which PID died and why"
+    procs = any(isinstance(r.get("supervisor"), dict)
+                for r in reps.values())
     if reps:
-        print(f"  {'replica':<10}{'ok':<5}{'rotation':<10}{'breaker':<11}"
-              f"{'est_wait':>9}  version")
+        hdr = (f"  {'replica':<10}{'ok':<5}{'rotation':<10}{'breaker':<11}"
+               f"{'est_wait':>9}")
+        if procs:
+            hdr += f"  {'pid':>7}{'restarts':>9}  last_exit"
+        print(hdr + "  version")
         for name, r in sorted(reps.items()):
             est = r.get("est_wait_s")
-            print(f"  {name[:10]:<10}{str(bool(r.get('ok'))):<5}"
-                  f"{'in' if r.get('in_rotation') else 'OUT':<10}"
-                  f"{str(r.get('breaker'))[:11]:<11}"
-                  f"{'-' if est is None else format(est, '.3f'):>9}  "
-                  f"{versions.get(name) or '-'}")
+            line = (f"  {name[:10]:<10}{str(bool(r.get('ok'))):<5}"
+                    f"{'in' if r.get('in_rotation') else 'OUT':<10}"
+                    f"{str(r.get('breaker'))[:11]:<11}"
+                    f"{'-' if est is None else format(est, '.3f'):>9}")
+            if procs:
+                sup = r.get("supervisor") or {}
+                last = sup.get("last_exit") or {}
+                why = ("-" if not last else
+                       f"code={last.get('code')}"
+                       + (f" ({str(last.get('reason'))[:28]})"
+                          if last.get("reason") else ""))
+                line += (f"  {str(sup.get('pid') or '-'):>7}"
+                         f"{str(sup.get('restarts', '-')):>9}  {why}")
+            print(line + f"  {versions.get(name) or '-'}")
     return 0
 
 
@@ -662,6 +679,20 @@ def _top_frame(args) -> list:
                          f"replica={ro.get('replica') or '-'}"
                          + (f" reasons={'; '.join(ro['reasons'])}"
                             if ro.get("reasons") else ""))
+        # process-backed replicas: pid + restart/crash census + last exit
+        for name, r in sorted((fleet.get("replicas") or {}).items()):
+            sup = r.get("supervisor")
+            if not isinstance(sup, dict):
+                continue
+            last = sup.get("last_exit") or {}
+            why = ("" if not last else
+                   f"  last_exit=code {last.get('code')}"
+                   + (f" ({str(last.get('reason'))[:32]})"
+                      if last.get("reason") else ""))
+            lines.append(
+                f"  proc {name[:10]:<10} pid={sup.get('pid') or '-'} "
+                f"{sup.get('state')}  restarts={sup.get('restarts')} "
+                f"crashes={sup.get('crashes')}{why}")
 
     # per-replica sparklines from the history plane
     try:

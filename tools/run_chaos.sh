@@ -42,6 +42,15 @@
 #     alert block, exactly ONE flight dump lands carrying the slowest
 #     request journeys, and the alert clears after the storm
 #     (test_tsdb_alerts.py::test_latency_storm_fires_ttft_burn_then_clears)
+#   * process fleet: the elastic-fleet drill over REAL OS processes — a
+#     2-process replica fleet (ReplicaSupervisor + RemoteReplicaClient
+#     over the C-API socket), a real bundle rollout respawning each
+#     process onto --bundle in strict mode, 4x open-loop step traffic
+#     throughout, and one replica SIGKILL'd mid-rollout — zero lost
+#     futures, zero silent in-process bundle fallbacks (a fallback exits
+#     3 before serving), and the fleet serves real processes after
+#     (test_remote_replica.py::
+#     test_process_fleet_drill_rollout_step_traffic_sigkill)
 #   * black box: PADDLE_CHAOS_POINTS=step:kill:@4 under PADDLE_OBS_BLACKBOX
 #     kills a launched worker mid-step; the flight recorder's JSONL dump
 #     must carry the in-flight step event + all-thread stacks, and
