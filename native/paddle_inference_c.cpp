@@ -50,6 +50,22 @@
 //   server validates frames: bad magic, a truncated payload, or a length
 //   prefix over its max-frame bound gets an error reply, then the server
 //   closes the connection (a desynced stream cannot be re-synced safely).
+//   Wire hardening (SUBMIT streams; opt-in, this C client is unaffected):
+//   a submit whose JSON header carries "crc": true negotiates CRC32
+//   framing FOR THAT STREAM — every reply frame's status byte gains flag
+//   0x80 and a u32 crc32 of the remaining payload is spliced directly
+//   after it (reply: u32 magic | u8 status|0x80 | u32 crc | rest; the
+//   low 7 bits are the real status). A header "req_uid" keys idempotent
+//   resubmit: the server caches the last N OK terminal frames by uid and
+//   replays the cached bytes when a uid it already answered submits
+//   again, so a client retrying an ambiguous terminal-frame loss never
+//   triggers a second decode. Streams also carry heartbeat chunk frames
+//   (~every 0.5 s when idle) so clients can run a stall watchdog, and
+//   the server arms SO_SNDTIMEO + a bounded send buffer per connection —
+//   a reader that stops draining is shed after write_timeout_s. Frames a
+//   client STARTS must finish within frame_timeout_s or the server
+//   answers a timeout error frame and closes. Clients that never send
+//   "crc"/"req_uid" (like this one) see the legacy protocol unchanged.
 
 #include <cstdint>
 #include <cstring>

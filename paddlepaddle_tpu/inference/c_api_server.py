@@ -26,15 +26,38 @@ built on (:mod:`~.remote_replica`):
 * ``_OP_DRAIN`` — graceful admission close (JSON ``{timeout, reason}``).
 * ``_OP_RESTART`` — drain + in-place engine restart for native clients;
   the replica supervisor restarts by SIGTERM/respawn instead.
+
+Wire hardening (the netchaos proxy's counterpart — see
+``docs/serving.md`` "Wire-protocol hardening"):
+
+* **frame CRC** — a submit header carrying ``"crc": true`` negotiates
+  CRC32-protected frames for that stream: the status byte gains the
+  ``_ST_CRC_FLAG`` high bit and a ``<u32 crc32(rest)>`` follows it.
+  Legacy clients never set the flag and keep the old frames bit-exact.
+* **idempotent submit** — a header ``req_uid`` keys a bounded ring of
+  recent terminal results; a resubmit whose uid has a cached terminal
+  replays it without decoding again (the ambiguous-failure case: the
+  decode finished but the terminal frame was lost on the wire).
+* **write deadline + bounded send buffer** — ``SO_SNDTIMEO`` +
+  ``SO_SNDBUF`` per connection, so a slow-loris client (reads at
+  1 byte/s, or never) sheds with a cancelled request instead of wedging
+  this handler thread in ``sendall`` forever.
+* **mid-frame read deadline** — once a frame STARTS arriving, the rest
+  must land within ``frame_timeout_s`` (idle waits between requests stay
+  unbounded — persistent native connections are legal). A trickled or
+  abandoned half-frame gets an error frame and a close, bounded-time.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import select
 import socket
 import struct
 import threading
+import zlib
+from collections import OrderedDict
 from time import perf_counter as _now
 from typing import Callable, List, Optional, Sequence, Tuple
 
@@ -53,9 +76,67 @@ _OP_SUBMIT, _OP_DRAIN, _OP_RESTART = 5, 6, 7
 # nonzero status as "error text" keeps working.
 _ST_OK, _ST_ERR, _ST_CHUNK, _ST_TYPED = 0, 1, 2, 3
 
+# status-byte high bit: the frame payload is CRC-protected —
+# <u32 magic><u8 status|0x80><u32 crc32(rest)><rest>. Only set on submit
+# streams whose client ASKED (hdr {"crc": true}), so legacy peers never
+# see it; the low 7 bits still carry the real status.
+_ST_CRC_FLAG = 0x80
+
+# the server heartbeats an idle submit stream this often — exported so
+# RemoteReplicaClient can cross-check its watchdog against it (a client
+# heartbeat_timeout_s at or below this guarantees spurious stalls)
+_HB_INTERVAL_S = 0.5
+
 # a frame length past this is garbage (or an attack), not a request: reply
 # with an error frame and close instead of trying to buffer it
 _MAX_FRAME = 1 << 28  # 256 MiB
+
+
+class _FrameStall(Exception):
+    """A started frame did not finish within ``frame_timeout_s``."""
+
+    def __init__(self, missing: int):
+        super().__init__(f"{missing} bytes missing")
+        self.missing = int(missing)
+
+
+def crc_wrap(frame: bytes) -> bytes:
+    """Arm a reply frame's CRC: flag the status byte, splice the checksum
+    of everything after it. ``frame`` is ``<u32 magic><u8 status><rest>``."""
+    rest = frame[5:]
+    return (frame[:4] + bytes([frame[4] | _ST_CRC_FLAG])
+            + struct.pack("<I", zlib.crc32(rest)) + rest)
+
+
+class _ResultRing:
+    """Bounded req_uid → terminal-frame cache backing idempotent submit.
+    Holds the last ``cap`` OK terminals (raw frames, pre-CRC); a resubmit
+    that hits replays the bytes instead of decoding twice. Error
+    terminals are NOT cached — a retry after a typed failure must re-run."""
+
+    def __init__(self, cap: int = 256):
+        self.cap = int(cap)
+        self._d: "OrderedDict[str, bytes]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.replays = 0
+
+    def put(self, uid: str, frame: bytes) -> None:
+        with self._lock:
+            self._d[uid] = frame
+            self._d.move_to_end(uid)
+            while len(self._d) > self.cap:
+                self._d.popitem(last=False)
+
+    def get(self, uid: str) -> Optional[bytes]:
+        with self._lock:
+            frame = self._d.get(uid)
+            if frame is not None:
+                self._d.move_to_end(uid)
+            return frame
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._d)
 
 
 def _pack_tensor(name: str, arr: np.ndarray) -> bytes:
@@ -118,7 +199,12 @@ class CApiServer:
                  metrics_fn: Optional[Callable[[], str]] = None,
                  engine=None,
                  port: Optional[int] = None,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1",
+                 heartbeat_interval_s: float = _HB_INTERVAL_S,
+                 write_timeout_s: float = 10.0,
+                 frame_timeout_s: float = 30.0,
+                 send_buffer_bytes: Optional[int] = 256 * 1024,
+                 result_cache: int = 256):
         if socket_path is None and port is None:
             raise ValueError("CApiServer needs socket_path= (UDS) or "
                              "port= (loopback TCP)")
@@ -129,6 +215,11 @@ class CApiServer:
         self.engine = engine      # arms _OP_SUBMIT/_OP_DRAIN/_OP_RESTART
         self.health_fn = health_fn
         self.metrics_fn = metrics_fn
+        self.heartbeat_interval_s = float(heartbeat_interval_s)
+        self.write_timeout_s = float(write_timeout_s)
+        self.frame_timeout_s = float(frame_timeout_s)
+        self.send_buffer_bytes = send_buffer_bytes
+        self._results = _ResultRing(result_cache)
         if predictor is None:
             self.input_names = list(input_names or [])
             self.output_names = list(output_names or [])
@@ -268,8 +359,17 @@ class CApiServer:
         error or SLO header + output tensor). The connection is this
         request's: it closes when the frame lands. A half-written stream
         whose client disconnected cancels the request, releasing its
-        decode slot and KV pages — a dead client must not leak pages."""
+        decode slot and KV pages — a dead client must not leak pages.
+
+        Hardening seams (all negotiated by the CLIENT's header, so legacy
+        peers are untouched): ``"crc": true`` arms CRC32 frames for this
+        stream; ``"req_uid"`` keys the idempotent-resubmit ring — a uid
+        whose terminal is cached REPLAYS it, zero re-decode. Writes ride
+        the connection's ``SO_SNDTIMEO``: a client that stops reading
+        (slow-loris) trips it, the request is cancelled and the decode
+        slot released instead of this thread wedging in ``sendall``."""
         from .robustness import RequestValidationError, error_to_wire
+        from .robustness import safe_inc as _safe_inc
 
         eng = self.engine
         try:
@@ -282,11 +382,35 @@ class CApiServer:
                 "malformed _OP_SUBMIT frame: truncated or invalid "
                 "kwargs/prompt payload")))
             return
+        crc = bool(hdr.pop("crc", False))
+        uid = hdr.pop("req_uid", None)
+
+        def send(frame: bytes) -> None:
+            self._send_frame(conn, crc_wrap(frame) if crc else frame)
+
         if eng is None:
-            self._send_frame(conn, self._reply_typed(RequestValidationError(
+            send(self._reply_typed(RequestValidationError(
                 "this server has no serving engine attached "
                 "(predictor-only endpoint)")))
             return
+        if uid:
+            cached = self._results.get(str(uid))
+            if cached is not None:
+                # idempotent resubmit: this uid already decoded to a
+                # terminal once — its frame was (presumably) lost on the
+                # wire. Replay the cached bytes: token-exact by
+                # construction, zero engine work, never a double decode
+                self._results.replays += 1
+                _safe_inc("paddle_capi_dedup_replays_total",
+                          "resubmits served from the terminal-result ring "
+                          "instead of decoding again")
+                try:
+                    send(self._reply_json(_ST_CHUNK, {"ev": "accepted"}))
+                    send(self._reply_json(_ST_CHUNK, {"ev": "replay"}))
+                    send(cached)
+                except OSError:
+                    pass
+                return
         journey = None
         tr = hdr.pop("trace", None)
         if isinstance(tr, dict):
@@ -311,14 +435,13 @@ class CApiServer:
         try:
             fut = eng.submit(prompt, **kw)
         except Exception as e:       # typed admission refusal, validation
-            self._send_frame(conn, self._reply_typed(e))
+            send(self._reply_typed(e))
             return
         try:
             # the client's submit() blocks on this first frame: accepted
             # here mirrors the in-process contract where a returning
             # submit() call IS the admission decision
-            self._send_frame(conn, self._reply_json(_ST_CHUNK,
-                                                    {"ev": "accepted"}))
+            send(self._reply_json(_ST_CHUNK, {"ev": "accepted"}))
             sent_admit = sent_first = False
             last_n = 0
             last_tx = _now()
@@ -345,13 +468,14 @@ class CApiServer:
                 if sent_first and fut._n_new > last_n:
                     last_n = fut._n_new
                     events.append({"ev": "progress", "n": last_n})
-                if not events and _now() - last_tx > 0.5:
+                if (not events
+                        and _now() - last_tx > self.heartbeat_interval_s):
                     # heartbeat: a long decode with nothing to report
                     # must not read as a dead replica to the client's
-                    # read timeout
+                    # stall watchdog
                     events.append({"ev": "hb"})
                 for ev in events:
-                    self._send_frame(conn, self._reply_json(_ST_CHUNK, ev))
+                    send(self._reply_json(_ST_CHUNK, ev))
                 if events:
                     last_tx = _now()
             err = fut._error
@@ -359,7 +483,7 @@ class CApiServer:
                 doc = error_to_wire(err)
                 if journey is not None:
                     doc["journey"] = self._journey_wire(journey)
-                self._send_frame(conn, self._reply_json(_ST_TYPED, doc))
+                send(self._reply_json(_ST_TYPED, doc))
                 return
             out = np.ascontiguousarray(np.asarray(fut._output))
             head = {
@@ -377,8 +501,28 @@ class CApiServer:
             }
             if journey is not None:
                 head["journey"] = self._journey_wire(journey)
-            self._send_frame(conn, self._reply_json(
-                _ST_OK, head, _pack_tensor("output_ids", out)))
+            terminal = self._reply_json(
+                _ST_OK, head, _pack_tensor("output_ids", out))
+            if uid:
+                # cache BEFORE the send: the case dedup exists for is the
+                # terminal frame dying on the wire after decode finished
+                self._results.put(str(uid), terminal)
+            send(terminal)
+        except (socket.timeout, BlockingIOError):
+            # the per-connection write deadline (SO_SNDTIMEO) tripped:
+            # the client reads too slowly to drain our bounded send
+            # buffer (slow-loris) — shed it and release the decode slot
+            # instead of wedging this handler thread in sendall
+            _safe_inc("paddle_capi_write_timeouts_total",
+                      "submit streams shed because the client stopped "
+                      "draining its socket before the write deadline")
+            try:
+                from ..observability import flight
+                flight.record("capi", "write_timeout",
+                              timeout_s=self.write_timeout_s)
+            except Exception:
+                pass
+            fut.cancel()
         except OSError:
             # client went away mid-stream (BrokenPipe/reset): release the
             # slot — kv.pages_free must come back to its idle value
@@ -393,33 +537,94 @@ class CApiServer:
                 "spans": list(j.spans), "dropped": j.dropped}
 
     # -- transport ----------------------------------------------------------
+    def _recv_within(self, conn: socket.socket, n: int,
+                     deadline: float) -> Optional[bytes]:
+        """Read exactly ``n`` bytes before ``deadline`` (monotonic).
+        Returns None on EOF, raises :class:`_FrameStall` on deadline.
+        select-based so it composes with the connection's blocking
+        mode — ``settimeout`` would also put ``recv(1, MSG_DONTWAIT)``
+        disconnect probes to sleep, breaking the 5 ms submit poll loop."""
+        buf = b""
+        while len(buf) < n:
+            left = deadline - _now()
+            if left <= 0:
+                raise _FrameStall(n - len(buf))
+            r, _, _ = select.select([conn], [], [], min(left, 1.0))
+            if not r:
+                continue
+            chunk = conn.recv(min(1 << 20, n - len(buf)))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
     def _serve_conn(self, conn: socket.socket):
+        from .robustness import safe_inc as _safe_inc
+
         try:
+            # bounded send buffer + kernel write deadline: a peer that
+            # stops reading makes sendall raise (socket.timeout /
+            # BlockingIOError) after write_timeout_s instead of wedging
+            # this thread for the life of the connection
+            try:
+                if self.send_buffer_bytes:
+                    conn.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF,
+                                    int(self.send_buffer_bytes))
+                if self.write_timeout_s:
+                    sec = int(self.write_timeout_s)
+                    usec = int((self.write_timeout_s - sec) * 1e6)
+                    conn.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                                    struct.pack("ll", sec, usec))
+            except OSError:
+                pass   # non-fatal: platform without the sockopt
             with conn:
                 while not self._stop.is_set():
-                    head = b""
-                    while len(head) < 8:
-                        chunk = conn.recv(8 - len(head))
-                        if not chunk:
-                            return
-                        head += chunk
-                    (length,) = struct.unpack("<Q", head)
-                    if length > _MAX_FRAME:
-                        # status 1 (not typed): the op byte lives inside
-                        # the payload we refuse to buffer, so the peer may
-                        # be a legacy native client — keep the legacy
-                        # error-frame contract here
-                        reply = self._reply_err(
-                            f"frame length {length} exceeds max "
-                            f"{_MAX_FRAME} bytes")
-                        conn.sendall(struct.pack("<Q", len(reply)) + reply)
+                    # the wait for a frame's FIRST byte is unbounded — a
+                    # persistent legacy connection may idle between ops.
+                    # Once a frame starts, the rest must land within
+                    # frame_timeout_s or the peer is stalling us mid-frame
+                    first = conn.recv(1)
+                    if not first:
                         return
-                    buf = b""
-                    while len(buf) < length:
-                        chunk = conn.recv(min(1 << 20, length - len(buf)))
-                        if not chunk:
+                    deadline = _now() + self.frame_timeout_s
+                    try:
+                        rest = self._recv_within(conn, 7, deadline)
+                        if rest is None:
                             return
-                        buf += chunk
+                        (length,) = struct.unpack("<Q", first + rest)
+                        if length > _MAX_FRAME:
+                            # status 1 (not typed): the op byte lives
+                            # inside the payload we refuse to buffer, so
+                            # the peer may be a legacy native client —
+                            # keep the legacy error-frame contract here
+                            reply = self._reply_err(
+                                f"frame length {length} exceeds max "
+                                f"{_MAX_FRAME} bytes")
+                            conn.sendall(
+                                struct.pack("<Q", len(reply)) + reply)
+                            return
+                        buf = self._recv_within(conn, length, deadline)
+                        if buf is None:
+                            return
+                    except _FrameStall as st:
+                        # a frame started but never finished: the peer is
+                        # stalling us mid-frame (trunc chaos, wedged
+                        # client). Typed close in bounded time — never a
+                        # handler thread parked on recv forever
+                        _safe_inc(
+                            "paddle_capi_frame_timeouts_total",
+                            "connections closed because a started frame "
+                            "did not complete within frame_timeout_s")
+                        try:
+                            reply = self._reply_err(
+                                f"frame read timed out mid-frame: "
+                                f"{st.missing} bytes still missing after "
+                                f"{self.frame_timeout_s:.0f}s")
+                            conn.sendall(
+                                struct.pack("<Q", len(reply)) + reply)
+                        except OSError:
+                            pass
+                        return
                     if (len(buf) >= 5
                             and struct.unpack_from("<IB", buf)
                             == (_MAGIC, _OP_SUBMIT)):

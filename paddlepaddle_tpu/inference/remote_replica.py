@@ -43,6 +43,8 @@ import sys
 import tempfile
 import threading
 import time
+import uuid
+import zlib
 from collections import deque
 from typing import Callable, Dict, Optional, Sequence, Tuple
 
@@ -50,19 +52,22 @@ import numpy as np
 
 from ..resilience.retry import RetryPolicy, call_with_retry, compute_delay
 from .c_api_server import (
+    _HB_INTERVAL_S,
     _MAGIC,
     _OP_DRAIN,
     _OP_HEALTH,
     _OP_RESTART,
     _OP_SUBMIT,
     _ST_CHUNK,
+    _ST_CRC_FLAG,
     _ST_OK,
     _ST_TYPED,
     _Cursor,
     _pack_tensor,
     _unpack_tensor,
 )
-from .robustness import error_from_wire
+from .robustness import ReplicaStalledError, WireCorruptionError, \
+    error_from_wire
 from .robustness import safe_inc as _safe_inc
 from .router import ReplicaClient
 from .serving import _REQ_IDS, GenerationResult
@@ -100,7 +105,19 @@ def _parse_reply(frame: bytes) -> Tuple[int, _Cursor]:
     c = _Cursor(frame)
     if c.take("I") != _MAGIC:
         raise ConnectionError("bad reply magic from replica")
-    return c.take("B"), c
+    status = c.take("B")
+    if status & _ST_CRC_FLAG:
+        # CRC-armed frame (this stream asked for it): verify before ANY
+        # byte of the payload is interpreted — corruption must surface as
+        # a typed infra failure, never as wrong tokens
+        want = c.take("I")
+        rest = c.b[c.o:]
+        if zlib.crc32(rest) != want:
+            raise WireCorruptionError(
+                f"frame payload failed CRC32 ({len(rest)} bytes, "
+                f"status {status & 0x7F})")
+        status &= 0x7F
+    return status, c
 
 
 def _json_body(c: _Cursor) -> dict:
@@ -147,12 +164,35 @@ class RemoteReplicaClient(ReplicaClient):
     a dead process reads exactly like :meth:`ReplicaClient.kill` did
     in-process. Typed serving errors cross the wire as JSON and
     rehydrate into the same classes (same retryability, same
-    ``retry_after_s`` hints)."""
+    ``retry_after_s`` hints).
+
+    Wire hardening (all client-negotiated, legacy servers unaffected):
+
+    * **stall watchdog** — the submit stream expects SOME frame (chunk,
+      heartbeat, terminal) within ``heartbeat_timeout_s``; silence means
+      the wire black-holed, and the typed retryable
+      :class:`~.robustness.ReplicaStalledError` fails the request over
+      in ~2 s instead of pinning it for ``read_timeout_s``.
+    * **frame CRC** — ``crc=True`` (default) asks the server to CRC32
+      its reply payloads; a mismatch raises the typed retryable
+      :class:`~.robustness.WireCorruptionError` and abandons the
+      connection.
+    * **idempotent submit** — every submit carries a ``req_uid``; a
+      resubmit of the same uid after an ambiguous failure replays the
+      server's cached terminal instead of decoding twice.
+
+    Set ``PADDLE_NETCHAOS`` and every connection routes through a
+    :class:`~..resilience.netchaos.NetChaosProxy` injecting the spec'd
+    faults — the deterministic chaos drill for all three paths."""
+
+    supports_req_uid = True
 
     def __init__(self, address=None, name: str = "replica",
                  supervisor: Optional["ReplicaSupervisor"] = None,
                  connect_timeout_s: float = 5.0,
                  read_timeout_s: float = 30.0,
+                 heartbeat_timeout_s: float = 2.0,
+                 crc: bool = True,
                  connect_policy: Optional[RetryPolicy] = None):
         if address is None and supervisor is None:
             raise ValueError("RemoteReplicaClient needs address= or "
@@ -162,6 +202,26 @@ class RemoteReplicaClient(ReplicaClient):
         self._address = address
         self.connect_timeout_s = float(connect_timeout_s)
         self.read_timeout_s = float(read_timeout_s)
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.crc = bool(crc)
+        self._nc_proxy = None       # None = not checked, False = disabled
+        if min(self.heartbeat_timeout_s, self.read_timeout_s) \
+                <= _HB_INTERVAL_S:
+            # config cross-check: a watchdog at or below the server's
+            # heartbeat interval reads EVERY long decode as a stall —
+            # guaranteed spurious failovers and breaker evictions. Warn
+            # loudly; do not silently "fix" the caller's number
+            _safe_inc("paddle_replica_timeout_misconfig_total",
+                      "clients built with stall/read timeouts at or "
+                      "below the server heartbeat interval",
+                      replica=name)
+            sys.stderr.write(
+                f"[remote-replica] {name}: heartbeat_timeout_s="
+                f"{self.heartbeat_timeout_s:g}s / read_timeout_s="
+                f"{self.read_timeout_s:g}s is at or below the server "
+                f"heartbeat interval ({_HB_INTERVAL_S:g}s) — every "
+                f"quiet-but-healthy decode will trip the stall watchdog "
+                f"and cause spurious failovers\n")
         # bounded reconnect with jittered backoff for SUBMIT connects: a
         # replica mid-respawn (supervisor restart window) is a transient,
         # not a failover — health probes stay single-attempt so the
@@ -177,8 +237,28 @@ class RemoteReplicaClient(ReplicaClient):
             return self.supervisor.address()
         return self._address
 
+    def _netchaos(self):
+        """PADDLE_NETCHAOS auto-wrap: lazily start ONE proxy per client
+        targeting :meth:`address` (re-resolved per connection, so a
+        supervisor respawn is chased through the proxy too). Disabled =
+        one getenv on the first connect, then a cached False."""
+        if self._nc_proxy is False:
+            return None
+        if self._nc_proxy is None:
+            from ..resilience import netchaos as _nc
+
+            spec = _nc.env_spec()
+            if not spec:
+                self._nc_proxy = False
+                return None
+            self._nc_proxy = _nc.NetChaosProxy(
+                self.address, specs=spec,
+                name=f"netchaos:{self.name}").start()
+        return self._nc_proxy
+
     def _connect_once(self) -> socket.socket:
-        addr = self.address()
+        proxy = self._netchaos()
+        addr = proxy.address() if proxy is not None else self.address()
         if addr is None:
             raise ConnectionError(
                 f"replica {self.name} has no address (process not ready)")
@@ -224,6 +304,7 @@ class RemoteReplicaClient(ReplicaClient):
                temperature: float = 0.0, top_k: int = 0,
                eos_token_id=None, deadline_s: Optional[float] = None,
                prefix_len: Optional[int] = None,
+               req_uid: Optional[str] = None,
                trace=None) -> GenerationResult:
         if self._killed:
             raise ConnectionError(f"replica {self.name} is dead")
@@ -231,10 +312,17 @@ class RemoteReplicaClient(ReplicaClient):
         fut._req_id = next(_REQ_IDS)
         fut._trace = trace            # carried, never closed: the caller
         #   (router wrapper or direct user) owns the journey
+        # mint a uid when the caller (router hedging passes its own)
+        # didn't: an ambiguous terminal-frame loss must be resubmittable
+        # without a second decode
+        fut._req_uid = req_uid or uuid.uuid4().hex
         hdr = {"max_new_tokens": int(max_new_tokens),
                "temperature": float(temperature), "top_k": int(top_k),
                "eos_token_id": eos_token_id, "deadline_s": deadline_s,
-               "prefix_len": prefix_len}
+               "prefix_len": prefix_len,
+               "req_uid": fut._req_uid}
+        if self.crc:
+            hdr["crc"] = True
         if trace is not None:
             hdr["trace"] = {"trace_id": getattr(trace, "trace_id", None),
                             "req_id": getattr(trace, "req_id", None)}
@@ -247,7 +335,16 @@ class RemoteReplicaClient(ReplicaClient):
         s = self._connect()
         try:
             _send_frame(s, payload)
+            # the stream-progress watchdog starts NOW: the server's
+            # accepted frame (and after it, at least a heartbeat every
+            # _HB_INTERVAL_S) must land within heartbeat_timeout_s, or
+            # the wire black-holed — fail over in ~2 s, not
+            # read_timeout_s
+            s.settimeout(self.heartbeat_timeout_s)
             status, c = _parse_reply(_recv_frame(s))
+        except socket.timeout:
+            s.close()
+            raise self._stall_error()
         except Exception:
             s.close()
             raise
@@ -272,6 +369,22 @@ class RemoteReplicaClient(ReplicaClient):
         fut._add_done_callback(
             lambda f, _s=s: (_close_quietly(_s) if f.cancelled() else None))
         return fut
+
+    def _stall_error(self) -> ReplicaStalledError:
+        _safe_inc("paddle_replica_stalls_total",
+                  "stream-progress watchdog trips (no frame within "
+                  "heartbeat_timeout_s)", replica=self.name)
+        try:
+            from ..observability import flight
+
+            flight.record("stall", self.name,
+                          timeout_s=self.heartbeat_timeout_s)
+        except Exception:
+            pass
+        return ReplicaStalledError(
+            f"replica {self.name}: no stream frame (chunk or heartbeat) "
+            f"within {self.heartbeat_timeout_s:g}s — wire black-holed or "
+            f"replica wedged", stalled_after_s=self.heartbeat_timeout_s)
 
     def _read_stream(self, s: socket.socket, fut: GenerationResult,
                      trace) -> None:
@@ -321,9 +434,15 @@ class RemoteReplicaClient(ReplicaClient):
                     f"status {status}"))
                 return
         except socket.timeout:
-            fut._set(error=TimeoutError(
-                f"replica {self.name}: no stream frame within "
-                f"{self.read_timeout_s}s"))
+            # the watchdog tripped mid-stream: close the socket (the
+            # server's disconnect probe then cancels the request and
+            # releases its decode slot) and surface the typed stall
+            fut._set(error=self._stall_error())
+        except WireCorruptionError as e:
+            _safe_inc("paddle_wire_corruption_total",
+                      "reply frames abandoned on CRC32 mismatch",
+                      replica=self.name)
+            fut._set(error=e)
         except Exception as e:
             # SIGKILL mid-stream lands here: EOF/reset → an UNTYPED
             # connection error, which the router fails over — the exact
@@ -372,13 +491,21 @@ class RemoteReplicaClient(ReplicaClient):
         return doc
 
     def stop(self) -> None:
-        if self.supervisor is not None:
-            self.supervisor.stop()
-            return
+        # drain FIRST, tear the chaos proxy down LAST: the drain RPC goes
+        # through _connect, which would lazily re-arm a fresh proxy from
+        # the env after a premature stop (and leak its accept thread)
         try:
-            self.drain(0.0, reason="stop")
-        except Exception:
-            pass
+            if self.supervisor is not None:
+                self.supervisor.stop()
+            else:
+                try:
+                    self.drain(0.0, reason="stop")
+                except Exception:
+                    pass
+        finally:
+            if self._nc_proxy:
+                self._nc_proxy.stop()
+                self._nc_proxy = None
 
     def restart(self, drain_timeout: Optional[float] = None,
                 factory: Optional[Callable] = None) -> None:
@@ -442,6 +569,7 @@ class ReplicaSupervisor:
                  preset: str = "tiny",
                  model_json: Optional[str] = None,
                  engine_json: Optional[str] = None,
+                 server_json: Optional[str] = None,
                  warmup: str = "auto",
                  metrics_port: Optional[int] = None,
                  allow_bundle_fallback: bool = False,
@@ -466,6 +594,7 @@ class ReplicaSupervisor:
         self.preset = preset
         self.model_json = model_json
         self.engine_json = engine_json
+        self.server_json = server_json
         self.warmup = warmup
         self.metrics_port = metrics_port
         self.allow_bundle_fallback = bool(allow_bundle_fallback)
@@ -527,6 +656,8 @@ class ReplicaSupervisor:
             cmd += ["--model-json", self.model_json]
         if self.engine_json:
             cmd += ["--engine-json", self.engine_json]
+        if self.server_json:
+            cmd += ["--server-json", self.server_json]
         if self.metrics_port is not None:
             cmd += ["--metrics-port", str(self.metrics_port)]
         return cmd + self.extra_args

@@ -87,6 +87,11 @@ def main(argv=None) -> int:
                     "exporter on this port (0 = ephemeral)")
     ap.add_argument("--drain-timeout", type=float, default=None,
                     help="SIGTERM drain bound (seconds)")
+    ap.add_argument("--server-json", default=None,
+                    help="JSON dict of CApiServer kwargs "
+                    "(heartbeat_interval_s, write_timeout_s, "
+                    "frame_timeout_s, send_buffer_bytes, result_cache "
+                    "— the wire-hardening knobs)")
     args = ap.parse_args(argv)
     if (args.socket is None) == (args.port is None):
         ap.error("exactly one of --socket / --port is required")
@@ -124,8 +129,9 @@ def main(argv=None) -> int:
         exp = exporter.start(port=args.metrics_port)
         exporter_port = getattr(exp, "port", args.metrics_port)
 
+    srv_kw = json.loads(args.server_json) if args.server_json else {}
     srv = CApiServer(None, socket_path=args.socket, port=args.port,
-                     engine=eng, health_fn=eng.health)
+                     engine=eng, health_fn=eng.health, **srv_kw)
     srv.start()
     ready = {"pid": os.getpid(), "socket": args.socket, "port": srv.port,
              "metrics_port": exporter_port,

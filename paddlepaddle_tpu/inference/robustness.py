@@ -36,7 +36,8 @@ __all__ = [
     "ServingError", "ServerOverloadedError", "DeadlineExceededError",
     "RequestCancelledError", "CircuitOpenError", "EngineDrainingError",
     "RequestValidationError", "KVCapacityError", "FleetUnavailableError",
-    "DeployError", "CircuitBreaker", "QueueWaitEstimator", "safe_inc",
+    "DeployError", "ReplicaStalledError", "WireCorruptionError",
+    "CircuitBreaker", "QueueWaitEstimator", "safe_inc",
     "safe_set", "error_to_wire", "error_from_wire",
 ]
 
@@ -138,6 +139,28 @@ class FleetUnavailableError(ServingError):
         self.retry_after_s = float(retry_after_s)
 
 
+class ReplicaStalledError(ServingError):
+    """The stream-progress watchdog tripped: a replica connection accepted
+    the request (or was mid-stream) but produced NO frame — chunk, progress
+    or heartbeat — within ``heartbeat_timeout_s``. A black-holed or
+    partitioned connection, not a slow decode: the server heartbeats every
+    ``heartbeat_interval_s`` even when there is nothing to report, so
+    silence means the wire (or the peer) is gone. Retryable — another
+    replica can serve the request, and the stalled connection is closed so
+    the server's disconnect probe releases the decode slot."""
+
+    def __init__(self, msg: str, stalled_after_s: float = 0.0):
+        super().__init__(msg)
+        self.stalled_after_s = float(stalled_after_s)
+
+
+class WireCorruptionError(ServingError):
+    """A frame failed its CRC32 payload check: bytes were damaged in
+    transit. The connection is abandoned (a desynced stream cannot be
+    trusted for one more frame) and the request is retryable — corruption
+    must surface as a typed infra failure, NEVER as wrong tokens."""
+
+
 class DeployError(ServingError):
     """A :meth:`~.fleet.FleetController.deploy` could not START: the
     candidate bundle failed pre-flight validation (missing/garbled
@@ -176,6 +199,7 @@ _WIRE_FIELDS = {
     "KVCapacityError": ("pages_needed", "pages_capacity"),
     "FleetUnavailableError": ("replicas", "healthy", "retry_after_s"),
     "DeployError": ("stage", "reasons"),
+    "ReplicaStalledError": ("stalled_after_s",),
 }
 
 

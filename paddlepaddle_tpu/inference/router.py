@@ -77,7 +77,8 @@ import itertools
 import sys
 import threading
 import time
-from typing import Callable, Dict, List, Optional, Sequence
+import uuid
+from typing import Callable, Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
@@ -88,9 +89,11 @@ from .robustness import (
     DeadlineExceededError,
     EngineDrainingError,
     FleetUnavailableError,
+    ReplicaStalledError,
     RequestCancelledError,
     ServerOverloadedError,
     ServingError,
+    WireCorruptionError,
 )
 from .robustness import safe_inc as _safe_inc
 from .robustness import safe_set as _safe_set
@@ -103,9 +106,13 @@ def _retryable(exc: BaseException) -> bool:
     open breaker, draining replica, or anything that is NOT a typed
     serving error (decode blew up, chaos, dead replica) — yes. Failures
     that travel with the request (validation, expired deadline, client
-    cancel) or with the whole fleet (FleetUnavailableError) — no."""
+    cancel) or with the whole fleet (FleetUnavailableError) — no. The
+    wire-hardening errors are typed ServingErrors but travel with the
+    CONNECTION, not the request — a stalled or corrupted stream says
+    nothing about whether another replica can serve it."""
     if isinstance(exc, (CircuitOpenError, EngineDrainingError,
-                        ServerOverloadedError)):
+                        ServerOverloadedError, ReplicaStalledError,
+                        WireCorruptionError)):
         return True
     return not isinstance(exc, ServingError)
 
@@ -122,6 +129,12 @@ class ReplicaClient:
     fail untyped (the router's failover path), and the replica refuses
     everything — including health probes — until :meth:`restart`.
     """
+
+    # a client advertising req_uid support accepts submit(req_uid=...)
+    # and guarantees a resubmitted uid is never decoded twice — the
+    # precondition for the router's hedged requests (cancelling the
+    # loser is safe) and ambiguous-failure resubmission
+    supports_req_uid = False
 
     def __init__(self, factory: Callable[[], ServingEngine],
                  name: str = "replica"):
@@ -237,7 +250,8 @@ class _Pending:
 
     __slots__ = ("prompt_ids", "kw", "future", "deadline", "prefix_key",
                  "attempts", "tried", "last_error", "inner", "trace",
-                 "t_attempt")
+                 "t_attempt", "req_uid", "cur_rep", "hedge_inner",
+                 "hedge_armed", "delivered", "in_submit")
 
     def __init__(self, prompt_ids, kw, future, deadline, prefix_key):
         self.prompt_ids = prompt_ids
@@ -252,6 +266,19 @@ class _Pending:
         self.trace = None                     # reqtrace Journey, or None
         self.t_attempt: Optional[float] = None  # current attempt's dispatch
         #                                         stamp (perf_counter)
+        self.req_uid = uuid.uuid4().hex       # idempotency key: the SAME
+        #   uid rides every attempt and the hedge, so cancelling a loser
+        #   (or resubmitting after an ambiguous loss) never decodes twice
+        self.cur_rep: Optional[str] = None    # current attempt's replica
+        self.hedge_inner: Optional[GenerationResult] = None
+        self.hedge_armed = False              # hedge timer scheduled
+        self.delivered = False                # terminal delivered (under
+        #   the router's stats lock: primary and hedge race to deliver)
+        self.in_submit: Optional[str] = None  # replica a dispatcher is
+        #   currently BLOCKED submitting to — a gray accept (delayed or
+        #   black-holed accepted frame) wedges the dispatch thread there
+        #   for up to heartbeat_timeout_s, and the hedge must cover that
+        #   window too, not just the post-accept stream
 
 
 class ServingRouter:
@@ -271,7 +298,9 @@ class ServingRouter:
                  breaker_reset_s: float = 1.0,
                  retry_policy: Optional[RetryPolicy] = None,
                  affinity_max_wait_s: float = 1.0,
-                 drain_timeout_s: Optional[float] = None):
+                 drain_timeout_s: Optional[float] = None,
+                 hedge_after_s: Union[float, str, None] = "auto",
+                 hedge_budget_pct: float = 10.0):
         if not replicas:
             raise ValueError("ServingRouter needs at least one replica")
         self.breaker_threshold = int(breaker_threshold)
@@ -292,12 +321,26 @@ class ServingRouter:
             max_attempts=3, base_delay=0.05, max_delay=1.0)
         self.affinity_max_wait_s = float(affinity_max_wait_s)
         self.drain_timeout_s = drain_timeout_s
+        # hedged requests (Dean & Barroso, "The Tail at Scale"): a request
+        # with no first token after hedge_after_s gets ONE duplicate on a
+        # different healthy replica; first terminal wins, the loser is
+        # cancelled (safe: req_uid dedup means a cancelled twin never
+        # cost a second decode). "auto" derives the delay from observed
+        # TTFT (p99, floor 2x p50) via the tsdb history plane — with no
+        # history armed, auto hedging stays off. hedge_budget_pct caps
+        # hedges at a fraction of submits so hedging cannot melt an
+        # already-overloaded fleet
+        self.hedge_after_s = hedge_after_s
+        self.hedge_budget_pct = float(hedge_budget_pct)
+        self._hedge_cache: Optional[float] = None
+        self._hedge_cache_t = 0.0
         self._stats_lock = threading.Lock()
         self.stats = {"submitted": 0, "completed": 0, "failed": 0,
                       "picks": 0, "retries": 0, "failovers": 0,
                       "evictions": 0, "readmissions": 0,
                       "rolling_restarts": 0, "replicas_added": 0,
-                      "replicas_removed": 0}
+                      "replicas_removed": 0,
+                      "hedges": 0, "hedge_wins": 0}
         self._stop = threading.Event()
         self._draining = threading.Event()
         self._prober: Optional[threading.Thread] = None
@@ -413,24 +456,30 @@ class ServingRouter:
                 due = []
                 now = time.monotonic()
                 while self._retry_heap and self._retry_heap[0][0] <= now:
-                    due.append(heapq.heappop(self._retry_heap)[2])
-            for pend in due:
-                self._dispatch(pend)
+                    due.append(heapq.heappop(self._retry_heap)[2:])
+            for kind, pend in due:
+                if kind == "hedge":
+                    self._maybe_hedge(pend)
+                else:
+                    self._dispatch(pend)
 
-    def _schedule(self, pend: _Pending, delay: float) -> None:
+    def _schedule(self, pend: _Pending, delay: float,
+                  kind: str = "retry") -> None:
         with self._retry_cv:
             # drain()/stop() set their flag BEFORE sweeping the heap under
             # this same lock — so a push either lands before the sweep
             # (and is swept) or observes the flag here. No entry can
             # strand behind an exiting retrier thread: zero lost futures
             if self._draining.is_set() or self._stop.is_set():
-                self._finish_fail(pend, EngineDrainingError(
-                    "request shed: serving router drained before it was "
-                    "served"))
-                return
+                if kind == "retry":
+                    self._finish_fail(pend, EngineDrainingError(
+                        "request shed: serving router drained before it "
+                        "was served"))
+                return     # a dropped hedge timer loses nothing: the
+                #            primary attempt still owns the future
             heapq.heappush(self._retry_heap,
                            (time.monotonic() + delay,
-                            next(self._retry_seq), pend))
+                            next(self._retry_seq), kind, pend))
             self._retry_cv.notify()
 
     # -- pick policy ---------------------------------------------------------
@@ -479,7 +528,33 @@ class ServingRouter:
         return min(cands, key=self._load_score)
 
     # -- dispatch / failover -------------------------------------------------
+    def _claim_delivery(self, pend: _Pending) -> bool:
+        """Exactly-once delivery gate: with a hedge in flight, the primary
+        and the duplicate race to resolve the future — the loser of this
+        claim must neither double-count stats nor overwrite SLO stamps."""
+        with self._stats_lock:
+            if pend.delivered:
+                return False
+            pend.delivered = True
+            return True
+
+    @staticmethod
+    def _cancel_losers(pend: _Pending, winner) -> None:
+        # safe by construction: both attempts carried the same req_uid,
+        # so a cancelled twin whose decode already finished left a cached
+        # terminal, not a second decode
+        for other in (pend.inner, pend.hedge_inner):
+            if other is not None and other is not winner \
+                    and not other.done():
+                try:
+                    other.cancel()
+                except Exception:
+                    pass
+
     def _finish_ok(self, pend: _Pending, inner: GenerationResult) -> None:
+        if not self._claim_delivery(pend):
+            return
+        self._cancel_losers(pend, inner)
         fut = pend.future
         # carry the replica future's SLO stamps so fleet-level slo_summary
         # reports real TTFT/latency (measured from ROUTER submit time).
@@ -498,6 +573,9 @@ class ServingRouter:
 
     def _finish_fail(self, pend: _Pending, err: BaseException,
                      sync: bool = False) -> None:
+        if not self._claim_delivery(pend):
+            return
+        self._cancel_losers(pend, None)
         self._bump("failed")
         if sync:
             # the raise IS the delivery: the future is never set, so the
@@ -612,10 +690,23 @@ class ServingRouter:
                 kw.pop("trace", None)
             if pend.deadline is not None:
                 kw["deadline_s"] = max(pend.deadline - now, 1e-3)
+            if getattr(rep.client, "supports_req_uid", False):
+                kw["req_uid"] = pend.req_uid
             pend.t_attempt = time.perf_counter()
+            # arm the hedge timer BEFORE the blocking submit: the accept
+            # round trip itself can gray-fail (delayed or black-holed
+            # accepted frame), wedging this thread until the stall
+            # watchdog fires — exactly the tail a hedge exists to cut
+            if not pend.hedge_armed and len(self._replicas) > 1:
+                delay = self._hedge_delay()
+                if delay is not None:
+                    pend.hedge_armed = True
+                    self._schedule(pend, delay, kind="hedge")
+            pend.in_submit = rep.name
             try:
                 inner = rep.client.submit(pend.prompt_ids, **kw)
             except BaseException as e:  # noqa: BLE001 — classify below
+                pend.in_submit = None
                 if (isinstance(e, TypeError) and "trace" in kw
                         and "trace" in f"{e}"):
                     # a trace-unaware replica client choked on the
@@ -661,7 +752,9 @@ class ServingRouter:
                     continue          # same round, next replica
                 self._finish_fail(pend, e, sync)
                 return
+            pend.in_submit = None
             pend.inner = inner
+            pend.cur_rep = rep.name
             if pend.future.done():
                 # cancel landed between the top-of-loop check and the
                 # submit: the stale-inner cancel callback missed this
@@ -726,6 +819,151 @@ class ServingRouter:
             self._finish_fail(pend, err)
             return
         self._dispatch(pend)
+
+    # -- hedged requests -----------------------------------------------------
+    def _hedge_delay(self) -> Optional[float]:
+        """The armed hedge delay in seconds, or None for no hedging.
+        ``hedge_after_s`` numeric → that; ``"auto"`` → observed TTFT p99
+        (floor 2× p50) from the tsdb history plane, cached ~1 s — with no
+        history armed (or no TTFT data yet), auto stays OFF: hedging
+        without a measured tail is just doubled load."""
+        h = self.hedge_after_s
+        if h is None or h == "off":
+            return None
+        if h != "auto":
+            v = float(h)
+            return v if v > 0 else None
+        now = time.monotonic()
+        if now - self._hedge_cache_t < 1.0:
+            return self._hedge_cache
+        val = None
+        try:
+            from ..observability import tsdb as _tsdb
+
+            hist = _tsdb.get()
+            if hist is not None:
+                p99 = hist.window_agg("paddle_serving_ttft_seconds:p99",
+                                      60.0, "avg")
+                p50 = hist.window_agg("paddle_serving_ttft_seconds:p50",
+                                      60.0, "avg")
+                if p99:
+                    v99 = max(p99.values())
+                    v50 = max(p50.values()) if p50 else 0.0
+                    val = max(float(v99), 2.0 * float(v50))
+                    if val <= 0:
+                        val = None
+        except Exception:
+            val = None
+        self._hedge_cache, self._hedge_cache_t = val, now
+        return val
+
+    def _hedge_outcome(self, outcome: str) -> None:
+        _safe_inc("paddle_router_hedges_total",
+                  "hedged duplicate attempts by outcome "
+                  "(launched/won/lost/failed/suppressed)",
+                  outcome=outcome)
+
+    def _maybe_hedge(self, pend: _Pending) -> None:
+        """The hedge timer fired: the request has been in flight for
+        hedge_after_s. If its primary attempt still has no first token,
+        dispatch ONE duplicate to a different healthy replica — first
+        terminal wins, the loser is cancelled. A hedge failure is
+        fire-and-forget: it never burns breaker evidence and never
+        triggers failover (the primary attempt still owns the request's
+        retry budget)."""
+        fut = pend.future
+        if fut.done() or self._draining.is_set():
+            return
+        inner = pend.inner
+        primary = pend.in_submit or pend.cur_rep
+        if inner is None:
+            if pend.in_submit is None:
+                # between attempts: failover owns it
+                return
+            # else the dispatcher is BLOCKED in client.submit — a gray
+            # accept (delayed/black-holed accepted frame); this is a tail
+            # the hedge must cut, not skip
+        elif inner.done() or inner._t_first is not None:
+            # already terminal, or the first token arrived — the tail
+            # this hedge would cut no longer exists
+            return
+        cands = self._candidates(
+            exclude=() if primary is None else (primary,))
+        if not cands:
+            self._hedge_outcome("suppressed")
+            return
+        with self._stats_lock:
+            budget = max(1.0, self.stats["submitted"]
+                         * self.hedge_budget_pct / 100.0)
+            if self.stats["hedges"] + 1 > budget:
+                suppressed = True
+            else:
+                suppressed = False
+                self.stats["hedges"] += 1
+        if suppressed:
+            self._hedge_outcome("suppressed")
+            return
+        rep = min(cands, key=self._load_score)
+        kw = dict(pend.kw)
+        kw.pop("trace", None)     # one journey cannot ride two live
+        #   streams; the hedge is recorded as a router span instead
+        if pend.deadline is not None:
+            kw["deadline_s"] = max(pend.deadline - time.monotonic(), 1e-3)
+        if getattr(rep.client, "supports_req_uid", False):
+            kw["req_uid"] = pend.req_uid
+        t0 = time.perf_counter()
+        try:
+            hinner = rep.client.submit(pend.prompt_ids, **kw)
+        except Exception as e:
+            self._hedge_outcome("failed")
+            if pend.trace is not None:
+                pend.trace.event("router.hedge", t0=t0, replica=rep.name,
+                                 launched=False,
+                                 error=f"{type(e).__name__}: {e}"[:200])
+            return
+        pend.hedge_inner = hinner
+        if fut.done():
+            hinner.cancel()
+            return
+        with self._stats_lock:
+            rep.inflight += 1
+        self._hedge_outcome("launched")
+        _flight_record("router", rep.name, event="hedge",
+                       req=str(fut._req_id or "?"),
+                       primary=str(primary))
+        if pend.trace is not None:
+            pend.trace.event("router.hedge", t0=t0, replica=rep.name,
+                             primary=primary, launched=True)
+        hinner._add_done_callback(
+            lambda _i, _pend=pend, _rep=rep:
+            self._on_hedge_done(_pend, _rep, _i))
+
+    def _on_hedge_done(self, pend: _Pending, rep: _Replica,
+                       hinner: GenerationResult) -> None:
+        with self._stats_lock:
+            rep.inflight = max(0, rep.inflight - 1)
+        fut = pend.future
+        err = hinner._error
+        if fut.done() or pend.delivered:
+            # the primary delivered first (and _finish_ok cancelled us),
+            # or the client went away — either way this duplicate lost
+            self._hedge_outcome(
+                "lost" if isinstance(err, RequestCancelledError)
+                else "lost" if err is None else "failed")
+            return
+        if err is None:
+            rep.breaker.record_success()
+            with self._stats_lock:
+                self.stats["hedge_wins"] += 1
+            self._hedge_outcome("won")
+            if pend.trace is not None:
+                pend.trace.event("router.hedge_win", replica=rep.name)
+            self._finish_ok(pend, hinner)
+            return
+        # hedge failed while the primary is still working: drop it on the
+        # floor — no failover, no breaker evidence (one duplicate's death
+        # must not evict a replica the primary path hasn't judged)
+        self._hedge_outcome("failed")
 
     # -- client API ----------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int = 32,
@@ -878,11 +1116,14 @@ class ServingRouter:
         """Fail every pending resubmission waiting in the retry heap —
         drain/stop must leave no future unresolved."""
         with self._retry_cv:
-            waiting = [p for _, _, p in self._retry_heap]
+            waiting = [(k, p) for _, _, k, p in self._retry_heap]
             self._retry_heap.clear()
             self._retry_cv.notify()
         n = 0
-        for pend in waiting:
+        for kind, pend in waiting:
+            if kind != "retry":
+                continue     # a swept hedge timer just never fires: the
+                #              primary attempt still resolves the future
             if not pend.future.done():
                 self._finish_fail(pend, err)
                 n += 1

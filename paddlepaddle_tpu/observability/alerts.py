@@ -354,6 +354,24 @@ def default_rules() -> List[AlertRule]:
                         "averaged over a minute): shape churn is eating "
                         "the TPU"),
         AlertRule(
+            "replica_stalled",
+            [AlertCondition("paddle_replica_stalls_total", 60.0, "max",
+                            ">", 0.0)],
+            for_s=0.0, severity="warn",
+            description="a stream-progress watchdog tripped in the last "
+                        "minute — a replica connection black-holed or a "
+                        "replica stopped producing frames"),
+        AlertRule(
+            "replica_stalled_sustained",
+            [AlertCondition("paddle_replica_stalls_total", 60.0, "avg",
+                            ">", 0.02),
+             AlertCondition("paddle_replica_stalls_total", 300.0, "avg",
+                            ">", 0.005)],
+            for_s=0.0, severity="page",
+            description="stall-detector trips sustained on both the fast "
+                        "and slow window (> ~1/min) — a partial partition "
+                        "or a gray-failing replica, not a one-off blip"),
+        AlertRule(
             "fleet_snapshot_stale",
             [AlertCondition("paddle_fleet_snapshot_age_seconds", 60.0,
                             "last", ">", 3.0 * publish)],

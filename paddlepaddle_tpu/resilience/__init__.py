@@ -10,6 +10,10 @@ This package makes that machinery *provable*:
   fault injection at the runtime's hot seams (store ops, collective launch,
   checkpoint shard writes, DataLoader workers, step execution, serving
   admission/decode);
+* :mod:`~.netchaos` — deterministic NETWORK fault injection
+  (``PADDLE_NETCHAOS``): a frame-aware proxy between the remote replica
+  client and a replica socket that black-holes, delays, throttles,
+  resets, truncates or corrupts the wire on a seeded schedule;
 * :mod:`~.retry` — ``RetryPolicy`` + ``retry``/``call_with_retry`` with
   exponential backoff, jitter and deadlines, applied at the store,
   checkpoint-I/O and rendezvous seams;
@@ -23,8 +27,9 @@ registry (``paddle_retry_*``, ``paddle_chaos_*``, ``paddle_ckpt_*``,
 ``paddle_preemptions_total``), so operators can watch fault handling happen.
 """
 
-from . import chaos, integrity, preemption, retry  # noqa: F401
+from . import chaos, integrity, netchaos, preemption, retry  # noqa: F401
 from .chaos import ChaosError, chaos_point  # noqa: F401
+from .netchaos import NetChaosProxy, parse_netchaos  # noqa: F401
 from .integrity import (  # noqa: F401
     CheckpointCorruptionError,
     CheckpointManager,
@@ -43,8 +48,9 @@ from .preemption import (  # noqa: F401
 from .retry import RetryPolicy, call_with_retry  # noqa: F401
 
 __all__ = [
-    "chaos", "retry", "preemption", "integrity",
+    "chaos", "retry", "preemption", "integrity", "netchaos",
     "ChaosError", "chaos_point",
+    "NetChaosProxy", "parse_netchaos",
     "RetryPolicy", "call_with_retry",
     "PreemptionHandler", "install_preemption_handler",
     "preemption_requested", "uninstall_preemption_handler",

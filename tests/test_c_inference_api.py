@@ -364,3 +364,276 @@ def test_c_oversized_frame_gets_error_frame_before_payload(tmp_path):
             assert "exceeds max" in msg
             # then close: EOF, not a hang waiting for our "payload"
             assert s.recv(1) == b""
+
+
+# ---------------------------------------------------------------------------
+# Wire hardening: idempotent resubmit (req_uid dedup ring), per-stream CRC
+# negotiation, the per-connection write deadline (slow-loris shed), the
+# mid-frame read deadline, and a seeded framing fuzz sweep. All against an
+# in-process CApiServer over a FakeModel engine — seconds-cheap, no g++.
+# ---------------------------------------------------------------------------
+
+def _fake_engine(**kw):
+    from paddlepaddle_tpu.inference import ServingEngine
+    from test_serving_robustness import FakeModel
+
+    model = FakeModel()
+    kw.setdefault("mode", "static")
+    kw.setdefault("max_batch_size", 2)
+    kw.setdefault("max_wait_ms", 2.0)
+    kw.setdefault("max_len", 64)
+    return model, ServingEngine(model, **kw)
+
+
+def _submit_payload(prompt, crc=False, req_uid=None, **hdr_kw):
+    import json as _json
+
+    from paddlepaddle_tpu.inference.c_api_server import _MAGIC, _pack_tensor
+
+    hdr = dict({"max_new_tokens": 4}, **hdr_kw)
+    if crc:
+        hdr["crc"] = True
+    if req_uid is not None:
+        hdr["req_uid"] = req_uid
+    blob = _json.dumps(hdr).encode()
+    return (struct.pack("<IB", _MAGIC, 5)
+            + struct.pack("<I", len(blob)) + blob
+            + _pack_tensor("prompt", np.asarray(prompt, np.int32)))
+
+
+def _stream(sock_path, payload, timeout=10.0):
+    """Submit and read the whole stream; returns the list of raw reply
+    frames (magic-prefixed, CRC flag intact) up to and including the
+    terminal (status 0/1/3)."""
+    frames = []
+    with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+        s.connect(sock_path)
+        s.settimeout(timeout)
+        s.sendall(struct.pack("<Q", len(payload)) + payload)
+        while True:
+            head = _recv_exact(s, 8)
+            if len(head) < 8:
+                return frames
+            (length,) = struct.unpack("<Q", head)
+            frame = _recv_exact(s, length)
+            frames.append(frame)
+            status = frame[4] & 0x7F
+            if status != 2:              # anything but a chunk ends it
+                return frames
+
+
+def _chunk_events(frames):
+    import json as _json
+
+    evs = []
+    for f in frames:
+        if f[4] & 0x7F != 2:
+            continue
+        off = 9 if f[4] & 0x80 else 5
+        (n,) = struct.unpack_from("<I", f, off)
+        evs.append(_json.loads(f[off + 4:off + 4 + n])["ev"])
+    return evs
+
+
+def _terminal_ids(frames):
+    from paddlepaddle_tpu.inference.c_api_server import (
+        _Cursor,
+        _unpack_tensor,
+    )
+
+    f = frames[-1]
+    assert f[4] & 0x7F == 0, f"terminal not OK: status {f[4]}"
+    off = 9 if f[4] & 0x80 else 5
+    c = _Cursor(f[off:])
+    (n,) = struct.unpack_from("<I", c.b, c.o)
+    c.o += 4 + n
+    _, out = _unpack_tensor(c)
+    return out
+
+
+def test_c_submit_req_uid_resubmit_replays_without_second_decode(tmp_path):
+    """The idempotent-resubmit contract: same req_uid ⇒ the cached
+    terminal frame is replayed byte-for-byte (token-exact) and the engine
+    NEVER decodes twice — the client can blindly resubmit after an
+    ambiguous terminal-frame loss."""
+    from paddlepaddle_tpu.inference.c_api_server import CApiServer
+
+    model, eng = _fake_engine()
+    eng.start()
+    sock = str(tmp_path / "pd.sock")
+    try:
+        with CApiServer(None, sock, engine=eng):
+            first = _stream(sock, _submit_payload([5, 6, 7], req_uid="u-1"))
+            calls = model.calls
+            again = _stream(sock, _submit_payload([5, 6, 7], req_uid="u-1"))
+            assert model.calls == calls, "resubmit hit the engine again"
+            assert "replay" in _chunk_events(again)
+            assert "replay" not in _chunk_events(first)
+            np.testing.assert_array_equal(_terminal_ids(first),
+                                          _terminal_ids(again))
+            # a DIFFERENT uid decodes fresh
+            other = _stream(sock, _submit_payload([5, 6, 7], req_uid="u-2"))
+            assert model.calls == calls + 1
+            assert "replay" not in _chunk_events(other)
+    finally:
+        eng.stop()
+
+
+def test_c_submit_crc_negotiation_is_per_stream(tmp_path):
+    """`"crc": true` in the submit header flags every reply frame with
+    0x80 + a valid CRC32; a legacy submit on the SAME server gets plain
+    frames — the flag is per-stream, never sprung on an old client."""
+    import zlib
+
+    from paddlepaddle_tpu.inference.c_api_server import CApiServer
+
+    _, eng = _fake_engine()
+    eng.start()
+    sock = str(tmp_path / "pd.sock")
+    try:
+        with CApiServer(None, sock, engine=eng):
+            crcd = _stream(sock, _submit_payload([1, 2], crc=True))
+            assert crcd and all(f[4] & 0x80 for f in crcd)
+            for f in crcd:
+                (want,) = struct.unpack_from("<I", f, 5)
+                assert zlib.crc32(f[9:]) & 0xFFFFFFFF == want
+            plain = _stream(sock, _submit_payload([1, 2]))
+            assert plain and all(not (f[4] & 0x80) for f in plain)
+            np.testing.assert_array_equal(_terminal_ids(crcd),
+                                          _terminal_ids(plain))
+    finally:
+        eng.stop()
+
+
+def test_c_slow_loris_client_is_shed_by_write_deadline(tmp_path):
+    """A client that submits and never drains its socket must be shed by
+    the per-connection write deadline (SO_SNDTIMEO + bounded send buffer)
+    within ~write_timeout_s — never a handler thread wedged in sendall."""
+    import time as _time
+
+    import paddlepaddle_tpu.observability as obs
+    from paddlepaddle_tpu.inference.c_api_server import CApiServer
+
+    obs.reset()
+    _, eng = _fake_engine(max_len=16384, max_batch_size=1)
+    eng.start()
+    sock = str(tmp_path / "pd.sock")
+    try:
+        with CApiServer(None, sock, engine=eng, write_timeout_s=0.5,
+                        send_buffer_bytes=4096):
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+            s.connect(sock)
+            # ~48 KB terminal: far past server SNDBUF + client RCVBUF
+            payload = _submit_payload(list(range(8)),
+                                      max_new_tokens=12000)
+            s.sendall(struct.pack("<Q", len(payload)) + payload)
+            # never read: the server's sendall must hit the deadline
+            deadline = _time.monotonic() + 8.0
+            while _time.monotonic() < deadline:
+                if ("paddle_capi_write_timeouts_total"
+                        in obs.to_prometheus_text()):
+                    break
+                _time.sleep(0.05)
+            else:
+                raise AssertionError(
+                    "write deadline never tripped — slow-loris wedges the "
+                    "handler thread")
+            s.close()
+            # the server survived the shed: a polite stream still works
+            ok = _stream(sock, _submit_payload([1, 2]))
+            assert ok[-1][4] & 0x7F == 0
+    finally:
+        eng.stop()
+        obs.reset()
+
+
+def test_c_mid_frame_stall_gets_timeout_error_frame(tmp_path):
+    """A peer that sends a length prefix then goes quiet mid-frame gets a
+    typed-up error frame within ~frame_timeout_s and a close — the
+    half-frame can never pin a connection thread forever. EOF mid-frame
+    (peer died) stays a SILENT close, the legacy truncation contract."""
+    from paddlepaddle_tpu.inference.c_api_server import CApiServer
+
+    sock = str(tmp_path / "pd.sock")
+    with CApiServer(_NullPredictor(), sock, frame_timeout_s=0.5):
+        with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+            s.connect(sock)
+            s.settimeout(5.0)
+            s.sendall(struct.pack("<Q", 64) + b"\xaa" * 10)   # 54 short
+            head = _recv_exact(s, 8)
+            assert len(head) == 8, "no error frame before the close"
+            (length,) = struct.unpack("<Q", head)
+            frame = _recv_exact(s, length)
+            assert frame[4] == 1
+            (n,) = struct.unpack_from("<I", frame, 5)
+            assert b"timed out mid-frame" in frame[9:9 + n]
+            assert s.recv(1) == b""
+        # EOF mid-frame: silent close, no error frame
+        with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+            s.connect(sock)
+            s.settimeout(5.0)
+            s.sendall(struct.pack("<Q", 64) + b"\xaa" * 10)
+            s.shutdown(socket.SHUT_WR)
+            assert s.recv(1) == b""
+
+
+def test_c_framing_fuzz_bounded_typed_close(tmp_path):
+    """Seeded fuzz over the frame layer: random garbage, bad magic, valid
+    magic + random op/body, truncated-then-closed payloads. Every
+    connection must end in bounded time with either a reply frame or a
+    clean EOF — never a hang, and the server answers a well-formed
+    request afterwards."""
+    import random as _random
+
+    from paddlepaddle_tpu.inference.c_api_server import _MAGIC, CApiServer
+
+    rng = _random.Random(0xC0FFEE)
+    sock = str(tmp_path / "pd.sock")
+    with CApiServer(_NullPredictor(), sock, frame_timeout_s=1.0):
+        for i in range(40):
+            kind = i % 4
+            body = bytes(rng.randrange(256)
+                         for _ in range(rng.randrange(1, 256)))
+            if kind == 1:
+                body = struct.pack("<IB", _MAGIC,
+                                   rng.randrange(256)) + body
+            with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+                s.connect(sock)
+                s.settimeout(5.0)
+                if kind == 3:   # truncated payload then EOF
+                    s.sendall(struct.pack("<Q", len(body) + 32) + body)
+                    s.shutdown(socket.SHUT_WR)
+                else:
+                    s.sendall(struct.pack("<Q", len(body)) + body)
+                # bounded outcome: a reply frame OR a clean close — the
+                # settimeout turns "neither, ever" into the failure.
+                # (A reply with the connection held open is legal: ops
+                # that don't desync the stream keep it persistent.)
+                try:
+                    head = _recv_exact(s, 8)
+                    if head:            # got a reply: it must be whole
+                        (length,) = struct.unpack("<Q", head)
+                        frame = _recv_exact(s, length)
+                        assert len(frame) == length
+                        assert frame[:4] == struct.pack("<I", _MAGIC)
+                except OSError as e:   # pragma: no cover
+                    raise AssertionError(
+                        f"fuzz case {i} (kind {kind}) hung: {e}") from e
+        status, _ = _rpc(sock, struct.pack("<IB", _MAGIC, 2))
+        assert status == 0
+
+
+def test_result_ring_is_bounded_lru():
+    from paddlepaddle_tpu.inference.c_api_server import _ResultRing
+
+    ring = _ResultRing(cap=4)
+    for i in range(8):
+        ring.put(f"u{i}", b"f%d" % i)
+    assert len(ring) == 4
+    assert ring.get("u0") is None          # evicted
+    assert ring.get("u7") == b"f7"
+    ring.get("u4")                         # touch: now most-recent
+    ring.put("u8", b"f8")
+    assert ring.get("u4") == b"f4"         # survived the insert
+    assert ring.get("u5") is None          # LRU victim instead

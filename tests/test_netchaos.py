@@ -1,0 +1,477 @@
+"""Net-chaos proxy (resilience/netchaos.py) + the wire-hardening paths
+it exists to exercise: the client's stream-progress watchdog
+(ReplicaStalledError in ~heartbeat_timeout_s, not read_timeout_s), frame
+CRC verification (WireCorruptionError, never silently-wrong tokens), and
+the typed-vs-untyped split the router's failover depends on.
+
+Budget discipline: everything here runs against a scripted in-process
+FAKE frame server (no engine, no subprocess) — the whole module is
+seconds-cheap. The real-process drills live behind ``chaos`` markers in
+tools/run_chaos.sh.
+"""
+
+import json
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddlepaddle_tpu.inference.c_api_server import (
+    _MAGIC,
+    _OP_SUBMIT,
+    _ST_CHUNK,
+    _ST_OK,
+    _pack_tensor,
+    crc_wrap,
+)
+from paddlepaddle_tpu.inference.remote_replica import RemoteReplicaClient
+from paddlepaddle_tpu.inference.robustness import (
+    ReplicaStalledError,
+    ServingError,
+    WireCorruptionError,
+)
+from paddlepaddle_tpu.resilience.netchaos import (
+    NETCHAOS_MODES,
+    NETCHAOS_POINTS,
+    NetChaosProxy,
+    env_seed,
+    parse_netchaos,
+)
+
+
+# -- spec grammar (no sockets) ------------------------------------------------
+
+def test_parse_netchaos_fields_and_schedules():
+    specs = parse_netchaos(
+        "down:blackhole:@2; up:delay:0.5:80, conn:reset:%3")
+    assert [(s.point, s.mode) for s in specs] == [
+        ("down", "blackhole"), ("up", "delay"), ("conn", "reset")]
+    bh, dl, rst = specs
+    assert (bh.sched_kind, bh.sched_value) == ("at", 2)
+    assert (dl.sched_kind, dl.sched_value) == ("prob", 0.5)
+    assert dl.arg == 80
+    assert (rst.sched_kind, rst.sched_value) == ("every", 3)
+
+
+def test_parse_netchaos_rejects_typos_loudly():
+    with pytest.raises(ValueError, match="point"):
+        parse_netchaos("sideways:delay:1.0")
+    with pytest.raises(ValueError, match="mode"):
+        parse_netchaos("down:gremlins:1.0")
+    with pytest.raises(ValueError, match="sched"):
+        parse_netchaos("down:delay")
+    assert parse_netchaos("") == []
+
+
+def test_env_seed_falls_back_to_chaos_seed(monkeypatch):
+    monkeypatch.delenv("PADDLE_NETCHAOS_SEED", raising=False)
+    monkeypatch.setenv("PADDLE_CHAOS_SEED", "41")
+    assert env_seed() == 41
+    monkeypatch.setenv("PADDLE_NETCHAOS_SEED", "7")
+    assert env_seed() == 7
+    monkeypatch.setenv("PADDLE_NETCHAOS_SEED", "nope")
+    assert env_seed() == 0
+
+
+# -- scripted fake frame server ----------------------------------------------
+
+def _chunk(ev, crc=False, **kw):
+    blob = json.dumps(dict({"ev": ev}, **kw)).encode()
+    f = (struct.pack("<IB", _MAGIC, _ST_CHUNK)
+         + struct.pack("<I", len(blob)) + blob)
+    return crc_wrap(f) if crc else f
+
+
+def _terminal(out, crc=False):
+    arr = np.ascontiguousarray(np.asarray(out, np.int32))
+    blob = json.dumps({"n_new": int(arr.size), "n_at_first": 1,
+                       "streaming": True}).encode()
+    f = (struct.pack("<IB", _MAGIC, _ST_OK)
+         + struct.pack("<I", len(blob)) + blob
+         + _pack_tensor("output_ids", arr))
+    return crc_wrap(f) if crc else f
+
+
+class FakeWire:
+    """Loopback TCP server speaking just enough of the C-API frame
+    protocol to drive RemoteReplicaClient's stream reader — each
+    connection reads ONE request frame, then plays ``script``: a list of
+    frame bytes, ``("sleep", s)`` pauses, or ``"hang"`` (go silent with
+    the socket open — what a black-holed peer looks like from userspace).
+    """
+
+    def __init__(self, script):
+        self.script = script
+        self._stop = threading.Event()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", 0))
+        self.port = self._sock.getsockname()[1]
+        self._sock.listen(8)
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            head = b""
+            while len(head) < 8:
+                b = conn.recv(8 - len(head))
+                if not b:
+                    return
+                head += b
+            (n,) = struct.unpack("<Q", head)
+            body = b""
+            while len(body) < n:
+                b = conn.recv(n - len(body))
+                if not b:
+                    return
+                body += b
+            for step in self.script:
+                if step == "hang":
+                    self._stop.wait(30.0)
+                    return
+                if isinstance(step, tuple) and step[0] == "sleep":
+                    time.sleep(step[1])
+                    continue
+                conn.sendall(struct.pack("<Q", len(step)) + step)
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def _client(target_port, proxy=None, **kw):
+    kw.setdefault("heartbeat_timeout_s", 0.6)
+    kw.setdefault("read_timeout_s", 5.0)
+    kw.setdefault("connect_timeout_s", 2.0)
+    cli = RemoteReplicaClient(address=target_port, name="fake", **kw)
+    if proxy is not None:
+        cli._nc_proxy = proxy       # dial the chaos proxy, not the target
+    return cli
+
+
+OUT = np.arange(12, dtype=np.int32)
+HAPPY = [_chunk("accepted"), _chunk("first", n=1), _terminal(OUT)]
+
+
+@pytest.fixture
+def wire():
+    servers = []
+
+    def make(script):
+        w = FakeWire(script)
+        servers.append(w)
+        return w
+
+    yield make
+    for w in servers:
+        w.close()
+
+
+# -- proxy behavior through the real client ----------------------------------
+
+def test_proxy_is_transparent_when_nothing_fires(wire):
+    w = wire(HAPPY)
+    with NetChaosProxy(w.port, specs="down:delay:@9999", seed=0) as px:
+        fut = _client(w.port, px).submit([1, 2, 3], max_new_tokens=4)
+        np.testing.assert_array_equal(fut.result(timeout=5.0), OUT)
+    assert px.fire_counts() == {}
+    # frame-aware hit accounting: every down frame crossed the seam
+    assert px.hit_counts().get("down", 0) >= 3
+
+
+def test_delay_fires_on_every_frame_and_stream_survives(wire):
+    w = wire(HAPPY)
+    with NetChaosProxy(w.port, specs="down:delay:1.0:20", seed=0) as px:
+        fut = _client(w.port, px).submit([1], max_new_tokens=4)
+        np.testing.assert_array_equal(fut.result(timeout=5.0), OUT)
+    assert px.fire_counts().get("down", 0) >= 3
+
+
+def test_blackhole_mid_stream_trips_stall_watchdog_fast(wire):
+    """The acceptance drill in miniature: frame 1 (accepted) passes, the
+    wire then black-holes — the client must surface a TYPED retryable
+    ReplicaStalledError within ~heartbeat_timeout_s, not read_timeout_s,
+    and never a wrong/partial result."""
+    w = wire(HAPPY + ["hang"])
+    with NetChaosProxy(w.port, specs="down:blackhole:@2", seed=0) as px:
+        cli = _client(w.port, px, heartbeat_timeout_s=0.6)
+        t0 = time.perf_counter()
+        fut = cli.submit([1], max_new_tokens=4)
+        with pytest.raises(ReplicaStalledError) as ei:
+            fut.result(timeout=5.0)
+        took = time.perf_counter() - t0
+    assert took < 3.0, f"stall took {took:.2f}s — watchdog not bounding"
+    assert ei.value.stalled_after_s == pytest.approx(0.6)
+    assert isinstance(ei.value, ServingError)     # router-retryable shape
+    assert px.fire_counts().get("down") == 1
+
+
+def test_conn_blackhole_stalls_submit_synchronously(wire):
+    w = wire(HAPPY)
+    with NetChaosProxy(w.port, specs="conn:blackhole:1.0", seed=0) as px:
+        cli = _client(w.port, px, heartbeat_timeout_s=0.5,
+                      read_timeout_s=5.0)
+        with pytest.raises(ReplicaStalledError):
+            cli.submit([1], max_new_tokens=4)
+    assert px.fire_counts().get("conn", 0) >= 1
+
+
+def test_corrupt_with_crc_surfaces_wire_corruption_never_bad_tokens(wire):
+    """Corruption lands past the CRC header → the client must fail TYPED
+    (WireCorruptionError, retryable) — the pre-CRC failure mode was
+    silently wrong output_ids."""
+    w = wire([_chunk("accepted", crc=True), _chunk("first", n=1, crc=True),
+              _terminal(OUT, crc=True)])
+    with NetChaosProxy(w.port, specs="down:corrupt:@3", seed=3) as px:
+        fut = _client(w.port, px).submit([1], max_new_tokens=4)
+        with pytest.raises(WireCorruptionError):
+            fut.result(timeout=5.0)
+    assert px.fire_counts().get("down") == 1
+
+
+def test_corruption_without_crc_would_pass_silently(wire):
+    """Contrast pin for the test above: the SAME corrupted terminal frame
+    without CRC protection decodes 'successfully' into wrong bytes — this
+    is the failure class the CRC flag exists to kill. (If this test ever
+    fails because corruption happens to break JSON/tensor parsing, tighten
+    the corrupt offset — the point is that no check CATCHES it.)"""
+    w = wire([_chunk("accepted"), _terminal(OUT)])
+    with NetChaosProxy(w.port, specs="down:corrupt:@2", seed=3) as px:
+        fut = _client(w.port, px, crc=False).submit([1], max_new_tokens=4)
+        try:
+            out = fut.result(timeout=5.0)
+            assert not np.array_equal(out, OUT)   # wrong tokens, no error
+        except (WireCorruptionError,) as e:       # pragma: no cover
+            pytest.fail(f"no CRC on the wire yet {e!r} was raised")
+        except Exception:
+            pass   # parse desync is also acceptable evidence of damage
+    assert px.fire_counts().get("down") == 1
+
+
+def test_reset_mid_stream_is_untyped_connection_error(wire):
+    """RST → ConnectionError (UNTYPED) — the router's failover class,
+    distinct from the stall/corruption typed retryables."""
+    w = wire(HAPPY)
+    with NetChaosProxy(w.port, specs="down:reset:@2", seed=0) as px:
+        fut = _client(w.port, px).submit([1], max_new_tokens=4)
+        with pytest.raises(ConnectionError) as ei:
+            fut.result(timeout=5.0)
+        assert not isinstance(ei.value, ServingError)
+    assert px.fire_counts().get("down") == 1
+
+
+def test_trunc_mid_frame_is_untyped_connection_error(wire):
+    w = wire(HAPPY)
+    with NetChaosProxy(w.port, specs="down:trunc:@2", seed=0) as px:
+        fut = _client(w.port, px).submit([1], max_new_tokens=4)
+        with pytest.raises(ConnectionError) as ei:
+            fut.result(timeout=5.0)
+        assert not isinstance(ei.value, ServingError)
+
+
+def test_same_seed_same_frames_same_fires(wire):
+    """The determinism contract: fixed seed + fixed frame sequence ⇒
+    identical injection decisions, run to run."""
+    counts = []
+    for _ in range(2):
+        w = wire(HAPPY)
+        with NetChaosProxy(w.port, specs="down:delay:0.5:1", seed=11) as px:
+            fut = _client(w.port, px).submit([1], max_new_tokens=4)
+            np.testing.assert_array_equal(fut.result(timeout=5.0), OUT)
+            counts.append((px.hit_counts(), px.fire_counts()))
+    assert counts[0] == counts[1]
+
+
+def test_env_var_arms_the_client_automatically(wire, monkeypatch):
+    monkeypatch.setenv("PADDLE_NETCHAOS", "down:delay:1.0:5")
+    monkeypatch.setenv("PADDLE_NETCHAOS_SEED", "2")
+    w = wire(HAPPY)
+    cli = _client(w.port)                 # no proxy injected by hand
+    fut = cli.submit([1], max_new_tokens=4)
+    np.testing.assert_array_equal(fut.result(timeout=5.0), OUT)
+    assert cli._nc_proxy not in (None, False)
+    assert cli._nc_proxy.fire_counts().get("down", 0) >= 3
+    cli.stop()                            # stop() owns the proxy too
+    assert cli._nc_proxy is None
+
+
+def test_netchaos_off_means_no_proxy_object(wire, monkeypatch):
+    monkeypatch.delenv("PADDLE_NETCHAOS", raising=False)
+    w = wire(HAPPY)
+    cli = _client(w.port)
+    fut = cli.submit([1], max_new_tokens=4)
+    np.testing.assert_array_equal(fut.result(timeout=5.0), OUT)
+    assert cli._nc_proxy is False         # one getenv, then cached off
+
+
+# -- config cross-check satellite --------------------------------------------
+
+def test_timeout_misconfig_warns_on_stderr_and_metric(capsys):
+    import paddlepaddle_tpu.observability as obs
+
+    obs.reset()
+    try:
+        RemoteReplicaClient(address=1, name="bad",
+                            heartbeat_timeout_s=0.4)   # <= server 0.5 s
+        err = capsys.readouterr().err
+        assert "heartbeat interval" in err and "stall watchdog" in err
+        text = obs.to_prometheus_text()
+        assert "paddle_replica_timeout_misconfig_total" in text
+    finally:
+        obs.reset()
+
+
+def test_sane_timeouts_do_not_warn(capsys):
+    RemoteReplicaClient(address=1, name="ok", heartbeat_timeout_s=2.0)
+    assert "stall watchdog" not in capsys.readouterr().err
+
+
+# -- alert-rules satellite ----------------------------------------------------
+
+def test_replica_stalled_alert_rules_are_registered():
+    from paddlepaddle_tpu.observability.alerts import default_rules
+
+    rules = {r.name: r for r in default_rules()}
+    warn = rules["replica_stalled"]
+    page = rules["replica_stalled_sustained"]
+    assert warn.severity == "warn" and page.severity == "page"
+    assert all(c.series == "paddle_replica_stalls_total"
+               for c in warn.conditions + page.conditions)
+    # the page needs BOTH a fast and a slow window — a single trip must
+    # never page
+    assert len(page.conditions) == 2
+    assert {c.window_s for c in page.conditions} == {60.0, 300.0}
+
+
+# -- the real-process drill (chaos tier, via tools/run_chaos.sh) --------------
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_process_fleet_survives_hostile_network():
+    """The hostile-network drill over REAL OS processes: a 2-process
+    fleet behind the router, the wire to r0 broken by the netchaos proxy.
+
+    * blackhole mid-stream → the stall watchdog trips within
+      ~heartbeat_timeout_s, the router fails over, the future completes
+      with the SAME tokens — zero lost futures;
+    * idempotent resubmit: the same req_uid against a real replica
+      replays the cached terminal (dedup counter on the replica's own
+      metrics endpoint) token-exact;
+    * corrupt frame under CRC → WireCorruptionError (typed retryable),
+      retried to completion — never silently-wrong tokens.
+    """
+    import paddlepaddle_tpu.observability as obs
+    from paddlepaddle_tpu.inference.remote_replica import (
+        ProcessReplicaFactory,
+        _parse_reply,
+        _recv_frame,
+        _send_frame,
+    )
+    from paddlepaddle_tpu.inference.router import ServingRouter
+
+    obs.reset()
+    factory = ProcessReplicaFactory(
+        preset="tiny", warmup="off",
+        supervisor_kw={"ready_timeout_s": 180.0},
+        client_kw={"heartbeat_timeout_s": 1.0})
+    clients = [factory(name=f"nc{i}") for i in range(2)]
+    router = ServingRouter(clients, probe_interval_s=60.0)
+    router.start()
+    prompt = np.arange(6, dtype=np.int32)
+    proxies = []
+
+    def _arm(idx, spec):
+        for px_old in proxies:
+            px_old.stop()
+        px = NetChaosProxy(clients[idx].address, specs=spec,
+                           seed=1234, name=f"drill:{spec}").start()
+        proxies.append(px)
+        clients[idx]._nc_proxy = px
+        return px
+
+    def _force(idx):
+        router._probe_once()
+        for i, rep in enumerate(router._replicas):
+            rep.snapshot = dict(rep.snapshot or {}, ok=True,
+                                est_wait_s=(0.0 if i == idx else 30.0))
+
+    try:
+        # prime decode programs on BOTH replicas, and grab the control
+        # tokens every chaotic submit must still produce
+        control = clients[0].submit(prompt, max_new_tokens=4).result(180)
+        np.testing.assert_array_equal(
+            clients[1].submit(prompt, max_new_tokens=4).result(180),
+            control)
+
+        # 1) blackhole mid-stream: frame 2 of r0's submit stream (the one
+        #    right after accepted) vanishes and the wire goes silent
+        _arm(0, "down:blackhole:@2")
+        _force(0)
+        t0 = time.perf_counter()
+        out = router.submit(prompt, max_new_tokens=4).result(60)
+        took = time.perf_counter() - t0
+        np.testing.assert_array_equal(out, control)
+        assert took < 20.0, f"failover took {took:.1f}s"
+        assert router.stats["retries"] + router.stats["failovers"] >= 1
+        text = obs.to_prometheus_text()
+        assert "paddle_replica_stalls_total" in text
+        assert "paddle_netchaos_injections_total" in text
+
+        # 2) idempotent resubmit against the real replica process
+        clients[0]._nc_proxy = False          # direct wire for this leg
+        uid = "drill-dedup-uid"
+        first = clients[1].submit(prompt, max_new_tokens=4,
+                                  req_uid=uid).result(60)
+        again = clients[1].submit(prompt, max_new_tokens=4,
+                                  req_uid=uid).result(60)
+        np.testing.assert_array_equal(first, control)
+        np.testing.assert_array_equal(again, control)
+        s = clients[1]._connect()
+        try:                                  # the replica's OWN registry
+            _send_frame(s, struct.pack("<IB", 0x50444331, 4))
+            status, c = _parse_reply(_recv_frame(s))
+        finally:
+            s.close()
+        assert status == 0
+        (n,) = struct.unpack_from("<I", c.b, c.o)
+        scrape = c.b[c.o + 4:c.o + 4 + n].decode()
+        assert "paddle_capi_dedup_replays_total" in scrape
+
+        # 3) corrupt under CRC: typed WireCorruptionError, retried clean
+        _arm(0, "down:corrupt:@2")
+        _force(0)
+        out = router.submit(prompt, max_new_tokens=4).result(60)
+        np.testing.assert_array_equal(out, control)
+        assert "paddle_wire_corruption_total" in obs.to_prometheus_text()
+    finally:
+        router.stop()
+        for px in proxies:
+            px.stop()
+        for cl in clients:
+            try:
+                cl.supervisor.stop(drain_timeout=2.0)
+            except Exception:
+                pass
+        obs.reset()

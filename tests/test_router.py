@@ -577,3 +577,171 @@ def test_router_over_continuous_engines():
         assert h["ok"] and h["router"]["completed"] == 4
         assert all(v["pages_free"] is not None
                    for v in h["replicas"].values())
+
+
+# -- hedged requests ---------------------------------------------------------
+
+def _force_pick(r, idx):
+    """Make replica ``idx`` the unambiguous least-wait pick."""
+    r._probe_once()
+    for i, rep in enumerate(r._replicas):
+        rep.snapshot = dict(rep.snapshot or {}, ok=True,
+                            est_wait_s=(0.0 if i == idx else 30.0))
+
+
+def test_hedge_duplicates_to_other_replica_and_wins():
+    """Primary stuck pre-first-token past hedge_after_s ⇒ a duplicate on a
+    DIFFERENT replica; first terminal wins, delivery is exactly-once."""
+    slow, fast = FakeModel(delay_s=1.2), FakeModel()
+    r = ServingRouter([_factory(slow, max_batch_size=1),
+                       _factory(fast, max_batch_size=1)],
+                      probe_interval_s=_QUIET, hedge_after_s=0.15)
+    r.start()
+    try:
+        _force_pick(r, 0)                      # primary = the slow one
+        t0 = time.perf_counter()
+        fut = r.submit(_prompt(), max_new_tokens=2)
+        out = fut.result(30)
+        took = time.perf_counter() - t0
+        assert out.shape == (6,)
+        assert took < 1.0, f"hedge never rescued: {took:.2f}s"
+        assert slow.calls + fast.calls >= 2    # the duplicate really ran
+        time.sleep(1.3)                        # let the loser terminal land
+        assert r.stats["hedges"] == 1
+        assert r.stats["hedge_wins"] == 1
+        assert r.stats["completed"] == 1       # exactly-once delivery
+        assert r.stats["failed"] == 0
+    finally:
+        r.stop()
+
+
+def test_hedge_loses_gracefully_when_primary_finishes_first():
+    primary, other = FakeModel(delay_s=0.4), FakeModel(delay_s=1.5)
+    r = ServingRouter([_factory(primary, max_batch_size=1),
+                       _factory(other, max_batch_size=1)],
+                      probe_interval_s=_QUIET, hedge_after_s=0.1)
+    r.start()
+    try:
+        _force_pick(r, 0)
+        fut = r.submit(_prompt(), max_new_tokens=2)
+        assert fut.result(30).shape == (6,)
+        time.sleep(1.4)                        # hedge terminal lands late
+        assert r.stats["hedges"] == 1
+        assert r.stats["hedge_wins"] == 0
+        assert r.stats["completed"] == 1
+    finally:
+        r.stop()
+
+
+def test_hedge_needs_a_second_replica():
+    r = ServingRouter([_factory(FakeModel(delay_s=0.4), max_batch_size=1)],
+                      probe_interval_s=_QUIET, hedge_after_s=0.05)
+    r.start()
+    try:
+        fut = r.submit(_prompt(), max_new_tokens=2)
+        assert fut.result(30).shape == (6,)
+        assert r.stats["hedges"] == 0          # nothing to hedge onto
+    finally:
+        r.stop()
+
+
+def test_hedge_budget_caps_duplicate_rate():
+    """The budget is a hard fraction of submits: a fleet-wide slowdown
+    must not double total load via hedging."""
+    import paddlepaddle_tpu.observability as obs
+
+    obs.reset()
+    mk = lambda: FakeModel(delay_s=0.5)
+    r = ServingRouter([_factory(mk(), max_batch_size=4),
+                       _factory(mk(), max_batch_size=4)],
+                      probe_interval_s=_QUIET, hedge_after_s=0.05,
+                      hedge_budget_pct=10.0)
+    r.start()
+    try:
+        r._probe_once()
+        futs = [r.submit(_prompt(), max_new_tokens=2) for _ in range(6)]
+        oks, errs = _resolve_all(futs)
+        assert len(oks) == 6 and not errs
+        # 10% of 6 submits floors at max(1, 0.6) = 1 allowed hedge
+        assert r.stats["hedges"] <= 1
+        text = obs.to_prometheus_text()
+        assert 'paddle_router_hedges_total' in text
+        assert 'outcome="suppressed"' in text
+    finally:
+        r.stop()
+        obs.reset()
+
+
+def test_hedge_auto_is_off_without_ttft_history():
+    """hedge_after_s="auto" derives its delay from observed TTFT — with
+    no history there is no defensible number, so auto means OFF, never a
+    guessed constant."""
+    import paddlepaddle_tpu.observability as obs
+
+    obs.reset()
+    r = ServingRouter([_factory(), _factory()],
+                      probe_interval_s=_QUIET, hedge_after_s="auto")
+    r.start()
+    try:
+        assert r._hedge_delay() is None
+        fut = r.submit(_prompt(), max_new_tokens=2)
+        assert fut.result(30).shape == (6,)
+        assert r.stats["hedges"] == 0
+    finally:
+        r.stop()
+        obs.reset()
+
+
+def test_hedge_off_values():
+    for off in (None, 0, 0.0, "off"):
+        r = ServingRouter([_factory(), _factory()],
+                          probe_interval_s=_QUIET, hedge_after_s=off)
+        try:
+            assert r._hedge_delay() is None, f"hedge_after_s={off!r}"
+        finally:
+            r.stop()
+
+
+class _GrayAcceptClient(ReplicaClient):
+    """A replica whose submit() call itself wedges — the remote client's
+    blocking accept round trip under a delayed/black-holed accepted frame
+    (it blocks the dispatcher until the stall watchdog fires). The hedge
+    must cover this window too, not just the post-accept stream."""
+
+    def __init__(self, factory, name, block_s):
+        super().__init__(factory, name=name)
+        self.block_s = block_s
+
+    def submit(self, prompt_ids, **kw):
+        time.sleep(self.block_s)
+        return super().submit(prompt_ids, **kw)
+
+
+def test_hedge_covers_gray_accept_blocked_in_submit():
+    """The dispatcher blocked inside client.submit (gray accept) is the
+    nastiest pre-first-token tail: pend.inner is still None when the
+    hedge timer fires, and the hedge must dispatch anyway — to a
+    DIFFERENT replica — and win while the primary is still wedged."""
+    gray = _GrayAcceptClient(_factory(FakeModel(), max_batch_size=1),
+                             "r0", block_s=1.5)
+    fast = FakeModel()
+    r = ServingRouter([gray, _factory(fast, max_batch_size=1)],
+                      probe_interval_s=_QUIET, hedge_after_s=0.15)
+    r.start()
+    try:
+        _force_pick(r, 0)
+        fut = r.submit(_prompt(), max_new_tokens=2)
+        out = fut.result(30)
+        assert out.shape == (6,)
+        # the hedge delivered while the primary was still blocked: the
+        # future's first token landed well inside the 1.5 s accept wedge
+        slo = fut.slo()
+        assert slo["ttft_s"] is not None and slo["ttft_s"] < 1.2, slo
+        assert fast.calls >= 1                 # the duplicate really ran
+        time.sleep(0.3)                        # primary attempt unwinds
+        assert r.stats["hedges"] == 1
+        assert r.stats["hedge_wins"] == 1
+        assert r.stats["completed"] == 1       # exactly-once delivery
+        assert r.stats["failed"] == 0
+    finally:
+        r.stop()

@@ -51,6 +51,18 @@
 #     3 before serving), and the fleet serves real processes after
 #     (test_remote_replica.py::
 #     test_process_fleet_drill_rollout_step_traffic_sigkill)
+#   * hostile network: a 2-process fleet behind the router with the
+#     netchaos proxy breaking r0's wire — blackhole mid-stream trips the
+#     stall watchdog within ~heartbeat_timeout_s and fails over token-
+#     exact (zero lost futures), req_uid resubmit replays the cached
+#     terminal off the real replica's dedup ring (zero duplicate
+#     decodes), and a corrupted frame under CRC surfaces
+#     WireCorruptionError and retries clean — never wrong tokens
+#     (test_netchaos.py::test_chaos_process_fleet_survives_hostile_network;
+#     ad-hoc drills: tools/serving_bench.py --remote-fleet
+#     --netchaos-first "down:blackhole:0.1" or --netchaos
+#     "down:throttle:@1:512" for the slow-loris flavor, seeded via
+#     PADDLE_NETCHAOS_SEED)
 #   * black box: PADDLE_CHAOS_POINTS=step:kill:@4 under PADDLE_OBS_BLACKBOX
 #     kills a launched worker mid-step; the flight recorder's JSONL dump
 #     must carry the in-flight step event + all-thread stacks, and

@@ -569,6 +569,150 @@ def run_fleet(model, prompts, args):
         router.stop()
 
 
+def _scrape_counter(name):
+    """Sum a counter's label variants from this process's registry via the
+    exposition text — no private registry API needed."""
+    try:
+        from paddlepaddle_tpu.observability import to_prometheus_text
+
+        total = 0.0
+        for ln in to_prometheus_text().splitlines():
+            if ln.startswith(name) and not ln.startswith("#"):
+                try:
+                    total += float(ln.rsplit(None, 1)[-1])
+                except ValueError:
+                    pass
+        return total
+    except Exception:
+        return None
+
+
+_HEDGE_FROM_ARGS = object()      # sentinel: None must mean OFF (the A/B
+#   baseline leg), not "derive from --hedge"
+
+
+def run_remote_fleet(args, hedge_after=_HEDGE_FROM_ARGS):
+    """--remote-fleet: the fleet as REAL OS processes (one supervised
+    replica_main per replica over the C-API socket protocol), optionally
+    behind deterministic net-chaos proxies (--netchaos / --netchaos-first)
+    and with hedged requests armed (--hedge). Reports availability,
+    failover/retry/hedge/stall counts, per-point injection tallies — the
+    hostile-network drill as a reproducible bench row."""
+    from paddlepaddle_tpu.inference.remote_replica import (
+        ProcessReplicaFactory,
+    )
+    from paddlepaddle_tpu.inference.router import ServingRouter
+    from paddlepaddle_tpu.resilience.netchaos import NetChaosProxy
+
+    if hedge_after is _HEDGE_FROM_ARGS:
+        hedge_after = (None if args.hedge in (None, "off")
+                       else "auto" if args.hedge == "auto"
+                       else float(args.hedge))
+    factory = ProcessReplicaFactory(
+        preset=args.preset,
+        client_kw={"heartbeat_timeout_s": args.heartbeat_timeout})
+    clients = [factory(name=f"r{i}") for i in range(args.replicas)]
+    vocab = 128 if args.preset == "tiny" else 512
+    rng = np.random.default_rng(0)
+    # fixed prompt length: varying lengths would make the tail a
+    # compile-bucket lottery (fresh processes pay one prefill compile
+    # per shape), drowning the wire effects this row measures
+    prompts = [rng.integers(1, vocab, size=8).astype(np.int32)
+               for _ in range(args.reqs)]
+    for c in clients:
+        # warm each replica's compile caches BEFORE the chaos proxies
+        # arm (a warmup frame must not burn a scheduled @N hit) and
+        # outside the router, so the counters stay workload-only
+        try:
+            c.start()             # spawn the process now, not at probe
+            c.submit(prompts[0],
+                     max_new_tokens=args.new_tokens).result(120)
+        except Exception as e:  # noqa: BLE001 — warmup best-effort
+            sys.stderr.write(
+                f"  warmup {c.name}: {type(e).__name__}: {e}\n")
+    proxies = []
+    for i, c in enumerate(clients):
+        spec = args.netchaos or (args.netchaos_first if i == 0 else None)
+        if spec:
+            px = NetChaosProxy(c.address, specs=spec,
+                               seed=args.netchaos_seed,
+                               name=f"netchaos:{c.name}").start()
+            c._nc_proxy = px      # the client's PADDLE_NETCHAOS seam,
+            proxies.append(px)    # armed programmatically per replica
+    router = ServingRouter(clients, probe_interval_s=0.2,
+                           hedge_after_s=hedge_after,
+                           hedge_budget_pct=args.hedge_budget)
+    stalls0 = _scrape_counter("paddle_replica_stalls_total") or 0.0
+    router.start()
+    try:
+        t0 = time.perf_counter()
+        futs, submitted = [], 0
+        for p in prompts:
+            submitted += 1
+            try:
+                futs.append((p, router.submit(
+                    p, max_new_tokens=args.new_tokens)))
+            except Exception as e:  # noqa: BLE001 — availability metric
+                sys.stderr.write(
+                    f"  submit refused: {type(e).__name__}: {e}\n")
+            if args.pace:
+                # open-loop pacing: keep in-flight low so TTFT measures
+                # the wire/decode tail, not self-inflicted queue wait —
+                # the regime hedging exists for
+                time.sleep(args.pace)
+        completed = new_tokens = 0
+        for p, f in futs:
+            try:
+                out = f.result(600)
+            except Exception as e:  # noqa: BLE001 — availability metric
+                sys.stderr.write(
+                    f"  request failed: {type(e).__name__}: {e}\n")
+            else:
+                completed += 1
+                new_tokens += len(out) - len(p)
+        dt = time.perf_counter() - t0
+        h = router.health()["router"]
+        stalls = (_scrape_counter("paddle_replica_stalls_total")
+                  or 0.0) - stalls0
+        row = {"remote_fleet": True, "replicas": args.replicas,
+               "preset": args.preset,
+               "netchaos": args.netchaos or args.netchaos_first,
+               "netchaos_seed": args.netchaos_seed,
+               "hedge_after_s": (str(hedge_after)
+                                 if hedge_after is not None else "off"),
+               "aggregate_tok_s": round(new_tokens / max(dt, 1e-9), 1),
+               "wall_s": round(dt, 2),
+               "availability": round(completed / max(submitted, 1), 4),
+               "failovers": h["failovers"], "retries": h["retries"],
+               "hedges": h["hedges"], "hedge_wins": h["hedge_wins"],
+               "stalls": int(stalls)}
+        if proxies:
+            fires = {}
+            for px in proxies:
+                for point, n in px.fire_counts().items():
+                    fires[point] = fires.get(point, 0) + n
+            row["netchaos_fires"] = fires
+        row.update(slo_summary([f for _, f in futs]))
+        return row
+    finally:
+        router.stop()
+        for px in proxies:
+            px.stop()
+        for c in clients:
+            c.stop()
+
+
+def fmt_remote(row):
+    print(f"remote fleet x{row['replicas']} ({row['preset']})  "
+          f"availability={row['availability']:.3f}  "
+          f"failovers={row['failovers']}  stalls={row['stalls']}  "
+          f"hedges={row['hedges']} (wins={row['hedge_wins']})"
+          + (f"  netchaos={row['netchaos']} fires={row['netchaos_fires']}"
+             if row.get("netchaos") else ""))
+    print(f"  SLO: ttft p50={row['ttft_p50_ms']}ms "
+          f"p99={row['ttft_p99_ms']}ms  wall={row['wall_s']}s", flush=True)
+
+
 def run_traffic(model, prompts, args):
     """Open-loop profile against one engine, a fixed router fleet
     (--replicas N), or an AUTOSCALED fleet (--autoscale MIN:MAX arms a
@@ -777,6 +921,35 @@ def main():
                     "paged-reference vs paged-fused — whose "
                     "paged_chunk_overhead_pct (the r7 <=5% budget) "
                     "perf_gate gates lower-is-better")
+    ap.add_argument("--remote-fleet", action="store_true",
+                    help="run the --replicas fleet as REAL OS processes "
+                    "(supervised replica_main per replica over the C-API "
+                    "socket protocol) — the surface --netchaos and "
+                    "--hedge apply to")
+    ap.add_argument("--preset", choices=("tiny", "small"), default="tiny",
+                    help="replica_main model preset for --remote-fleet")
+    ap.add_argument("--netchaos", default=None, metavar="SPEC",
+                    help="deterministic net-fault proxy in front of EVERY "
+                    "replica (PADDLE_NETCHAOS grammar, e.g. "
+                    "'down:blackhole:@3' or 'down:delay:0.3:250'); "
+                    "requires --remote-fleet")
+    ap.add_argument("--netchaos-first", default=None, metavar="SPEC",
+                    help="like --netchaos but only replica r0 — the "
+                    "single-slow-replica tail profile hedging exists for")
+    ap.add_argument("--netchaos-seed", type=int, default=0)
+    ap.add_argument("--hedge", default="off",
+                    help="router hedge_after_s: 'off', 'auto' (observed "
+                    "TTFT p99 via tsdb), or seconds (e.g. 0.5)")
+    ap.add_argument("--hedge-budget", type=float, default=25.0,
+                    help="hedge budget as %% of submits (default 25)")
+    ap.add_argument("--hedge-ab", action="store_true",
+                    help="run the --remote-fleet workload twice — hedging "
+                    "off then --hedge — and report the TTFT p99 delta")
+    ap.add_argument("--heartbeat-timeout", type=float, default=2.0,
+                    help="client stall-watchdog seconds (default 2)")
+    ap.add_argument("--pace", type=float, default=0.0,
+                    help="--remote-fleet: sleep this many seconds between "
+                    "submits (open-loop pacing; 0 = submit all at once)")
     ap.add_argument("--hidden", type=int, default=1024)
     ap.add_argument("--layers", type=int, default=12)
     ap.add_argument("--max-len", type=int, default=2048)
@@ -786,6 +959,40 @@ def main():
                     "shape: the body plus a meta block with git sha + "
                     "unix stamp)")
     args = ap.parse_args()
+
+    if (args.netchaos or args.netchaos_first or args.hedge_ab) \
+            and not args.remote_fleet:
+        ap.error("--netchaos/--netchaos-first/--hedge-ab exercise the "
+                 "socket wire path; add --remote-fleet")
+    if args.remote_fleet:
+        # no local model: the replica processes build their own preset
+        body = {"remote_fleet": True, "replicas": args.replicas,
+                "requests": args.reqs,
+                "new_tokens_per_req": args.new_tokens}
+        if args.hedge_ab:
+            base = run_remote_fleet(args, hedge_after=None)
+            fmt_remote(base)
+            hedge_after = ("auto" if args.hedge == "auto"
+                           else float(args.hedge)
+                           if args.hedge not in (None, "off") else 0.5)
+            hedged = run_remote_fleet(args, hedge_after=hedge_after)
+            fmt_remote(hedged)
+            body["hedge_off"] = base
+            body["hedge_on"] = hedged
+            if base.get("ttft_p99_ms") and hedged.get("ttft_p99_ms"):
+                body["hedge_ttft_p99_improvement_pct"] = round(
+                    100.0 * (base["ttft_p99_ms"] - hedged["ttft_p99_ms"])
+                    / base["ttft_p99_ms"], 1)
+                print(f"hedge A/B: ttft p99 {base['ttft_p99_ms']}ms -> "
+                      f"{hedged['ttft_p99_ms']}ms "
+                      f"({body['hedge_ttft_p99_improvement_pct']:+.1f}%)",
+                      flush=True)
+        else:
+            row = run_remote_fleet(args)
+            fmt_remote(row)
+            body.update(row)
+        _emit(body, args)
+        return
 
     model = build_model(args)
     cfg = model.config
