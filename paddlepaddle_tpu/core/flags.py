@@ -212,6 +212,33 @@ define_flag("obs_perf", False,
             "measured MFU + roofline classification, and serve them as "
             "paddle_program_* gauges and the exporter's /programs endpoint",
             env="PADDLE_OBS_PERF")
+define_flag("obs_prof", False,
+            "arm the always-on sampling wall-clock profiler "
+            "(observability/profiler.py): a daemon thread samples "
+            "sys._current_frames() at obs_prof_hz into bounded per-second "
+            "folded-stack rings, categorized by serving seam (decode / "
+            "admission / router / wire / gc), served at /profile and "
+            "rank-merged at /fleet/profile", env="PADDLE_OBS_PROF")
+define_flag("obs_prof_hz", 50.0,
+            "sampling-profiler rate in samples per second; the overhead "
+            "gate (tools/check_obs_overhead.py gate 7) holds the default "
+            "under 5% on the dispatch microloop and serving fast path",
+            env="PADDLE_OBS_PROF_HZ")
+define_flag("obs_prof_window_s", 120.0,
+            "seconds of per-second folded-stack aggregation the profiler "
+            "keeps (bounded ring; flight-recorder dumps attach the last "
+            "~10s as hot_stacks)", env="PADDLE_OBS_PROF_WINDOW_S")
+define_flag("obs_memledger", False,
+            "arm the live memory ledger (observability/memledger.py): a "
+            "daemon thread attributes jax.live_arrays() into named buckets "
+            "(params, KV page pool, prefix-pinned, draft, workspace, "
+            "unattributed) every obs_memledger_interval_s, publishes "
+            "paddle_mem_* gauges (headroom rides the tsdb plane) and "
+            "reconciles PagePool accounting for page-leak detection",
+            env="PADDLE_OBS_MEMLEDGER")
+define_flag("obs_memledger_interval_s", 5.0,
+            "seconds between memory-ledger samples",
+            env="PADDLE_OBS_MEMLEDGER_INTERVAL_S")
 
 # Compile-cache family (core/compile_cache.py + inference/compile_plan.py):
 # persistent XLA compilation cache so warm-disk restarts skip backend

@@ -126,6 +126,22 @@ def _stamp(req, attr: str, value=None) -> None:
         pass
 
 
+def _account(kind: str, n: int) -> None:
+    """Goodput-ledger attribution (observability.goodput). The engine is
+    the SINGLE accounting point for decoded tokens: every token stamped
+    into ``stats["tokens_out"]`` lands here exactly once — as ``useful``/
+    ``overshoot`` at retirement or as a waste kind when the slot is
+    released without delivering. Never raises into decode."""
+    if n <= 0:
+        return
+    try:
+        from ..observability import goodput
+
+        goodput.account(kind, n)
+    except Exception:
+        pass
+
+
 class _Slot:
     __slots__ = ("req", "emitted", "budget", "spec_steps", "spec_accepted")
 
@@ -301,6 +317,15 @@ class BatchDecodeEngine:
         # serving a different program
         self.fused = self._resolve_fused(fused_kernels)
         self.compile_plan = _cp.CompilePlan.for_engine(self)
+        try:
+            # weak registration: the memory ledger attributes this
+            # engine's params/KV/draft buckets and reconciles its page
+            # pool for leaks — it must never extend the engine's lifetime
+            from ..observability import memledger as _memledger
+
+            _memledger.register_engine(self)
+        except Exception:
+            pass
         if bundle is not None:
             # never fatal: a stale/foreign bundle logs and falls back to
             # the lazy build path — a deploy with a bad artifact serves
@@ -1297,6 +1322,21 @@ class BatchDecodeEngine:
             eos = getattr(s.req, "eos_token_id", None)
             if eos is not None and eos in gen:
                 gen = gen[: gen.index(eos) + 1]   # trim past eos, keep it
+            res = getattr(s.req, "result", None)
+            if res is not None and getattr(res, "_event", None) is not None \
+                    and res._event.is_set():
+                # the future already has an outcome (a client cancel
+                # raced this chunk's retirement): the _set below will
+                # lose, nobody receives these tokens — attribute ALL of
+                # them to the cancel kind, not to useful
+                _account(getattr(res, "_cancel_kind", "cancel"),
+                         len(s.emitted))
+            else:
+                _account("useful", len(gen))
+                # tokens emitted past eos/budget and trimmed here: real
+                # decode work nobody receives (the spec chunk's tail,
+                # the chunk that overshot the budget)
+                _account("overshoot", len(s.emitted) - len(gen))
             _stamp(s.req, "_n_new", len(gen))
             if self.spec is not None:
                 # accepted counts ride the result future so slo()
@@ -1357,11 +1397,15 @@ class BatchDecodeEngine:
                 self._first_pending.pop(int(i), None)
                 self._release_kv(int(i))
 
-    def release_slot(self, slot: int):
+    def release_slot(self, slot: int, reason: str = "cancel"):
         """Free one slot without delivering a result — the cancellation /
         deadline path: the device lane goes inactive (no phantom compute),
         the host slot is recycled, and the next admission may reuse it. The
-        caller owns failing the request's future."""
+        caller owns failing the request's future. ``reason`` names the
+        goodput kind the slot's already-decoded tokens are wasted as."""
+        s = self._host_slots[int(slot)]
+        if s.req is not None:
+            _account(reason, len(s.emitted))
         self.reset_slots([slot])
         self._host_slots[int(slot)] = _Slot()
 
@@ -1417,6 +1461,10 @@ class BatchDecodeEngine:
             live = acc[slot][acc[slot] >= 0]
             s.spec_steps += int(live.size)
             s.spec_accepted += int(live.sum())
+            # drafted-but-rejected proposals: k drafted per live verify
+            # step minus the accepted run — real draft work the target
+            # never advanced past (outside the tokens_out identity)
+            _account("spec_rejected", int(k * live.size - live.sum()))
             tr = _trace_of(s.req)
             if tr is not None and live.size:
                 tr.event("spec.round", t0=t0, t1=time.perf_counter(),

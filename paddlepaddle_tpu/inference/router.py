@@ -547,7 +547,17 @@ class ServingRouter:
             if other is not None and other is not winner \
                     and not other.done():
                 try:
-                    other.cancel()
+                    # thread the goodput reason: the loser's decoded
+                    # tokens are hedge waste, not a client cancel. A
+                    # remote replica future's cancel() is a socket
+                    # disconnect with no reason channel — its replica
+                    # books the tokens as "cancel" on its own ledger.
+                    other.cancel(reason="hedge_loser")
+                except TypeError:
+                    try:
+                        other.cancel()
+                    except Exception:
+                        pass
                 except Exception:
                     pass
 

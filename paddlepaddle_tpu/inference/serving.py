@@ -113,6 +113,20 @@ _safe_inc = _rob_safe_inc
 _safe_set = _rob_safe_set
 
 
+def _goodput_account(kind: str, n: int) -> None:
+    """Goodput-ledger attribution for the serving-layer waste paths the
+    engine cannot see (a failed decode chunk's partial output, drain/stop
+    abandonment, static-batch delivery). Never raises."""
+    if n <= 0:
+        return
+    try:
+        from ..observability import goodput
+
+        goodput.account(kind, n)
+    except Exception:
+        pass
+
+
 class GenerationResult:
     """Future for one request. Carries the request's lifecycle timestamps
     (submit -> admit -> first token -> finish), stamped by the engine, so
@@ -130,6 +144,7 @@ class GenerationResult:
         self._output = None
         self._error: Optional[BaseException] = None
         self._cancelled = False
+        self._cancel_kind = "cancel"   # goodput kind a cancel wastes as
         self._callbacks: List = []     # run once, after the outcome is set
         self._obs_emit = True          # False: a wrapper future (router)
         #           whose replica-side inner future already feeds the SLO
@@ -162,13 +177,18 @@ class GenerationResult:
     def cancelled(self) -> bool:
         return self._cancelled
 
-    def cancel(self) -> bool:
+    def cancel(self, reason: str = "cancel") -> bool:
         """Cancel the request: the future fails with
         :class:`RequestCancelledError` immediately, a queued request is
         dropped at pop time, and an in-flight decode slot is released on
         the next scheduler cycle (the chip stops spending on it). Returns
-        True if the request had not already finished."""
+        True if the request had not already finished. ``reason`` names
+        the goodput kind the abandoned tokens are attributed to (the
+        router passes ``"hedge_loser"`` when reaping a hedge's loser);
+        it rides the future because the slot sweep that releases the
+        decode slot runs later, on the engine thread."""
         self._cancelled = True
+        self._cancel_kind = reason
         if self._event.is_set():
             return False
         self._set(error=RequestCancelledError("request cancelled by client"))
@@ -811,8 +831,19 @@ class ServingEngine:
             slo_burn = _rt.burn_snapshot()
         except Exception:
             slo_burn = {"enabled": False}
+        try:
+            from ..observability import goodput as _goodput
+
+            goodput_block = _goodput.snapshot()
+        except Exception:
+            goodput_block = {"kinds": {}}
         return {
             "state": state,
+            # useful-vs-wasted token ledger (observability.goodput): the
+            # remote-fleet bench sums this across replica healths to get
+            # fleet goodput_tok_s / waste_pct — a socket replica's ledger
+            # lives in ITS process, not the router's
+            "goodput": goodput_block,
             "mode": self.mode,
             # sliding-window SLO burn rate vs FLAGS_slo_{ttft,tpot}_ms —
             # the signal the SLO-driven autoscaler (ROADMAP item 5)
@@ -1011,9 +1042,13 @@ class ServingEngine:
         # block on a future no server will serve
         self._shed_waiting(shed_error)
         if self._engine is not None:
+            kind = ("drain" if isinstance(shed_error, EngineDrainingError)
+                    else "stop")
             for i, s in enumerate(self._engine._host_slots):
                 if s.req is not None and not s.req.result.done():
                     s.req.result._set(error=shed_error)
+                    # mid-flight output abandoned by the shutdown
+                    _goodput_account(kind, len(s.emitted))
                     self._engine._host_slots[i] = type(s)()
             self._engine.reset_slots()  # no phantom active device lanes
         if overran:
@@ -1233,6 +1268,8 @@ class ServingEngine:
         out = np.asarray(out.numpy())
         t_first = time.perf_counter()  # no streaming in static mode: the
         plen = leader.prompt_ids.shape[1]  # first token lands with the batch
+        lockstep = max(r.max_new_tokens for r in batch)
+        useful = overshoot = 0
         for i, req in enumerate(batch):
             row = out[i, : plen + req.max_new_tokens]
             req.result._t_first = t_first     # TTFT == full latency here
@@ -1243,11 +1280,19 @@ class ServingEngine:
             if eos is not None and eos in gen:  # don't count post-eos pad
                 gen = gen[: int(np.argmax(gen == eos)) + 1]
             req.result._n_new = len(gen)
+            # static batches decode max(max_new_tokens) for EVERY row in
+            # lockstep: the post-eos / past-budget tail is real decode
+            # work the caller never sees. Summed across the batch, two
+            # ledger calls total — accounting must not tax the fast path
+            useful += len(gen)
+            overshoot += lockstep - len(gen)
             tr = req.result._trace
             if tr is not None:
                 tr.event("decode.batch", t0=t_admit, t1=t_first,
                          tokens=len(gen))
             req.result._set(output=row)
+        _goodput_account("useful", useful)
+        _goodput_account("overshoot", overshoot)
 
     def _sweep_slots(self) -> None:
         """Release in-flight slots whose client departed (cancel) or whose
@@ -1259,14 +1304,15 @@ class ServingEngine:
             if req is None:
                 continue
             if req.result.done():       # cancelled (first outcome won)
-                eng.release_slot(i)
+                eng.release_slot(i, reason=getattr(
+                    req.result, "_cancel_kind", "cancel"))
                 self._bump("cancelled")
                 _safe_inc("paddle_serving_cancelled_total",
                           "requests cancelled by clients")
             elif req.deadline is not None and now >= req.deadline:
                 req.result._set(error=DeadlineExceededError(
                     "request deadline expired mid-decode"))
-                eng.release_slot(i)
+                eng.release_slot(i, reason="deadline")
                 self._bump("deadline_expired")
                 _safe_inc("paddle_serving_shed_total",
                           "requests shed by serving admission control, "
@@ -1324,6 +1370,10 @@ class ServingEngine:
                 for i, s in enumerate(eng._host_slots):
                     if s.req is not None:
                         s.req.result._set(error=e)
+                        # partial output discarded with the failed chunk:
+                        # wasted as retry_discard (the caller/router owns
+                        # any retry; the tokens are gone either way)
+                        _goodput_account("retry_discard", len(s.emitted))
                         eng._host_slots[i] = type(s)()
                 eng.reset_slots()  # clear phantom device lanes too
                 self._bump("batches_failed")

@@ -39,7 +39,7 @@ import os
 from typing import Optional
 
 from ..core import flags as _flags
-from . import flight, perf, reqtrace, watchdog
+from . import flight, goodput, memledger, perf, profiler, reqtrace, watchdog
 from .metrics import (  # noqa: F401
     BYTES_BUCKETS,
     LATENCY_BUCKETS,
@@ -393,13 +393,22 @@ def disable_history() -> None:
 
 def reset() -> None:
     """Clear the ring buffer, all metric values, watchdog state, the
-    perf plane (program costs + step timeline), and tear down the
-    history/alerting plane."""
+    perf plane (program costs + step timeline), the goodput ledger, and
+    tear down the history/alerting, profiler, and memory-ledger planes."""
     _recorder.clear()
     _registry.clear()
     watchdog.reset()
     perf.reset()
     reqtrace.reset()
+    goodput.reset()
+    try:
+        profiler.reset()
+    except Exception:
+        pass
+    try:
+        memledger.reset()
+    except Exception:
+        pass
     try:
         disable_history()
     except Exception:
@@ -708,6 +717,22 @@ if _flags.flag_value("obs_reqtrace"):
     except Exception:
         pass
 
+if _flags.flag_value("obs_prof"):
+    try:
+        profiler.enable()
+    except Exception as _e:
+        import sys as _sys
+
+        _sys.stderr.write(f"[obs] profiler autostart failed: {_e!r}\n")
+
+if _flags.flag_value("obs_memledger"):
+    try:
+        memledger.enable()
+    except Exception as _e:
+        import sys as _sys
+
+        _sys.stderr.write(f"[obs] memledger autostart failed: {_e!r}\n")
+
 if _flags.flag_value("obs_tsdb"):
     try:
         enable_history()
@@ -734,6 +759,7 @@ __all__ = [
     "enable", "disable", "reset", "is_enabled", "safe_inc", "safe_set",
     "get_recorder", "get_registry", "snapshot", "to_prometheus_text",
     "export_chrome_trace", "summary", "watchdog", "flight", "perf",
-    "reqtrace", "start_exporter", "stop_exporter",
+    "reqtrace", "profiler", "memledger", "goodput",
+    "start_exporter", "stop_exporter",
     "enable_history", "disable_history",
 ]

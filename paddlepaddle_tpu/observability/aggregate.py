@@ -74,6 +74,10 @@ def tsdb_key(rank: int) -> str:
     return f"obs/tsdb/rank{rank}"
 
 
+def prof_key(rank: int) -> str:
+    return f"obs/profile/rank{rank}"
+
+
 def _clock_sample() -> dict:
     return {"wall": time.time(), "perf": time.perf_counter()}
 
@@ -148,6 +152,16 @@ class FleetPublisher:
         if hist is not None:
             self.store.set(tsdb_key(self.rank), json.dumps(
                 {"wall": clock["wall"], "rank": self.rank, "tsdb": hist}))
+        # sampling-profiler hot stacks: published only when the profiler
+        # is armed — the key's absence tells the rank-0 merge "this rank
+        # does not profile", not "stale"
+        from . import profiler as _profiler
+
+        prof = _profiler.get()
+        if prof is not None:
+            self.store.set(prof_key(self.rank), json.dumps(
+                {"wall": clock["wall"], "rank": self.rank,
+                 "profile": prof.jsonable(seconds=None)}))
 
     def _publish_safe(self) -> None:
         try:
@@ -403,6 +417,57 @@ def collect_fleet_tsdb(store, world: int, local_rank: Optional[int] = None,
             "series_selector": selector, "ranks": ranks}
 
 
+def collect_fleet_profile(store, world: int,
+                          local_rank: Optional[int] = None,
+                          seconds: Optional[float] = None,
+                          top: int = 30) -> dict:
+    """The ``/fleet/profile`` body: every profiling rank's hot stacks
+    keyed by rank, plus a fleet-wide merge (summed category counts and
+    the top folded stacks across ranks — same-shape stacks on different
+    ranks add up, which is exactly what a fleet flamegraph wants)."""
+    from . import profiler as _profiler
+
+    now = time.time()
+    ranks: Dict[str, dict] = {}
+    for r in range(int(world)):
+        if local_rank is not None and r == int(local_rank):
+            prof = _profiler.get()
+            if prof is not None:
+                ranks[str(r)] = {"wall": now,
+                                 **prof.jsonable(seconds, top)}
+            continue
+        try:
+            if not store.check(prof_key(r)):
+                continue
+            doc = json.loads(store.get(prof_key(r)))
+        except Exception:
+            continue  # a dead rank must not fail the whole merge
+        ranks[str(r)] = {"wall": doc.get("wall"), **doc.get("profile", {})}
+    cats: Dict[str, int] = {}
+    stacks: Dict[str, int] = {}
+    for body in ranks.values():
+        for cat, n in (body.get("categories") or {}).items():
+            cats[cat] = cats.get(cat, 0) + int(n)
+        for row in body.get("top") or []:
+            stacks[row["stack"]] = (stacks.get(row["stack"], 0)
+                                    + int(row["samples"]))
+    total = sum(stacks.values())
+    # same ranking rule as SamplingProfiler.hot_stacks: burning stacks
+    # first, parked (idle) stacks after all of them regardless of count
+    ranked = sorted(stacks.items(),
+                    key=lambda kv: (kv[0].startswith("idle;"), -kv[1],
+                                    kv[0]))
+    merged_top = [{"stack": s, "samples": n,
+                   "category": s.split(";", 1)[0],
+                   "pct": round(100.0 * n / total, 2) if total else 0.0}
+                  for s, n in ranked[:max(top, 0)]]
+    return {"now": now, "world": int(world), "query_seconds": seconds,
+            "ranks": ranks,
+            "merged": {"categories": dict(
+                sorted(cats.items(), key=lambda kv: -kv[1])),
+                "top": merged_top}}
+
+
 def fleet_status(store, world: int) -> dict:
     """Who has published, and how stale — the ``/fleet/ranks`` body.
     Reads the few-dozen-byte clock anchor for the age, not the full
@@ -455,3 +520,16 @@ def install_fleet_routes(exporter, store, world: int,
             window_s)))
 
     exporter.register_param_route("/fleet/query", _fleet_query)
+
+    def _fleet_profile(params):
+        try:
+            seconds = (float(params["seconds"])
+                       if params.get("seconds") else None)
+            top = int(params["top"]) if params.get("top") else 30
+        except ValueError as e:
+            return (400, "application/json",
+                    json.dumps({"error": f"bad parameter: {e}"}))
+        return (200, "application/json", json.dumps(collect_fleet_profile(
+            store, world, local_rank, seconds, top), default=str))
+
+    exporter.register_param_route("/fleet/profile", _fleet_profile)

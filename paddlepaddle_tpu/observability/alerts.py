@@ -372,6 +372,32 @@ def default_rules() -> List[AlertRule]:
                         "and slow window (> ~1/min) — a partial partition "
                         "or a gray-failing replica, not a one-off blip"),
         AlertRule(
+            "waste_burn",
+            # the goodput plane's sliding-window waste share: sustained
+            # over-budget waste on both windows catches hedge storms and
+            # spec-rejection storms; a brief hedge burst (the fast window
+            # alone) is the feature working as designed, not an alert
+            [AlertCondition("paddle_goodput_waste_pct", 60.0, "avg",
+                            ">", 50.0),
+             AlertCondition("paddle_goodput_waste_pct", 300.0, "avg",
+                            ">", 50.0)],
+            for_s=0.0, severity="warn",
+            description="more than half the decoded tokens are wasted "
+                        "(hedge losers / spec rejects / retry discards) "
+                        "on both the fast and slow window — the fleet is "
+                        "burning chips on work nobody receives"),
+        AlertRule(
+            "hbm_headroom",
+            # published by the memory ledger ONLY on backends that report
+            # a device memory limit — on CPU the series never exists and
+            # the alert engine's absence-of-data rule keeps this silent
+            [AlertCondition("paddle_mem_headroom_ratio", 60.0, "avg",
+                            "<", 0.05)],
+            for_s=60.0, severity="page",
+            description="device memory headroom below 5% for a sustained "
+                        "minute — the next admission burst or compile "
+                        "workspace spike OOMs the chip"),
+        AlertRule(
             "fleet_snapshot_stale",
             [AlertCondition("paddle_fleet_snapshot_age_seconds", 60.0,
                             "last", ">", 3.0 * publish)],

@@ -169,6 +169,8 @@ class TelemetryExporter:
         self.register_route("/requests/trace", self._requests_trace)
         self.register_param_route("/query", self._query)
         self.register_route("/alerts", self._alerts)
+        self.register_param_route("/profile", self._profile)
+        self.register_route("/mem", self._mem)
 
     def _index(self):
         return 200, _JSON, json.dumps(
@@ -231,6 +233,53 @@ class TelemetryExporter:
         from . import alerts
 
         return alerts.alerts_body()
+
+    def _profile(self, params: Dict[str, str]):
+        """Sampling-profiler read side: ``/profile?seconds=&format=
+        collapsed|json&top=``; ``?device=<seconds>`` opens an on-demand
+        ``jax.profiler`` device-trace window instead and returns its
+        output directory."""
+        from . import profiler
+
+        prof = profiler.get()
+        if prof is None:
+            return 503, _JSON, json.dumps(
+                {"enabled": False,
+                 "error": "profiler not armed (set PADDLE_OBS_PROF=1 or "
+                          "call observability.profiler.enable())"})
+        try:
+            seconds = (float(params["seconds"])
+                       if params.get("seconds") else 10.0)
+            top = int(params["top"]) if params.get("top") else 30
+            device = (float(params["device"])
+                      if params.get("device") else None)
+        except ValueError as e:
+            return 400, _JSON, json.dumps({"error": f"bad parameter: {e}"})
+        if device is not None:
+            try:
+                outdir = prof.device_trace(seconds=device)
+            except Exception as e:
+                return 409, _JSON, json.dumps({"error": repr(e)})
+            return 200, _JSON, json.dumps(
+                {"device_trace": outdir, "seconds": device})
+        if params.get("format") == "collapsed":
+            return (200, "text/plain; charset=utf-8",
+                    prof.collapsed(seconds))
+        body = dict(prof.jsonable(seconds, top), enabled=True,
+                    rank=_rank())
+        return 200, _JSON, json.dumps(body, allow_nan=False, default=str)
+
+    def _mem(self):
+        """Memory-ledger read side: last bucketed sample + deltas. Takes
+        a fresh sample on demand so ``obsctl mem`` works without the
+        periodic thread armed."""
+        from . import memledger
+
+        try:
+            body = dict(memledger.sample_now(), rank=_rank())
+        except Exception as e:
+            return 503, _JSON, json.dumps({"error": repr(e)})
+        return 200, _JSON, json.dumps(body, allow_nan=False, default=str)
 
     def _healthz(self):
         from . import _metrics_on, _trace_on, _watchdog_on

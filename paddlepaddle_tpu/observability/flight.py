@@ -233,6 +233,24 @@ class FlightRecorder:
             })
         except Exception:
             pass
+        try:
+            # sampling-profiler context: the instantaneous stack snapshot
+            # below says where the process IS; the last ~10 s of hot
+            # folded stacks say where it has been SPENDING — the
+            # difference between "stuck here now" and "spinning here"
+            from . import profiler as _profiler
+
+            prof = _profiler.get()
+            if prof is not None:
+                lines.append({
+                    "rec": "hot_stacks",
+                    "window_s": 10.0,
+                    "hz": prof.hz,
+                    "categories": prof.categories(10.0),
+                    "stacks": prof.hot_stacks(10.0, 15),
+                })
+        except Exception:
+            pass
         lines.append({"rec": "stacks", "threads": self._stacks()})
         lines.append({"rec": "end", "events": len(events)})
         with open(path, "w") as f:

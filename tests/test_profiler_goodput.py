@@ -1,0 +1,437 @@
+"""Profiling & waste-attribution plane (observability/profiler.py,
+goodput.py, memledger.py + engine/serving accounting seams).
+
+The acceptance surface: the sampling profiler classifies stacks by seam
+and pins the decode seam as #1 under a decode-shaped load (rendered by
+``obsctl profile`` against a live exporter); the goodput ledger
+RECONCILES — through a chaos run with speculation, a mid-flight
+hedge-loser cancel and a hard stop, useful + attributed waste equals the
+engine's ``tokens_out`` EXACTLY and zero KV pages leak; the memory
+ledger buckets live HBM and the default ruleset grows ``waste_burn`` +
+``hbm_headroom``. The prof-on hot-path budgets live in
+tools/check_obs_overhead.py (gate 7) and tools/check_serving_overhead.py
+(prof-on leg), not here.
+"""
+
+import json
+import os
+import queue
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddlepaddle_tpu as paddle
+import paddlepaddle_tpu.observability as obs
+from paddlepaddle_tpu.observability import (
+    aggregate,
+    exporter,
+    flight,
+    goodput,
+    memledger,
+    profiler,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_obsctl():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "obsctl", os.path.join(_REPO, "tools", "obsctl.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture
+def clean_planes():
+    """Goodput/profiler/memledger singletons fully reset before AND
+    after — the goodput ledger is global and accumulates across suites."""
+    goodput.reset()
+    profiler.reset()
+    memledger.reset()
+    flight.disable()
+    exporter.stop()
+    yield
+    goodput.reset()
+    profiler.reset()
+    memledger.reset()
+    flight.disable()
+    exporter.stop()
+    obs.disable()
+    obs.reset()
+
+
+def _get(url, timeout=10):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+# ---------------------------------------------------------------------------
+# goodput ledger units
+# ---------------------------------------------------------------------------
+
+def test_goodput_ledger_counts_and_window(clean_planes):
+    led = goodput.GoodputLedger(window_s=60.0)
+    led.account("useful", 90, now=1000.0)
+    led.account("hedge_loser", 10, now=1001.0)
+    snap_window = led.waste_pct(now=1002.0)
+    assert snap_window == pytest.approx(10.0)
+    # the old events age out of the window; cumulative counts do not
+    led.account("useful", 5, now=2000.0)
+    assert led.waste_pct(now=2000.0) == pytest.approx(0.0)
+    snap = led.snapshot()
+    assert snap["kinds"]["useful"] == 95
+    assert snap["kinds"]["hedge_loser"] == 10
+    assert snap["decoded_tokens"] == 105
+    assert snap["waste_pct"] == pytest.approx(100.0 * 10 / 105, abs=0.01)
+    with pytest.raises(ValueError):
+        led.account("not_a_kind", 1)
+    # the module-level seam never raises (it guards the engine hot path)
+    goodput.account("not_a_kind", 1)
+    led.reset()
+    assert led.snapshot()["decoded_tokens"] == 0
+    assert led.waste_pct(now=3000.0) is None
+
+
+def test_goodput_spec_rejected_outside_decoded_identity(clean_planes):
+    led = goodput.GoodputLedger()
+    led.account("useful", 10)
+    led.account("spec_rejected", 7)
+    snap = led.snapshot()
+    assert snap["decoded_tokens"] == 10      # drafts never hit tokens_out
+    assert snap["wasted_tokens"] == 7        # ... but they are real waste
+    assert set(goodput.DECODED_KINDS) == set(goodput.KINDS) - {
+        "spec_rejected"}
+
+
+# ---------------------------------------------------------------------------
+# sampling profiler: classification + decode-seam pin
+# ---------------------------------------------------------------------------
+
+def test_classify_seams_and_idle_innermost_only():
+    assert profiler.classify(
+        [("decode_engine.py", "_decode_chunk")]) == "decode"
+    assert profiler.classify([("serving.py", "_sweep_slots")]) == "admission"
+    assert profiler.classify([("router.py", "_maybe_hedge")]) == "router"
+    assert profiler.classify([("socket.py", "recv_into")]) == "wire"
+    assert profiler.classify([("threading.py", "wait")]) == "idle"
+    # idle matches the INNERMOST frame only: an engine frame above a
+    # helper's wait() still reads as decode, not idle
+    assert profiler.classify(
+        [("speculative.py", "_spec_chunk"), ("threading.py", "wait")]
+    ) == "decode"
+    assert profiler.classify([("mymodule.py", "work")]) == "other"
+
+
+def _busy_decode_thread():
+    """A thread whose hot frame is literally named like the engine's
+    decode seam — the synthetic load the decode-seam pin samples."""
+    stop = threading.Event()
+
+    def _decode_chunk():        # the name IS the classification input
+        while not stop.is_set():
+            sum(range(200))
+
+    t = threading.Thread(target=_decode_chunk, daemon=True,
+                         name="fake-decode")
+    t.start()
+    return stop, t
+
+
+def test_profiler_pins_decode_seam_hot(clean_planes):
+    stop, t = _busy_decode_thread()
+    blocked = threading.Thread(target=queue.Queue().get, daemon=True,
+                               name="parked")
+    blocked.start()
+    try:
+        prof = profiler.SamplingProfiler(hz=50.0, window_s=60.0)
+        for _ in range(40):
+            prof.sample_once()
+        rows = prof.hot_stacks(seconds=60.0, n=10)
+        assert rows, "no stacks sampled"
+        assert rows[0]["category"] == "decode"
+        assert rows[0]["thread"] == "fake-decode"
+        assert rows[0]["leaf"].endswith(":_decode_chunk")
+        cats = prof.categories(60.0)
+        assert cats.get("decode", 0) >= 40       # every tick saw it
+        assert cats.get("idle", 0) >= 1          # the parked thread
+        # flamegraph-ready collapsed: "folded;stack count" lines
+        coll = prof.collapsed()
+        line = next(ln for ln in coll.splitlines()
+                    if ln.startswith("decode;fake-decode;"))
+        assert int(line.rsplit(" ", 1)[1]) >= 40
+        j = prof.jsonable(seconds=60.0, n=5)
+        assert j["samples"] >= 40 and j["ticks"] == 40
+        assert j["top"][0]["category"] == "decode"
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+
+def test_profiler_enable_disable_idempotent(clean_planes):
+    p1 = profiler.enable(hz=200.0, start_thread=False)
+    assert profiler.enable(start_thread=False) is p1
+    assert profiler.get() is p1
+    profiler.disable()
+    assert profiler.get() is None
+
+
+# ---------------------------------------------------------------------------
+# /profile + /mem endpoints and obsctl rendering
+# ---------------------------------------------------------------------------
+
+def test_profile_endpoint_503_when_off_then_serves(clean_planes, tmp_path,
+                                                   capsys):
+    obsctl = _load_obsctl()
+    stop, t = _busy_decode_thread()
+    try:
+        with exporter.TelemetryExporter(port=0) as e:
+            status, body = _get(e.url("/profile"))
+            assert status == 503
+            assert json.loads(body)["enabled"] is False
+
+            prof = profiler.enable(start_thread=False)
+            for _ in range(25):
+                prof.sample_once()
+
+            status, body = _get(e.url("/profile?seconds=120&top=5"))
+            assert status == 200
+            doc = json.loads(body)
+            assert doc["enabled"] is True
+            assert doc["top"][0]["category"] == "decode"
+
+            status, body = _get(
+                e.url("/profile?seconds=120&format=collapsed"))
+            assert status == 200
+            assert b"decode;fake-decode;" in body
+
+            status, _ = _get(e.url("/profile?seconds=bogus"))
+            assert status == 400
+
+            # obsctl profile: the operator's top-N hot-stack table
+            target = f"127.0.0.1:{e.port}"
+            out_file = str(tmp_path / "stacks.collapsed")
+            assert obsctl.main(["profile", target, "-s", "120", "-n", "5",
+                                "--collapsed", out_file]) == 0
+            rendered = capsys.readouterr().out
+            first_row = next(ln for ln in rendered.splitlines()
+                             if ln.strip().startswith("1 "))
+            assert "decode" in first_row and "_decode_chunk" in first_row
+            with open(out_file) as f:
+                assert "decode;fake-decode;" in f.read()
+
+            # /mem + obsctl mem: one-shot ledger sample (no engines here —
+            # buckets may be zero, but the endpoint and table must work)
+            status, body = _get(e.url("/mem"))
+            assert status == 200
+            doc = json.loads(body)
+            assert doc["sampled"] is True
+            assert set(doc["buckets"]) == set(memledger.BUCKETS)
+            assert obsctl.main(["mem", target]) == 0
+            assert "bucket" in capsys.readouterr().out
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+
+def test_fleet_profile_merges_ranks(clean_planes):
+    from paddlepaddle_tpu.distributed.store import TCPStore
+
+    stop, t = _busy_decode_thread()
+    try:
+        prof = profiler.enable(start_thread=False)
+        for _ in range(10):
+            prof.sample_once()
+        store = TCPStore("127.0.0.1", 0, is_master=True)
+        for rank in (0, 1):
+            aggregate.FleetPublisher(store, rank=rank, interval_s=60,
+                                     text_fn=lambda: "").publish()
+        doc = aggregate.collect_fleet_profile(store, world=2)
+        assert set(doc["ranks"]) == {"0", "1"}
+        merged = doc["merged"]
+        # identical folded stacks sum across ranks: 2x the local count
+        local = prof.categories(None).get("decode", 0)
+        assert merged["categories"]["decode"] == 2 * local
+        assert merged["top"][0]["category"] == "decode"
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# memory ledger units
+# ---------------------------------------------------------------------------
+
+def test_memledger_leak_check_nonpaged_is_zero(clean_planes):
+    class _Eng:
+        kv_layout = "contiguous"
+
+    assert memledger.leak_check(_Eng())["leaked_pages"] == 0
+
+
+def test_memledger_sample_and_deltas(clean_planes):
+    led = memledger.MemoryLedger(interval_s=5.0)
+    s = led.sample()
+    assert set(s["buckets"]) == set(memledger.BUCKETS)
+    led.sample()
+    j = led.jsonable()
+    assert j["sampled"] is True
+    assert set(j["deltas"]) == set(memledger.BUCKETS)
+    # gauges rode the registry
+    txt = obs.to_prometheus_text()
+    assert 'paddle_mem_bytes{bucket="params"}' in txt
+    assert "paddle_mem_leaked_pages" in txt
+
+
+# ---------------------------------------------------------------------------
+# default alert rules + perf_gate + flight dump satellites
+# ---------------------------------------------------------------------------
+
+def test_default_rules_grow_waste_burn_and_hbm_headroom():
+    from paddlepaddle_tpu.observability.alerts import default_rules
+
+    rules = {r.name: r for r in default_rules()}
+    wb = rules["waste_burn"]
+    assert wb.severity == "warn"
+    assert {c.series for c in wb.conditions} == {"paddle_goodput_waste_pct"}
+    assert {c.window_s for c in wb.conditions} == {60.0, 300.0}  # fast+slow
+    hh = rules["hbm_headroom"]
+    assert hh.severity == "page"
+    assert [c.series for c in hh.conditions] == ["paddle_mem_headroom_ratio"]
+    assert hh.conditions[0].op == "<"
+
+
+def test_perf_gate_maps_goodput_fields():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "perf_gate", os.path.join(_REPO, "tools", "perf_gate.py"))
+    pg = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pg)
+    HIGHER, LOWER, serving_metrics = pg.HIGHER, pg.LOWER, pg.serving_metrics
+    m = serving_metrics({"serving_bench": {
+        "goodput_tok_s": 123.4, "waste_pct": 2.5,
+        "spec": {"goodput_tok_s": 150.0, "waste_pct": 20.0}}})
+    assert m["serving.goodput_tok_s"] == (123.4, HIGHER)
+    assert m["serving.waste_pct"] == (2.5, LOWER)
+    assert m["serving.spec_goodput_tok_s"] == (150.0, HIGHER)
+    assert m["serving.spec_waste_pct"] == (20.0, LOWER)
+
+
+def test_flight_dump_carries_hot_stacks(clean_planes, tmp_path):
+    stop, t = _busy_decode_thread()
+    try:
+        prof = profiler.enable(start_thread=False)
+        for _ in range(12):
+            prof.sample_once()
+        flight.enable(str(tmp_path), install_hooks=False)
+        path = flight.dump("profiler_test")
+        recs = [json.loads(ln) for ln in open(path)]
+        (hot,) = [r for r in recs if r["rec"] == "hot_stacks"]
+        assert hot["hz"] == prof.hz
+        assert hot["categories"].get("decode", 0) > 0
+        assert hot["stacks"][0]["category"] == "decode"
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# THE reconciliation drill: speculation + mid-flight hedge-loser cancel +
+# hard stop — every decoded token attributed exactly once, zero leaked pages
+# ---------------------------------------------------------------------------
+
+def _llama(hidden=64, layers=2, vocab=128, max_len=96, dtype="bfloat16"):
+    from paddlepaddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    return LlamaForCausalLM(LlamaConfig(
+        vocab_size=vocab, hidden_size=hidden, intermediate_size=hidden * 3,
+        num_hidden_layers=layers, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=max_len,
+        dtype=dtype))
+
+
+def test_chaos_goodput_reconciles_exactly_with_spec_and_cancel(clean_planes):
+    from paddlepaddle_tpu.inference import ServingEngine
+
+    paddle.seed(0)
+    target = _llama()
+    paddle.seed(7)
+    draft = _llama(hidden=32)   # weak independent draft: rejections happen
+
+    rng = np.random.default_rng(3)
+    eng = ServingEngine(target, max_batch_size=3, decode_chunk=8,
+                        kv_page_size=16, draft=draft, spec_k=2)
+    eng.start()
+    inner = eng._engine
+    # one request completes cleanly (useful tokens, trimmed retirement)
+    eng.submit(rng.integers(0, 128, (5,)).astype(np.int32),
+               max_new_tokens=6).result(300)
+    # three long requests fill every slot
+    futs = [eng.submit(rng.integers(0, 128, (p,)).astype(np.int32),
+                       max_new_tokens=60)
+            for p in (7, 11, 9)]
+    deadline = time.time() + 120
+    while inner.stats["tokens_out"] < 30 and time.time() < deadline:
+        time.sleep(0.02)
+    assert inner.stats["tokens_out"] >= 30, "engine never reached mid-decode"
+    futs[0].cancel(reason="hedge_loser")     # a hedge twin won elsewhere
+    before = inner.stats["tokens_out"]
+    deadline = time.time() + 120
+    while inner.stats["tokens_out"] < before + 10 and time.time() < deadline:
+        time.sleep(0.01)
+    eng.stop()           # abandons whatever is still mid-flight ("stop")
+
+    snap = goodput.snapshot()
+    # THE identity: every decoded token attributed to exactly one kind.
+    # Not >=, not approximately — exactly.
+    assert snap["decoded_tokens"] == inner.stats["tokens_out"], snap
+    assert snap["kinds"]["useful"] > 0
+    assert snap["kinds"]["hedge_loser"] > 0    # cancel reason threaded thru
+    assert snap["kinds"]["stop"] > 0           # stop abandoned live slots
+    assert snap["kinds"]["spec_rejected"] > 0  # weak draft was rejected
+    assert snap["waste_pct"] > 0
+
+    # zero leaked KV pages: pool used == slot-owned + prefix-pinned
+    lk = memledger.leak_check(inner)
+    assert lk["leaked_pages"] == 0, lk
+
+    # the memory ledger attributes this engine's buckets
+    s = memledger.MemoryLedger().sample()
+    assert s["engines"] >= 1
+    assert s["buckets"]["params"] > 0
+    assert s["buckets"]["kv_pages"] > 0
+    assert s["buckets"]["draft"] > 0
+    assert s["leaked_pages"] == 0
+
+    # the series are first-class on the registry
+    txt = obs.to_prometheus_text()
+    assert 'paddle_goodput_tokens_total{kind="useful"}' in txt
+    assert "paddle_goodput_waste_pct" in txt
+    assert 'paddle_mem_bytes{bucket="params"}' in txt
+
+    # health() surfaces the block the bench/fleet sums
+    gp = eng.health()["goodput"]
+    assert gp["kinds"] == snap["kinds"]
+
+
+def test_deadline_and_retry_reasons_reach_ledger(clean_planes):
+    """The serving sweep threads distinct reasons through release_slot —
+    unit-level, no real engine: release_slot's accounting is the single
+    point remote/local cancels and deadline sweeps converge on."""
+    from paddlepaddle_tpu.inference.serving import GenerationResult
+
+    res = GenerationResult()
+    assert res._cancel_kind == "cancel"        # disconnect-shaped default
+    res.cancel(reason="hedge_loser")
+    assert res._cancel_kind == "hedge_loser"
+    assert res.cancelled()

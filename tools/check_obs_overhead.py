@@ -27,6 +27,12 @@ the instrumented entry point (``apply_op``) vs the uninstrumented inner
    ``interval_s`` (with the alert engine riding the same tick), so the
    per-op dispatch path pays nothing but the live sampler thread's
    background noise — which must stay under the same budget.
+7. **sampling profiler armed** — ``PADDLE_OBS_PROF`` at the default
+   rate: the wall-clock profiler walks ``sys._current_frames()`` on its
+   own daemon thread; the dispatched op pays nothing directly, but the
+   GIL time the walker steals is real — the whole point of gating it is
+   proving always-on profiling is viable on the hot path (same <5%
+   budget).
 
 A journey-record microbench is printed for information (the per-request
 cost of mint + a typical span set + finish with reqtrace armed) but not
@@ -275,6 +281,17 @@ def main() -> int:
                                 setup=lambda: obs.enable_history(
                                     interval_s=0.1),
                                 teardown=obs.disable_history),
+                args.ops, args.budget)
+
+    # gate 7: always-on sampling profiler at the default rate — the
+    # stack walker runs on its own thread, so what this bounds is the
+    # GIL share it steals from the dispatch loop
+    from paddlepaddle_tpu.observability import profiler
+
+    rc |= _gate("prof-on",
+                lambda: measure(args.ops, args.repeats,
+                                setup=lambda: profiler.enable(),
+                                teardown=profiler.disable),
                 args.ops, args.budget)
 
     _step_bracket_info()

@@ -10,6 +10,11 @@ collect + decode loop), both over a fake model whose decode costs exactly
 0.5ms per batch (the floor of a real tiny-model step), and FAILS (exit 1)
 if the median paired end-to-end latency ratio exceeds the budget.
 
+A second leg repeats the pairing with the always-on sampling profiler
+armed at its default rate around the CURRENT engine only — always-on
+profiling must fit inside the same <5% serving budget, or it is not
+always-on.
+
 Usage:  python tools/check_serving_overhead.py [--requests 64]
             [--budget 0.05] [--repeats 7]
 
@@ -183,24 +188,59 @@ def main() -> int:
         return SeedStaticEngine(TinyDecodeModel(), max_batch_size=8,
                                 max_wait_ms=20.0)
 
+    def _paired(tag, setup=None, teardown=None):
+        """Median paired latency ratio over ``repeats`` rounds;
+        setup/teardown bracket only the CURRENT engine's rounds so the
+        seed replica is always the no-telemetry baseline. One retry on
+        failure (noise filter, same policy as check_obs_overhead)."""
+        def one():
+            rounds = []
+            for _ in range(args.repeats):
+                if setup is not None:
+                    setup()
+                try:
+                    a = _run_bursts(current, args.requests, args.bursts)
+                finally:
+                    if teardown is not None:
+                        teardown()
+                rounds.append((a, _run_bursts(seed, args.requests,
+                                              args.bursts)))
+            overhead = statistics.median(a / b for a, b in rounds) - 1.0
+            cur = min(a for a, _ in rounds)
+            base = min(b for _, b in rounds)
+            print(f"[{tag}] {args.requests}-request burst: "
+                  f"current={cur * 1e3:.1f}ms "
+                  f"seed-replica={base * 1e3:.1f}ms "
+                  f"median-paired overhead={overhead:+.2%}, "
+                  f"budget {args.budget:.0%}")
+            return overhead
+
+        overhead = one()
+        if overhead >= args.budget:
+            print(f"[{tag}] over budget; retrying once (noise filter)")
+            overhead = one()
+        if overhead >= args.budget:
+            print(f"FAIL[{tag}]: serving fast path overhead "
+                  f"{overhead:.2%} >= {args.budget:.0%} budget",
+                  file=sys.stderr)
+            return 1
+        return 0
+
     _run_bursts(current, args.requests, 3)   # warm both paths (thread
     _run_bursts(seed, args.requests, 3)      # spawn, allocator, imports)
-    rounds = [(_run_bursts(current, args.requests, args.bursts),
-               _run_bursts(seed, args.requests, args.bursts))
-              for _ in range(args.repeats)]
-    overhead = statistics.median(a / b for a, b in rounds) - 1.0
-    cur = min(a for a, _ in rounds)
-    base = min(b for _, b in rounds)
-    print(f"{args.requests}-request burst: current={cur * 1e3:.1f}ms "
-          f"seed-replica={base * 1e3:.1f}ms "
-          f"median-paired overhead={overhead:+.2%}, "
-          f"budget {args.budget:.0%}")
-    if overhead >= args.budget:
-        print(f"FAIL: no-limits serving fast path overhead {overhead:.2%} "
-              f">= {args.budget:.0%} budget", file=sys.stderr)
-        return 1
-    print("OK")
-    return 0
+
+    rc = _paired("no-limits")
+
+    # leg 2: sampling profiler armed at its default rate while the
+    # current engine serves — the stack walker's GIL share must fit in
+    # the same budget for "always-on" to be honest
+    from paddlepaddle_tpu.observability import profiler
+
+    rc |= _paired("prof-on", setup=lambda: profiler.enable(),
+                  teardown=profiler.disable)
+
+    print("OK" if rc == 0 else "FAIL", flush=True)
+    return rc
 
 
 if __name__ == "__main__":

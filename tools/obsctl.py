@@ -55,10 +55,25 @@ Subcommands:
       reason, rollout state/version (incl. rollback reasons), SLO burn
       readings, and the per-replica rotation/breaker/version table.
 
+  profile TARGET [-s SECONDS] [-n TOP] [--fleet] [--collapsed OUT]
+      Render the sampling profiler's hot-stack table (/profile, or
+      rank-0's merged /fleet/profile with --fleet): category totals then
+      the top-N folded stacks by sample share. --collapsed writes the
+      flamegraph-ready collapsed file (feed to inferno / flamegraph.pl /
+      speedscope); --device SECONDS opens an on-demand jax.profiler
+      device-trace window and prints its output directory.
+
+  mem TARGET
+      Render the memory ledger's bucketed attribution (/mem): bytes per
+      bucket (params, kv_pages, prefix_pinned, draft, workspace,
+      unattributed), delta since the previous sample, headroom ratio and
+      the KV page-leak reconciliation.
+
   blackbox tail [--dir DIR] [-n N] [--raw]
       Render the newest flight-recorder dump in DIR (default:
       $PADDLE_OBS_BLACKBOX_DIR or <tmpdir>/paddle_blackbox): header, the
-      last N events, in-flight steps/tasks, and thread-stack summaries.
+      last N events, in-flight steps/tasks, thread-stack summaries, and
+      the profiler's last-10s hot stacks when one was armed.
 
 `scrape`, `programs`, `fleet`, `query`, `alerts`, `top` and `blackbox
 tail` are stdlib-only (fast,
@@ -623,6 +638,127 @@ def cmd_alerts(args) -> int:
     return 0
 
 
+# -- profile / mem -----------------------------------------------------------
+
+def cmd_profile(args) -> int:
+    """Render the sampling profiler's top-N hot stacks; --collapsed
+    writes the flamegraph-ready file, --fleet merges across ranks."""
+    if args.device:
+        from urllib.parse import urlencode
+
+        q = urlencode({"device": str(args.device)})
+        status, doc = _get_json(args.target, f"/profile?{q}", args.timeout)
+        if status != 200:
+            sys.stderr.write(f"[obsctl] device trace failed: {doc}\n")
+            return 1
+        print(f"[profile] device trace written: {doc.get('device_trace')} "
+              f"({args.device:g}s window; open in TensorBoard/Perfetto)")
+        return 0
+    from urllib.parse import urlencode
+
+    q = urlencode({"seconds": str(args.seconds), "top": str(args.top)})
+    path = ("/fleet/profile" if args.fleet else "/profile") + "?" + q
+    status, doc = _get_json(args.target, path, args.timeout)
+    if status == 503 or not (doc.get("enabled", True)
+                             or args.fleet):
+        print(f"[profile] {args.target}: profiler off — arm "
+              "PADDLE_OBS_PROF=1 or observability.profiler.enable()")
+        return 1
+    if status != 200:
+        sys.stderr.write(f"[obsctl] /profile failed ({status}): {doc}\n")
+        return 1
+    if args.json:
+        print(json.dumps(doc, indent=2))
+        return 0
+    if args.fleet:
+        body = doc.get("merged") or {}
+        ranks = doc.get("ranks") or {}
+        print(f"[profile] fleet merge — {args.target}  "
+              f"ranks={len(ranks)}/{doc.get('world')}  "
+              f"window={args.seconds:g}s")
+    else:
+        body = doc
+        print(f"[profile] {args.target}  hz={doc.get('hz')}  "
+              f"samples={doc.get('samples')}  window={args.seconds:g}s  "
+              f"uptime={doc.get('uptime_s')}s")
+    cats = body.get("categories") or {}
+    total = sum(cats.values()) or 1
+    if cats:
+        print("  seams: " + "  ".join(
+            f"{c}={n} ({100.0 * n / total:.1f}%)"
+            for c, n in cats.items()))
+    rows = body.get("top") or []
+    if not rows:
+        print("  (no samples yet)")
+        return 0
+    print(f"  {'#':>3} {'pct':>6} {'samples':>8} {'seam':<10} "
+          f"{'thread':<16} leaf")
+    for i, r in enumerate(rows):
+        stack = r.get("stack", "")
+        parts = stack.split(";")
+        thread = r.get("thread") or (parts[1] if len(parts) > 1 else "?")
+        leaf = r.get("leaf") or (parts[-1] if parts else "?")
+        print(f"  {i + 1:>3} {r.get('pct', 0):>5.1f}% "
+              f"{r.get('samples', 0):>8} {r.get('category', '?'):<10} "
+              f"{thread[:16]:<16} {leaf}")
+    if args.collapsed:
+        q = urlencode({"seconds": str(args.seconds),
+                       "format": "collapsed"})
+        status, raw = _get(args.target, f"/profile?{q}", args.timeout)
+        if status != 200:
+            sys.stderr.write(f"[obsctl] collapsed fetch failed: "
+                             f"{status}\n")
+            return 1
+        with open(args.collapsed, "wb") as f:
+            f.write(raw if isinstance(raw, bytes) else raw.encode())
+        print(f"  collapsed stacks written: {args.collapsed} "
+              f"(flamegraph.pl / inferno / speedscope)")
+    return 0
+
+
+def _fmt_mem(n) -> str:
+    n = float(n or 0)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n:.1f}GiB"
+
+
+def cmd_mem(args) -> int:
+    """Render the memory ledger's bucketed attribution with deltas."""
+    status, doc = _get_json(args.target, "/mem", args.timeout)
+    if status != 200:
+        sys.stderr.write(f"[obsctl] /mem failed ({status}): {doc}\n")
+        return 1
+    if args.json:
+        print(json.dumps(doc, indent=2))
+        return 0
+    if not doc.get("sampled"):
+        print(f"[mem] {args.target}: no sample yet")
+        return 1
+    buckets = doc.get("buckets") or {}
+    deltas = doc.get("deltas") or {}
+    total = sum(buckets.values()) or 1
+    head = f"[mem] {args.target}  engines={doc.get('engines')}"
+    hr = doc.get("headroom_ratio")
+    if hr is not None:
+        head += (f"  headroom={100.0 * hr:.1f}% of "
+                 f"{_fmt_mem(doc.get('device_bytes_limit'))}")
+    print(head)
+    print(f"  {'bucket':<14}{'bytes':>12}{'share':>8}{'delta':>12}")
+    for b, v in sorted(buckets.items(), key=lambda kv: -kv[1]):
+        d = deltas.get(b)
+        print(f"  {b:<14}{_fmt_mem(v):>12}{100.0 * v / total:>7.1f}%"
+              f"{('-' if d is None else _fmt_mem(d)):>12}")
+    print(f"  live arrays: {_fmt_mem(doc.get('live_array_bytes'))}  "
+          f"leaked KV pages: {doc.get('leaked_pages')}")
+    if doc.get("leaked_pages"):
+        print("  WARNING: page pool holds pages no slot or prefix owns — "
+              "a release path is leaking")
+    return 0
+
+
 def _top_frame(args) -> list:
     """One rendered frame of `obsctl top` as a list of lines."""
     lines = []
@@ -656,6 +792,47 @@ def _top_frame(args) -> list:
                          "none firing")
     else:
         lines.append("  alerts: engine off (PADDLE_OBS_TSDB=1 to arm)")
+
+    # goodput / HBM strip: cumulative waste from any serving provider's
+    # health block, window sparklines from the history plane when armed
+    gp = None
+    for prov in provs.values():
+        if isinstance(prov, dict) and isinstance(prov.get("goodput"), dict) \
+                and prov["goodput"].get("kinds"):
+            gp = prov["goodput"]
+            break
+    try:
+        from urllib.parse import urlencode
+
+        q = urlencode({"series": "paddle_goodput_waste_pct",
+                       "window": str(args.window)})
+        _s, wdoc = _get_json(args.target, f"/query?{q}", args.timeout)
+        q = urlencode({"series": "paddle_mem_headroom_ratio",
+                       "window": str(args.window)})
+        _s, hdoc = _get_json(args.target, f"/query?{q}", args.timeout)
+    except Exception:
+        wdoc, hdoc = {}, {}
+
+    def _pts(doc):
+        for s in doc.get("series") or []:
+            return [p[1] for p in s.get("points") or []]
+        return []
+
+    wpts, hpts = _pts(wdoc), _pts(hdoc)
+    if gp is not None or wpts or hpts:
+        parts = []
+        if gp is not None:
+            parts.append(f"useful={_fnum(gp.get('useful_tokens'))}tok "
+                         f"wasted={_fnum(gp.get('wasted_tokens'))}tok "
+                         f"waste={gp.get('waste_pct', 0):.1f}%")
+        if wpts:
+            parts.append(f"waste%[{args.window:g}s] {wpts[-1]:.1f} "
+                         f"{_spark(wpts)}")
+        lines.append("  goodput: " + "  ".join(parts)
+                     if parts else "  goodput: (no tokens yet)")
+        if hpts:
+            lines.append(f"  hbm: headroom {100.0 * hpts[-1]:.1f}%  "
+                         f"{_spark(hpts)}")
 
     # fleet census + rollout (from the fleet /healthz provider, if any)
     fleet = None
@@ -813,6 +990,16 @@ def _render_blackbox(path: str, last_n: int) -> None:
         for t in infl.get("tasks", []):
             print(f"  in-flight task: {t.get('name')} "
                   f"group={t.get('group')} {t.get('elapsed_s')}s")
+    for hot in by_rec.get("hot_stacks", []):
+        cats = hot.get("categories") or {}
+        total = sum(cats.values()) or 1
+        print(f"  hot stacks (last {hot.get('window_s')}s @ "
+              f"{hot.get('hz')}Hz): "
+              + "  ".join(f"{c}={100.0 * n / total:.0f}%"
+                          for c, n in cats.items()))
+        for r in (hot.get("stacks") or [])[:5]:
+            print(f"    {r.get('pct', 0):>5.1f}% {r.get('category'):<10} "
+                  f"{r.get('leaf')}")
     for stacks in by_rec.get("stacks", []):
         threads = stacks.get("threads", [])
         names = ", ".join(t.get("name", "?") for t in threads)
@@ -928,6 +1115,33 @@ def main(argv=None) -> int:
                    help="sparkline window seconds (default 120)")
     p.add_argument("--timeout", type=float, default=5.0)
     p.set_defaults(fn=cmd_top)
+
+    p = sub.add_parser("profile",
+                       help="render the sampling profiler's hot stacks")
+    p.add_argument("target", help="host:port or URL of a per-rank exporter")
+    p.add_argument("-s", "--seconds", type=float, default=10.0,
+                   help="trailing window to merge (default 10)")
+    p.add_argument("-n", "--top", type=int, default=20,
+                   help="hot stacks to show (default 20)")
+    p.add_argument("--fleet", action="store_true",
+                   help="rank-merged view via /fleet/profile")
+    p.add_argument("--collapsed", default="",
+                   help="also write flamegraph-ready collapsed stacks here")
+    p.add_argument("--device", type=float, default=0.0,
+                   help="capture an on-demand device trace of N seconds "
+                        "instead of sampling stats")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw JSON instead of the table")
+    p.add_argument("--timeout", type=float, default=30.0)
+    p.set_defaults(fn=cmd_profile)
+
+    p = sub.add_parser("mem",
+                       help="render the live memory ledger's buckets")
+    p.add_argument("target", help="host:port or URL of a per-rank exporter")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw JSON instead of the table")
+    p.add_argument("--timeout", type=float, default=5.0)
+    p.set_defaults(fn=cmd_mem)
 
     p = sub.add_parser("aggregate",
                        help="merge /metrics from several exporters")

@@ -161,6 +161,13 @@ def serving_metrics(doc: dict) -> Dict[str, Tuple[float, str]]:
     # fleet-router column (serving_bench --replicas N): completed/submitted
     # under the workload — the availability the failover path defends
     put("serving.availability", body.get("availability"), HIGHER)
+    # goodput columns: USEFUL tokens/s (delivered, post-trim) and the
+    # wasted share of attributed tokens. waste_pct LOWER with the
+    # zero-LOWER-baseline rule means a clean baseline pins a zero floor —
+    # any new hedging/retry/overshoot waste is an infinite regression
+    # until the baseline is re-cut with it
+    put("serving.goodput_tok_s", body.get("goodput_tok_s"), HIGHER)
+    put("serving.waste_pct", body.get("waste_pct"), LOWER)
     # speculative column (serving_bench --spec-k N): gate the throughput;
     # the acceptance rate is a DRAFT-QUALITY number, not an engine-perf
     # number (a better-trained draft raises it, an engine change cannot),
@@ -170,6 +177,11 @@ def serving_metrics(doc: dict) -> Dict[str, Tuple[float, str]]:
         put("serving.spec_tok_s", spec.get("aggregate_tok_s"), HIGHER)
         put("serving.spec_ttft_p50_ms", spec.get("ttft_p50_ms"), LOWER)
         put("serving.spec_tpot_ms", spec.get("tpot_ms"), LOWER)
+        # spec goodput: rejected drafts are the waste speculation PAYS
+        # for its latency win — the pair keeps the trade visible
+        put("serving.spec_goodput_tok_s", spec.get("goodput_tok_s"),
+            HIGHER)
+        put("serving.spec_waste_pct", spec.get("waste_pct"), LOWER)
     # elastic-fleet column (serving_bench --traffic [--autoscale]): the
     # post-step TTFT p99 is the SLO the autoscaler must hold through a
     # traffic step; dropped_requests is a HARD ZERO floor (the zero-LOWER-

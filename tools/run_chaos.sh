@@ -63,6 +63,13 @@
 #     --netchaos-first "down:blackhole:0.1" or --netchaos
 #     "down:throttle:@1:512" for the slow-loris flavor, seeded via
 #     PADDLE_NETCHAOS_SEED)
+#   * goodput reconciliation: every chaos drill above is ALSO a ledger
+#     audit — the goodput ledger attributes every decoded token exactly
+#     once (useful + hedge_loser + retry_discard + cancel/deadline +
+#     drain/stop + overshoot == the engine's tokens_out), so a drill
+#     that leaks unattributed tokens or KV pages fails the fast-tier
+#     reconciliation pin (test_profiler_goodput.py); run any drill with
+#     PADDLE_OBS_PROF=1 to get the hot-stacks section in crash dumps
 #   * black box: PADDLE_CHAOS_POINTS=step:kill:@4 under PADDLE_OBS_BLACKBOX
 #     kills a launched worker mid-step; the flight recorder's JSONL dump
 #     must carry the in-flight step event + all-thread stacks, and

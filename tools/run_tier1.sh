@@ -80,6 +80,17 @@
 # (tools/run_chaos.sh). The tsdb-on hot-path budget (<5%) is gate 6 of
 # tools/check_obs_overhead.py.
 #
+# Profiling-and-goodput suite: tests/test_profiler_goodput.py (sampling
+# profiler seam classification + decode-seam pin over a synthetic busy
+# thread, goodput-ledger reconciliation chaos drill — useful + attributed
+# waste == tokens_out EXACTLY with speculation + mid-flight cancel +
+# stop, zero leaked KV pages —, memory-ledger buckets/leak check,
+# /profile + /mem endpoints, obsctl profile/mem rendering, waste_burn +
+# hbm_headroom default rules, flight hot_stacks record, perf_gate
+# goodput fields) runs here — manual-drive sampling, seconds total. The
+# prof-on hot-path budget (<5%) is gate 7 of tools/check_obs_overhead.py
+# and the prof-on serving leg of tools/check_serving_overhead.py.
+#
 # Perf regression gate (not run here — needs a bench artifact): after a
 # bench run, `python tools/perf_gate.py --baseline BENCH_r05.json
 # --current <new>.json` exits nonzero on a tokens/s / MFU / TTFT

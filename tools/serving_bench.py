@@ -448,7 +448,8 @@ def run_serving(model, prompts, args, kv_layout, slots, num_pages=None,
             # occupancy peak must measure the WORKLOAD, not warm traffic
             eng._engine.pool.peak_used = eng._engine.pool.used
         eng._engine.stats["peak_busy"] = 0
-        t0 = time.perf_counter()
+        gp0 = _goodput_kinds()   # after warm: the row's waste is the
+        t0 = time.perf_counter()  # workload's, not the warmup's
         futs = [eng.submit(p, max_new_tokens=args.new_tokens, prefix_len=pl)
                 for p, pl in prompts]
         outs = [f.result(1800) for f in futs]
@@ -462,6 +463,7 @@ def run_serving(model, prompts, args, kv_layout, slots, num_pages=None,
            "aggregate_tok_s": round(new_tokens / max(dt, 1e-9), 1),
            "wall_s": round(dt, 2), "new_tokens": new_tokens,
            "concurrency_peak": peak_busy}
+    row.update(_goodput_cols(gp0, dt))
     if tp > 1:
         row["tp"] = tp
     if fused_info is not None:
@@ -513,6 +515,7 @@ def run_fleet(model, prompts, args):
             eng._engine.stats["peak_busy"] = 0
         before = [(eng.stats["decode_tokens"], eng.stats["requests"])
                   for eng in engines]
+        gp0 = _goodput_kinds()   # replicas are in-process: one ledger
         t0 = time.perf_counter()
         # a synchronous refusal (overload shed, fleet unavailable) counts
         # against availability exactly like an in-flight failure — the
@@ -559,6 +562,7 @@ def run_fleet(model, prompts, args):
                "availability": round(completed / max(submitted, 1), 4),
                "failovers": h["failovers"], "retries": h["retries"],
                "per_replica": per_replica}
+        row.update(_goodput_cols(gp0, dt))
         if hits + misses:
             # FLEET-wide hit rate: prefix-affine routing must keep it,
             # not divide it by the replica count
@@ -585,6 +589,43 @@ def _scrape_counter(name):
         return total
     except Exception:
         return None
+
+
+def _goodput_kinds():
+    """Cumulative per-kind token counts from this process's goodput
+    ledger (None if the observability package is unavailable)."""
+    try:
+        from paddlepaddle_tpu.observability import goodput
+
+        return dict(goodput.snapshot()["kinds"])
+    except Exception:
+        return None
+
+
+def _goodput_cols(before, dt, after=None):
+    """``goodput_tok_s`` (useful tokens/s) + ``waste_pct`` for one run,
+    from the per-kind delta across the timed window. Empty when the
+    ledger was unreadable on either side — a row must never carry a
+    goodput number computed against a missing baseline."""
+    if after is None:
+        after = _goodput_kinds()
+    if before is None or after is None:
+        return {}
+    d = {k: int(after.get(k, 0)) - int(before.get(k, 0)) for k in after}
+    useful = d.get("useful", 0)
+    wasted = sum(v for k, v in d.items() if k != "useful")
+    attributed = useful + wasted
+    return {
+        "goodput_tok_s": round(useful / max(dt, 1e-9), 1),
+        "waste_pct": (round(100.0 * wasted / attributed, 2)
+                      if attributed > 0 else 0.0),
+    }
+
+
+def _fmt_goodput(row, pad=""):
+    if "goodput_tok_s" in row:
+        print(f"{pad} goodput: {row['goodput_tok_s']:.1f} useful tok/s  "
+              f"waste={row['waste_pct']}%", flush=True)
 
 
 _HEDGE_FROM_ARGS = object()      # sentinel: None must mean OFF (the A/B
@@ -639,10 +680,26 @@ def run_remote_fleet(args, hedge_after=_HEDGE_FROM_ARGS):
                                name=f"netchaos:{c.name}").start()
             c._nc_proxy = px      # the client's PADDLE_NETCHAOS seam,
             proxies.append(px)    # armed programmatically per replica
+    def _fleet_goodput():
+        # decode happens in the replica PROCESSES: their ledgers are the
+        # source of truth, summed over the health RPC (a dead or chaos-
+        # wedged replica just contributes nothing)
+        total, seen = {}, 0
+        for c in clients:
+            try:
+                kinds = (c.health().get("goodput") or {}).get("kinds") or {}
+            except Exception:
+                continue
+            seen += 1
+            for k, v in kinds.items():
+                total[k] = total.get(k, 0) + int(v)
+        return total if seen else None
+
     router = ServingRouter(clients, probe_interval_s=0.2,
                            hedge_after_s=hedge_after,
                            hedge_budget_pct=args.hedge_budget)
     stalls0 = _scrape_counter("paddle_replica_stalls_total") or 0.0
+    gp0 = _fleet_goodput()
     router.start()
     try:
         t0 = time.perf_counter()
@@ -686,6 +743,7 @@ def run_remote_fleet(args, hedge_after=_HEDGE_FROM_ARGS):
                "failovers": h["failovers"], "retries": h["retries"],
                "hedges": h["hedges"], "hedge_wins": h["hedge_wins"],
                "stalls": int(stalls)}
+        row.update(_goodput_cols(gp0, dt, after=_fleet_goodput()))
         if proxies:
             fires = {}
             for px in proxies:
@@ -711,6 +769,7 @@ def fmt_remote(row):
              if row.get("netchaos") else ""))
     print(f"  SLO: ttft p50={row['ttft_p50_ms']}ms "
           f"p99={row['ttft_p99_ms']}ms  wall={row['wall_s']}s", flush=True)
+    _fmt_goodput(row, " ")
 
 
 def run_traffic(model, prompts, args):
@@ -826,6 +885,7 @@ def fmt_fleet(row):
     print(f"{'':<22} SLO: ttft p50={row['ttft_p50_ms']}ms "
           f"p99={row['ttft_p99_ms']}ms  tpot={row['tpot_ms']}ms/token  "
           f"queue_wait p99={row['queue_wait_p99_ms']}ms", flush=True)
+    _fmt_goodput(row, f"{'':<22}")
 
 
 def fmt(row, label):
@@ -838,6 +898,7 @@ def fmt(row, label):
     print(f"{'':<22} SLO: ttft p50={row['ttft_p50_ms']}ms "
           f"p99={row['ttft_p99_ms']}ms  tpot={row['tpot_ms']}ms/token  "
           f"queue_wait p99={row['queue_wait_p99_ms']}ms", flush=True)
+    _fmt_goodput(row, f"{'':<22}")
     if "spec_k" in row:
         print(f"{'':<22} spec: k={row['spec_k']} draft={row['draft']} "
               f"({row['draft_params_m']}M, {row['draft_quant']})  "
