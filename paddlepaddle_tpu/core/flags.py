@@ -317,3 +317,18 @@ define_flag("serving_drain_timeout_s", 30.0,
             "default drain(timeout): how long a draining engine lets "
             "in-flight slots finish before shedding the remainder",
             env="PADDLE_SERVING_DRAIN_TIMEOUT_S")
+
+# KV-memory family (ROADMAP item 4): int8 KV pages + host-RAM prefix tier.
+define_flag("serving_kv_quant", "",
+            "KV-cache quantization for the paged pool: 'int8' stores K/V "
+            "pages as int8 codes with per-page-per-head scales (about 2x "
+            "pages at a fixed byte budget); '' = full-precision KV (the "
+            "seed behavior). Constructor arguments win.",
+            env="PADDLE_SERVING_KV_QUANT")
+define_flag("serving_kv_host_bytes", 0,
+            "byte budget for the host-RAM prefix-cache spill tier: "
+            "refcount-0 prefix entries evicted from the device pool are "
+            "serialized to host RAM and restored into fresh device pages "
+            "on the next hit; LRU spans both tiers and host-tier discard "
+            "is the true eviction (0 = tier off, eviction discards)",
+            env="PADDLE_SERVING_KV_HOST_BYTES")

@@ -178,6 +178,13 @@ class CompilePlan:
             "quant": engine.quant or "off",
             "quant_group_size": (engine.quant_meta.get("group_size", -1)
                                  if engine.quant else -1),
+            # int8 KV pages change every program that touches the pool
+            # (admission quantize-scatter, decode dequant, verify) AND the
+            # cache pytree's treedef — a bundle built under the other
+            # scheme must be rejected at load, not deserialized into the
+            # wrong structure. The host spill tier is deliberately NOT a
+            # fact: it never changes a compiled program.
+            "kv_quant": getattr(engine, "kv_quant", None) or "off",
             "mesh": (engine.plan.describe()
                      if engine.plan is not None else None),
             # speculative decoding: draft arch + quant + k make the

@@ -67,6 +67,71 @@ def _bucket(n: int, q: int = 128) -> int:
     return -(-n // q) * q
 
 
+# -- int8 KV-page quantization (kv_quant="int8") ----------------------------
+# KVQuant/KIVI-style symmetric absmax: each K/V page carries one f32 scale
+# per kv head ([num_pages, kvh] riding the pool as a parallel buffer), codes
+# are int8 in [-127, 127]. Dequant is exactly ``codes * scale`` in f32 —
+# the same product whether it runs in the fused kernel's VMEM pass or the
+# reference gather, which is what makes kernel-vs-reference token-exact at
+# identical pool bytes.
+
+def _kv_quant_pages(x):
+    """Quantize whole pages ``x [npg, ps, kvh, hd]`` (f32) at admission:
+    per-(page, head) absmax scale. Positions past the prefill length must
+    already be zeroed by the caller so padding never inflates a scale."""
+    amax = jnp.max(jnp.abs(x), axis=(1, 3))                  # [npg, kvh]
+    scale = amax / 127.0
+    codes = jnp.clip(
+        jnp.round(x / jnp.maximum(scale, 1e-20)[:, None, :, None]),
+        -127, 127).astype(jnp.int8)
+    return codes, scale
+
+
+def _kv_dequant_gather(codes, scale, idx, dtype):
+    """Gather-dequant pages ``idx`` from an int8 pool: the reference (non-
+    kernel) read path. ``codes[idx] [..., ps, kvh, hd]`` times
+    ``scale[idx] [..., kvh]`` in f32, cast to the engine's KV dtype."""
+    g = codes[idx].astype(jnp.float32)
+    s = scale[idx][..., None, :, None]
+    return (g * s).astype(dtype)
+
+
+def _kv_quant_scatter(codes, scales, new_rows, phys, off):
+    """Scatter ``new_rows [S, W, kvh, hd]`` (this step's K or V, already in
+    the engine's KV dtype) into the int8 pool at physical page ``phys`` /
+    in-page offset ``off`` ([S, W] each), quantizing at write time.
+
+    The page scale is a RUNNING absmax: when a new row fits the existing
+    scale the rescale factor is exactly 1.0 and ``round(q * 1.0) == q`` —
+    existing codes are bit-identical, so steady-state decode appends are
+    drift-free; only a genuine absmax growth requantizes the page (the
+    standard running-scale tradeoff, documented in docs/quantization.md).
+    W is static and small (1 for chunked decode, k+1 for spec verify), so
+    the python loop unrolls into W gather/scatter pairs per pool. Duplicate
+    physical targets across slots only occur on the sacrificial null page
+    0, where last-write-wins garbage is by design never read unmasked."""
+    S, W = phys.shape
+    sl = jnp.arange(S)
+    new_rows = new_rows.astype(jnp.float32)
+    for w in range(W):
+        pw, ow = phys[:, w], off[:, w]
+        new = new_rows[:, w]                                 # [S, kvh, hd]
+        old_scale = scales[pw]                               # [S, kvh]
+        new_scale = jnp.maximum(old_scale,
+                                jnp.max(jnp.abs(new), axis=-1) / 127.0)
+        safe = jnp.maximum(new_scale, 1e-20)
+        q_new = jnp.clip(jnp.round(new / safe[..., None]),
+                         -127, 127).astype(jnp.int8)
+        page = codes[pw].astype(jnp.float32)                 # [S, ps, kvh, hd]
+        factor = old_scale / safe                            # == 1.0 no-grow
+        page = jnp.clip(jnp.round(page * factor[:, None, :, None]),
+                        -127, 127).astype(jnp.int8)
+        page = page.at[sl, ow].set(q_new)
+        codes = codes.at[pw].set(page)
+        scales = scales.at[pw].set(new_scale)
+    return codes, scales
+
+
 _perf_mod = None
 
 
@@ -165,7 +230,9 @@ class BatchDecodeEngine:
                  prefix_cache: bool = True, mesh=None, plan=None,
                  bundle: Optional[str] = None, draft=None, spec_k: int = 0,
                  draft_quant: Optional[str] = None,
-                 fused_kernels: Optional[bool] = None):
+                 fused_kernels: Optional[bool] = None,
+                 kv_quant: Optional[str] = None,
+                 kv_host_bytes: Optional[int] = None):
         cfg = model.config
         if kv_layout not in ("paged", "contiguous"):
             raise ValueError(
@@ -222,8 +289,51 @@ class BatchDecodeEngine:
             #   silently replicate it on every chip
             self.params = self.plan.shard(self.params)
             self._mesh_gauges()
+        # KV-cache quantization (ROADMAP item 4a): int8 codes + per-page-
+        # per-head scales riding the pool. Resolved AFTER the plan so the
+        # tp seam can be rejected loudly; argument wins over the flag,
+        # ""/"off" are the explicit off spellings.
+        from ..core.flags import flag_value as _flag_value
+
+        if kv_quant is None:
+            kv_quant = _flag_value("serving_kv_quant") or None
+        if kv_quant in ("", "off"):
+            kv_quant = None
+        if kv_quant is not None:
+            if kv_quant == "int4":
+                raise ValueError(
+                    "kv_quant='int4': the int8 page format (codes + "
+                    "per-page-per-head scales) is the shipped scheme; "
+                    "int4 packing is the named follow-up seam on the same "
+                    "scale buffers (docs/quantization.md) — honestly "
+                    "absent, not silently served as int8")
+            if kv_quant != "int8":
+                raise ValueError(
+                    f"kv_quant={kv_quant!r}: 'int8' is the supported "
+                    "KV-cache scheme ('int4' is the named seam)")
+            if kv_layout != "paged":
+                raise ValueError(
+                    "kv_quant='int8' needs kv_layout='paged' — scales "
+                    "ride the page pool; the contiguous layout is the "
+                    "full-precision parity baseline")
+            if self.plan is not None:
+                raise ValueError(
+                    "kv_quant with a tensor-parallel plan: sharding the "
+                    "(codes, scale) pair per layer is a named follow-up "
+                    "seam (shard_kv places plain pools only) — serve "
+                    "int8 KV single-chip or drop the plan")
+            if not self._llama_shaped_layers():
+                raise ValueError(
+                    "kv_quant='int8' drives the llama decoder submodules "
+                    "directly (quantize-at-scatter needs the raw K/V "
+                    "projections); this model is not llama-decoder-shaped")
+        self.kv_quant = kv_quant
         kvh, hd = cfg.num_key_value_heads, cfg.head_dim
         dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+        self._kv_dtype = dtype     # compute dtype for scratch/dequant even
+        #   when the pool itself stores int8 codes
+        self.kv_host = None        # host-RAM prefix spill tier (item 4b)
+        self._restore_ms: List[float] = []
         if kv_layout == "paged":
             self.page_size = int(page_size)
             if self.page_size < 1:
@@ -242,13 +352,37 @@ class BatchDecodeEngine:
             self.prefix = PrefixCache()
             self.prefix_enabled = bool(prefix_cache)
             self.page_table = jnp.zeros((self.S, self.P), jnp.int32)
-            self.caches = [
-                (jnp.zeros((n_pages, self.page_size, kvh, hd), dtype),
-                 jnp.zeros((n_pages, self.page_size, kvh, hd), dtype))
-                for _ in range(cfg.num_hidden_layers)]
+            if self.kv_quant == "int8":
+                # each pool entry is (codes int8, scale f32 [pages, kvh]):
+                # a nested pytree, so program args / scan carries /
+                # donation / bundle templates thread it unchanged
+                self.caches = [
+                    ((jnp.zeros((n_pages, self.page_size, kvh, hd),
+                                jnp.int8),
+                      jnp.zeros((n_pages, kvh), jnp.float32)),
+                     (jnp.zeros((n_pages, self.page_size, kvh, hd),
+                                jnp.int8),
+                      jnp.zeros((n_pages, kvh), jnp.float32)))
+                    for _ in range(cfg.num_hidden_layers)]
+            else:
+                self.caches = [
+                    (jnp.zeros((n_pages, self.page_size, kvh, hd), dtype),
+                     jnp.zeros((n_pages, self.page_size, kvh, hd), dtype))
+                    for _ in range(cfg.num_hidden_layers)]
+            if kv_host_bytes is None:
+                kv_host_bytes = int(
+                    _flag_value("serving_kv_host_bytes") or 0)
+            if kv_host_bytes and prefix_cache:
+                from .kv_pool import HostPrefixTier
+
+                self.kv_host = HostPrefixTier(int(kv_host_bytes))
             self._slot_pages: List[List[int]] = [[] for _ in range(self.S)]
             self._slot_prefix: List[Optional[str]] = [None] * self.S
             self._kv_gauges(total=True)
+            if self.kv_quant is not None:
+                _safe_set("paddle_serving_kv_quant_enabled",
+                          "KV-cache quantization live on this engine "
+                          "(1 = yes)", 1, mode=self.kv_quant)
         else:
             self.page_size = 0
             self.P = 0
@@ -369,28 +503,59 @@ class BatchDecodeEngine:
         _safe_set("paddle_serving_kv_pages_free",
                   "KV pages currently on the free list",
                   self.pool.free_count)
+        if self.kv_host is not None:
+            _safe_set("paddle_serving_kv_host_bytes",
+                      "bytes of spilled prefix slabs resident in the "
+                      "host-RAM tier", self.kv_host.used_bytes)
+            _safe_set("paddle_serving_kv_host_occupancy",
+                      "host-tier bytes used over its byte budget "
+                      "(the kv_host_tier_full alert input)",
+                      round(self.kv_host.occupancy, 4))
+
+    def _restore_percentile(self, q: float) -> Optional[float]:
+        """p-th percentile of recent host-tier restore latencies (ms)."""
+        if not self._restore_ms:
+            return None
+        xs = sorted(self._restore_ms)
+        return round(xs[min(len(xs) - 1, int(q * len(xs)))], 3)
 
     def kv_stats(self) -> Dict[str, object]:
         """KV-pool snapshot for ``health()``/``/healthz`` and the serving
-        bench: layout, page accounting, prefix-cache hit data."""
+        bench: layout, page accounting, prefix-cache hit data, host-tier
+        spill/restore counters."""
         cfg = self.cfg
         kvh, hd = cfg.num_key_value_heads, cfg.head_dim
-        itemsize = np.dtype(self.caches[0][0].dtype).itemsize
+        if self.kv_quant == "int8":
+            itemsize = 1                      # int8 codes cross HBM
+        else:
+            itemsize = np.dtype(self._kv_dtype).itemsize
         per_tok = 2 * kvh * hd * itemsize * cfg.num_hidden_layers
         if self.kv_layout != "paged":
             return {"layout": "contiguous",
                     "kv_bytes": int(self.S * self.L * per_tok)}
         pool, pfx = self.pool, self.prefix
+        # per-page scale overhead in int8 mode: one f32 per (page, head)
+        # per K and V per layer — the honest page_bytes the memledger's
+        # pinned-prefix reconciliation multiplies by
+        scale_bytes = (2 * kvh * 4 * cfg.num_hidden_layers
+                       if self.kv_quant == "int8" else 0)
+        page_bytes = int(self.page_size * per_tok + scale_bytes)
+        host = {"enabled": False}
+        if self.kv_host is not None:
+            host = dict(self.kv_host.stats(), enabled=True,
+                        restore_ms_p50=self._restore_percentile(0.50),
+                        restore_ms_p99=self._restore_percentile(0.99))
         return {
             "layout": "paged",
+            "kv_quant": self.kv_quant or "off",
             "page_size": self.page_size,
             "pages_total": pool.usable,
             "pages_free": pool.free_count,
             "pages_used": pool.used,
             "pages_peak": pool.peak_used,
             "occupancy": round(pool.used / max(pool.usable, 1), 4),
-            "page_bytes": int(self.page_size * per_tok),
-            "kv_bytes": int(pool.num_pages * self.page_size * per_tok),
+            "page_bytes": page_bytes,
+            "kv_bytes": int(pool.num_pages * page_bytes),
             "prefix": {
                 "enabled": self.prefix_enabled,
                 "entries": len(pfx),
@@ -399,6 +564,7 @@ class BatchDecodeEngine:
                 "misses": pfx.misses,
                 "evictions": pfx.evictions,
             },
+            "host": host,
         }
 
     def spec_info(self) -> Dict[str, object]:
@@ -451,7 +617,8 @@ class BatchDecodeEngine:
             ok, reason = _pa.paged_attention_supported(
                 page_size=self.page_size, head_dim=self.cfg.head_dim,
                 num_heads=self.cfg.num_attention_heads,
-                num_kv_heads=self.cfg.num_key_value_heads, plan=self.plan)
+                num_kv_heads=self.cfg.num_key_value_heads, plan=self.plan,
+                kv_quant=self.kv_quant)
             if ok and not self._llama_shaped_layers():
                 ok, reason = False, "model layers not llama-decoder-shaped"
         if ok:
@@ -511,7 +678,13 @@ class BatchDecodeEngine:
             pos < L,
             page_table[jnp.broadcast_to(rows, pos.shape), page_idx], 0)
         off = pos % ps
-        if self.fused.get("enabled"):
+        if self.fused.get("enabled") or self.kv_quant == "int8":
+            # int8 KV always takes the direct-submodule path even without
+            # the kernel: quantize-at-scatter must happen BEFORE attention
+            # reads the pool, so kernel and reference attend the SAME
+            # quantized bytes (that identity is what makes the parity
+            # test token-exact) — the generic layer call below would
+            # attend this step's full-precision rows instead
             return self._forward_paged_fused(params, toks, pools,
                                              page_table, lens, phys, off)
         with _ag.no_grad(), self.model.bind_state(params):
@@ -537,6 +710,27 @@ class BatchDecodeEngine:
                 logits = unwrap(self.model.lm_head(hidden))
         return logits, new_pools
 
+    def _ref_gqa_attention(self, q, kview, vview, lens, *, rep, scale):
+        """Reference gather-dequant attention over a materialized logical
+        view [S, T, kvh, hd]: the same bottom-right causal rule, GQA
+        grouping (q head g*rep+r reads kv head g) and f32 accumulation as
+        the Pallas kernel — the non-kernel half of the int8-KV parity
+        pair (docs/kernels.md fallback matrix)."""
+        S, W, h, hd = q.shape
+        kvh = kview.shape[2]
+        T = kview.shape[1]
+        qg = q.astype(jnp.float32).reshape(S, W, kvh, rep, hd) * scale
+        att = jnp.einsum("swgrd,stgd->swgrt", qg,
+                         kview.astype(jnp.float32))
+        k_pos = jnp.arange(T, dtype=jnp.int32)
+        q_pos = lens[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]
+        mask = k_pos[None, None, :] <= q_pos[:, :, None]      # [S, W, T]
+        att = jnp.where(mask[:, :, None, None, :], att, -1e30)
+        p = jax.nn.softmax(att, axis=-1)
+        out = jnp.einsum("swgrt,stgd->swgrd", p,
+                         vview.astype(jnp.float32))
+        return out.reshape(S, W, h, hd).astype(q.dtype)
+
     def _forward_paged_fused(self, params, toks, pools, page_table, lens,
                              phys, off):
         """The fused-kernel form of :meth:`_forward_paged`: identical
@@ -546,7 +740,12 @@ class BatchDecodeEngine:
         the attention WALKS THE PAGE TABLE IN-KERNEL
         (ops/kernels/paged_attention.py) instead of materializing
         ``pool[page_table]`` in HBM. The layer loop drives the llama
-        submodules directly — `_resolve_fused` verified the shape."""
+        submodules directly — `_resolve_fused` verified the shape.
+
+        Under ``kv_quant="int8"`` this is ALSO the reference path (kernel
+        off → gather-dequant + :meth:`_ref_gqa_attention`): both forms
+        quantize-scatter first and attend the identical int8 bytes, which
+        is the parity contract."""
         import math as _math
 
         from ..models.llama import _apply_rope
@@ -559,7 +758,10 @@ class BatchDecodeEngine:
                        cfg.head_dim)
         rep = nh // kvh
         scale = 1.0 / _math.sqrt(hd)
+        quant = self.kv_quant == "int8"
+        use_kernel = bool(self.fused.get("enabled"))
         interp = self.fused.get("paged_attention") == "interpret"
+        ps, P = self.page_size, self.P
         with _ag.no_grad(), self.model.bind_state(params):
             mdl = self.model.model
             x = mdl.embed_tokens(toks)
@@ -575,14 +777,39 @@ class BatchDecodeEngine:
                 # write first, then attend: the causal mask admits this
                 # step's own positions, exactly like the reference
                 # view-write in _cached_attention
-                kp = kp.at[phys, off].set(unwrap(k).astype(kp.dtype))
-                vp = vp.at[phys, off].set(unwrap(v).astype(vp.dtype))
-                out = paged_attention(unwrap(q), kp, vp, page_table, lens,
-                                      rep=rep, scale=scale,
-                                      interpret=interp)
+                if quant:
+                    (kq, ksc), (vq, vsc) = kp, vp
+                    kq, ksc = _kv_quant_scatter(
+                        kq, ksc, unwrap(k).astype(self._kv_dtype),
+                        phys, off)
+                    vq, vsc = _kv_quant_scatter(
+                        vq, vsc, unwrap(v).astype(self._kv_dtype),
+                        phys, off)
+                    if use_kernel:
+                        out = paged_attention(
+                            unwrap(q), kq, vq, page_table, lens, rep=rep,
+                            scale=scale, k_scale=ksc, v_scale=vsc,
+                            interpret=interp)
+                    else:
+                        kview = _kv_dequant_gather(
+                            kq, ksc, page_table, self._kv_dtype).reshape(
+                                S, P * ps, kvh, hd)
+                        vview = _kv_dequant_gather(
+                            vq, vsc, page_table, self._kv_dtype).reshape(
+                                S, P * ps, kvh, hd)
+                        out = self._ref_gqa_attention(
+                            unwrap(q), kview, vview, lens, rep=rep,
+                            scale=scale)
+                    new_pools.append(((kq, ksc), (vq, vsc)))
+                else:
+                    kp = kp.at[phys, off].set(unwrap(k).astype(kp.dtype))
+                    vp = vp.at[phys, off].set(unwrap(v).astype(vp.dtype))
+                    out = paged_attention(unwrap(q), kp, vp, page_table,
+                                          lens, rep=rep, scale=scale,
+                                          interpret=interp)
+                    new_pools.append((kp, vp))
                 x = x + attn.o_proj(out.reshape(S, W, nh * hd))
                 x = x + layer.mlp(layer.post_attention_layernorm(x))
-                new_pools.append((kp, vp))
             hidden = mdl.norm(x)
             if self.model.lm_head is None:
                 logits = unwrap(hidden) @ unwrap(mdl.embed_tokens.weight).T
@@ -662,7 +889,7 @@ class BatchDecodeEngine:
         npg = pages_needed(bucket, ps)
         pad = npg * ps - bucket
         kvh, hd = self.cfg.num_key_value_heads, self.cfg.head_dim
-        dtype = pools[0][0].dtype
+        dtype = self._kv_dtype
         scratch = [(jnp.zeros((1, bucket, kvh, hd), dtype),
                     jnp.zeros((1, bucket, kvh, hd), dtype))
                    for _ in range(self.cfg.num_hidden_layers)]
@@ -672,14 +899,32 @@ class BatchDecodeEngine:
         first = self._sample(row[None], temp[None], top_k[None], sub)[0]
         dest = jax.lax.dynamic_slice(page_table, (slot, jnp.int32(0)),
                                      (1, npg))[0]
+        # positions past the prompt hold prefill activations for the
+        # bucket's zero-padding — mask them out of the int8 scale (the
+        # attention mask already hides them; decode overwrites them)
+        valid = (jnp.arange(npg * ps, dtype=jnp.int32)
+                 < plen).reshape(npg, ps)[:, :, None, None]
         out_pools = []
         for (kp, vp), (ks, vs) in zip(pools, scratch):
             if pad:
                 ks = jnp.pad(ks, ((0, 0), (0, pad), (0, 0), (0, 0)))
                 vs = jnp.pad(vs, ((0, 0), (0, pad), (0, 0), (0, 0)))
-            kp = kp.at[dest].set(ks[0].reshape(npg, ps, kvh, hd))
-            vp = vp.at[dest].set(vs[0].reshape(npg, ps, kvh, hd))
-            out_pools.append((kp, vp))
+            kpg = ks[0].reshape(npg, ps, kvh, hd)
+            vpg = vs[0].reshape(npg, ps, kvh, hd)
+            if self.kv_quant == "int8":
+                (kq, kscale), (vq, vscale) = kp, vp
+                kc, ksc = _kv_quant_pages(
+                    jnp.where(valid, kpg.astype(jnp.float32), 0.0))
+                vc, vsc = _kv_quant_pages(
+                    jnp.where(valid, vpg.astype(jnp.float32), 0.0))
+                out_pools.append(((kq.at[dest].set(kc),
+                                   kscale.at[dest].set(ksc)),
+                                  (vq.at[dest].set(vc),
+                                   vscale.at[dest].set(vsc))))
+            else:
+                kp = kp.at[dest].set(kpg)
+                vp = vp.at[dest].set(vpg)
+                out_pools.append((kp, vp))
         return self._set_slot_state(out_pools, lens, tokens, active, temps,
                                     eos_ids, budgets, top_ks, key, slot,
                                     plen, temp, eos, budget, top_k, first)
@@ -700,14 +945,22 @@ class BatchDecodeEngine:
                  eos_ids, budgets, top_ks, ids, tail_plen, slot, temp, eos,
                  budget, top_k, key):
             kvh, hd = self.cfg.num_key_value_heads, self.cfg.head_dim
-            dtype = pools[0][0].dtype
+            dtype = self._kv_dtype
+            quant = self.kv_quant == "int8"
             row_pages = jax.lax.dynamic_slice(
                 page_table, (slot, jnp.int32(0)), (1, self.P))[0]
             pfx = row_pages[:n_pfx]
             scratch = []
             for kp, vp in pools:
-                kpfx = kp[pfx].reshape(1, aligned, kvh, hd)
-                vpfx = vp[pfx].reshape(1, aligned, kvh, hd)
+                if quant:
+                    (kq, ksc), (vq, vsc) = kp, vp
+                    kpfx = _kv_dequant_gather(kq, ksc, pfx, dtype).reshape(
+                        1, aligned, kvh, hd)
+                    vpfx = _kv_dequant_gather(vq, vsc, pfx, dtype).reshape(
+                        1, aligned, kvh, hd)
+                else:
+                    kpfx = kp[pfx].reshape(1, aligned, kvh, hd)
+                    vpfx = vp[pfx].reshape(1, aligned, kvh, hd)
                 zk = jnp.zeros((1, tail_bucket, kvh, hd), dtype)
                 scratch.append((jnp.concatenate([kpfx, zk], axis=1),
                                 jnp.concatenate([vpfx, zk], axis=1)))
@@ -717,6 +970,8 @@ class BatchDecodeEngine:
             key2, sub = jax.random.split(key)
             first = self._sample(row[None], temp[None], top_k[None], sub)[0]
             dest = row_pages[n_pfx:n_pfx + npg_tail]
+            valid = (jnp.arange(npg_tail * ps, dtype=jnp.int32)
+                     < tail_plen).reshape(npg_tail, ps)[:, :, None, None]
             out_pools = []
             for (kp, vp), (ks, vs) in zip(pools, scratch):
                 kt = ks[:, aligned:]
@@ -724,9 +979,22 @@ class BatchDecodeEngine:
                 if pad:
                     kt = jnp.pad(kt, ((0, 0), (0, pad), (0, 0), (0, 0)))
                     vt = jnp.pad(vt, ((0, 0), (0, pad), (0, 0), (0, 0)))
-                kp = kp.at[dest].set(kt[0].reshape(npg_tail, ps, kvh, hd))
-                vp = vp.at[dest].set(vt[0].reshape(npg_tail, ps, kvh, hd))
-                out_pools.append((kp, vp))
+                ktp = kt[0].reshape(npg_tail, ps, kvh, hd)
+                vtp = vt[0].reshape(npg_tail, ps, kvh, hd)
+                if quant:
+                    (kq, kscale), (vq, vscale) = kp, vp
+                    kc, ksc = _kv_quant_pages(
+                        jnp.where(valid, ktp.astype(jnp.float32), 0.0))
+                    vc, vsc = _kv_quant_pages(
+                        jnp.where(valid, vtp.astype(jnp.float32), 0.0))
+                    out_pools.append(((kq.at[dest].set(kc),
+                                       kscale.at[dest].set(ksc)),
+                                      (vq.at[dest].set(vc),
+                                       vscale.at[dest].set(vsc))))
+                else:
+                    kp = kp.at[dest].set(ktp)
+                    vp = vp.at[dest].set(vtp)
+                    out_pools.append((kp, vp))
             return self._set_slot_state(
                 out_pools, lens, tokens, active, temps, eos_ids, budgets,
                 top_ks, key2, slot, aligned + tail_plen, temp, eos, budget,
@@ -1127,8 +1395,10 @@ class BatchDecodeEngine:
                 "num_pages or shorten the request", pages_needed=total,
                 pages_capacity=self.pool.usable)
         if self.pool.free_count < need:
+            spill = (self._spill_prefix if self.kv_host is not None
+                     else None)
             evicted = self.prefix.evict_until(self.pool, need,
-                                              exclude=exclude)
+                                              exclude=exclude, spill=spill)
             if evicted:
                 _safe_inc("paddle_serving_kv_prefix_evictions_total",
                           "prefix-cache entries LRU-evicted for pages",
@@ -1136,6 +1406,96 @@ class BatchDecodeEngine:
             if self.pool.free_count < need:
                 return None
         return self.pool.alloc(need)
+
+    # -- host-RAM prefix spill tier (ROADMAP item 4b) ------------------------
+    def _slab_meta(self) -> Dict[str, object]:
+        """The engine-compatibility facts a slab must match to restore —
+        a mismatch (config change across a restart, foreign slab) is a
+        loud miss, never silently-wrong KV."""
+        cfg = self.cfg
+        return {"page_size": self.page_size,
+                "kvh": cfg.num_key_value_heads, "hd": cfg.head_dim,
+                "layers": cfg.num_hidden_layers,
+                "kv_quant": self.kv_quant or "off",
+                "dtype": np.dtype(self._kv_dtype).name}
+
+    def _spill_prefix(self, h: str, entry) -> bool:
+        """``evict_until``'s spill callback: serialize the entry's live
+        device pages (+ scales under int8) into a slab and hand it to the
+        host tier. Runs BEFORE the pages return to the free list. False
+        (tier rejected it — bigger than the whole budget) means the
+        eviction proceeds as a true discard."""
+        from .kv_pool import HostSlab, serialize_page_slab
+
+        idx = np.asarray(entry.pages, np.int32)
+        arrays = []
+        for kp, vp in self.caches:
+            if self.kv_quant == "int8":
+                (kq, ksc), (vq, vsc) = kp, vp
+                arrays += [np.asarray(kq[idx]), np.asarray(ksc[idx]),
+                           np.asarray(vq[idx]), np.asarray(vsc[idx])]
+            else:
+                arrays += [np.asarray(kp[idx]), np.asarray(vp[idx])]
+        meta = dict(self._slab_meta(), length=entry.length,
+                    n_pages=len(entry.pages))
+        blob = serialize_page_slab(meta, arrays)
+        slab = HostSlab(blob, entry.length, len(entry.pages),
+                        entry.last_used)
+        ok = self.kv_host.put(h, slab)
+        if ok:
+            _safe_inc("paddle_serving_kv_prefix_spills_total",
+                      "prefix entries spilled to the host-RAM tier "
+                      "instead of discarded")
+            _flight_record("kv", "prefix_spill", hash=h[:16],
+                           pages=len(entry.pages), bytes=len(blob))
+        return ok
+
+    def _restore_prefix(self, h: str, slab, pfx_pages: List[int]) -> bool:
+        """Write a popped host slab back into freshly reserved device
+        pages and re-register the prefix (refcount 0 — the hit path about
+        to run takes the slot's ref). False on any mismatch/corruption:
+        the caller folds the pages back into a full-prefill miss."""
+        from .kv_pool import deserialize_page_slab
+
+        try:
+            meta, arrays = deserialize_page_slab(slab.blob)
+            want = dict(self._slab_meta(), length=meta.get("length"),
+                        n_pages=len(pfx_pages))
+            if meta != want:
+                raise ValueError(f"slab/engine mismatch: {meta} != {want}")
+            idx = jnp.asarray(np.asarray(pfx_pages, np.int32))
+            per = 4 if self.kv_quant == "int8" else 2
+            out = []
+            for li, (kp, vp) in enumerate(self.caches):
+                a = arrays[li * per:(li + 1) * per]
+                if self.kv_quant == "int8":
+                    (kq, ksc), (vq, vsc) = kp, vp
+                    out.append(((kq.at[idx].set(jnp.asarray(a[0])),
+                                 ksc.at[idx].set(jnp.asarray(a[1]))),
+                                (vq.at[idx].set(jnp.asarray(a[2])),
+                                 vsc.at[idx].set(jnp.asarray(a[3])))))
+                else:
+                    out.append((kp.at[idx].set(jnp.asarray(a[0])),
+                                vp.at[idx].set(jnp.asarray(a[1]))))
+            self.caches = out
+            entry = self.prefix.register(h, pfx_pages, int(meta["length"]))
+            entry.refcount = 0
+            _safe_inc("paddle_serving_kv_prefix_restores_total",
+                      "prefix entries restored from the host tier into "
+                      "device pages")
+            _flight_record("kv", "prefix_restore", hash=h[:16],
+                           pages=len(pfx_pages), bytes=len(slab.blob))
+            return True
+        except Exception as e:
+            sys.stderr.write(
+                f"[serving] host-tier slab {h[:16]} failed to restore "
+                f"({type(e).__name__}: {e}); serving the request as a "
+                "full-prefill miss\n")
+            _safe_inc("paddle_serving_kv_host_restore_failures_total",
+                      "host-tier slabs that failed validation/restore "
+                      "(request served as a miss)",
+                      reason=type(e).__name__)
+            return False
 
     def _admit(self, req) -> bool:
         """Prefill ``req`` into a free slot (one compiled call, no host
@@ -1169,14 +1529,44 @@ class BatchDecodeEngine:
         aligned = n_pfx = 0
         h = entry = None
         pages_reserved = None
+        restored = False
         if self.kv_layout == "paged":
             aligned, n_pfx, h, entry = self._prefix_plan(req, ids, plen)
             hit = entry is not None
-            private = self._reserve_pages(plen, req.max_new_tokens,
-                                          n_pfx if hit else 0,
-                                          exclude=h if hit else None)
+            slab = None
+            if not hit and h is not None and self.kv_host is not None:
+                # device miss with a spilled copy: POP the slab before the
+                # reservation below — its own spills could otherwise push
+                # this very slab over the host budget's LRU edge. We own
+                # it now: restore it, or put it back on every early exit.
+                slab = self.kv_host.pop(h)
+            try:
+                private = self._reserve_pages(
+                    plen, req.max_new_tokens, n_pfx if hit else 0,
+                    exclude=h if hit else None)
+            except BaseException:
+                if slab is not None:
+                    self.kv_host.put_back(h, slab)
+                raise
             if private is None:
+                if slab is not None:
+                    self.kv_host.put_back(h, slab)
                 return False          # pool dry: decode frees pages later
+            if slab is not None:
+                # the no-prefix reservation covers prompt+budget in full:
+                # its first n_pfx pages become the restored prefix, the
+                # rest stay private — exactly a hit's reservation split
+                t0r = time.perf_counter()
+                pfx_pages, rest = private[:n_pfx], private[n_pfx:]
+                if self._restore_prefix(h, slab, pfx_pages):
+                    entry = self.prefix.lookup(h)
+                    hit = restored = True
+                    private = rest
+                    self._restore_ms.append(
+                        (time.perf_counter() - t0r) * 1e3)
+                    del self._restore_ms[:-512]
+                else:
+                    private = pfx_pages + rest   # bad slab: full miss
             pages_reserved = len(private)
             self._slot_pages[slot] = private
             row = np.zeros((self.P,), np.int32)
@@ -1282,8 +1672,10 @@ class BatchDecodeEngine:
                     **({} if pages_reserved is None
                        else {"pages": pages_reserved}),
                     **({} if h is None
-                       else {"prefix": "hit" if entry is not None
-                             else "miss", "prefix_pages": n_pfx}))
+                       else {"prefix": "restore" if restored
+                             else ("hit" if entry is not None
+                                   else "miss"),
+                             "prefix_pages": n_pfx}))
                 if self.spec is not None:
                     tr.event("spec.draft_prefill", bucket=bucket)
             except Exception:

@@ -25,10 +25,13 @@ from __future__ import annotations
 
 import hashlib
 import itertools
-from typing import Dict, List, Optional
+import json
+import struct
+from typing import Callable, Dict, List, Optional, Tuple
 
-__all__ = ["PagePool", "PrefixCache", "PrefixEntry", "pages_needed",
-           "prefix_hash"]
+__all__ = ["PagePool", "PrefixCache", "PrefixEntry", "HostPrefixTier",
+           "HostSlab", "pages_needed", "prefix_hash",
+           "serialize_page_slab", "deserialize_page_slab"]
 
 
 def pages_needed(tokens: int, page_size: int) -> int:
@@ -44,6 +47,199 @@ def prefix_hash(prompt_ids, aligned: int) -> str:
 
     ids = np.ascontiguousarray(np.asarray(prompt_ids, np.int32).reshape(-1))
     return f"{aligned}:" + hashlib.sha1(ids[:aligned].tobytes()).hexdigest()
+
+
+_SLAB_MAGIC = b"KVS1"
+
+
+def serialize_page_slab(meta: dict, arrays) -> bytes:
+    """Pack the physical content of a prefix's KV pages — per-layer page
+    tensors, their quantization scales when present, and the table-row
+    metadata — into one contiguous byte string.
+
+    Wire format (little-endian, versioned by the magic):
+
+        [4B magic "KVS1"][u32 header_len][header JSON][raw array bytes...]
+
+    where the header carries ``meta`` verbatim plus a per-array manifest of
+    ``{"dtype": <numpy dtype str>, "shape": [...]}`` in order. The round
+    trip is byte-exact (tests pin it) — this is the same slab a future
+    prefill/decode disaggregation ships KV over (ROADMAP item 2), so the
+    format stays self-describing and carries no engine object references.
+    """
+    import numpy as np
+
+    manifest = []
+    chunks = []
+    for a in arrays:
+        a = np.ascontiguousarray(np.asarray(a))
+        # dtype by NAME, not .str: ml_dtypes types (bfloat16) stringify to
+        # an anonymous void ('<V2') that cannot reconstruct the dtype
+        manifest.append({"dtype": a.dtype.name, "shape": list(a.shape)})
+        chunks.append(a.tobytes())
+    header = json.dumps({"meta": meta, "arrays": manifest},
+                        sort_keys=True).encode("utf-8")
+    out = bytearray()
+    out += _SLAB_MAGIC
+    out += struct.pack("<I", len(header))
+    out += header
+    for c in chunks:
+        out += c
+    return bytes(out)
+
+
+def deserialize_page_slab(blob: bytes) -> Tuple[dict, list]:
+    """Inverse of :func:`serialize_page_slab`: ``(meta, [np.ndarray])``.
+    Raises ``ValueError`` on a bad magic or truncated payload — a corrupt
+    slab must surface loudly, never as silently-wrong KV."""
+    import numpy as np
+
+    if blob[:4] != _SLAB_MAGIC:
+        raise ValueError("page slab: bad magic (not a KVS1 slab)")
+    (hlen,) = struct.unpack("<I", blob[4:8])
+    header = json.loads(blob[8:8 + hlen].decode("utf-8"))
+    meta, manifest = header["meta"], header["arrays"]
+
+    def _dtype_of(name: str):
+        try:
+            return np.dtype(name)
+        except TypeError:
+            # bfloat16/fp8 names resolve only through ml_dtypes (always
+            # present alongside jax; this module itself stays jax-free)
+            import ml_dtypes
+
+            return np.dtype(getattr(ml_dtypes, name))
+
+    arrays = []
+    off = 8 + hlen
+    for spec in manifest:
+        dt = _dtype_of(spec["dtype"])
+        shape = tuple(spec["shape"])
+        n = dt.itemsize * int(np.prod(shape, dtype=np.int64)) if shape \
+            else dt.itemsize
+        raw = blob[off:off + n]
+        if len(raw) != n:
+            raise ValueError("page slab: truncated array payload")
+        arrays.append(np.frombuffer(raw, dtype=dt).reshape(shape).copy())
+        off += n
+    if off != len(blob):
+        raise ValueError("page slab: trailing bytes after last array")
+    return meta, arrays
+
+
+class HostSlab:
+    """One spilled prefix resident in the host tier: its serialized page
+    slab plus the LRU stamp it carried on the device tier (so host-tier
+    discard order continues the device-tier LRU, not insertion order)."""
+
+    __slots__ = ("blob", "length", "n_pages", "stamp", "hits")
+
+    def __init__(self, blob: bytes, length: int, n_pages: int, stamp: int):
+        self.blob = blob
+        self.length = int(length)
+        self.n_pages = int(n_pages)
+        self.stamp = int(stamp)
+        self.hits = 0
+
+    @property
+    def nbytes(self) -> int:
+        return len(self.blob)
+
+
+class HostPrefixTier:
+    """Bounded host-RAM spill tier for refcount-0 prefix entries. The two
+    tiers are EXCLUSIVE: a prefix lives either in device pages (PrefixCache)
+    or here as a serialized slab, never both — restore pops the slab before
+    device pages are written, so reconciliation can assert zero overlap.
+
+    LRU spans both tiers: ``put`` carries the device entry's ``last_used``
+    stamp across, and when the byte budget is exceeded the smallest stamp is
+    discarded first. A host-tier discard is the TRUE eviction — the bytes
+    are gone; the device-tier "eviction" above it was only a spill.
+
+    Same threading contract as the rest of this module: the one engine
+    thread owns every mutation; stats reads from client threads see a
+    consistent-enough snapshot."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = int(max_bytes)
+        if self.max_bytes <= 0:
+            raise ValueError(
+                f"host tier byte budget must be > 0, got {max_bytes} "
+                "(use no tier at all for 'off')")
+        self._entries: Dict[str, HostSlab] = {}
+        self.used_bytes = 0
+        self.spills = 0      # slabs accepted into the tier
+        self.restores = 0    # slabs popped for device restore
+        self.discards = 0    # true evictions (budget pressure or rejects)
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, h: str) -> bool:
+        return h in self._entries
+
+    def keys(self):
+        return list(self._entries.keys())
+
+    @property
+    def occupancy(self) -> float:
+        return self.used_bytes / self.max_bytes
+
+    def put(self, h: str, slab: HostSlab) -> bool:
+        """Admit a slab, discarding oldest-stamp entries until it fits.
+        Returns False (counted as a discard — the bytes are dropped) when
+        the slab alone exceeds the whole budget."""
+        if h in self._entries:
+            # exclusive tiers make this unreachable from the engine; keep
+            # the accounting honest for direct users
+            self.used_bytes -= self._entries.pop(h).nbytes
+        if slab.nbytes > self.max_bytes:
+            self.discards += 1
+            return False
+        while self.used_bytes + slab.nbytes > self.max_bytes:
+            victim = min(self._entries.items(),
+                         key=lambda kv: kv[1].stamp)[0]
+            self.used_bytes -= self._entries.pop(victim).nbytes
+            self.discards += 1
+        self._entries[h] = slab
+        self.used_bytes += slab.nbytes
+        self.spills += 1
+        return True
+
+    def pop(self, h: str) -> Optional[HostSlab]:
+        """Remove and return the slab for ``h`` (None on miss). The caller
+        is now the only owner — on a failed restore it must either re-``put``
+        the slab or accept the discard."""
+        slab = self._entries.pop(h, None)
+        if slab is not None:
+            self.used_bytes -= slab.nbytes
+            slab.hits += 1
+            self.restores += 1
+        return slab
+
+    def put_back(self, h: str, slab: HostSlab) -> None:
+        """Undo a ``pop`` whose restore could not proceed (reservation dry,
+        admission rollback): re-admit without counting a second spill or
+        a phantom restore."""
+        if self.put(h, slab):
+            self.spills -= 1
+        self.restores -= 1
+
+    def clear(self) -> None:
+        self._entries.clear()
+        self.used_bytes = 0
+
+    def stats(self) -> dict:
+        return {
+            "entries": len(self._entries),
+            "budget_bytes": self.max_bytes,
+            "used_bytes": self.used_bytes,
+            "occupancy": self.occupancy,
+            "spills": self.spills,
+            "restores": self.restores,
+            "discards": self.discards,
+        }
 
 
 class PagePool:
@@ -167,12 +363,22 @@ class PrefixCache:
             raise ValueError(f"prefix {h} refcount underflow")
 
     def evict_until(self, pool: PagePool, need_free: int,
-                    exclude: Optional[str] = None) -> int:
+                    exclude: Optional[str] = None,
+                    spill: Optional[Callable[[str, PrefixEntry], bool]]
+                    = None) -> int:
         """Evict refcount-0 entries oldest-first until ``pool`` has at
         least ``need_free`` free pages (or no evictable entry remains).
-        Returns the number of entries evicted. ``exclude`` protects one
-        hash — the entry a prefix HIT is about to reference must not be
-        evicted to make room for that very request's private pages."""
+        Returns the number of entries removed from the device tier.
+        ``exclude`` protects one hash — the entry a prefix HIT is about to
+        reference must not be evicted to make room for that very request's
+        private pages.
+
+        ``spill``, when given, is called with ``(hash, entry)`` BEFORE the
+        entry's pages return to the pool (the page content is still live on
+        device). A True return means the entry moved to a lower tier — the
+        pages are still freed here, but ``evictions`` (the true-discard
+        counter) is not bumped; the host tier's own discard is the real
+        eviction."""
         evicted = 0
         while pool.free_count < need_free:
             victims = [(e.last_used, h) for h, e in self._entries.items()
@@ -180,9 +386,12 @@ class PrefixCache:
             if not victims:
                 break
             _, h = min(victims)
-            pool.free(self._entries.pop(h).pages)
+            entry = self._entries.pop(h)
+            spilled = bool(spill(h, entry)) if spill is not None else False
+            pool.free(entry.pages)
             evicted += 1
-            self.evictions += 1
+            if not spilled:
+                self.evictions += 1
         return evicted
 
     def clear(self, pool: PagePool) -> None:

@@ -412,9 +412,17 @@ class ServingEngine:
                  draft=None,
                  spec_k: int = 0,
                  draft_quant: Optional[str] = None,
-                 fused_kernels: Optional[bool] = None):
+                 fused_kernels: Optional[bool] = None,
+                 kv_quant: Optional[str] = None,
+                 kv_host_bytes: Optional[int] = None):
         if mode not in ("continuous", "static"):
             raise ValueError(f"mode must be 'continuous' or 'static', got {mode!r}")
+        if (kv_quant not in (None, "", "off")
+                or kv_host_bytes) and mode != "continuous":
+            raise ValueError(
+                "kv_quant/kv_host_bytes require the continuous engine — "
+                "the paged KV pool (int8 pages, host spill tier) lives "
+                "there; static mode decodes through generate_cached")
         if (draft is not None or spec_k) and mode != "continuous":
             raise ValueError(
                 "speculative decoding (draft=/spec_k=) requires the "
@@ -494,7 +502,8 @@ class ServingEngine:
                 page_size=kv_page_size, num_pages=kv_num_pages,
                 prefix_cache=prefix_cache, mesh=mesh, plan=plan,
                 bundle=bundle, draft=draft, spec_k=spec_k,
-                draft_quant=draft_quant, fused_kernels=fused_kernels)
+                draft_quant=draft_quant, fused_kernels=fused_kernels,
+                kv_quant=kv_quant, kv_host_bytes=kv_host_bytes)
             self._spec_enabled = self._engine.spec is not None
             if self._spec_enabled:
                 self._announce_spec()
@@ -528,6 +537,9 @@ class ServingEngine:
                 pass
             if quant is not None:
                 self._announce_quant(self._engine.quant_meta)
+            if (self._engine.kv_quant is not None
+                    or self._engine.kv_host is not None):
+                self._announce_kv_memory()
         else:
             self._max_len = max_len or getattr(
                 getattr(model, "config", None), "max_position_embeddings",
@@ -570,6 +582,25 @@ class ServingEngine:
             f"{len(meta.get('quantized', ()))} weights, "
             f"{meta.get('bytes_saved', 0) / 1e6:.1f} MB HBM reads saved "
             "per full weight pass\n")
+
+    def _announce_kv_memory(self) -> None:
+        """One-time (construction, cold path) observability for the KV
+        memory levers (ROADMAP item 4): int8 KV pages and/or the host-RAM
+        prefix tier. Off path runs none of this."""
+        eng = self._engine
+        parts = []
+        if eng.kv_quant is not None:
+            parts.append(f"kv_quant={eng.kv_quant}")
+        if eng.kv_host is not None:
+            _safe_set("paddle_serving_kv_host_budget_bytes",
+                      "byte budget of the host-RAM prefix spill tier",
+                      eng.kv_host.max_bytes)
+            parts.append(
+                f"host tier {eng.kv_host.max_bytes / 1e6:.1f} MB")
+        sys.stderr.write(
+            f"[serving] KV memory: {', '.join(parts)} "
+            f"({eng.pool.usable} device pages x "
+            f"{eng.kv_stats()['page_bytes']} B)\n")
 
     def _announce_spec(self) -> None:
         """One-time (construction, cold path) observability for

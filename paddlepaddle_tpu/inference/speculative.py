@@ -323,7 +323,13 @@ class SpeculativeDecoder:
             rows = jnp.arange(S, dtype=jnp.int32)
             # the k+1-position target forward IS the engine's paged decode
             # forward at W=k+1 — one implementation, so the verify path
-            # can never diverge from single-token decode
+            # can never diverge from single-token decode. This includes
+            # kv_quant="int8": verify scatters quantized pages and
+            # dequantizes in the same kernel (or reference) pass as W=1
+            # decode, while the draft keeps its own full-precision
+            # contiguous caches above — acceptance compares target
+            # greedy tokens, so quantization error shows up as a lower
+            # acceptance rate, never as a divergent committed stream
             toks = jnp.concatenate([tokens[:, None], proposals], axis=1)
             logits, caches = eng._forward_paged(
                 params, toks, caches, page_table, lens)

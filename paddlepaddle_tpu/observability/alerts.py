@@ -346,6 +346,19 @@ def default_rules() -> List[AlertRule]:
             description="the paged KV pool had zero free pages for a full "
                         "minute — admissions are queuing on preemption"),
         AlertRule(
+            "kv_host_tier_full",
+            # published by engines with the host prefix tier armed
+            # (ROADMAP item 4): sustained near-full occupancy means every
+            # further spill discards a cached prefix — the tier has
+            # degraded to plain eviction and the budget needs raising
+            [AlertCondition("paddle_serving_kv_host_occupancy", 60.0,
+                            "avg", ">=", 0.9)],
+            for_s=0.0, severity="warn",
+            description="the host-RAM prefix tier averaged >= 90% of its "
+                        "byte budget over the last minute — spills are "
+                        "discarding cached prefixes instead of keeping "
+                        "them warm"),
+        AlertRule(
             "recompile_storm",
             [AlertCondition("paddle_jit_compiles_total", 60.0, "avg",
                             ">", 0.2)],

@@ -15,6 +15,9 @@ into named buckets:
 * ``workspace``    — allocator bytes held beyond live arrays (compile
   scratch, donation slack, fragmentation); only when the backend
   reports ``memory_stats``
+* ``kv_host_spill`` — prefix-cache page slabs spilled to the host-RAM
+  tier (ROADMAP item 4); HOST bytes, so deliberately excluded from the
+  attributed-device sum that ``unattributed`` reconciles against
 * ``unattributed`` — live array bytes no bucket claims
 
 Gauges (``paddle_mem_bytes{bucket=}``, ``paddle_mem_total_bytes``,
@@ -44,7 +47,7 @@ from typing import Dict, List, Optional
 from ..core import flags as _flags
 
 BUCKETS = ("params", "kv_pages", "prefix_pinned", "draft", "workspace",
-           "unattributed")
+           "kv_host_spill", "unattributed")
 
 # module-level so engines can register BEFORE (or without) the ledger
 # being armed — arming later must see engines constructed earlier
@@ -92,18 +95,35 @@ def leak_check(engine) -> Dict[str, int]:
     """Reconcile the page pool's used count against slot + prefix
     ownership. ``leaked_pages`` is the pages the pool says are out but
     nobody owns (a dropped release); negative would mean double
-    ownership. Contiguous-layout engines have no pool — zeros."""
+    ownership. Contiguous-layout engines have no pool — zeros.
+
+    With the host prefix tier armed the check spans both tiers: a prefix
+    hash must live in the device cache XOR the host tier (``tier_overlap``
+    — a hash in both means a spill forgot to evict, i.e. double-resident
+    KV), and ``host_entries``/``host_bytes`` make the host side of "zero
+    leaked pages either tier" auditable from one call."""
     if getattr(engine, "kv_layout", None) != "paged":
         return {"pages_used": 0, "slot_pages": 0, "prefix_pages": 0,
-                "leaked_pages": 0}
+                "leaked_pages": 0, "host_entries": 0, "host_bytes": 0,
+                "tier_overlap": 0}
     slot_pages = sum(len(p) for p in engine._slot_pages)
     prefix_pages = int(engine.prefix.cached_pages)
     used = int(engine.pool.used)
+    host = getattr(engine, "kv_host", None)
+    host_entries = host_bytes = overlap = 0
+    if host is not None:
+        host_entries = len(host)
+        host_bytes = int(host.used_bytes)
+        overlap = sum(1 for h in host.keys()
+                      if engine.prefix.lookup(h) is not None)
     return {
         "pages_used": used,
         "slot_pages": int(slot_pages),
         "prefix_pages": prefix_pages,
         "leaked_pages": used - slot_pages - prefix_pages,
+        "host_entries": host_entries,
+        "host_bytes": host_bytes,
+        "tier_overlap": overlap,
     }
 
 
@@ -145,6 +165,12 @@ class MemoryLedger:
                           * int(ks["prefix"]["cached_pages"]))
             buckets["kv_pages"] += max(kv_bytes - pinned, 0)
             buckets["prefix_pinned"] += pinned
+            host = ks.get("host") or {}
+            if host.get("enabled"):
+                # host-RAM slabs, not device memory: tracked as its own
+                # bucket but kept OUT of the attributed-device sum below
+                buckets["kv_host_spill"] += int(host.get("used_bytes", 0)
+                                                or 0)
             spec = getattr(eng, "spec", None)
             if spec is not None:
                 buckets["draft"] += _tree_bytes(

@@ -69,7 +69,7 @@ NEG_INF = -1e30
 
 def paged_attention_supported(*, page_size: int, head_dim: int,
                               num_heads: int, num_kv_heads: int,
-                              plan=None) -> tuple:
+                              plan=None, kv_quant=None) -> tuple:
     """(ok, reason) — the fallback matrix for the decode kernel. The
     engine calls this ONCE at construction; a False here is a loud
     fallback to the reference ``pool[page_table]`` formulation, never a
@@ -78,6 +78,11 @@ def paged_attention_supported(*, page_size: int, head_dim: int,
         return False, "pallas unavailable"
     if not flag_value("fused_paged_attention"):
         return False, "FLAGS_fused_paged_attention off"
+    if kv_quant not in (None, "off", "int8"):
+        # int8 dequant happens inside the VMEM pass (codes * per-page-
+        # per-head scale, the standard quant-kernel pattern); any other
+        # scheme is a loud fallback to the gather-dequant reference
+        return False, f"kv_quant {kv_quant!r} has no in-kernel dequant"
     if plan is not None:
         # sharded pools would need the kernel to see only the local KV
         # shard + a head-offset — a named follow-up seam, not a silent
@@ -96,10 +101,19 @@ def paged_attention_supported(*, page_size: int, head_dim: int,
     return True, "ok"
 
 
-def _paged_attn_kernel(pt_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
-                       m_ref, l_ref, acc_ref, *, page_size, rep, scale,
-                       num_pages_per_slot):
-    """Grid (slot, logical page): online-softmax accumulate one page."""
+def _paged_attn_kernel(pt_ref, lens_ref, q_ref, k_ref, v_ref, *rest,
+                       page_size, rep, scale, num_pages_per_slot,
+                       quantized):
+    """Grid (slot, logical page): online-softmax accumulate one page.
+    ``quantized`` is a static trace-time flag: the int8 variant takes two
+    extra scale operands (``[pages, kvh]`` f32, blocked per page) and
+    dequantizes the page inside the VMEM pass — codes are cast to f32 and
+    multiplied by the per-page-per-head scale, so int8 K/V bytes cross HBM
+    and full precision exists only in VMEM."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
     s, j = pl.program_id(0), pl.program_id(1)
     ps = page_size
 
@@ -112,6 +126,9 @@ def _paged_attn_kernel(pt_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
     qb = q_ref[0]                                  # [W, h, hd]
     kb = k_ref[0].astype(jnp.float32)              # [ps, kvh, hd]
     vb = v_ref[0].astype(jnp.float32)
+    if quantized:
+        kb = kb * ks_ref[0][None, :, None]         # scale [kvh] broadcast
+        vb = vb * vs_ref[0][None, :, None]
     W = qb.shape[0]
     kvh, hd = kb.shape[1], kb.shape[2]
 
@@ -156,14 +173,22 @@ def _paged_attn_kernel(pt_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def paged_attention(q, k_pool, v_pool, page_table, lens, *, rep, scale,
-                    interpret=None):
+                    k_scale=None, v_scale=None, interpret=None):
     """Attend ``q [S, W, h, hd]`` over each slot's paged KV through the
     page table, in-kernel. Returns ``out [S, W, h, hd]`` in q's dtype.
     New K/V for this step must already be scattered into the pool (the
-    engine writes pages first; the causal mask then admits them)."""
+    engine writes pages first; the causal mask then admits them).
+
+    ``k_scale``/``v_scale`` (``[pages, kvh]`` f32, both or neither) arm
+    the int8 path: the pools hold int8 codes and each page is dequantized
+    in VMEM as ``codes * scale`` — the page walk, masking and softmax are
+    byte-for-byte the same program otherwise."""
     S, W, h, hd = q.shape
     ps, kvh = k_pool.shape[1], k_pool.shape[2]
     P = page_table.shape[1]
+    quantized = k_scale is not None
+    if quantized != (v_scale is not None):
+        raise ValueError("k_scale and v_scale must be passed together")
     if interpret is None:
         interpret = interpret_mode()
 
@@ -175,14 +200,28 @@ def paged_attention(q, k_pool, v_pool, page_table, lens, *, rep, scale,
         visible = j * ps <= lens[s] + (W - 1)
         return (jnp.where(visible, pt[s, j], 0), 0, 0, 0)
 
+    def idx_scale(s, j, pt, lens):
+        # same redirect as the pages: a masked page's scale row is the
+        # null page's — finite, and the mask discards the product anyway
+        visible = j * ps <= lens[s] + (W - 1)
+        return (jnp.where(visible, pt[s, j], 0), 0)
+
+    in_specs = [
+        pl.BlockSpec((1, W, h, hd), lambda s, j, pt, lens: (s, 0, 0, 0)),
+        pl.BlockSpec((1, ps, kvh, hd), idx_kv),
+        pl.BlockSpec((1, ps, kvh, hd), idx_kv),
+    ]
+    operands = [q, k_pool, v_pool]
+    if quantized:
+        in_specs += [pl.BlockSpec((1, kvh), idx_scale),
+                     pl.BlockSpec((1, kvh), idx_scale)]
+        operands += [jnp.asarray(k_scale, jnp.float32),
+                     jnp.asarray(v_scale, jnp.float32)]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(S, P),
-        in_specs=[
-            pl.BlockSpec((1, W, h, hd), lambda s, j, pt, lens: (s, 0, 0, 0)),
-            pl.BlockSpec((1, ps, kvh, hd), idx_kv),
-            pl.BlockSpec((1, ps, kvh, hd), idx_kv),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, W, h, hd),
                                lambda s, j, pt, lens: (s, 0, 0, 0)),
         scratch_shapes=[
@@ -193,7 +232,7 @@ def paged_attention(q, k_pool, v_pool, page_table, lens, *, rep, scale,
     )
     kernel = functools.partial(
         _paged_attn_kernel, page_size=ps, rep=rep, scale=scale,
-        num_pages_per_slot=P)
+        num_pages_per_slot=P, quantized=quantized)
     # the kernel body is dtype-explicit (int32 positions, f32
     # accumulators) so it traces identically with the package's global
     # x64 on or off
@@ -203,4 +242,4 @@ def paged_attention(q, k_pool, v_pool, page_table, lens, *, rep, scale,
         out_shape=jax.ShapeDtypeStruct((S, W, h, hd), q.dtype),
         interpret=interpret,
     )(jnp.asarray(page_table, jnp.int32), jnp.asarray(lens, jnp.int32),
-      q, k_pool, v_pool)
+      *operands)

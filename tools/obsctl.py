@@ -834,6 +834,33 @@ def _top_frame(args) -> list:
             lines.append(f"  hbm: headroom {100.0 * hpts[-1]:.1f}%  "
                          f"{_spark(hpts)}")
 
+    # KV strip: device pool + host prefix tier split, from any serving
+    # provider's health block (paged engines only)
+    kv = None
+    for prov in provs.values():
+        if isinstance(prov, dict) and isinstance(prov.get("kv"), dict) \
+                and prov["kv"].get("layout") == "paged":
+            kv = prov["kv"]
+            break
+    if kv is not None:
+        parts = [f"device {kv.get('pages_used')}/{kv.get('pages_total')} "
+                 f"pages ({100.0 * float(kv.get('occupancy') or 0):.0f}%)"
+                 f" quant={kv.get('kv_quant', 'off')}"]
+        host = kv.get("host") or {}
+        if host.get("enabled"):
+            used_mb = (host.get("used_bytes") or 0) / 2**20
+            budget_mb = (host.get("budget_bytes") or 0) / 2**20
+            parts.append(
+                f"host {used_mb:.1f}/{budget_mb:.0f} MB "
+                f"({100.0 * float(host.get('occupancy') or 0):.0f}%) "
+                f"spills={host.get('spills')} restores={host.get('restores')}"
+                f" discards={host.get('discards')}"
+                + (f" restore_p50={host['restore_ms_p50']:.0f}ms"
+                   if host.get("restore_ms_p50") is not None else ""))
+        else:
+            parts.append("host tier off")
+        lines.append("  kv: " + "  ".join(parts))
+
     # fleet census + rollout (from the fleet /healthz provider, if any)
     fleet = None
     for prov in provs.values():

@@ -168,6 +168,24 @@ def serving_metrics(doc: dict) -> Dict[str, Tuple[float, str]]:
     # until the baseline is re-cut with it
     put("serving.goodput_tok_s", body.get("goodput_tok_s"), HIGHER)
     put("serving.waste_pct", body.get("waste_pct"), LOWER)
+    # tiered-prefix columns (serving_bench --kv-host-mb N): the host-tier
+    # restore must stay far cheaper than the prefill it replaces — both
+    # percentiles gated LOWER so a serializer/scatter regression in the
+    # spill/restore path cannot hide behind the hit-rate staying high
+    put("serving.prefix_restore_ms_p50",
+        body.get("prefix_restore_ms_p50"), LOWER)
+    put("serving.prefix_restore_ms_p99",
+        body.get("prefix_restore_ms_p99"), LOWER)
+    # int8-KV arm (serving_bench --ab --kv-quant int8): at the SAME pool
+    # bytes the quantized engine must keep its throughput AND its packing
+    # win (the ~2x-pages concurrency peak) — either sliding means the
+    # quant path lost its reason to exist
+    kvq = body.get("kv_quant_ab")
+    if isinstance(kvq, dict) and isinstance(kvq.get("int8"), dict):
+        put("serving.kvq_mixed_tok_s",
+            kvq["int8"].get("aggregate_tok_s"), HIGHER)
+        put("serving.kvq_concurrency_peak",
+            kvq["int8"].get("concurrency_peak"), HIGHER)
     # speculative column (serving_bench --spec-k N): gate the throughput;
     # the acceptance rate is a DRAFT-QUALITY number, not an engine-perf
     # number (a better-trained draft raises it, an engine change cannot),

@@ -63,6 +63,13 @@
 #     --netchaos-first "down:blackhole:0.1" or --netchaos
 #     "down:throttle:@1:512" for the slow-loris flavor, seeded via
 #     PADDLE_NETCHAOS_SEED)
+#   * tiered KV: an int8-KV engine with a deliberately tiny device pool
+#     AND a one-slab host budget churns 4 rotating prefixes — spills,
+#     restores, and true host-tier discards all fire, then the cross-tier
+#     audit must hold: zero leaked device pages, zero prefix hashes
+#     resident on both tiers, and the host byte ledger drains to exactly
+#     zero when every slab is popped
+#     (test_kv_quant_tier.py::test_chaos_tiered_kv_zero_leak_both_tiers)
 #   * goodput reconciliation: every chaos drill above is ALSO a ledger
 #     audit — the goodput ledger attributes every decoded token exactly
 #     once (useful + hedge_loser + retry_discard + cancel/deadline +

@@ -273,11 +273,17 @@ def gen_prompts(args, cfg, rng):
             out.append((rng.integers(0, V, (n,)).astype(np.int32), None))
         return out
     if args.profile == "prefix":
-        system = rng.integers(0, V, (args.prefix_len,)).astype(np.int32)
+        # --prefix-count > 1 is the TIERED-cache drill shape: N distinct
+        # system prompts visited round-robin, so a device pool smaller
+        # than the prefix working set must spill/restore through the
+        # host tier (--kv-host-mb) to keep the hit rate up
+        systems = [rng.integers(0, V, (args.prefix_len,)).astype(np.int32)
+                   for _ in range(max(args.prefix_count, 1))]
         out = []
-        for _ in range(args.reqs):
+        for i in range(args.reqs):
             tail = rng.integers(0, V, (int(rng.integers(16, 48)),))
-            out.append((np.concatenate([system, tail.astype(np.int32)]),
+            out.append((np.concatenate([systems[i % len(systems)],
+                                        tail.astype(np.int32)]),
                         args.prefix_len))
         return out
     return [(rng.integers(0, V, (int(rng.integers(lo, hi)),)).astype(np.int32),
@@ -420,7 +426,7 @@ def build_draft(args, model):
 
 def run_serving(model, prompts, args, kv_layout, slots, num_pages=None,
                 prefix_cache=True, warm=True, tp=1, spec=False,
-                fused=False):
+                fused=False, kv_quant=None, kv_host_bytes=None):
     """One engine pass over the workload; returns the metrics row.
     ``tp > 1`` serves through a tensor-parallel engine (sharding plan over
     an ``mp``-axis mesh: weights column/row-parallel, KV pool sharded on
@@ -441,6 +447,7 @@ def run_serving(model, prompts, args, kv_layout, slots, num_pages=None,
                        # PADDLE_FUSED_KERNELS=1 must not arm the kernel
                        # in a row labeled (and baselined) as reference
                        fused_kernels=bool(fused),
+                       kv_quant=kv_quant, kv_host_bytes=kv_host_bytes,
                        **spec_kw) as eng:
         if warm:
             warm_engine(eng, model, prompts, args, prefix_cache)
@@ -478,6 +485,17 @@ def run_serving(model, prompts, args, kv_layout, slots, num_pages=None,
         row["prefix_hit_rate"] = (round(pfx["hits"] / looked, 4)
                                   if looked else None)
         row["prefix_evictions"] = pfx["evictions"]
+        row["kv_quant"] = kv["kv_quant"]
+        row["kv_page_bytes"] = kv["page_bytes"]
+        host = kv.get("host") or {}
+        if host.get("enabled"):
+            # the tiered-prefix columns perf_gate tracks: restore latency
+            # percentiles plus the spill/restore/discard census
+            row["prefix_restore_ms_p50"] = host.get("restore_ms_p50")
+            row["prefix_restore_ms_p99"] = host.get("restore_ms_p99")
+            row["prefix_spills"] = host.get("spills")
+            row["prefix_restores"] = host.get("restores")
+            row["prefix_host_discards"] = host.get("discards")
     if spec_info is not None:
         row["spec_k"] = spec_info["k"]
         row["draft"] = args.draft
@@ -929,6 +947,11 @@ def main():
     ap.add_argument("--budget-slots", type=int, default=None,
                     help="contiguous slots whose bytes fix the A/B budget "
                     "(default slots//2)")
+    ap.add_argument("--prefix-count", type=int, default=1,
+                    help="distinct system prompts for --profile prefix "
+                         "(> 1 turns it into the tiered-cache drill: a "
+                         "prefix working set bigger than the device pool "
+                         "round-robins through the host tier)")
     ap.add_argument("--prefix-len", type=int, default=256,
                     help="shared system-prompt length (prefix profile)")
     ap.add_argument("--replicas", type=int, default=1,
@@ -975,6 +998,16 @@ def main():
                     "lower bound on this harness)")
     ap.add_argument("--draft-quant", action="store_true",
                     help="serve the draft weight-only int8")
+    ap.add_argument("--kv-quant", choices=("off", "int8"), default="off",
+                    help="quantize paged KV pages to int8 codes with "
+                         "per-page-per-head scales (halves page bytes; "
+                         "with --ab, adds an int8 arm at the SAME byte "
+                         "budget as the bf16 paged arm)")
+    ap.add_argument("--kv-host-mb", type=int, default=0,
+                    help="host-RAM prefix tier budget in MB: refcount-0 "
+                         "prefix entries spill page slabs to host RAM on "
+                         "eviction and restore into fresh device pages "
+                         "on re-hit (0 = tier off)")
     ap.add_argument("--fused-kernels", action="store_true",
                     help="arm the fused Pallas paged-attention kernel "
                     "(FLAGS_fused_kernels; interpret-mode on CPU) for the "
@@ -1130,12 +1163,45 @@ def main():
         body.update(pag)         # headline row = the paged engine
         body["contiguous"] = con
         body["kv_budget_slots"] = slots_c
+        if args.kv_quant == "int8":
+            # int8 arm at the SAME device byte budget: the bf16 arm's
+            # pool bytes re-divided by the int8 page size (codes + f32
+            # per-page-per-head scales) — more pages, identical HBM spend
+            cfg_kv = model.config
+            int8_page_bytes = (
+                args.page_size * 2 * cfg_kv.num_key_value_heads
+                * cfg_kv.head_dim * cfg_kv.num_hidden_layers
+                + 2 * cfg_kv.num_key_value_heads * 4
+                * cfg_kv.num_hidden_layers)
+            usable = (pages_budget - 1) * pag["kv_page_bytes"]
+            pages_int8 = int(usable // int8_page_bytes) + 1
+            qrow = run_serving(model, prompts, args, "paged", args.slots,
+                               num_pages=pages_int8, kv_quant="int8")
+            fmt(qrow, f"paged int8 x{args.slots}")
+            ratio = (qrow["concurrency_peak"]
+                     / max(pag["concurrency_peak"], 1))
+            print(f"(int8 KV: {pages_int8 - 1} pages vs "
+                  f"{pages_budget - 1} at equal bytes, "
+                  f"{ratio:.2f}x concurrency peak)")
+            body["kv_quant_ab"] = {
+                "baseline": {k: pag.get(k) for k in
+                             ("aggregate_tok_s", "concurrency_peak",
+                              "kv_pages_total", "kv_page_bytes")},
+                "int8": qrow,
+                "concurrency_ratio": round(ratio, 3),
+            }
     else:
         row = run_serving(model, prompts, args, args.kv_layout, args.slots,
                           num_pages=args.num_pages,
-                          fused=args.fused_kernels)
+                          fused=args.fused_kernels,
+                          kv_quant=(None if args.kv_quant == "off"
+                                    else args.kv_quant),
+                          kv_host_bytes=(args.kv_host_mb << 20
+                                         if args.kv_host_mb else None))
         fmt(row, f"{args.kv_layout} x{args.slots}"
-            + (" +fused" if args.fused_kernels else ""))
+            + (" +fused" if args.fused_kernels else "")
+            + (f" kv={args.kv_quant}" if args.kv_quant != "off" else "")
+            + (f" host={args.kv_host_mb}MB" if args.kv_host_mb else ""))
         body.update(row)
         print(f"({row['aggregate_tok_s'] / max(single_tps, 1e-9):.1f}x "
               "single-sequence)")
